@@ -1,0 +1,16 @@
+"""dynamo_tpu — a TPU-native distributed LLM inference serving framework.
+
+Re-implements the capability surface of NVIDIA Dynamo (see SURVEY.md for the
+structural analysis of the reference) with a TPU-first design:
+
+- native JAX/XLA engine (pjit-sharded models, paged KV cache, continuous
+  batching) instead of subprocess GPU engines,
+- Pallas kernels for the hot ops (paged attention, block copy/relayout),
+- ICI/DCN mesh-to-mesh transfers for disaggregated prefill->decode KV movement
+  instead of NIXL/RDMA,
+- an asyncio distributed runtime (component/endpoint model, discovery with
+  leases+watches, request plane + TCP call-home response streams) instead of
+  the reference's tokio/etcd/NATS runtime.
+"""
+
+__version__ = "0.1.0"

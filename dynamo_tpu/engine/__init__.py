@@ -1,0 +1,1 @@
+from dynamo_tpu.engine.config import ModelConfig, EngineConfig, get_model_config

@@ -1,0 +1,132 @@
+"""Model + engine configuration.
+
+The reference carries model metadata in a ModelDeploymentCard built from HF
+config.json / GGUF (reference: lib/llm/src/model_card/model.rs:55-201). Here the
+architectural subset needed by the JAX engine lives in ModelConfig; the serving
+metadata (tokenizer, chat template, context length) lives in
+dynamo_tpu/llm/model_card.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyper-parameters for a decoder-only transformer."""
+
+    name: str = "tiny"
+    vocab_size: int = 256
+    hidden_size: int = 128
+    intermediate_size: int = 256
+    num_layers: int = 2
+    num_heads: int = 4
+    num_kv_heads: int = 2
+    head_dim: int = 32
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    max_model_len: int = 2048
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    # MoE (Mixtral-style); num_experts == 0 means dense MLP.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # Multimodal (Qwen2-VL-style); None means text-only.
+    vision: Optional["VisionConfig"] = None
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    """Vision encoder config (ViT-style) for multimodal models."""
+
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 128
+    intermediate_size: int = 256
+    num_layers: int = 2
+    num_heads: int = 4
+    # Projection into the text model's embedding space happens at hidden_size
+    # -> text hidden_size.
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Serving engine knobs (continuous batching, paging, buckets).
+
+    Mirrors the role of engine args passed to vLLM/SGLang by the reference
+    (reference: launch/dynamo-run/src/flags.rs, examples/llm/configs/*.yaml);
+    block/page size default matches the canonical example config's KV block 64
+    (reference: examples/llm/configs/disagg_router.yaml).
+    """
+
+    page_size: int = 64                 # tokens per KV page
+    num_pages: int = 512                # HBM pages per engine
+    max_slots: int = 8                  # concurrent decode slots
+    max_prefill_chunk: int = 512        # longest single prefill step
+    prefill_buckets: tuple = (16, 32, 64, 128, 256, 512)
+    # (page-count buckets are derived: pow2 up to max_model_len/page_size)
+    max_model_len: int = 2048
+    # mesh axes sizes: (dp, tp). dp>1 replicates the whole engine.
+    tp: int = 1
+    dp: int = 1
+    # sequence-parallel axis for long-context ring attention (0 = off)
+    sp: int = 1
+
+
+# -- named architectures ------------------------------------------------------
+
+_CONFIGS = {
+    # test-size models
+    "tiny": ModelConfig(),
+    "tiny-moe": ModelConfig(
+        name="tiny-moe", num_experts=4, num_experts_per_tok=2,
+        intermediate_size=256,
+    ),
+    "tiny-vl": ModelConfig(name="tiny-vl", vision=VisionConfig()),
+    # Llama-3.2-1B-class: the single-chip flagship (fits v5e-1 HBM with cache)
+    "llama3-1b": ModelConfig(
+        name="llama3-1b", vocab_size=128256, hidden_size=2048,
+        intermediate_size=8192, num_layers=16, num_heads=32, num_kv_heads=8,
+        head_dim=64, rope_theta=500000.0, max_model_len=8192,
+    ),
+    # DeepSeek-R1-Distill-Llama-8B == Llama-3.1-8B architecture
+    "llama3-8b": ModelConfig(
+        name="llama3-8b", vocab_size=128256, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        head_dim=128, rope_theta=500000.0, max_model_len=16384,
+    ),
+    "llama3-70b": ModelConfig(
+        name="llama3-70b", vocab_size=128256, hidden_size=8192,
+        intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
+        head_dim=128, rope_theta=500000.0, max_model_len=16384,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        head_dim=128, rope_theta=1e6, max_model_len=16384,
+        num_experts=8, num_experts_per_tok=2,
+    ),
+    "qwen2-vl-7b": ModelConfig(
+        name="qwen2-vl-7b", vocab_size=152064, hidden_size=3584,
+        intermediate_size=18944, num_layers=28, num_heads=28, num_kv_heads=4,
+        head_dim=128, rope_theta=1e6, max_model_len=16384,
+        vision=VisionConfig(image_size=448, patch_size=14, hidden_size=1280,
+                            intermediate_size=3420, num_layers=32,
+                            num_heads=16),
+    ),
+}
+
+
+def get_model_config(name: str) -> ModelConfig:
+    if name not in _CONFIGS:
+        raise KeyError(f"unknown model config {name!r}; have {sorted(_CONFIGS)}")
+    return _CONFIGS[name]

@@ -1,0 +1,227 @@
+"""NativeEngine: the JAX/XLA serving engine.
+
+This replaces the reference's GPU engine side-cars (vLLM/SGLang subprocesses
+over ZMQ, TRT-LLM over C++ FFI — reference: lib/llm/src/engines/, SURVEY.md
+§2.8) with an in-process JAX engine: the model runs under jit on the local
+mesh, the KV cache is donated across steps so it never leaves HBM, and the
+scheduler (engine/scheduler.py) feeds bucketed static-shape steps so XLA
+compiles a small fixed program set.
+
+Step fusion: forward + last-token gather + sampling are one jitted program, so
+only the sampled token ids ([B] int32) cross the device->host boundary each
+step.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Dict, List, Optional, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.kv_cache import SequenceState
+from dynamo_tpu.engine.sampler import make_keys, sample
+from dynamo_tpu.engine.scheduler import (
+    DecodePlan, EngineRequest, PrefillPlan, SamplingParams, Scheduler,
+)
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.llama import AttnMetadata
+from dynamo_tpu.parallel.mesh import single_device_mesh
+
+
+@dataclasses.dataclass
+class StepOutput:
+    """One emitted event for one request after an engine step."""
+
+    request_id: str
+    token: Optional[int]           # None when finished without a new token
+    finished: bool = False
+    finish_reason: Optional[str] = None   # "stop" | "length" | "cancelled"
+
+
+class NativeEngine:
+    """Continuous-batching JAX engine for one model on one mesh."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        engine_cfg: EngineConfig,
+        mesh: Optional[Mesh] = None,
+        params=None,
+        eos_token_ids: Optional[Set[int]] = None,
+        seed: int = 0,
+    ):
+        self.model_cfg = model_cfg
+        self.cfg = engine_cfg
+        self.mesh = mesh if mesh is not None else single_device_mesh()
+        self.eos_token_ids = set(eos_token_ids or ())
+        self.scheduler = Scheduler(engine_cfg)
+        self.step_count = 0
+        self._finished_cb = None
+
+        shardings = jax.tree.map(
+            lambda spec: NamedSharding(self.mesh, spec),
+            llama.param_shardings(model_cfg),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        if params is None:
+            init = jax.jit(
+                functools.partial(llama.init_params, cfg=model_cfg),
+                out_shardings=shardings)
+            params = init(jax.random.PRNGKey(seed))
+        else:
+            params = jax.device_put(params, shardings)
+        self.params = params
+
+        cache_shd = NamedSharding(self.mesh, llama.cache_sharding(model_cfg))
+        init_cache = jax.jit(
+            functools.partial(
+                llama.init_cache, model_cfg,
+                num_pages=engine_cfg.num_pages, page_size=engine_cfg.page_size),
+            out_shardings={"k": cache_shd, "v": cache_shd})
+        self.cache = init_cache()
+
+        self._step_fn = jax.jit(
+            functools.partial(_engine_step, model_cfg,
+                              tuple(sorted(self.eos_token_ids))),
+            donate_argnums=(1,))
+
+    # -- public API ----------------------------------------------------------
+
+    def add_request(self, req: EngineRequest) -> None:
+        self.scheduler.add_request(req)
+
+    def abort(self, request_id: str) -> bool:
+        return self.scheduler.abort(request_id)
+
+    def has_work(self) -> bool:
+        s = self.scheduler
+        return bool(s.waiting) or any(x is not None for x in s.running)
+
+    def step(self) -> List[StepOutput]:
+        """Run one scheduler step on the device; returns per-request events."""
+        plan = self.scheduler.schedule()
+        if plan is None:
+            return []
+        self.step_count += 1
+        if isinstance(plan, PrefillPlan):
+            return self._run_prefill(plan)
+        return self._run_decode(plan)
+
+    def generate(self, prompt: List[int], params: SamplingParams,
+                 request_id: str = "req") -> List[int]:
+        """Synchronous convenience: run one request to completion."""
+        self.add_request(EngineRequest(request_id, prompt, params))
+        out: List[int] = []
+        while True:
+            events = self.step()
+            done = False
+            for ev in events:
+                if ev.request_id != request_id:
+                    continue
+                if ev.token is not None:
+                    out.append(ev.token)
+                done |= ev.finished
+            if done:
+                return out
+            if not events and not self.has_work():
+                return out
+
+    # -- internals -----------------------------------------------------------
+
+    def _sampling_arrays(self, reqs: List[Optional[SequenceState]]):
+        n = len(reqs)
+        temp = np.zeros((n,), np.float32)
+        top_k = np.zeros((n,), np.int32)
+        top_p = np.ones((n,), np.float32)
+        seeds = np.zeros((n,), np.int32)
+        counters = np.zeros((n,), np.int32)
+        min_toks = np.zeros((n,), np.int32)
+        for i, seq in enumerate(reqs):
+            if seq is None:
+                continue
+            p = self.scheduler.params[seq.request_id]
+            temp[i] = p.temperature
+            top_k[i] = p.top_k
+            top_p[i] = p.top_p
+            seeds[i] = p.seed & 0x7FFFFFFF
+            counters[i] = len(seq.output)
+            min_toks[i] = p.min_tokens
+        return temp, top_k, top_p, seeds, counters, min_toks
+
+    def _run_device_step(self, plan, reqs):
+        temp, top_k, top_p, seeds, counters, min_toks = \
+            self._sampling_arrays(reqs)
+        tokens, self.cache = self._step_fn(
+            self.params, self.cache,
+            jnp.asarray(plan.tokens), jnp.asarray(plan.positions),
+            jnp.asarray(plan.page_table), jnp.asarray(plan.kv_lens),
+            jnp.asarray(plan.write_idx), jnp.asarray(plan.last_idx),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(seeds), jnp.asarray(counters),
+            jnp.asarray(min_toks))
+        return np.asarray(jax.device_get(tokens))
+
+    def _run_prefill(self, plan: PrefillPlan) -> List[StepOutput]:
+        sampled = self._run_device_step(plan, [plan.seq])
+        tok = self.scheduler.commit_prefill(
+            plan, int(sampled[0]) if plan.is_last_chunk else None)
+        if tok is None:
+            return []
+        return [self._postprocess(plan.seq, tok)]
+
+    def _run_decode(self, plan: DecodePlan) -> List[StepOutput]:
+        sampled = self._run_device_step(plan, plan.seqs)
+        emitted = self.scheduler.commit_decode(plan, sampled)
+        return [self._postprocess(seq, tok) for seq, tok in emitted]
+
+    def _postprocess(self, seq: SequenceState, tok: int) -> StepOutput:
+        p = self.scheduler.params[seq.request_id]
+        n_out = len(seq.output)
+        finish = None
+        emit: Optional[int] = tok
+        # Hidden stop ids always stop and are never emitted. EOS before
+        # min_tokens cannot occur: the device step masks eos logits while
+        # the emitted count is below min_tokens.
+        if tok in p.stop_token_ids:
+            finish, emit = "stop", None
+        elif not p.ignore_eos and tok in self.eos_token_ids:
+            finish, emit = "stop", None
+        elif n_out >= p.max_tokens:
+            finish = "length"
+        if finish is not None:
+            self.scheduler.finish(seq)
+        return StepOutput(seq.request_id, emit, finish is not None, finish)
+
+    # -- introspection -------------------------------------------------------
+
+    def metrics(self):
+        return self.scheduler.metrics()
+
+    def drain_kv_events(self):
+        return self.scheduler.allocator.drain_events()
+
+
+def _engine_step(cfg: ModelConfig, eos_ids: tuple, params, cache, tokens,
+                 positions, page_table, kv_lens, write_idx, last_idx,
+                 temperature, top_k, top_p, seeds, counters, min_tokens):
+    """forward + gather last logits + sample, fused into one XLA program."""
+    meta = AttnMetadata(positions=positions, page_table=page_table,
+                        kv_lens=kv_lens, write_idx=write_idx)
+    logits, cache = llama.forward(params, cfg, tokens, cache, meta)
+    b = tokens.shape[0]
+    last = logits[jnp.arange(b), last_idx]          # [B, V] f32
+    if eos_ids:
+        # min_tokens: ban eos until enough tokens have been emitted
+        ban = (counters < min_tokens)[:, None]      # [B, 1]
+        eos = jnp.asarray(eos_ids, jnp.int32)
+        eos_mask = jnp.zeros((last.shape[-1],), bool).at[eos].set(True)
+        last = jnp.where(ban & eos_mask[None, :], -1e30, last)
+    keys = make_keys(seeds, counters)
+    toks = sample(last, temperature, top_k, top_p, keys)
+    return toks, cache

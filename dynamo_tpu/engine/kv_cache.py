@@ -1,0 +1,177 @@
+"""Host-side paged KV cache bookkeeping: page allocator + per-sequence state.
+
+This is the engine-internal analogue of the reference's KV block pools
+(reference: lib/llm/src/kv/reuse.rs:50-214 AvailableBlocks,
+kv/reserved.rs:66-140 ReservedBlocks): free pages are reclaimable by content
+hash (prefix cache), in-flight pages are ref-counted and shared between
+sequences with identical prefixes. The device arrays themselves live in the
+engine (models/*.init_cache); only integer bookkeeping happens here, so the
+scheduler never touches HBM.
+
+Prefix reuse hashing follows the reference's chained sequence hash
+(reference: lib/llm/src/tokens.rs:30-210): each full page is identified by
+hash(parent_seq_hash, page_token_ids).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import xxhash
+
+
+def page_hash(parent: int, tokens: Sequence[int]) -> int:
+    """Chained content hash of one full page of tokens.
+
+    xxh3_64 seed 1337 over token bytes, chained with the parent hash —
+    matching the reference's block-hash recipe (reference:
+    lib/llm/src/kv_router/indexer.rs:87-104, seed at :64).
+    """
+    h = xxhash.xxh3_64(seed=1337)
+    h.update(parent.to_bytes(8, "little", signed=False))
+    for t in tokens:
+        h.update(int(t).to_bytes(4, "little", signed=True))
+    return h.intdigest()
+
+
+@dataclasses.dataclass
+class PageInfo:
+    ref_count: int = 0
+    seq_hash: Optional[int] = None   # set once the page is full + hashed
+
+
+class PageAllocator:
+    """Free-list page allocator with content-hash reuse (prefix caching).
+
+    Freed pages keep their contents and sit in a reuse map keyed by chained
+    sequence hash until evicted (LRU order), like the reference's
+    AvailableBlocks match-by-sequence-hash reclaim.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.pages: List[PageInfo] = [PageInfo() for _ in range(num_pages)]
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        # seq_hash -> page id, for pages whose ref_count dropped to 0
+        self._reusable: Dict[int, int] = {}
+        self._reusable_order: List[int] = []  # LRU eviction order (page ids)
+        # live (ref_count>0) full pages by hash, for inflight sharing
+        self._live: Dict[int, int] = {}
+        self.events: List[Tuple[str, int, int, int]] = []  # (kind, page, hash, parent)
+
+    # -- stats ---------------------------------------------------------------
+    @property
+    def num_free(self) -> int:
+        return len(self._free) + len(self._reusable)
+
+    @property
+    def usage(self) -> float:
+        return 1.0 - self.num_free / self.num_pages
+
+    def can_allocate(self, n: int) -> bool:
+        return self.num_free >= n
+
+    # -- allocation ----------------------------------------------------------
+    def allocate(self) -> int:
+        """Take one blank page (evicting from the reuse pool if needed)."""
+        if self._free:
+            pid = self._free.pop()
+        else:
+            pid = self._evict_one()
+        info = self.pages[pid]
+        info.ref_count = 1
+        info.seq_hash = None
+        return pid
+
+    def _evict_one(self) -> int:
+        while self._reusable_order:
+            pid = self._reusable_order.pop(0)
+            info = self.pages[pid]
+            if info.ref_count == 0 and info.seq_hash is not None \
+                    and self._reusable.get(info.seq_hash) == pid:
+                del self._reusable[info.seq_hash]
+                self.events.append(("removed", pid, info.seq_hash, 0))
+                info.seq_hash = None
+                return pid
+        raise MemoryError("KV cache exhausted: no free or reusable pages")
+
+    def lookup(self, seq_hash: int) -> Optional[int]:
+        """Find a page holding this hashed prefix page (live or reusable)."""
+        pid = self._live.get(seq_hash)
+        if pid is not None:
+            return pid
+        return self._reusable.get(seq_hash)
+
+    def share(self, pid: int) -> int:
+        """Add a reference to an existing page (prefix-cache hit)."""
+        info = self.pages[pid]
+        if info.ref_count == 0:
+            # revive from the reuse pool
+            if info.seq_hash is not None and self._reusable.get(info.seq_hash) == pid:
+                del self._reusable[info.seq_hash]
+                self._live[info.seq_hash] = pid
+        info.ref_count += 1
+        return pid
+
+    def seal(self, pid: int, parent_hash: int, tokens: Sequence[int]) -> int:
+        """Mark a page full and content-hashed; returns the chained hash."""
+        sh = page_hash(parent_hash, tokens)
+        info = self.pages[pid]
+        info.seq_hash = sh
+        self._live[sh] = pid
+        self.events.append(("stored", pid, sh, parent_hash))
+        return sh
+
+    def free(self, pid: int) -> None:
+        info = self.pages[pid]
+        info.ref_count -= 1
+        if info.ref_count > 0:
+            return
+        if info.seq_hash is not None:
+            if self._live.get(info.seq_hash) == pid:
+                del self._live[info.seq_hash]
+            if info.seq_hash in self._reusable:
+                # duplicate content (two requests computed the same page):
+                # only one copy is worth keeping — recycle this one as blank
+                info.seq_hash = None
+                self._free.append(pid)
+            else:
+                self._reusable[info.seq_hash] = pid
+                self._reusable_order.append(pid)
+        else:
+            self._free.append(pid)
+
+    def drain_events(self) -> List[Tuple[str, int, int, int]]:
+        ev, self.events = self.events, []
+        return ev
+
+
+@dataclasses.dataclass
+class SequenceState:
+    """Per-request device-cache bookkeeping owned by the scheduler."""
+
+    request_id: str
+    prompt: List[int]
+    pages: List[int] = dataclasses.field(default_factory=list)
+    page_hashes: List[int] = dataclasses.field(default_factory=list)
+    num_cached: int = 0       # tokens whose KV is already valid in the cache
+    num_computed: int = 0     # tokens whose KV was computed by US this request
+    output: List[int] = dataclasses.field(default_factory=list)
+    slot: int = -1            # decode slot id, -1 while prefilling
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + len(self.output)
+
+    @property
+    def all_tokens(self) -> List[int]:
+        """prompt + generated tokens; the KV-resident token sequence.
+
+        Prefill iterates over this (not just prompt) so a preempted request
+        re-prefills its generated tokens too without folding them into the
+        prompt (which would corrupt max_tokens accounting)."""
+        return self.prompt + self.output
+
+    def flat_index(self, pos: int, page_size: int) -> int:
+        return self.pages[pos // page_size] * page_size + pos % page_size

@@ -1,0 +1,57 @@
+"""Token sampling (greedy / temperature / top-k / top-p) as one jitted kernel.
+
+Matches the sampling-option surface the reference forwards to its engines
+(reference: lib/llm/src/protocols/common.rs:248 SamplingOptions — temperature,
+top_k, top_p, seed; greedy when nvext.greed_sampling or temperature==0).
+
+All-batch vectorized with static vocab: one descending sort powers both top-k
+(rank mask) and top-p (cumulative-probability mask); XLA fuses the rest.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def make_keys(seeds: jax.Array, counters: jax.Array) -> jax.Array:
+    """Per-row PRNG keys: deterministic in (request seed, token index)."""
+    base = jax.random.PRNGKey(0)
+    return jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.fold_in(base, s), c)
+    )(seeds, counters)
+
+
+def sample(
+    logits: jax.Array,        # [B, V] f32
+    temperature: jax.Array,   # [B] f32; 0 => greedy
+    top_k: jax.Array,         # [B] int32; 0 => disabled
+    top_p: jax.Array,         # [B] f32; 1.0 => disabled
+    keys: jax.Array,          # [B] PRNG keys (make_keys)
+) -> jax.Array:               # [B] int32
+    b, v = logits.shape
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]            # [B, V] desc
+    ranks = jnp.argsort(jnp.argsort(scaled, axis=-1)[:, ::-1], axis=-1)
+
+    # top-k: keep ranks < k (k==0 disables)
+    k = jnp.where(top_k > 0, top_k, v)[:, None]
+    keep_k = ranks < k
+
+    # top-p: keep the smallest prefix of sorted probs with cumsum >= top_p,
+    # always keeping the argmax.
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+    sorted_keep = (cumprobs - sorted_probs) < top_p[:, None]
+    keep_p = jnp.take_along_axis(sorted_keep, ranks, axis=-1)
+
+    masked = jnp.where(keep_k & keep_p, scaled, NEG_INF)
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row)
+    )(keys, masked).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled)

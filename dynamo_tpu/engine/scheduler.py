@@ -1,0 +1,366 @@
+"""Continuous-batching scheduler for the native JAX engine.
+
+Plays the role vLLM's scheduler plays behind the reference's worker (reference:
+the engine side-car layer, SURVEY.md §1; chunked prefill + paged scheduling are
+engine-internal there). TPU-first constraint: every device step must have a
+static shape, so the scheduler buckets prefill chunk lengths and page counts to
+a small fixed set (powers of two) and pads decode to a fixed slot count —
+XLA compiles one program per bucket and never recompiles in steady state.
+
+Step policy: prefill-priority, one prefill chunk at a time (bounded by
+max_prefill_chunk), otherwise one decode step over all active slots. The
+disaggregated deployment sends long prefills to dedicated prefill workers
+(dynamo_tpu/disagg/), which is the reference's answer to prefill/decode
+interference (reference: docs/disagg_serving.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.kv_cache import PageAllocator, SequenceState
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Engine-level sampling options.
+
+    Mirrors the reference's SamplingOptions + StopConditions subset that its
+    engines honour (reference: lib/llm/src/protocols/common.rs:205,248).
+    """
+
+    max_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    ignore_eos: bool = False
+    stop_token_ids: tuple = ()   # hidden stop ids (not emitted)
+    min_tokens: int = 0
+
+
+@dataclasses.dataclass
+class EngineRequest:
+    request_id: str
+    prompt: List[int]
+    params: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+
+
+@dataclasses.dataclass
+class PrefillPlan:
+    seq: SequenceState
+    tokens: np.ndarray      # [1, Tb] int32
+    positions: np.ndarray   # [1, Tb]
+    page_table: np.ndarray  # [1, Pb]
+    kv_lens: np.ndarray     # [1]
+    write_idx: np.ndarray   # [1, Tb]
+    last_idx: np.ndarray    # [1] index of last valid token in the chunk
+    n_valid: int = 0
+    is_last_chunk: bool = False
+
+
+@dataclasses.dataclass
+class DecodePlan:
+    seqs: List[Optional[SequenceState]]  # per slot
+    tokens: np.ndarray      # [S, 1]
+    positions: np.ndarray   # [S, 1]
+    page_table: np.ndarray  # [S, Pb]
+    kv_lens: np.ndarray     # [S]
+    write_idx: np.ndarray   # [S, 1]
+    last_idx: np.ndarray    # [S]
+
+
+@dataclasses.dataclass
+class EngineMetrics:
+    """Snapshot published to the router, field-for-field the reference's
+    ForwardPassMetrics (reference: lib/llm/src/kv_router/protocols.rs:42-54).
+    """
+
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0        # name kept for wire parity; HBM here
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+
+def pow2_buckets(max_value: int, start: int = 1) -> List[int]:
+    out, b = [], start
+    while b < max_value:
+        out.append(b)
+        b *= 2
+    out.append(max_value)
+    return out
+
+
+def next_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"{n} exceeds largest bucket {buckets[-1]}")
+
+
+class Scheduler:
+    def __init__(self, cfg: EngineConfig):
+        self.cfg = cfg
+        self.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
+        self.waiting: deque[SequenceState] = deque()
+        self.running: List[Optional[SequenceState]] = [None] * cfg.max_slots
+        self.params: Dict[str, SamplingParams] = {}
+        ps = cfg.page_size
+        self.prefill_buckets = list(cfg.prefill_buckets)
+        max_pages_per_seq = -(-cfg.max_model_len // ps)
+        self.page_buckets = pow2_buckets(max_pages_per_seq)
+        self._prefix_hits = 0
+        self._prefix_lookups = 0
+
+    # -- request lifecycle ---------------------------------------------------
+
+    def add_request(self, req: EngineRequest) -> SequenceState:
+        if len(req.prompt) + req.params.max_tokens > self.cfg.max_model_len:
+            raise ValueError(
+                f"request {req.request_id}: len {len(req.prompt)} + "
+                f"max_tokens {req.params.max_tokens} exceeds max_model_len "
+                f"{self.cfg.max_model_len}")
+        seq = SequenceState(request_id=req.request_id, prompt=list(req.prompt))
+        self.params[req.request_id] = req.params
+        self._match_prefix(seq)
+        self.waiting.append(seq)
+        return seq
+
+    def _match_prefix(self, seq: SequenceState) -> None:
+        """Share full pages already resident (prefix cache hit)."""
+        ps = self.cfg.page_size
+        parent = 0
+        all_toks = seq.all_tokens
+        n_full = (len(all_toks) - 1) // ps  # always recompute >=1 token
+        from dynamo_tpu.engine.kv_cache import page_hash
+        for i in range(n_full):
+            toks = all_toks[i * ps:(i + 1) * ps]
+            h = page_hash(parent, toks)
+            self._prefix_lookups += 1
+            pid = self.allocator.lookup(h)
+            if pid is None:
+                break
+            self.allocator.share(pid)
+            seq.pages.append(pid)
+            seq.page_hashes.append(h)
+            seq.num_cached += ps
+            self._prefix_hits += 1
+            parent = h
+
+    def finish(self, seq: SequenceState) -> None:
+        if seq.slot >= 0:
+            self.running[seq.slot] = None
+            seq.slot = -1
+        for pid in seq.pages:
+            self.allocator.free(pid)
+        seq.pages = []
+        self.params.pop(seq.request_id, None)
+
+    def abort(self, request_id: str) -> bool:
+        for seq in list(self.waiting):
+            if seq.request_id == request_id:
+                self.waiting.remove(seq)
+                self.finish(seq)
+                return True
+        for seq in self.running:
+            if seq is not None and seq.request_id == request_id:
+                self.finish(seq)
+                return True
+        return False
+
+    # -- planning ------------------------------------------------------------
+
+    def _free_slot(self) -> int:
+        for i, s in enumerate(self.running):
+            if s is None:
+                return i
+        return -1
+
+    def _ensure_pages(self, seq: SequenceState, upto_len: int) -> bool:
+        """Allocate pages so positions [0, upto_len) have slots."""
+        ps = self.cfg.page_size
+        need = -(-upto_len // ps) - len(seq.pages)
+        if need <= 0:
+            return True
+        if not self.allocator.can_allocate(need):
+            return False
+        for _ in range(need):
+            seq.pages.append(self.allocator.allocate())
+        return True
+
+    def _seal_full_pages(self, seq: SequenceState) -> None:
+        """Hash pages that just became full of computed tokens (emit events)."""
+        ps = self.cfg.page_size
+        all_tokens = seq.prompt + seq.output
+        valid = seq.num_cached
+        n_full = valid // ps
+        while len(seq.page_hashes) < n_full:
+            i = len(seq.page_hashes)
+            parent = seq.page_hashes[-1] if seq.page_hashes else 0
+            h = self.allocator.seal(seq.pages[i], parent, all_tokens[i * ps:(i + 1) * ps])
+            seq.page_hashes.append(h)
+
+    def schedule(self):
+        """Return a PrefillPlan, DecodePlan, or None (idle)."""
+        plan = self._schedule_prefill()
+        if plan is not None:
+            return plan
+        return self._schedule_decode()
+
+    def _schedule_prefill(self) -> Optional[PrefillPlan]:
+        while self.waiting:
+            seq = self.waiting[0]
+            n_toks = len(seq.all_tokens)
+            if seq.num_cached >= n_toks:
+                # fully cached prefix was trimmed to len-1 in _match_prefix
+                raise AssertionError("prefix match must leave >=1 token")
+            if self._free_slot() < 0 and \
+                    seq.num_cached + self.cfg.max_prefill_chunk >= n_toks:
+                # final chunk would need a decode slot; wait for one
+                return None
+            n = min(n_toks - seq.num_cached, self.cfg.max_prefill_chunk)
+            if not self._ensure_pages(seq, seq.num_cached + n):
+                if not any(s is not None for s in self.running):
+                    raise MemoryError(
+                        f"prompt of {n_toks} tokens cannot fit in "
+                        f"{self.cfg.num_pages} pages of {self.cfg.page_size}")
+                return None  # memory pressure: let decodes drain
+            self.waiting.popleft()
+            return self._build_prefill(seq, n)
+        return None
+
+    def _build_prefill(self, seq: SequenceState, n: int) -> PrefillPlan:
+        ps = self.cfg.page_size
+        tb = next_bucket(n, self.prefill_buckets)
+        start = seq.num_cached
+        tokens = np.zeros((1, tb), np.int32)
+        tokens[0, :n] = seq.all_tokens[start:start + n]
+        positions = np.full((1, tb), max(start + n - 1, 0), np.int32)
+        positions[0, :n] = np.arange(start, start + n)
+        write_idx = np.full((1, tb), -1, np.int32)
+        for j in range(n):
+            write_idx[0, j] = seq.flat_index(start + j, ps)
+        pb = next_bucket(max(len(seq.pages), 1), self.page_buckets)
+        page_table = np.zeros((1, pb), np.int32)
+        page_table[0, :len(seq.pages)] = seq.pages
+        kv_lens = np.array([start + n], np.int32)
+        last = np.array([n - 1], np.int32)
+        return PrefillPlan(
+            seq=seq, tokens=tokens, positions=positions, page_table=page_table,
+            kv_lens=kv_lens, write_idx=write_idx, last_idx=last, n_valid=n,
+            is_last_chunk=(start + n == len(seq.all_tokens)))
+
+    def commit_prefill(self, plan: PrefillPlan, sampled_token: Optional[int]):
+        """Account a finished prefill step; returns the emitted token or None."""
+        seq = plan.seq
+        seq.num_cached += plan.n_valid
+        seq.num_computed += plan.n_valid
+        self._seal_full_pages(seq)
+        if plan.is_last_chunk:
+            assert sampled_token is not None
+            slot = self._free_slot()
+            assert slot >= 0, "final prefill chunk scheduled without a free slot"
+            seq.slot = slot
+            self.running[slot] = seq
+            seq.output.append(int(sampled_token))
+            return int(sampled_token)
+        self.waiting.appendleft(seq)  # continue chunking next step
+        return None
+
+    def _schedule_decode(self) -> Optional[DecodePlan]:
+        active = [s for s in self.running if s is not None]
+        if not active:
+            return None
+        ps = self.cfg.page_size
+        # make room for the token each active seq is about to write,
+        # preempting (youngest-first) until the allocation succeeds or the
+        # sequence itself got preempted
+        for seq in active:
+            while seq.slot >= 0 and not self._ensure_pages(seq, seq.total_len + 1):
+                self._preempt_one()
+        active = [s for s in self.running if s is not None]
+        if not active:
+            return None
+        s_count = self.cfg.max_slots
+        max_pages = max(len(s.pages) for s in active)
+        pb = next_bucket(max_pages, self.page_buckets)
+        tokens = np.zeros((s_count, 1), np.int32)
+        positions = np.zeros((s_count, 1), np.int32)
+        page_table = np.zeros((s_count, pb), np.int32)
+        kv_lens = np.zeros((s_count,), np.int32)
+        write_idx = np.full((s_count, 1), -1, np.int32)
+        seqs: List[Optional[SequenceState]] = [None] * s_count
+        for seq in active:
+            i = seq.slot
+            seqs[i] = seq
+            last_tok = seq.output[-1] if seq.output else seq.prompt[-1]
+            pos = seq.total_len - 1  # position of the token being fed
+            tokens[i, 0] = last_tok
+            positions[i, 0] = pos
+            page_table[i, :len(seq.pages)] = seq.pages
+            kv_lens[i] = pos + 1
+            write_idx[i, 0] = seq.flat_index(pos, ps)
+        return DecodePlan(
+            seqs=seqs, tokens=tokens, positions=positions,
+            page_table=page_table, kv_lens=kv_lens, write_idx=write_idx,
+            last_idx=np.zeros((s_count,), np.int32))
+
+    def _preempt_one(self) -> None:
+        """Evict the youngest running seq back to waiting (recompute later)."""
+        victim = None
+        for seq in self.running:
+            if seq is not None and (victim is None or seq.num_computed < victim.num_computed):
+                victim = seq
+        if victim is None:
+            raise MemoryError("KV cache exhausted with nothing to preempt")
+        self.running[victim.slot] = None
+        victim.slot = -1
+        for pid in victim.pages:
+            self.allocator.free(pid)
+        victim.pages = []
+        victim.page_hashes = []
+        victim.num_cached = 0
+        victim.num_computed = 0
+        # restart from scratch; prefill iterates all_tokens (prompt + output)
+        # so generated tokens are recomputed without touching max_tokens
+        # accounting
+        self._match_prefix(victim)
+        self.waiting.appendleft(victim)
+
+    def commit_decode(self, plan: DecodePlan, sampled: np.ndarray):
+        """Account decode results; returns [(seq, token)] emitted this step."""
+        out = []
+        for i, seq in enumerate(plan.seqs):
+            if seq is None:
+                continue
+            seq.num_cached += 1  # the fed token's KV is now resident
+            seq.num_computed += 1
+            self._seal_full_pages(seq)
+            tok = int(sampled[i])
+            seq.output.append(tok)
+            out.append((seq, tok))
+        return out
+
+    # -- metrics -------------------------------------------------------------
+
+    def metrics(self) -> EngineMetrics:
+        alloc = self.allocator
+        active = sum(1 for s in self.running if s is not None)
+        return EngineMetrics(
+            request_active_slots=active,
+            request_total_slots=self.cfg.max_slots,
+            kv_active_blocks=alloc.num_pages - alloc.num_free,
+            kv_total_blocks=alloc.num_pages,
+            num_requests_waiting=len(self.waiting),
+            gpu_cache_usage_perc=alloc.usage,
+            gpu_prefix_cache_hit_rate=(
+                self._prefix_hits / self._prefix_lookups
+                if self._prefix_lookups else 0.0),
+        )

@@ -1,0 +1,86 @@
+"""ModelDeploymentCard: serving metadata bundle for a model.
+
+Reference equivalent: lib/llm/src/model_card/model.rs:55-201 (ModelInfoType /
+TokenizerKind / PromptFormatterArtifact / context length / kv info, checksum
+`mdcsum`) built from an HF repo dir (model_card/create.rs). Ours additionally
+carries the JAX engine's ModelConfig name so a worker can be spun up from the
+card alone.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import xxhash
+
+from dynamo_tpu.engine.config import ModelConfig, get_model_config
+
+
+@dataclasses.dataclass
+class ModelDeploymentCard:
+    name: str
+    model_type: str = "chat"            # "chat" | "completion"
+    arch: str = "tiny"                  # key into engine config registry
+    tokenizer_kind: str = "byte"        # "hf" | "byte"
+    tokenizer_path: Optional[str] = None
+    chat_template: Optional[str] = None  # jinja source, if any
+    context_length: int = 2048
+    kv_page_size: int = 64
+    eos_token_ids: List[int] = dataclasses.field(default_factory=list)
+    bos_token_id: Optional[int] = None
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def mdcsum(self) -> str:
+        payload = json.dumps(self.to_dict(), sort_keys=True).encode()
+        return f"{xxhash.xxh3_64_intdigest(payload, seed=1337):016x}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelDeploymentCard":
+        d = dict(d)
+        d.pop("mdcsum", None)
+        return cls(**d)
+
+    def model_config(self) -> ModelConfig:
+        return get_model_config(self.arch)
+
+    def load_tokenizer(self):
+        from dynamo_tpu.llm.tokenizer import ByteTokenizer, HFTokenizer
+        if self.tokenizer_kind == "hf":
+            return HFTokenizer(self.tokenizer_path, self.eos_token_ids,
+                               self.bos_token_id)
+        return ByteTokenizer()
+
+    @classmethod
+    def from_hf_dir(cls, path: str, name: Optional[str] = None,
+                    arch: Optional[str] = None) -> "ModelDeploymentCard":
+        """Build a card from a HF-style model directory (config.json +
+        tokenizer.json [+ tokenizer_config.json chat_template]) — the
+        reference's from_local_path flow (reference:
+        lib/llm/src/model_card/create.rs)."""
+        with open(os.path.join(path, "config.json")) as f:
+            hf = json.load(f)
+        eos = hf.get("eos_token_id", [])
+        if isinstance(eos, int):
+            eos = [eos]
+        chat_template = None
+        tok_cfg_path = os.path.join(path, "tokenizer_config.json")
+        if os.path.exists(tok_cfg_path):
+            with open(tok_cfg_path) as f:
+                tok_cfg = json.load(f)
+            chat_template = tok_cfg.get("chat_template")
+        return cls(
+            name=name or os.path.basename(path.rstrip("/")),
+            arch=arch or "tiny",
+            tokenizer_kind="hf",
+            tokenizer_path=os.path.join(path, "tokenizer.json"),
+            chat_template=chat_template,
+            context_length=int(hf.get("max_position_embeddings", 2048)),
+            eos_token_ids=eos,
+            bos_token_id=hf.get("bos_token_id"),
+        )

@@ -1,0 +1,144 @@
+"""OpenAI preprocessor: chat-template rendering + tokenization + defaults.
+
+Reference equivalent: OpenAIPreprocessor (reference: lib/llm/src/
+preprocessor.rs:63-173 request path, :175-246 response transform) — renders
+the HF chat template (minijinja there, jinja2 here), tokenizes, merges model
+defaults/eos/stop, and emits `token_ids` / `formatted_prompt` annotation
+events when the client asks via ext.annotations (reference:
+preprocessor.rs:60-61,137-146).
+"""
+from __future__ import annotations
+
+import uuid
+from typing import List, Optional, Tuple, Union
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.tokenizer import BaseTokenizer
+from dynamo_tpu.protocols.common import (
+    OutputOptions, PreprocessedRequest, SamplingOptions, StopConditions,
+)
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest, CompletionRequest, Ext,
+)
+from dynamo_tpu.protocols.sse import Annotated
+
+ANNOTATION_TOKEN_IDS = "token_ids"
+ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
+
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message.role }}|>{{ message.content }}</s>"
+    "{% endfor %}"
+    "<|assistant|>"
+)
+
+
+class OpenAIPreprocessor:
+    def __init__(self, card: ModelDeploymentCard,
+                 tokenizer: Optional[BaseTokenizer] = None):
+        self.card = card
+        self.tokenizer = tokenizer or card.load_tokenizer()
+        self._template = None
+
+    def _render_chat(self, request: ChatCompletionRequest) -> str:
+        if self._template is None:
+            import jinja2
+            env = jinja2.Environment(keep_trailing_newline=True)
+            env.globals["raise_exception"] = _raise_exception
+            src = self.card.chat_template or DEFAULT_CHAT_TEMPLATE
+            self._template = env.from_string(src)
+        msgs = []
+        for m in request.messages:
+            content = m.content
+            if isinstance(content, list):  # multimodal parts: keep text parts
+                content = "".join(p.get("text", "") for p in content
+                                  if isinstance(p, dict))
+            msgs.append({"role": m.role, "content": content or "",
+                         **({"name": m.name} if m.name else {})})
+        return self._template.render(
+            messages=msgs, add_generation_prompt=True,
+            bos_token="", eos_token="", tools=request.tools)
+
+    def preprocess_chat(
+        self, request: ChatCompletionRequest,
+        request_id: Optional[str] = None,
+    ) -> Tuple[PreprocessedRequest, List[Annotated]]:
+        ext = request.ext or Ext()
+        if ext.use_raw_prompt and request.messages:
+            prompt = str(request.messages[-1].content or "")
+        else:
+            prompt = self._render_chat(request)
+        token_ids = self.tokenizer.encode(prompt)
+        pre = self._finish(request, token_ids, request_id)
+        return pre, self._annotations(ext, prompt, token_ids)
+
+    def preprocess_completion(
+        self, request: CompletionRequest,
+        request_id: Optional[str] = None,
+    ) -> Tuple[PreprocessedRequest, List[Annotated]]:
+        ext = request.ext or Ext()
+        prompt = request.prompt
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            token_ids = list(prompt)
+            prompt_text = ""
+        else:
+            prompt_text = prompt if isinstance(prompt, str) else str(prompt)
+            token_ids = self.tokenizer.encode(prompt_text)
+        pre = self._finish(request, token_ids, request_id)
+        return pre, self._annotations(ext, prompt_text, token_ids)
+
+    def _finish(self, request, token_ids: List[int],
+                request_id: Optional[str]) -> PreprocessedRequest:
+        ext = request.ext or Ext()
+        stop = request.stop
+        if isinstance(stop, str):
+            stop = [stop]
+        max_tokens = getattr(request, "max_completion_tokens", None) \
+            or request.max_tokens
+        temperature = request.temperature
+        if ext.greed_sampling:
+            temperature = 0.0
+        remaining = self.card.context_length - len(token_ids)
+        return PreprocessedRequest(
+            request_id=request_id or uuid.uuid4().hex,
+            token_ids=token_ids,
+            sampling=SamplingOptions(
+                temperature=temperature,
+                top_p=request.top_p,
+                top_k=ext.top_k,
+                repetition_penalty=ext.repetition_penalty,
+                seed=request.seed,
+                n=request.n,
+            ),
+            stop=StopConditions(
+                max_tokens=min(max_tokens, remaining) if max_tokens
+                else max(remaining, 1),
+                stop=stop,
+                ignore_eos=bool(ext.ignore_eos),
+            ),
+            output=OutputOptions(
+                logprobs=getattr(request, "top_logprobs", None)
+                or (request.logprobs if not isinstance(request.logprobs, bool)
+                    else None),
+                echo=bool(getattr(request, "echo", False)),
+            ),
+            eos_token_ids=list(self.tokenizer.eos_token_ids),
+            model=request.model,
+            mdc_sum=self.card.mdcsum,
+            annotations=list(ext.annotations or []),
+        )
+
+    @staticmethod
+    def _annotations(ext: Ext, prompt: str,
+                     token_ids: List[int]) -> List[Annotated]:
+        out = []
+        wanted = set(ext.annotations or ())
+        if ANNOTATION_FORMATTED_PROMPT in wanted:
+            out.append(Annotated.annotation(ANNOTATION_FORMATTED_PROMPT, prompt))
+        if ANNOTATION_TOKEN_IDS in wanted:
+            out.append(Annotated.annotation(ANNOTATION_TOKEN_IDS, token_ids))
+        return out
+
+
+def _raise_exception(message):
+    raise ValueError(message)

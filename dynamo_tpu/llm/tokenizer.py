@@ -1,0 +1,104 @@
+"""Tokenizer wrappers + incremental detokenization.
+
+Reference equivalents: the HF `tokenizers` / sentencepiece wrappers and the
+DecodeStream incremental decoder (reference: lib/llm/src/tokenizers/{hf,sp}.rs,
+tokenizers.rs). Incremental decoding must buffer until a multi-token glyph
+(e.g. UTF-8 continuation or sentencepiece prefix space) resolves — we track a
+prefix offset into the decoded string of the pending token window.
+
+Backends:
+- HF `tokenizers.Tokenizer` (tokenizer.json) when available,
+- a deterministic `ByteTokenizer` fixture (ids = bytes + specials) so every
+  test and the echo engine run with zero model downloads (the analogue of the
+  reference's no-GPU echo engines, SURVEY.md §4.5).
+"""
+from __future__ import annotations
+
+import abc
+from typing import List, Optional, Sequence
+
+
+class BaseTokenizer(abc.ABC):
+    eos_token_ids: List[int] = []
+    bos_token_id: Optional[int] = None
+
+    @abc.abstractmethod
+    def encode(self, text: str) -> List[int]: ...
+
+    @abc.abstractmethod
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def vocab_size(self) -> int: ...
+
+
+class HFTokenizer(BaseTokenizer):
+    """Wraps a HuggingFace tokenizers.Tokenizer (tokenizer.json)."""
+
+    def __init__(self, path: str, eos_token_ids: Sequence[int] = (),
+                 bos_token_id: Optional[int] = None):
+        from tokenizers import Tokenizer
+        self._tok = Tokenizer.from_file(path)
+        self.eos_token_ids = list(eos_token_ids)
+        self.bos_token_id = bos_token_id
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=False)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+
+class ByteTokenizer(BaseTokenizer):
+    """Deterministic byte-level tokenizer for tests/echo: id = byte + 3.
+
+    ids 0..2 are reserved: 0 pad, 1 bos, 2 eos.
+    """
+
+    def __init__(self):
+        self.eos_token_ids = [2]
+        self.bos_token_id = 1
+
+    def encode(self, text: str) -> List[int]:
+        return [b + 3 for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i - 3 for i in ids if i >= 3).decode("utf-8", "replace")
+
+    @property
+    def vocab_size(self) -> int:
+        return 259
+
+
+class DecodeStream:
+    """Incremental detokenizer: feed token ids, get printable text deltas.
+
+    Handles tokens that only become printable with successors (UTF-8
+    continuations, sentencepiece space markers) by decoding a sliding window
+    and emitting only the stable suffix — same contract as the reference's
+    DecodeStream (reference: lib/llm/src/tokenizers.rs).
+    """
+
+    REPLACEMENT = "�"
+
+    def __init__(self, tokenizer: BaseTokenizer):
+        self._tok = tokenizer
+        self._ids: List[int] = []
+        self._prefix_offset = 0  # start of the decode window (token index)
+        self._read_offset = 0    # ids before this are already emitted
+
+    def step(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        prefix = self._tok.decode(self._ids[self._prefix_offset:self._read_offset])
+        full = self._tok.decode(self._ids[self._prefix_offset:])
+        if full.endswith(self.REPLACEMENT):
+            return ""  # mid-glyph: wait for more tokens
+        delta = full[len(prefix):]
+        self._prefix_offset = self._read_offset
+        self._read_offset = len(self._ids)
+        return delta

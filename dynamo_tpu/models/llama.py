@@ -1,0 +1,237 @@
+"""Llama-family decoder (also hosts the Mixtral-style MoE MLP variant).
+
+Functional JAX, TPU-first:
+- parameters are a pytree of arrays **stacked over layers** and the layer loop
+  is a `lax.scan`, so XLA compiles one layer body regardless of depth;
+- all matmuls are bf16 on the MXU; softmax/normalization accumulate in f32;
+- tensor parallelism is expressed as PartitionSpecs over a named mesh axis
+  "tp" (see param_shardings) — XLA inserts the all-reduces over ICI;
+- the KV cache is paged ([layers, pages, page_size, kv_heads, head_dim]) and
+  attention runs against it in both prefill and decode (ops/attention.py).
+
+Covers the architecture of DeepSeek-R1-Distill-Llama-8B / Llama-3-70B (the
+reference's canonical + scale-out configs, reference:
+examples/llm/configs/disagg_router.yaml, BASELINE.md) and Mixtral-8x7B when
+cfg.num_experts > 0.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.ops.attention import paged_attention, write_kv_pages
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class AttnMetadata:
+    """Everything the paged forward pass needs besides tokens.
+
+    All arrays are bucketed to static shapes by the scheduler.
+    """
+
+    positions: jax.Array    # [B, Tq] int32 absolute positions
+    page_table: jax.Array   # [B, Pb] int32
+    kv_lens: jax.Array      # [B] int32 (valid kv length AFTER this step)
+    write_idx: jax.Array    # [B, Tq] int32 flat slot indices (<0 = padding)
+
+
+def _dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+# -- init ---------------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Random-init parameters (stacked over layers)."""
+    dt = _dtype(cfg)
+    d, hd = cfg.hidden_size, cfg.head_dim
+    h, hkv, f, l = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size, cfg.num_layers
+    keys = jax.random.split(rng, 12)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5).astype(dt)
+
+    layers = {
+        "attn_norm": jnp.ones((l, d), dt),
+        "wq": dense(keys[0], (l, d, h * hd), d),
+        "wk": dense(keys[1], (l, d, hkv * hd), d),
+        "wv": dense(keys[2], (l, d, hkv * hd), d),
+        "wo": dense(keys[3], (l, h * hd, d), h * hd),
+        "mlp_norm": jnp.ones((l, d), dt),
+    }
+    if cfg.is_moe:
+        e = cfg.num_experts
+        layers.update({
+            "router": dense(keys[4], (l, d, e), d),
+            "w_gate": dense(keys[5], (l, e, d, f), d),
+            "w_up": dense(keys[6], (l, e, d, f), d),
+            "w_down": dense(keys[7], (l, e, f, d), f),
+        })
+    else:
+        layers.update({
+            "w_gate": dense(keys[5], (l, d, f), d),
+            "w_up": dense(keys[6], (l, d, f), d),
+            "w_down": dense(keys[7], (l, f, d), f),
+        })
+    params: Params = {
+        "embed": dense(keys[8], (cfg.vocab_size, d), d),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dt),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(keys[9], (d, cfg.vocab_size), d)
+    return params
+
+
+def param_shardings(cfg: ModelConfig) -> Params:
+    """PartitionSpecs matching init_params' tree; mesh axes ("dp", "tp").
+
+    Megatron-style TP (reference delegates TP to engines via
+    --tensor-parallel-size, reference: launch/dynamo-run/src/lib.rs +
+    engines/sglang/worker.rs:285-320; here it is first-class): attention heads
+    and MLP hidden dim shard over "tp"; XLA inserts the psum after wo/w_down.
+    MoE experts shard over "tp" as well (expert-parallel uses the same axis
+    until the dedicated "ep" mesh is used — see models/moe notes).
+    """
+    layers = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.is_moe:
+        layers.update({
+            "router": P(None, None, None),
+            "w_gate": P(None, "tp", None, None),
+            "w_up": P(None, "tp", None, None),
+            "w_down": P(None, "tp", None, None),
+        })
+    else:
+        layers.update({
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        })
+    out: Params = {
+        "embed": P(None, None),
+        "layers": layers,
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        out["lm_head"] = P(None, "tp")
+    return out
+
+
+def cache_sharding(cfg: ModelConfig) -> P:
+    """KV cache [L, P, ps, Hkv, hd]: shard kv heads over tp."""
+    del cfg
+    return P(None, None, None, "tp", None)
+
+
+def init_cache(cfg: ModelConfig, num_pages: int, page_size: int) -> Dict[str, jax.Array]:
+    dt = _dtype(cfg)
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+# -- forward ------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * w
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, T, H, hd]; positions: [B, T]."""
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, jnp.float32) / hd))  # [hd/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs          # [B, T, hd/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _moe_mlp(x: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
+    """Dense-compute MoE (top-k routing, all experts evaluated then masked).
+
+    TPU-friendly for moderate expert counts: one big batched einsum over the
+    expert axis keeps the MXU busy and avoids dynamic shapes. A ragged
+    all-to-all EP dispatch over a dedicated "ep" axis is the scale-out path
+    (parallel/expert.py).
+    """
+    b, t, d = x.shape
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), lp["router"].astype(jnp.float32))
+    weights, idx = jax.lax.top_k(logits, k)                    # [B, T, k]
+    weights = jax.nn.softmax(weights, axis=-1)
+    one_hot = jax.nn.one_hot(idx, e, dtype=jnp.float32)        # [B, T, k, E]
+    combine = jnp.einsum("btk,btke->bte", weights, one_hot)    # [B, T, E]
+
+    gate = jnp.einsum("btd,edf->betf", x, lp["w_gate"])
+    up = jnp.einsum("btd,edf->betf", x, lp["w_up"])
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    down = jnp.einsum("betf,efd->betd", act, lp["w_down"])     # [B, E, T, D]
+    return jnp.einsum("betd,bte->btd", down.astype(jnp.float32), combine).astype(x.dtype)
+
+
+def _dense_mlp(x: jax.Array, lp: Params) -> jax.Array:
+    gate = jnp.einsum("btd,df->btf", x, lp["w_gate"])
+    up = jnp.einsum("btd,df->btf", x, lp["w_up"])
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    return jnp.einsum("btf,fd->btd", act, lp["w_down"])
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,            # [B, Tq] int32
+    cache: Dict[str, jax.Array],  # {"k","v"}: [L, P, ps, Hkv, hd]
+    meta: AttnMetadata,
+    input_embeds: Optional[jax.Array] = None,  # [B, Tq, D] overrides tokens
+) -> tuple[jax.Array, Dict[str, jax.Array]]:
+    """One paged forward step. Returns (logits [B, Tq, V], updated cache)."""
+    b, tq = tokens.shape
+    h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    if input_embeds is None:
+        x = jnp.take(params["embed"], tokens, axis=0)
+    else:
+        x = input_embeds.astype(_dtype(cfg))
+
+    def layer_step(x, layer):
+        lp, kc, vc = layer
+        xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = jnp.einsum("btd,de->bte", xn, lp["wq"]).reshape(b, tq, h, hd)
+        k = jnp.einsum("btd,de->bte", xn, lp["wk"]).reshape(b, tq, hkv, hd)
+        v = jnp.einsum("btd,de->bte", xn, lp["wv"]).reshape(b, tq, hkv, hd)
+        q = apply_rope(q, meta.positions, cfg.rope_theta)
+        k = apply_rope(k, meta.positions, cfg.rope_theta)
+        kc, vc = write_kv_pages(kc, vc, k, v, meta.write_idx)
+        attn = paged_attention(q, kc, vc, meta.page_table, meta.kv_lens, meta.positions)
+        x = x + jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd), lp["wo"])
+
+        xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        mlp = _moe_mlp(xn, lp, cfg) if cfg.is_moe else _dense_mlp(xn, lp)
+        x = x + mlp
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"])
+    )
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x, head).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}

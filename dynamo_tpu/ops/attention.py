@@ -1,0 +1,105 @@
+"""Attention over a paged KV cache.
+
+TPU-first design: both prefill (Tq tokens) and decode (Tq=1) run the same
+"gather pages -> masked attention" computation with bucketed static shapes, so
+XLA sees a small, fixed set of programs and everything lands on the MXU. The
+page gather is a plain `take` on the page axis, which XLA lowers to an
+efficient dynamic-gather; a Pallas kernel that reads HBM pages directly (no
+materialized gather) lives in dynamo_tpu/ops/paged_attention.py and is used on
+TPU for decode.
+
+Reference equivalent: the engines' paged attention (vLLM/TRT-LLM internals) and
+the KV block layout in lib/llm/src/kv/layer.rs:100-616. We keep K and V as
+separate [num_pages, page_size, n_kv_heads, head_dim] arrays per layer
+(stacked over layers) instead of the reference's 5-D
+[2, blocks, block_size, heads, head_dim] tensor: separate arrays keep XLA
+layouts simple and let the kv-head axis shard cleanly over the `tp` mesh axis.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def gather_pages(cache: jax.Array, page_table: jax.Array) -> jax.Array:
+    """[P, ps, Hkv, hd] gathered by [B, Pb] -> [B, Pb*ps, Hkv, hd]."""
+    b, pb = page_table.shape
+    _, ps, hkv, hd = cache.shape
+    gathered = jnp.take(cache, page_table.reshape(-1), axis=0)
+    return gathered.reshape(b, pb * ps, hkv, hd)
+
+
+def paged_attention(
+    q: jax.Array,            # [B, Tq, H, hd]
+    k_cache: jax.Array,      # [P, ps, Hkv, hd]
+    v_cache: jax.Array,      # [P, ps, Hkv, hd]
+    page_table: jax.Array,   # [B, Pb] int32
+    kv_lens: jax.Array,      # [B] int32 — valid kv length per sequence
+    q_positions: jax.Array,  # [B, Tq] int32 — absolute position of each query
+) -> jax.Array:
+    """Causal attention of q against the paged KV prefix. Returns [B, Tq, H, hd]."""
+    b, tq, h, hd = q.shape
+    hkv = k_cache.shape[2]
+    g = h // hkv
+
+    k = gather_pages(k_cache, page_table)  # [B, Lk, Hkv, hd]
+    v = gather_pages(v_cache, page_table)
+    lk = k.shape[1]
+
+    qg = q.reshape(b, tq, hkv, g, hd)
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg.astype(jnp.float32), k.astype(jnp.float32)
+    )
+    scores = scores * (hd ** -0.5)
+
+    kv_pos = jnp.arange(lk, dtype=jnp.int32)[None, :]          # [1, Lk]
+    causal = kv_pos[:, None, :] <= q_positions[:, :, None]      # [B, Tq, Lk]
+    valid = kv_pos < kv_lens[:, None]                           # [B, Lk]
+    mask = causal & valid[:, None, :]                           # [B, Tq, Lk]
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, tq, h, hd).astype(q.dtype)
+
+
+def write_kv_pages(
+    k_cache: jax.Array,   # [P, ps, Hkv, hd]
+    v_cache: jax.Array,
+    k_new: jax.Array,     # [B, Tq, Hkv, hd]
+    v_new: jax.Array,
+    write_idx: jax.Array,  # [B, Tq] int32 flat indices into P*ps; <0 = skip
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter new KV entries into the paged cache at flat token slots."""
+    p, ps, hkv, hd = k_cache.shape
+    flat_k = k_cache.reshape(p * ps, hkv, hd)
+    flat_v = v_cache.reshape(p * ps, hkv, hd)
+    idx = write_idx.reshape(-1)
+    keep = idx >= 0
+    # Out-of-range (negative) indices are dropped by scatter mode "drop".
+    safe_idx = jnp.where(keep, idx, p * ps)
+    kn = k_new.reshape(-1, hkv, hd).astype(flat_k.dtype)
+    vn = v_new.reshape(-1, hkv, hd).astype(flat_v.dtype)
+    flat_k = flat_k.at[safe_idx].set(kn, mode="drop")
+    flat_v = flat_v.at[safe_idx].set(vn, mode="drop")
+    return flat_k.reshape(p, ps, hkv, hd), flat_v.reshape(p, ps, hkv, hd)
+
+
+def dense_causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, positions: jax.Array
+) -> jax.Array:
+    """Plain causal attention (no paging); [B, T, H, hd] each. Test oracle."""
+    b, t, h, hd = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, t, hkv, g, hd)
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) * (hd ** -0.5)
+    mask = positions[:, None, :] <= positions[:, :, None]  # [B, Tq, Tk]
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, t, h, hd).astype(q.dtype)

@@ -1,0 +1,46 @@
+"""Device mesh construction and axis conventions.
+
+Axis names used across the framework:
+- "dp": data parallel (replicate model, shard batch) — the reference's DP is
+  worker replicas balanced by the router (reference:
+  lib/runtime/src/component/client.rs:181-244); within one engine dp shards
+  the decode batch.
+- "tp": tensor parallel over ICI (reference delegates to engines via
+  --tensor-parallel-size; first-class here).
+- "pp": pipeline stages (reference: vLLM-only, vllm_inc.py:38).
+- "ep": expert parallel for MoE (absent in the reference; required for the
+  Mixtral config, SURVEY.md §2.9).
+- "sp": sequence parallel / ring attention for long context (absent in the
+  reference; SURVEY.md §2.9).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+def make_mesh(
+    dp: int = 1, tp: int = 1, pp: int = 1, ep: int = 1, sp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh with the framework's canonical axis order.
+
+    "tp" is innermost so tensor-parallel collectives ride the fastest ICI
+    links; "dp" is outermost so replicas can span DCN.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = dp * tp * pp * ep * sp
+    if n > len(devices):
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(dp, pp, ep, sp, tp)
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    devices = [device] if device is not None else jax.devices()[:1]
+    return make_mesh(devices=devices)

@@ -1,0 +1,75 @@
+"""Engine-agnostic internal request/response protocol.
+
+Role-equivalent of the reference's common protocol types (reference:
+lib/llm/src/protocols/common.rs: StopConditions :205, SamplingOptions :248,
+OutputOptions :320, FinishReason :52) and the backend I/O types (reference:
+lib/llm/src/protocols/common/llm_backend.rs:27-126 BackendInput/
+BackendOutput). Pydantic models double as validation + wire schema.
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional
+
+import pydantic
+
+
+class FinishReason(str, enum.Enum):
+    STOP = "stop"            # eos or stop sequence
+    LENGTH = "length"        # max_tokens reached
+    CANCELLED = "cancelled"  # client disconnect / stop_generating
+    ERROR = "error"
+
+
+class StopConditions(pydantic.BaseModel):
+    max_tokens: Optional[int] = None
+    stop: Optional[List[str]] = None              # visible stop strings
+    stop_token_ids_hidden: Optional[List[int]] = None  # never emitted
+    min_tokens: Optional[int] = None
+    ignore_eos: bool = False
+
+
+class SamplingOptions(pydantic.BaseModel):
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    repetition_penalty: Optional[float] = None
+    seed: Optional[int] = None
+    n: int = 1
+
+
+class OutputOptions(pydantic.BaseModel):
+    logprobs: Optional[int] = None
+    echo: bool = False
+
+
+class PreprocessedRequest(pydantic.BaseModel):
+    """What the frontend/processor sends to a worker (token-level request).
+
+    Counterpart of the reference's BackendInput (token_ids, sampling, stop,
+    eos ids, mdc checksum).
+    """
+
+    request_id: str
+    token_ids: List[int]
+    sampling: SamplingOptions = SamplingOptions()
+    stop: StopConditions = StopConditions()
+    output: OutputOptions = OutputOptions()
+    eos_token_ids: List[int] = []
+    model: str = ""
+    mdc_sum: str = ""
+    annotations: List[str] = []
+
+
+class EngineOutput(pydantic.BaseModel):
+    """One streamed frame from a worker back to the frontend.
+
+    Counterpart of the reference's BackendOutput/LLMEngineOutput.
+    """
+
+    token_ids: List[int] = []
+    text: Optional[str] = None
+    cum_log_probs: Optional[float] = None
+    finish_reason: Optional[FinishReason] = None

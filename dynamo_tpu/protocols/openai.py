@@ -1,0 +1,150 @@
+"""OpenAI-compatible protocol types (chat completions + completions + models)
+with the engine-extension field `ext` (our analogue of the reference's nvext,
+reference: lib/llm/src/protocols/openai/nvext.rs:27-90 — ignore_eos, top_k,
+repetition_penalty, greedy sampling, use_raw_prompt, annotations).
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Literal, Optional, Union
+
+import pydantic
+
+
+class Ext(pydantic.BaseModel):
+    """Non-OpenAI extension knobs (reference nvext equivalent)."""
+
+    ignore_eos: Optional[bool] = None
+    top_k: Optional[int] = None
+    repetition_penalty: Optional[float] = None
+    greed_sampling: Optional[bool] = None
+    use_raw_prompt: Optional[bool] = None
+    annotations: Optional[List[str]] = None
+
+
+class ChatMessage(pydantic.BaseModel):
+    role: str
+    content: Optional[Union[str, List[Dict[str, Any]]]] = None
+    name: Optional[str] = None
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+    tool_call_id: Optional[str] = None
+
+
+class ChatCompletionRequest(pydantic.BaseModel):
+    model: str
+    messages: List[ChatMessage]
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    n: int = 1
+    stream: bool = False
+    stream_options: Optional[Dict[str, Any]] = None
+    stop: Optional[Union[str, List[str]]] = None
+    presence_penalty: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    seed: Optional[int] = None
+    logprobs: Optional[bool] = None
+    top_logprobs: Optional[int] = None
+    user: Optional[str] = None
+    tools: Optional[List[Dict[str, Any]]] = None
+    tool_choice: Optional[Union[str, Dict[str, Any]]] = None
+    ext: Optional[Ext] = None
+    # accept unknown fields permissively like the reference's serde does
+    model_config = pydantic.ConfigDict(extra="allow")
+
+
+class CompletionRequest(pydantic.BaseModel):
+    model: str
+    prompt: Union[str, List[str], List[int], List[List[int]]]
+    max_tokens: Optional[int] = 16
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    n: int = 1
+    stream: bool = False
+    stop: Optional[Union[str, List[str]]] = None
+    seed: Optional[int] = None
+    echo: bool = False
+    logprobs: Optional[int] = None
+    user: Optional[str] = None
+    ext: Optional[Ext] = None
+    model_config = pydantic.ConfigDict(extra="allow")
+
+
+class Usage(pydantic.BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ChatChoiceDelta(pydantic.BaseModel):
+    role: Optional[str] = None
+    content: Optional[str] = None
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+
+
+class ChatStreamChoice(pydantic.BaseModel):
+    index: int = 0
+    delta: ChatChoiceDelta = ChatChoiceDelta()
+    finish_reason: Optional[str] = None
+
+
+class ChatChoice(pydantic.BaseModel):
+    index: int = 0
+    message: ChatMessage = ChatMessage(role="assistant", content="")
+    finish_reason: Optional[str] = None
+
+
+class ChatCompletionResponse(pydantic.BaseModel):
+    id: str
+    object: Literal["chat.completion"] = "chat.completion"
+    created: int
+    model: str
+    choices: List[ChatChoice]
+    usage: Optional[Usage] = None
+
+
+class ChatCompletionChunk(pydantic.BaseModel):
+    id: str
+    object: Literal["chat.completion.chunk"] = "chat.completion.chunk"
+    created: int
+    model: str
+    choices: List[ChatStreamChoice]
+    usage: Optional[Usage] = None
+
+
+class CompletionChoice(pydantic.BaseModel):
+    index: int = 0
+    text: str = ""
+    finish_reason: Optional[str] = None
+    logprobs: Optional[Dict[str, Any]] = None
+
+
+class CompletionResponse(pydantic.BaseModel):
+    id: str
+    object: Literal["text_completion"] = "text_completion"
+    created: int
+    model: str
+    choices: List[CompletionChoice]
+    usage: Optional[Usage] = None
+
+
+class ModelInfo(pydantic.BaseModel):
+    id: str
+    object: Literal["model"] = "model"
+    created: int = 0
+    owned_by: str = "dynamo-tpu"
+
+
+class ModelList(pydantic.BaseModel):
+    object: Literal["list"] = "list"
+    data: List[ModelInfo] = []
+
+
+def new_response_id(prefix: str = "cmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def now() -> int:
+    return int(time.time())

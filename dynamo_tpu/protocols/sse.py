@@ -1,0 +1,104 @@
+"""Server-Sent Events codec + the Annotated frame envelope.
+
+Reference equivalents: the SSE codec (reference: lib/llm/src/protocols/
+codec.rs) and the `Annotated{data,id,event,comment}` envelope aligned with
+SSE semantics (reference: lib/runtime/src/protocols/annotated.rs:32-80) used
+to carry both data frames and request-introspection annotations
+(`token_ids`, `formatted_prompt`) through the stream.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Iterator, List, Optional
+
+
+@dataclasses.dataclass
+class Annotated:
+    data: Optional[Any] = None
+    id: Optional[str] = None
+    event: Optional[str] = None
+    comment: Optional[List[str]] = None
+
+    def is_error(self) -> bool:
+        return self.event == "error"
+
+    @classmethod
+    def from_error(cls, message: str) -> "Annotated":
+        return cls(event="error", comment=[message])
+
+    @classmethod
+    def annotation(cls, name: str, value: Any) -> "Annotated":
+        return cls(event=name, data=value)
+
+    def to_wire(self) -> dict:
+        out = {}
+        for f in ("data", "id", "event", "comment"):
+            v = getattr(self, f)
+            if v is not None:
+                out[f] = v
+        return out
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "Annotated":
+        return cls(data=d.get("data"), id=d.get("id"), event=d.get("event"),
+                   comment=d.get("comment"))
+
+
+@dataclasses.dataclass
+class SseEvent:
+    data: Optional[str] = None
+    event: Optional[str] = None
+    id: Optional[str] = None
+    comments: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def is_done(self) -> bool:
+        return self.data is not None and self.data.strip() == "[DONE]"
+
+
+def encode_event(ev: SseEvent) -> str:
+    """Encode one SSE event block (terminated by a blank line)."""
+    lines = []
+    for c in ev.comments:
+        lines.append(f": {c}")
+    if ev.event:
+        lines.append(f"event: {ev.event}")
+    if ev.id:
+        lines.append(f"id: {ev.id}")
+    if ev.data is not None:
+        for part in ev.data.split("\n"):
+            lines.append(f"data: {part}")
+    return "\n".join(lines) + "\n\n"
+
+
+def encode_json_data(obj: Any) -> str:
+    return encode_event(SseEvent(data=json.dumps(obj, separators=(",", ":"))))
+
+
+DONE_FRAME = "data: [DONE]\n\n"
+
+
+def decode_stream(text: str) -> Iterator[SseEvent]:
+    """Parse SSE text into events; tolerates multi-line data, comments,
+    and unknown fields (the edge cases the reference replay tests cover)."""
+    for block in text.split("\n\n"):
+        if not block.strip():
+            continue
+        ev = SseEvent()
+        data_lines: List[str] = []
+        for line in block.split("\n"):
+            if not line:
+                continue
+            if line.startswith(":"):
+                ev.comments.append(line[1:].strip())
+            elif line.startswith("data:"):
+                data_lines.append(line[5:].lstrip(" "))
+            elif line.startswith("event:"):
+                ev.event = line[6:].strip()
+            elif line.startswith("id:"):
+                ev.id = line[3:].strip()
+            # unknown fields ignored per SSE spec
+        if data_lines:
+            ev.data = "\n".join(data_lines)
+        yield ev

@@ -1,0 +1,2 @@
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime

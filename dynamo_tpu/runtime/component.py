@@ -1,0 +1,339 @@
+"""Discoverable component model: Namespace -> Component -> Endpoint -> Client.
+
+Same tree and discovery semantics as the reference (reference:
+lib/runtime/src/component.rs:99-270, component/endpoint.rs:57-144,
+component/client.rs:52-245): an endpoint instance registers a KV key
+`{ns}/components/{comp}/{endpoint}:{worker_id}` under the worker's primary
+lease and serves the request subject `{ns}|{comp}.{endpoint}-{worker_id}`;
+clients watch the KV prefix to track live instances and route
+random / round-robin / direct.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+import msgpack
+
+from dynamo_tpu.runtime import dataplane
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, FnEngine
+
+log = logging.getLogger("dynamo_tpu.component")
+
+
+def instance_key(ns: str, comp: str, endpoint: str, worker_id: str) -> str:
+    return f"{ns}/components/{comp}/{endpoint}:{worker_id}"
+
+
+def instance_subject(ns: str, comp: str, endpoint: str, worker_id: str) -> str:
+    return f"{ns}|{comp}.{endpoint}-{worker_id}"
+
+
+class Namespace:
+    def __init__(self, runtime, name: str):
+        self._rt = runtime
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self._rt, self, name)
+
+    # -- event plane (reference: lib/runtime/src/traits/events.rs:27-79) -----
+    def event_subject(self, subject: str) -> str:
+        return f"{self.name}.{subject}"
+
+    async def publish(self, subject: str, payload: Any) -> None:
+        await self._rt.messaging.publish(
+            self.event_subject(subject), msgpack.packb(payload))
+
+    async def subscribe(self, subject: str):
+        gen = await self._rt.messaging.subscribe(self.event_subject(subject))
+
+        async def decoded():
+            async for subj, payload in gen:
+                yield subj, msgpack.unpackb(payload, raw=False)
+
+        return decoded()
+
+
+class Component:
+    def __init__(self, runtime, namespace: Namespace, name: str):
+        self._rt = runtime
+        self.namespace = namespace
+        self.name = name
+
+    @property
+    def etcd_root(self) -> str:
+        return f"{self.namespace.name}/components/{self.name}"
+
+    @property
+    def service_name(self) -> str:
+        return f"{self.namespace.name}|{self.name}"
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self._rt, self, name)
+
+    async def publish(self, subject: str, payload: Any) -> None:
+        await self._rt.messaging.publish(
+            f"{self.namespace.name}.{self.name}.{subject}", msgpack.packb(payload))
+
+    async def subscribe(self, subject: str):
+        gen = await self._rt.messaging.subscribe(
+            f"{self.namespace.name}.{self.name}.{subject}")
+
+        async def decoded():
+            async for subj, payload in gen:
+                yield subj, msgpack.unpackb(payload, raw=False)
+
+        return decoded()
+
+    async def list_instances(self) -> List[Dict[str, Any]]:
+        entries = await self._rt.kv.get_prefix(self.etcd_root + "/")
+        out = []
+        for e in entries:
+            try:
+                out.append(json.loads(e.value))
+            except (ValueError, TypeError):
+                continue
+        return out
+
+
+class Endpoint:
+    def __init__(self, runtime, component: Component, name: str):
+        self._rt = runtime
+        self.component = component
+        self.name = name
+
+    @property
+    def ns(self) -> str:
+        return self.component.namespace.name
+
+    def key_for(self, worker_id: str) -> str:
+        return instance_key(self.ns, self.component.name, self.name, worker_id)
+
+    def subject_for(self, worker_id: str) -> str:
+        return instance_subject(self.ns, self.component.name, self.name, worker_id)
+
+    async def serve(
+        self,
+        engine: AsyncEngine | Callable,
+        metadata: Optional[Dict[str, Any]] = None,
+        stats_handler: Optional[Callable[[], Dict[str, Any]]] = None,
+    ) -> "ServedEndpoint":
+        """Register and start serving this endpoint instance.
+
+        The handler runs the push-endpoint loop (reference:
+        pipeline/network/ingress/push_handler.rs:25-112): decode the request
+        envelope, rebuild the Context, call home over TCP, run the engine,
+        pump response frames into the socket.
+        """
+        if not isinstance(engine, AsyncEngine):
+            engine = FnEngine(engine)
+        rt = self._rt
+        worker_id = rt.worker_id
+        subject = self.subject_for(worker_id)
+
+        async def handle(payload: bytes) -> bytes:
+            env = msgpack.unpackb(payload, raw=False)
+            ctx = Context(env.get("request_id"), env.get("baggage") or {})
+            try:
+                reader_writer = await dataplane.call_home(
+                    env["connection_info"], env["stream_id"], ctx)
+            except Exception as e:
+                return msgpack.packb({"ok": False, "error": str(e)})
+            _, writer = reader_writer
+            req = msgpack.unpackb(env["payload"], raw=False)
+
+            async def run():
+                try:
+                    gen = engine.generate(req, ctx)
+                except Exception as e:  # engine rejected the request outright
+                    log.exception("engine failure on %s", subject)
+                    await dataplane.close_with_error(
+                        writer, f"{type(e).__name__}: {e}")
+                    return
+                # generator-time failures are forwarded by pump_stream
+                await dataplane.pump_stream(writer, _packed(gen), ctx)
+
+            asyncio.create_task(run())
+            return msgpack.packb({"ok": True})
+
+        unserve = await rt.messaging.serve(subject, handle)
+        info = {
+            "namespace": self.ns,
+            "component": self.component.name,
+            "endpoint": self.name,
+            "worker_id": worker_id,
+            "subject": subject,
+            **(metadata or {}),
+        }
+        await rt.kv.put(self.key_for(worker_id), json.dumps(info).encode(),
+                        rt.lease.id if rt.lease else 0)
+        served = ServedEndpoint(self, worker_id, unserve, stats_handler)
+        rt.register_served(served)
+        if stats_handler is not None:
+            stats_subject = f"$STATS.{subject}"
+            async def stats(payload: bytes) -> bytes:
+                return msgpack.packb(stats_handler())
+            served._unserve_stats = await rt.messaging.serve(stats_subject, stats)
+        return served
+
+    def client(self) -> "Client":
+        return Client(self._rt, self)
+
+
+def _packed(gen) -> AsyncIterator[bytes]:
+    async def inner():
+        async for item in gen:
+            yield msgpack.packb(item)
+    return inner()
+
+
+class ServedEndpoint:
+    def __init__(self, endpoint: Endpoint, worker_id: str, unserve,
+                 stats_handler=None):
+        self.endpoint = endpoint
+        self.worker_id = worker_id
+        self._unserve = unserve
+        self._unserve_stats = None
+        self.stats_handler = stats_handler
+
+    async def shutdown(self):
+        await self._unserve()
+        if self._unserve_stats is not None:
+            await self._unserve_stats()
+        await self.endpoint._rt.kv.delete(self.endpoint.key_for(self.worker_id))
+
+
+class Client:
+    """Routes requests to live endpoint instances.
+
+    Maintains a watch on the instance prefix (reference:
+    component/client.rs:64-149) and offers random / round_robin / direct
+    routing (reference: client.rs:181-244) plus the streaming request path
+    over the data plane.
+    """
+
+    def __init__(self, runtime, endpoint: Endpoint):
+        self._rt = runtime
+        self.endpoint = endpoint
+        self.instances: Dict[str, Dict[str, Any]] = {}
+        self._rr = 0
+        self._watch_task: Optional[asyncio.Task] = None
+        self._ready = asyncio.Event()
+
+    async def start(self) -> "Client":
+        prefix = instance_key(self.endpoint.ns, self.endpoint.component.name,
+                              self.endpoint.name, "")
+        snapshot, events = await self._rt.kv.watch_prefix(prefix)
+        for e in snapshot:
+            self._apply("put", e.key, e.value)
+        self._ready.set()
+
+        async def pump():
+            async for ev in events:
+                self._apply(ev.kind, ev.key, ev.value)
+
+        self._watch_task = asyncio.create_task(pump())
+        return self
+
+    def _apply(self, kind: str, key: str, value: Optional[bytes]):
+        worker_id = key.rsplit(":", 1)[-1]
+        if kind == "put" and value is not None:
+            try:
+                self.instances[worker_id] = json.loads(value)
+            except (ValueError, TypeError):
+                pass
+        elif kind == "delete":
+            self.instances.pop(worker_id, None)
+
+    async def wait_for_instances(self, timeout: float = 10.0) -> None:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while not self.instances:
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(
+                    f"no instances of {self.endpoint.subject_for('*')}")
+            await asyncio.sleep(0.02)
+
+    def instance_ids(self) -> List[str]:
+        return sorted(self.instances)
+
+    # -- routing -------------------------------------------------------------
+
+    def _pick_random(self) -> str:
+        return random.choice(self.instance_ids())
+
+    def _pick_round_robin(self) -> str:
+        ids = self.instance_ids()
+        self._rr = (self._rr + 1) % len(ids)
+        return ids[self._rr]
+
+    async def generate(self, request: Any, context: Optional[Context] = None,
+                       instance: Optional[str] = None,
+                       policy: str = "random") -> AsyncIterator[Any]:
+        """Send a request; yields response frames (decoded msgpack)."""
+        if not self.instances:
+            await self.wait_for_instances()
+        if instance is None:
+            instance = (self._pick_round_robin() if policy == "round_robin"
+                        else self._pick_random())
+        ctx = context or Context()
+        subject = self.endpoint.subject_for(instance)
+
+        server = await self._rt.data_plane()
+        stream = server.register()
+        envelope = msgpack.packb({
+            "request_id": ctx.id,
+            "baggage": ctx.baggage,
+            "payload": msgpack.packb(request),
+            "connection_info": server.connection_info,
+            "stream_id": stream.stream_id,
+        })
+        try:
+            ack = msgpack.unpackb(
+                await self._rt.messaging.request(subject, envelope), raw=False)
+        except Exception:
+            server.unregister(stream.stream_id)
+            raise
+        if not ack.get("ok"):
+            server.unregister(stream.stream_id)
+            raise RuntimeError(ack.get("error", "request rejected"))
+
+        async def gen():
+            stopped = False
+            async for data in server.stream_responses(stream):
+                if ctx.is_stopped and not stopped:
+                    stopped = True
+                    await server.send_stop(stream)
+                    if ctx.is_killed:
+                        return
+                yield msgpack.unpackb(data, raw=False)
+
+        return gen()
+
+    async def direct(self, request: Any, instance: str,
+                     context: Optional[Context] = None) -> AsyncIterator[Any]:
+        return await self.generate(request, context, instance=instance)
+
+    async def round_robin(self, request: Any,
+                          context: Optional[Context] = None) -> AsyncIterator[Any]:
+        return await self.generate(request, context, policy="round_robin")
+
+    async def scrape_stats(self, timeout: float = 2.0) -> Dict[str, Dict]:
+        """Collect custom stats from each live instance (reference:
+        NATS $SRV.STATS scrape, lib/runtime/src/service.rs:32-100)."""
+        out = {}
+        for worker_id in self.instance_ids():
+            subject = f"$STATS.{self.endpoint.subject_for(worker_id)}"
+            try:
+                raw = await self._rt.messaging.request(subject, b"", timeout)
+                out[worker_id] = msgpack.unpackb(raw, raw=False)
+            except Exception:
+                continue
+        return out
+
+    async def stop(self):
+        if self._watch_task:
+            self._watch_task.cancel()

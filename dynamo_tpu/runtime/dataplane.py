@@ -1,0 +1,195 @@
+"""Response-plane transport: direct TCP call-home streams.
+
+Like the reference, responses never transit the message broker: the requester
+registers a pending stream on its local TCP server and sends its address with
+the request; the responder dials back ("call home"), sends a prologue
+(ok/error), then pumps response frames (reference:
+lib/runtime/src/pipeline/network/tcp/server.rs:74-380, tcp/client.rs:77-130,
+egress/push.rs:104-166). The connection is bidirectional: the requester can
+send a {"stop": true} control frame to cancel generation mid-stream, and a
+dropped connection stops the responder's engine (the reference's
+monitor_for_disconnects / context kill path).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import AsyncIterator, Dict, Optional, Tuple
+
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.wire import read_frame, write_frame
+
+log = logging.getLogger("dynamo_tpu.dataplane")
+
+_END = object()
+
+
+class PendingStream:
+    def __init__(self, stream_id: str):
+        self.stream_id = stream_id
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.connected = asyncio.Event()
+
+
+class DataPlaneServer:
+    """Per-process TCP server accepting call-home response connections."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 advertise_host: Optional[str] = None):
+        self.host, self.port = host, port
+        self.advertise_host = advertise_host or host
+        self._pending: Dict[str, PendingStream] = {}
+        self._server = None
+
+    async def start(self):
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._on_connect, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    @property
+    def connection_info(self) -> Dict[str, object]:
+        return {"host": self.advertise_host, "port": self.port}
+
+    def register(self) -> PendingStream:
+        stream = PendingStream(uuid.uuid4().hex)
+        self._pending[stream.stream_id] = stream
+        return stream
+
+    def unregister(self, stream_id: str) -> None:
+        self._pending.pop(stream_id, None)
+
+    async def _on_connect(self, reader, writer):
+        stream = None
+        try:
+            hello = await read_frame(reader)  # CallHomeHandshake
+            stream = self._pending.get(hello.get("stream_id", ""))
+            if stream is None:
+                write_frame(writer, {"ok": False, "error": "unknown stream"})
+                await writer.drain()
+                writer.close()
+                return
+            stream.writer = writer
+            stream.connected.set()
+            write_frame(writer, {"ok": True})
+            await writer.drain()
+            while True:
+                frame = await read_frame(reader)
+                stream.queue.put_nowait(frame)
+                if frame.get("end"):
+                    break
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            if stream is not None:
+                stream.queue.put_nowait(
+                    {"end": True, "error": "response stream lost"})
+        finally:
+            if stream is not None:
+                self._pending.pop(stream.stream_id, None)
+            writer.close()
+
+    async def stream_responses(self, stream: PendingStream,
+                               timeout: float = 60.0) -> AsyncIterator[bytes]:
+        """Yield response payload frames until end; raises on stream error."""
+        try:
+            while True:
+                frame = await asyncio.wait_for(stream.queue.get(), timeout)
+                if frame.get("error"):
+                    raise RuntimeError(frame["error"])
+                if "data" in frame and frame["data"] is not None:
+                    yield frame["data"]
+                if frame.get("end"):
+                    return
+        finally:
+            self.unregister(stream.stream_id)
+            if stream.writer is not None:
+                stream.writer.close()
+
+    async def send_stop(self, stream: PendingStream) -> None:
+        """Cancel generation: send a stop control frame back to the responder."""
+        if stream.writer is not None and not stream.writer.is_closing():
+            write_frame(stream.writer, {"stop": True})
+            await stream.writer.drain()
+
+
+async def call_home(
+    connection_info: Dict[str, object],
+    stream_id: str,
+    context: Context,
+) -> Tuple[asyncio.StreamReader, asyncio.StreamWriter]:
+    """Responder side: dial the requester and complete the handshake.
+
+    Also spawns a reader task that maps incoming {"stop": true} frames and
+    connection loss onto the request Context.
+    """
+    reader, writer = await asyncio.open_connection(
+        connection_info["host"], int(connection_info["port"]))
+    write_frame(writer, {"stream_id": stream_id})
+    await writer.drain()
+    ack = await read_frame(reader)
+    if not ack.get("ok"):
+        writer.close()
+        raise ConnectionError(ack.get("error", "handshake rejected"))
+
+    async def watch_control():
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame.get("stop"):
+                    context.stop_generating()
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                asyncio.CancelledError):
+            context.stop_generating()
+
+    task = asyncio.create_task(watch_control())
+    writer._dynamo_watch_task = task  # cancelled when stream finishes
+    return reader, writer
+
+
+async def close_with_error(writer: asyncio.StreamWriter, message: str) -> None:
+    """Responder side: report a failure and tear the stream down."""
+    try:
+        write_frame(writer, {"end": True, "error": message})
+        await writer.drain()
+    except (ConnectionResetError, BrokenPipeError):
+        pass
+    finally:
+        task = getattr(writer, "_dynamo_watch_task", None)
+        if task:
+            task.cancel()
+        writer.close()
+
+
+async def pump_stream(writer: asyncio.StreamWriter, gen,
+                      context: Context) -> None:
+    """Responder side: forward engine output frames into the TCP socket."""
+    try:
+        async for item in gen:
+            if context.is_killed:
+                break
+            write_frame(writer, {"data": item})
+            await writer.drain()
+        write_frame(writer, {"end": True})
+        await writer.drain()
+    except (ConnectionResetError, BrokenPipeError):
+        context.stop_generating()
+    except Exception as e:  # noqa: BLE001 — forwarded to the requester
+        try:
+            write_frame(writer, {"end": True,
+                                 "error": f"{type(e).__name__}: {e}"})
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+    finally:
+        task = getattr(writer, "_dynamo_watch_task", None)
+        if task:
+            task.cancel()
+        writer.close()

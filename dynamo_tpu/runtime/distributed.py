@@ -1,0 +1,131 @@
+"""DistributedRuntime: the per-process cluster handle.
+
+Bundles the control-plane clients (KV + messaging), a worker id, the primary
+lease (TTL 10s; lease lost => runtime shutdown, shutdown => lease revoked —
+the same two-way coupling as the reference, reference:
+lib/runtime/src/transports/etcd.rs:85-120), a lazily-started TCP data-plane
+server for call-home response streams (reference:
+lib/runtime/src/distributed.rs:110-120), and the component registry.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Dict, List, Optional
+
+from dynamo_tpu.runtime.component import Namespace
+from dynamo_tpu.runtime.dataplane import DataPlaneServer
+from dynamo_tpu.runtime.transports.base import KVStore, Lease, Messaging
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+log = logging.getLogger("dynamo_tpu.runtime")
+
+LEASE_TTL_S = 10.0
+
+
+class DistributedRuntime:
+    def __init__(self, kv: KVStore, messaging: Messaging,
+                 worker_id: Optional[str] = None,
+                 advertise_host: str = "127.0.0.1"):
+        self.kv = kv
+        self.messaging = messaging
+        self.worker_id = worker_id or uuid.uuid4().hex[:16]
+        self.lease: Optional[Lease] = None
+        self.shutdown_event = asyncio.Event()
+        self._data_plane: Optional[DataPlaneServer] = None
+        self._served: List[object] = []
+        self._advertise_host = advertise_host
+        self._lease_watch: Optional[asyncio.Task] = None
+        self._namespaces: Dict[str, Namespace] = {}
+
+    # -- constructors --------------------------------------------------------
+
+    @classmethod
+    async def create_local(cls, plane: Optional[MemoryPlane] = None,
+                           worker_id: Optional[str] = None
+                           ) -> "DistributedRuntime":
+        """In-process control plane (tests, single-process serving)."""
+        plane = plane or MemoryPlane()
+        rt = cls(plane.kv, plane.messaging, worker_id)
+        rt._plane = plane
+        await rt._init_lease()
+        return rt
+
+    @classmethod
+    async def connect(cls, host: str = "127.0.0.1", port: int = 6230,
+                      worker_id: Optional[str] = None,
+                      advertise_host: str = "127.0.0.1"
+                      ) -> "DistributedRuntime":
+        """Connect to a standalone control-plane server."""
+        from dynamo_tpu.runtime.transports.tcp import ControlPlaneClient
+        client = await ControlPlaneClient(host, port).connect()
+        rt = cls(client, client, worker_id, advertise_host)
+        rt._client = client
+        await rt._init_lease()
+        return rt
+
+    async def _init_lease(self):
+        self.lease = await self.kv.grant_lease(LEASE_TTL_S)
+
+        async def watch():
+            await self.lease.lost.wait()
+            log.warning("primary lease lost; shutting down runtime %s",
+                        self.worker_id)
+            await self.shutdown()
+
+        self._lease_watch = asyncio.create_task(watch())
+        # Heartbeat for planes whose Lease exposes a direct keep_alive hook
+        # (memory plane); the TCP client runs its own keepalive loop.
+        keep_alive = getattr(self.lease, "keep_alive", None)
+        if callable(keep_alive):
+            async def heartbeat():
+                while not self.shutdown_event.is_set():
+                    await asyncio.sleep(LEASE_TTL_S / 3)
+                    keep_alive()
+
+            self._lease_heartbeat = asyncio.create_task(heartbeat())
+
+    # -- accessors -----------------------------------------------------------
+
+    def namespace(self, name: str) -> Namespace:
+        if name not in self._namespaces:
+            self._namespaces[name] = Namespace(self, name)
+        return self._namespaces[name]
+
+    async def data_plane(self) -> DataPlaneServer:
+        if self._data_plane is None:
+            self._data_plane = DataPlaneServer(
+                advertise_host=self._advertise_host)
+            await self._data_plane.start()
+        return self._data_plane
+
+    def register_served(self, served) -> None:
+        self._served.append(served)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def shutdown(self):
+        if self.shutdown_event.is_set():
+            return
+        self.shutdown_event.set()
+        for served in self._served:
+            try:
+                await served.shutdown()
+            except Exception:
+                pass
+        if self._lease_watch:
+            self._lease_watch.cancel()
+        hb = getattr(self, "_lease_heartbeat", None)
+        if hb:
+            hb.cancel()
+        if self.lease is not None:
+            try:
+                await self.lease.revoke()
+            except Exception:
+                pass
+        if self._data_plane is not None:
+            await self._data_plane.stop()
+        client = getattr(self, "_client", None)
+        if client is not None:
+            await client.close()

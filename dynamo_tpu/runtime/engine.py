@@ -1,0 +1,77 @@
+"""Core engine abstraction: streaming generate with a controllable context.
+
+The reference's central trait is AsyncEngine: `generate(SingleIn<Req>) ->
+ManyOut<Resp>` with a per-request AsyncEngineContext carrying id/stop/kill
+(reference: lib/runtime/src/engine.rs:22-110, pipeline/context.rs:33-160).
+Python/asyncio equivalent: `generate(request, Context) -> AsyncIterator`.
+"""
+from __future__ import annotations
+
+import abc
+import asyncio
+import uuid
+from typing import Any, AsyncIterator, Dict, Optional
+
+
+class Context:
+    """Request envelope: id, typed baggage, cooperative stop/kill signals.
+
+    stop = "finish the current response gracefully and end the stream";
+    kill = "abandon immediately" — the same split as the reference's
+    AsyncEngineContext stop_generating/kill (reference:
+    lib/runtime/src/engine.rs:47-85).
+    """
+
+    def __init__(self, request_id: Optional[str] = None,
+                 baggage: Optional[Dict[str, Any]] = None):
+        self.id = request_id or uuid.uuid4().hex
+        self.baggage: Dict[str, Any] = dict(baggage or {})
+        self._stopped = asyncio.Event()
+        self._killed = asyncio.Event()
+
+    # -- control -------------------------------------------------------------
+    def stop_generating(self) -> None:
+        self._stopped.set()
+
+    def kill(self) -> None:
+        self._killed.set()
+        self._stopped.set()
+
+    @property
+    def is_stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    @property
+    def is_killed(self) -> bool:
+        return self._killed.is_set()
+
+    async def wait_stopped(self) -> None:
+        await self._stopped.wait()
+
+    def child(self) -> "Context":
+        """Same id + baggage, linked cancellation (parent stop cascades)."""
+        c = Context(self.id, self.baggage)
+        if self.is_stopped:
+            c._stopped.set()
+        if self.is_killed:
+            c._killed.set()
+        return c
+
+
+class AsyncEngine(abc.ABC):
+    """A streaming request->response engine."""
+
+    @abc.abstractmethod
+    def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        """Return an async iterator of response frames."""
+
+
+class FnEngine(AsyncEngine):
+    """Wrap an async generator function as an engine (test fixture pattern,
+    reference: lib/runtime/tests/common/engines.rs closure engines)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        return self._fn(request, context)

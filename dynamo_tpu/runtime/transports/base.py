@@ -1,0 +1,118 @@
+"""Control-plane transport interfaces.
+
+The reference splits its control plane between etcd (discovery/config/lease,
+reference: lib/runtime/src/transports/etcd.rs) and NATS (request plane,
+events, work queue, reference: transports/nats.rs). We keep the same
+*semantics* behind two interfaces — KVStore and Messaging — with two
+implementations: an in-process memory plane (test + single-process serving,
+the analogue of the reference's mock network, reference:
+lib/runtime/tests/common/mock.rs) and a TCP client to our standalone
+control-plane server (dynamo_tpu.runtime.transports.server).
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import AsyncIterator, Awaitable, Callable, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class WatchEvent:
+    kind: str          # "put" | "delete"
+    key: str
+    value: Optional[bytes] = None
+
+
+@dataclasses.dataclass
+class KVEntry:
+    key: str
+    value: bytes
+    lease_id: int = 0
+
+
+class Lease:
+    """A TTL lease; keys attached to it vanish when it expires/revokes.
+
+    Matches the reference's primary-lease semantics: lease lost => runtime
+    shutdown; shutdown => lease revoked (reference: transports/etcd.rs:85-120,
+    etcd/lease.rs). TTL default 10s per BASELINE.md.
+    """
+
+    def __init__(self, lease_id: int, revoke_cb):
+        self.id = lease_id
+        self._revoke_cb = revoke_cb
+        self.lost = None  # set by transport: asyncio.Event fired on expiry
+
+    async def revoke(self):
+        await self._revoke_cb(self.id)
+
+
+class KVStore(abc.ABC):
+    """etcd-role: consistent KV with atomic create, prefix watch, leases."""
+
+    @abc.abstractmethod
+    async def put(self, key: str, value: bytes, lease_id: int = 0) -> None: ...
+
+    @abc.abstractmethod
+    async def create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        """Atomic create; False if the key already exists."""
+
+    @abc.abstractmethod
+    async def get(self, key: str) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    async def get_prefix(self, prefix: str) -> List[KVEntry]: ...
+
+    @abc.abstractmethod
+    async def delete(self, key: str) -> None: ...
+
+    @abc.abstractmethod
+    async def grant_lease(self, ttl: float = 10.0) -> Lease: ...
+
+    @abc.abstractmethod
+    async def watch_prefix(
+        self, prefix: str
+    ) -> Tuple[List[KVEntry], AsyncIterator[WatchEvent]]:
+        """Snapshot + subsequent events (reference: etcd.rs
+        kv_get_and_watch_prefix)."""
+
+
+Handler = Callable[[bytes], Awaitable[AsyncIterator[bytes]]]
+
+
+class Messaging(abc.ABC):
+    """NATS-role: addressed request/reply, pub/sub events, durable queue."""
+
+    @abc.abstractmethod
+    async def serve(self, subject: str,
+                    handler: Callable[[bytes], Awaitable[bytes]]) -> Callable:
+        """Register a request handler; returns an async unsubscribe fn."""
+
+    @abc.abstractmethod
+    async def request(self, subject: str, payload: bytes,
+                      timeout: float = 30.0) -> bytes: ...
+
+    @abc.abstractmethod
+    async def publish(self, subject: str, payload: bytes) -> None: ...
+
+    @abc.abstractmethod
+    async def subscribe(self, subject: str) -> AsyncIterator[Tuple[str, bytes]]:
+        """Subscribe to a subject (trailing '>' wildcard supported)."""
+
+    @abc.abstractmethod
+    async def queue_push(self, queue: str, payload: bytes) -> None: ...
+
+    @abc.abstractmethod
+    async def queue_pop(self, queue: str,
+                        timeout: Optional[float] = None) -> Optional[bytes]:
+        """Durable work-queue pop (reference: NATS JetStream prefill queue)."""
+
+    @abc.abstractmethod
+    async def queue_depth(self, queue: str) -> int: ...
+
+
+def subject_matches(pattern: str, subject: str) -> bool:
+    """NATS-style: '>' matches any suffix."""
+    if pattern.endswith(">"):
+        return subject.startswith(pattern[:-1])
+    return pattern == subject

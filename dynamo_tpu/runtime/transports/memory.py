@@ -1,0 +1,201 @@
+"""In-process control plane: KVStore + Messaging backed by plain dicts.
+
+The single-process analogue of etcd+NATS, in the spirit of the reference's
+in-memory mock control/data plane used to test multi-component behavior
+without a cluster (reference: lib/runtime/tests/common/mock.rs:31-60,
+including its injectable LatencyModel).
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from collections import defaultdict
+from typing import AsyncIterator, Dict, List, Optional, Tuple
+
+from dynamo_tpu.runtime.transports.base import (
+    KVEntry, KVStore, Lease, Messaging, WatchEvent, subject_matches,
+)
+
+
+class LatencyModel:
+    """Optional injected delay for simulating network hops in tests."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+
+    async def apply(self):
+        if self.delay_s > 0:
+            await asyncio.sleep(self.delay_s)
+
+
+class MemoryKVStore(KVStore):
+    def __init__(self, latency: Optional[LatencyModel] = None):
+        self._data: Dict[str, KVEntry] = {}
+        self._watchers: List[Tuple[str, asyncio.Queue]] = []
+        self._lease_seq = itertools.count(1)
+        self._lease_keys: Dict[int, set] = defaultdict(set)
+        self._lease_tasks: Dict[int, asyncio.Task] = {}
+        self._lease_deadline: Dict[int, float] = {}
+        self._latency = latency or LatencyModel()
+
+    async def _notify(self, ev: WatchEvent):
+        for prefix, q in list(self._watchers):
+            if ev.key.startswith(prefix):
+                q.put_nowait(ev)
+
+    async def put(self, key: str, value: bytes, lease_id: int = 0) -> None:
+        await self._latency.apply()
+        self._data[key] = KVEntry(key, value, lease_id)
+        if lease_id:
+            self._lease_keys[lease_id].add(key)
+        await self._notify(WatchEvent("put", key, value))
+
+    async def create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        await self._latency.apply()
+        if key in self._data:
+            return False
+        await self.put(key, value, lease_id)
+        return True
+
+    async def get(self, key: str) -> Optional[bytes]:
+        await self._latency.apply()
+        e = self._data.get(key)
+        return e.value if e else None
+
+    async def get_prefix(self, prefix: str) -> List[KVEntry]:
+        await self._latency.apply()
+        return [e for k, e in sorted(self._data.items()) if k.startswith(prefix)]
+
+    async def delete(self, key: str) -> None:
+        await self._latency.apply()
+        e = self._data.pop(key, None)
+        if e is not None:
+            if e.lease_id:
+                self._lease_keys[e.lease_id].discard(key)
+            await self._notify(WatchEvent("delete", key))
+
+    # -- leases --------------------------------------------------------------
+
+    async def grant_lease(self, ttl: float = 10.0) -> Lease:
+        lease_id = next(self._lease_seq)
+        lease = Lease(lease_id, self._revoke)
+        lease.lost = asyncio.Event()
+        self._lease_deadline[lease_id] = time.monotonic() + ttl
+        self._lease_tasks[lease_id] = asyncio.create_task(
+            self._lease_watchdog(lease_id, ttl, lease))
+        lease.keep_alive = lambda: self._keep_alive(lease_id, ttl)
+        return lease
+
+    def _keep_alive(self, lease_id: int, ttl: float):
+        if lease_id in self._lease_deadline:
+            self._lease_deadline[lease_id] = time.monotonic() + ttl
+
+    async def _lease_watchdog(self, lease_id: int, ttl: float, lease: Lease):
+        while True:
+            deadline = self._lease_deadline.get(lease_id)
+            if deadline is None:
+                return
+            now = time.monotonic()
+            if now >= deadline:
+                await self._expire(lease_id)
+                lease.lost.set()
+                return
+            await asyncio.sleep(min(deadline - now, ttl / 3))
+
+    async def _expire(self, lease_id: int):
+        self._lease_deadline.pop(lease_id, None)
+        for key in list(self._lease_keys.pop(lease_id, ())):
+            await self.delete(key)
+
+    async def _revoke(self, lease_id: int):
+        task = self._lease_tasks.pop(lease_id, None)
+        if task:
+            task.cancel()
+        await self._expire(lease_id)
+
+    # -- watch ---------------------------------------------------------------
+
+    async def watch_prefix(self, prefix: str):
+        snapshot = await self.get_prefix(prefix)
+        q: asyncio.Queue = asyncio.Queue()
+        entry = (prefix, q)
+        self._watchers.append(entry)
+
+        async def gen() -> AsyncIterator[WatchEvent]:
+            try:
+                while True:
+                    yield await q.get()
+            finally:
+                if entry in self._watchers:
+                    self._watchers.remove(entry)
+
+        return snapshot, gen()
+
+
+class MemoryMessaging(Messaging):
+    def __init__(self, latency: Optional[LatencyModel] = None):
+        self._handlers: Dict[str, callable] = {}
+        self._subs: List[Tuple[str, asyncio.Queue]] = []
+        self._queues: Dict[str, asyncio.Queue] = defaultdict(asyncio.Queue)
+        self._latency = latency or LatencyModel()
+
+    async def serve(self, subject, handler):
+        self._handlers[subject] = handler
+
+        async def unsubscribe():
+            if self._handlers.get(subject) is handler:
+                del self._handlers[subject]
+
+        return unsubscribe
+
+    async def request(self, subject, payload, timeout: float = 30.0):
+        await self._latency.apply()
+        handler = self._handlers.get(subject)
+        if handler is None:
+            raise ConnectionError(f"no responder on subject {subject!r}")
+        return await asyncio.wait_for(handler(payload), timeout)
+
+    async def publish(self, subject, payload):
+        await self._latency.apply()
+        for pattern, q in list(self._subs):
+            if subject_matches(pattern, subject):
+                q.put_nowait((subject, payload))
+
+    async def subscribe(self, subject):
+        q: asyncio.Queue = asyncio.Queue()
+        entry = (subject, q)
+        self._subs.append(entry)
+
+        async def gen():
+            try:
+                while True:
+                    yield await q.get()
+            finally:
+                if entry in self._subs:
+                    self._subs.remove(entry)
+
+        return gen()
+
+    async def queue_push(self, queue, payload):
+        await self._latency.apply()
+        self._queues[queue].put_nowait(payload)
+
+    async def queue_pop(self, queue, timeout=None):
+        try:
+            if timeout is None:
+                return await self._queues[queue].get()
+            return await asyncio.wait_for(self._queues[queue].get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    async def queue_depth(self, queue):
+        return self._queues[queue].qsize()
+
+
+class MemoryPlane:
+    """Bundle of both planes, shared by components within one process."""
+
+    def __init__(self, latency: Optional[LatencyModel] = None):
+        self.kv = MemoryKVStore(latency)
+        self.messaging = MemoryMessaging(latency)

@@ -1,0 +1,265 @@
+"""Standalone control-plane server: the etcd+NATS replacement.
+
+One asyncio TCP server providing discovery KV (leases, prefix watches), the
+request plane (addressed request/reply routed to registered responders),
+the event plane (pub/sub), and durable work queues — the roles the reference
+outsources to etcd and NATS/JetStream (reference: SURVEY.md §L0,
+deploy/docker-compose.yml:16-31). State is held in the same MemoryKVStore/
+MemoryMessaging used in-process, so semantics are identical in tests and
+deployments.
+
+Run: python -m dynamo_tpu.runtime.transports.server --port 6230
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import logging
+from typing import Dict
+
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+from dynamo_tpu.runtime.transports.wire import read_frame, write_frame
+
+log = logging.getLogger("dynamo_tpu.controlplane")
+
+DEFAULT_PORT = 6230
+
+
+class _Conn:
+    def __init__(self, server: "ControlPlaneServer", reader, writer):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.watch_tasks: Dict[int, asyncio.Task] = {}
+        self.sub_tasks: Dict[int, asyncio.Task] = {}
+        self.responders: Dict[str, None] = {}
+        self.pending_handles: Dict[int, asyncio.Future] = {}
+        self.pop_tasks: Dict[int, asyncio.Task] = {}
+        self._write_lock = asyncio.Lock()
+
+    async def send(self, msg):
+        async with self._write_lock:
+            write_frame(self.writer, msg)
+            await self.writer.drain()
+
+    async def run(self):
+        try:
+            while True:
+                msg = await read_frame(self.reader)
+                asyncio.create_task(self._dispatch(msg))
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            await self.cleanup()
+
+    async def cleanup(self):
+        for t in list(self.watch_tasks.values()) + list(self.sub_tasks.values()) \
+                + list(self.pop_tasks.values()):
+            t.cancel()
+        for subject in list(self.responders):
+            # only deregister if WE are still the registered responder — a
+            # reconnected worker may have re-registered the same subject
+            if self.server.responders.get(subject) is self:
+                del self.server.responders[subject]
+        for fut in self.pending_handles.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("responder disconnected"))
+        self.writer.close()
+
+    async def _dispatch(self, msg):
+        op = msg.get("op")
+        rid = msg.get("id")
+        try:
+            handler = getattr(self, f"_op_{op}", None)
+            if handler is None:
+                raise ValueError(f"unknown op {op!r}")
+            result = await handler(msg)
+            if rid is not None:
+                await self.send({"id": rid, **(result or {})})
+        except Exception as e:  # noqa: BLE001 — reported to the peer
+            if rid is not None:
+                await self.send({"id": rid, "error": f"{type(e).__name__}: {e}"})
+            else:
+                log.exception("error handling %s", op)
+
+    # -- KV ------------------------------------------------------------------
+
+    async def _op_put(self, m):
+        await self.server.plane.kv.put(m["key"], m["value"], m.get("lease", 0))
+        return {}
+
+    async def _op_create(self, m):
+        ok = await self.server.plane.kv.create(m["key"], m["value"], m.get("lease", 0))
+        return {"ok": ok}
+
+    async def _op_get(self, m):
+        return {"value": await self.server.plane.kv.get(m["key"])}
+
+    async def _op_get_prefix(self, m):
+        entries = await self.server.plane.kv.get_prefix(m["prefix"])
+        return {"entries": [[e.key, e.value, e.lease_id] for e in entries]}
+
+    async def _op_delete(self, m):
+        await self.server.plane.kv.delete(m["key"])
+        return {}
+
+    async def _op_lease_grant(self, m):
+        lease = await self.server.plane.kv.grant_lease(m.get("ttl", 10.0))
+        self.server.leases[lease.id] = lease
+        return {"lease": lease.id}
+
+    async def _op_lease_keepalive(self, m):
+        lease = self.server.leases.get(m["lease"])
+        if lease is None:
+            return {"ok": False}
+        lease.keep_alive()
+        return {"ok": True}
+
+    async def _op_lease_revoke(self, m):
+        lease = self.server.leases.pop(m["lease"], None)
+        if lease is not None:
+            await lease.revoke()
+        return {}
+
+    async def _op_watch(self, m):
+        wid = next(self.server.ids)
+        snapshot, events = await self.server.plane.kv.watch_prefix(m["prefix"])
+
+        async def pump():
+            async for ev in events:
+                await self.send({"op": "watch_event", "watch_id": wid,
+                                 "kind": ev.kind, "key": ev.key,
+                                 "value": ev.value})
+
+        self.watch_tasks[wid] = asyncio.create_task(pump())
+        return {"watch_id": wid,
+                "entries": [[e.key, e.value, e.lease_id] for e in snapshot]}
+
+    async def _op_unwatch(self, m):
+        t = self.watch_tasks.pop(m["watch_id"], None)
+        if t:
+            t.cancel()
+        return {}
+
+    # -- request plane -------------------------------------------------------
+
+    async def _op_serve(self, m):
+        subject = m["subject"]
+        self.server.responders[subject] = self
+        self.responders[subject] = None
+        return {}
+
+    async def _op_unserve(self, m):
+        subject = m["subject"]
+        if self.server.responders.get(subject) is self:
+            del self.server.responders[subject]
+        self.responders.pop(subject, None)
+        return {}
+
+    async def _op_request(self, m):
+        responder = self.server.responders.get(m["subject"])
+        if responder is None:
+            raise ConnectionError(f"no responder on {m['subject']!r}")
+        hid = next(self.server.ids)
+        fut = asyncio.get_running_loop().create_future()
+        responder.pending_handles[hid] = fut
+        await responder.send({"op": "handle", "handle_id": hid,
+                              "subject": m["subject"], "payload": m["payload"]})
+        try:
+            payload = await asyncio.wait_for(fut, m.get("timeout", 30.0))
+        finally:
+            responder.pending_handles.pop(hid, None)
+        return {"payload": payload}
+
+    async def _op_reply(self, m):
+        fut = self.pending_handles.get(m["handle_id"])
+        if fut is not None and not fut.done():
+            if m.get("error"):
+                fut.set_exception(RuntimeError(m["error"]))
+            else:
+                fut.set_result(m["payload"])
+        return None
+
+    # -- events --------------------------------------------------------------
+
+    async def _op_publish(self, m):
+        await self.server.plane.messaging.publish(m["subject"], m["payload"])
+        return {}
+
+    async def _op_subscribe(self, m):
+        sid = next(self.server.ids)
+        gen = await self.server.plane.messaging.subscribe(m["subject"])
+
+        async def pump():
+            async for subject, payload in gen:
+                await self.send({"op": "event", "sub_id": sid,
+                                 "subject": subject, "payload": payload})
+
+        self.sub_tasks[sid] = asyncio.create_task(pump())
+        return {"sub_id": sid}
+
+    async def _op_unsubscribe(self, m):
+        t = self.sub_tasks.pop(m["sub_id"], None)
+        if t:
+            t.cancel()
+        return {}
+
+    # -- queues --------------------------------------------------------------
+
+    async def _op_queue_push(self, m):
+        await self.server.plane.messaging.queue_push(m["queue"], m["payload"])
+        return {}
+
+    async def _op_queue_pop(self, m):
+        payload = await self.server.plane.messaging.queue_pop(
+            m["queue"], m.get("timeout"))
+        return {"payload": payload}
+
+    async def _op_queue_depth(self, m):
+        return {"depth": await self.server.plane.messaging.queue_depth(m["queue"])}
+
+    async def _op_ping(self, m):
+        return {"pong": True}
+
+
+class ControlPlaneServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
+        self.host, self.port = host, port
+        self.plane = MemoryPlane()
+        self.responders: Dict[str, _Conn] = {}
+        self.leases: Dict[int, object] = {}
+        self.ids = itertools.count(1)
+        self._server: asyncio.AbstractServer = None
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._on_connect, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def _on_connect(self, reader, writer):
+        await _Conn(self, reader, writer).run()
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def serve_forever(self):
+        await self.start()
+        log.info("control plane listening on %s:%d", self.host, self.port)
+        await asyncio.Event().wait()
+
+
+def main():
+    ap = argparse.ArgumentParser(description="dynamo-tpu control plane server")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=DEFAULT_PORT)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(ControlPlaneServer(args.host, args.port).serve_forever())
+
+
+if __name__ == "__main__":
+    main()

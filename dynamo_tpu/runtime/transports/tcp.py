@@ -1,0 +1,239 @@
+"""TCP client for the control-plane server: KVStore + Messaging over one socket.
+
+Counterpart of the reference's etcd/NATS client wrappers (reference:
+lib/runtime/src/transports/etcd.rs:38-328, transports/nats.rs:45-110) — one
+multiplexed connection carries KV ops, watches, addressed requests, events,
+and queue ops.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import AsyncIterator, Dict, Optional
+
+from dynamo_tpu.runtime.transports.base import (
+    KVEntry, KVStore, Lease, Messaging, WatchEvent,
+)
+from dynamo_tpu.runtime.transports.wire import read_frame, write_frame
+
+log = logging.getLogger("dynamo_tpu.transports.tcp")
+
+
+class ControlPlaneClient(KVStore, Messaging):
+    def __init__(self, host: str = "127.0.0.1", port: int = 6230):
+        self.host, self.port = host, port
+        self._reader = None
+        self._writer = None
+        self._ids = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._watch_queues: Dict[int, asyncio.Queue] = {}
+        self._sub_queues: Dict[int, asyncio.Queue] = {}
+        self._handlers: Dict[str, callable] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._keepalive_tasks: Dict[int, asyncio.Task] = {}
+        self._write_lock = asyncio.Lock()
+        self.closed = asyncio.Event()
+
+    async def connect(self) -> "ControlPlaneClient":
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port)
+        self._reader_task = asyncio.create_task(self._read_loop())
+        return self
+
+    async def close(self):
+        for t in self._keepalive_tasks.values():
+            t.cancel()
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+        self.closed.set()
+
+    # -- plumbing ------------------------------------------------------------
+
+    async def _send(self, msg):
+        async with self._write_lock:
+            write_frame(self._writer, msg)
+            await self._writer.drain()
+
+    async def _rpc(self, msg, timeout: float = 60.0):
+        rid = next(self._ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            await self._send({"id": rid, **msg})
+            reply = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(rid, None)
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return reply
+
+    async def _read_loop(self):
+        try:
+            while True:
+                msg = await read_frame(self._reader)
+                op = msg.get("op")
+                if op is None:
+                    fut = self._pending.get(msg.get("id"))
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+                elif op == "watch_event":
+                    q = self._watch_queues.get(msg["watch_id"])
+                    if q:
+                        q.put_nowait(WatchEvent(msg["kind"], msg["key"],
+                                                msg.get("value")))
+                elif op == "event":
+                    q = self._sub_queues.get(msg["sub_id"])
+                    if q:
+                        q.put_nowait((msg["subject"], msg["payload"]))
+                elif op == "handle":
+                    asyncio.create_task(self._handle_request(msg))
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            self.closed.set()
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("control plane lost"))
+
+    async def _handle_request(self, msg):
+        handler = self._handlers.get(msg["subject"])
+        reply = {"op": "reply", "handle_id": msg["handle_id"]}
+        if handler is None:
+            reply["error"] = f"no local handler for {msg['subject']!r}"
+        else:
+            try:
+                reply["payload"] = await handler(msg["payload"])
+            except Exception as e:  # noqa: BLE001 — reported to the caller
+                reply["error"] = f"{type(e).__name__}: {e}"
+        await self._send(reply)
+
+    # -- KVStore -------------------------------------------------------------
+
+    async def put(self, key, value, lease_id: int = 0):
+        await self._rpc({"op": "put", "key": key, "value": value,
+                         "lease": lease_id})
+
+    async def create(self, key, value, lease_id: int = 0) -> bool:
+        return (await self._rpc({"op": "create", "key": key, "value": value,
+                                 "lease": lease_id}))["ok"]
+
+    async def get(self, key):
+        return (await self._rpc({"op": "get", "key": key}))["value"]
+
+    async def get_prefix(self, prefix):
+        reply = await self._rpc({"op": "get_prefix", "prefix": prefix})
+        return [KVEntry(k, v, l) for k, v, l in reply["entries"]]
+
+    async def delete(self, key):
+        await self._rpc({"op": "delete", "key": key})
+
+    async def grant_lease(self, ttl: float = 10.0) -> Lease:
+        reply = await self._rpc({"op": "lease_grant", "ttl": ttl})
+        lease_id = reply["lease"]
+        lease = Lease(lease_id, self._revoke_lease)
+        lease.lost = asyncio.Event()
+        self._keepalive_tasks[lease_id] = asyncio.create_task(
+            self._keepalive_loop(lease_id, ttl, lease))
+        return lease
+
+    async def _revoke_lease(self, lease_id: int):
+        t = self._keepalive_tasks.pop(lease_id, None)
+        if t:
+            t.cancel()
+        await self._rpc({"op": "lease_revoke", "lease": lease_id})
+
+    async def _keepalive_loop(self, lease_id: int, ttl: float, lease: Lease):
+        """Heartbeat at ttl/3; a lost lease fires lease.lost (the runtime
+        couples that to shutdown, as the reference couples its primary etcd
+        lease to the cancellation token)."""
+        try:
+            while True:
+                await asyncio.sleep(ttl / 3)
+                try:
+                    ok = (await self._rpc({"op": "lease_keepalive",
+                                           "lease": lease_id}, timeout=ttl))["ok"]
+                except Exception:
+                    ok = False
+                if not ok:
+                    lease.lost.set()
+                    return
+        except asyncio.CancelledError:
+            pass
+
+    async def watch_prefix(self, prefix):
+        reply = await self._rpc({"op": "watch", "prefix": prefix})
+        wid = reply["watch_id"]
+        q: asyncio.Queue = asyncio.Queue()
+        self._watch_queues[wid] = q
+        snapshot = [KVEntry(k, v, l) for k, v, l in reply["entries"]]
+
+        async def gen() -> AsyncIterator[WatchEvent]:
+            try:
+                while True:
+                    yield await q.get()
+            finally:
+                self._watch_queues.pop(wid, None)
+                try:
+                    await self._rpc({"op": "unwatch", "watch_id": wid})
+                except Exception:
+                    pass
+
+        return snapshot, gen()
+
+    # -- Messaging -----------------------------------------------------------
+
+    async def serve(self, subject, handler):
+        self._handlers[subject] = handler
+        await self._rpc({"op": "serve", "subject": subject})
+
+        async def unsubscribe():
+            self._handlers.pop(subject, None)
+            await self._rpc({"op": "unserve", "subject": subject})
+
+        return unsubscribe
+
+    async def request(self, subject, payload, timeout: float = 30.0):
+        reply = await self._rpc({"op": "request", "subject": subject,
+                                 "payload": payload, "timeout": timeout},
+                                timeout=timeout + 5)
+        return reply["payload"]
+
+    async def publish(self, subject, payload):
+        await self._rpc({"op": "publish", "subject": subject,
+                         "payload": payload})
+
+    async def subscribe(self, subject):
+        reply = await self._rpc({"op": "subscribe", "subject": subject})
+        sid = reply["sub_id"]
+        q: asyncio.Queue = asyncio.Queue()
+        self._sub_queues[sid] = q
+
+        async def gen():
+            try:
+                while True:
+                    yield await q.get()
+            finally:
+                self._sub_queues.pop(sid, None)
+                try:
+                    await self._rpc({"op": "unsubscribe", "sub_id": sid})
+                except Exception:
+                    pass
+
+        return gen()
+
+    async def queue_push(self, queue, payload):
+        await self._rpc({"op": "queue_push", "queue": queue,
+                         "payload": payload})
+
+    async def queue_pop(self, queue, timeout=None):
+        rpc_timeout = (timeout + 5) if timeout is not None else 3600.0
+        reply = await self._rpc({"op": "queue_pop", "queue": queue,
+                                 "timeout": timeout}, timeout=rpc_timeout)
+        return reply["payload"]
+
+    async def queue_depth(self, queue):
+        return (await self._rpc({"op": "queue_depth", "queue": queue}))["depth"]

@@ -1,0 +1,35 @@
+"""Length-prefixed msgpack framing shared by the control plane and data plane.
+
+Equivalent in role to the reference's TwoPartCodec length-prefixed wire format
+(reference: lib/runtime/src/pipeline/network/codec/two_part.rs:23-139); we use
+a single msgpack map per frame (header fields + binary payload under "payload")
+rather than separate header/data parts — msgpack keeps binary payloads
+zero-escape, and one map keeps the codec trivial.
+"""
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Dict
+
+import msgpack
+
+MAX_FRAME = 256 * 1024 * 1024  # KV pages can be large
+
+
+def pack(msg: Dict[str, Any]) -> bytes:
+    body = msgpack.packb(msg, use_bin_type=True)
+    return struct.pack(">I", len(body)) + body
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Dict[str, Any]:
+    header = await reader.readexactly(4)
+    (length,) = struct.unpack(">I", header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame of {length} bytes exceeds max {MAX_FRAME}")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False)
+
+
+def write_frame(writer: asyncio.StreamWriter, msg: Dict[str, Any]) -> None:
+    writer.write(pack(msg))

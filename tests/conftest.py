@@ -1,0 +1,26 @@
+"""Test configuration: force CPU with an 8-device virtual mesh.
+
+Mirrors the reference's hardware-independent test strategy (SURVEY.md §4.5):
+the reference tests its runtime with closure engines and a mock network; we
+test our JAX engine and sharding on a virtual 8-device CPU mesh so no TPU is
+required.
+
+NOTE: this image registers the TPU backend via sitecustomize and pins
+jax_platforms programmatically, so an env-var override is not enough — we must
+set the config knob after importing jax (before any backend init).
+"""
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,133 @@
+"""End-to-end engine tests: continuous batching, prefix cache, stop conditions."""
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+
+
+def make_engine(**kw):
+    defaults = dict(
+        page_size=8, num_pages=64, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512)
+    defaults.update(kw)
+    return NativeEngine(CFG, EngineConfig(**defaults), seed=0)
+
+
+def test_greedy_generate_deterministic():
+    eng1 = make_engine()
+    eng2 = make_engine()
+    prompt = list(range(10, 30))
+    p = SamplingParams(max_tokens=8, temperature=0.0)
+    out1 = eng1.generate(prompt, p, "a")
+    out2 = eng2.generate(prompt, p, "b")
+    assert len(out1) == 8
+    assert out1 == out2
+
+
+def test_chunked_prefill_same_output():
+    """A prompt longer than max_prefill_chunk must give identical greedy
+    output to an engine that prefills it in one chunk."""
+    prompt = list(range(5, 53))  # 48 tokens
+    p = SamplingParams(max_tokens=6, temperature=0.0)
+    small = make_engine(max_prefill_chunk=16)
+    big = make_engine(max_prefill_chunk=64, prefill_buckets=(8, 16, 32, 64))
+    assert small.generate(prompt, p, "a") == big.generate(prompt, p, "b")
+
+
+def test_continuous_batching_matches_sequential():
+    """Concurrent greedy requests must produce the same tokens as running
+    each alone (batching must not change results)."""
+    prompts = [list(range(3, 19)), list(range(40, 50)), list(range(7, 36))]
+    p = SamplingParams(max_tokens=5, temperature=0.0)
+    solo = [make_engine().generate(pr, p, f"s{i}") for i, pr in enumerate(prompts)]
+
+    eng = make_engine()
+    for i, pr in enumerate(prompts):
+        eng.add_request(EngineRequest(f"r{i}", pr, p))
+    got = {f"r{i}": [] for i in range(len(prompts))}
+    done = set()
+    while len(done) < len(prompts):
+        for ev in eng.step():
+            if ev.token is not None:
+                got[ev.request_id].append(ev.token)
+            if ev.finished:
+                done.add(ev.request_id)
+    assert [got[f"r{i}"] for i in range(len(prompts))] == solo
+
+
+def test_prefix_cache_reuse():
+    eng = make_engine()
+    prompt = list(range(1, 33))  # 32 tokens = 4 full pages
+    p = SamplingParams(max_tokens=4, temperature=0.0)
+    out1 = eng.generate(prompt, p, "a")
+    m1 = eng.metrics()
+    assert m1.gpu_prefix_cache_hit_rate == 0.0
+    out2 = eng.generate(prompt, p, "b")
+    assert out2 == out1
+    m2 = eng.metrics()
+    assert m2.gpu_prefix_cache_hit_rate > 0.0
+    ev = eng.drain_kv_events()
+    assert any(e[0] == "stored" for e in ev)
+
+
+def test_seeded_sampling_deterministic():
+    prompt = list(range(2, 20))
+    p = SamplingParams(max_tokens=6, temperature=0.9, top_k=20, seed=1234)
+    out1 = make_engine().generate(prompt, p, "a")
+    out2 = make_engine().generate(prompt, p, "b")
+    assert out1 == out2
+
+
+def test_stop_token_hidden():
+    """Engine must stop on a stop_token_id without emitting it."""
+    eng = make_engine()
+    prompt = list(range(10, 26))
+    # first run to discover the greedy continuation
+    ref = eng.generate(prompt, SamplingParams(max_tokens=6), "probe")
+    stop = ref[2]
+    eng2 = make_engine()
+    out = eng2.generate(
+        prompt, SamplingParams(max_tokens=6, stop_token_ids=(stop,)), "x")
+    assert out == ref[:2]
+
+
+def test_eos_and_max_tokens():
+    eng = make_engine()
+    prompt = list(range(10, 26))
+    ref = eng.generate(prompt, SamplingParams(max_tokens=6), "probe")
+    eos = ref[3]
+    eng2 = NativeEngine(
+        CFG, EngineConfig(page_size=8, num_pages=64, max_slots=4,
+                          max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+                          max_model_len=512),
+        eos_token_ids={eos}, seed=0)
+    out = eng2.generate(prompt, SamplingParams(max_tokens=6), "x")
+    assert out == ref[:3]
+    # ignore_eos overrides
+    eng3 = NativeEngine(
+        CFG, EngineConfig(page_size=8, num_pages=64, max_slots=4,
+                          max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+                          max_model_len=512),
+        eos_token_ids={eos}, seed=0)
+    out3 = eng3.generate(prompt, SamplingParams(max_tokens=6, ignore_eos=True), "y")
+    assert out3 == ref
+
+
+def test_request_too_long_rejected():
+    eng = make_engine()
+    with pytest.raises(ValueError):
+        eng.add_request(EngineRequest("big", list(range(600)), SamplingParams()))
+
+
+def test_metrics_snapshot():
+    eng = make_engine()
+    eng.add_request(EngineRequest("m", list(range(20)), SamplingParams(max_tokens=4)))
+    eng.step()
+    m = eng.metrics()
+    assert m.request_total_slots == 4
+    assert m.kv_total_blocks == 64
+    assert m.kv_active_blocks > 0

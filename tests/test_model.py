@@ -1,0 +1,128 @@
+"""Model-level tests: paged attention correctness against dense oracles.
+
+Strategy mirrors the reference's hardware-independent unit tests (SURVEY.md
+§4.5): tiny configs, CPU devices, exact comparisons where possible.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.llama import AttnMetadata
+from dynamo_tpu.ops.attention import (
+    dense_causal_attention, paged_attention, write_kv_pages,
+)
+
+CFG = ModelConfig(dtype="float32")  # f32 on CPU for tight comparisons
+
+
+def test_paged_attention_matches_dense():
+    """Scatter KV into shuffled pages; paged attn must equal dense attn."""
+    rng = np.random.default_rng(0)
+    b, t, h, hkv, hd, ps = 2, 48, 4, 2, 16, 8
+    n_pages = 32
+    q = jnp.asarray(rng.standard_normal((b, t, h, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, hkv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, hkv, hd)), jnp.float32)
+
+    # assign each sequence non-contiguous pages
+    perm = rng.permutation(n_pages)
+    pages_per_seq = t // ps
+    page_table = np.zeros((b, pages_per_seq + 2), np.int32)  # padded bucket
+    k_cache = jnp.zeros((n_pages, ps, hkv, hd), jnp.float32)
+    v_cache = jnp.zeros((n_pages, ps, hkv, hd), jnp.float32)
+    for i in range(b):
+        pages = perm[i * pages_per_seq:(i + 1) * pages_per_seq]
+        page_table[i, :pages_per_seq] = pages
+        write_idx = np.array([pages[p // ps] * ps + p % ps for p in range(t)],
+                             np.int32)[None, :]
+        k_cache, v_cache = write_kv_pages(
+            k_cache, v_cache, k[i:i + 1], v[i:i + 1], jnp.asarray(write_idx))
+
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    kv_lens = jnp.full((b,), t, jnp.int32)
+    out = paged_attention(q, k_cache, v_cache, jnp.asarray(page_table),
+                          kv_lens, positions)
+    expected = dense_causal_attention(q, k, v, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_write_kv_pages_drops_negative_indices():
+    k_cache = jnp.zeros((2, 4, 1, 8), jnp.float32)
+    v_cache = jnp.zeros((2, 4, 1, 8), jnp.float32)
+    k_new = jnp.ones((1, 3, 1, 8), jnp.float32)
+    write_idx = jnp.asarray([[0, -1, 5]], jnp.int32)
+    k2, _ = write_kv_pages(k_cache, v_cache, k_new, k_new, write_idx)
+    flat = np.asarray(k2).reshape(8, 8)
+    assert flat[0].sum() == 8 and flat[5].sum() == 8
+    assert np.abs(flat[[1, 2, 3, 4, 6, 7]]).sum() == 0
+
+
+def _full_forward_logits(params, cfg, tokens_np):
+    """Oracle: one prefill pass over the whole sequence, all positions."""
+    t = len(tokens_np)
+    ps = 8
+    n_pages = (t + ps - 1) // ps + 1
+    cache = llama.init_cache(cfg, n_pages, ps)
+    meta = AttnMetadata(
+        positions=jnp.arange(t, dtype=jnp.int32)[None],
+        page_table=jnp.arange(n_pages, dtype=jnp.int32)[None],
+        kv_lens=jnp.asarray([t], jnp.int32),
+        write_idx=jnp.arange(t, dtype=jnp.int32)[None],
+    )
+    logits, _ = llama.forward(params, cfg, jnp.asarray(tokens_np)[None], cache, meta)
+    return np.asarray(logits[0])
+
+
+def test_chunked_prefill_and_decode_match_full_forward():
+    """KV built incrementally (chunks + single-token decode) must give the
+    same logits as one full-sequence pass."""
+    cfg = CFG
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    rng = np.random.default_rng(2)
+    t = 20
+    tokens = rng.integers(0, cfg.vocab_size, t).astype(np.int32)
+    full = _full_forward_logits(params, cfg, tokens)
+
+    ps = 8
+    n_pages = 8
+    cache = llama.init_cache(cfg, n_pages, ps)
+    page_table = jnp.arange(n_pages, dtype=jnp.int32)[None]
+    got = np.zeros_like(full)
+    # chunked prefill: [0,8), [8,16)
+    for start, end in [(0, 8), (8, 16)]:
+        meta = AttnMetadata(
+            positions=jnp.arange(start, end, dtype=jnp.int32)[None],
+            page_table=page_table,
+            kv_lens=jnp.asarray([end], jnp.int32),
+            write_idx=jnp.arange(start, end, dtype=jnp.int32)[None],
+        )
+        logits, cache = llama.forward(
+            params, cfg, jnp.asarray(tokens[start:end])[None], cache, meta)
+        got[start:end] = np.asarray(logits[0])
+    # decode one token at a time: positions 16..19
+    for pos in range(16, t):
+        meta = AttnMetadata(
+            positions=jnp.asarray([[pos]], jnp.int32),
+            page_table=page_table,
+            kv_lens=jnp.asarray([pos + 1], jnp.int32),
+            write_idx=jnp.asarray([[pos]], jnp.int32),
+        )
+        logits, cache = llama.forward(
+            params, cfg, jnp.asarray([[tokens[pos]]]), cache, meta)
+        got[pos] = np.asarray(logits[0, 0])
+
+    np.testing.assert_allclose(got, full, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_forward_runs():
+    cfg = ModelConfig(name="tiny-moe", dtype="float32", num_experts=4,
+                      num_experts_per_tok=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    logits = _full_forward_logits(params, cfg, np.arange(10, dtype=np.int32))
+    assert logits.shape == (10, cfg.vocab_size)
+    assert np.isfinite(logits).all()

@@ -1,0 +1,118 @@
+"""Protocol layer tests: SSE codec, incremental detokenize, stop jail,
+preprocessor (template+tokenize+defaults+annotations)."""
+from dynamo_tpu.llm.backend import BackendPostprocessor, StopJail
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.tokenizer import ByteTokenizer, DecodeStream
+from dynamo_tpu.protocols.common import EngineOutput, FinishReason
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest, ChatMessage, CompletionRequest, Ext,
+)
+from dynamo_tpu.protocols.sse import (
+    SseEvent, decode_stream, encode_event, encode_json_data,
+)
+
+
+def test_sse_roundtrip_with_edge_cases():
+    text = (
+        encode_event(SseEvent(comments=["keepalive"]))
+        + encode_event(SseEvent(data='{"a":1}', event="annotation", id="7"))
+        + encode_event(SseEvent(data="line1\nline2"))
+        + "data: [DONE]\n\n"
+    )
+    events = list(decode_stream(text))
+    assert events[0].comments == ["keepalive"] and events[0].data is None
+    assert events[1].data == '{"a":1}' and events[1].event == "annotation"
+    assert events[1].id == "7"
+    assert events[2].data == "line1\nline2"
+    assert events[3].is_done
+
+
+def test_encode_json_data():
+    assert encode_json_data({"x": 1}) == 'data: {"x":1}\n\n'
+
+
+def test_decode_stream_utf8_boundary():
+    tok = ByteTokenizer()
+    ids = tok.encode("héllo ✓")
+    ds = DecodeStream(tok)
+    out = "".join(ds.step(i) for i in ids)
+    assert out == "héllo ✓"
+    # multi-byte glyphs must never emit partial replacement chars
+    ds2 = DecodeStream(tok)
+    pieces = [ds2.step(i) for i in tok.encode("✓")]
+    assert "".join(pieces) == "✓"
+    assert all("�" not in p for p in pieces)
+
+
+def test_stop_jail_partial_and_full():
+    jail = StopJail(["STOP"])
+    out, stopped = jail.push("hello ST")
+    assert out == "hello " and not stopped  # "ST" held as possible prefix
+    out, stopped = jail.push("ILL")  # resolves to not-a-stop
+    assert out == "STILL" and not stopped
+    out, stopped = jail.push(" and STOP now")
+    assert out == " and " and stopped
+
+
+def test_backend_postprocessor_end_to_end():
+    tok = ByteTokenizer()
+    bp = BackendPostprocessor(tok, stop_strings=["</s>"])
+    r1 = bp.process(EngineOutput(token_ids=tok.encode("hi the")))
+    r2 = bp.process(EngineOutput(token_ids=tok.encode("re</s>ignored")))
+    assert r1.text + r2.text == "hi there"
+    assert r2.finish_reason == FinishReason.STOP
+
+
+def test_preprocessor_chat_template_and_defaults():
+    card = ModelDeploymentCard(name="m", context_length=128)
+    pre = OpenAIPreprocessor(card)
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[ChatMessage(role="user", content="hi")],
+        max_tokens=10, temperature=0.5, stop="END",
+        ext=Ext(annotations=["token_ids", "formatted_prompt"], top_k=5),
+    )
+    out, anns = pre.preprocess_chat(req, "rid")
+    assert out.request_id == "rid"
+    assert out.token_ids == pre.tokenizer.encode("<|user|>hi</s><|assistant|>")
+    assert out.stop.max_tokens == 10
+    assert out.stop.stop == ["END"]
+    assert out.sampling.temperature == 0.5
+    assert out.sampling.top_k == 5
+    assert out.eos_token_ids == [2]
+    assert {a.event for a in anns} == {"token_ids", "formatted_prompt"}
+    assert out.mdc_sum == card.mdcsum
+
+
+def test_preprocessor_completion_and_token_prompt():
+    card = ModelDeploymentCard(name="m", context_length=64)
+    pre = OpenAIPreprocessor(card)
+    out, _ = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt="abc", max_tokens=99))
+    # max_tokens clamped to remaining context
+    assert out.stop.max_tokens == 61
+    assert out.token_ids == pre.tokenizer.encode("abc")
+    out2, _ = pre.preprocess_completion(
+        CompletionRequest(model="m", prompt=[5, 6, 7]))
+    assert out2.token_ids == [5, 6, 7]
+
+
+def test_greed_sampling_ext():
+    card = ModelDeploymentCard(name="m")
+    pre = OpenAIPreprocessor(card)
+    req = ChatCompletionRequest(
+        model="m", messages=[ChatMessage(role="user", content="x")],
+        temperature=0.9, ext=Ext(greed_sampling=True))
+    out, _ = pre.preprocess_chat(req)
+    assert out.sampling.temperature == 0.0
+
+
+def test_model_card_roundtrip_and_checksum():
+    card = ModelDeploymentCard(name="m", arch="tiny", context_length=512)
+    d = card.to_dict()
+    card2 = ModelDeploymentCard.from_dict(d)
+    assert card2 == card
+    assert card.mdcsum == card2.mdcsum
+    card3 = ModelDeploymentCard(name="m2", arch="tiny", context_length=512)
+    assert card3.mdcsum != card.mdcsum

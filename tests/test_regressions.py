@@ -1,0 +1,131 @@
+"""Regression tests for review findings (round 1)."""
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+
+
+def test_memory_plane_lease_kept_alive():
+    """create_local runtimes must NOT self-destruct at the 10s lease TTL."""
+    import dynamo_tpu.runtime.distributed as dist
+
+    async def main():
+        old = dist.LEASE_TTL_S
+        dist.LEASE_TTL_S = 0.3
+        try:
+            plane = MemoryPlane()
+            rt = await DistributedRuntime.create_local(plane, "w")
+            await rt.kv.put("k", b"v", rt.lease.id)
+            await asyncio.sleep(1.2)  # 4x TTL
+            assert not rt.shutdown_event.is_set()
+            assert await rt.kv.get("k") == b"v"
+            await rt.shutdown()
+            await asyncio.sleep(0.05)
+            assert await rt.kv.get("k") is None  # revoke removed the key
+        finally:
+            dist.LEASE_TTL_S = old
+
+    asyncio.run(main())
+
+
+def test_engine_rejection_propagates_to_client():
+    async def main():
+        plane = MemoryPlane()
+        srt = await DistributedRuntime.create_local(plane, "w")
+
+        def bad_engine(request, context):
+            raise ValueError("bad request shape")
+
+        await srt.namespace("ns").component("c").endpoint("gen").serve(bad_engine)
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("c").endpoint("gen").client()
+        await client.start()
+        with pytest.raises(RuntimeError, match="bad request shape"):
+            async for _ in await client.generate({}):
+                pass
+        await crt.shutdown()
+        await srt.shutdown()
+
+    asyncio.run(main())
+
+
+def test_duplicate_page_hash_no_leak():
+    """Two requests computing identical pages must not leak pool pages."""
+    eng = NativeEngine(
+        CFG, EngineConfig(page_size=8, num_pages=32, max_slots=4,
+                          max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+                          max_model_len=512), seed=0)
+    prompt = list(range(1, 25))  # 3 full pages
+    p = SamplingParams(max_tokens=2, temperature=0.0)
+    # run both CONCURRENTLY so neither can prefix-hit the other's pages
+    eng.add_request(EngineRequest("a", prompt, p))
+    eng.add_request(EngineRequest("b", prompt, p))
+    done = set()
+    while len(done) < 2:
+        for ev in eng.step():
+            if ev.finished:
+                done.add(ev.request_id)
+    alloc = eng.scheduler.allocator
+    assert alloc.num_free == alloc.num_pages  # everything reclaimable
+
+
+def test_min_tokens_blocks_eos():
+    eng0 = NativeEngine(
+        CFG, EngineConfig(page_size=8, num_pages=64, max_slots=4,
+                          max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+                          max_model_len=512), seed=0)
+    prompt = list(range(10, 26))
+    ref = eng0.generate(prompt, SamplingParams(max_tokens=8), "probe")
+    eos = ref[2]
+
+    def eng_with_eos():
+        return NativeEngine(
+            CFG, EngineConfig(page_size=8, num_pages=64, max_slots=4,
+                              max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+                              max_model_len=512),
+            eos_token_ids={eos}, seed=0)
+
+    # without min_tokens: stops at the eos position, eos not emitted
+    out = eng_with_eos().generate(prompt, SamplingParams(max_tokens=8), "x")
+    assert len(out) == 2
+    # with min_tokens: eos masked, generation continues past it
+    out2 = eng_with_eos().generate(
+        prompt, SamplingParams(max_tokens=6, min_tokens=5), "y")
+    assert len(out2) >= 5
+    assert eos not in out2[:4]
+
+
+def test_preemption_preserves_greedy_output():
+    """Force preemption via a tiny page pool; greedy outputs must match an
+    un-preempted engine, and max_tokens must be respected."""
+    gen_cfg = dict(page_size=8, max_slots=2, max_prefill_chunk=16,
+                   prefill_buckets=(8, 16), max_model_len=256)
+    big = NativeEngine(CFG, EngineConfig(num_pages=64, **gen_cfg), seed=0)
+    p = SamplingParams(max_tokens=12, temperature=0.0)
+    prompts = [list(range(3, 19)), list(range(40, 56))]
+    expect = [big.generate(pr, p, f"s{i}") for i, pr in enumerate(prompts)]
+
+    # 8 pages of 8 tokens = 64 token slots; two seqs of 16+12=28 tokens need
+    # 56 slots but page-granularity rounding forces contention/preemption.
+    small = NativeEngine(CFG, EngineConfig(num_pages=8, **gen_cfg), seed=0)
+    for i, pr in enumerate(prompts):
+        small.add_request(EngineRequest(f"r{i}", pr, p))
+    got = {f"r{i}": [] for i in range(2)}
+    done = set()
+    for _ in range(500):
+        for ev in small.step():
+            if ev.token is not None:
+                got[ev.request_id].append(ev.token)
+            if ev.finished:
+                done.add(ev.request_id)
+        if len(done) == 2:
+            break
+    assert len(done) == 2, "requests did not finish under memory pressure"
+    assert [got[f"r{i}"] for i in range(2)] == expect

@@ -1,0 +1,222 @@
+"""Distributed runtime tests: component model, discovery, leases, streaming.
+
+Modeled on the reference's runtime test strategy (SURVEY.md §4.2): closure
+engines + in-memory control plane for most tests; a real TCP control-plane
+server for the transport-integration tests (the analogue of the reference's
+gated etcd/NATS tests, but self-contained so they always run).
+"""
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+from dynamo_tpu.runtime.transports.server import ControlPlaneServer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def echo_engine(request, context):
+    for i in range(int(request.get("n", 3))):
+        if context.is_stopped:
+            return
+        yield {"i": i, "text": request.get("text", "")}
+
+
+def test_serve_and_generate_memory_plane():
+    async def main():
+        plane = MemoryPlane()
+        server_rt = await DistributedRuntime.create_local(plane, "worker1")
+        client_rt = await DistributedRuntime.create_local(plane, "client1")
+        ep = server_rt.namespace("ns").component("echo").endpoint("generate")
+        await ep.serve(echo_engine)
+
+        client = client_rt.namespace("ns").component("echo").endpoint(
+            "generate").client()
+        await client.start()
+        await client.wait_for_instances()
+        frames = []
+        async for frame in await client.generate({"n": 4, "text": "hi"}):
+            frames.append(frame)
+        assert [f["i"] for f in frames] == [0, 1, 2, 3]
+        assert frames[0]["text"] == "hi"
+        await client_rt.shutdown()
+        await server_rt.shutdown()
+
+    run(main())
+
+
+def test_routing_policies_and_direct():
+    async def main():
+        plane = MemoryPlane()
+        rts = []
+        for wid in ("w1", "w2"):
+            rt = await DistributedRuntime.create_local(plane, wid)
+            ep = rt.namespace("ns").component("c").endpoint("gen")
+
+            async def engine(request, context, wid=wid):
+                yield {"worker": wid}
+
+            await ep.serve(engine)
+            rts.append(rt)
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("c").endpoint("gen").client()
+        await client.start()
+        await client.wait_for_instances()
+        assert client.instance_ids() == ["w1", "w2"]
+
+        # direct routing hits the requested instance
+        for wid in ("w1", "w2"):
+            frames = [f async for f in await client.direct({}, wid)]
+            assert frames == [{"worker": wid}]
+
+        # round robin alternates
+        seen = []
+        for _ in range(4):
+            frames = [f async for f in await client.round_robin({})]
+            seen.append(frames[0]["worker"])
+        assert set(seen) == {"w1", "w2"}
+        for rt in rts + [crt]:
+            await rt.shutdown()
+
+    run(main())
+
+
+def test_instance_removed_on_shutdown():
+    async def main():
+        plane = MemoryPlane()
+        rt1 = await DistributedRuntime.create_local(plane, "w1")
+        ep = rt1.namespace("ns").component("c").endpoint("gen")
+        await ep.serve(echo_engine)
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("c").endpoint("gen").client()
+        await client.start()
+        await client.wait_for_instances()
+        assert client.instance_ids() == ["w1"]
+        await rt1.shutdown()
+        await asyncio.sleep(0.05)  # watch event propagation
+        assert client.instance_ids() == []
+        await crt.shutdown()
+
+    run(main())
+
+
+def test_lease_expiry_prunes_instances():
+    """Killing keep-alive (by revoking through expiry path) removes keys —
+    the reference's lease-TTL failure-detection behavior."""
+    async def main():
+        plane = MemoryPlane()
+        lease = await plane.kv.grant_lease(ttl=0.15)
+        await plane.kv.put("ns/components/c/gen:wX", b"{}", lease.id)
+        assert await plane.kv.get("ns/components/c/gen:wX") is not None
+        await asyncio.sleep(0.4)  # no keep-alive -> expiry
+        assert await plane.kv.get("ns/components/c/gen:wX") is None
+        assert lease.lost.is_set()
+
+    run(main())
+
+
+def test_cancellation_stops_stream():
+    async def main():
+        plane = MemoryPlane()
+        srt = await DistributedRuntime.create_local(plane, "w")
+        produced = []
+
+        async def slow_engine(request, context):
+            for i in range(1000):
+                if context.is_stopped:
+                    return
+                produced.append(i)
+                yield {"i": i}
+                await asyncio.sleep(0.01)
+
+        await srt.namespace("ns").component("c").endpoint("gen").serve(slow_engine)
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("c").endpoint("gen").client()
+        await client.start()
+        ctx = Context()
+        count = 0
+        async for _ in await client.generate({"n": 1000}, ctx):
+            count += 1
+            if count == 5:
+                ctx.stop_generating()
+        await asyncio.sleep(0.2)
+        assert count >= 5
+        assert len(produced) < 1000  # engine observed the stop
+        await crt.shutdown()
+        await srt.shutdown()
+
+    run(main())
+
+
+def test_events_pub_sub():
+    async def main():
+        plane = MemoryPlane()
+        rt = await DistributedRuntime.create_local(plane, "w")
+        ns = rt.namespace("ns")
+        sub = await ns.subscribe("kv_events")
+        await ns.publish("kv_events", {"event_id": 1, "op": "stored"})
+        subject, payload = await asyncio.wait_for(anext(sub), 1.0)
+        assert subject == "ns.kv_events"
+        assert payload["event_id"] == 1
+        await rt.shutdown()
+
+    run(main())
+
+
+def test_stats_scrape():
+    async def main():
+        plane = MemoryPlane()
+        rt = await DistributedRuntime.create_local(plane, "w1")
+        ep = rt.namespace("ns").component("c").endpoint("gen")
+        await ep.serve(echo_engine, stats_handler=lambda: {"load": 0.5})
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("c").endpoint("gen").client()
+        await client.start()
+        await client.wait_for_instances()
+        stats = await client.scrape_stats()
+        assert stats == {"w1": {"load": 0.5}}
+        await crt.shutdown()
+        await rt.shutdown()
+
+    run(main())
+
+
+# -- TCP control plane (integration, self-contained) --------------------------
+
+def test_tcp_control_plane_end_to_end():
+    async def main():
+        server = await ControlPlaneServer(port=0).start()
+        try:
+            rt1 = await DistributedRuntime.connect("127.0.0.1", server.port, "w1")
+            rt2 = await DistributedRuntime.connect("127.0.0.1", server.port, "c1")
+            ep = rt1.namespace("ns").component("echo").endpoint("generate")
+            await ep.serve(echo_engine)
+            client = rt2.namespace("ns").component("echo").endpoint(
+                "generate").client()
+            await client.start()
+            await client.wait_for_instances()
+            frames = [f async for f in await client.generate({"n": 3, "text": "t"})]
+            assert [f["i"] for f in frames] == [0, 1, 2]
+
+            # queue semantics
+            await rt1.messaging.queue_push("q1", b"job1")
+            assert await rt2.messaging.queue_depth("q1") == 1
+            assert await rt2.messaging.queue_pop("q1", timeout=1.0) == b"job1"
+            assert await rt2.messaging.queue_pop("q1", timeout=0.05) is None
+
+            # kv watch across connections
+            snapshot, events = await rt2.kv.watch_prefix("models/")
+            assert snapshot == []
+            await rt1.kv.put("models/m1", b"v1")
+            ev = await asyncio.wait_for(anext(events), 2.0)
+            assert (ev.kind, ev.key, ev.value) == ("put", "models/m1", b"v1")
+            await rt1.shutdown()
+            await rt2.shutdown()
+        finally:
+            await server.stop()
+
+    run(main())
