@@ -34,6 +34,16 @@ def page_hash(parent: int, tokens: Sequence[int]) -> int:
     return h.intdigest()
 
 
+def tokens_hash(tokens: Sequence[int]) -> int:
+    """Content-only (unchained) page hash — the router-side LocalBlockHash
+    (reference: lib/llm/src/kv_router/indexer.rs:87-104): computable from
+    query tokens alone, keys the routing radix tree."""
+    h = xxhash.xxh3_64(seed=1337)
+    for t in tokens:
+        h.update(int(t).to_bytes(4, "little", signed=True))
+    return h.intdigest()
+
+
 @dataclasses.dataclass
 class PageInfo:
     ref_count: int = 0
@@ -58,7 +68,9 @@ class PageAllocator:
         self._reusable_order: List[int] = []  # LRU eviction order (page ids)
         # live (ref_count>0) full pages by hash, for inflight sharing
         self._live: Dict[int, int] = {}
-        self.events: List[Tuple[str, int, int, int]] = []  # (kind, page, hash, parent)
+        # (kind, page, seq_hash, parent_seq_hash, tokens_hash); tokens_hash=0
+        # for "removed" (removal is keyed by the chained hash)
+        self.events: List[Tuple[str, int, int, int, int]] = []
 
     # -- stats ---------------------------------------------------------------
     @property
@@ -91,7 +103,7 @@ class PageAllocator:
             if info.ref_count == 0 and info.seq_hash is not None \
                     and self._reusable.get(info.seq_hash) == pid:
                 del self._reusable[info.seq_hash]
-                self.events.append(("removed", pid, info.seq_hash, 0))
+                self.events.append(("removed", pid, info.seq_hash, 0, 0))
                 info.seq_hash = None
                 return pid
         raise MemoryError("KV cache exhausted: no free or reusable pages")
@@ -120,7 +132,7 @@ class PageAllocator:
         info = self.pages[pid]
         info.seq_hash = sh
         self._live[sh] = pid
-        self.events.append(("stored", pid, sh, parent_hash))
+        self.events.append(("stored", pid, sh, parent_hash, tokens_hash(tokens)))
         return sh
 
     def free(self, pid: int) -> None:
@@ -142,7 +154,7 @@ class PageAllocator:
         else:
             self._free.append(pid)
 
-    def drain_events(self) -> List[Tuple[str, int, int, int]]:
+    def drain_events(self) -> List[Tuple[str, int, int, int, int]]:
         ev, self.events = self.events, []
         return ev
 

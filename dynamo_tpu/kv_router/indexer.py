@@ -1,0 +1,243 @@
+"""Global KV-cache radix-tree index for KV-aware routing.
+
+Re-implements the reference's indexer semantics (reference:
+lib/llm/src/kv_router/indexer.rs:163-900) TPU-side: a prefix tree whose edges
+are content-only page hashes (tokens_hash), each node recording which workers
+hold that page. `find_matches` walks a query's page-hash prefix accumulating
+per-worker overlap counts; `apply_event` applies worker Stored/Removed events
+using a per-worker `block_hash -> node` map for O(1) application;
+`remove_worker` purges a dead worker's pages (driven by the client watch on
+instance keys, matching indexer.rs:380-387).
+
+The reference runs the tree in a single owner thread with mpsc channels; here
+the tree is a plain object owned by the asyncio event loop (single-threaded by
+construction), and `KvIndexer` is the event-plane-fed wrapper. A hash-sharded
+variant (`KvIndexerSharded`, reference indexer.rs:677-900) splits workers
+across independent trees to bound per-tree size.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence
+
+from dynamo_tpu.kv_router.protocols import (
+    KvCacheRemoveData, KvCacheStoreData, RouterEvent, compute_page_hashes,
+)
+
+
+@dataclasses.dataclass
+class MatchResult:
+    """Per-worker count of query prefix pages resident on that worker."""
+
+    scores: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # frequency of recent use of the matched prefix (when tracking enabled)
+    frequencies: List[int] = dataclasses.field(default_factory=list)
+
+    def best(self) -> Optional[str]:
+        if not self.scores:
+            return None
+        return max(self.scores, key=lambda w: self.scores[w])
+
+
+class _Node:
+    __slots__ = ("tokens_hash", "parent", "children", "workers", "recent_uses")
+
+    def __init__(self, tokens_hash: int, parent: Optional["_Node"]):
+        self.tokens_hash = tokens_hash
+        self.parent = parent
+        self.children: Dict[int, _Node] = {}
+        # worker_id -> block_hash this worker stored the page under
+        self.workers: Dict[str, int] = {}
+        self.recent_uses: Deque[float] = deque()
+
+
+class RadixTree:
+    def __init__(self, expiration_duration_s: Optional[float] = None):
+        self.root = _Node(0, None)
+        # worker_id -> {block_hash -> node}
+        self.lookup: Dict[str, Dict[int, _Node]] = {}
+        self.expiration_s = expiration_duration_s
+
+    # -- matching ------------------------------------------------------------
+
+    def find_matches(self, page_hashes: Sequence[int],
+                     early_exit: bool = False,
+                     now: Optional[float] = None) -> MatchResult:
+        """Walk the query's page-hash prefix, accumulating per-worker overlap.
+
+        A worker's score is the number of leading query pages it holds
+        (reference indexer.rs:239-275 walks exactly this way: the walk stops
+        at the first page no worker holds).
+        """
+        result = MatchResult()
+        node = self.root
+        for h in page_hashes:
+            nxt = node.children.get(h)
+            if nxt is None:
+                break
+            node = nxt
+            for worker in node.workers:
+                result.scores[worker] = result.scores.get(worker, 0) + 1
+            if self.expiration_s is not None:
+                t = now if now is not None else time.monotonic()
+                self._expire(node, t)
+                node.recent_uses.append(t)
+                result.frequencies.append(len(node.recent_uses))
+            if early_exit and len(node.workers) == 1:
+                break
+        return result
+
+    def _expire(self, node: _Node, now: float) -> None:
+        cutoff = now - self.expiration_s
+        while node.recent_uses and node.recent_uses[0] < cutoff:
+            node.recent_uses.popleft()
+
+    # -- event application ---------------------------------------------------
+
+    def apply_event(self, event: RouterEvent) -> None:
+        worker = event.worker_id
+        data = event.event.data
+        table = self.lookup.setdefault(worker, {})
+        if isinstance(data, KvCacheStoreData):
+            if data.parent_hash is None or data.parent_hash == 0:
+                node = self.root
+            else:
+                node = table.get(data.parent_hash)
+                if node is None:
+                    # parent unknown (e.g. events raced a restart): root-attach
+                    node = self.root
+            for blk in data.blocks:
+                child = node.children.get(blk.tokens_hash)
+                if child is None:
+                    child = _Node(blk.tokens_hash, node)
+                    node.children[blk.tokens_hash] = child
+                child.workers[worker] = blk.block_hash
+                table[blk.block_hash] = child
+                node = child
+        elif isinstance(data, KvCacheRemoveData):
+            for bh in data.block_hashes:
+                node = table.pop(bh, None)
+                if node is None:
+                    continue
+                if node.workers.get(worker) == bh:
+                    del node.workers[worker]
+                self._maybe_prune(node)
+
+    def _maybe_prune(self, node: _Node) -> None:
+        while (node.parent is not None and not node.workers
+               and not node.children):
+            parent = node.parent
+            if parent.children.get(node.tokens_hash) is node:
+                del parent.children[node.tokens_hash]
+            node = parent
+
+    def remove_worker(self, worker: str) -> None:
+        table = self.lookup.pop(worker, None)
+        if not table:
+            return
+        for node in set(table.values()):
+            node.workers.pop(worker, None)
+            self._maybe_prune(node)
+
+    def clear_all_blocks(self, worker: str) -> None:
+        """Worker restarted with an empty cache: drop its pages, keep it known."""
+        self.remove_worker(worker)
+        self.lookup[worker] = {}
+
+    # -- introspection -------------------------------------------------------
+
+    def num_nodes(self) -> int:
+        count = 0
+        stack = [self.root]
+        while stack:
+            n = stack.pop()
+            count += 1
+            stack.extend(n.children.values())
+        return count - 1  # exclude root
+
+    def worker_block_count(self, worker: str) -> int:
+        return len(self.lookup.get(worker, {}))
+
+
+class KvIndexer:
+    """Event-fed index: subscribe to `{ns}.{component}.kv_events` and answer
+    overlap queries (reference indexer.rs:499-668)."""
+
+    def __init__(self, block_size: int,
+                 expiration_duration_s: Optional[float] = None):
+        self.block_size = block_size
+        self.tree = RadixTree(expiration_duration_s)
+        self.events_applied = 0
+        # tombstones: in-flight events from a removed worker must not
+        # resurrect it (they'd leak ghost nodes forever, since a worker
+        # absent from the endpoint snapshot can never be removed again)
+        self._removed: set = set()
+
+    def apply_event(self, event: RouterEvent) -> None:
+        if event.worker_id in self._removed:
+            return
+        self.tree.apply_event(event)
+        self.events_applied += 1
+
+    def revive_worker(self, worker: str) -> None:
+        """A worker id re-appeared live (restart): accept its events again."""
+        self._removed.discard(worker)
+
+    def apply_raw(self, msg: dict) -> None:
+        self.apply_event(RouterEvent.unpack(msg))
+
+    def find_matches(self, page_hashes: Sequence[int]) -> MatchResult:
+        return self.tree.find_matches(page_hashes)
+
+    def find_matches_for_tokens(self, tokens: Sequence[int]) -> MatchResult:
+        return self.find_matches(
+            compute_page_hashes(tokens, self.block_size))
+
+    def remove_worker(self, worker: str) -> None:
+        self._removed.add(worker)
+        self.tree.remove_worker(worker)
+
+
+class KvIndexerSharded:
+    """Shards workers across independent trees (reference indexer.rs:677-900).
+
+    Queries fan out to every shard and merge; events touch exactly one shard,
+    so application parallelizes across owner tasks in a multi-loop deployment.
+    """
+
+    def __init__(self, block_size: int, num_shards: int = 4,
+                 expiration_duration_s: Optional[float] = None):
+        self.block_size = block_size
+        self.shards = [KvIndexer(block_size, expiration_duration_s)
+                       for _ in range(num_shards)]
+
+    def _shard_for(self, worker: str) -> KvIndexer:
+        return self.shards[hash(worker) % len(self.shards)]
+
+    def apply_event(self, event: RouterEvent) -> None:
+        self._shard_for(event.worker_id).apply_event(event)
+
+    def find_matches(self, page_hashes: Sequence[int]) -> MatchResult:
+        merged = MatchResult()
+        for shard in self.shards:
+            res = shard.find_matches(page_hashes)
+            merged.scores.update(res.scores)
+            # per-depth use counts sum across shards (each shard tracks its
+            # own matched path; total recent uses of depth i is the sum)
+            for i, f in enumerate(res.frequencies):
+                if i < len(merged.frequencies):
+                    merged.frequencies[i] += f
+                else:
+                    merged.frequencies.append(f)
+        return merged
+
+    def find_matches_for_tokens(self, tokens: Sequence[int]) -> MatchResult:
+        return self.find_matches(compute_page_hashes(tokens, self.block_size))
+
+    def remove_worker(self, worker: str) -> None:
+        self._shard_for(worker).remove_worker(worker)
+
+    def revive_worker(self, worker: str) -> None:
+        self._shard_for(worker).revive_worker(worker)

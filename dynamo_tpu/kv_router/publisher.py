@@ -1,0 +1,151 @@
+"""Worker-side KV event + load-metrics publishing.
+
+Reference: lib/llm/src/kv_router/publisher.rs:33-137 — the engine worker
+pushes block Stored/Removed events onto the event plane subject
+`{ns}.{component}.kv_events` and exposes its latest ForwardPassMetrics via
+the endpoint stats handler, which the router-side aggregator scrapes
+(metrics_aggregator.rs:26-145). Here the event source is our own allocator
+(engine/kv_cache.py PageAllocator.drain_events) instead of a patched vLLM.
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+from typing import Dict, Optional
+
+from dynamo_tpu.kv_router.protocols import (
+    KvCacheEvent, KvCacheRemoveData, KvCacheStoreData, KvCacheStoredBlockData,
+    RouterEvent,
+)
+from dynamo_tpu.kv_router.scoring import ProcessedEndpoints, WorkerMetrics
+
+log = logging.getLogger("dynamo_tpu.kv_router")
+
+KV_EVENTS_SUBJECT = "kv_events"
+KV_HIT_RATE_SUBJECT = "kv-hit-rate"
+
+
+class KvEventPublisher:
+    """Converts allocator events into RouterEvents on the event plane."""
+
+    def __init__(self, component, worker_id: str):
+        self.component = component
+        self.worker_id = worker_id
+        self._event_id = 0
+
+    async def publish_allocator_events(self, events) -> int:
+        """Publish a batch of (kind, page, seq_hash, parent, tokens_hash)
+        tuples drained from PageAllocator; returns the number of RouterEvents
+        published. Consecutive stored events that chain (parent == previous
+        seq_hash) coalesce into one multi-block Stored event, and runs of
+        removals into one Removed event, so an N-page prefill costs O(1)
+        event-plane messages (the reference batches the same way —
+        KvCacheStoreData carries a block list)."""
+        batches: list = []
+        for kind, _pid, seq_hash, parent, tok_hash in events:
+            if kind == "stored":
+                prev = batches[-1] if batches else None
+                if (prev is not None and isinstance(prev, KvCacheStoreData)
+                        and prev.blocks and prev.blocks[-1].block_hash == parent):
+                    prev.blocks.append(KvCacheStoredBlockData(seq_hash, tok_hash))
+                else:
+                    batches.append(KvCacheStoreData(
+                        parent_hash=parent or None,
+                        blocks=[KvCacheStoredBlockData(seq_hash, tok_hash)]))
+            else:
+                prev = batches[-1] if batches else None
+                if isinstance(prev, KvCacheRemoveData):
+                    prev.block_hashes.append(seq_hash)
+                else:
+                    batches.append(KvCacheRemoveData(block_hashes=[seq_hash]))
+        for data in batches:
+            ev = RouterEvent(self.worker_id,
+                             KvCacheEvent(self._event_id, data))
+            self._event_id += 1
+            await self.component.publish(KV_EVENTS_SUBJECT, ev.pack())
+        return len(batches)
+
+    async def publish_stored(self, parent_hash: Optional[int], blocks) -> None:
+        data = KvCacheStoreData(
+            parent_hash=parent_hash,
+            blocks=[KvCacheStoredBlockData(bh, th) for bh, th in blocks])
+        ev = RouterEvent(self.worker_id, KvCacheEvent(self._event_id, data))
+        self._event_id += 1
+        await self.component.publish(KV_EVENTS_SUBJECT, ev.pack())
+
+    async def publish_removed(self, block_hashes) -> None:
+        ev = RouterEvent(self.worker_id, KvCacheEvent(
+            self._event_id, KvCacheRemoveData(list(block_hashes))))
+        self._event_id += 1
+        await self.component.publish(KV_EVENTS_SUBJECT, ev.pack())
+
+
+class KvMetricsPublisher:
+    """Holds the worker's latest load snapshot; plugs into the endpoint's
+    stats handler so the aggregator's scrape sees it."""
+
+    def __init__(self):
+        self.metrics = WorkerMetrics()
+
+    def update(self, m) -> None:
+        if dataclasses.is_dataclass(m) and not isinstance(m, WorkerMetrics):
+            m = WorkerMetrics.from_dict(dataclasses.asdict(m))
+        self.metrics = m
+
+    def stats_handler(self) -> dict:
+        return dataclasses.asdict(self.metrics)
+
+
+class KvMetricsAggregator:
+    """Router-side scrape loop: polls live workers' stats handlers into a
+    ProcessedEndpoints snapshot (reference metrics_aggregator.rs:26-145)."""
+
+    def __init__(self, client, interval_s: float = 0.5):
+        self.client = client            # runtime Client on the worker endpoint
+        self.interval_s = interval_s
+        self.endpoints = ProcessedEndpoints()
+        self._task: Optional[asyncio.Task] = None
+        self._listeners = []
+
+    def on_update(self, cb) -> None:
+        """cb(ProcessedEndpoints, removed_worker_ids) per scrape."""
+        self._listeners.append(cb)
+
+    async def scrape_once(self) -> ProcessedEndpoints:
+        stats = await self.client.scrape_stats()
+        workers: Dict[str, WorkerMetrics] = {}
+        for worker_id, payload in stats.items():
+            try:
+                workers[worker_id] = WorkerMetrics.from_dict(payload)
+            except (TypeError, KeyError):
+                continue
+        removed = set(self.endpoints.workers) - set(workers)
+        # a live instance that failed this scrape keeps its last snapshot
+        # (copied: the scheduler optimistically bumps the current snapshot,
+        # and those bumps must not compound across failed scrapes)
+        for worker_id in removed & set(self.client.instances):
+            workers[worker_id] = dataclasses.replace(
+                self.endpoints.workers[worker_id])
+            removed.discard(worker_id)
+        self.endpoints = ProcessedEndpoints(workers)
+        for cb in self._listeners:
+            cb(self.endpoints, removed)
+        return self.endpoints
+
+    async def start(self) -> None:
+        async def loop():
+            while True:
+                try:
+                    await self.scrape_once()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("metrics scrape failed")
+                await asyncio.sleep(self.interval_s)
+        self._task = asyncio.create_task(loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None

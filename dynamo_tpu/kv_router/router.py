@@ -1,0 +1,85 @@
+"""KvRouter facade: event subscription + radix index + scheduler in one.
+
+Reference: lib/llm/src/kv_router/kv_router.rs:51-164 — subscribes to the
+component's `kv_events` subject, feeds the indexer, keeps a metrics-driven
+worker snapshot, and answers `schedule(tokens) -> worker_id`. Dead workers
+(instance key deleted) are purged from both the index and the endpoint
+snapshot, matching the reference's remove_worker path (indexer.rs:380-387).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional, Sequence
+
+from dynamo_tpu.kv_router.indexer import KvIndexer, MatchResult
+from dynamo_tpu.kv_router.protocols import RouterEvent, compute_page_hashes
+from dynamo_tpu.kv_router.publisher import (
+    KV_EVENTS_SUBJECT, KV_HIT_RATE_SUBJECT, KvMetricsAggregator,
+)
+from dynamo_tpu.kv_router.scheduler import KvScheduler, WorkerSelector
+
+log = logging.getLogger("dynamo_tpu.kv_router")
+
+
+class KvRouter:
+    def __init__(self, component, worker_client, block_size: int,
+                 selector: Optional[WorkerSelector] = None,
+                 scrape_interval_s: float = 0.5,
+                 publish_hit_events: bool = False):
+        self.component = component
+        self.client = worker_client
+        self.block_size = block_size
+        self.indexer = KvIndexer(block_size)
+        self.scheduler = KvScheduler(block_size, selector)
+        self.aggregator = KvMetricsAggregator(worker_client, scrape_interval_s)
+        self.publish_hit_events = publish_hit_events
+        self._event_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "KvRouter":
+        sub = await self.component.subscribe(KV_EVENTS_SUBJECT)
+
+        async def pump():
+            async for _subj, msg in sub:
+                try:
+                    self.indexer.apply_event(RouterEvent.unpack(msg))
+                except Exception:
+                    log.exception("bad kv event: %r", msg)
+
+        self._event_task = asyncio.create_task(pump())
+
+        def on_metrics(endpoints, removed):
+            self.scheduler.update_endpoints(endpoints)
+            for worker_id in removed:
+                self.indexer.remove_worker(worker_id)
+            for worker_id in endpoints.workers:
+                self.indexer.revive_worker(worker_id)
+
+        self.aggregator.on_update(on_metrics)
+        await self.aggregator.start()
+        return self
+
+    async def stop(self) -> None:
+        if self._event_task:
+            self._event_task.cancel()
+            self._event_task = None
+        await self.aggregator.stop()
+
+    # -- scheduling ----------------------------------------------------------
+
+    def find_matches_for_tokens(self, tokens: Sequence[int]) -> MatchResult:
+        return self.indexer.find_matches(
+            compute_page_hashes(tokens, self.block_size))
+
+    async def schedule(self, tokens: Sequence[int]) -> str:
+        """Pick the best worker for this token sequence; returns worker_id."""
+        overlap = self.find_matches_for_tokens(tokens)
+        worker_id = self.scheduler.schedule(len(tokens), overlap)
+        if self.publish_hit_events:
+            for ev in self.scheduler.drain_hit_events():
+                await self.component.publish(KV_HIT_RATE_SUBJECT, {
+                    "worker_id": ev.worker_id, "isl_blocks": ev.isl_blocks,
+                    "overlap_blocks": ev.overlap_blocks})
+        else:
+            self.scheduler.drain_hit_events()
+        return worker_id

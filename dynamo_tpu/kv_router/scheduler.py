@@ -1,0 +1,133 @@
+"""KV-aware worker selection.
+
+Implements the reference's scheduler semantics (reference:
+lib/llm/src/kv_router/scheduler.rs:88-340): given the query's per-worker
+overlap scores (from the radix index) and each worker's load metrics, rank
+workers by
+
+    logit = overlap_weight * overlap_score - kv_usage - normalized_active
+
+where `overlap_score = matched_blocks * block_size / isl` (fraction of the
+prompt already resident), `kv_usage = kv_active_blocks / kv_total_blocks`,
+and `normalized_active = request_active_slots / request_total_slots`
+(reference DefaultWorkerSelector, scheduler.rs:236-340, cost at :290 with
+overlap_weight=2). Ties break randomly; the chosen worker's active slots and
+blocks are optimistically bumped so back-to-back schedules don't pile onto
+one worker before the next metrics scrape lands
+(process_worker_selection, scheduler.rs:208-232).
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Protocol
+
+from dynamo_tpu.kv_router.indexer import MatchResult
+from dynamo_tpu.kv_router.scoring import ProcessedEndpoints, WorkerMetrics
+
+
+class AllWorkersBusy(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class SchedulingRequest:
+    isl_tokens: int                # input sequence length in tokens
+    overlap: MatchResult           # per-worker matched block counts
+
+
+@dataclasses.dataclass
+class WorkerSelection:
+    worker_id: str
+    required_blocks: int
+    overlap_blocks: int
+
+
+class WorkerSelector(Protocol):
+    def select_worker(self, endpoints: ProcessedEndpoints,
+                      request: SchedulingRequest,
+                      block_size: int) -> WorkerSelection: ...
+
+
+class DefaultWorkerSelector:
+    def __init__(self, overlap_weight: float = 2.0,
+                 rng: Optional[random.Random] = None):
+        self.overlap_weight = overlap_weight
+        self.rng = rng or random.Random()
+
+    def select_worker(self, endpoints: ProcessedEndpoints,
+                      request: SchedulingRequest,
+                      block_size: int) -> WorkerSelection:
+        if not endpoints.workers:
+            raise AllWorkersBusy("no live workers")
+        isl = max(request.isl_tokens, 1)
+        best_logit = float("-inf")
+        best: List[str] = []
+        for worker_id, m in endpoints.workers.items():
+            matched = request.overlap.scores.get(worker_id, 0)
+            overlap_score = matched * block_size / isl
+            kv_usage = (m.kv_active_blocks / m.kv_total_blocks
+                        if m.kv_total_blocks else 0.0)
+            norm_active = (m.request_active_slots / m.request_total_slots
+                           if m.request_total_slots else 0.0)
+            logit = (self.overlap_weight * overlap_score
+                     - kv_usage - norm_active)
+            if logit > best_logit:
+                best_logit, best = logit, [worker_id]
+            elif logit == best_logit:
+                best.append(worker_id)
+        worker_id = self.rng.choice(best)
+        required = -(-isl // block_size)
+        return WorkerSelection(
+            worker_id=worker_id, required_blocks=required,
+            overlap_blocks=request.overlap.scores.get(worker_id, 0))
+
+
+@dataclasses.dataclass
+class KVHitRateEvent:
+    """Published per scheduling decision on the event plane
+    (reference scheduler.rs emits `kv-hit-rate` events)."""
+
+    worker_id: str
+    isl_blocks: int
+    overlap_blocks: int
+
+
+class KvScheduler:
+    """Ranks workers for each request against the latest metrics snapshot.
+
+    The endpoints snapshot is swapped in whole by the metrics aggregator's
+    scrape loop (reference: watch channel of ProcessedEndpoints); optimistic
+    bumps are applied to the current snapshot between scrapes.
+    """
+
+    def __init__(self, block_size: int,
+                 selector: Optional[WorkerSelector] = None):
+        self.block_size = block_size
+        self.selector = selector or DefaultWorkerSelector()
+        self.endpoints = ProcessedEndpoints()
+        self.hit_events: List[KVHitRateEvent] = []
+
+    def update_endpoints(self, endpoints: ProcessedEndpoints) -> None:
+        self.endpoints = endpoints
+
+    def remove_worker(self, worker_id: str) -> None:
+        self.endpoints.workers.pop(worker_id, None)
+
+    def schedule(self, isl_tokens: int, overlap: MatchResult) -> str:
+        sel = self.selector.select_worker(
+            self.endpoints, SchedulingRequest(isl_tokens, overlap),
+            self.block_size)
+        m = self.endpoints.workers.get(sel.worker_id)
+        if m is not None:
+            # optimistic accounting until the next scrape
+            m.request_active_slots += 1
+            m.kv_active_blocks += sel.required_blocks - sel.overlap_blocks
+        self.hit_events.append(KVHitRateEvent(
+            worker_id=sel.worker_id, isl_blocks=sel.required_blocks,
+            overlap_blocks=sel.overlap_blocks))
+        return sel.worker_id
+
+    def drain_hit_events(self) -> List[KVHitRateEvent]:
+        ev, self.hit_events = self.hit_events, []
+        return ev

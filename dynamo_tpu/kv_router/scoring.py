@@ -1,0 +1,55 @@
+"""Aggregated per-worker load state consumed by the KV scheduler.
+
+Mirrors the reference's ProcessedEndpoints (reference:
+lib/llm/src/kv_router/scoring.rs:24-53): the live worker set with each
+worker's latest ForwardPassMetrics, plus load average/stddev over active
+blocks used to normalize the cost function.
+"""
+from __future__ import annotations
+
+import dataclasses
+import statistics
+from typing import Dict, List
+
+
+@dataclasses.dataclass
+class WorkerMetrics:
+    """Field-for-field the reference's ForwardPassMetrics
+    (reference: lib/llm/src/kv_router/protocols.rs:42-54); published by the
+    engine worker (engine/scheduler.py EngineMetrics is the source)."""
+
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WorkerMetrics":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+@dataclasses.dataclass
+class ProcessedEndpoints:
+    workers: Dict[str, WorkerMetrics] = dataclasses.field(default_factory=dict)
+
+    @property
+    def worker_ids(self) -> List[str]:
+        return sorted(self.workers)
+
+    @property
+    def load_avg(self) -> float:
+        if not self.workers:
+            return 0.0
+        return statistics.fmean(
+            w.kv_active_blocks for w in self.workers.values())
+
+    @property
+    def load_std(self) -> float:
+        if len(self.workers) < 2:
+            return 0.0
+        return statistics.pstdev(
+            w.kv_active_blocks for w in self.workers.values())

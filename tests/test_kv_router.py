@@ -1,0 +1,288 @@
+"""KV-aware router tests.
+
+Mirrors the reference's indexer/scheduler unit tests (SURVEY.md §4.1:
+lib/llm/src/kv_router/indexer.rs:900-1409) plus an end-to-end router test
+over the in-memory control plane: engine allocator events -> publisher ->
+indexer -> scheduler -> worker choice.
+"""
+import asyncio
+import random
+
+import pytest
+
+from dynamo_tpu.engine.kv_cache import PageAllocator, page_hash, tokens_hash
+from dynamo_tpu.kv_router.indexer import KvIndexer, KvIndexerSharded, RadixTree
+from dynamo_tpu.kv_router.protocols import (
+    KvCacheEvent, KvCacheRemoveData, KvCacheStoreData, KvCacheStoredBlockData,
+    RouterEvent, compute_page_hashes,
+)
+from dynamo_tpu.kv_router.publisher import (
+    KvEventPublisher, KvMetricsAggregator, KvMetricsPublisher,
+)
+from dynamo_tpu.kv_router.router import KvRouter
+from dynamo_tpu.kv_router.scheduler import (
+    AllWorkersBusy, DefaultWorkerSelector, KvScheduler,
+)
+from dynamo_tpu.kv_router.scoring import ProcessedEndpoints, WorkerMetrics
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+
+def stored(worker, seq, parent=None, eid=0):
+    """Build a Stored RouterEvent for a chain of (block_hash, tokens_hash)."""
+    return RouterEvent(worker, KvCacheEvent(eid, KvCacheStoreData(
+        parent_hash=parent,
+        blocks=[KvCacheStoredBlockData(bh, th) for bh, th in seq])))
+
+
+def removed(worker, hashes, eid=0):
+    return RouterEvent(worker, KvCacheEvent(
+        eid, KvCacheRemoveData(list(hashes))))
+
+
+class TestRadixTree:
+    def test_store_and_match(self):
+        tree = RadixTree()
+        # w1 holds pages [A, B]; w2 holds [A]
+        tree.apply_event(stored("w1", [(101, 1), (102, 2)]))
+        tree.apply_event(stored("w2", [(201, 1)]))
+        res = tree.find_matches([1, 2, 3])
+        assert res.scores == {"w1": 2, "w2": 1}
+        # divergent first page: nothing
+        assert tree.find_matches([9]).scores == {}
+
+    def test_chained_store_via_parent(self):
+        tree = RadixTree()
+        tree.apply_event(stored("w1", [(101, 1)]))
+        # extend from parent block_hash 101
+        tree.apply_event(stored("w1", [(102, 2)], parent=101))
+        assert tree.find_matches([1, 2]).scores == {"w1": 2}
+
+    def test_removed_prunes(self):
+        tree = RadixTree()
+        tree.apply_event(stored("w1", [(101, 1), (102, 2)]))
+        tree.apply_event(removed("w1", [102]))
+        assert tree.find_matches([1, 2]).scores == {"w1": 1}
+        assert tree.num_nodes() == 1  # leaf pruned
+        tree.apply_event(removed("w1", [101]))
+        assert tree.find_matches([1]).scores == {}
+        assert tree.num_nodes() == 0
+
+    def test_removal_keeps_shared_node(self):
+        tree = RadixTree()
+        tree.apply_event(stored("w1", [(101, 1)]))
+        tree.apply_event(stored("w2", [(201, 1)]))
+        tree.apply_event(removed("w1", [101]))
+        assert tree.find_matches([1]).scores == {"w2": 1}
+
+    def test_remove_worker(self):
+        tree = RadixTree()
+        tree.apply_event(stored("w1", [(101, 1), (102, 2)]))
+        tree.apply_event(stored("w2", [(201, 1)]))
+        tree.remove_worker("w1")
+        assert tree.find_matches([1, 2]).scores == {"w2": 1}
+        assert tree.worker_block_count("w1") == 0
+        # interior node with a child must survive even with no workers
+        tree.apply_event(stored("w3", [(301, 1), (302, 2), (303, 3)]))
+        tree.remove_worker("w2")
+        assert tree.find_matches([1, 2, 3]).scores == {"w3": 3}
+
+    def test_frequency_tracking_expiry(self):
+        tree = RadixTree(expiration_duration_s=10.0)
+        tree.apply_event(stored("w1", [(101, 1)]))
+        r1 = tree.find_matches([1], now=0.0)
+        r2 = tree.find_matches([1], now=1.0)
+        assert r1.frequencies == [1] and r2.frequencies == [2]
+        r3 = tree.find_matches([1], now=100.0)  # both expired
+        assert r3.frequencies == [1]
+
+    def test_event_roundtrip_pack_unpack(self):
+        ev = stored("w1", [(101, 1), (102, 2)], parent=5, eid=7)
+        assert RouterEvent.unpack(ev.pack()) == ev
+        ev2 = removed("w9", [11, 12], eid=8)
+        assert RouterEvent.unpack(ev2.pack()) == ev2
+
+
+class TestIndexers:
+    def test_indexer_token_query(self):
+        idx = KvIndexer(block_size=4)
+        toks = list(range(12))
+        h = compute_page_hashes(toks, 4)
+        idx.apply_event(stored("w1", [(1, h[0]), (2, h[1]), (3, h[2])]))
+        res = idx.find_matches_for_tokens(toks + [99, 100])  # partial page ignored
+        assert res.scores == {"w1": 3}
+        # only first page matches
+        res2 = idx.find_matches_for_tokens(toks[:4] + [7, 7, 7, 7])
+        assert res2.scores == {"w1": 1}
+
+    def test_sharded_matches_unsharded(self):
+        idx = KvIndexer(block_size=2)
+        sharded = KvIndexerSharded(block_size=2, num_shards=3)
+        rng = random.Random(0)
+        workers = [f"w{i}" for i in range(8)]
+        for eid in range(200):
+            w = rng.choice(workers)
+            chain = [(rng.randrange(1 << 30), rng.randrange(8))
+                     for _ in range(rng.randrange(1, 4))]
+            ev = stored(w, chain, eid=eid)
+            idx.apply_event(ev)
+            sharded.apply_event(ev)
+        for _ in range(50):
+            q = [rng.randrange(8) for _ in range(rng.randrange(1, 5))]
+            assert idx.find_matches(q).scores == sharded.find_matches(q).scores
+        sharded.remove_worker("w3")
+        idx.remove_worker("w3")
+        for _ in range(20):
+            q = [rng.randrange(8) for _ in range(3)]
+            assert idx.find_matches(q).scores == sharded.find_matches(q).scores
+
+
+class TestScheduler:
+    def _endpoints(self, **workers):
+        return ProcessedEndpoints({
+            wid: WorkerMetrics(**kw) for wid, kw in workers.items()})
+
+    def test_overlap_wins(self):
+        sched = KvScheduler(block_size=16,
+                            selector=DefaultWorkerSelector(rng=random.Random(0)))
+        sched.update_endpoints(self._endpoints(
+            w1=dict(request_active_slots=1, request_total_slots=8,
+                    kv_active_blocks=10, kv_total_blocks=100),
+            w2=dict(request_active_slots=1, request_total_slots=8,
+                    kv_active_blocks=10, kv_total_blocks=100)))
+        from dynamo_tpu.kv_router.indexer import MatchResult
+        overlap = MatchResult(scores={"w2": 4})  # w2 holds 4 of 4 pages
+        assert sched.schedule(64, overlap) == "w2"
+        ev = sched.drain_hit_events()
+        assert len(ev) == 1 and ev[0].overlap_blocks == 4
+
+    def test_load_breaks_even_overlap(self):
+        sched = KvScheduler(block_size=16,
+                            selector=DefaultWorkerSelector(rng=random.Random(0)))
+        sched.update_endpoints(self._endpoints(
+            busy=dict(request_active_slots=8, request_total_slots=8,
+                      kv_active_blocks=90, kv_total_blocks=100),
+            idle=dict(request_active_slots=0, request_total_slots=8,
+                      kv_active_blocks=5, kv_total_blocks=100)))
+        from dynamo_tpu.kv_router.indexer import MatchResult
+        assert sched.schedule(64, MatchResult()) == "idle"
+
+    def test_optimistic_bump(self):
+        sched = KvScheduler(block_size=16,
+                            selector=DefaultWorkerSelector(rng=random.Random(0)))
+        sched.update_endpoints(self._endpoints(
+            w1=dict(request_total_slots=8, kv_total_blocks=100),
+            w2=dict(request_total_slots=8, kv_total_blocks=100)))
+        from dynamo_tpu.kv_router.indexer import MatchResult
+        picks = {sched.schedule(160, MatchResult()) for _ in range(2)}
+        # after the first pick its slots/blocks were bumped -> second differs
+        assert picks == {"w1", "w2"}
+
+    def test_no_workers_raises(self):
+        sched = KvScheduler(block_size=16)
+        from dynamo_tpu.kv_router.indexer import MatchResult
+        with pytest.raises(AllWorkersBusy):
+            sched.schedule(10, MatchResult())
+
+
+class TestIndexerTombstones:
+    def test_late_event_cannot_resurrect_removed_worker(self):
+        idx = KvIndexer(block_size=4)
+        idx.apply_event(stored("w1", [(101, 1)]))
+        idx.remove_worker("w1")
+        idx.apply_event(stored("w1", [(102, 2)]))  # in-flight straggler
+        assert idx.find_matches([1]).scores == {}
+        assert idx.find_matches([2]).scores == {}
+        # revival (worker id re-appeared live) accepts events again
+        idx.revive_worker("w1")
+        idx.apply_event(stored("w1", [(103, 3)]))
+        assert idx.find_matches([3]).scores == {"w1": 1}
+
+    def test_sharded_merges_frequencies(self):
+        sharded = KvIndexerSharded(block_size=4, num_shards=2,
+                                   expiration_duration_s=60.0)
+        sharded.apply_event(stored("w1", [(101, 1)]))
+        sharded.apply_event(stored("w2", [(201, 1)]))
+        res = sharded.find_matches([1])
+        assert res.scores == {"w1": 1, "w2": 1}
+        assert res.frequencies and res.frequencies[0] >= 1
+
+
+class TestAllocatorEventBridge:
+    def test_allocator_events_to_index(self):
+        """Engine allocator seal/evict events round-trip into a queryable
+        index: the tokens a worker cached are found by a token query."""
+        alloc = PageAllocator(num_pages=8, page_size=4)
+        toks = list(range(8))
+        p0, p1 = alloc.allocate(), alloc.allocate()
+        h0 = alloc.seal(p0, 0, toks[:4])
+        h1 = alloc.seal(p1, h0, toks[4:])
+        events = alloc.drain_events()
+        assert [e[0] for e in events] == ["stored", "stored"]
+        assert events[0][2] == h0 == page_hash(0, toks[:4])
+        assert events[0][4] == tokens_hash(toks[:4])
+
+        idx = KvIndexer(block_size=4)
+        for kind, _pid, sh, parent, th in events:
+            idx.apply_event(stored("w1", [(sh, th)], parent=parent or None))
+        assert idx.find_matches_for_tokens(toks).scores == {"w1": 2}
+
+
+class TestRouterEndToEnd:
+    def test_router_over_memory_plane(self):
+        async def main():
+            plane = MemoryPlane()
+            worker_rts = []
+            pubs = {}
+            for wid in ("w1", "w2"):
+                rt = await DistributedRuntime.create_local(plane, wid)
+                comp = rt.namespace("ns").component("worker")
+                mpub = KvMetricsPublisher()
+                mpub.update(WorkerMetrics(
+                    request_active_slots=0, request_total_slots=8,
+                    kv_active_blocks=0, kv_total_blocks=100))
+
+                async def engine(request, context, wid=wid):
+                    yield {"worker": wid}
+
+                await comp.endpoint("generate").serve(
+                    engine, stats_handler=mpub.stats_handler)
+                pubs[wid] = (comp, mpub)
+                worker_rts.append(rt)
+
+            rrt = await DistributedRuntime.create_local(plane, "router")
+            comp = rrt.namespace("ns").component("worker")
+            client = comp.endpoint("generate").client()
+            await client.start()
+            await client.wait_for_instances()
+            router = await KvRouter(comp, client, block_size=4,
+                                    scrape_interval_s=0.05).start()
+            await asyncio.sleep(0.15)  # let a scrape land
+            assert set(router.scheduler.endpoints.workers) == {"w1", "w2"}
+
+            # w2 publishes that it cached the prompt's first two pages
+            toks = list(range(100, 116))
+            alloc = PageAllocator(8, 4)
+            pids = [alloc.allocate(), alloc.allocate()]
+            parent = 0
+            for i, pid in enumerate(pids):
+                parent = alloc.seal(pid, parent, toks[i * 4:(i + 1) * 4])
+            await KvEventPublisher(pubs["w2"][0], "w2").publish_allocator_events(
+                alloc.drain_events())
+            await asyncio.sleep(0.1)  # event pump
+
+            assert router.find_matches_for_tokens(toks).scores == {"w2": 2}
+            assert await router.schedule(toks) == "w2"
+
+            # dead worker is purged from index + endpoints on next scrape
+            await worker_rts[1].shutdown()
+            await asyncio.sleep(0.3)
+            assert router.find_matches_for_tokens(toks).scores == {}
+            assert set(router.scheduler.endpoints.workers) == {"w1"}
+            assert await router.schedule(toks) == "w1"
+
+            await router.stop()
+            await rrt.shutdown()
+            await worker_rts[0].shutdown()
+
+        asyncio.run(main())
