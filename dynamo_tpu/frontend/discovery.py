@@ -1,0 +1,134 @@
+"""Model registry + frontend discovery watcher.
+
+Reference equivalents: llmctl writes model->endpoint mappings into etcd
+(reference: launch/llmctl/src/main.rs:218-300, keys
+`{ns}/components/{comp}/models/{type}/{name}`), and the HTTP frontend's
+model watcher builds a full remote pipeline per key and registers it in the
+ModelManager, removing it on delete (reference:
+lib/llm/src/http/service/discovery.rs:58-145).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, Optional
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.pipeline import RemotePipeline
+
+log = logging.getLogger("dynamo_tpu.discovery")
+
+MODELS_PREFIX = "models/"
+
+
+def model_key(model_type: str, name: str) -> str:
+    return f"{MODELS_PREFIX}{model_type}/{name}"
+
+
+async def register_model(kv, name: str, namespace: str, component: str,
+                         card: ModelDeploymentCard,
+                         endpoint: str = "generate",
+                         model_type: str = "chat",
+                         kv_routed: bool = False) -> None:
+    """Write the model->endpoint mapping (the llmctl `add model` op)."""
+    payload = {
+        "name": name,
+        "namespace": namespace,
+        "component": component,
+        "endpoint": endpoint,
+        "model_type": model_type,
+        "kv_routed": kv_routed,
+        "card": card.to_dict(),
+    }
+    await kv.put(model_key(model_type, name), json.dumps(payload).encode())
+
+
+async def unregister_model(kv, name: str, model_type: str = "chat") -> None:
+    await kv.delete(model_key(model_type, name))
+
+
+async def list_registered_models(kv) -> Dict[str, dict]:
+    out = {}
+    for e in await kv.get_prefix(MODELS_PREFIX):
+        try:
+            out[e.key[len(MODELS_PREFIX):]] = json.loads(e.value)
+        except (ValueError, TypeError):
+            continue
+    return out
+
+
+class ModelWatcher:
+    """Watches the model registry and (de)registers pipelines live."""
+
+    def __init__(self, runtime, model_manager, make_router=None):
+        """make_router: optional async (component, client, card) -> KvRouter
+        enabling KV-aware routing for models registered kv_routed=True."""
+        self.runtime = runtime
+        self.models = model_manager
+        self.make_router = make_router
+        self._task: Optional[asyncio.Task] = None
+        self._owned: Dict[str, tuple] = {}  # key -> (client, router)
+
+    async def start(self) -> "ModelWatcher":
+        snapshot, events = await self.runtime.kv.watch_prefix(MODELS_PREFIX)
+        for e in snapshot:
+            await self._on_put(e.key, e.value)
+
+        async def pump():
+            async for ev in events:
+                try:
+                    if ev.kind == "put":
+                        await self._on_put(ev.key, ev.value)
+                    else:
+                        await self._on_delete(ev.key)
+                except Exception:
+                    log.exception("model watch event failed: %s", ev.key)
+
+        self._task = asyncio.create_task(pump())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+        for client, router in self._owned.values():
+            if router is not None:
+                await router.stop()
+            await client.stop()
+        self._owned.clear()
+
+    async def _on_put(self, key: str, value: bytes) -> None:
+        prev = self._owned.pop(key, None)
+        if prev is not None:  # re-registration: stop the old client/router
+            client, router = prev
+            if router is not None:
+                await router.stop()
+            await client.stop()
+        info = json.loads(value)
+        card = ModelDeploymentCard.from_dict(info["card"])
+        comp = self.runtime.namespace(info["namespace"]).component(
+            info["component"])
+        client = comp.endpoint(info["endpoint"]).client()
+        await client.start()
+        router = None
+        if info.get("kv_routed") and self.make_router is not None:
+            router = await self.make_router(comp, client, card)
+        pipeline = RemotePipeline(card, client, router=router)
+        self.models.add(info["name"], pipeline, info.get("model_type", "chat"))
+        self._owned[key] = (client, router)
+        log.info("model registered: %s -> %s/%s/%s%s", info["name"],
+                 info["namespace"], info["component"], info["endpoint"],
+                 " [kv-routed]" if router else "")
+
+    async def _on_delete(self, key: str) -> None:
+        parts = key[len(MODELS_PREFIX):].split("/", 1)
+        if len(parts) == 2:
+            self.models.remove(parts[1])
+        owned = self._owned.pop(key, None)
+        if owned:
+            client, router = owned
+            if router is not None:
+                await router.stop()
+            await client.stop()
+        log.info("model removed: %s", key)

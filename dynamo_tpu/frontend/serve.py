@@ -1,0 +1,63 @@
+"""Standalone OpenAI HTTP frontend: watches the model registry and serves.
+
+Reference equivalent: the standalone http binary (reference:
+components/http/src/main.rs:56-102) — connect to the control plane, watch
+registered models, serve OpenAI routes.
+
+Usage:
+  python -m dynamo_tpu.frontend.serve --port 8080 \
+      --control-host 127.0.0.1 --control-port 5550
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from dynamo_tpu.frontend.discovery import ModelWatcher
+from dynamo_tpu.frontend.service import HttpService
+from dynamo_tpu.kv_router.router import KvRouter
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+async def run_frontend(runtime, host: str = "0.0.0.0", port: int = 8080,
+                       kv_routing: bool = True) -> HttpService:
+    service = await HttpService(host, port).start()
+
+    async def make_router(component, client, card):
+        return await KvRouter(component, client,
+                              block_size=card.kv_page_size).start()
+
+    watcher = await ModelWatcher(
+        runtime, service.models,
+        make_router=make_router if kv_routing else None).start()
+    service._watcher = watcher  # keep alive / stoppable
+    return service
+
+
+async def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--control-host", default="127.0.0.1")
+    p.add_argument("--control-port", type=int, default=5550)
+    p.add_argument("--worker-id", default=None)
+    p.add_argument("--no-kv-routing", action="store_true")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    runtime = await DistributedRuntime.connect(
+        args.control_host, args.control_port, args.worker_id)
+    service = await run_frontend(runtime, args.host, args.port,
+                                 kv_routing=not args.no_kv_routing)
+    print(f"READY http=:{service.port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await service._watcher.stop()
+        await service.stop()
+        await runtime.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,204 @@
+"""OpenAI-compatible HTTP frontend service.
+
+Reference equivalent: the axum HttpService (reference:
+lib/llm/src/http/service/service_v2.rs:23-130, openai.rs:132-540):
+`/v1/chat/completions`, `/v1/completions`, `/v1/models`, `/metrics`,
+`/health`; a ModelManager mapping model name -> engine pipeline; SSE
+streaming with a disconnect monitor that stops generation; Prometheus
+request metrics with an RAII inflight guard (http/service/metrics.rs:24-130).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import AsyncIterator, Dict, Optional, Protocol
+
+import pydantic
+
+from dynamo_tpu.frontend.http import (
+    HttpError, HttpServer, Request, Response, StreamingResponse,
+)
+from dynamo_tpu.observability.metrics import MetricsRegistry
+from dynamo_tpu.protocols import sse
+from dynamo_tpu.protocols.delta import (
+    aggregate_chat_chunks, aggregate_completion_chunks,
+)
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest, CompletionRequest, ModelInfo, ModelList,
+)
+from dynamo_tpu.runtime.engine import Context
+
+log = logging.getLogger("dynamo_tpu.frontend")
+
+
+class OpenAIEngine(Protocol):
+    """What the frontend needs from a model pipeline: chunk streams."""
+
+    async def generate_chat(self, request: ChatCompletionRequest,
+                            context: Context) -> AsyncIterator: ...
+
+    async def generate_completion(self, request: CompletionRequest,
+                                  context: Context) -> AsyncIterator: ...
+
+
+class ModelManager:
+    def __init__(self):
+        self.chat: Dict[str, OpenAIEngine] = {}
+        self.completion: Dict[str, OpenAIEngine] = {}
+
+    def add(self, name: str, engine: OpenAIEngine,
+            model_type: str = "chat") -> None:
+        if model_type in ("chat", "both"):
+            self.chat[name] = engine
+        if model_type in ("completion", "both"):
+            self.completion[name] = engine
+
+    def remove(self, name: str) -> None:
+        self.chat.pop(name, None)
+        self.completion.pop(name, None)
+
+    def list_models(self) -> ModelList:
+        names = sorted(set(self.chat) | set(self.completion))
+        return ModelList(data=[ModelInfo(id=n) for n in names])
+
+
+class HttpService:
+    def __init__(self, host: str = "0.0.0.0", port: int = 8080,
+                 registry: Optional[MetricsRegistry] = None):
+        self.server = HttpServer(host, port)
+        self.models = ModelManager()
+        self.registry = registry or MetricsRegistry()
+        m = self.registry
+        self._requests = m.counter(
+            "llm_http_service_requests_total",
+            "HTTP requests by model/endpoint/type/status",
+            ("model", "endpoint", "request_type", "status"))
+        self._inflight = m.gauge(
+            "llm_http_service_inflight_requests",
+            "requests currently being served", ("model",))
+        self._duration = m.histogram(
+            "llm_http_service_request_duration_seconds",
+            "request duration", ("model",))
+        s = self.server
+        s.route("POST", "/v1/chat/completions", self._chat)
+        s.route("POST", "/v1/completions", self._completions)
+        s.route("GET", "/v1/models", self._models)
+        s.route("GET", "/metrics", self._metrics)
+        s.route("GET", "/health", self._health)
+        s.route("GET", "/live", self._health)
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    async def start(self) -> "HttpService":
+        await self.server.start()
+        log.info("http frontend on :%d", self.server.port)
+        return self
+
+    async def stop(self) -> None:
+        await self.server.stop()
+
+    # -- handlers ------------------------------------------------------------
+
+    async def _health(self, req: Request) -> Response:
+        return Response.json({"status": "ok",
+                              "models": [m.id for m in
+                                         self.models.list_models().data]})
+
+    async def _models(self, req: Request) -> Response:
+        return Response.json(self.models.list_models().model_dump())
+
+    async def _metrics(self, req: Request) -> Response:
+        return Response.text(self.registry.render(),
+                             content_type="text/plain; version=0.0.4")
+
+    async def _chat(self, req: Request):
+        try:
+            request = ChatCompletionRequest.model_validate(req.json())
+        except pydantic.ValidationError as e:
+            raise HttpError(422, str(e.errors()[:3]))
+        engine = self.models.chat.get(request.model)
+        if engine is None:
+            raise HttpError(404, f"model '{request.model}' not found")
+        return await self._run(req, request, "chat", request.model,
+                               lambda ctx: engine.generate_chat(request, ctx))
+
+    async def _completions(self, req: Request):
+        try:
+            request = CompletionRequest.model_validate(req.json())
+        except pydantic.ValidationError as e:
+            raise HttpError(422, str(e.errors()[:3]))
+        engine = self.models.completion.get(request.model)
+        if engine is None:
+            raise HttpError(404, f"model '{request.model}' not found")
+        return await self._run(req, request, "completion", request.model,
+                               lambda ctx: engine.generate_completion(
+                                   request, ctx))
+
+    # -- core ----------------------------------------------------------------
+
+    async def _run(self, http_req: Request, oai_req, endpoint: str,
+                   model: str, start_stream):
+        request_type = "stream" if oai_req.stream else "unary"
+        t0 = time.perf_counter()
+        ctx = Context()
+        self._inflight.inc(model)
+
+        def finish(status: str):
+            self._inflight.dec(model)
+            self._requests.inc(model, endpoint, request_type, status)
+            self._duration.observe(model, value=time.perf_counter() - t0)
+
+        try:
+            chunk_gen = await _ensure_aiter(start_stream(ctx))
+        except Exception:
+            finish("error")
+            raise
+
+        if not oai_req.stream:
+            chunks = []
+            try:
+                async for chunk in chunk_gen:
+                    chunks.append(chunk)
+            except Exception:
+                finish("error")
+                raise
+            finish("success")
+            agg = (aggregate_chat_chunks if endpoint == "chat"
+                   else aggregate_completion_chunks)(chunks)
+            return Response.json(agg.model_dump(exclude_none=True))
+
+        async def sse_gen():
+            status = "success"
+            try:
+                async for chunk in chunk_gen:
+                    if http_req.disconnected.is_set():
+                        ctx.stop_generating()
+                        status = "disconnect"
+                        break
+                    yield sse.encode_json_data(
+                        chunk.model_dump(exclude_none=True)).encode()
+                else:
+                    yield sse.DONE_FRAME.encode()
+            except asyncio.CancelledError:
+                ctx.stop_generating()
+                status = "disconnect"
+                raise
+            except Exception as e:
+                log.exception("stream error for %s", model)
+                yield sse.encode_event(sse.SseEvent(
+                    event="error", data=str(e))).encode()
+                status = "error"
+            finally:
+                ctx.stop_generating()
+                finish(status)
+
+        return StreamingResponse(sse_gen())
+
+
+async def _ensure_aiter(maybe_coro):
+    if asyncio.iscoroutine(maybe_coro):
+        return await maybe_coro
+    return maybe_coro

@@ -106,8 +106,10 @@ class RadixTree:
             else:
                 node = table.get(data.parent_hash)
                 if node is None:
-                    # parent unknown (e.g. events raced a restart): root-attach
-                    node = self.root
+                    # parent unknown (events raced a router restart): drop the
+                    # event — root-attaching a mid-sequence page would forge a
+                    # depth-1 prefix edge and cause false routing matches
+                    return
             for blk in data.blocks:
                 child = node.children.get(blk.tokens_hash)
                 if child is None:

@@ -107,6 +107,10 @@ class KvMetricsAggregator:
         self.endpoints = ProcessedEndpoints()
         self._task: Optional[asyncio.Task] = None
         self._listeners = []
+        # pristine last successful scrape per worker — carry-forward copies
+        # come from here, NOT from the bump-mutated working snapshot, so
+        # optimistic bumps never compound across scrape windows
+        self._last_scraped: Dict[str, WorkerMetrics] = {}
 
     def on_update(self, cb) -> None:
         """cb(ProcessedEndpoints, removed_worker_ids) per scrape."""
@@ -117,17 +121,28 @@ class KvMetricsAggregator:
         workers: Dict[str, WorkerMetrics] = {}
         for worker_id, payload in stats.items():
             try:
-                workers[worker_id] = WorkerMetrics.from_dict(payload)
+                m = WorkerMetrics.from_dict(payload)
             except (TypeError, KeyError):
                 continue
+            workers[worker_id] = m
+            self._last_scraped[worker_id] = dataclasses.replace(m)
         removed = set(self.endpoints.workers) - set(workers)
-        # a live instance that failed this scrape keeps its last snapshot
-        # (copied: the scheduler optimistically bumps the current snapshot,
-        # and those bumps must not compound across failed scrapes)
+        # a live instance that failed this scrape resumes from its last
+        # *pristine* snapshot (not the bump-mutated working copy)
         for worker_id in removed & set(self.client.instances):
-            workers[worker_id] = dataclasses.replace(
-                self.endpoints.workers[worker_id])
-            removed.discard(worker_id)
+            last = self._last_scraped.get(worker_id)
+            if last is not None:
+                workers[worker_id] = dataclasses.replace(last)
+                removed.discard(worker_id)
+        for worker_id in removed:
+            self._last_scraped.pop(worker_id, None)
+        # a live instance that never published stats is still routable, with
+        # unit totals so the scheduler's optimistic bump has teeth (zero
+        # totals would make it look permanently idle and attract the whole
+        # request stream between scrapes)
+        for worker_id in set(self.client.instances) - set(workers):
+            workers[worker_id] = WorkerMetrics(
+                request_total_slots=1, kv_total_blocks=1)
         self.endpoints = ProcessedEndpoints(workers)
         for cb in self._listeners:
             cb(self.endpoints, removed)

@@ -1,0 +1,139 @@
+"""Model pipelines: OpenAI request -> preprocess -> engine -> postprocess.
+
+The frontend-side assembly the reference builds per model (reference:
+Frontend -> OpenAIPreprocessor(Operator) -> Backend(Operator) ->
+ExecutionContext, preprocessor.rs:254-306 / backend.rs:112+, and the remote
+variant built by the model-discovery watcher, http/service/discovery.rs:
+58-145): render+tokenize, stream token frames from a local or remote engine,
+incrementally detokenize with the stop-string jail, and emit OpenAI delta
+chunks.
+"""
+from __future__ import annotations
+
+import logging
+from typing import AsyncIterator, Optional
+
+from dynamo_tpu.llm.backend import BackendPostprocessor
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.protocols.common import (
+    EngineOutput, FinishReason, PreprocessedRequest,
+)
+from dynamo_tpu.protocols.delta import (
+    ChatDeltaGenerator, CompletionDeltaGenerator,
+)
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest, CompletionRequest, Usage,
+)
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+log = logging.getLogger("dynamo_tpu.pipeline")
+
+
+class Pipeline:
+    """Shared OpenAI-facing plumbing; subclasses provide the token stream."""
+
+    def __init__(self, card: ModelDeploymentCard):
+        self.card = card
+        self.preprocessor = OpenAIPreprocessor(card)
+
+    async def _token_stream(self, pre: PreprocessedRequest,
+                            context: Context) -> AsyncIterator[dict]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+    # -- OpenAIEngine interface ----------------------------------------------
+
+    async def generate_chat(self, request: ChatCompletionRequest,
+                            context: Context):
+        pre, annotations = self.preprocessor.preprocess_chat(
+            request, context.id)
+        gen = ChatDeltaGenerator(request.model)
+        post = BackendPostprocessor(self.preprocessor.tokenizer,
+                                    pre.stop.stop or ())
+        want_usage = bool(request.stream_options
+                          and request.stream_options.get("include_usage"))
+        async for chunk in self._drive(pre, context, gen, post, want_usage):
+            yield chunk
+
+    async def generate_completion(self, request: CompletionRequest,
+                                  context: Context):
+        pre, annotations = self.preprocessor.preprocess_completion(
+            request, context.id)
+        gen = CompletionDeltaGenerator(request.model)
+        post = BackendPostprocessor(self.preprocessor.tokenizer,
+                                    pre.stop.stop or ())
+        async for chunk in self._drive(pre, context, gen, post, False):
+            yield chunk
+
+    async def _drive(self, pre: PreprocessedRequest, context: Context,
+                     gen, post: BackendPostprocessor, want_usage: bool):
+        n_out = 0
+        finish: Optional[str] = None
+        async for raw in self._token_stream(pre, context):
+            frame = EngineOutput.model_validate(raw)
+            n_out += len(frame.token_ids)
+            res = post.process(frame)
+            if res.text:
+                yield gen.text_chunk(res.text)
+            if res.finish_reason is not None:
+                finish = res.finish_reason.value
+                if res.finish_reason == FinishReason.STOP \
+                        and frame.finish_reason is None:
+                    # stop string matched frontend-side: stop the engine
+                    context.stop_generating()
+                break
+        if finish is None:
+            # stream ended with no finish frame: abnormal termination (worker
+            # died / stream lost), or the client stopped us — never report a
+            # clean "stop" for a truncated response
+            finish = (FinishReason.CANCELLED.value if context.is_stopped
+                      else FinishReason.ERROR.value)
+        usage = Usage(prompt_tokens=len(pre.token_ids),
+                      completion_tokens=n_out,
+                      total_tokens=len(pre.token_ids) + n_out) \
+            if want_usage else None
+        yield gen.finish_chunk(finish, usage=usage)
+
+
+class LocalPipeline(Pipeline):
+    """Engine lives in-process (single-node serve, `run in=http out=native`)."""
+
+    def __init__(self, card: ModelDeploymentCard, engine: AsyncEngine):
+        super().__init__(card)
+        self.engine = engine
+
+    async def _token_stream(self, pre, context):
+        async for frame in self.engine.generate(
+                pre.model_dump(exclude_none=True), context):
+            yield frame
+
+
+class RemotePipeline(Pipeline):
+    """Engine is a remote worker endpoint; optionally KV-aware routed.
+
+    This is what the discovery watcher builds per registered model: a runtime
+    Client plus (optionally) a KvRouter that picks the worker holding the
+    longest cached prefix (reference: discovery.rs:58-145 + kv_router).
+    """
+
+    def __init__(self, card: ModelDeploymentCard, client,
+                 router=None, policy: str = "round_robin"):
+        super().__init__(card)
+        self.client = client
+        self.router = router
+        self.policy = policy
+
+    async def _token_stream(self, pre, context):
+        instance = None
+        if self.router is not None:
+            try:
+                instance = await self.router.schedule(pre.token_ids)
+            except Exception:
+                log.exception("kv routing failed; falling back to %s",
+                              self.policy)
+        stream = await self.client.generate(
+            pre.model_dump(exclude_none=True), context,
+            instance=instance, policy=self.policy)
+        async for frame in stream:
+            yield frame

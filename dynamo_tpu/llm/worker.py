@@ -1,0 +1,208 @@
+"""LLM worker: serves a token-level engine over a runtime endpoint.
+
+The reference attaches GPU engines as subprocess side-cars behind ZMQ
+(reference: lib/llm/src/engines/, SURVEY.md §2.8); here the engine is
+in-process JAX (`NativeEngineWorker`) or a deterministic no-TPU fake
+(`EchoTokenEngine`, the analogue of the reference's EchoFull/EchoCore,
+launch/dynamo-run/src/output/echo_*.rs). The wire contract both directions
+is the common protocol: PreprocessedRequest in, EngineOutput frames out.
+
+The worker also owns the router-facing side channels: KV events from its
+page allocator and ForwardPassMetrics via the endpoint stats handler
+(SURVEY.md §3.4).
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+from typing import AsyncIterator, Dict, Optional
+
+from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+from dynamo_tpu.kv_router.publisher import KvEventPublisher, KvMetricsPublisher
+from dynamo_tpu.protocols.common import (
+    EngineOutput, FinishReason, PreprocessedRequest,
+)
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+log = logging.getLogger("dynamo_tpu.worker")
+
+
+def _to_engine_request(pre: PreprocessedRequest) -> EngineRequest:
+    s, st = pre.sampling, pre.stop
+    return EngineRequest(
+        request_id=pre.request_id,
+        prompt=list(pre.token_ids),
+        params=SamplingParams(
+            max_tokens=st.max_tokens or 16,
+            temperature=s.temperature if s.temperature is not None else 0.0,
+            top_k=s.top_k or 0,
+            top_p=s.top_p if s.top_p is not None else 1.0,
+            seed=s.seed or 0,
+            ignore_eos=st.ignore_eos,
+            stop_token_ids=tuple(st.stop_token_ids_hidden or ()),
+            min_tokens=st.min_tokens or 0,
+        ))
+
+
+class EchoTokenEngine(AsyncEngine):
+    """Echoes the prompt tokens back, one frame per token, rate-limited.
+
+    Deterministic zero-hardware engine for tests and stack bring-up
+    (reference: echo_full.rs / echo_core.rs).
+    """
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+
+    async def generate(self, request, context: Context):
+        pre = PreprocessedRequest.model_validate(request)
+        n = pre.stop.max_tokens or len(pre.token_ids)
+        emitted = 0
+        for tok in pre.token_ids:
+            if emitted >= n or context.is_stopped:
+                break
+            if self.delay_s:
+                await asyncio.sleep(self.delay_s)
+            emitted += 1
+            yield EngineOutput(token_ids=[tok]).model_dump(exclude_none=True)
+        reason = (FinishReason.LENGTH if emitted >= n
+                  else FinishReason.CANCELLED if context.is_stopped
+                  else FinishReason.STOP)
+        yield EngineOutput(token_ids=[], finish_reason=reason).model_dump(
+            exclude_none=True)
+
+
+class NativeEngineWorker(AsyncEngine):
+    """Serves a NativeEngine: async request fan-in, device step loop,
+    per-request frame fan-out, KV event + metrics publication."""
+
+    def __init__(self, engine, component=None, worker_id: str = "",
+                 step_idle_sleep_s: float = 0.002):
+        self.engine = engine
+        self.metrics_publisher = KvMetricsPublisher()
+        self.event_publisher = (
+            KvEventPublisher(component, worker_id) if component is not None
+            else None)
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._wake = asyncio.Event()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._idle_sleep = step_idle_sleep_s
+        # engine state is touched ONLY by the step loop (adds/aborts are
+        # staged here) so nothing mutates the scheduler while a device step
+        # runs in the executor thread
+        self._pending_adds: list = []
+        self._pending_aborts: list = []
+
+    async def start(self) -> "NativeEngineWorker":
+        self._loop_task = asyncio.create_task(self._step_loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._loop_task:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+            self._loop_task = None
+
+    # -- engine loop ----------------------------------------------------------
+
+    def _apply_pending(self) -> None:
+        """Apply staged adds/aborts; runs only between device steps."""
+        adds, self._pending_adds = self._pending_adds, []
+        for req in adds:
+            try:
+                self.engine.add_request(req)
+            except (ValueError, MemoryError) as e:
+                q = self._queues.get(req.request_id)
+                if q is not None:
+                    q.put_nowait(EngineOutput(finish_reason=FinishReason.ERROR,
+                                              text=str(e)))
+        aborts, self._pending_aborts = self._pending_aborts, []
+        for rid in aborts:
+            self.engine.abort(rid)
+
+    async def _step_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            self._apply_pending()
+            if not self.engine.has_work():
+                self._wake.clear()
+                if not self._pending_adds:
+                    self.metrics_publisher.update(self.engine.metrics())
+                    try:
+                        await asyncio.wait_for(self._wake.wait(), timeout=1.0)
+                    except asyncio.TimeoutError:
+                        pass
+                continue
+            try:
+                outputs = await loop.run_in_executor(None, self.engine.step)
+            except Exception:
+                log.exception("engine step failed; failing active requests")
+                for q in self._queues.values():
+                    q.put_nowait(EngineOutput(
+                        finish_reason=FinishReason.ERROR))
+                self._queues.clear()
+                continue
+            for ev in outputs:
+                q = self._queues.get(ev.request_id)
+                if q is None:
+                    continue
+                q.put_nowait(EngineOutput(
+                    token_ids=[ev.token] if ev.token is not None else [],
+                    finish_reason=(FinishReason(ev.finish_reason)
+                                   if ev.finish_reason else None)))
+            self.metrics_publisher.update(self.engine.metrics())
+            if self.event_publisher is not None:
+                events = self.engine.drain_kv_events()
+                if events:
+                    await self.event_publisher.publish_allocator_events(events)
+
+    # -- AsyncEngine ----------------------------------------------------------
+
+    async def generate(self, request, context: Context):
+        pre = PreprocessedRequest.model_validate(request)
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[pre.request_id] = q
+        try:
+            self._pending_adds.append(_to_engine_request(pre))
+            self._wake.set()
+            while True:
+                get = asyncio.create_task(q.get())
+                stop = asyncio.create_task(context.wait_stopped())
+                done, pending = await asyncio.wait(
+                    {get, stop}, return_when=asyncio.FIRST_COMPLETED)
+                for t in pending:
+                    t.cancel()
+                if stop in done and get not in done:
+                    self._pending_aborts.append(pre.request_id)
+                    self._wake.set()
+                    yield EngineOutput(
+                        finish_reason=FinishReason.CANCELLED).model_dump(
+                            exclude_none=True)
+                    return
+                frame: EngineOutput = get.result()
+                yield frame.model_dump(exclude_none=True)
+                if frame.finish_reason is not None:
+                    return
+        finally:
+            self._queues.pop(pre.request_id, None)
+
+    # -- stats ----------------------------------------------------------------
+
+    def stats_handler(self) -> dict:
+        return self.metrics_publisher.stats_handler()
+
+
+async def serve_llm_worker(runtime, namespace: str, component: str,
+                           engine: AsyncEngine, endpoint: str = "generate",
+                           card=None):
+    """Register + serve an LLM engine endpoint with stats wired up."""
+    comp = runtime.namespace(namespace).component(component)
+    ep = comp.endpoint(endpoint)
+    stats = getattr(engine, "stats_handler", None)
+    metadata = {"model_card": card.to_dict()} if card is not None else None
+    served = await ep.serve(engine, metadata=metadata, stats_handler=stats)
+    return served

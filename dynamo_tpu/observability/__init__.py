@@ -1,0 +1,5 @@
+from dynamo_tpu.observability.metrics import (
+    Counter, Gauge, Histogram, MetricsRegistry,
+)
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]

@@ -1,0 +1,198 @@
+"""Minimal Prometheus-compatible metrics registry.
+
+Role-equivalent of the reference's prometheus crates usage (reference:
+lib/llm/src/http/service/metrics.rs:24-130 — counters/gauges/histograms with
+model/endpoint/status labels, exposed on GET /metrics in text exposition
+format). Stdlib-only: the image has no prometheus_client, and the needs are
+small (label vectors, histogram buckets, text rendering).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+LabelKey = Tuple[str, ...]
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _esc(v: str) -> str:
+    """Escape a label value per the Prometheus exposition format."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(names: Sequence[str], values: LabelKey,
+                extra: Optional[Dict[str, str]] = None) -> str:
+    parts = [f'{n}="{_esc(v)}"' for n, v in zip(names, values)]
+    if extra:
+        parts += [f'{n}="{_esc(v)}"' for n, v in extra.items()]
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def _check(self, labels: LabelKey):
+        if len(labels) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, got {labels}")
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[LabelKey, float] = {}
+
+    def inc(self, *labels: str, value: float = 1.0) -> None:
+        self._check(labels)
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + value
+
+    def get(self, *labels: str) -> float:
+        return self._values.get(labels, 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.kind}"]
+        for labels, v in sorted(self._values.items()):
+            out.append(f"{self.name}"
+                       f"{_fmt_labels(self.label_names, labels)} {_fmt_value(v)}")
+        if not self._values and not self.label_names:
+            out.append(f"{self.name} 0")
+        return out
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[LabelKey, float] = {}
+
+    def set(self, *labels: str, value: float) -> None:
+        self._check(labels)
+        with self._lock:
+            self._values[labels] = float(value)
+
+    def inc(self, *labels: str, value: float = 1.0) -> None:
+        self._check(labels)
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + value
+
+    def dec(self, *labels: str, value: float = 1.0) -> None:
+        self.inc(*labels, value=-value)
+
+    def get(self, *labels: str) -> float:
+        return self._values.get(labels, 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.kind}"]
+        for labels, v in sorted(self._values.items()):
+            out.append(f"{self.name}"
+                       f"{_fmt_labels(self.label_names, labels)} {_fmt_value(v)}")
+        if not self._values and not self.label_names:
+            out.append(f"{self.name} 0")
+        return out
+
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   10.0, 30.0, 60.0, float("inf"))
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, label_names=(),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        bl = sorted(set(buckets))
+        if bl[-1] != float("inf"):
+            bl.append(float("inf"))
+        self.buckets = tuple(bl)
+        self._counts: Dict[LabelKey, List[int]] = {}
+        self._sums: Dict[LabelKey, float] = {}
+        self._totals: Dict[LabelKey, int] = {}
+
+    def observe(self, *labels: str, value: float) -> None:
+        self._check(labels)
+        with self._lock:
+            counts = self._counts.setdefault(labels, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            self._sums[labels] = self._sums.get(labels, 0.0) + value
+            self._totals[labels] = self._totals.get(labels, 0) + 1
+
+    def count(self, *labels: str) -> int:
+        return self._totals.get(labels, 0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.kind}"]
+        for labels in sorted(self._counts):
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[labels][i]
+                lab = _fmt_labels(self.label_names, labels,
+                                  {"le": _fmt_value(b)})
+                out.append(f"{self.name}_bucket{lab} {cum}")
+            plain = _fmt_labels(self.label_names, labels)
+            out.append(f"{self.name}_sum{plain} "
+                       f"{_fmt_value(self._sums[labels])}")
+            out.append(f"{self.name}_count{plain} {self._totals[labels]}")
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "", label_names=()) -> Counter:
+        return self._get_or_make(Counter, name, help_, label_names)
+
+    def gauge(self, name: str, help_: str = "", label_names=()) -> Gauge:
+        return self._get_or_make(Gauge, name, help_, label_names)
+
+    def histogram(self, name: str, help_: str = "", label_names=(),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, label_names, buckets)
+                self._metrics[name] = m
+            elif not isinstance(m, Histogram):
+                raise TypeError(f"{name} already registered as {m.kind}")
+            return m
+
+    def _get_or_make(self, cls, name, help_, label_names):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_, label_names)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"{name} already registered as {m.kind}")
+            return m
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].render())
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,112 @@
+"""OpenAI delta generation + SSE aggregation.
+
+Reference equivalents: the delta generators turning backend frames into
+chat/completion stream chunks and the aggregators folding an SSE stream back
+into a unary response for non-streaming clients (reference:
+lib/llm/src/protocols/openai/chat_completions/{delta,aggregator}.rs and
+completions/{delta,aggregator}.rs).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from dynamo_tpu.protocols.openai import (
+    ChatChoice, ChatChoiceDelta, ChatCompletionChunk, ChatCompletionResponse,
+    ChatMessage, ChatStreamChoice, CompletionChoice, CompletionResponse,
+    Usage, new_response_id, now,
+)
+
+
+class ChatDeltaGenerator:
+    """Builds chat.completion.chunk frames from text deltas."""
+
+    def __init__(self, model: str, response_id: Optional[str] = None):
+        self.model = model
+        self.id = response_id or new_response_id("chatcmpl")
+        self.created = now()
+        self._sent_role = False
+
+    def _chunk(self, choice: ChatStreamChoice,
+               usage: Optional[Usage] = None) -> ChatCompletionChunk:
+        return ChatCompletionChunk(id=self.id, created=self.created,
+                                   model=self.model, choices=[choice],
+                                   usage=usage)
+
+    def role_chunk(self, index: int = 0) -> ChatCompletionChunk:
+        self._sent_role = True
+        return self._chunk(ChatStreamChoice(
+            index=index, delta=ChatChoiceDelta(role="assistant", content="")))
+
+    def text_chunk(self, text: str, index: int = 0) -> ChatCompletionChunk:
+        delta = ChatChoiceDelta(content=text)
+        if not self._sent_role:
+            delta.role = "assistant"
+            self._sent_role = True
+        return self._chunk(ChatStreamChoice(index=index, delta=delta))
+
+    def finish_chunk(self, finish_reason: str, index: int = 0,
+                     usage: Optional[Usage] = None) -> ChatCompletionChunk:
+        return self._chunk(ChatStreamChoice(
+            index=index, delta=ChatChoiceDelta(), finish_reason=finish_reason),
+            usage)
+
+
+class CompletionDeltaGenerator:
+    def __init__(self, model: str, response_id: Optional[str] = None):
+        self.model = model
+        self.id = response_id or new_response_id("cmpl")
+        self.created = now()
+
+    def text_chunk(self, text: str, index: int = 0) -> CompletionResponse:
+        return CompletionResponse(
+            id=self.id, created=self.created, model=self.model,
+            choices=[CompletionChoice(index=index, text=text)])
+
+    def finish_chunk(self, finish_reason: str, index: int = 0,
+                     usage: Optional[Usage] = None) -> CompletionResponse:
+        return CompletionResponse(
+            id=self.id, created=self.created, model=self.model,
+            choices=[CompletionChoice(index=index, text="",
+                                      finish_reason=finish_reason)],
+            usage=usage)
+
+
+def aggregate_chat_chunks(
+        chunks: Iterable[ChatCompletionChunk]) -> ChatCompletionResponse:
+    """Fold a chunk stream into a unary chat.completion response."""
+    pieces: List[str] = []
+    finish: Optional[str] = None
+    rid, created, model, usage = None, None, None, None
+    for c in chunks:
+        rid, created, model = c.id, c.created, c.model
+        usage = c.usage or usage
+        for choice in c.choices:
+            if choice.delta.content:
+                pieces.append(choice.delta.content)
+            if choice.finish_reason:
+                finish = choice.finish_reason
+    return ChatCompletionResponse(
+        id=rid or new_response_id("chatcmpl"), created=created or now(),
+        model=model or "", usage=usage,
+        choices=[ChatChoice(
+            message=ChatMessage(role="assistant", content="".join(pieces)),
+            finish_reason=finish)])
+
+
+def aggregate_completion_chunks(
+        chunks: Iterable[CompletionResponse]) -> CompletionResponse:
+    pieces: List[str] = []
+    finish: Optional[str] = None
+    rid, created, model, usage = None, None, None, None
+    for c in chunks:
+        rid, created, model = c.id, c.created, c.model
+        usage = c.usage or usage
+        for choice in c.choices:
+            if choice.text:
+                pieces.append(choice.text)
+            if choice.finish_reason:
+                finish = choice.finish_reason
+    return CompletionResponse(
+        id=rid or new_response_id("cmpl"), created=created or now(),
+        model=model or "", usage=usage,
+        choices=[CompletionChoice(text="".join(pieces), finish_reason=finish)])

@@ -34,6 +34,7 @@ class DistributedRuntime:
         self.lease: Optional[Lease] = None
         self.shutdown_event = asyncio.Event()
         self._data_plane: Optional[DataPlaneServer] = None
+        self._data_plane_lock = asyncio.Lock()
         self._served: List[object] = []
         self._advertise_host = advertise_host
         self._lease_watch: Optional[asyncio.Task] = None
@@ -94,10 +95,13 @@ class DistributedRuntime:
         return self._namespaces[name]
 
     async def data_plane(self) -> DataPlaneServer:
-        if self._data_plane is None:
-            self._data_plane = DataPlaneServer(
-                advertise_host=self._advertise_host)
-            await self._data_plane.start()
+        # lock: a concurrent caller must not see the server pre-start
+        # (its advertised port would still be 0)
+        async with self._data_plane_lock:
+            if self._data_plane is None:
+                server = DataPlaneServer(advertise_host=self._advertise_host)
+                await server.start()
+                self._data_plane = server
         return self._data_plane
 
     def register_served(self, served) -> None:

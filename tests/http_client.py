@@ -1,0 +1,100 @@
+"""Tiny asyncio HTTP/1.1 test client (unary + SSE streaming)."""
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import AsyncIterator, Dict, Optional, Tuple
+
+
+async def _read_headers(reader) -> Tuple[int, Dict[str, str]]:
+    head = await reader.readuntil(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    headers = {}
+    for line in lines[1:]:
+        if ":" in line:
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+    return status, headers
+
+
+def _request_bytes(method: str, path: str, host: str,
+                   body: Optional[bytes]) -> bytes:
+    head = (f"{method} {path} HTTP/1.1\r\nhost: {host}\r\n"
+            "content-type: application/json\r\n"
+            f"content-length: {len(body or b'')}\r\n\r\n")
+    return head.encode() + (body or b"")
+
+
+async def request(host: str, port: int, method: str, path: str,
+                  body=None) -> Tuple[int, bytes]:
+    """Unary request; returns (status, full body bytes)."""
+    if isinstance(body, (dict, list)):
+        body = json.dumps(body).encode()
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(_request_bytes(method, path, host, body))
+        await writer.drain()
+        status, headers = await _read_headers(reader)
+        if headers.get("transfer-encoding") == "chunked":
+            out = b""
+            while True:
+                size_line = await reader.readuntil(b"\r\n")
+                size = int(size_line.strip(), 16)
+                if size == 0:
+                    await reader.readuntil(b"\r\n")
+                    break
+                out += await reader.readexactly(size)
+                await reader.readexactly(2)
+            return status, out
+        length = int(headers.get("content-length", "0"))
+        return status, (await reader.readexactly(length) if length else b"")
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+async def sse_events(host: str, port: int, path: str, body,
+                     max_events: Optional[int] = None
+                     ) -> AsyncIterator[Tuple[Optional[str], str]]:
+    """POST and yield (event, data) SSE tuples as they arrive; closing the
+    generator drops the connection (client disconnect)."""
+    if isinstance(body, (dict, list)):
+        body = json.dumps(body).encode()
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(_request_bytes("POST", path, host, body))
+        await writer.drain()
+        status, headers = await _read_headers(reader)
+        assert status == 200, status
+        buf = b""
+        n = 0
+        while True:
+            size_line = await reader.readuntil(b"\r\n")
+            size = int(size_line.strip(), 16)
+            if size == 0:
+                break
+            buf += await reader.readexactly(size)
+            await reader.readexactly(2)
+            while b"\n\n" in buf:
+                block, buf = buf.split(b"\n\n", 1)
+                event, datas = None, []
+                for line in block.decode().split("\n"):
+                    if line.startswith("event:"):
+                        event = line[6:].strip()
+                    elif line.startswith("data:"):
+                        datas.append(line[5:].lstrip(" "))
+                if datas or event:
+                    yield event, "\n".join(datas)
+                    n += 1
+                    if max_events is not None and n >= max_events:
+                        return
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass

@@ -1,0 +1,360 @@
+"""HTTP frontend tests: OpenAI routes, SSE, metrics, discovery, e2e serving.
+
+Mirrors the reference's http-service tests (SURVEY.md §4.2: real server +
+CounterEngine/AlwaysFailEngine fakes, Prometheus counters/inflight asserted,
+SSE behavior) plus the full distributed path: echo worker over the in-memory
+control plane, model registration, KV-routed native-engine serving.
+"""
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.frontend.discovery import (
+    ModelWatcher, list_registered_models, register_model, unregister_model,
+)
+from dynamo_tpu.frontend.service import HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.pipeline import LocalPipeline
+from dynamo_tpu.llm.worker import EchoTokenEngine, serve_llm_worker
+from dynamo_tpu.observability.metrics import MetricsRegistry
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionChunk, ChatCompletionRequest, ChatStreamChoice,
+    new_response_id, now,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+from tests.http_client import request, sse_events
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class CounterEngine:
+    """Streams n numbered chunks (reference CounterEngine fake)."""
+
+    def __init__(self, n=3, delay=0.0):
+        self.n = n
+        self.delay = delay
+        self.contexts = []
+
+    async def generate_chat(self, request, context):
+        self.contexts.append(context)
+        gen_id, created = new_response_id("chatcmpl"), now()
+        for i in range(self.n):
+            if context.is_stopped:
+                return
+            if self.delay:
+                await asyncio.sleep(self.delay)
+            yield ChatCompletionChunk(
+                id=gen_id, created=created, model=request.model,
+                choices=[ChatStreamChoice(
+                    index=0, delta={"role": "assistant", "content": f"c{i} "})])
+        yield ChatCompletionChunk(
+            id=gen_id, created=created, model=request.model,
+            choices=[ChatStreamChoice(index=0, delta={},
+                                      finish_reason="stop")])
+
+    async def generate_completion(self, request, context):
+        raise NotImplementedError
+        yield
+
+
+class AlwaysFailEngine:
+    async def generate_chat(self, request, context):
+        raise RuntimeError("boom")
+        yield
+
+    generate_completion = generate_chat
+
+
+CHAT_BODY = {"model": "m", "messages": [{"role": "user", "content": "hi"}]}
+
+
+class TestHttpService:
+    def test_unary_chat_aggregates_and_counts(self):
+        async def main():
+            svc = await HttpService("127.0.0.1", 0).start()
+            svc.models.add("m", CounterEngine(3))
+            status, body = await request(
+                "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+                CHAT_BODY)
+            assert status == 200
+            resp = json.loads(body)
+            assert resp["choices"][0]["message"]["content"] == "c0 c1 c2 "
+            assert resp["choices"][0]["finish_reason"] == "stop"
+            assert svc._requests.get("m", "chat", "unary", "success") == 1
+            assert svc._inflight.get("m") == 0
+            assert svc._duration.count("m") == 1
+            await svc.stop()
+
+        run(main())
+
+    def test_streaming_sse_with_done(self):
+        async def main():
+            svc = await HttpService("127.0.0.1", 0).start()
+            svc.models.add("m", CounterEngine(2))
+            events = []
+            async for ev, data in sse_events(
+                    "127.0.0.1", svc.port, "/v1/chat/completions",
+                    {**CHAT_BODY, "stream": True}):
+                events.append((ev, data))
+            assert events[-1][1] == "[DONE]"
+            contents = [json.loads(d)["choices"][0]["delta"].get("content")
+                        for _, d in events[:-2]]
+            assert contents == ["c0 ", "c1 "]
+            assert svc._requests.get("m", "chat", "stream", "success") == 1
+            await svc.stop()
+
+        run(main())
+
+    def test_client_disconnect_stops_generation(self):
+        async def main():
+            svc = await HttpService("127.0.0.1", 0).start()
+            eng = CounterEngine(1000, delay=0.01)
+            svc.models.add("m", eng)
+            gen = sse_events("127.0.0.1", svc.port, "/v1/chat/completions",
+                             {**CHAT_BODY, "stream": True}, max_events=3)
+            got = [d async for _, d in gen]
+            assert len(got) == 3  # connection dropped after 3 events
+            for _ in range(100):
+                if eng.contexts and eng.contexts[0].is_stopped:
+                    break
+                await asyncio.sleep(0.05)
+            assert eng.contexts[0].is_stopped
+            assert svc._inflight.get("m") == 0
+            await svc.stop()
+
+        run(main())
+
+    def test_errors_and_statuses(self):
+        async def main():
+            svc = await HttpService("127.0.0.1", 0).start()
+            svc.models.add("m", AlwaysFailEngine())
+            # unknown model -> 404
+            status, _ = await request(
+                "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+                {**CHAT_BODY, "model": "nope"})
+            assert status == 404
+            # invalid body -> 422
+            status, _ = await request(
+                "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+                {"model": "m"})
+            assert status == 422
+            # wrong method -> 405
+            status, _ = await request(
+                "127.0.0.1", svc.port, "GET", "/v1/chat/completions")
+            assert status == 405
+            # unknown path -> 404
+            status, _ = await request("127.0.0.1", svc.port, "GET", "/nope")
+            assert status == 404
+            # engine failure -> 500 + error counter
+            status, _ = await request(
+                "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+                CHAT_BODY)
+            assert status == 500
+            assert svc._requests.get("m", "chat", "unary", "error") == 1
+            await svc.stop()
+
+        run(main())
+
+    def test_models_and_metrics_routes(self):
+        async def main():
+            svc = await HttpService("127.0.0.1", 0).start()
+            svc.models.add("m1", CounterEngine(), "chat")
+            svc.models.add("m2", CounterEngine(), "completion")
+            status, body = await request("127.0.0.1", svc.port, "GET",
+                                         "/v1/models")
+            assert status == 200
+            assert [m["id"] for m in json.loads(body)["data"]] == ["m1", "m2"]
+            await request("127.0.0.1", svc.port, "POST",
+                          "/v1/chat/completions", {**CHAT_BODY, "model": "m1"})
+            status, body = await request("127.0.0.1", svc.port, "GET",
+                                         "/metrics")
+            text = body.decode()
+            assert status == 200
+            assert ('llm_http_service_requests_total{model="m1",'
+                    'endpoint="chat",request_type="unary",status="success"} 1'
+                    in text)
+            assert "# TYPE llm_http_service_request_duration_seconds histogram" \
+                in text
+            await svc.stop()
+
+        run(main())
+
+
+def byte_card(name="echo-model", **kw):
+    return ModelDeploymentCard(name=name, arch="tiny", tokenizer_kind="byte",
+                               context_length=512, eos_token_ids=[2], **kw)
+
+
+class TestLocalPipeline:
+    def test_chat_roundtrip_with_echo(self):
+        async def main():
+            card = byte_card()
+            pipe = LocalPipeline(card, EchoTokenEngine())
+            svc = await HttpService("127.0.0.1", 0).start()
+            svc.models.add("echo-model", pipe, "both")
+            status, body = await request(
+                "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+                {"model": "echo-model", "max_tokens": 500,
+                 "messages": [{"role": "user", "content": "hello tpu"}]})
+            assert status == 200
+            content = json.loads(body)["choices"][0]["message"]["content"]
+            # echo engine returns the rendered prompt text
+            assert "hello tpu" in content
+            # completions route too
+            status, body = await request(
+                "127.0.0.1", svc.port, "POST", "/v1/completions",
+                {"model": "echo-model", "prompt": "abc", "max_tokens": 10})
+            assert status == 200
+            assert json.loads(body)["choices"][0]["text"] == "abc"
+            await svc.stop()
+
+        run(main())
+
+    def test_stop_string_jails_and_finishes(self):
+        async def main():
+            card = byte_card()
+            pipe = LocalPipeline(card, EchoTokenEngine())
+            svc = await HttpService("127.0.0.1", 0).start()
+            svc.models.add("echo-model", pipe, "completion")
+            status, body = await request(
+                "127.0.0.1", svc.port, "POST", "/v1/completions",
+                {"model": "echo-model", "prompt": "hello STOP world",
+                 "max_tokens": 100, "stop": ["STOP"]})
+            assert status == 200
+            choice = json.loads(body)["choices"][0]
+            assert choice["text"] == "hello "
+            assert choice["finish_reason"] == "stop"
+            await svc.stop()
+
+        run(main())
+
+
+class TestKvRoutedDiscovery:
+    def test_model_watcher_builds_kv_routed_pipeline(self):
+        """kv_routed registration wires a KvRouter into the remote pipeline;
+        the request lands on the worker holding the cached prefix."""
+        async def main():
+            from dynamo_tpu.engine.kv_cache import PageAllocator
+            from dynamo_tpu.kv_router.publisher import KvEventPublisher
+            from dynamo_tpu.kv_router.router import KvRouter
+
+            plane = MemoryPlane()
+            wrts, comps = {}, {}
+            for wid in ("wa", "wb"):
+                rt = await DistributedRuntime.create_local(plane, wid)
+                await serve_llm_worker(rt, "ns", "backend", EchoTokenEngine(),
+                                       card=byte_card())
+                wrts[wid] = rt
+                comps[wid] = rt.namespace("ns").component("backend")
+
+            frt = await DistributedRuntime.create_local(plane, "front")
+            svc = await HttpService("127.0.0.1", 0).start()
+            routers = []
+
+            async def make_router(component, client, card):
+                r = await KvRouter(component, client,
+                                   block_size=card.kv_page_size,
+                                   scrape_interval_s=0.05).start()
+                routers.append(r)
+                return r
+
+            watcher = await ModelWatcher(frt, svc.models,
+                                         make_router=make_router).start()
+            card = byte_card(kv_page_size=4)
+            await register_model(frt.kv, "echo-model", "ns", "backend", card,
+                                 model_type="chat", kv_routed=True)
+            await asyncio.sleep(0.2)
+            assert routers, "router was not built for kv_routed model"
+            pipe = svc.models.chat["echo-model"]
+            assert pipe.router is routers[0]
+
+            # wb announces it holds the prompt's prefix pages
+            prompt_text = "route me to the warm one"
+            pre, _ = pipe.preprocessor.preprocess_chat(
+                ChatCompletionRequest(model="echo-model", messages=[
+                    {"role": "user", "content": prompt_text}]))
+            alloc = PageAllocator(16, 4)
+            parent = 0
+            for i in range(len(pre.token_ids) // 4):
+                pid = alloc.allocate()
+                parent = alloc.seal(pid, parent,
+                                    pre.token_ids[i * 4:(i + 1) * 4])
+            await KvEventPublisher(comps["wb"], "wb").publish_allocator_events(
+                alloc.drain_events())
+            await asyncio.sleep(0.2)
+
+            assert await routers[0].schedule(pre.token_ids) == "wb"
+            status, body = await request(
+                "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+                {"model": "echo-model", "max_tokens": 400, "messages": [
+                    {"role": "user", "content": prompt_text}]})
+            assert status == 200
+            assert prompt_text in \
+                json.loads(body)["choices"][0]["message"]["content"]
+
+            await watcher.stop()
+            await svc.stop()
+            for rt in list(wrts.values()) + [frt]:
+                await rt.shutdown()
+
+        run(main())
+
+
+class TestDistributedServing:
+    def test_echo_worker_via_registry_end_to_end(self):
+        """frontend + model registry + remote echo worker over the in-memory
+        control plane: the reference's full serve path without hardware."""
+        async def main():
+            plane = MemoryPlane()
+            wrt = await DistributedRuntime.create_local(plane, "w1")
+            card = byte_card()
+            await serve_llm_worker(wrt, "ns", "backend", EchoTokenEngine(),
+                                   card=card)
+
+            frt = await DistributedRuntime.create_local(plane, "front")
+            svc = await HttpService("127.0.0.1", 0).start()
+            watcher = await ModelWatcher(frt, svc.models).start()
+            await register_model(frt.kv, "echo-model", "ns", "backend", card,
+                                 model_type="both")
+            await asyncio.sleep(0.1)
+            assert "echo-model" in svc.models.chat
+
+            status, body = await request(
+                "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+                {"model": "echo-model", "max_tokens": 400,
+                 "messages": [{"role": "user", "content": "over the wire"}]})
+            assert status == 200
+            content = json.loads(body)["choices"][0]["message"]["content"]
+            assert "over the wire" in content
+
+            # streaming path
+            events = []
+            async for ev, data in sse_events(
+                    "127.0.0.1", svc.port, "/v1/chat/completions",
+                    {"model": "echo-model", "stream": True, "max_tokens": 400,
+                     "messages": [{"role": "user", "content": "abc"}]}):
+                events.append(data)
+            assert events[-1] == "[DONE]"
+            text = "".join(
+                json.loads(d)["choices"][0]["delta"].get("content") or ""
+                for d in events[:-1] if d != "[DONE]")
+            assert "abc" in text
+
+            # deregistration removes the model live
+            await unregister_model(frt.kv, "echo-model", "both")
+            models = await list_registered_models(frt.kv)
+            assert models == {}
+            await asyncio.sleep(0.05)
+            assert "echo-model" not in svc.models.chat
+
+            await watcher.stop()
+            await svc.stop()
+            await frt.shutdown()
+            await wrt.shutdown()
+
+        run(main())

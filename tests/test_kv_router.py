@@ -185,6 +185,32 @@ class TestScheduler:
             sched.schedule(10, MatchResult())
 
 
+class TestOrphanEvents:
+    def test_unknown_parent_store_is_dropped(self):
+        """A mid-sequence page whose parent is unknown (router restarted)
+        must NOT root-attach — that would forge a fake depth-1 prefix."""
+        tree = RadixTree()
+        tree.apply_event(stored("w1", [(102, 2)], parent=101))  # orphan
+        assert tree.find_matches([2]).scores == {}
+        assert tree.num_nodes() == 0
+
+    def test_fresh_worker_defaults_are_bumpable(self):
+        """Never-scraped instances get unit totals so the optimistic bump
+        spreads traffic instead of flooding one cold worker."""
+        sched = KvScheduler(block_size=16,
+                            selector=DefaultWorkerSelector(rng=random.Random(0)))
+        sched.update_endpoints(ProcessedEndpoints({
+            "cold": WorkerMetrics(request_total_slots=1, kv_total_blocks=1),
+            "warm": WorkerMetrics(request_active_slots=1,
+                                  request_total_slots=8,
+                                  kv_active_blocks=10, kv_total_blocks=100)}))
+        from dynamo_tpu.kv_router.indexer import MatchResult
+        first = sched.schedule(64, MatchResult())
+        second = sched.schedule(64, MatchResult())
+        assert first == "cold"
+        assert second == "warm"  # bump made the cold worker look busy
+
+
 class TestIndexerTombstones:
     def test_late_event_cannot_resurrect_removed_worker(self):
         idx = KvIndexer(block_size=4)

@@ -1,0 +1,166 @@
+"""Native-engine worker tests: async serving loop, KV events, routing, abort."""
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import SamplingParams
+from dynamo_tpu.kv_router.router import KvRouter
+from dynamo_tpu.llm.worker import NativeEngineWorker, serve_llm_worker
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+PAGE = 8
+
+
+def make_engine():
+    return NativeEngine(CFG, EngineConfig(
+        page_size=PAGE, num_pages=64, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512), seed=0)
+
+
+def pre_request(rid, prompt, max_tokens=6):
+    return PreprocessedRequest(
+        request_id=rid, token_ids=prompt,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    ).model_dump(exclude_none=True)
+
+
+def test_worker_streams_match_direct_engine():
+    prompt = list(range(10, 30))
+    direct = make_engine().generate(prompt, SamplingParams(
+        max_tokens=6, temperature=0.0, ignore_eos=True), "d")
+
+    async def main():
+        plane = MemoryPlane()
+        wrt = await DistributedRuntime.create_local(plane, "w1")
+        worker = await NativeEngineWorker(make_engine()).start()
+        await serve_llm_worker(wrt, "ns", "backend", worker)
+
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("backend").endpoint(
+            "generate").client()
+        await client.start()
+        await client.wait_for_instances()
+        toks = []
+        async for frame in await client.generate(pre_request("r1", prompt)):
+            toks.extend(frame.get("token_ids", ()))
+        await worker.stop()
+        await crt.shutdown()
+        await wrt.shutdown()
+        return toks
+
+    assert asyncio.run(main()) == direct
+
+
+def test_worker_concurrent_requests_and_metrics():
+    async def main():
+        plane = MemoryPlane()
+        wrt = await DistributedRuntime.create_local(plane, "w1")
+        worker = await NativeEngineWorker(make_engine()).start()
+        await serve_llm_worker(wrt, "ns", "backend", worker)
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("backend").endpoint(
+            "generate").client()
+        await client.start()
+        await client.wait_for_instances()
+
+        async def one(rid, base):
+            prompt = list(range(base, base + 12))
+            toks = []
+            async for frame in await client.generate(pre_request(rid, prompt)):
+                toks.extend(frame.get("token_ids", ()))
+            return toks
+
+        results = await asyncio.gather(one("a", 5), one("b", 50), one("c", 100))
+        assert all(len(r) == 6 for r in results)
+        stats = await client.scrape_stats()
+        assert stats["w1"]["request_total_slots"] == 4
+        assert stats["w1"]["kv_total_blocks"] == 64
+        await worker.stop()
+        await crt.shutdown()
+        await wrt.shutdown()
+
+    asyncio.run(main())
+
+
+def test_worker_kv_events_feed_router():
+    """Worker publishes page events; the router learns which worker holds
+    the prefix and routes a matching request there (SURVEY.md §3.4 path)."""
+    async def main():
+        plane = MemoryPlane()
+        wrt = await DistributedRuntime.create_local(plane, "warm")
+        comp = wrt.namespace("ns").component("backend")
+        worker = await NativeEngineWorker(
+            make_engine(), component=comp, worker_id="warm").start()
+        await serve_llm_worker(wrt, "ns", "backend", worker)
+
+        # a second cold worker with no cached pages
+        wrt2 = await DistributedRuntime.create_local(plane, "cold")
+        worker2 = await NativeEngineWorker(
+            make_engine(), component=wrt2.namespace("ns").component("backend"),
+            worker_id="cold").start()
+        await serve_llm_worker(wrt2, "ns", "backend", worker2)
+
+        rrt = await DistributedRuntime.create_local(plane, "router")
+        rcomp = rrt.namespace("ns").component("backend")
+        client = rcomp.endpoint("generate").client()
+        await client.start()
+        await client.wait_for_instances()
+        router = await KvRouter(rcomp, client, block_size=PAGE,
+                                scrape_interval_s=0.05).start()
+
+        prompt = list(range(200, 232))  # 32 tokens = 4 full pages
+        async for _ in await client.direct(pre_request("warmup", prompt),
+                                           "warm"):
+            pass
+        await asyncio.sleep(0.3)  # event + metrics propagation
+
+        scores = router.find_matches_for_tokens(prompt).scores
+        assert scores.get("warm", 0) >= 3, scores
+        assert "cold" not in scores
+        # KV-aware choice sends the matching prompt back to the warm worker
+        assert await router.schedule(prompt) == "warm"
+
+        await router.stop()
+        await worker.stop()
+        await worker2.stop()
+        for rt in (rrt, wrt, wrt2):
+            await rt.shutdown()
+
+    asyncio.run(main())
+
+
+def test_client_stop_aborts_engine_request():
+    async def main():
+        plane = MemoryPlane()
+        wrt = await DistributedRuntime.create_local(plane, "w1")
+        engine = make_engine()
+        worker = await NativeEngineWorker(engine).start()
+        await serve_llm_worker(wrt, "ns", "backend", worker)
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("backend").endpoint(
+            "generate").client()
+        await client.start()
+        ctx = Context()
+        prompt = list(range(10, 26))
+        count = 0
+        async for frame in await client.generate(
+                pre_request("r1", prompt, max_tokens=200), ctx):
+            count += frame and 1
+            if count == 3:
+                ctx.stop_generating()
+        await asyncio.sleep(0.3)
+        # engine slot freed (abort reached the worker)
+        m = engine.metrics()
+        assert m.request_active_slots == 0
+        assert m.num_requests_waiting == 0
+        await worker.stop()
+        await crt.shutdown()
+        await wrt.shutdown()
+
+    asyncio.run(main())
