@@ -124,7 +124,9 @@ class ModelWatcher:
     async def _on_delete(self, key: str) -> None:
         parts = key[len(MODELS_PREFIX):].split("/", 1)
         if len(parts) == 2:
-            self.models.remove(parts[1])
+            # only deregister the deleted key's model_type: the same name may
+            # still be registered under the other type (separate KV key)
+            self.models.remove(parts[1], model_type=parts[0])
         owned = self._owned.pop(key, None)
         if owned:
             client, router = owned

@@ -96,7 +96,7 @@ class HttpServer:
 
     async def start(self) -> "HttpServer":
         self._server = await asyncio.start_server(
-            self._serve_conn, self.host, self.port)
+            self._serve_conn, self.host, self.port, limit=MAX_HEADER)
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
         return self
@@ -157,6 +157,8 @@ class HttpServer:
         try:
             length = int(headers.get("content-length", "0"))
         except ValueError:
+            raise HttpError(400, "invalid content-length")
+        if length < 0:
             raise HttpError(400, "invalid content-length")
         if length > MAX_BODY:
             raise HttpError(400, "body too large")

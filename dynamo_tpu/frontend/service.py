@@ -54,9 +54,11 @@ class ModelManager:
         if model_type in ("completion", "both"):
             self.completion[name] = engine
 
-    def remove(self, name: str) -> None:
-        self.chat.pop(name, None)
-        self.completion.pop(name, None)
+    def remove(self, name: str, model_type: str = "both") -> None:
+        if model_type in ("chat", "both"):
+            self.chat.pop(name, None)
+        if model_type in ("completion", "both"):
+            self.completion.pop(name, None)
 
     def list_models(self) -> ModelList:
         names = sorted(set(self.chat) | set(self.completion))
@@ -146,7 +148,15 @@ class HttpService:
         ctx = Context()
         self._inflight.inc(model)
 
+        finished = False
+
         def finish(status: str):
+            # idempotent: also reachable from the stream-guard aclose path
+            # when the SSE generator is closed before its first iteration
+            nonlocal finished
+            if finished:
+                return
+            finished = True
             self._inflight.dec(model)
             self._requests.inc(model, endpoint, request_type, status)
             self._duration.observe(model, value=time.perf_counter() - t0)
@@ -195,7 +205,34 @@ class HttpService:
                 ctx.stop_generating()
                 finish(status)
 
-        return StreamingResponse(sse_gen())
+        def on_close():
+            # closing a never-started generator skips its finally block; make
+            # sure the inflight gauge and request counters still settle
+            ctx.stop_generating()
+            finish("disconnect")
+
+        return StreamingResponse(_GuardedGen(sse_gen(), on_close))
+
+
+class _GuardedGen:
+    """Async-gen wrapper whose aclose() runs cleanup even when the wrapped
+    generator was never iterated (plain aclose() would skip its body)."""
+
+    def __init__(self, gen, on_close):
+        self.gen = gen
+        self.on_close = on_close
+
+    def __aiter__(self):
+        return self
+
+    def __anext__(self):
+        return self.gen.__anext__()
+
+    async def aclose(self):
+        try:
+            await self.gen.aclose()
+        finally:
+            self.on_close()
 
 
 async def _ensure_aiter(maybe_coro):

@@ -126,23 +126,21 @@ class KvMetricsAggregator:
                 continue
             workers[worker_id] = m
             self._last_scraped[worker_id] = dataclasses.replace(m)
-        removed = set(self.endpoints.workers) - set(workers)
         # a live instance that failed this scrape resumes from its last
-        # *pristine* snapshot (not the bump-mutated working copy)
-        for worker_id in removed & set(self.client.instances):
+        # *pristine* snapshot (not the bump-mutated working copy); one that
+        # never published stats is still routable, with unit totals so the
+        # scheduler's optimistic bump has teeth (zero totals would make it
+        # look permanently idle and attract the whole request stream between
+        # scrapes). Either way a live instance must never count as removed —
+        # removal purges its radix-index entries.
+        for worker_id in set(self.client.instances) - set(workers):
             last = self._last_scraped.get(worker_id)
-            if last is not None:
-                workers[worker_id] = dataclasses.replace(last)
-                removed.discard(worker_id)
+            workers[worker_id] = (dataclasses.replace(last)
+                                  if last is not None else WorkerMetrics(
+                                      request_total_slots=1, kv_total_blocks=1))
+        removed = set(self.endpoints.workers) - set(workers)
         for worker_id in removed:
             self._last_scraped.pop(worker_id, None)
-        # a live instance that never published stats is still routable, with
-        # unit totals so the scheduler's optimistic bump has teeth (zero
-        # totals would make it look permanently idle and attract the whole
-        # request stream between scrapes)
-        for worker_id in set(self.client.instances) - set(workers):
-            workers[worker_id] = WorkerMetrics(
-                request_total_slots=1, kv_total_blocks=1)
         self.endpoints = ProcessedEndpoints(workers)
         for cb in self._listeners:
             cb(self.endpoints, removed)

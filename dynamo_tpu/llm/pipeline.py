@@ -51,8 +51,11 @@ class Pipeline:
         gen = ChatDeltaGenerator(request.model)
         post = BackendPostprocessor(self.preprocessor.tokenizer,
                                     pre.stop.stop or ())
-        want_usage = bool(request.stream_options
-                          and request.stream_options.get("include_usage"))
+        # non-streaming responses always carry usage (OpenAI API behavior);
+        # streaming only on stream_options.include_usage
+        want_usage = not request.stream or bool(
+            request.stream_options
+            and request.stream_options.get("include_usage"))
         async for chunk in self._drive(pre, context, gen, post, want_usage):
             yield chunk
 
@@ -63,7 +66,8 @@ class Pipeline:
         gen = CompletionDeltaGenerator(request.model)
         post = BackendPostprocessor(self.preprocessor.tokenizer,
                                     pre.stop.stop or ())
-        async for chunk in self._drive(pre, context, gen, post, False):
+        async for chunk in self._drive(pre, context, gen, post,
+                                       not request.stream):
             yield chunk
 
     async def _drive(self, pre: PreprocessedRequest, context: Context,

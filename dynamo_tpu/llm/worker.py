@@ -145,6 +145,9 @@ class NativeEngineWorker(AsyncEngine):
                     q.put_nowait(EngineOutput(
                         finish_reason=FinishReason.ERROR))
                 self._queues.clear()
+                # requests staged during the failing step have no consumer
+                # anymore — drop them so they never occupy an engine slot
+                self._pending_adds.clear()
                 continue
             for ev in outputs:
                 q = self._queues.get(ev.request_id)
@@ -166,17 +169,16 @@ class NativeEngineWorker(AsyncEngine):
         pre = PreprocessedRequest.model_validate(request)
         q: asyncio.Queue = asyncio.Queue()
         self._queues[pre.request_id] = q
+        stop = asyncio.create_task(context.wait_stopped())
         try:
             self._pending_adds.append(_to_engine_request(pre))
             self._wake.set()
             while True:
                 get = asyncio.create_task(q.get())
-                stop = asyncio.create_task(context.wait_stopped())
-                done, pending = await asyncio.wait(
+                done, _ = await asyncio.wait(
                     {get, stop}, return_when=asyncio.FIRST_COMPLETED)
-                for t in pending:
-                    t.cancel()
                 if stop in done and get not in done:
+                    get.cancel()
                     self._pending_aborts.append(pre.request_id)
                     self._wake.set()
                     yield EngineOutput(
@@ -188,6 +190,7 @@ class NativeEngineWorker(AsyncEngine):
                 if frame.finish_reason is not None:
                     return
         finally:
+            stop.cancel()
             self._queues.pop(pre.request_id, None)
 
     # -- stats ----------------------------------------------------------------

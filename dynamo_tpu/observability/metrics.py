@@ -43,27 +43,12 @@ class _Metric:
         self.help = help_
         self.label_names = tuple(label_names)
         self._lock = threading.Lock()
+        self._values: Dict[LabelKey, float] = {}
 
     def _check(self, labels: LabelKey):
         if len(labels) != len(self.label_names):
             raise ValueError(
                 f"{self.name}: expected labels {self.label_names}, got {labels}")
-
-
-class Counter(_Metric):
-    kind = "counter"
-
-    def __init__(self, name, help_, label_names=()):
-        super().__init__(name, help_, label_names)
-        self._values: Dict[LabelKey, float] = {}
-
-    def inc(self, *labels: str, value: float = 1.0) -> None:
-        self._check(labels)
-        with self._lock:
-            self._values[labels] = self._values.get(labels, 0.0) + value
-
-    def get(self, *labels: str) -> float:
-        return self._values.get(labels, 0.0)
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -76,12 +61,20 @@ class Counter(_Metric):
         return out
 
 
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, *labels: str, value: float = 1.0) -> None:
+        self._check(labels)
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + value
+
+    def get(self, *labels: str) -> float:
+        return self._values.get(labels, 0.0)
+
+
 class Gauge(_Metric):
     kind = "gauge"
-
-    def __init__(self, name, help_, label_names=()):
-        super().__init__(name, help_, label_names)
-        self._values: Dict[LabelKey, float] = {}
 
     def set(self, *labels: str, value: float) -> None:
         self._check(labels)
@@ -98,16 +91,6 @@ class Gauge(_Metric):
 
     def get(self, *labels: str) -> float:
         return self._values.get(labels, 0.0)
-
-    def render(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}",
-               f"# TYPE {self.name} {self.kind}"]
-        for labels, v in sorted(self._values.items()):
-            out.append(f"{self.name}"
-                       f"{_fmt_labels(self.label_names, labels)} {_fmt_value(v)}")
-        if not self._values and not self.label_names:
-            out.append(f"{self.name} 0")
-        return out
 
 
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
@@ -172,20 +155,13 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help_: str = "", label_names=(),
                   buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = Histogram(name, help_, label_names, buckets)
-                self._metrics[name] = m
-            elif not isinstance(m, Histogram):
-                raise TypeError(f"{name} already registered as {m.kind}")
-            return m
+        return self._get_or_make(Histogram, name, help_, label_names, buckets)
 
-    def _get_or_make(self, cls, name, help_, label_names):
+    def _get_or_make(self, cls, name, help_, label_names, *args):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = cls(name, help_, label_names)
+                m = cls(name, help_, label_names, *args)
                 self._metrics[name] = m
             elif not isinstance(m, cls):
                 raise TypeError(f"{name} already registered as {m.kind}")

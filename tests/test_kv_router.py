@@ -312,3 +312,30 @@ class TestRouterEndToEnd:
             await worker_rts[0].shutdown()
 
         asyncio.run(main())
+
+
+class TestAggregatorStatlessWorkers:
+    def test_live_statless_instance_never_counts_removed(self):
+        """A live instance whose $STATS scrape fails (e.g. an engine with no
+        stats handler) must NOT appear in the aggregator's `removed` set —
+        removal purges the worker's radix-index entries, which made KV
+        routing effectively random (regression: scrape_once computed
+        `removed` before the live-instance fallback)."""
+        class FakeClient:
+            instances = {"wa": {}, "wb": {}}
+            async def scrape_stats(self, timeout=2.0):
+                return {}  # nobody answers $STATS
+
+        async def main():
+            agg = KvMetricsAggregator(FakeClient(), interval_s=999)
+            removed_log = []
+            agg.on_update(lambda eps, removed: removed_log.append(set(removed)))
+            for _ in range(3):
+                eps = await agg.scrape_once()
+            assert set(eps.workers) == {"wa", "wb"}
+            assert all(r == set() for r in removed_log), removed_log
+            # fallback metrics keep the optimistic bump meaningful
+            assert eps.workers["wa"].request_total_slots == 1
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            main())
