@@ -1,0 +1,21 @@
+"""Disaggregated prefill/decode serving (SURVEY.md §2.6/§2.7/§3.3).
+
+The reference splits long prefills onto dedicated prefill engines: the decode
+worker allocates all KV blocks up-front, enqueues a RemotePrefillRequest on a
+durable queue, and the prefill worker writes KV straight into the decode
+engine's memory over RDMA (NIXL), then notifies. Here the transport is the
+TPU interconnect: KV pages move between the prefill and decode meshes as
+sharded jax arrays (`jax.device_put` across meshes = ICI/DCN transfer +
+relayout), with the same queue/notify control flow.
+"""
+from dynamo_tpu.disagg.protocols import PrefillCompletion, RemotePrefillRequest
+from dynamo_tpu.disagg.queue import PrefillQueue
+from dynamo_tpu.disagg.router import DisaggregatedRouter
+from dynamo_tpu.disagg.transfer import LocalTransferBackend, TransferBackend
+from dynamo_tpu.disagg.worker import DisaggDecodeWorker, PrefillWorker
+
+__all__ = [
+    "RemotePrefillRequest", "PrefillCompletion", "PrefillQueue",
+    "DisaggregatedRouter", "TransferBackend", "LocalTransferBackend",
+    "DisaggDecodeWorker", "PrefillWorker",
+]

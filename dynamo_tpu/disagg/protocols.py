@@ -1,0 +1,47 @@
+"""Wire types for disaggregated prefill.
+
+Counterparts of the reference's RemotePrefillRequest / MemoryTransferRequest
+(reference: the vLLM patch's remote_prefill.py — engine_id, request_id,
+prompt_token_ids, sampling_params, block_ids, computed_block_ids; SURVEY.md
+§2.7) plus the completion notification that NIXL delivers via send_notif
+(reference: SURVEY.md §3.3).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import pydantic
+
+from dynamo_tpu.protocols.common import SamplingOptions, StopConditions
+
+
+class RemotePrefillRequest(pydantic.BaseModel):
+    """Enqueued by the decode worker; consumed by a prefill worker."""
+
+    engine_id: str            # decode worker id (transfer + notify target)
+    request_id: str
+    token_ids: List[int]
+    sampling: SamplingOptions = SamplingOptions()
+    stop: StopConditions = StopConditions()
+    # decode-side page ids covering the full prompt, in sequence order
+    page_ids: List[int]
+    # leading tokens already valid decode-side (prefix-cache hit); the
+    # corresponding leading pages are NOT transferred (reference:
+    # computed_block_ids semantics)
+    num_cached_tokens: int = 0
+    page_size: int = 0        # decode engine page size (must match prefill)
+    # fully-qualified messaging subject for the PrefillCompletion notify
+    notify_subject: str = ""
+
+
+class PrefillCompletion(pydantic.BaseModel):
+    """Published on `completion_subject(engine_id)` after the KV pages have
+    been injected into the decode engine."""
+
+    request_id: str
+    first_token: Optional[int] = None   # sampled by the prefill engine
+    error: Optional[str] = None
+
+
+def completion_subject(engine_id: str) -> str:
+    return f"disagg.prefill_done.{engine_id}"

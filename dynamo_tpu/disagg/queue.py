@@ -1,0 +1,38 @@
+"""Durable prefill work queue.
+
+Role of the reference's NATS JetStream prefill queue (reference:
+examples/llm/utils/prefill_queue.py:25-56, nats_queue.py): decode workers
+enqueue RemotePrefillRequests, any prefill worker dequeues — the queue load-
+balances prefill work and survives worker churn (elastic xPyD, reference:
+docs/disagg_serving.md:95-101). Rides the runtime Messaging queue primitives
+(memory plane in-process, control-plane server across processes).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from dynamo_tpu.disagg.protocols import RemotePrefillRequest
+
+
+def queue_name(namespace: str, model: str) -> str:
+    return f"{namespace}.prefill_queue.{model or 'default'}"
+
+
+class PrefillQueue:
+    def __init__(self, messaging, namespace: str, model: str = ""):
+        self.messaging = messaging
+        self.name = queue_name(namespace, model)
+
+    async def enqueue(self, req: RemotePrefillRequest) -> None:
+        await self.messaging.queue_push(
+            self.name, req.model_dump_json().encode())
+
+    async def dequeue(self, timeout: Optional[float] = None
+                      ) -> Optional[RemotePrefillRequest]:
+        payload = await self.messaging.queue_pop(self.name, timeout=timeout)
+        if payload is None:
+            return None
+        return RemotePrefillRequest.model_validate_json(payload)
+
+    async def depth(self) -> int:
+        return await self.messaging.queue_depth(self.name)

@@ -1,0 +1,66 @@
+"""Local-vs-remote prefill decision.
+
+Mirrors the reference's two-condition policy: prefill goes remote iff the
+un-cached prefill work is long enough AND the prefill queue is not backed up
+(reference: lib/llm/src/disagg_router.rs:24-259 for the length condition with
+a live-reloadable etcd threshold; examples/llm/components/disagg_router.py
+adds the queue-depth condition).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+log = logging.getLogger("dynamo_tpu.disagg")
+
+
+def config_key(model: str) -> str:
+    """Discovery-store key watched for live threshold updates (reference:
+    etcd key public/components/disagg_router/models/chat/{model},
+    disagg_router.rs:38-141)."""
+    return f"public/components/disagg_router/models/{model or 'default'}"
+
+
+class DisaggregatedRouter:
+    def __init__(self, max_local_prefill_length: int = 1000,
+                 max_prefill_queue_size: int = 2, model: str = ""):
+        self.max_local_prefill_length = max_local_prefill_length
+        self.max_prefill_queue_size = max_prefill_queue_size
+        self.model = model
+
+    def prefill_remote(self, prefill_length: int, prefix_hit_length: int,
+                       queue_depth: int) -> bool:
+        long_enough = (prefill_length - prefix_hit_length
+                       > self.max_local_prefill_length)
+        queue_ok = queue_depth < self.max_prefill_queue_size
+        return long_enough and queue_ok
+
+    # -- live config reload ---------------------------------------------------
+
+    async def watch_config(self, kv) -> None:
+        """Follow threshold updates from the discovery store until cancelled."""
+        key = config_key(self.model)
+        snapshot, events = await kv.watch_prefix(key)
+        for entry in snapshot:
+            self._apply(entry.value)
+        async for ev in events:
+            if ev.kind == "put" and ev.value is not None:
+                self._apply(ev.value)
+
+    def start_watching(self, kv) -> asyncio.Task:
+        return asyncio.create_task(self.watch_config(kv))
+
+    def _apply(self, raw: bytes) -> None:
+        try:
+            cfg = json.loads(raw)
+        except (ValueError, TypeError):
+            log.warning("ignoring malformed disagg config: %r", raw[:100])
+            return
+        if "max_local_prefill_length" in cfg:
+            self.max_local_prefill_length = int(cfg["max_local_prefill_length"])
+        if "max_prefill_queue_size" in cfg:
+            self.max_prefill_queue_size = int(cfg["max_prefill_queue_size"])
+        log.info("disagg thresholds: local<=%d queue<%d",
+                 self.max_local_prefill_length, self.max_prefill_queue_size)

@@ -1,0 +1,81 @@
+"""KV page transfer between prefill and decode engines.
+
+This is the role the reference fills with NIXL (UCX/RDMA one-sided reads and
+writes into decode VRAM, plus a Triton relayout kernel when prefill TP !=
+decode TP — reference: the vLLM patch's nixl.py + kv_rearrange.py, SURVEY.md
+§2.7). TPU-native replacement: extracted pages are sharded jax arrays;
+`jax.device_put` onto the decode engine's mesh + cache sharding IS the
+transfer (XLA moves the bytes over ICI/DCN) AND the relayout (resharding
+between different tp layouts replaces kv_rearrange) in one step.
+
+Backends:
+- LocalTransferBackend: prefill and decode engines live in this process (one
+  host driving both meshes); device_put crosses meshes directly.
+- The cross-process path rides the same interface: a remote backend serializes
+  pages host-side and ships them over the runtime data plane (see
+  dynamo_tpu/disagg/remote_transfer.py when present); the control flow
+  (queue -> transfer -> notify) is identical.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Dict
+
+import jax
+
+
+class TransferBackend(abc.ABC):
+    """Writes KV pages into a decode engine identified by engine_id."""
+
+    @abc.abstractmethod
+    async def send_pages(self, engine_id: str, request_id: str, dst_page_ids,
+                         k_pages, v_pages) -> None:
+        """Inject pages (k/v: [L, Hkv, Nb, ps, hd] on the sender's mesh)
+        into the target engine's cache at dst_page_ids.
+
+        Raises if request_id is no longer pending on the target (the decode
+        side timed out and released the pages — injecting would corrupt
+        whatever they were reallocated to)."""
+
+
+class LocalTransferBackend(TransferBackend):
+    """In-process registry of decode workers, one host driving both meshes.
+
+    Matches the reference's NixlMetadataStore role (engine_id -> transfer
+    target, reference: examples/llm/utils/nixl.py:57-105) with the registry
+    itself standing in for the etcd-published agent metadata.
+    """
+
+    def __init__(self):
+        self._receivers: Dict[str, object] = {}
+
+    def register(self, engine_id: str, worker) -> None:
+        """worker: a NativeEngineWorker wrapping the decode engine."""
+        self._receivers[engine_id] = worker
+
+    def unregister(self, engine_id: str) -> None:
+        self._receivers.pop(engine_id, None)
+
+    async def send_pages(self, engine_id: str, request_id: str, dst_page_ids,
+                         k_pages, v_pages) -> None:
+        worker = self._receivers.get(engine_id)
+        if worker is None:
+            raise KeyError(f"unknown decode engine {engine_id!r}")
+        # The cross-mesh move + relayout: place the pages with the decode
+        # engine's cache sharding (ICI/DCN transfer; resharding handles
+        # prefill-TP != decode-TP, the kv_rearrange equivalent).
+        shd = worker.engine.cache_sharding
+        k = jax.device_put(k_pages, shd)
+        v = jax.device_put(v_pages, shd)
+        ids = list(dst_page_ids)
+
+        def inject(eng):
+            # guard against decode-side timeout/release: the pages may have
+            # been reallocated to another request
+            if request_id not in eng.scheduler.remote:
+                raise KeyError(
+                    f"request {request_id!r} no longer pending on "
+                    f"{engine_id!r}")
+            eng.inject_pages(ids, k, v)
+
+        await worker.submit(inject)

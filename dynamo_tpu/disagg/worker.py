@@ -1,0 +1,328 @@
+"""Disaggregated decode + prefill workers.
+
+Decode side (DisaggDecodeWorker, reference: examples/llm/components/
+worker.py:149-198 VllmWorker.generate): per request decide local vs remote
+prefill; remote = allocate decode pages up-front, enqueue a
+RemotePrefillRequest, keep serving other requests while the prefill engine
+works, then splice the request into the decode batch when the KV lands.
+
+Prefill side (PrefillWorker, reference: examples/llm/components/
+prefill_worker.py:38-155): dequeue loop; run prefill-only on the local
+engine, push the KV pages into the decode engine over the transfer backend,
+notify completion with the first sampled token.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from dynamo_tpu.disagg.protocols import (
+    PrefillCompletion, RemotePrefillRequest, completion_subject,
+)
+from dynamo_tpu.disagg.queue import PrefillQueue
+from dynamo_tpu.disagg.router import DisaggregatedRouter
+from dynamo_tpu.disagg.transfer import TransferBackend
+from dynamo_tpu.engine.scheduler import EngineRequest
+from dynamo_tpu.llm.worker import NativeEngineWorker, _to_engine_request
+from dynamo_tpu.protocols.common import (
+    EngineOutput, FinishReason, PreprocessedRequest,
+)
+from dynamo_tpu.runtime.engine import Context
+
+log = logging.getLogger("dynamo_tpu.disagg")
+
+
+class DisaggDecodeWorker(NativeEngineWorker):
+    """Decode worker with conditional remote prefill."""
+
+    def __init__(self, engine, messaging, disagg_router: DisaggregatedRouter,
+                 prefill_queue: PrefillQueue, component=None,
+                 worker_id: str = "", prefill_timeout_s: float = 120.0,
+                 **kwargs):
+        super().__init__(engine, component=component, worker_id=worker_id,
+                         **kwargs)
+        self.messaging = messaging
+        self.disagg_router = disagg_router
+        self.prefill_queue = prefill_queue
+        self.engine_id = worker_id or f"decode-{id(self):x}"
+        self.prefill_timeout_s = prefill_timeout_s
+        self.notify_subject = completion_subject(self.engine_id)
+        self._completions: dict[str, asyncio.Future] = {}
+        self._notify_task: asyncio.Task | None = None
+        # counters surfaced through worker stats
+        self.remote_prefills = 0
+        self.local_prefills = 0
+
+    async def start(self):
+        await super().start()
+        # subscribe BEFORE returning so a completion published right after
+        # start (or before our first remote request) cannot be dropped
+        sub = await self.messaging.subscribe(self.notify_subject)
+        self._notify_task = asyncio.create_task(self._notify_loop(sub))
+        return self
+
+    async def stop(self):
+        if self._notify_task:
+            self._notify_task.cancel()
+            try:
+                await self._notify_task
+            except asyncio.CancelledError:
+                pass
+            self._notify_task = None
+        await super().stop()
+
+    async def _notify_loop(self, sub):
+        async for _subject, payload in sub:
+            try:
+                done = PrefillCompletion.model_validate_json(payload)
+            except Exception:
+                log.exception("malformed prefill completion: %r",
+                              payload[:200])
+                continue
+            fut = self._completions.pop(done.request_id, None)
+            if fut is not None and not fut.done():
+                fut.set_result(done)
+
+    # -- request path ---------------------------------------------------------
+
+    async def generate(self, request, context: Context):
+        pre = PreprocessedRequest.model_validate(request)
+        req = _to_engine_request(pre)
+        use_remote = False
+        # fast path: a short prompt can never go remote (prefix hits only
+        # shrink the uncached length) — skip the engine/queue round trips
+        maybe_remote = (len(req.prompt)
+                        > self.disagg_router.max_local_prefill_length)
+        if maybe_remote:
+            try:
+                prefix_hit = await self.submit(
+                    lambda eng: eng.scheduler.peek_prefix(req.prompt))
+                depth = await self.prefill_queue.depth()
+                use_remote = self.disagg_router.prefill_remote(
+                    len(req.prompt), prefix_hit, depth)
+            except Exception:
+                log.exception("disagg decision failed; prefilling locally")
+        if not use_remote:
+            self.local_prefills += 1
+            async for frame in super().generate(request, context):
+                yield frame
+            return
+        async for frame in self._generate_remote(pre, req, context):
+            yield frame
+
+    async def _generate_remote(self, pre: PreprocessedRequest,
+                               req: EngineRequest, context: Context):
+        rid = req.request_id
+        alloc = await self.submit(lambda eng: eng.allocate_remote(req))
+        if alloc is None:
+            # no pages free right now: local path applies backpressure
+            log.info("remote alloc failed for %s; local fallback", rid)
+            self.local_prefills += 1
+            async for frame in super().generate(
+                    pre.model_dump(exclude_none=True), context):
+                yield frame
+            return
+        # until the seq is released or activated, any exit (incl. client
+        # closing the stream mid-wait) must free the up-front allocation —
+        # a staged abort covers remote/waiting/running states alike
+        holding = True
+        try:
+            self.remote_prefills += 1
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._completions[rid] = fut
+            await self.prefill_queue.enqueue(RemotePrefillRequest(
+                engine_id=self.engine_id,
+                request_id=rid,
+                token_ids=list(pre.token_ids),
+                sampling=pre.sampling,
+                stop=pre.stop,
+                page_ids=alloc.page_ids,
+                num_cached_tokens=alloc.num_cached_tokens,
+                page_size=self.engine.cfg.page_size,
+                notify_subject=self.notify_subject,
+            ))
+            stop_task = asyncio.create_task(context.wait_stopped())
+            try:
+                await asyncio.wait(
+                    {asyncio.ensure_future(fut), stop_task},
+                    timeout=self.prefill_timeout_s,
+                    return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                stop_task.cancel()
+            self._completions.pop(rid, None)
+            if context.is_stopped:
+                yield EngineOutput(
+                    finish_reason=FinishReason.CANCELLED).model_dump(
+                        exclude_none=True)
+                return
+            completion = fut.result() if fut.done() else None
+            if completion is None or completion.error:
+                # remote prefill failed or timed out: recompute locally
+                log.warning("remote prefill failed for %s (%s); local "
+                            "fallback", rid,
+                            completion.error if completion else "timeout")
+                await self.submit(lambda eng: eng.release_remote(rid))
+                holding = False
+                self.local_prefills += 1
+                async for frame in super().generate(
+                        pre.model_dump(exclude_none=True), context):
+                    yield frame
+                return
+            # KV pages are already injected (transfer happens before notify).
+            first = int(completion.first_token)
+            p = req.params
+            # same stop semantics as the local path (_postprocess): hidden
+            # stop ids and eos are never emitted
+            hidden_stop = first in p.stop_token_ids
+            eos = (not p.ignore_eos) and first in self.engine.eos_token_ids
+            if hidden_stop or eos or p.max_tokens <= 1:
+                reason = (FinishReason.STOP if (hidden_stop or eos)
+                          else FinishReason.LENGTH)
+                await self.submit(lambda eng: eng.release_remote(rid))
+                holding = False
+                if not (hidden_stop or eos):
+                    yield EngineOutput(token_ids=[first]).model_dump(
+                        exclude_none=True)
+                yield EngineOutput(finish_reason=reason).model_dump(
+                    exclude_none=True)
+                return
+            yield EngineOutput(token_ids=[first]).model_dump(
+                exclude_none=True)
+            q = self._register(rid)
+            try:
+                await self.submit(
+                    lambda eng: eng.activate_remote(rid, first))
+                async for frame in self._stream(rid, context, q):
+                    yield frame
+                holding = False  # _stream owns cleanup from activation on
+            finally:
+                self._queues.pop(rid, None)
+        finally:
+            self._completions.pop(rid, None)
+            if holding:
+                self._pending_aborts.append(rid)
+                self._wake.set()
+
+    def stats_handler(self) -> dict:
+        stats = super().stats_handler()
+        stats["disagg"] = {"remote_prefills": self.remote_prefills,
+                           "local_prefills": self.local_prefills}
+        return stats
+
+
+class PrefillWorker:
+    """Queue consumer running prefill-only requests on its own engine."""
+
+    def __init__(self, worker: NativeEngineWorker, queue: PrefillQueue,
+                 transfer: TransferBackend, messaging,
+                 dequeue_timeout_s: float = 1.0, max_inflight: int = 4):
+        self.worker = worker
+        self.queue = queue
+        self.transfer = transfer
+        self.messaging = messaging
+        self.dequeue_timeout_s = dequeue_timeout_s
+        # cap concurrent handlers so excess work stays in the durable queue,
+        # where queue_depth() feeds the disagg routers' backpressure signal
+        self._slots = asyncio.Semaphore(max_inflight)
+        self._loop_task: asyncio.Task | None = None
+        self._inflight: set[asyncio.Task] = set()
+        self.completed = 0
+        self.failed = 0
+
+    async def start(self) -> "PrefillWorker":
+        await self.worker.start()
+        self._loop_task = asyncio.create_task(self._consume())
+        return self
+
+    async def stop(self) -> None:
+        if self._loop_task:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+            self._loop_task = None
+        for t in list(self._inflight):
+            t.cancel()
+        await self.worker.stop()
+
+    async def _consume(self) -> None:
+        while True:
+            await self._slots.acquire()  # before dequeue: backpressure stays
+            try:                         # visible in the queue depth
+                req = await self.queue.dequeue(timeout=self.dequeue_timeout_s)
+            except asyncio.CancelledError:
+                self._slots.release()
+                raise
+            except Exception:
+                self._slots.release()
+                log.exception("prefill dequeue failed; retrying")
+                await asyncio.sleep(0.5)
+                continue
+            if req is None:
+                self._slots.release()
+                continue
+            # handle concurrently: the engine interleaves chunked prefills,
+            # so a long prefill doesn't head-of-line-block the queue
+            task = asyncio.create_task(self._handle(req))
+            self._inflight.add(task)
+
+            def done(t, task=task):
+                self._inflight.discard(task)
+                self._slots.release()
+
+            task.add_done_callback(done)
+
+    async def _handle(self, req: RemotePrefillRequest) -> None:
+        rid = req.request_id
+        try:
+            eng_ps = self.worker.engine.cfg.page_size
+            if req.page_size != eng_ps:
+                raise ValueError(
+                    f"page size mismatch: decode {req.page_size} != "
+                    f"prefill {eng_ps}")
+            q = self.worker._register(rid)
+            try:
+                pre = PreprocessedRequest(
+                    request_id=rid, token_ids=req.token_ids,
+                    sampling=req.sampling, stop=req.stop)
+                er = _to_engine_request(pre)
+                er.prefill_only = True
+                self.worker._pending_adds.append(er)
+                self.worker._wake.set()
+                frame: EngineOutput = await q.get()
+            finally:
+                self.worker._queues.pop(rid, None)
+            if frame.finish_reason != FinishReason.PREFILL_DONE:
+                raise RuntimeError(
+                    f"prefill ended with {frame.finish_reason}: {frame.text}")
+            first_token = frame.token_ids[0]
+            # ship only the pages the decode side doesn't already have
+            start_page = req.num_cached_tokens // eng_ps
+            def extract(eng):
+                seq = eng.scheduler.parked[rid]
+                return eng.extract_pages(seq.pages[start_page:])
+            pages = await self.worker.submit(extract)
+            await self.transfer.send_pages(
+                req.engine_id, rid, req.page_ids[start_page:],
+                pages["k"], pages["v"])
+            await self.worker.submit(lambda eng: eng.release_parked(rid))
+            self.completed += 1
+            await self._notify(req, PrefillCompletion(
+                request_id=rid, first_token=first_token))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.exception("remote prefill %s failed", rid)
+            self.failed += 1
+            await self.worker.submit(lambda eng: eng.abort(rid))
+            await self._notify(req, PrefillCompletion(
+                request_id=rid, error=str(e)))
+
+    async def _notify(self, req: RemotePrefillRequest,
+                      done: PrefillCompletion) -> None:
+        try:
+            await self.messaging.publish(
+                req.notify_subject, done.model_dump_json().encode())
+        except Exception:
+            log.exception("completion notify failed for %s", req.request_id)

@@ -32,6 +32,10 @@ class ModelConfig:
     # MoE (Mixtral-style); num_experts == 0 means dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # decode attention impl: "auto" (Pallas kernel on TPU, XLA gather
+    # elsewhere), "on", "off", "interpret" (kernel in interpreter mode, for
+    # CPU tests). The engine forces "off" on multi-device meshes.
+    decode_kernel: str = "auto"
     # Multimodal (Qwen2-VL-style); None means text-only.
     vision: Optional["VisionConfig"] = None
 

@@ -28,6 +28,7 @@ from dynamo_tpu.engine.kv_cache import SequenceState
 from dynamo_tpu.engine.sampler import make_keys, sample
 from dynamo_tpu.engine.scheduler import (
     DecodePlan, EngineRequest, PrefillPlan, SamplingParams, Scheduler,
+    next_bucket,
 )
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.llama import AttnMetadata
@@ -56,9 +57,13 @@ class NativeEngine:
         eos_token_ids: Optional[Set[int]] = None,
         seed: int = 0,
     ):
+        self.mesh = mesh if mesh is not None else single_device_mesh()
+        if self.mesh.size > 1 and model_cfg.decode_kernel != "off":
+            # pallas_call can't be auto-partitioned by jit; use the XLA
+            # gather path until the kernel is wrapped in shard_map
+            model_cfg = dataclasses.replace(model_cfg, decode_kernel="off")
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
-        self.mesh = mesh if mesh is not None else single_device_mesh()
         self.eos_token_ids = set(eos_token_ids or ())
         self.scheduler = Scheduler(engine_cfg)
         self.step_count = 0
@@ -90,6 +95,16 @@ class NativeEngine:
             functools.partial(_engine_step, model_cfg,
                               tuple(sorted(self.eos_token_ids))),
             donate_argnums=(1,))
+        # disaggregation: whole-page gather/scatter on the
+        # [L, Hkv, P, ps, hd] cache (the TPU equivalent of the reference's
+        # NIXL read/write_blocks, SURVEY.md §2.7); ids are bucketed,
+        # out-of-range ids are dropped
+        self._extract_fn = jax.jit(_extract_pages)
+        self._inject_fn = jax.jit(_inject_pages, donate_argnums=(0,))
+
+    @property
+    def cache_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, llama.cache_sharding(self.model_cfg))
 
     # -- public API ----------------------------------------------------------
 
@@ -173,6 +188,10 @@ class NativeEngine:
             plan, int(sampled[0]) if plan.is_last_chunk else None)
         if tok is None:
             return []
+        if plan.seq.prefill_only:
+            # disaggregated prefill: hand the first token to the transfer
+            # layer; stop-condition handling happens on the decode side
+            return [StepOutput(plan.seq.request_id, tok, True, "prefill_done")]
         return [self._postprocess(plan.seq, tok)]
 
     def _run_decode(self, plan: DecodePlan) -> List[StepOutput]:
@@ -198,6 +217,55 @@ class NativeEngine:
             self.scheduler.finish(seq)
         return StepOutput(seq.request_id, emit, finish is not None, finish)
 
+    # -- disaggregation ------------------------------------------------------
+
+    def allocate_remote(self, req: EngineRequest):
+        """Decode side: allocate pages up-front for a remote prefill."""
+        return self.scheduler.add_remote(req)
+
+    def activate_remote(self, request_id: str, first_token: int) -> None:
+        self.scheduler.activate_remote(request_id, first_token)
+
+    def release_remote(self, request_id: str) -> None:
+        self.scheduler.release_remote(request_id)
+
+    def release_parked(self, request_id: str) -> None:
+        self.scheduler.release_parked(request_id)
+
+    def _bucket_ids(self, page_ids) -> np.ndarray:
+        """Pad a page-id list to a bucketed static shape; padding ids point
+        past the cache so extract reads garbage that inject later drops."""
+        n = max(len(page_ids), 1)
+        nb = next_bucket(n, self.scheduler.page_buckets)
+        out = np.full((nb,), self.cfg.num_pages, np.int32)
+        out[:len(page_ids)] = page_ids
+        return out
+
+    def extract_pages(self, page_ids) -> tuple:
+        """Gather whole KV pages -> ({k,v} [L, Hkv, Nb, ps, hd], on-device)."""
+        ids = jnp.asarray(self._bucket_ids(page_ids))
+        ids = jnp.minimum(ids, self.cfg.num_pages - 1)  # clamp padding reads
+        return self._extract_fn(self.cache, ids)
+
+    def inject_pages(self, page_ids, k_pages, v_pages) -> None:
+        """Scatter whole KV pages into this engine's cache (donated update).
+
+        The caller is responsible for placing k/v on this engine's mesh with
+        cache sharding (transfer.py does the cross-mesh device_put — the
+        ICI/DCN reshard that replaces the reference's kv_rearrange kernel).
+
+        The id padding follows the SENDER's bucket (k_pages.shape[2]), not
+        ours — the two engines may have different max_model_len and hence
+        different page-count buckets; padding ids drop on scatter."""
+        nb = k_pages.shape[2]
+        if len(page_ids) > nb:
+            raise ValueError(
+                f"{len(page_ids)} dst pages but only {nb} pages sent")
+        ids = np.full((nb,), self.cfg.num_pages, np.int32)
+        ids[:len(page_ids)] = page_ids
+        self.cache = self._inject_fn(self.cache, jnp.asarray(ids),
+                                     k_pages, v_pages)
+
     # -- introspection -------------------------------------------------------
 
     def metrics(self):
@@ -205,6 +273,18 @@ class NativeEngine:
 
     def drain_kv_events(self):
         return self.scheduler.allocator.drain_events()
+
+
+def _extract_pages(cache, ids):
+    """Gather pages [L, Hkv, P, ps, hd] by ids [Nb] -> [L, Hkv, Nb, ps, hd]."""
+    return {"k": jnp.take(cache["k"], ids, axis=2),
+            "v": jnp.take(cache["v"], ids, axis=2)}
+
+
+def _inject_pages(cache, ids, k_pages, v_pages):
+    """Scatter pages into the cache at ids; out-of-range ids are dropped."""
+    return {"k": cache["k"].at[:, :, ids].set(k_pages, mode="drop"),
+            "v": cache["v"].at[:, :, ids].set(v_pages, mode="drop")}
 
 
 def _engine_step(cfg: ModelConfig, eos_ids: tuple, params, cache, tokens,

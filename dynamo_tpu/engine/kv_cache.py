@@ -171,6 +171,7 @@ class SequenceState:
     num_computed: int = 0     # tokens whose KV was computed by US this request
     output: List[int] = dataclasses.field(default_factory=list)
     slot: int = -1            # decode slot id, -1 while prefilling
+    prefill_only: bool = False  # park after prefill instead of decoding
 
     @property
     def total_len(self) -> int:

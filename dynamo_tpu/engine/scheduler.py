@@ -48,6 +48,22 @@ class EngineRequest:
     request_id: str
     prompt: List[int]
     params: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    # prefill-only: run chunked prefill, sample the first token, then park
+    # the sequence (pages held) instead of taking a decode slot — the prefill
+    # half of disaggregated serving (reference: prefill workers,
+    # examples/llm/components/prefill_worker.py:38-155).
+    prefill_only: bool = False
+
+
+@dataclasses.dataclass
+class RemoteAllocation:
+    """Decode-side up-front allocation for a remotely-prefilled request
+    (reference: the vLLM patch allocates all decode blocks before enqueueing
+    the RemotePrefillRequest, SURVEY.md §3.3)."""
+
+    request_id: str
+    page_ids: List[int]
+    num_cached_tokens: int   # prefix-hit tokens already valid decode-side
 
 
 @dataclasses.dataclass
@@ -112,6 +128,11 @@ class Scheduler:
         self.waiting: deque[SequenceState] = deque()
         self.running: List[Optional[SequenceState]] = [None] * cfg.max_slots
         self.params: Dict[str, SamplingParams] = {}
+        # disaggregation state: decode-side sequences awaiting remote prefill,
+        # and prefill-side sequences parked (prefill done, pages held) until
+        # their KV is pulled by the transfer engine
+        self.remote: Dict[str, SequenceState] = {}
+        self.parked: Dict[str, SequenceState] = {}
         ps = cfg.page_size
         self.prefill_buckets = list(cfg.prefill_buckets)
         max_pages_per_seq = -(-cfg.max_model_len // ps)
@@ -121,38 +142,111 @@ class Scheduler:
 
     # -- request lifecycle ---------------------------------------------------
 
-    def add_request(self, req: EngineRequest) -> SequenceState:
+    def _admit(self, req: EngineRequest) -> SequenceState:
+        """Validate + create + register a sequence (shared local/remote)."""
         if len(req.prompt) + req.params.max_tokens > self.cfg.max_model_len:
             raise ValueError(
                 f"request {req.request_id}: len {len(req.prompt)} + "
                 f"max_tokens {req.params.max_tokens} exceeds max_model_len "
                 f"{self.cfg.max_model_len}")
-        seq = SequenceState(request_id=req.request_id, prompt=list(req.prompt))
+        seq = SequenceState(request_id=req.request_id, prompt=list(req.prompt),
+                            prefill_only=req.prefill_only)
         self.params[req.request_id] = req.params
         self._match_prefix(seq)
+        return seq
+
+    def add_request(self, req: EngineRequest) -> SequenceState:
+        seq = self._admit(req)
         self.waiting.append(seq)
         return seq
+
+    # -- disaggregation: decode side -----------------------------------------
+
+    def peek_prefix(self, tokens: List[int]) -> int:
+        """Longest locally-cached prefix (tokens), without allocating.
+
+        Feeds the local-vs-remote prefill decision (reference:
+        disagg_router.rs:24-259 uses prefill_length - prefix_hit_length)."""
+        matches, _ = self._prefix_walk(tokens)
+        return len(matches) * self.cfg.page_size
+
+    def add_remote(self, req: EngineRequest) -> Optional[RemoteAllocation]:
+        """Allocate decode-side pages for the full prompt up-front and park
+        the sequence until the remote prefill lands (reference: SURVEY.md
+        §3.3, the vLLM patch's up-front decode block allocation).
+
+        Returns None when pages are unavailable right now (caller should fall
+        back to local prefill or retry)."""
+        seq = self._admit(req)
+        if not self._ensure_pages(seq, len(seq.prompt)):
+            # roll back: return shared prefix pages, drop params
+            self.finish(seq)
+            return None
+        self.remote[req.request_id] = seq
+        return RemoteAllocation(
+            request_id=req.request_id,
+            page_ids=list(seq.pages),
+            num_cached_tokens=seq.num_cached)
+
+    def activate_remote(self, request_id: str, first_token: int
+                        ) -> SequenceState:
+        """Remote prefill completed and its KV was injected: seed the first
+        generated token and enter the normal scheduling flow (a 1-token
+        prefill chunk writes that token's KV, then the seq takes a decode
+        slot)."""
+        seq = self.remote.pop(request_id)
+        n = len(seq.prompt)
+        seq.num_cached = n
+        seq.num_computed = n
+        seq.output.append(int(first_token))
+        self._seal_full_pages(seq)  # publish stored events for injected pages
+        self.waiting.appendleft(seq)
+        return seq
+
+    def release_remote(self, request_id: str) -> None:
+        """Abort a pending remote allocation (prefill failed / client gone)."""
+        seq = self.remote.pop(request_id, None)
+        if seq is not None:
+            self.finish(seq)
+
+    # -- disaggregation: prefill side ----------------------------------------
+
+    def release_parked(self, request_id: str) -> None:
+        """Free a parked prefill-only sequence's pages (after KV extraction).
+
+        Freed full pages enter the reuse pool keyed by content hash, so the
+        prefill worker accumulates a prefix cache for free."""
+        seq = self.parked.pop(request_id, None)
+        if seq is not None:
+            self.finish(seq)
+
+    def _prefix_walk(self, tokens: List[int]):
+        """Cached full-page prefix matches [(page_id, chained_hash)], stopping
+        at the first miss; always leaves >=1 token to recompute."""
+        from dynamo_tpu.engine.kv_cache import page_hash
+        ps = self.cfg.page_size
+        parent, out = 0, []
+        n_full = (len(tokens) - 1) // ps
+        for i in range(n_full):
+            h = page_hash(parent, tokens[i * ps:(i + 1) * ps])
+            pid = self.allocator.lookup(h)
+            if pid is None:
+                break
+            out.append((pid, h))
+            parent = h
+        return out, n_full
 
     def _match_prefix(self, seq: SequenceState) -> None:
         """Share full pages already resident (prefix cache hit)."""
         ps = self.cfg.page_size
-        parent = 0
-        all_toks = seq.all_tokens
-        n_full = (len(all_toks) - 1) // ps  # always recompute >=1 token
-        from dynamo_tpu.engine.kv_cache import page_hash
-        for i in range(n_full):
-            toks = all_toks[i * ps:(i + 1) * ps]
-            h = page_hash(parent, toks)
-            self._prefix_lookups += 1
-            pid = self.allocator.lookup(h)
-            if pid is None:
-                break
+        matches, n_full = self._prefix_walk(seq.all_tokens)
+        self._prefix_hits += len(matches)
+        self._prefix_lookups += min(len(matches) + 1, n_full)
+        for pid, h in matches:
             self.allocator.share(pid)
             seq.pages.append(pid)
             seq.page_hashes.append(h)
             seq.num_cached += ps
-            self._prefix_hits += 1
-            parent = h
 
     def finish(self, seq: SequenceState) -> None:
         if seq.slot >= 0:
@@ -173,6 +267,12 @@ class Scheduler:
             if seq is not None and seq.request_id == request_id:
                 self.finish(seq)
                 return True
+        if request_id in self.remote:
+            self.release_remote(request_id)
+            return True
+        if request_id in self.parked:
+            self.release_parked(request_id)
+            return True
         return False
 
     # -- planning ------------------------------------------------------------
@@ -221,17 +321,21 @@ class Scheduler:
             if seq.num_cached >= n_toks:
                 # fully cached prefix was trimmed to len-1 in _match_prefix
                 raise AssertionError("prefix match must leave >=1 token")
-            if self._free_slot() < 0 and \
+            if not seq.prefill_only and self._free_slot() < 0 and \
                     seq.num_cached + self.cfg.max_prefill_chunk >= n_toks:
                 # final chunk would need a decode slot; wait for one
+                # (prefill-only seqs park instead of taking a slot)
                 return None
             n = min(n_toks - seq.num_cached, self.cfg.max_prefill_chunk)
             if not self._ensure_pages(seq, seq.num_cached + n):
-                if not any(s is not None for s in self.running):
+                # only a true dead end raises: no running decode, no parked
+                # or remote sequence whose pages will be released shortly
+                if not any(s is not None for s in self.running) \
+                        and not self.parked and not self.remote:
                     raise MemoryError(
                         f"prompt of {n_toks} tokens cannot fit in "
                         f"{self.cfg.num_pages} pages of {self.cfg.page_size}")
-                return None  # memory pressure: let decodes drain
+                return None  # memory pressure: let pages drain
             self.waiting.popleft()
             return self._build_prefill(seq, n)
         return None
@@ -265,6 +369,10 @@ class Scheduler:
         self._seal_full_pages(seq)
         if plan.is_last_chunk:
             assert sampled_token is not None
+            if seq.prefill_only:
+                # park with pages held until the transfer engine extracts KV
+                self.parked[seq.request_id] = seq
+                return int(sampled_token)
             slot = self._free_slot()
             assert slot >= 0, "final prefill chunk scheduled without a free slot"
             seq.slot = slot

@@ -66,8 +66,10 @@ class Pipeline:
         gen = CompletionDeltaGenerator(request.model)
         post = BackendPostprocessor(self.preprocessor.tokenizer,
                                     pre.stop.stop or ())
-        async for chunk in self._drive(pre, context, gen, post,
-                                       not request.stream):
+        want_usage = not request.stream or bool(
+            getattr(request, "stream_options", None)
+            and request.stream_options.get("include_usage"))
+        async for chunk in self._drive(pre, context, gen, post, want_usage):
             yield chunk
 
     async def _drive(self, pre: PreprocessedRequest, context: Context,

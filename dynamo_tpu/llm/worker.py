@@ -93,6 +93,18 @@ class NativeEngineWorker(AsyncEngine):
         # runs in the executor thread
         self._pending_adds: list = []
         self._pending_aborts: list = []
+        # arbitrary staged engine ops (disagg page inject/extract/activate);
+        # run FIFO between device steps
+        self._pending_ops: list = []
+
+    def submit(self, fn) -> asyncio.Future:
+        """Stage `fn(engine)` to run between device steps; returns a future
+        resolving to its result. The only safe way to touch engine state
+        from outside the step loop."""
+        fut = asyncio.get_running_loop().create_future()
+        self._pending_ops.append((fn, fut))
+        self._wake.set()
+        return fut
 
     async def start(self) -> "NativeEngineWorker":
         self._loop_task = asyncio.create_task(self._step_loop())
@@ -110,7 +122,17 @@ class NativeEngineWorker(AsyncEngine):
     # -- engine loop ----------------------------------------------------------
 
     def _apply_pending(self) -> None:
-        """Apply staged adds/aborts; runs only between device steps."""
+        """Apply staged ops/adds/aborts; runs only between device steps."""
+        ops, self._pending_ops = self._pending_ops, []
+        for fn, fut in ops:
+            try:
+                result = fn(self.engine)
+            except Exception as e:  # surface to the submitter
+                if not fut.done():
+                    fut.set_exception(e)
+            else:
+                if not fut.done():
+                    fut.set_result(result)
         adds, self._pending_adds = self._pending_adds, []
         for req in adds:
             try:
@@ -130,7 +152,7 @@ class NativeEngineWorker(AsyncEngine):
             self._apply_pending()
             if not self.engine.has_work():
                 self._wake.clear()
-                if not self._pending_adds:
+                if not self._pending_adds and not self._pending_ops:
                     self.metrics_publisher.update(self.engine.metrics())
                     try:
                         await asyncio.wait_for(self._wake.wait(), timeout=1.0)
@@ -165,32 +187,49 @@ class NativeEngineWorker(AsyncEngine):
 
     # -- AsyncEngine ----------------------------------------------------------
 
-    async def generate(self, request, context: Context):
-        pre = PreprocessedRequest.model_validate(request)
+    def _register(self, request_id: str) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue()
-        self._queues[pre.request_id] = q
+        self._queues[request_id] = q
+        return q
+
+    async def _stream(self, request_id: str, context: Context,
+                      q: asyncio.Queue):
+        """Drain a request's frame queue, honoring client-side stop."""
         stop = asyncio.create_task(context.wait_stopped())
+        get = None
         try:
-            self._pending_adds.append(_to_engine_request(pre))
-            self._wake.set()
             while True:
                 get = asyncio.create_task(q.get())
                 done, _ = await asyncio.wait(
                     {get, stop}, return_when=asyncio.FIRST_COMPLETED)
                 if stop in done and get not in done:
-                    get.cancel()
-                    self._pending_aborts.append(pre.request_id)
+                    self._pending_aborts.append(request_id)
                     self._wake.set()
                     yield EngineOutput(
                         finish_reason=FinishReason.CANCELLED).model_dump(
                             exclude_none=True)
                     return
                 frame: EngineOutput = get.result()
+                get = None
                 yield frame.model_dump(exclude_none=True)
                 if frame.finish_reason is not None:
                     return
         finally:
             stop.cancel()
+            if get is not None:  # client closed the stream mid-get
+                get.cancel()
+                self._pending_aborts.append(request_id)
+                self._wake.set()
+
+    async def generate(self, request, context: Context):
+        pre = PreprocessedRequest.model_validate(request)
+        q = self._register(pre.request_id)
+        try:
+            self._pending_adds.append(_to_engine_request(pre))
+            self._wake.set()
+            async for frame in self._stream(pre.request_id, context, q):
+                yield frame
+        finally:
             self._queues.pop(pre.request_id, None)
 
     # -- stats ----------------------------------------------------------------

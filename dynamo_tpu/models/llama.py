@@ -25,8 +25,26 @@ from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_kv_pages
+from dynamo_tpu.ops.paged_attention import decode_paged_attention
 
 Params = Dict[str, Any]
+
+
+def _decode_kernel_mode(cfg: ModelConfig) -> Optional[str]:
+    """Resolve the decode-attention implementation at trace time.
+
+    Returns "tpu" / "interpret" to use the Pallas kernel, None for the XLA
+    gather path. "auto" picks the kernel on a real TPU backend only; the
+    engine forces "off" on multi-device meshes until the kernel is wrapped
+    in shard_map (auto-sharded jit cannot partition a pallas_call)."""
+    mode = cfg.decode_kernel
+    if mode == "off":
+        return None
+    if mode == "interpret":
+        return "interpret"
+    if mode == "on":
+        return "tpu"
+    return "tpu" if jax.default_backend() == "tpu" else None
 
 
 @dataclasses.dataclass
@@ -132,14 +150,17 @@ def param_shardings(cfg: ModelConfig) -> Params:
 
 
 def cache_sharding(cfg: ModelConfig) -> P:
-    """KV cache [L, P, ps, Hkv, hd]: shard kv heads over tp."""
+    """KV cache [L, Hkv, P, ps, hd]: shard kv heads over tp.
+
+    Head-major so one (head, page) slice is a contiguous [ps, hd] block —
+    the decode kernel's DMA unit (ops/paged_attention.py)."""
     del cfg
-    return P(None, None, None, "tp", None)
+    return P(None, "tp", None, None, None)
 
 
 def init_cache(cfg: ModelConfig, num_pages: int, page_size: int) -> Dict[str, jax.Array]:
     dt = _dtype(cfg)
-    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, cfg.num_kv_heads, num_pages, page_size, cfg.head_dim)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
@@ -210,6 +231,8 @@ def forward(
     else:
         x = input_embeds.astype(_dtype(cfg))
 
+    use_kernel = tq == 1 and _decode_kernel_mode(cfg) is not None
+
     def layer_step(x, layer):
         lp, kc, vc = layer
         xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -219,7 +242,14 @@ def forward(
         q = apply_rope(q, meta.positions, cfg.rope_theta)
         k = apply_rope(k, meta.positions, cfg.rope_theta)
         kc, vc = write_kv_pages(kc, vc, k, v, meta.write_idx)
-        attn = paged_attention(q, kc, vc, meta.page_table, meta.kv_lens, meta.positions)
+        if use_kernel:
+            # decode hot path: stream pages HBM->VMEM, no materialized gather
+            attn = decode_paged_attention(
+                q[:, 0], kc, vc, meta.page_table, meta.kv_lens,
+                interpret=_decode_kernel_mode(cfg) == "interpret")[:, None]
+        else:
+            attn = paged_attention(q, kc, vc, meta.page_table, meta.kv_lens,
+                                   meta.positions)
         x = x + jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd), lp["wo"])
 
         xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

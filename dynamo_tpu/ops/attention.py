@@ -6,14 +6,15 @@ XLA sees a small, fixed set of programs and everything lands on the MXU. The
 page gather is a plain `take` on the page axis, which XLA lowers to an
 efficient dynamic-gather; a Pallas kernel that reads HBM pages directly (no
 materialized gather) lives in dynamo_tpu/ops/paged_attention.py and is used on
-TPU for decode.
+TPU for decode (dispatch in models/llama.py).
 
 Reference equivalent: the engines' paged attention (vLLM/TRT-LLM internals) and
 the KV block layout in lib/llm/src/kv/layer.rs:100-616. We keep K and V as
-separate [num_pages, page_size, n_kv_heads, head_dim] arrays per layer
+separate [n_kv_heads, num_pages, page_size, head_dim] arrays per layer
 (stacked over layers) instead of the reference's 5-D
-[2, blocks, block_size, heads, head_dim] tensor: separate arrays keep XLA
-layouts simple and let the kv-head axis shard cleanly over the `tp` mesh axis.
+[2, blocks, block_size, heads, head_dim] tensor: head-major keeps one
+(head, page) slice contiguous (the decode kernel's DMA unit) and lets the
+kv-head axis shard cleanly over the `tp` mesh axis.
 """
 from __future__ import annotations
 
@@ -24,33 +25,33 @@ NEG_INF = -1e30
 
 
 def gather_pages(cache: jax.Array, page_table: jax.Array) -> jax.Array:
-    """[P, ps, Hkv, hd] gathered by [B, Pb] -> [B, Pb*ps, Hkv, hd]."""
+    """[Hkv, P, ps, hd] gathered by [B, Pb] -> [Hkv, B, Pb*ps, hd]."""
     b, pb = page_table.shape
-    _, ps, hkv, hd = cache.shape
-    gathered = jnp.take(cache, page_table.reshape(-1), axis=0)
-    return gathered.reshape(b, pb * ps, hkv, hd)
+    hkv, _, ps, hd = cache.shape
+    gathered = jnp.take(cache, page_table.reshape(-1), axis=1)
+    return gathered.reshape(hkv, b, pb * ps, hd)
 
 
 def paged_attention(
     q: jax.Array,            # [B, Tq, H, hd]
-    k_cache: jax.Array,      # [P, ps, Hkv, hd]
-    v_cache: jax.Array,      # [P, ps, Hkv, hd]
+    k_cache: jax.Array,      # [Hkv, P, ps, hd]
+    v_cache: jax.Array,      # [Hkv, P, ps, hd]
     page_table: jax.Array,   # [B, Pb] int32
     kv_lens: jax.Array,      # [B] int32 — valid kv length per sequence
     q_positions: jax.Array,  # [B, Tq] int32 — absolute position of each query
 ) -> jax.Array:
     """Causal attention of q against the paged KV prefix. Returns [B, Tq, H, hd]."""
     b, tq, h, hd = q.shape
-    hkv = k_cache.shape[2]
+    hkv = k_cache.shape[0]
     g = h // hkv
 
-    k = gather_pages(k_cache, page_table)  # [B, Lk, Hkv, hd]
+    k = gather_pages(k_cache, page_table)  # [Hkv, B, Lk, hd]
     v = gather_pages(v_cache, page_table)
-    lk = k.shape[1]
+    lk = k.shape[2]
 
     qg = q.reshape(b, tq, hkv, g, hd)
     scores = jnp.einsum(
-        "btkgd,bskd->bkgts", qg.astype(jnp.float32), k.astype(jnp.float32)
+        "btkgd,kbsd->bkgts", qg.astype(jnp.float32), k.astype(jnp.float32)
     )
     scores = scores * (hd ** -0.5)
 
@@ -61,30 +62,30 @@ def paged_attention(
     scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bkgts,kbsd->btkgd", probs, v.astype(jnp.float32))
     return out.reshape(b, tq, h, hd).astype(q.dtype)
 
 
 def write_kv_pages(
-    k_cache: jax.Array,   # [P, ps, Hkv, hd]
+    k_cache: jax.Array,   # [Hkv, P, ps, hd]
     v_cache: jax.Array,
     k_new: jax.Array,     # [B, Tq, Hkv, hd]
     v_new: jax.Array,
     write_idx: jax.Array,  # [B, Tq] int32 flat indices into P*ps; <0 = skip
 ) -> tuple[jax.Array, jax.Array]:
     """Scatter new KV entries into the paged cache at flat token slots."""
-    p, ps, hkv, hd = k_cache.shape
-    flat_k = k_cache.reshape(p * ps, hkv, hd)
-    flat_v = v_cache.reshape(p * ps, hkv, hd)
+    hkv, p, ps, hd = k_cache.shape
+    flat_k = k_cache.reshape(hkv, p * ps, hd)
+    flat_v = v_cache.reshape(hkv, p * ps, hd)
     idx = write_idx.reshape(-1)
     keep = idx >= 0
     # Out-of-range (negative) indices are dropped by scatter mode "drop".
     safe_idx = jnp.where(keep, idx, p * ps)
-    kn = k_new.reshape(-1, hkv, hd).astype(flat_k.dtype)
-    vn = v_new.reshape(-1, hkv, hd).astype(flat_v.dtype)
-    flat_k = flat_k.at[safe_idx].set(kn, mode="drop")
-    flat_v = flat_v.at[safe_idx].set(vn, mode="drop")
-    return flat_k.reshape(p, ps, hkv, hd), flat_v.reshape(p, ps, hkv, hd)
+    kn = k_new.reshape(-1, hkv, hd).swapaxes(0, 1).astype(flat_k.dtype)
+    vn = v_new.reshape(-1, hkv, hd).swapaxes(0, 1).astype(flat_v.dtype)
+    flat_k = flat_k.at[:, safe_idx].set(kn, mode="drop")
+    flat_v = flat_v.at[:, safe_idx].set(vn, mode="drop")
+    return (flat_k.reshape(hkv, p, ps, hd), flat_v.reshape(hkv, p, ps, hd))
 
 
 def dense_causal_attention(

@@ -19,6 +19,9 @@ class FinishReason(str, enum.Enum):
     LENGTH = "length"        # max_tokens reached
     CANCELLED = "cancelled"  # client disconnect / stop_generating
     ERROR = "error"
+    # internal to the disaggregated path: prefill half finished; never
+    # reaches the OpenAI layer (the decode side restates the final reason)
+    PREFILL_DONE = "prefill_done"
 
 
 class StopConditions(pydantic.BaseModel):

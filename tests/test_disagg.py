@@ -1,0 +1,323 @@
+"""Disaggregated prefill/decode tests.
+
+Mirrors the reference's disagg flow (SURVEY.md §3.3): decision router,
+durable prefill queue, decode-side up-front allocation, prefill-only engine
+runs, inter-mesh KV page transfer, completion notify — all on the virtual
+CPU mesh with the in-memory control plane.
+"""
+import asyncio
+
+import jax
+import pytest
+
+from dynamo_tpu.disagg import (
+    DisaggDecodeWorker, DisaggregatedRouter, LocalTransferBackend,
+    PrefillQueue, PrefillWorker, RemotePrefillRequest,
+)
+from dynamo_tpu.disagg.router import config_key
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+from dynamo_tpu.llm.worker import NativeEngineWorker
+from dynamo_tpu.parallel.mesh import make_mesh
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest, SamplingOptions, StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+PAGE = 8
+
+
+def make_engine(mesh=None):
+    return NativeEngine(CFG, EngineConfig(
+        page_size=PAGE, num_pages=64, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512), mesh=mesh, seed=0)
+
+
+def pre_request(rid, prompt, max_tokens=6):
+    return PreprocessedRequest(
+        request_id=rid, token_ids=prompt,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True))
+
+
+# -- router decision ----------------------------------------------------------
+
+def test_disagg_decision():
+    r = DisaggregatedRouter(max_local_prefill_length=1000,
+                            max_prefill_queue_size=2)
+    assert r.prefill_remote(prefill_length=2000, prefix_hit_length=0,
+                            queue_depth=0)
+    # prefix hit brings the un-cached work under the threshold
+    assert not r.prefill_remote(2000, 1500, 0)
+    # queue backed up: keep it local
+    assert not r.prefill_remote(2000, 0, 2)
+    assert not r.prefill_remote(500, 0, 0)
+
+
+def test_disagg_threshold_live_reload():
+    async def main():
+        plane = MemoryPlane()
+        r = DisaggregatedRouter(max_local_prefill_length=1000, model="m")
+        task = r.start_watching(plane.kv)
+        await asyncio.sleep(0.05)
+        await plane.kv.put(config_key("m"),
+                           b'{"max_local_prefill_length": 10}')
+        for _ in range(100):
+            if r.max_local_prefill_length == 10:
+                break
+            await asyncio.sleep(0.01)
+        task.cancel()
+        return r.max_local_prefill_length
+
+    assert asyncio.run(main()) == 10
+
+
+def test_prefill_queue_roundtrip():
+    async def main():
+        plane = MemoryPlane()
+        q = PrefillQueue(plane.messaging, "ns", "model-a")
+        req = RemotePrefillRequest(
+            engine_id="e1", request_id="r1", token_ids=[1, 2, 3],
+            page_ids=[4, 5], num_cached_tokens=0, page_size=8,
+            sampling=SamplingOptions(temperature=0.5),
+            notify_subject="disagg.prefill_done.e1")
+        await q.enqueue(req)
+        assert await q.depth() == 1
+        got = await q.dequeue(timeout=1.0)
+        assert await q.depth() == 0
+        empty = await q.dequeue(timeout=0.05)
+        return req, got, empty
+
+    req, got, empty = asyncio.run(main())
+    assert got == req
+    assert empty is None
+
+
+# -- engine-level remote prefill primitives -----------------------------------
+
+def test_engine_prefill_only_parks_and_extracts():
+    eng = make_engine()
+    prompt = list(range(10, 30))  # 20 tokens -> 3 pages (page 8)
+    eng.add_request(EngineRequest("p1", prompt, SamplingParams(
+        max_tokens=4, ignore_eos=True), prefill_only=True))
+    outs = []
+    while eng.has_work():
+        outs.extend(eng.step())
+    assert len(outs) == 1 and outs[0].finish_reason == "prefill_done"
+    assert outs[0].token is not None
+    seq = eng.scheduler.parked["p1"]
+    assert len(seq.pages) == 3  # ceil(20/8)
+    pages = eng.extract_pages(seq.pages)
+    # bucketed to 4 pages: [L, Hkv, 4, ps, hd]
+    assert pages["k"].shape == (CFG.num_layers, CFG.num_kv_heads, 4, PAGE,
+                                CFG.head_dim)
+    eng.release_parked("p1")
+    assert "p1" not in eng.scheduler.parked
+
+
+def test_engine_remote_alloc_inject_activate_matches_local():
+    prompt = list(range(40, 60))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+
+    prefill_eng = make_engine()
+    decode_eng = make_engine()
+    # decode side: allocate up-front
+    alloc = decode_eng.allocate_remote(EngineRequest("r", prompt, params))
+    assert alloc is not None and len(alloc.page_ids) == 3
+    # prefill side: run prefill-only, extract pages
+    prefill_eng.add_request(
+        EngineRequest("r", prompt, params, prefill_only=True))
+    outs = []
+    while prefill_eng.has_work():
+        outs.extend(prefill_eng.step())
+    first = outs[0].token
+    seq = prefill_eng.scheduler.parked["r"]
+    pages = prefill_eng.extract_pages(seq.pages)
+    # transfer: same process, device_put onto the decode cache sharding
+    k = jax.device_put(pages["k"], decode_eng.cache_sharding)
+    v = jax.device_put(pages["v"], decode_eng.cache_sharding)
+    decode_eng.inject_pages(alloc.page_ids, k, v)
+    prefill_eng.release_parked("r")
+    # activate and decode to completion
+    decode_eng.activate_remote("r", first)
+    toks = [first]
+    while decode_eng.has_work():
+        for ev in decode_eng.step():
+            if ev.token is not None:
+                toks.append(ev.token)
+    assert toks == expect
+
+
+# -- full worker-level disagg flow --------------------------------------------
+
+async def _drive(worker_gen):
+    toks, reason = [], None
+    async for frame in worker_gen:
+        toks.extend(frame.get("token_ids", ()))
+        if frame.get("finish_reason") not in (None, "prefill_done"):
+            reason = frame["finish_reason"]
+    return toks, reason
+
+
+def _build_stack(plane, decode_mesh=None, prefill_mesh=None,
+                 local_threshold=4):
+    transfer = LocalTransferBackend()
+    queue = PrefillQueue(plane.messaging, "ns", "tiny")
+    router = DisaggregatedRouter(max_local_prefill_length=local_threshold,
+                                 max_prefill_queue_size=4, model="tiny")
+    decode = DisaggDecodeWorker(
+        make_engine(decode_mesh), plane.messaging, router, queue,
+        worker_id="dec-0", prefill_timeout_s=30.0)
+    transfer.register("dec-0", decode)
+    prefill = PrefillWorker(
+        NativeEngineWorker(make_engine(prefill_mesh)), queue, transfer,
+        plane.messaging)
+    return decode, prefill
+
+
+def test_disagg_worker_e2e_matches_aggregated():
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill = _build_stack(plane)
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, reason = await _drive(
+                decode.generate(pre_request("r1", prompt).model_dump(
+                    exclude_none=True), Context("r1")))
+        finally:
+            await prefill.stop()
+            await decode.stop()
+        return toks, reason, decode.remote_prefills, prefill.completed
+
+    toks, reason, n_remote, n_prefills = asyncio.run(main())
+    assert n_remote == 1 and n_prefills == 1
+    assert reason == "length"
+    assert toks == expect
+
+
+def test_disagg_short_prompt_stays_local():
+    prompt = list(range(4))
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill = _build_stack(plane, local_threshold=100)
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, reason = await _drive(
+                decode.generate(pre_request("s1", prompt).model_dump(
+                    exclude_none=True), Context("s1")))
+        finally:
+            await prefill.stop()
+            await decode.stop()
+        return toks, decode.remote_prefills, decode.local_prefills
+
+    toks, n_remote, n_local = asyncio.run(main())
+    assert n_remote == 0 and n_local == 1
+    assert len(toks) == 6
+
+
+def test_disagg_tp_mismatch_relayout():
+    """Prefill tp=1, decode tp=2: device_put reshards (kv_rearrange role)."""
+    devs = jax.devices()
+    assert len(devs) >= 2
+    decode_mesh = make_mesh(tp=2, devices=devs[:2])
+    prompt = list(range(60, 80))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    # oracle: aggregated engine on the SAME decode mesh (identical layout)
+    expect = make_engine(decode_mesh).generate(prompt, params, "direct")
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill = _build_stack(plane, decode_mesh=decode_mesh)
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, _ = await _drive(
+                decode.generate(pre_request("t1", prompt).model_dump(
+                    exclude_none=True), Context("t1")))
+        finally:
+            await prefill.stop()
+            await decode.stop()
+        return toks, decode.remote_prefills
+
+    toks, n_remote = asyncio.run(main())
+    assert n_remote == 1
+    assert toks == expect
+
+
+def test_disagg_remote_first_token_hidden_stop_not_emitted():
+    """A hidden stop id sampled as the remote first token must not leak to
+    the client (parity with the local path's _postprocess)."""
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    first = make_engine().generate(prompt, params, "oracle")[0]
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill = _build_stack(plane)
+        await decode.start()
+        await prefill.start()
+        try:
+            req = PreprocessedRequest(
+                request_id="h1", token_ids=prompt,
+                stop=StopConditions(max_tokens=6, ignore_eos=True,
+                                    stop_token_ids_hidden=[first]))
+            toks, reason = await _drive(
+                decode.generate(req.model_dump(exclude_none=True),
+                                Context("h1")))
+        finally:
+            await prefill.stop()
+            await decode.stop()
+        return toks, reason, decode.remote_prefills
+
+    toks, reason, n_remote = asyncio.run(main())
+    assert n_remote == 1
+    assert toks == []
+    assert reason == "stop"
+
+
+def test_disagg_prefill_failure_falls_back_local():
+    """Transfer failure -> decode releases the allocation and recomputes."""
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+
+    class BrokenTransfer(LocalTransferBackend):
+        async def send_pages(self, *a, **k):
+            raise RuntimeError("link down")
+
+    async def main():
+        plane = MemoryPlane()
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        router = DisaggregatedRouter(max_local_prefill_length=4,
+                                     max_prefill_queue_size=4)
+        decode = DisaggDecodeWorker(
+            make_engine(), plane.messaging, router, queue,
+            worker_id="dec-0", prefill_timeout_s=30.0)
+        prefill = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue, BrokenTransfer(),
+            plane.messaging)
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, reason = await _drive(
+                decode.generate(pre_request("f1", prompt).model_dump(
+                    exclude_none=True), Context("f1")))
+        finally:
+            await prefill.stop()
+            await decode.stop()
+        return toks, reason, prefill.failed, decode.local_prefills
+
+    toks, reason, n_failed, n_local = asyncio.run(main())
+    assert n_failed == 1 and n_local == 1
+    assert reason == "length"
+    assert toks == expect

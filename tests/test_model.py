@@ -32,8 +32,8 @@ def test_paged_attention_matches_dense():
     perm = rng.permutation(n_pages)
     pages_per_seq = t // ps
     page_table = np.zeros((b, pages_per_seq + 2), np.int32)  # padded bucket
-    k_cache = jnp.zeros((n_pages, ps, hkv, hd), jnp.float32)
-    v_cache = jnp.zeros((n_pages, ps, hkv, hd), jnp.float32)
+    k_cache = jnp.zeros((hkv, n_pages, ps, hd), jnp.float32)
+    v_cache = jnp.zeros((hkv, n_pages, ps, hd), jnp.float32)
     for i in range(b):
         pages = perm[i * pages_per_seq:(i + 1) * pages_per_seq]
         page_table[i, :pages_per_seq] = pages
@@ -52,8 +52,8 @@ def test_paged_attention_matches_dense():
 
 
 def test_write_kv_pages_drops_negative_indices():
-    k_cache = jnp.zeros((2, 4, 1, 8), jnp.float32)
-    v_cache = jnp.zeros((2, 4, 1, 8), jnp.float32)
+    k_cache = jnp.zeros((1, 2, 4, 8), jnp.float32)
+    v_cache = jnp.zeros((1, 2, 4, 8), jnp.float32)
     k_new = jnp.ones((1, 3, 1, 8), jnp.float32)
     write_idx = jnp.asarray([[0, -1, 5]], jnp.int32)
     k2, _ = write_kv_pages(k_cache, v_cache, k_new, k_new, write_idx)
