@@ -32,6 +32,11 @@ class ModelConfig:
     # MoE (Mixtral-style); num_experts == 0 means dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # "dispatch" = capacity-based EP dispatch (ops/moe.py, serving default);
+    # "dense" = every expert computes every token (exact, E/k x FLOPs —
+    # oracle for tests)
+    moe_impl: str = "dispatch"
+    moe_capacity_factor: float = 2.0
     # decode attention impl: "auto" (Pallas kernel on TPU, XLA gather
     # elsewhere), "on", "off", "interpret" (kernel in interpreter mode, for
     # CPU tests). The engine forces "off" on multi-device meshes.
@@ -79,6 +84,9 @@ class EngineConfig:
     prefill_buckets: tuple = (16, 32, 64, 128, 256, 512)
     # (page-count buckets are derived: pow2 up to max_model_len/page_size)
     max_model_len: int = 2048
+    # host-DRAM KV tier capacity in pages (0 = tier off); evicted HBM pages
+    # spill here and return on prefix hits (engine/offload.py)
+    host_pages: int = 0
     # mesh axes sizes: (dp, tp). dp>1 replicates the whole engine.
     tp: int = 1
     dp: int = 1

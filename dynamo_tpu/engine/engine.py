@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
 from dynamo_tpu.engine.kv_cache import SequenceState
+from dynamo_tpu.engine.offload import HostKvPool
 from dynamo_tpu.engine.sampler import make_keys, sample
 from dynamo_tpu.engine.scheduler import (
     DecodePlan, EngineRequest, PrefillPlan, SamplingParams, Scheduler,
@@ -65,7 +66,20 @@ class NativeEngine:
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
         self.eos_token_ids = set(eos_token_ids or ())
-        self.scheduler = Scheduler(engine_cfg)
+        # host KV tier (reference: multi-tier KV block manager, SURVEY.md
+        # §2.5): evicted HBM pages spill to a host slab and come back on
+        # prefix hits instead of being recomputed
+        self.host_pool = None
+        if engine_cfg.host_pages > 0:
+            page_shape = (model_cfg.num_layers, model_cfg.num_kv_heads,
+                          engine_cfg.page_size, model_cfg.head_dim)
+            np_dtype = jnp.empty((), model_cfg.dtype).dtype
+            self.host_pool = HostKvPool(engine_cfg.host_pages, page_shape,
+                                        np_dtype)
+        self.scheduler = Scheduler(engine_cfg, host_pool=self.host_pool)
+        self._pending_offloads: list = []
+        if self.host_pool is not None:
+            self.scheduler.allocator.on_evict = self._offload_page
         self.step_count = 0
         self._finished_cb = None
 
@@ -91,9 +105,25 @@ class NativeEngine:
             out_shardings={"k": cache_shd, "v": cache_shd})
         self.cache = init_cache()
 
+        # sequence-parallel prefill (ring attention over the "sp" axis):
+        # requires whole-prompt single-chunk prefills and no prefix sharing
+        # (the ring path attends only within the chunk)
+        sp_mesh = None
+        if engine_cfg.sp > 1:
+            if self.mesh.shape.get("sp", 1) != engine_cfg.sp:
+                raise ValueError(
+                    f"engine sp={engine_cfg.sp} but mesh sp axis is "
+                    f"{self.mesh.shape.get('sp', 1)}")
+            if engine_cfg.max_prefill_chunk < engine_cfg.max_model_len:
+                raise ValueError(
+                    "sp>1 requires max_prefill_chunk >= max_model_len "
+                    "(whole-prompt prefill)")
+            if any(b % engine_cfg.sp for b in engine_cfg.prefill_buckets):
+                raise ValueError("every prefill bucket must divide by sp")
+            sp_mesh = self.mesh
         self._step_fn = jax.jit(
             functools.partial(_engine_step, model_cfg,
-                              tuple(sorted(self.eos_token_ids))),
+                              tuple(sorted(self.eos_token_ids)), sp_mesh),
             donate_argnums=(1,))
         # disaggregation: whole-page gather/scatter on the
         # [L, Hkv, P, ps, hd] cache (the TPU equivalent of the reference's
@@ -121,6 +151,8 @@ class NativeEngine:
     def step(self) -> List[StepOutput]:
         """Run one scheduler step on the device; returns per-request events."""
         plan = self.scheduler.schedule()
+        self._process_offloads()  # save evicted pages before any overwrite
+        self._process_onboards()  # host-tier pages the plan may read
         if plan is None:
             return []
         self.step_count += 1
@@ -217,6 +249,58 @@ class NativeEngine:
             self.scheduler.finish(seq)
         return StepOutput(seq.request_id, emit, finish is not None, finish)
 
+    # -- host KV tier --------------------------------------------------------
+
+    def _offload_page(self, pid: int, seq_hash: int) -> None:
+        """Allocator eviction hook: queue the page for a batched HBM -> host
+        copy (reference: CopyStream offload role). The extract is deferred to
+        the next cache-writing operation (_process_offloads), which runs
+        before anything can overwrite the evicted page's content."""
+        self._pending_offloads.append((pid, seq_hash))
+
+    def _process_offloads(self) -> None:
+        """Batched extract + host put of all pages evicted since the last
+        device-cache write. Chunked to the largest page bucket — the pending
+        list is engine-wide and can exceed the per-sequence bucket range."""
+        pending, self._pending_offloads = self._pending_offloads, []
+        max_b = self.scheduler.page_buckets[-1]
+        for start in range(0, len(pending), max_b):
+            chunk = pending[start:start + max_b]
+            pages = self.extract_pages([pid for pid, _ in chunk])
+            k = np.asarray(jax.device_get(pages["k"]))
+            v = np.asarray(jax.device_get(pages["v"]))
+            for i, (_, seq_hash) in enumerate(chunk):
+                self.host_pool.put(seq_hash, k[:, :, i], v[:, :, i])
+
+    def _process_onboards(self) -> None:
+        """Inject host-tier pages claimed by _match_prefix into HBM before
+        the device step that reads them."""
+        pending = self.scheduler.drain_onboards()
+        max_b = self.scheduler.page_buckets[-1]
+        for start in range(0, len(pending), max_b):
+            chunk = pending[start:start + max_b]
+            ids = [pid for pid, _ in chunk]
+            ks, vs = [], []
+            for _, h in chunk:
+                k, v = self.host_pool.get(h)
+                self.host_pool.unpin(h)
+                ks.append(k)
+                vs.append(v)
+            nb = next_bucket(len(ids), self.scheduler.page_buckets)
+            # [L, Hkv, Nb, ps, hd]; unused tail pages stay zero + dropped
+            k_pages = np.zeros(
+                (ks[0].shape[0], ks[0].shape[1], nb) + ks[0].shape[2:],
+                ks[0].dtype)
+            v_pages = np.zeros_like(k_pages)
+            for i, (k, v) in enumerate(zip(ks, vs)):
+                k_pages[:, :, i] = k
+                v_pages[:, :, i] = v
+            shd = self.cache_sharding
+            self.inject_pages(
+                ids, jax.device_put(jnp.asarray(k_pages), shd),
+                jax.device_put(jnp.asarray(v_pages), shd))
+            self.host_pool.stats.onboarded += len(ids)
+
     # -- disaggregation ------------------------------------------------------
 
     def allocate_remote(self, req: EngineRequest):
@@ -257,6 +341,10 @@ class NativeEngine:
         The id padding follows the SENDER's bucket (k_pages.shape[2]), not
         ours — the two engines may have different max_model_len and hence
         different page-count buckets; padding ids drop on scatter."""
+        # evicted-but-unsaved pages must reach the host slab before this
+        # write can overwrite them (disagg injects land on evicted pages)
+        if self._pending_offloads:
+            self._process_offloads()
         nb = k_pages.shape[2]
         if len(page_ids) > nb:
             raise ValueError(
@@ -287,13 +375,14 @@ def _inject_pages(cache, ids, k_pages, v_pages):
             "v": cache["v"].at[:, :, ids].set(v_pages, mode="drop")}
 
 
-def _engine_step(cfg: ModelConfig, eos_ids: tuple, params, cache, tokens,
-                 positions, page_table, kv_lens, write_idx, last_idx,
+def _engine_step(cfg: ModelConfig, eos_ids: tuple, sp_mesh, params, cache,
+                 tokens, positions, page_table, kv_lens, write_idx, last_idx,
                  temperature, top_k, top_p, seeds, counters, min_tokens):
     """forward + gather last logits + sample, fused into one XLA program."""
     meta = AttnMetadata(positions=positions, page_table=page_table,
                         kv_lens=kv_lens, write_idx=write_idx)
-    logits, cache = llama.forward(params, cfg, tokens, cache, meta)
+    logits, cache = llama.forward(params, cfg, tokens, cache, meta,
+                                  sp_mesh=sp_mesh)
     b = tokens.shape[0]
     last = logits[jnp.arange(b), last_idx]          # [B, V] f32
     if eos_ids:

@@ -61,6 +61,10 @@ class PageAllocator:
     def __init__(self, num_pages: int, page_size: int):
         self.num_pages = num_pages
         self.page_size = page_size
+        # host-tier hook: called with (pid, seq_hash) just before a reusable
+        # page's content is recycled, while its KV is still intact in HBM —
+        # the engine offloads it to the HostKvPool here (engine/offload.py)
+        self.on_evict = None
         self.pages: List[PageInfo] = [PageInfo() for _ in range(num_pages)]
         self._free: List[int] = list(range(num_pages - 1, -1, -1))
         # seq_hash -> page id, for pages whose ref_count dropped to 0
@@ -102,6 +106,8 @@ class PageAllocator:
             info = self.pages[pid]
             if info.ref_count == 0 and info.seq_hash is not None \
                     and self._reusable.get(info.seq_hash) == pid:
+                if self.on_evict is not None:
+                    self.on_evict(pid, info.seq_hash)
                 del self._reusable[info.seq_hash]
                 self.events.append(("removed", pid, info.seq_hash, 0, 0))
                 info.seq_hash = None

@@ -1,0 +1,115 @@
+"""Host-DRAM KV page tier (HBM -> host offload, host -> HBM onboard).
+
+Role of the reference's multi-tier KV block manager (reference:
+lib/llm/src/kv/reuse.rs:50-214 AvailableBlocks match-by-sequence-hash
+reclaim + priority eviction, kv/storage.rs Pinned/System tiers, and the
+layer-wise CopyStream offload engine, kv/layer.rs:619-1140). TPU shape of
+the idea: when a reusable HBM page is about to be recycled, its KV moves to
+a host slab; when a prefix walk misses HBM but hits the host pool, the page
+is injected back into a freshly-allocated HBM page before the next device
+step. The reference's "+40% TTFT from CPU-RAM offload" workload
+(docs/architecture.md:91-95, multi-turn conversations) is exactly the
+pattern this accelerates: onboarding is a host->HBM DMA instead of a
+recompute.
+
+The slab is one pre-allocated numpy array pair (pages stay in fixed slots;
+no per-page allocation churn). A C++ pinned-memory slab + async copy engine
+is the planned upgrade path for overlap; the tier protocol stays the same.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class OffloadStats:
+    offloaded: int = 0        # pages copied HBM -> host
+    onboarded: int = 0        # pages copied host -> HBM
+    evicted: int = 0          # pages dropped from the host pool (capacity)
+    host_hits: int = 0        # prefix-walk hits served from the host tier
+    put_dropped: int = 0      # offloads skipped because all slots were pinned
+
+
+class HostKvPool:
+    """Fixed-capacity host slab of KV pages keyed by chained sequence hash.
+
+    LRU eviction; duplicate puts refresh recency. Page payloads are
+    [L, Hkv, ps, hd] ndarray pairs (k, v) matching the device cache layout
+    so onboarding is a straight stack + device_put.
+    """
+
+    def __init__(self, capacity: int, page_shape: Tuple[int, ...],
+                 dtype: np.dtype):
+        self.capacity = capacity
+        self.k_slab = np.zeros((capacity,) + tuple(page_shape), dtype)
+        self.v_slab = np.zeros((capacity,) + tuple(page_shape), dtype)
+        self._by_hash: Dict[int, int] = {}     # seq_hash -> slot
+        self._hash_at: List[Optional[int]] = [None] * capacity
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        # insertion-ordered dict as an O(1) LRU (oldest = first key)
+        self._lru: Dict[int, None] = {}
+        # pin counts by hash: pinned entries are claimed by a pending
+        # onboard (an HBM page was already sealed expecting this payload)
+        # and must survive LRU until drained
+        self._pins: Dict[int, int] = {}
+        self.stats = OffloadStats()
+
+    def __contains__(self, seq_hash: int) -> bool:
+        return seq_hash in self._by_hash
+
+    def pin(self, seq_hash: int) -> None:
+        self._pins[seq_hash] = self._pins.get(seq_hash, 0) + 1
+
+    def unpin(self, seq_hash: int) -> None:
+        n = self._pins.get(seq_hash, 0) - 1
+        if n <= 0:
+            self._pins.pop(seq_hash, None)
+        else:
+            self._pins[seq_hash] = n
+
+    def put(self, seq_hash: int, k_page: np.ndarray, v_page: np.ndarray
+            ) -> None:
+        if seq_hash in self._by_hash:
+            self._touch(self._by_hash[seq_hash])
+            return
+        if self._free:
+            slot = self._free.pop()
+        else:
+            slot = None
+            for cand in self._lru:          # oldest unpinned entry
+                if self._hash_at[cand] not in self._pins:
+                    slot = cand
+                    break
+            if slot is None:                # everything pinned: skip offload
+                self.stats.put_dropped += 1
+                return
+            del self._lru[slot]
+            old = self._hash_at[slot]
+            if old is not None:
+                del self._by_hash[old]
+            self.stats.evicted += 1
+        self.k_slab[slot] = k_page
+        self.v_slab[slot] = v_page
+        self._by_hash[seq_hash] = slot
+        self._hash_at[slot] = seq_hash
+        self._lru[slot] = None
+        self.stats.offloaded += 1
+
+    def get(self, seq_hash: int
+            ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        slot = self._by_hash.get(seq_hash)
+        if slot is None:
+            return None
+        self._touch(slot)
+        return self.k_slab[slot], self.v_slab[slot]
+
+    def _touch(self, slot: int) -> None:
+        self._lru.pop(slot, None)
+        self._lru[slot] = None
+
+    @property
+    def used(self) -> int:
+        return self.capacity - len(self._free)

@@ -122,9 +122,14 @@ def next_bucket(n: int, buckets: Sequence[int]) -> int:
 
 
 class Scheduler:
-    def __init__(self, cfg: EngineConfig):
+    def __init__(self, cfg: EngineConfig, host_pool=None):
         self.cfg = cfg
         self.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
+        # host KV tier (engine/offload.py); None = tier disabled
+        self.host_pool = host_pool
+        # (pid, seq_hash) pairs whose HBM page must be filled from the host
+        # pool before the next device step (engine drains + injects)
+        self.pending_onboards: list = []
         self.waiting: deque[SequenceState] = deque()
         self.running: List[Optional[SequenceState]] = [None] * cfg.max_slots
         self.params: Dict[str, SamplingParams] = {}
@@ -221,32 +226,61 @@ class Scheduler:
             self.finish(seq)
 
     def _prefix_walk(self, tokens: List[int]):
-        """Cached full-page prefix matches [(page_id, chained_hash)], stopping
-        at the first miss; always leaves >=1 token to recompute."""
+        """Cached full-page prefix matches, stopping at the first miss in
+        both tiers; always leaves >=1 token to recompute.
+
+        Returns ([(kind, page_id_or_None, chained_hash, page_tokens)],
+        n_full) where kind is "hbm" or "host"."""
+        if self.cfg.sp > 1:
+            # ring-attention prefill attends only within its chunk, so a
+            # shared prefix cannot be skipped — disable prefix matching
+            return [], 0
         from dynamo_tpu.engine.kv_cache import page_hash
         ps = self.cfg.page_size
         parent, out = 0, []
         n_full = (len(tokens) - 1) // ps
         for i in range(n_full):
-            h = page_hash(parent, tokens[i * ps:(i + 1) * ps])
+            toks = tokens[i * ps:(i + 1) * ps]
+            h = page_hash(parent, toks)
             pid = self.allocator.lookup(h)
-            if pid is None:
+            if pid is not None:
+                out.append(("hbm", pid, h, toks))
+            elif self.host_pool is not None and h in self.host_pool:
+                out.append(("host", None, h, toks))
+            else:
                 break
-            out.append((pid, h))
             parent = h
         return out, n_full
 
     def _match_prefix(self, seq: SequenceState) -> None:
-        """Share full pages already resident (prefix cache hit)."""
+        """Share resident full pages; onboard host-tier pages (prefix hit)."""
         ps = self.cfg.page_size
         matches, n_full = self._prefix_walk(seq.all_tokens)
-        self._prefix_hits += len(matches)
         self._prefix_lookups += min(len(matches) + 1, n_full)
-        for pid, h in matches:
-            self.allocator.share(pid)
+        parent = 0
+        for kind, pid, h, toks in matches:
+            if kind == "host":
+                # pull the page back into HBM: take a blank page now, the
+                # engine injects the payload before the next device step;
+                # pin the host entry so LRU can't drop it before the drain
+                if not self.allocator.can_allocate(1):
+                    break
+                self.host_pool.pin(h)
+                pid = self.allocator.allocate()
+                self.allocator.seal(pid, parent, toks)
+                self.pending_onboards.append((pid, h))
+                self.host_pool.stats.host_hits += 1
+            else:
+                self.allocator.share(pid)
             seq.pages.append(pid)
             seq.page_hashes.append(h)
             seq.num_cached += ps
+            self._prefix_hits += 1
+            parent = h
+
+    def drain_onboards(self) -> list:
+        out, self.pending_onboards = self.pending_onboards, []
+        return out
 
     def finish(self, seq: SequenceState) -> None:
         if seq.slot >= 0:

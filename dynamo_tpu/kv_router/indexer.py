@@ -115,6 +115,11 @@ class RadixTree:
                 if child is None:
                     child = _Node(blk.tokens_hash, node)
                     node.children[blk.tokens_hash] = child
+                # re-store under a new block_hash: drop the stale mapping
+                # (invariant: table entries are {bh: node.workers[w]==bh})
+                old = child.workers.get(worker)
+                if old is not None and old != blk.block_hash:
+                    table.pop(old, None)
                 child.workers[worker] = blk.block_hash
                 table[blk.block_hash] = child
                 node = child
@@ -168,9 +173,25 @@ class KvIndexer:
     overlap queries (reference indexer.rs:499-668)."""
 
     def __init__(self, block_size: int,
-                 expiration_duration_s: Optional[float] = None):
+                 expiration_duration_s: Optional[float] = None,
+                 native: object = "auto"):
         self.block_size = block_size
-        self.tree = RadixTree(expiration_duration_s)
+        # native C++ tree (dynamo_tpu/native/kv_indexer.cpp) when available;
+        # the Python tree is the fallback and the frequency-tracking path
+        self.tree = None
+        if native and expiration_duration_s is None:
+            try:  # lazy: native.radix imports MatchResult from this module
+                from dynamo_tpu.native import radix
+                if radix.available():
+                    self.tree = radix.NativeRadixTree()
+            except Exception:
+                if native is True:
+                    raise
+        if self.tree is None:
+            if native is True:
+                raise RuntimeError("native kv indexer requested but "
+                                   "unavailable")
+            self.tree = RadixTree(expiration_duration_s)
         self.events_applied = 0
         # tombstones: in-flight events from a removed worker must not
         # resurrect it (they'd leak ghost nodes forever, since a worker

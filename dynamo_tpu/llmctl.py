@@ -1,0 +1,82 @@
+"""llmctl: manage model->endpoint mappings in the discovery store.
+
+Reference equivalent: launch/llmctl/src/main.rs:115-300 — `llmctl http add
+chat-model <name> <endpoint>`, `list`, `remove` writing etcd keys the HTTP
+frontend's model watcher consumes.
+
+Usage:
+  python -m dynamo_tpu.llmctl [--control-host H --control-port P] list
+  python -m dynamo_tpu.llmctl add <name> <ns.component.endpoint> \
+      [--arch tiny] [--model-type chat] [--kv-routed]
+  python -m dynamo_tpu.llmctl remove <name> [--model-type chat]
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+
+from dynamo_tpu.frontend.discovery import (
+    list_registered_models, register_model, unregister_model,
+)
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+async def amain() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--control-host", default="127.0.0.1")
+    p.add_argument("--control-port", type=int, default=5550)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("list", help="list registered models")
+
+    pa = sub.add_parser("add", help="register a model->endpoint mapping")
+    pa.add_argument("name")
+    pa.add_argument("endpoint", help="ns.component.endpoint")
+    pa.add_argument("--arch", default="tiny")
+    pa.add_argument("--model-type", default="chat",
+                    choices=("chat", "completion"))
+    pa.add_argument("--kv-routed", action="store_true")
+
+    pr = sub.add_parser("remove", help="unregister a model")
+    pr.add_argument("name")
+    pr.add_argument("--model-type", default="chat")
+
+    args = p.parse_args()
+    runtime = await DistributedRuntime.connect(
+        args.control_host, args.control_port)
+    try:
+        if args.cmd == "list":
+            models = await list_registered_models(runtime.kv)
+            for key, payload in sorted(models.items()):
+                print(f"{key}\t{payload['namespace']}."
+                      f"{payload['component']}.{payload['endpoint']}\t"
+                      f"kv_routed={payload.get('kv_routed', False)}")
+            if not models:
+                print("(no models registered)")
+        elif args.cmd == "add":
+            try:
+                ns, comp, ep = args.endpoint.split(".", 2)
+            except ValueError:
+                raise SystemExit("endpoint must be ns.component.endpoint")
+            card = ModelDeploymentCard(name=args.name, arch=args.arch,
+                                       model_type=args.model_type)
+            await register_model(runtime.kv, args.name, ns, comp, card,
+                                 endpoint=ep, model_type=args.model_type,
+                                 kv_routed=args.kv_routed)
+            print(f"added {args.model_type} model {args.name} -> "
+                  f"{args.endpoint}")
+        elif args.cmd == "remove":
+            await unregister_model(runtime.kv, args.name, args.model_type)
+            print(f"removed {args.model_type} model {args.name}")
+    finally:
+        await runtime.shutdown()
+
+
+def main() -> None:
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

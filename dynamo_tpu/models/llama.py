@@ -25,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_kv_pages
+from dynamo_tpu.ops.moe import moe_dispatch_mlp
 from dynamo_tpu.ops.paged_attention import decode_paged_attention
 
 Params = Dict[str, Any]
@@ -127,11 +128,13 @@ def param_shardings(cfg: ModelConfig) -> Params:
         "mlp_norm": P(None, None),
     }
     if cfg.is_moe:
+        # experts shard over "ep", each expert's FFN dim over "tp"; on
+        # meshes without those axes (size 1) the specs are no-ops
         layers.update({
             "router": P(None, None, None),
-            "w_gate": P(None, "tp", None, None),
-            "w_up": P(None, "tp", None, None),
-            "w_down": P(None, "tp", None, None),
+            "w_gate": P(None, "ep", None, "tp"),
+            "w_up": P(None, "ep", None, "tp"),
+            "w_down": P(None, "ep", "tp", None),
         })
     else:
         layers.update({
@@ -218,11 +221,19 @@ def forward(
     params: Params,
     cfg: ModelConfig,
     tokens: jax.Array,            # [B, Tq] int32
-    cache: Dict[str, jax.Array],  # {"k","v"}: [L, P, ps, Hkv, hd]
+    cache: Dict[str, jax.Array],  # {"k","v"}: [L, Hkv, P, ps, hd]
     meta: AttnMetadata,
     input_embeds: Optional[jax.Array] = None,  # [B, Tq, D] overrides tokens
+    sp_mesh=None,  # Mesh with an "sp" axis: ring-attention prefill
 ) -> tuple[jax.Array, Dict[str, jax.Array]]:
-    """One paged forward step. Returns (logits [B, Tq, V], updated cache)."""
+    """One paged forward step. Returns (logits [B, Tq, V], updated cache).
+
+    When sp_mesh is given, prefill (Tq > 1) runs ring attention with the
+    sequence sharded over "sp" (ops/ring_attention.py) instead of attending
+    to the paged cache — the engine guarantees such prefills are whole-prompt
+    single chunks with no cached prefix (engine.py asserts, prefix matching
+    disabled), so chunk-internal attention IS the full attention.
+    """
     b, tq = tokens.shape
     h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
@@ -232,6 +243,18 @@ def forward(
         x = input_embeds.astype(_dtype(cfg))
 
     use_kernel = tq == 1 and _decode_kernel_mode(cfg) is not None
+    use_ring = sp_mesh is not None and tq > 1
+    if use_ring:
+        from jax.sharding import NamedSharding
+        from dynamo_tpu.ops.ring_attention import ring_attention
+        # shard the token axis so layernorm/projections parallelize over sp
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(sp_mesh, P(None, "sp", None)))
+        # padding slots carry position == last valid; mark keys invalid by
+        # index (valid tokens occupy the first kv_len slots of the chunk)
+        idx = jnp.arange(tq, dtype=jnp.int32)[None, :]
+        kv_positions = jnp.where(idx < meta.kv_lens[:, None],
+                                 meta.positions, -1)
 
     def layer_step(x, layer):
         lp, kc, vc = layer
@@ -247,13 +270,21 @@ def forward(
             attn = decode_paged_attention(
                 q[:, 0], kc, vc, meta.page_table, meta.kv_lens,
                 interpret=_decode_kernel_mode(cfg) == "interpret")[:, None]
+        elif use_ring:
+            attn = ring_attention(q, k, v, meta.positions, kv_positions,
+                                  sp_mesh)
         else:
             attn = paged_attention(q, kc, vc, meta.page_table, meta.kv_lens,
                                    meta.positions)
         x = x + jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd), lp["wo"])
 
         xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        mlp = _moe_mlp(xn, lp, cfg) if cfg.is_moe else _dense_mlp(xn, lp)
+        if not cfg.is_moe:
+            mlp = _dense_mlp(xn, lp)
+        elif cfg.moe_impl == "dense":
+            mlp = _moe_mlp(xn, lp, cfg)
+        else:
+            mlp = moe_dispatch_mlp(xn, lp, cfg, cfg.moe_capacity_factor)
         x = x + mlp
         return x, (kc, vc)
 

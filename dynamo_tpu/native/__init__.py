@@ -1,0 +1,57 @@
+"""Native (C++) runtime components, built on demand with the system g++.
+
+The reference keeps its runtime hot paths native (Rust runtime, C++ engine
+shim, CUDA block-copy kernel — SURVEY.md §2.1/§2.5/§2.8); this package holds
+our native equivalents, loaded via ctypes with pure-Python fallbacks so the
+framework works without a toolchain.
+
+Build model: `g++ -O2 -shared -fPIC` at first import, cached next to the
+source and rebuilt when the source is newer than the library.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger("dynamo_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIBS = {}
+
+
+def _build(name: str) -> Optional[str]:
+    src = os.path.join(_DIR, f"{name}.cpp")
+    lib = os.path.join(_DIR, f"lib{name}.so")
+    if os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
+        return lib
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", lib, src],
+            check=True, capture_output=True, timeout=120)
+        return lib
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        stderr = getattr(e, "stderr", b"") or b""
+        log.warning("native build of %s failed (%s); using Python fallback",
+                    name, stderr.decode(errors="replace")[:500] or e)
+        return None
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """Build (if needed) + dlopen a native component; None on failure."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        lib_path = _build(name)
+        lib = None
+        if lib_path is not None:
+            try:
+                lib = ctypes.CDLL(lib_path)
+            except OSError as e:
+                log.warning("dlopen %s failed: %s", lib_path, e)
+        _LIBS[name] = lib
+        return lib

@@ -20,7 +20,11 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 
 NEG_INF = -1e30
 
@@ -53,9 +57,12 @@ def _ring_local(axis: str, n: int, q, k, v, qpos, kpos):
     scale = hd ** -0.5
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    m = jnp.full((b, hkv, g, tq, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((b, hkv, g, tq, 1), jnp.float32)
-    acc = jnp.zeros((b, hkv, g, tq, hd), jnp.float32)
+    # mark the fresh accumulators as device-varying over the ring axis so
+    # the fori_loop carry types stay consistent (shard_map VMA tracking)
+    pvary = getattr(jax.lax, "pvary", lambda x, axes: x)
+    m = pvary(jnp.full((b, hkv, g, tq, 1), NEG_INF, jnp.float32), (axis,))
+    l = pvary(jnp.zeros((b, hkv, g, tq, 1), jnp.float32), (axis,))
+    acc = pvary(jnp.zeros((b, hkv, g, tq, hd), jnp.float32), (axis,))
 
     def step(i, carry):
         k_c, v_c, kpos_c, m, l, acc = carry
@@ -92,6 +99,5 @@ def ring_attention(
         mesh=mesh,
         in_specs=(seq, seq, seq, pos, pos),
         out_specs=seq,
-        check_rep=False,
     )
     return f(q, k, v, q_positions, kv_positions)

@@ -1,0 +1,206 @@
+"""Single-command launcher: `python -m dynamo_tpu.run in=X out=Y [model]`.
+
+Role of the reference's dynamo-run binary (reference:
+launch/dynamo-run/src/opt.rs:23-133 `in={http|text|stdin|batch|endpoint|
+none}` x `out={engines|echo|endpoint}`, lib.rs:54-260): one process that
+wires an input frontend to an engine and runs it.
+
+Inputs:
+  in=http[:port]     OpenAI HTTP server (default port 8080)
+  in=text            interactive chat REPL
+  in=stdin           one prompt from stdin -> streamed completion -> exit
+  in=batch:FILE      JSONL prompts -> JSONL completions on stdout
+  in=endpoint:NS.COMP.EP  serve the engine as a control-plane endpoint
+                     (worker mode; requires --control-host/--control-port)
+
+Outputs (engines):
+  out=native         in-process JAX engine (random-init weights unless the
+                     model spec is an HF dir with weights)
+  out=echo           deterministic token-echo engine (no hardware)
+
+Model spec: a named architecture from the config registry ("tiny",
+"llama3-1b", "llama3-8b", "mixtral-8x7b", ...) or a path to an HF-style
+model directory (config.json + tokenizer.json).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+import uuid
+
+from dynamo_tpu.engine.config import EngineConfig, get_model_config
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.pipeline import LocalPipeline
+from dynamo_tpu.llm.worker import (
+    EchoTokenEngine, NativeEngineWorker, serve_llm_worker,
+)
+from dynamo_tpu.protocols.openai import ChatCompletionRequest
+from dynamo_tpu.runtime.engine import Context
+
+log = logging.getLogger("dynamo_tpu.run")
+
+
+def build_card(model_spec: str) -> ModelDeploymentCard:
+    if os.path.isdir(model_spec):
+        return ModelDeploymentCard.from_hf_dir(model_spec)
+    return ModelDeploymentCard(name=model_spec, arch=model_spec,
+                               tokenizer_kind="byte")
+
+
+async def build_engine(out_spec: str, card: ModelDeploymentCard, args):
+    if out_spec == "echo":
+        return EchoTokenEngine(delay_s=args.echo_delay)
+    if out_spec != "native":
+        raise SystemExit(f"unknown out={out_spec!r}")
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.parallel.mesh import make_mesh
+    model_cfg = get_model_config(card.arch)
+    eng_cfg = EngineConfig(
+        page_size=card.kv_page_size, num_pages=args.num_pages,
+        max_slots=args.max_slots, max_prefill_chunk=args.max_prefill_chunk,
+        max_model_len=min(card.context_length, model_cfg.max_model_len),
+        tp=args.tp, host_pages=args.host_pages)
+    mesh = make_mesh(tp=args.tp) if args.tp > 1 else None
+    engine = NativeEngine(model_cfg, eng_cfg, mesh=mesh,
+                          eos_token_ids=set(card.eos_token_ids))
+    return await NativeEngineWorker(engine).start()
+
+
+async def run_http(pipe: LocalPipeline, card, port: int) -> None:
+    from dynamo_tpu.frontend.service import HttpService
+    service = await HttpService(port=port).start()
+    service.models.add(card.name, pipe, card.model_type)
+    print(f"READY http=:{service.port} model={card.name}", flush=True)
+    await asyncio.Event().wait()
+
+
+async def _stream_chat(pipe: LocalPipeline, card, prompt: str,
+                       max_tokens: int, out=sys.stdout) -> None:
+    req = ChatCompletionRequest(
+        model=card.name, stream=True, max_tokens=max_tokens,
+        messages=[{"role": "user", "content": prompt}])
+    ctx = Context(uuid.uuid4().hex)
+    async for chunk in pipe.generate_chat(req, ctx):
+        for choice in chunk.choices:
+            if choice.delta.content:
+                out.write(choice.delta.content)
+                out.flush()
+    out.write("\n")
+
+
+async def run_text(pipe: LocalPipeline, card, max_tokens: int) -> None:
+    print(f"model={card.name}; empty line to exit", flush=True)
+    loop = asyncio.get_running_loop()
+    while True:
+        line = await loop.run_in_executor(None, lambda: input("> "))
+        if not line.strip():
+            return
+        await _stream_chat(pipe, card, line, max_tokens)
+
+
+async def run_stdin(pipe: LocalPipeline, card, max_tokens: int) -> None:
+    prompt = sys.stdin.read().strip()
+    await _stream_chat(pipe, card, prompt, max_tokens)
+
+
+async def run_batch(pipe: LocalPipeline, card, path: str,
+                    max_tokens: int) -> None:
+    """JSONL in ({"prompt": ...}), JSONL out ({"prompt", "text"})."""
+    with open(path) as f:
+        prompts = [json.loads(line)["prompt"] for line in f if line.strip()]
+
+    async def one(prompt):
+        from dynamo_tpu.protocols.delta import aggregate_chat_chunks
+        req = ChatCompletionRequest(
+            model=card.name, stream=False, max_tokens=max_tokens,
+            messages=[{"role": "user", "content": prompt}])
+        chunks = [c async for c in pipe.generate_chat(req, Context())]
+        agg = aggregate_chat_chunks(chunks)
+        return {"prompt": prompt,
+                "text": agg.choices[0].message.content,
+                "finish_reason": agg.choices[0].finish_reason}
+
+    results = await asyncio.gather(*(one(p) for p in prompts))
+    for r in results:
+        print(json.dumps(r), flush=True)
+
+
+async def run_endpoint(engine, card, spec: str, args) -> None:
+    from dynamo_tpu.frontend.discovery import register_model
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    try:
+        ns, comp, ep = spec.split(".", 2)
+    except ValueError:
+        raise SystemExit("in=endpoint needs NS.COMPONENT.ENDPOINT")
+    runtime = await DistributedRuntime.connect(
+        args.control_host, args.control_port)
+    await serve_llm_worker(runtime, ns, comp, engine, endpoint=ep, card=card)
+    await register_model(runtime.kv, card.name, ns, comp, card, endpoint=ep,
+                         model_type=card.model_type)
+    print(f"READY endpoint={spec} model={card.name}", flush=True)
+    await runtime.shutdown_event.wait()
+
+
+async def amain() -> None:
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("io", nargs="+",
+                   help="in=... out=... [model] (order-free key=value)")
+    p.add_argument("--max-tokens", type=int, default=256)
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--max-slots", type=int, default=8)
+    p.add_argument("--max-prefill-chunk", type=int, default=512)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--host-pages", type=int, default=0)
+    p.add_argument("--echo-delay", type=float, default=0.0)
+    p.add_argument("--control-host", default="127.0.0.1")
+    p.add_argument("--control-port", type=int, default=5550)
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args()
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO)
+
+    in_spec, out_spec, model_spec = "text", "echo", "tiny"
+    for tok in args.io:
+        if tok.startswith("in="):
+            in_spec = tok[3:]
+        elif tok.startswith("out="):
+            out_spec = tok[4:]
+        else:
+            model_spec = tok
+
+    card = build_card(model_spec)
+    engine = await build_engine(out_spec, card, args)
+
+    if in_spec.startswith("endpoint:"):
+        await run_endpoint(engine, card, in_spec[len("endpoint:"):], args)
+        return
+    pipe = LocalPipeline(card, engine)
+    if in_spec.startswith("http"):
+        port = int(in_spec[5:]) if in_spec.startswith("http:") else 8080
+        await run_http(pipe, card, port)
+    elif in_spec == "text":
+        await run_text(pipe, card, args.max_tokens)
+    elif in_spec == "stdin":
+        await run_stdin(pipe, card, args.max_tokens)
+    elif in_spec.startswith("batch:"):
+        await run_batch(pipe, card, in_spec[len("batch:"):], args.max_tokens)
+    elif in_spec == "none":
+        print("READY (in=none; engine built, exiting)", flush=True)
+    else:
+        raise SystemExit(f"unknown in={in_spec!r}")
+
+
+def main() -> None:
+    try:
+        asyncio.run(amain())
+    except (KeyboardInterrupt, EOFError):
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""CLI smoke tests: dynamo_tpu.run (dynamo-run equivalent) + llmctl.
+
+Reference: launch/dynamo-run opt matrix + llmctl registry ops (SURVEY.md
+§2 L4). Subprocess-driven with the echo engine (no hardware, fast).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+
+
+def test_run_batch_echo(tmp_path):
+    batch = tmp_path / "b.jsonl"
+    batch.write_text('{"prompt": "hello"}\n{"prompt": "again"}\n')
+    out = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.run",
+         f"in=batch:{batch}", "out=echo", "tiny"],
+        capture_output=True, text=True, timeout=120, env=ENV, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
+    assert len(lines) == 2
+    assert "hello" in lines[0]["text"]
+    assert lines[0]["finish_reason"] == "stop"
+
+
+def test_run_stdin_echo():
+    out = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.run", "in=stdin", "out=echo"],
+        input="ping pong", capture_output=True, text=True, timeout=120,
+        env=ENV, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    assert "ping pong" in out.stdout
+
+
+def test_run_rejects_unknown_specs():
+    out = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.run", "in=bogus", "out=echo"],
+        capture_output=True, text=True, timeout=120, env=ENV, cwd=REPO)
+    assert out.returncode != 0
+    assert "unknown in=" in out.stderr
