@@ -19,6 +19,9 @@ NOMINAL_BASELINE_TOK_S = 1000.0  # ~40% of single-chip roofline at batch 8
 
 
 def main():
+    import dataclasses
+    import sys
+
     import jax
 
     from dynamo_tpu.engine.config import EngineConfig, get_model_config
@@ -30,20 +33,30 @@ def main():
     cfg = EngineConfig(
         page_size=64, num_pages=256, max_slots=slots, max_prefill_chunk=512,
         prefill_buckets=(128,), max_model_len=2048)
-    engine = NativeEngine(model_cfg, cfg, seed=0)
 
     prompt_len, gen_len = 128, 128
     params = SamplingParams(max_tokens=gen_len + 64, temperature=0.0,
                             ignore_eos=True)
-    for i in range(slots):
-        prompt = [(7 * i + j) % 1000 + 1 for j in range(prompt_len)]
-        engine.add_request(EngineRequest(f"bench-{i}", prompt, params))
 
-    # warmup: prefill all + a few decode steps (includes compiles)
-    while engine.scheduler.waiting:
-        engine.step()
-    for _ in range(10):
-        engine.step()
+    def build_and_warm(mcfg):
+        engine = NativeEngine(mcfg, cfg, seed=0)
+        for i in range(slots):
+            prompt = [(7 * i + j) % 1000 + 1 for j in range(prompt_len)]
+            engine.add_request(EngineRequest(f"bench-{i}", prompt, params))
+        # warmup: prefill all + a few decode steps (includes compiles)
+        while engine.scheduler.waiting:
+            engine.step()
+        for _ in range(10):
+            engine.step()
+        return engine
+
+    try:
+        engine = build_and_warm(model_cfg)
+    except Exception as e:  # pallas decode kernel unavailable on this chip
+        print(f"decode kernel path failed ({type(e).__name__}: {e}); "
+              "falling back to XLA gather attention", file=sys.stderr)
+        engine = build_and_warm(
+            dataclasses.replace(model_cfg, decode_kernel="off"))
 
     # timed steady-state decode
     n_steps = 50

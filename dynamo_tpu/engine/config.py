@@ -26,6 +26,7 @@ class ModelConfig:
     head_dim: int = 32
     rope_theta: float = 500000.0
     rms_norm_eps: float = 1e-5
+    attn_bias: bool = False      # q/k/v projection bias (Qwen2-style)
     max_model_len: int = 2048
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"

@@ -305,6 +305,12 @@ class NativeEngine:
 
     def allocate_remote(self, req: EngineRequest):
         """Decode side: allocate pages up-front for a remote prefill."""
+        if self.cfg.sp > 1:
+            # an sp engine's prefill path is ring attention over the whole
+            # prompt; remote activation would re-enter scheduling with a
+            # mid-sequence chunk the ring path must not see. SP engines are
+            # the prefill side of disaggregation, not the decode side.
+            return None
         return self.scheduler.add_remote(req)
 
     def activate_remote(self, request_id: str, first_token: int) -> None:

@@ -253,13 +253,22 @@ class Scheduler:
         return out, n_full
 
     def _match_prefix(self, seq: SequenceState) -> None:
-        """Share resident full pages; onboard host-tier pages (prefix hit)."""
+        """Share resident full pages; onboard host-tier pages (prefix hit).
+
+        Each hash is RE-resolved at application time: an onboard's
+        allocate() below can evict a reusable page the walk saw as an HBM
+        hit (the eviction offloads it, so it typically resolves as a host
+        hit instead). Trusting the walk's page ids would alias one physical
+        page under two prefix positions — silent wrong KV."""
         ps = self.cfg.page_size
         matches, n_full = self._prefix_walk(seq.all_tokens)
         self._prefix_lookups += min(len(matches) + 1, n_full)
         parent = 0
-        for kind, pid, h, toks in matches:
-            if kind == "host":
+        for _kind, _pid, h, toks in matches:
+            pid = self.allocator.lookup(h)
+            if pid is not None:
+                self.allocator.share(pid)
+            elif self.host_pool is not None and h in self.host_pool:
                 # pull the page back into HBM: take a blank page now, the
                 # engine injects the payload before the next device step;
                 # pin the host entry so LRU can't drop it before the drain
@@ -271,7 +280,7 @@ class Scheduler:
                 self.pending_onboards.append((pid, h))
                 self.host_pool.stats.host_hits += 1
             else:
-                self.allocator.share(pid)
+                break
             seq.pages.append(pid)
             seq.page_hashes.append(h)
             seq.num_cached += ps

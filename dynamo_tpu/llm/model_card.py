@@ -30,6 +30,10 @@ class ModelDeploymentCard:
     kv_page_size: int = 64
     eos_token_ids: List[int] = dataclasses.field(default_factory=list)
     bos_token_id: Optional[int] = None
+    # HF-sourced models: raw config.json dict (drives ModelConfig) and the
+    # checkpoint dir (drives weight loading, models/loader.py)
+    hf_config: Optional[Dict[str, Any]] = None
+    model_path: Optional[str] = None
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
@@ -47,6 +51,9 @@ class ModelDeploymentCard:
         return cls(**d)
 
     def model_config(self) -> ModelConfig:
+        if self.hf_config is not None:
+            from dynamo_tpu.models.loader import config_from_hf
+            return config_from_hf(self.hf_config, name=self.name)
         return get_model_config(self.arch)
 
     def load_tokenizer(self):
@@ -74,13 +81,21 @@ class ModelDeploymentCard:
             with open(tok_cfg_path) as f:
                 tok_cfg = json.load(f)
             chat_template = tok_cfg.get("chat_template")
+        tok_json = os.path.join(path, "tokenizer.json")
+        if not os.path.exists(tok_json):
+            import logging
+            logging.getLogger("dynamo_tpu.model_card").warning(
+                "%s has no tokenizer.json; falling back to byte-level "
+                "tokenization (text will be garbage for real models)", path)
         return cls(
             name=name or os.path.basename(path.rstrip("/")),
             arch=arch or "tiny",
-            tokenizer_kind="hf",
-            tokenizer_path=os.path.join(path, "tokenizer.json"),
+            tokenizer_kind="hf" if os.path.exists(tok_json) else "byte",
+            tokenizer_path=tok_json if os.path.exists(tok_json) else None,
             chat_template=chat_template,
             context_length=int(hf.get("max_position_embeddings", 2048)),
             eos_token_ids=eos,
             bos_token_id=hf.get("bos_token_id"),
+            hf_config=hf,
+            model_path=path,
         )

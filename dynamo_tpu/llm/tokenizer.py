@@ -68,7 +68,10 @@ class ByteTokenizer(BaseTokenizer):
         return [b + 3 for b in text.encode("utf-8")]
 
     def decode(self, ids: Sequence[int]) -> str:
-        return bytes(i - 3 for i in ids if i >= 3).decode("utf-8", "replace")
+        # specials (<3) and ids beyond the byte range (a model vocab can
+        # exceed 259) are skipped rather than crashing the detokenizer
+        return bytes(i - 3 for i in ids
+                     if 3 <= i < 259).decode("utf-8", "replace")
 
     @property
     def vocab_size(self) -> int:

@@ -85,6 +85,12 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         "wo": dense(keys[3], (l, h * hd, d), h * hd),
         "mlp_norm": jnp.ones((l, d), dt),
     }
+    if cfg.attn_bias:
+        layers.update({
+            "wq_b": jnp.zeros((l, h * hd), dt),
+            "wk_b": jnp.zeros((l, hkv * hd), dt),
+            "wv_b": jnp.zeros((l, hkv * hd), dt),
+        })
     if cfg.is_moe:
         e = cfg.num_experts
         layers.update({
@@ -127,6 +133,12 @@ def param_shardings(cfg: ModelConfig) -> Params:
         "wo": P(None, "tp", None),
         "mlp_norm": P(None, None),
     }
+    if cfg.attn_bias:
+        layers.update({
+            "wq_b": P(None, "tp"),
+            "wk_b": P(None, "tp"),
+            "wv_b": P(None, "tp"),
+        })
     if cfg.is_moe:
         # experts shard over "ep", each expert's FFN dim over "tp"; on
         # meshes without those axes (size 1) the specs are no-ops
@@ -259,9 +271,14 @@ def forward(
     def layer_step(x, layer):
         lp, kc, vc = layer
         xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("btd,de->bte", xn, lp["wq"]).reshape(b, tq, h, hd)
-        k = jnp.einsum("btd,de->bte", xn, lp["wk"]).reshape(b, tq, hkv, hd)
-        v = jnp.einsum("btd,de->bte", xn, lp["wv"]).reshape(b, tq, hkv, hd)
+        q = jnp.einsum("btd,de->bte", xn, lp["wq"])
+        k = jnp.einsum("btd,de->bte", xn, lp["wk"])
+        v = jnp.einsum("btd,de->bte", xn, lp["wv"])
+        if cfg.attn_bias:
+            q, k, v = q + lp["wq_b"], k + lp["wk_b"], v + lp["wv_b"]
+        q = q.reshape(b, tq, h, hd)
+        k = k.reshape(b, tq, hkv, hd)
+        v = v.reshape(b, tq, hkv, hd)
         q = apply_rope(q, meta.positions, cfg.rope_theta)
         k = apply_rope(k, meta.positions, cfg.rope_theta)
         kc, vc = write_kv_pages(kc, vc, k, v, meta.write_idx)

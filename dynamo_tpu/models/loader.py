@@ -1,0 +1,155 @@
+"""HF checkpoint loading: config.json -> ModelConfig, safetensors -> params.
+
+Role of the reference's model sourcing path (reference:
+launch/dynamo-run/src/hub.rs HF download + model_card/create.rs building the
+MDC from a local HF dir; actual weight loading is delegated to the engines).
+Here the engine is ours, so loading is first-class: map HF checkpoint tensor
+names (Llama/Qwen2/Mixtral families) onto the stacked-layer functional
+params used by models/llama.py, in the engine dtype, ready for device_put
+with param_shardings.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+from dynamo_tpu.engine.config import ModelConfig
+
+ARCHES = {
+    "LlamaForCausalLM": "llama",
+    "MistralForCausalLM": "llama",
+    "Qwen2ForCausalLM": "qwen2",
+    "MixtralForCausalLM": "mixtral",
+}
+
+
+def config_from_hf(hf: Dict[str, Any], name: str = "") -> ModelConfig:
+    """Map an HF config.json dict onto our ModelConfig."""
+    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    if arch not in ARCHES:
+        raise ValueError(f"unsupported architecture {arch!r} "
+                         f"(supported: {sorted(ARCHES)})")
+    family = ARCHES[arch]
+    heads = hf["num_attention_heads"]
+    moe = family == "mixtral"
+    return ModelConfig(
+        name=name or hf.get("model_type", family),
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=heads,
+        num_kv_heads=hf.get("num_key_value_heads", heads),
+        head_dim=hf.get("head_dim") or hf["hidden_size"] // heads,
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        max_model_len=int(hf.get("max_position_embeddings", 2048)),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        attn_bias=(family == "qwen2") or bool(hf.get("attention_bias",
+                                                     False)),
+        num_experts=int(hf.get("num_local_experts", 0)) if moe else 0,
+        num_experts_per_tok=int(hf.get("num_experts_per_tok", 2)),
+    )
+
+
+def _read_all_tensors(path: str) -> Dict[str, np.ndarray]:
+    from safetensors import safe_open
+    files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no *.safetensors under {path}")
+    out: Dict[str, np.ndarray] = {}
+    for f in files:
+        with safe_open(f, framework="np") as st:
+            for key in st.keys():
+                out[key] = st.get_tensor(key)
+    return out
+
+
+def load_params_from_hf(path: str, cfg: ModelConfig,
+                        dtype: str = "") -> Dict[str, Any]:
+    """Read an HF-style dir into our stacked-layer params pytree (numpy).
+
+    Tensor name mapping (HF stores projections as [out, in]; ours are
+    [in, out], hence the transposes):
+      model.embed_tokens.weight          -> embed
+      model.layers.{i}.input_layernorm   -> attn_norm[i]
+      .self_attn.{q,k,v}_proj.weight(.T) -> wq/wk/wv[i] (+ .bias -> w*_b)
+      .self_attn.o_proj.weight.T         -> wo[i]
+      .post_attention_layernorm          -> mlp_norm[i]
+      .mlp.{gate,up,down}_proj.weight.T  -> w_gate/w_up/w_down[i]
+      .block_sparse_moe.gate.weight.T    -> router[i]        (Mixtral)
+      .block_sparse_moe.experts.{e}.w{1,3,2}.T -> w_gate/up/down[i,e]
+      model.norm.weight                  -> final_norm
+      lm_head.weight.T                   -> lm_head (absent when tied)
+    """
+    import jax.numpy as jnp
+    dt = jnp.empty((), dtype or cfg.dtype).dtype
+    raw = _read_all_tensors(path)
+
+    def t(name):  # transposed projection in target dtype
+        return np.asarray(raw[name].T, dtype=dt)
+
+    def w(name):
+        return np.asarray(raw[name], dtype=dt)
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(cfg.num_layers)])
+
+    pre = "model.layers.{}"
+    layers: Dict[str, Any] = {
+        "attn_norm": stack(
+            lambda i: w(f"model.layers.{i}.input_layernorm.weight")),
+        "wq": stack(lambda i: t(f"model.layers.{i}.self_attn.q_proj.weight")),
+        "wk": stack(lambda i: t(f"model.layers.{i}.self_attn.k_proj.weight")),
+        "wv": stack(lambda i: t(f"model.layers.{i}.self_attn.v_proj.weight")),
+        "wo": stack(lambda i: t(f"model.layers.{i}.self_attn.o_proj.weight")),
+        "mlp_norm": stack(
+            lambda i: w(f"model.layers.{i}.post_attention_layernorm.weight")),
+    }
+    if cfg.attn_bias:
+        for ours, theirs in (("wq_b", "q_proj"), ("wk_b", "k_proj"),
+                             ("wv_b", "v_proj")):
+            layers[ours] = stack(
+                lambda i, p=theirs:
+                w(f"model.layers.{i}.self_attn.{p}.bias"))
+    if cfg.is_moe:
+        moe = "model.layers.{}.block_sparse_moe"
+        layers["router"] = stack(
+            lambda i: t(moe.format(i) + ".gate.weight"))
+        for ours, theirs in (("w_gate", "w1"), ("w_up", "w3"),
+                             ("w_down", "w2")):
+            layers[ours] = np.stack([
+                np.stack([t(moe.format(i) + f".experts.{e}.{theirs}.weight")
+                          for e in range(cfg.num_experts)])
+                for i in range(cfg.num_layers)])
+    else:
+        layers["w_gate"] = stack(
+            lambda i: t(f"model.layers.{i}.mlp.gate_proj.weight"))
+        layers["w_up"] = stack(
+            lambda i: t(f"model.layers.{i}.mlp.up_proj.weight"))
+        layers["w_down"] = stack(
+            lambda i: t(f"model.layers.{i}.mlp.down_proj.weight"))
+
+    params: Dict[str, Any] = {
+        "embed": w("model.embed_tokens.weight"),
+        "layers": layers,
+        "final_norm": w("model.norm.weight"),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = t("lm_head.weight")
+    return params
+
+
+def load_model_dir(path: str, dtype: str = ""):
+    """Convenience: (ModelConfig, params) from one HF-style directory."""
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    cfg = config_from_hf(hf, name=os.path.basename(path.rstrip("/")))
+    if dtype:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, dtype=dtype)
+    return cfg, load_params_from_hf(path, cfg)

@@ -27,14 +27,19 @@ _LIBS = {}
 def _build(name: str) -> Optional[str]:
     src = os.path.join(_DIR, f"{name}.cpp")
     lib = os.path.join(_DIR, f"lib{name}.so")
-    if os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
-        return lib
     try:
+        if os.path.exists(lib) \
+                and os.path.getmtime(lib) >= os.path.getmtime(src):
+            return lib
+        # compile to a private temp path and rename into place: a concurrent
+        # process must never dlopen a partially-written .so
+        tmp = f"{lib}.{os.getpid()}.tmp"
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", lib, src],
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src],
             check=True, capture_output=True, timeout=120)
+        os.replace(tmp, lib)
         return lib
-    except (subprocess.SubprocessError, FileNotFoundError) as e:
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
         stderr = getattr(e, "stderr", b"") or b""
         log.warning("native build of %s failed (%s); using Python fallback",
                     name, stderr.decode(errors="replace")[:500] or e)

@@ -30,15 +30,17 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _decode_kernel(ps: int, pt_ref, lens_ref, q_ref, k_hbm, v_hbm, o_ref,
-                   k_buf, v_buf, sems):
+def _decode_kernel(ps: int, g: int, pt_ref, lens_ref, q_ref, k_hbm, v_hbm,
+                   o_ref, k_buf, v_buf, sems):
     s = pl.program_id(0)
     j = pl.program_id(1)
     kv_len = lens_ref[s]
     n_pages = pl.cdiv(kv_len, ps)
 
-    g, hd = q_ref.shape[1], q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32) * (hd ** -0.5)   # [G, hd]
+    hd = q_ref.shape[2]
+    # the q/o blocks span all H heads (TPU block tiling disallows a G-row
+    # block when G < 8); slice this kv-head's G query rows dynamically
+    q = q_ref[0, pl.ds(j * g, g), :].astype(jnp.float32) * (hd ** -0.5)
 
     def dma(i, slot, hbm, buf, kv):
         return pltpu.make_async_copy(
@@ -82,7 +84,7 @@ def _decode_kernel(ps: int, pt_ref, lens_ref, q_ref, k_hbm, v_hbm, o_ref,
     l0 = jnp.zeros((g, 1), jnp.float32)
     acc0 = jnp.zeros((g, hd), jnp.float32)
     _, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    o_ref[0, pl.ds(j * g, g), :] = (acc / l).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -107,11 +109,13 @@ def decode_paged_attention(
         num_scalar_prefetch=2,
         grid=(s, hkv),
         in_specs=[
-            pl.BlockSpec((1, g, hd), lambda i, j, *_: (i, j, 0)),
+            # full-head block per sequence; kv-head j slices its G rows
+            # (same block for every j => stays resident across the j loop)
+            pl.BlockSpec((1, h, hd), lambda i, j, *_: (i, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, g, hd), lambda i, j, *_: (i, j, 0)),
+        out_specs=pl.BlockSpec((1, h, hd), lambda i, j, *_: (i, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, ps, hd), k_cache.dtype),
             pltpu.VMEM((2, ps, hd), v_cache.dtype),
@@ -119,7 +123,7 @@ def decode_paged_attention(
         ],
     )
     return pl.pallas_call(
-        functools.partial(_decode_kernel, ps),
+        functools.partial(_decode_kernel, ps, g),
         out_shape=jax.ShapeDtypeStruct((s, h, hd), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,

@@ -56,16 +56,24 @@ async def build_engine(out_spec: str, card: ModelDeploymentCard, args):
         return EchoTokenEngine(delay_s=args.echo_delay)
     if out_spec != "native":
         raise SystemExit(f"unknown out={out_spec!r}")
+    import glob
+
     from dynamo_tpu.engine.engine import NativeEngine
     from dynamo_tpu.parallel.mesh import make_mesh
-    model_cfg = get_model_config(card.arch)
+    model_cfg = card.model_config()
+    params = None
+    if card.model_path and glob.glob(
+            os.path.join(card.model_path, "*.safetensors")):
+        from dynamo_tpu.models.loader import load_params_from_hf
+        log.info("loading weights from %s", card.model_path)
+        params = load_params_from_hf(card.model_path, model_cfg)
     eng_cfg = EngineConfig(
         page_size=card.kv_page_size, num_pages=args.num_pages,
         max_slots=args.max_slots, max_prefill_chunk=args.max_prefill_chunk,
         max_model_len=min(card.context_length, model_cfg.max_model_len),
         tp=args.tp, host_pages=args.host_pages)
     mesh = make_mesh(tp=args.tp) if args.tp > 1 else None
-    engine = NativeEngine(model_cfg, eng_cfg, mesh=mesh,
+    engine = NativeEngine(model_cfg, eng_cfg, mesh=mesh, params=params,
                           eos_token_ids=set(card.eos_token_ids))
     return await NativeEngineWorker(engine).start()
 
@@ -180,8 +188,9 @@ async def amain() -> None:
         await run_endpoint(engine, card, in_spec[len("endpoint:"):], args)
         return
     pipe = LocalPipeline(card, engine)
-    if in_spec.startswith("http"):
-        port = int(in_spec[5:]) if in_spec.startswith("http:") else 8080
+    if in_spec == "http" or (in_spec.startswith("http:")
+                             and in_spec[5:].isdigit()):
+        port = int(in_spec[5:]) if in_spec != "http" else 8080
         await run_http(pipe, card, port)
     elif in_spec == "text":
         await run_text(pipe, card, args.max_tokens)
