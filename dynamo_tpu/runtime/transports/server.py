@@ -249,6 +249,7 @@ class ControlPlaneServer:
     async def serve_forever(self):
         await self.start()
         log.info("control plane listening on %s:%d", self.host, self.port)
+        print(f"READY control-plane=:{self.port}", flush=True)
         await asyncio.Event().wait()
 
 

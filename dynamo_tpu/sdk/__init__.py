@@ -1,0 +1,18 @@
+"""Service-graph SDK: declare component graphs in Python, launch them.
+
+Role of the reference's Python SDK (reference: deploy/dynamo/sdk —
+`@service(dynamo={...})` BentoML-derived classes, `@dynamo_endpoint`
+methods, `depends()` edges, YAML config via the DYNAMO_SERVICE_CONFIG env
+JSON, `dynamo serve` spawning one process per service under a circus
+arbiter; SURVEY.md §2.11/§3.5). Here the runtime is ours: a service is a
+plain class, endpoints are async-generator methods, `depends()` resolves to
+runtime Clients at startup, and the supervisor (sdk/serve.py) spawns one
+process per service against the control-plane server.
+"""
+from dynamo_tpu.sdk.config import ServiceConfig
+from dynamo_tpu.sdk.service import (
+    Depends, async_on_start, depends, endpoint, service,
+)
+
+__all__ = ["service", "endpoint", "depends", "Depends", "async_on_start",
+           "ServiceConfig"]

@@ -1,0 +1,35 @@
+"""Per-service accelerator assignment (reference: sdk cli/allocator.py:33-99
+— the CUDA_VISIBLE_DEVICES math; here the unit is TPU chips).
+
+The supervisor hands each service worker a disjoint chip set via the env
+the TPU runtime respects (TPU_VISIBLE_CHIPS for PJRT). Services with no
+"tpu" resource get JAX_PLATFORMS=cpu so they never grab the chips
+(processors/routers/frontends are host-only).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+class ChipAllocator:
+    def __init__(self, total_chips: int):
+        self.total = total_chips
+        self._next = 0
+
+    def assign(self, n: int) -> List[int]:
+        if self._next + n > self.total:
+            raise RuntimeError(
+                f"not enough TPU chips: need {n}, "
+                f"{self.total - self._next} of {self.total} left")
+        chips = list(range(self._next, self._next + n))
+        self._next += n
+        return chips
+
+    def env_for(self, resources: Dict) -> Dict[str, str]:
+        n = int(resources.get("tpu", 0))
+        if n <= 0:
+            # host-only service: keep it off the chips entirely
+            return {"JAX_PLATFORMS": "cpu"}
+        chips = self.assign(n)
+        return {"TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chips),
+                "TPU_CHIPS_PER_PROCESS_BOUNDS": f"1,{n},1"}

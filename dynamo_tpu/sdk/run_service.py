@@ -1,0 +1,67 @@
+"""Per-process service entry (reference: sdk cli/serve_dynamo.py:107-191).
+
+Spawned by the supervisor (sdk/serve.py), one process per service worker:
+connect the runtime, instantiate the service class, resolve depends() edges
+to ServiceClients, run @async_on_start hooks, serve the endpoints, block.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib
+import logging
+import sys
+
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.sdk.client import ServiceClient
+
+log = logging.getLogger("dynamo_tpu.sdk")
+
+
+def resolve(spec_str: str):
+    mod_name, _, cls_name = spec_str.partition(":")
+    mod = importlib.import_module(mod_name)
+    cls = getattr(mod, cls_name)
+    if not hasattr(cls, "__service_spec__"):
+        raise SystemExit(f"{spec_str} is not a @service class")
+    return cls
+
+
+async def serve_service(cls, runtime) -> None:
+    spec = cls.__service_spec__
+    inst = cls()
+    for attr, dep_cls in spec.dependencies.items():
+        setattr(inst, attr,
+                ServiceClient(runtime, dep_cls.__service_spec__))
+    for hook in spec.start_hooks:
+        await getattr(inst, hook)()
+    comp = runtime.namespace(spec.namespace).component(spec.component)
+    stats = getattr(inst, "stats_handler", None)
+    for ep_name, attr in spec.endpoints.items():
+        await comp.endpoint(ep_name).serve(
+            getattr(inst, attr), stats_handler=stats)
+    shutdown = getattr(inst, "shutdown", None)
+    runtime._service_instance = inst  # keep alive
+    print(f"READY service={spec.name} worker={runtime.worker_id}",
+          flush=True)
+
+
+async def amain() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("service", help="module.path:ClassName")
+    p.add_argument("--control-host", default="127.0.0.1")
+    p.add_argument("--control-port", type=int, default=5550)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    cls = resolve(args.service)
+    runtime = await DistributedRuntime.connect(
+        args.control_host, args.control_port)
+    await serve_service(cls, runtime)
+    await runtime.shutdown_event.wait()
+
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(amain())
+    except KeyboardInterrupt:
+        sys.exit(0)

@@ -1,0 +1,40 @@
+"""Fixture service graph for SDK tests (importable by spawned processes).
+
+Shape mirrors the reference's canonical example (reference: examples/llm —
+Processor depends on Worker; SURVEY.md §3.2) at toy scale: the Worker
+upper-cases tokens, the Processor splits text and fans frames back.
+"""
+from dynamo_tpu.sdk import async_on_start, depends, endpoint, service
+from dynamo_tpu.sdk.config import ServiceConfig
+
+
+@service(name="EchoWorker", namespace="sdktest", component="worker")
+class EchoWorker:
+    def __init__(self):
+        self.cfg = ServiceConfig.global_instance().for_service("EchoWorker")
+        self.prefix = self.cfg.get("prefix", "")
+        self.started = False
+
+    @async_on_start
+    async def boot(self):
+        self.started = True
+
+    @endpoint()
+    async def generate(self, request, context):
+        assert self.started
+        for word in request["text"].split():
+            yield {"word": self.prefix + word.upper()}
+
+
+@service(name="Processor", namespace="sdktest", component="processor")
+class Processor:
+    worker = depends(EchoWorker)
+
+    @endpoint()
+    async def generate(self, request, context):
+        n = 0
+        stream = await self.worker.generate(request)
+        async for frame in stream:
+            n += 1
+            yield frame
+        yield {"count": n}
