@@ -1,0 +1,246 @@
+"""Cross-process KV transfer: the reference's NIXL role, TPU-style.
+
+The reference moves KV between separate engine processes with one-sided
+RDMA (DynamoNixlConnector register_kv_caches/read_blocks/write_blocks in
+the vLLM patch, SURVEY.md §2.7) plus a Triton relayout kernel when prefill
+TP != decode TP, with per-engine agent metadata published to etcd
+(examples/llm/utils/nixl.py:57-105). TPUs expose no user-level one-sided
+RDMA into HBM, so the TPU-native equivalent is a dedicated page-transfer
+data plane:
+
+- decode side: `KvTransferServer`, a per-worker TCP listener (separate from
+  the request plane, like NIXL's UCX side channel). Pages arrive host-side
+  in bounded chunks; `jax.device_put` onto the decode mesh with the cache
+  sharding is both the host->HBM DMA and the TP relayout (resharding
+  replaces kv_rearrange). Injection is rejected when the request is no
+  longer pending (decode timed out and reallocated the pages).
+- prefill side: `RemoteTransferBackend` resolves engine_id ->
+  {host, port} through the discovery KV (`kv_transfer/{engine_id}`, written
+  under the decode worker's lease — the NixlMetadataStore role, lazily
+  fetched and cached), keeps one pooled connection per decode engine, and
+  streams msgpack frames with raw page bytes.
+
+Chunk sizes are bucketed to powers of two so the decode engine compiles a
+bounded set of inject programs (same static-shape discipline as the
+scheduler's page buckets).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+import msgpack
+
+from dynamo_tpu.disagg.transfer import TransferBackend
+from dynamo_tpu.runtime.transports.base import KVStore
+from dynamo_tpu.runtime.transports.wire import read_frame, write_frame
+
+log = logging.getLogger("dynamo_tpu.disagg.transfer")
+
+KV_TRANSFER_PREFIX = "kv_transfer/"
+
+
+def transfer_key(engine_id: str) -> str:
+    return f"{KV_TRANSFER_PREFIX}{engine_id}"
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import jax.numpy as jnp  # bfloat16 etc. (ml_dtypes-backed)
+        return np.dtype(getattr(jnp, name))
+
+
+def _pow2_pad(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class KvTransferServer:
+    """Decode-side page-injection listener for one engine worker."""
+
+    def __init__(self, worker, engine_id: str, host: str = "127.0.0.1",
+                 port: int = 0, advertise_host: Optional[str] = None):
+        self.worker = worker
+        self.engine_id = engine_id
+        self.host, self.port = host, port
+        self.advertise_host = advertise_host or host
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.received_pages = 0
+
+    async def start(self) -> "KvTransferServer":
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._on_connect, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    @property
+    def connection_info(self) -> Dict[str, object]:
+        return {"host": self.advertise_host, "port": self.port}
+
+    async def register(self, kv: KVStore, lease_id: int = 0) -> None:
+        """Publish engine_id -> connection info in the discovery KV, under
+        the worker's lease so the key vanishes with the worker."""
+        await kv.put(transfer_key(self.engine_id),
+                     msgpack.packb(self.connection_info, use_bin_type=True),
+                     lease_id=lease_id)
+
+    async def _on_connect(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                frame = await read_frame(reader)
+                try:
+                    await self._inject_frame(frame)
+                    write_frame(writer, {"ok": True})
+                except Exception as e:  # noqa: BLE001 — sent to the peer
+                    log.warning("kv inject rejected: %s", e)
+                    write_frame(writer, {"ok": False,
+                                         "error": f"{type(e).__name__}: {e}"})
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def _inject_frame(self, frame: Dict) -> None:
+        rid = frame["request_id"]
+        page_ids = list(frame["page_ids"])
+        shape = tuple(frame["shape"])
+        dtype = _np_dtype(frame["dtype"])
+        k = np.frombuffer(frame["k"], dtype=dtype).reshape(shape)
+        v = np.frombuffer(frame["v"], dtype=dtype).reshape(shape)
+        # host -> decode HBM with the decode cache sharding: the transfer
+        # AND the tp relayout in one device_put (kv_rearrange equivalent)
+        shd = self.worker.engine.cache_sharding
+        k_dev = jax.device_put(k, shd)
+        v_dev = jax.device_put(v, shd)
+
+        def inject(eng):
+            if rid not in eng.scheduler.remote:
+                raise KeyError(
+                    f"request {rid!r} no longer pending on "
+                    f"{self.engine_id!r}")
+            eng.inject_pages(page_ids, k_dev, v_dev)
+
+        await self.worker.submit(inject)
+        self.received_pages += len(page_ids)
+
+
+class RemoteTransferBackend(TransferBackend):
+    """Prefill-side client shipping pages to remote decode engines."""
+
+    def __init__(self, kv: KVStore, chunk_pages: int = 16,
+                 connect_timeout_s: float = 10.0):
+        self._kv = kv
+        self.chunk_pages = chunk_pages
+        self.connect_timeout_s = connect_timeout_s
+        self._conns: Dict[str, Tuple[asyncio.StreamReader,
+                                     asyncio.StreamWriter]] = {}
+        self._locks: Dict[str, asyncio.Lock] = {}
+        self._meta: Dict[str, Dict] = {}
+        self.sent_pages = 0
+
+    # -- connection management ------------------------------------------------
+
+    async def _resolve(self, engine_id: str) -> Dict:
+        meta = self._meta.get(engine_id)
+        if meta is None:
+            raw = await self._kv.get(transfer_key(engine_id))
+            if raw is None:
+                raise KeyError(
+                    f"no kv-transfer metadata for engine {engine_id!r} "
+                    "(decode worker gone?)")
+            meta = msgpack.unpackb(raw, raw=False)
+            self._meta[engine_id] = meta
+        return meta
+
+    async def _connect(self, engine_id: str):
+        conn = self._conns.get(engine_id)
+        if conn is not None and not conn[1].is_closing():
+            return conn
+        meta = await self._resolve(engine_id)
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(meta["host"], int(meta["port"])),
+            self.connect_timeout_s)
+        self._conns[engine_id] = (reader, writer)
+        return reader, writer
+
+    def _drop(self, engine_id: str) -> None:
+        conn = self._conns.pop(engine_id, None)
+        if conn is not None:
+            conn[1].close()
+        self._meta.pop(engine_id, None)  # re-resolve: worker may have moved
+
+    async def close(self) -> None:
+        for engine_id in list(self._conns):
+            self._drop(engine_id)
+
+    # -- transfer -------------------------------------------------------------
+
+    async def send_pages(self, engine_id: str, request_id: str, dst_page_ids,
+                         k_pages, v_pages) -> None:
+        ids = list(dst_page_ids)
+        n = len(ids)
+        if n == 0:
+            return
+        lock = self._locks.setdefault(engine_id, asyncio.Lock())
+        async with lock:
+            try:
+                await self._send_chunks(engine_id, request_id, ids,
+                                        k_pages, v_pages)
+            except (ConnectionError, asyncio.IncompleteReadError, OSError):
+                # stale pooled connection or decode restart: re-resolve the
+                # metadata and retry once from the top (injects of the same
+                # pages are idempotent)
+                self._drop(engine_id)
+                await self._send_chunks(engine_id, request_id, ids,
+                                        k_pages, v_pages)
+
+    async def _send_chunks(self, engine_id: str, request_id: str, ids,
+                           k_pages, v_pages) -> None:
+        reader, writer = await self._connect(engine_id)
+        n = len(ids)
+        dtype_name = str(np.dtype(k_pages.dtype))
+        for start in range(0, n, self.chunk_pages):
+            chunk_ids = ids[start:start + self.chunk_pages]
+            nb = _pow2_pad(len(chunk_ids))  # bounded inject-program set
+            # slice on device, pull only this chunk to the host
+            k_np = np.asarray(jax.device_get(
+                k_pages[:, :, start:start + len(chunk_ids)]))
+            v_np = np.asarray(jax.device_get(
+                v_pages[:, :, start:start + len(chunk_ids)]))
+            if nb != len(chunk_ids):
+                pad = [(0, 0)] * 5
+                pad[2] = (0, nb - len(chunk_ids))
+                k_np = np.pad(k_np, pad)
+                v_np = np.pad(v_np, pad)
+            write_frame(writer, {
+                "request_id": request_id,
+                "page_ids": chunk_ids,
+                "shape": list(k_np.shape),
+                "dtype": dtype_name,
+                "k": k_np.tobytes(),
+                "v": v_np.tobytes(),
+            })
+            await writer.drain()
+            ack = await read_frame(reader)
+            if not ack.get("ok"):
+                raise RuntimeError(
+                    f"kv inject rejected by {engine_id!r}: "
+                    f"{ack.get('error', 'unknown error')}")
+            self.sent_pages += len(chunk_ids)

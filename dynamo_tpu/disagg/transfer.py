@@ -11,10 +11,10 @@ between different tp layouts replaces kv_rearrange) in one step.
 Backends:
 - LocalTransferBackend: prefill and decode engines live in this process (one
   host driving both meshes); device_put crosses meshes directly.
-- The cross-process path rides the same interface: a remote backend serializes
-  pages host-side and ships them over the runtime data plane (see
-  dynamo_tpu/disagg/remote_transfer.py when present); the control flow
-  (queue -> transfer -> notify) is identical.
+- RemoteTransferBackend (disagg/remote_transfer.py): prefill and decode in
+  separate processes/hosts; pages ship host-side over a dedicated TCP data
+  plane to the decode worker's KvTransferServer, which device_puts them onto
+  its mesh (same control flow: queue -> transfer -> notify).
 """
 from __future__ import annotations
 

@@ -40,7 +40,8 @@ class ModelConfig:
     moe_capacity_factor: float = 2.0
     # decode attention impl: "auto" (Pallas kernel on TPU, XLA gather
     # elsewhere), "on", "off", "interpret" (kernel in interpreter mode, for
-    # CPU tests). The engine forces "off" on multi-device meshes.
+    # CPU tests). On multi-device meshes the kernel runs under shard_map
+    # over the "tp" axis (ops/paged_attention.py decode_paged_attention_sharded).
     decode_kernel: str = "auto"
     # Multimodal (Qwen2-VL-style); None means text-only.
     vision: Optional["VisionConfig"] = None
@@ -93,6 +94,13 @@ class EngineConfig:
     dp: int = 1
     # sequence-parallel axis for long-context ring attention (0 = off)
     sp: int = 1
+    # longest run of consecutive prefill steps while decodes are active;
+    # after the streak one decode step runs, so a long prompt can stall
+    # running decodes by at most max_prefill_streak chunk-times (the
+    # aggregated-mode answer to prefill/decode interference; the reference
+    # delegates this to its engines' chunked-prefill interleaving,
+    # docs/architecture.md:57-61). 0 = unbounded (old prefill-priority).
+    max_prefill_streak: int = 2
 
 
 # -- named architectures ------------------------------------------------------

@@ -203,6 +203,10 @@ class NativeEngineWorker(AsyncEngine):
                 done, _ = await asyncio.wait(
                     {get, stop}, return_when=asyncio.FIRST_COMPLETED)
                 if stop in done and get not in done:
+                    # cancel + clear `get` so the finally block doesn't
+                    # stage a duplicate abort for this request
+                    get.cancel()
+                    get = None
                     self._pending_aborts.append(request_id)
                     self._wake.set()
                     yield EngineOutput(

@@ -26,7 +26,9 @@ from jax.sharding import PartitionSpec as P
 from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_kv_pages
 from dynamo_tpu.ops.moe import moe_dispatch_mlp
-from dynamo_tpu.ops.paged_attention import decode_paged_attention
+from dynamo_tpu.ops.paged_attention import (
+    decode_paged_attention, decode_paged_attention_sharded,
+)
 
 Params = Dict[str, Any]
 
@@ -35,9 +37,9 @@ def _decode_kernel_mode(cfg: ModelConfig) -> Optional[str]:
     """Resolve the decode-attention implementation at trace time.
 
     Returns "tpu" / "interpret" to use the Pallas kernel, None for the XLA
-    gather path. "auto" picks the kernel on a real TPU backend only; the
-    engine forces "off" on multi-device meshes until the kernel is wrapped
-    in shard_map (auto-sharded jit cannot partition a pallas_call)."""
+    gather path. "auto" picks the kernel on a real TPU backend only. On
+    multi-device meshes forward() wraps the kernel in shard_map over "tp"
+    (auto-sharded jit cannot partition a pallas_call)."""
     mode = cfg.decode_kernel
     if mode == "off":
         return None
@@ -237,8 +239,12 @@ def forward(
     meta: AttnMetadata,
     input_embeds: Optional[jax.Array] = None,  # [B, Tq, D] overrides tokens
     sp_mesh=None,  # Mesh with an "sp" axis: ring-attention prefill
-) -> tuple[jax.Array, Dict[str, jax.Array]]:
-    """One paged forward step. Returns (logits [B, Tq, V], updated cache).
+    mesh=None,     # multi-device Mesh: shard_map the decode kernel over "tp"
+    with_aux: bool = False,  # also return {"moe_dropped","moe_routed"}
+) -> tuple:
+    """One paged forward step. Returns (logits [B, Tq, V], updated cache),
+    plus an aux dict when with_aux=True (MoE capacity-drop counters summed
+    over layers; empty for non-dispatch models).
 
     When sp_mesh is given, prefill (Tq > 1) runs ring attention with the
     sequence sharded over "sp" (ops/ring_attention.py) instead of attending
@@ -284,9 +290,15 @@ def forward(
         kc, vc = write_kv_pages(kc, vc, k, v, meta.write_idx)
         if use_kernel:
             # decode hot path: stream pages HBM->VMEM, no materialized gather
-            attn = decode_paged_attention(
-                q[:, 0], kc, vc, meta.page_table, meta.kv_lens,
-                interpret=_decode_kernel_mode(cfg) == "interpret")[:, None]
+            interp = _decode_kernel_mode(cfg) == "interpret"
+            if mesh is not None and mesh.size > 1:
+                attn = decode_paged_attention_sharded(
+                    q[:, 0], kc, vc, meta.page_table, meta.kv_lens, mesh,
+                    interpret=interp)[:, None]
+            else:
+                attn = decode_paged_attention(
+                    q[:, 0], kc, vc, meta.page_table, meta.kv_lens,
+                    interpret=interp)[:, None]
         elif use_ring:
             attn = ring_attention(q, k, v, meta.positions, kv_positions,
                                   sp_mesh)
@@ -296,20 +308,35 @@ def forward(
         x = x + jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd), lp["wo"])
 
         xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        drop_stats = None
         if not cfg.is_moe:
             mlp = _dense_mlp(xn, lp)
         elif cfg.moe_impl == "dense":
             mlp = _moe_mlp(xn, lp, cfg)
         else:
-            mlp = moe_dispatch_mlp(xn, lp, cfg, cfg.moe_capacity_factor)
+            mlp, drop_stats = moe_dispatch_mlp(
+                xn, lp, cfg, cfg.moe_capacity_factor, return_dropped=True,
+                valid=token_valid)
         x = x + mlp
-        return x, (kc, vc)
+        ys = (kc, vc, drop_stats) if moe_aux else (kc, vc)
+        return x, ys
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (params["layers"], cache["k"], cache["v"])
-    )
+    moe_aux = cfg.is_moe and cfg.moe_impl == "dispatch"
+    # real (non-padding) positions: padding slots carry write_idx < 0
+    token_valid = meta.write_idx >= 0 if moe_aux else None
+    if moe_aux:
+        x, (new_k, new_v, drops) = jax.lax.scan(
+            layer_step, x, (params["layers"], cache["k"], cache["v"]))
+        aux = {"moe_dropped": jnp.sum(drops[0]),
+               "moe_routed": jnp.sum(drops[1])}
+    else:
+        x, (new_k, new_v) = jax.lax.scan(
+            layer_step, x, (params["layers"], cache["k"], cache["v"]))
+        aux = {}
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,dv->btv", x, head).astype(jnp.float32)
+    if with_aux:
+        return logits, {"k": new_k, "v": new_v}, aux
     return logits, {"k": new_k, "v": new_v}

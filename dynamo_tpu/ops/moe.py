@@ -21,12 +21,21 @@ import jax
 import jax.numpy as jnp
 
 
-def moe_dispatch_mlp(x: jax.Array, lp, cfg, capacity_factor: float = 2.0
-                     ) -> jax.Array:
+def moe_dispatch_mlp(x: jax.Array, lp, cfg, capacity_factor: float = 2.0,
+                     return_dropped: bool = False, valid=None):
     """Top-k routed expert MLP with fixed-capacity dispatch.
 
     x: [B, T, D]; lp holds router [D, E] and stacked expert weights
-    w_gate/w_up [E, D, F], w_down [E, F, D]. Returns [B, T, D].
+    w_gate/w_up [E, D, F], w_down [E, F, D]. Returns [B, T, D], or
+    ([B, T, D], (dropped, routed)) with return_dropped — the number of
+    (token, expert) assignments dropped over capacity and the total
+    routed, so the engine can surface the drop rate instead of degrading
+    silently (GShard-style capacity dropping is invisible in the output).
+
+    valid: optional [B, T] bool/0-1 mask of real (non-padding) positions.
+    Padded positions all share one hidden state, so unmasked they would
+    pile onto the same experts — consuming capacity real tokens need and
+    polluting the drop counters. Masked tokens route nowhere.
     """
     b, t, d = x.shape
     e, k = cfg.num_experts, cfg.num_experts_per_tok
@@ -40,6 +49,8 @@ def moe_dispatch_mlp(x: jax.Array, lp, cfg, capacity_factor: float = 2.0
     # flatten (token, choice) pairs in token-major order so earlier tokens
     # win capacity ties deterministically
     sel = jax.nn.one_hot(idx, e, dtype=f32)          # [B, T, k, E]
+    if valid is not None:
+        sel = sel * valid.astype(f32)[:, :, None, None]
     sel_flat = sel.reshape(b, t * k, e)
     pos = jnp.cumsum(sel_flat, axis=1) - 1.0         # position within expert
     cap = max(int(t * k / e * capacity_factor), 1)
@@ -61,4 +72,9 @@ def moe_dispatch_mlp(x: jax.Array, lp, cfg, capacity_factor: float = 2.0
     y = jnp.einsum("becf,efd->becd", act, lp["w_down"])  # [B, E, C, D]
 
     out = jnp.einsum("bsec,becd->bsd", combine, y.astype(f32))
-    return out.reshape(b, t, k, d).sum(axis=2).astype(x.dtype)
+    out = out.reshape(b, t, k, d).sum(axis=2).astype(x.dtype)
+    if return_dropped:
+        routed = jnp.sum(sel_flat)
+        dropped = routed - jnp.sum(keep)
+        return out, (dropped, routed)
+    return out

@@ -26,6 +26,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 
 NEG_INF = -1e30
 
@@ -128,3 +134,45 @@ def decode_paged_attention(
         grid_spec=grid_spec,
         interpret=interpret,
     )(page_table, kv_lens, q, k_cache, v_cache)
+
+
+def decode_paged_attention_sharded(
+    q: jax.Array,            # [S, H, hd] — H sharded over "tp"
+    k_cache: jax.Array,      # [Hkv, P, ps, hd] — Hkv sharded over "tp"
+    v_cache: jax.Array,
+    page_table: jax.Array,   # [S, Pb] replicated
+    kv_lens: jax.Array,      # [S] replicated
+    mesh: Mesh,
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Multi-chip decode kernel: shard_map over the "tp" mesh axis.
+
+    pallas_call cannot be auto-partitioned by jit, so each tp shard runs the
+    kernel on its own H/tp query heads against its Hkv/tp kv heads (the GQA
+    group ratio G = H/Hkv is per-shard invariant because param_shardings
+    split both over tp). page_table/kv_lens are replicated; every other mesh
+    axis (dp/sp/ep) is replicated too — decode batch stays whole per shard.
+    The head-parallel split mirrors how the reference's engines run their
+    paged-attention kernels under --tensor-parallel-size (SURVEY.md §2.9).
+    """
+    head_spec = P(None, "tp", None)
+    cache_spec = P("tp", None, None, None)
+    specs = dict(
+        mesh=mesh,
+        in_specs=(head_spec, cache_spec, cache_spec, P(None, None), P(None)),
+        out_specs=head_spec,
+    )
+    body = functools.partial(_decode_local, interpret)
+    try:
+        # pallas_call output has no varying-mesh-axis annotation; disable
+        # the VMA check (jax >= 0.7 name, then the older check_rep name)
+        f = shard_map(body, check_vma=False, **specs)
+    except TypeError:
+        f = shard_map(body, check_rep=False, **specs)
+    return f(q, k_cache, v_cache, page_table, kv_lens)
+
+
+def _decode_local(interpret, q, k_cache, v_cache, page_table, kv_lens):
+    return decode_paged_attention(q, k_cache, v_cache, page_table, kv_lens,
+                                  interpret=interpret)

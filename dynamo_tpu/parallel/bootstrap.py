@@ -1,0 +1,120 @@
+"""Multi-process / multi-host mesh bootstrap.
+
+The reference bootstraps multi-node engines with Ray (vLLM leader/follower,
+reference: lib/llm/src/engines/vllm/ray.rs), torch.distributed rendezvous
+(sglang --dist-init-addr + rank math, engines/sglang/worker.rs:285-320), or
+MPI (TRT-LLM). The TPU-native equivalent is `jax.distributed.initialize`:
+every process in one engine joins a coordinator, after which `jax.devices()`
+is the GLOBAL device list and one `Mesh` (and the engine's pjit programs)
+spans all hosts — XLA lays collectives over ICI within a slice and DCN
+across slices (SURVEY.md §2.9 "Multi-node bootstrap").
+
+Config comes from flags or env (the env names mirror the runtime's DYN_*
+convention):
+- DYN_COORD_ADDR   e.g. "10.0.0.1:8476" — absent => single-process (no-op)
+- DYN_NUM_PROCESSES
+- DYN_PROCESS_ID
+
+Every process of a multi-process engine must run the same scheduling code in
+lockstep (SPMD): the engine's bucketed static shapes make this deterministic
+— identical request streams produce identical jit-call sequences, so the
+collectives line up without any cross-host scheduler protocol.
+
+`python -m dynamo_tpu.parallel.bootstrap --selftest-child ...` is the child
+entry for the driver's 2-process x 4-device dry run (__graft_entry__.py):
+it joins the coordinator, builds a (dp=2, tp=4) mesh over the 8 GLOBAL CPU
+devices, and runs one full engine generate over the multi-process mesh.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("dynamo_tpu.parallel")
+
+
+def bootstrap_distributed(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join this process to a multi-process JAX cluster.
+
+    Arguments default to the DYN_COORD_ADDR / DYN_NUM_PROCESSES /
+    DYN_PROCESS_ID env vars. Returns True when distributed mode was
+    initialized, False for the single-process no-op. Must run before the
+    first jax backend use in the process.
+    """
+    coordinator = coordinator or os.environ.get("DYN_COORD_ADDR")
+    if not coordinator:
+        return False
+    if num_processes is None:
+        num_processes = int(os.environ.get("DYN_NUM_PROCESSES", "0"))
+    if process_id is None:
+        process_id = int(os.environ.get("DYN_PROCESS_ID", "-1"))
+    if num_processes <= 0 or process_id < 0:
+        raise ValueError(
+            "multi-process bootstrap needs num_processes > 0 and "
+            f"process_id >= 0 (got {num_processes}, {process_id})")
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    log.info("joined distributed cluster: coordinator=%s process %d/%d; "
+             "%d global devices (%d local)", coordinator, process_id,
+             num_processes, len(jax.devices()), len(jax.local_devices()))
+    return True
+
+
+def _selftest_child(coordinator: str, num_processes: int, process_id: int,
+                    local_devices: int) -> None:
+    """Dry-run child: full engine generate over a multi-process CPU mesh."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local_devices}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    bootstrap_distributed(coordinator, num_processes, process_id)
+
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import SamplingParams
+    from dynamo_tpu.parallel.mesh import make_mesh
+
+    n = len(jax.devices())
+    tp = min(4, n)
+    dp = n // tp
+    mesh = make_mesh(dp=dp, tp=tp)
+    cfg = ModelConfig(name="mp-dry", vocab_size=256, hidden_size=128,
+                      intermediate_size=256, num_layers=2, num_heads=8,
+                      num_kv_heads=4, head_dim=32, max_model_len=256)
+    eng_cfg = EngineConfig(page_size=8, num_pages=32, max_slots=4,
+                           max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+                           max_model_len=256)
+    engine = NativeEngine(cfg, eng_cfg, mesh=mesh, seed=0)
+    out = engine.generate(list(range(20)), SamplingParams(max_tokens=4),
+                          "mp-dry")
+    print(f"MPDRY process={process_id} devices={n} mesh=dp{dp}xtp{tp} "
+          f"tokens={out}", flush=True)
+
+
+def main() -> None:
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--selftest-child", action="store_true")
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--num-processes", type=int, required=True)
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("--local-devices", type=int, default=4)
+    args = p.parse_args()
+    if args.selftest_child:
+        _selftest_child(args.coordinator, args.num_processes,
+                        args.process_id, args.local_devices)
+    else:
+        bootstrap_distributed(args.coordinator, args.num_processes,
+                              args.process_id)
+
+
+if __name__ == "__main__":
+    main()

@@ -167,10 +167,18 @@ async def amain() -> None:
     p.add_argument("--echo-delay", type=float, default=0.0)
     p.add_argument("--control-host", default="127.0.0.1")
     p.add_argument("--control-port", type=int, default=5550)
+    p.add_argument("--coordinator", default=None,
+                   help="jax.distributed coordinator (host:port) when this "
+                        "engine spans processes/hosts; see DYN_COORD_ADDR")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args()
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO)
+    from dynamo_tpu.parallel.bootstrap import bootstrap_distributed
+    bootstrap_distributed(args.coordinator, args.num_processes,
+                          args.process_id)
 
     in_spec, out_spec, model_spec = "text", "echo", "tiny"
     for tok in args.io:

@@ -51,8 +51,20 @@ async def amain() -> None:
     p.add_argument("service", help="module.path:ClassName")
     p.add_argument("--control-host", default="127.0.0.1")
     p.add_argument("--control-port", type=int, default=5550)
+    p.add_argument("--coordinator", default=None,
+                   help="jax.distributed coordinator addr (host:port) for "
+                        "engines spanning processes/hosts; defaults to "
+                        "DYN_COORD_ADDR")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    # join the engine's multi-process mesh BEFORE any jax use (reference
+    # role: Ray leader/follower bootstrap, engines/vllm/ray.rs; here
+    # jax.distributed so one Mesh spans all the service's hosts)
+    from dynamo_tpu.parallel.bootstrap import bootstrap_distributed
+    bootstrap_distributed(args.coordinator, args.num_processes,
+                          args.process_id)
     cls = resolve(args.service)
     runtime = await DistributedRuntime.connect(
         args.control_host, args.control_port)

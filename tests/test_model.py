@@ -148,6 +148,84 @@ def test_moe_dispatch_matches_dense_compute():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_moe_dispatch_drop_accounting():
+    """Forced routing imbalance: the (dropped, routed) counters are exact.
+
+    ADVICE r1 (medium): GShard capacity dispatch drops tokens silently;
+    the counters make the degradation observable."""
+    from dynamo_tpu.ops.moe import moe_dispatch_mlp
+
+    cfg = ModelConfig(name="tiny-moe", dtype="float32", num_experts=4,
+                      num_experts_per_tok=2)
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    lp = dict(jax.tree.map(lambda a: a[0], params["layers"]))
+    t, k, e = 16, cfg.num_experts_per_tok, cfg.num_experts
+    rng = np.random.default_rng(5)
+    x_np = rng.standard_normal((1, t, cfg.hidden_size)).astype(np.float32)
+    out, (dropped, routed) = moe_dispatch_mlp(
+        jnp.asarray(x_np), lp, cfg, capacity_factor=0.25,
+        return_dropped=True)
+    # numpy replication of the routing + capacity accounting
+    logits = x_np[0] @ np.asarray(lp["router"], np.float32)       # [t, e]
+    top2 = np.argsort(-logits, axis=-1, kind="stable")[:, :k]     # [t, k]
+    cap = max(int(t * k / e * 0.25), 1)                           # 2
+    counts = np.zeros(e, np.int64)
+    kept = 0
+    for tok in range(t):                  # token-major order, like cumsum
+        for c in range(k):
+            ex = top2[tok, c]
+            if counts[ex] < cap:
+                kept += 1
+            counts[ex] += 1
+    assert int(routed) == t * k
+    assert int(dropped) == t * k - kept
+    assert int(dropped) > 0, "capacity 0.25 must actually drop"
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_moe_dispatch_parity_and_no_drops_at_shipped_capacity():
+    """At the shipped capacity_factor=2.0 with near-balanced routing the
+    dispatch path matches the dense oracle exactly and drops nothing —
+    the parity coverage ADVICE r1 flagged as missing for the serving
+    default."""
+    from dynamo_tpu.ops.moe import moe_dispatch_mlp
+
+    cfg = ModelConfig(name="tiny-moe", dtype="float32", num_experts=4,
+                      num_experts_per_tok=2, moe_capacity_factor=2.0)
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    lp = jax.tree.map(lambda a: a[0], params["layers"])
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.standard_normal((2, 24, cfg.hidden_size)),
+                    jnp.float32)
+    disp, (dropped, _) = moe_dispatch_mlp(
+        x, lp, cfg, capacity_factor=cfg.moe_capacity_factor,
+        return_dropped=True)
+    assert int(dropped) == 0, (
+        "seeded routing should stay under capacity at the shipped factor")
+    dense = llama._moe_mlp(x, lp, cfg)
+    np.testing.assert_allclose(np.asarray(disp), np.asarray(dense),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_moe_engine_surfaces_drop_counters():
+    """Engine-level: a dispatch-MoE engine accumulates routed/dropped."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import SamplingParams
+
+    cfg = ModelConfig(name="tiny-moe", dtype="float32", num_experts=4,
+                      num_experts_per_tok=2, max_model_len=128)
+    ecfg = EngineConfig(page_size=8, num_pages=16, max_slots=2,
+                        max_prefill_chunk=16, prefill_buckets=(8, 16),
+                        max_model_len=128)
+    eng = NativeEngine(cfg, ecfg, seed=0)
+    out = eng.generate(list(range(10)),
+                       SamplingParams(max_tokens=3, ignore_eos=True), "m")
+    assert len(out) == 3
+    assert eng.moe_routed_tokens > 0
+    assert 0.0 <= eng.moe_drop_rate() <= 1.0
+
+
 def test_moe_dispatch_sharded_over_ep_mesh():
     """Expert weights sharded over an ep mesh axis; jit compiles + matches."""
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -1,0 +1,275 @@
+"""Cross-process KV transfer tests (the NIXL-equivalent, VERDICT.md item 2).
+
+Layers of coverage:
+1. In-process over real TCP sockets: KvTransferServer + RemoteTransferBackend
+   replace LocalTransferBackend in the full disagg worker flow — exact-output
+   parity with an aggregated engine, tp-mismatch relayout, chunked frames.
+2. Rejection race: decode released the allocation (timeout path) before the
+   transfer lands — the inject must be refused.
+3. TRUE two-process: decode worker and prefill worker in separate OS
+   processes joined only by the standalone control-plane server; pages cross
+   a real process boundary; exact parity with the in-test aggregated oracle.
+"""
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import jax
+import pytest
+
+from dynamo_tpu.disagg import (
+    DisaggDecodeWorker, DisaggregatedRouter, KvTransferServer, PrefillQueue,
+    PrefillWorker, RemoteTransferBackend,
+)
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.llm.worker import NativeEngineWorker
+from dynamo_tpu.parallel.mesh import make_mesh
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+PAGE = 8
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_engine(mesh=None):
+    return NativeEngine(CFG, EngineConfig(
+        page_size=PAGE, num_pages=64, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512), mesh=mesh, seed=0)
+
+
+def pre_request(rid, prompt, max_tokens=6):
+    return PreprocessedRequest(
+        request_id=rid, token_ids=prompt,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True))
+
+
+async def _drive(worker_gen):
+    toks, reason = [], None
+    async for frame in worker_gen:
+        toks.extend(frame.get("token_ids", ()))
+        if frame.get("finish_reason") not in (None, "prefill_done"):
+            reason = frame["finish_reason"]
+    return toks, reason
+
+
+async def _build_remote_stack(plane, decode_mesh=None, prefill_mesh=None,
+                              chunk_pages=16):
+    """Disagg stack wired through the REMOTE transfer path over TCP."""
+    queue = PrefillQueue(plane.messaging, "ns", "tiny")
+    router = DisaggregatedRouter(max_local_prefill_length=4,
+                                 max_prefill_queue_size=8, model="tiny")
+    decode = DisaggDecodeWorker(
+        make_engine(decode_mesh), plane.messaging, router, queue,
+        worker_id="dec-0", prefill_timeout_s=30.0)
+    server = await KvTransferServer(decode, "dec-0").start()
+    await server.register(plane.kv)
+    transfer = RemoteTransferBackend(plane.kv, chunk_pages=chunk_pages)
+    prefill = PrefillWorker(
+        NativeEngineWorker(make_engine(prefill_mesh)), queue, transfer,
+        plane.messaging)
+    return decode, prefill, server, transfer
+
+
+def test_remote_transfer_e2e_matches_aggregated():
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, server, transfer = await _build_remote_stack(plane)
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, reason = await _drive(
+                decode.generate(pre_request("r1", prompt).model_dump(
+                    exclude_none=True), Context("r1")))
+        finally:
+            await prefill.stop()
+            await decode.stop()
+            await transfer.close()
+            await server.stop()
+        return (toks, reason, decode.remote_prefills, prefill.completed,
+                server.received_pages, transfer.sent_pages)
+
+    toks, reason, n_remote, n_done, rx, tx = asyncio.run(main())
+    assert n_remote == 1 and n_done == 1
+    assert rx == tx == 3  # 20 tokens / page 8 -> 3 pages crossed the wire
+    assert reason == "length"
+    assert toks == expect
+
+
+def test_remote_transfer_chunked_and_tp_mismatch():
+    """chunk_pages=1 forces one frame per page; prefill tp=2 vs decode tp=1
+    exercises the device_put relayout on receive."""
+    devs = jax.devices()
+    assert len(devs) >= 2
+    prefill_mesh = make_mesh(tp=2, devices=devs[:2])
+    prompt = list(range(60, 80))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, server, transfer = await _build_remote_stack(
+            plane, prefill_mesh=prefill_mesh, chunk_pages=1)
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, _ = await _drive(
+                decode.generate(pre_request("t1", prompt).model_dump(
+                    exclude_none=True), Context("t1")))
+        finally:
+            await prefill.stop()
+            await decode.stop()
+            await transfer.close()
+            await server.stop()
+        return toks, decode.remote_prefills, server.received_pages
+
+    toks, n_remote, rx = asyncio.run(main())
+    assert n_remote == 1 and rx == 3
+    assert toks == expect
+
+
+def test_remote_inject_rejected_after_release():
+    """Decode timed out and released the allocation: a late transfer must be
+    refused (injecting would corrupt reallocated pages)."""
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+
+    async def main():
+        plane = MemoryPlane()
+        decode = NativeEngineWorker(make_engine())
+        await decode.start()
+        server = await KvTransferServer(decode, "dec-0").start()
+        await server.register(plane.kv)
+        transfer = RemoteTransferBackend(plane.kv)
+        prefill_eng = make_engine()
+        try:
+            alloc = await decode.submit(
+                lambda eng: eng.allocate_remote(
+                    EngineRequest("race", prompt, params)))
+            assert alloc is not None
+            # prefill runs and extracts pages
+            prefill_eng.add_request(
+                EngineRequest("race", prompt, params, prefill_only=True))
+            while prefill_eng.has_work():
+                prefill_eng.step()
+            pages = prefill_eng.extract_pages(
+                prefill_eng.scheduler.parked["race"].pages)
+            # decode gives up (timeout path) BEFORE the transfer lands
+            await decode.submit(lambda eng: eng.release_remote("race"))
+            with pytest.raises(RuntimeError, match="no longer pending"):
+                await transfer.send_pages("dec-0", "race", alloc.page_ids,
+                                          pages["k"], pages["v"])
+        finally:
+            await transfer.close()
+            await server.stop()
+            await decode.stop()
+        return server.received_pages
+
+    assert asyncio.run(main()) == 0
+
+
+def test_remote_transfer_metadata_missing():
+    """Unknown engine_id (worker lease gone): clear error, no hang."""
+    async def main():
+        plane = MemoryPlane()
+        transfer = RemoteTransferBackend(plane.kv)
+        import numpy as np
+        z = np.zeros((2, 2, 1, 8, 32), np.float32)
+        with pytest.raises(KeyError, match="no kv-transfer metadata"):
+            await transfer.send_pages("ghost", "r", [0], z, z)
+
+    asyncio.run(main())
+
+
+# -- TRUE two-process disaggregation ------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(args, env):
+    return subprocess.Popen(
+        [sys.executable] + args, stdout=subprocess.PIPE, cwd=REPO, env=env,
+        text=True)
+
+
+def _wait_ready(proc, tag, deadline=120):
+    line = proc.stdout.readline()
+    assert line, f"{tag} exited before READY"
+    assert line.startswith("READY"), f"{tag} said {line!r}"
+
+
+def test_disagg_two_processes_exact_parity():
+    """Decode and prefill engines in SEPARATE OS processes; KV pages cross a
+    real process boundary over the transfer plane; output matches the
+    aggregated single-engine oracle exactly (VERDICT item 2 'Done' bar)."""
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "oracle")
+
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    cp = _spawn(["-m", "dynamo_tpu.runtime.transports.server",
+                 "--port", str(port)], env)
+    decode = prefill = None
+    try:
+        # give the control-plane server a moment to bind
+        deadline = 50
+        for _ in range(deadline * 10):
+            try:
+                s = socket.create_connection(("127.0.0.1", port), 0.2)
+                s.close()
+                break
+            except OSError:
+                import time
+                time.sleep(0.1)
+        decode = _spawn(["tests/disagg_remote_procs.py", "decode",
+                         str(port)], env)
+        prefill = _spawn(["tests/disagg_remote_procs.py", "prefill",
+                          str(port)], env)
+        _wait_ready(decode, "decode")
+        _wait_ready(prefill, "prefill")
+
+        async def drive():
+            from dynamo_tpu.runtime.distributed import DistributedRuntime
+            rt = await DistributedRuntime.connect("127.0.0.1", port)
+            client = rt.namespace("ns").component("decoder").endpoint(
+                "generate").client()
+            await client.start()
+            await client.wait_for_instances()
+            toks = []
+            req = pre_request("two-proc", prompt).model_dump(
+                exclude_none=True)
+            async for frame in await client.generate(req):
+                toks.extend(frame.get("token_ids", ()))
+            await client.stop()
+            await rt.shutdown()
+            return toks
+
+        toks = asyncio.run(asyncio.wait_for(drive(), 180))
+        assert toks == expect
+    finally:
+        for p in (decode, prefill, cp):
+            if p is not None:
+                p.send_signal(signal.SIGINT)
+        for p in (decode, prefill, cp):
+            if p is not None:
+                try:
+                    p.wait(15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
