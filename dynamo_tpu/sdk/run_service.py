@@ -10,6 +10,7 @@ import argparse
 import asyncio
 import importlib
 import logging
+import os
 import sys
 
 from dynamo_tpu.runtime.distributed import DistributedRuntime
@@ -30,6 +31,10 @@ def resolve(spec_str: str):
 async def serve_service(cls, runtime) -> None:
     spec = cls.__service_spec__
     inst = cls()
+    # services get the cluster handle before hooks run (kv, messaging,
+    # lease) — the reference injects the same via @dynamo_worker
+    # (reference: cli/serve_dynamo.py:111-122)
+    inst.runtime = runtime
     for attr, dep_cls in spec.dependencies.items():
         setattr(inst, attr,
                 ServiceClient(runtime, dep_cls.__service_spec__))
@@ -59,6 +64,16 @@ async def amain() -> None:
     p.add_argument("--process-id", type=int, default=None)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
+    # honor the allocator's JAX_PLATFORMS assignment programmatically:
+    # this image pins the TPU tunnel in sitecustomize, so the env var
+    # alone does not move host-only services onto CPU
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            import jax
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
     # join the engine's multi-process mesh BEFORE any jax use (reference
     # role: Ray leader/follower bootstrap, engines/vllm/ray.rs; here
     # jax.distributed so one Mesh spans all the service's hosts)

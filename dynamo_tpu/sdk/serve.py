@@ -32,7 +32,9 @@ _drain_tasks: set = set()
 
 
 async def wait_ready(proc: asyncio.subprocess.Process, tag: str,
-                     timeout: float = 60.0) -> None:
+                     timeout: float = 240.0) -> None:
+    """Engine-building services compile XLA programs before READY; the
+    timeout covers a cold first compile on a busy host."""
     async def pump():
         while True:
             line = await proc.stdout.readline()
