@@ -114,8 +114,10 @@ def main():
     if jax.default_backend() == "tpu":
         try:
             from dynamo_tpu.ops.paged_attention import decode_paged_attention
-            q = jnp.ones((1, 8, 64), jnp.bfloat16)
-            k = jnp.ones((1, 2, 64, 64), jnp.bfloat16)
+            # the flagship's exact head geometry (h=32, hkv=8 -> G=4, hd=64,
+            # ps=64): probes the packed-DMA path the real decode runs
+            q = jnp.ones((1, 32, 64), jnp.bfloat16)
+            k = jnp.ones((8, 2, 64, 64), jnp.bfloat16)
             pt = jnp.zeros((1, 1), jnp.int32)
             lens = jnp.ones((1,), jnp.int32)
             jax.block_until_ready(decode_paged_attention(q, k, k, pt, lens))

@@ -60,23 +60,25 @@ class NativeEngine:
         seed: int = 0,
     ):
         self.mesh = mesh if mesh is not None else single_device_mesh()
-        # the compiled kernel's shard_map path has hard constraints the XLA
-        # gather path doesn't: tp must divide the head counts (shard_map
-        # in_specs) and each shard needs >= 8 query heads (Mosaic q-block
-        # tiling minimum, ops/paged_attention.py). Fall back with the reason
-        # named rather than failing at first decode compile. Interpret mode
-        # is exempt (no tiling constraints; it is the CPU test path).
+        # the compiled kernel has hard constraints the XLA gather path
+        # doesn't: a lane-aligned DMA geometry (ops/paged_attention.py
+        # kernel_supported) and, under shard_map, tp dividing the head
+        # counts. Fall back with the reason named rather than failing at
+        # first decode compile. (The q block is grouped [S, Hkv, G, hd] so
+        # any per-shard G compiles — no >=8-head minimum anymore.)
         tp = self.mesh.shape.get("tp", 1)
-        if self.mesh.size > 1 and \
-                llama._decode_kernel_mode(model_cfg) == "tpu":
+        if llama._decode_kernel_mode(model_cfg) == "tpu":
+            from dynamo_tpu.ops.paged_attention import kernel_supported
             h, hkv = model_cfg.num_heads, model_cfg.num_kv_heads
             reason = None
-            if h % tp or hkv % tp:
+            if not kernel_supported(model_cfg.head_dim,
+                                    engine_cfg.page_size):
+                reason = (f"no lane-aligned DMA path for head_dim="
+                          f"{model_cfg.head_dim}, page_size="
+                          f"{engine_cfg.page_size}")
+            elif self.mesh.size > 1 and (h % tp or hkv % tp):
                 reason = (f"num_heads={h} / num_kv_heads={hkv} not "
                           f"divisible by tp={tp}")
-            elif h // tp < 8:
-                reason = (f"per-shard query heads {h // tp} < 8 "
-                          "(Mosaic block-tiling minimum)")
             if reason:
                 logging.getLogger(__name__).warning(
                     "decode kernel disabled on this mesh: %s; "

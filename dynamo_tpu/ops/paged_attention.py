@@ -17,6 +17,16 @@ Grid: (batch, kv_head). Each program owns one (sequence, kv head) pair and
 loops over that sequence's pages (dynamic trip count = ceil(kv_len/ps)),
 prefetching page i+1 while computing page i. Grouped-query heads ride along:
 the q block is [G, hd] with G = H // Hkv.
+
+head_dim < 128 (llama3-1b has hd=64): an HBM slice whose minor dim is hd
+would violate Mosaic's 128-lane tiling ("Slice shape along dimension 3 must
+be aligned to tiling (128)"). The packed variant instead views each [ps, hd]
+page as [ps/pack, 128] rows (pack = 128//hd; a free row-major reshape done
+outside the kernel), so every DMA is lane-aligned. Row r of a packed block
+holds tokens r*pack .. r*pack+pack-1; scores come from `pack` lane-shifted
+copies of q dotted against the packed block, and the flash accumulator is
+kept packed [G, 128] (each hd-lane segment accumulates its residue class),
+folded to [G, hd] by a reshape+sum outside the kernel.
 """
 from __future__ import annotations
 
@@ -36,6 +46,16 @@ except ImportError:  # older jax
 NEG_INF = -1e30
 
 
+def kernel_supported(head_dim: int, page_size: int) -> bool:
+    """Whether the compiled (non-interpret) kernel has a lane-aligned path
+    for this geometry: hd a multiple of 128 (direct DMA) or hd < 128 with
+    128 % hd == 0 and ps % (128//hd) == 0 (packed DMA). Callers gate to the
+    XLA fallback otherwise instead of dying at Mosaic compile."""
+    if head_dim >= 128:
+        return head_dim % 128 == 0
+    return 128 % head_dim == 0 and page_size % (128 // head_dim) == 0
+
+
 def _decode_kernel(ps: int, g: int, pt_ref, lens_ref, q_ref, k_hbm, v_hbm,
                    o_ref, k_buf, v_buf, sems):
     s = pl.program_id(0)
@@ -43,10 +63,12 @@ def _decode_kernel(ps: int, g: int, pt_ref, lens_ref, q_ref, k_hbm, v_hbm,
     kv_len = lens_ref[s]
     n_pages = pl.cdiv(kv_len, ps)
 
-    hd = q_ref.shape[2]
-    # the q/o blocks span all H heads (TPU block tiling disallows a G-row
-    # block when G < 8); slice this kv-head's G query rows dynamically
-    q = q_ref[0, pl.ds(j * g, g), :].astype(jnp.float32) * (hd ** -0.5)
+    hd = q_ref.shape[3]
+    # q is pre-grouped [S, Hkv, G, hd] and the BlockSpec blocks over the
+    # kv-head dim, so the block's minor dims (G, hd) equal the full array
+    # extent — the layout Mosaic accepts even when G < 8 (a G-row slice of
+    # an [H, hd] block is an unsupported vector.load for G=4, hd=64)
+    q = q_ref[0, 0].astype(jnp.float32) * (hd ** -0.5)
 
     def dma(i, slot, hbm, buf, kv):
         return pltpu.make_async_copy(
@@ -90,7 +112,89 @@ def _decode_kernel(ps: int, g: int, pt_ref, lens_ref, q_ref, k_hbm, v_hbm,
     l0 = jnp.zeros((g, 1), jnp.float32)
     acc0 = jnp.zeros((g, hd), jnp.float32)
     _, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
-    o_ref[0, pl.ds(j * g, g), :] = (acc / l).astype(o_ref.dtype)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def _decode_kernel_packed(ps: int, g: int, hd: int, pack: int,
+                          pt_ref, lens_ref, q_ref, k_hbm, v_hbm,
+                          o_ref, k_buf, v_buf, sems):
+    """hd < 128 variant: pages are packed [rows, 128] blocks (rows = ps/pack).
+
+    Token (r*pack + pk) of a page lives in row r, lanes [pk*hd, (pk+1)*hd).
+    The output o_ref is the PACKED accumulator [G, 128] (f32): lane segment
+    pk holds the attention contribution of tokens == pk (mod pack); the
+    caller folds segments with a reshape+sum.
+    """
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    kv_len = lens_ref[s]
+    n_pages = pl.cdiv(kv_len, ps)
+    rows = ps // pack
+
+    # q pre-grouped [S, Hkv, G, hd]; this block is kv-head j's G query rows
+    q = q_ref[0, 0].astype(jnp.float32) * (hd ** -0.5)
+    zeros = jnp.zeros((g, hd), jnp.float32)
+    # pack lane-shifted copies: q_shifts[pk] has q in lanes [pk*hd,(pk+1)*hd)
+    q_shifts = [
+        jnp.concatenate([zeros] * pk + [q] + [zeros] * (pack - 1 - pk),
+                        axis=-1)
+        for pk in range(pack)
+    ]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (g, pack * hd), 1)
+    lane_masks = [(lane // hd) == pk for pk in range(pack)]
+
+    def dma(i, slot, hbm, buf, kv):
+        return pltpu.make_async_copy(
+            hbm.at[j, pt_ref[s, i]], buf.at[slot], sems.at[slot, kv])
+
+    dma(0, 0, k_hbm, k_buf, 0).start()
+    dma(0, 0, v_hbm, v_buf, 1).start()
+
+    def body(i, carry):
+        m, l, acc = carry            # m, l: [G, 1]; acc: [G, 128] packed
+        slot = jax.lax.rem(i, 2)
+        nxt = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            dma(i + 1, nxt, k_hbm, k_buf, 0).start()
+            dma(i + 1, nxt, v_hbm, v_buf, 1).start()
+
+        dma(i, slot, k_hbm, k_buf, 0).wait()
+        dma(i, slot, v_hbm, v_buf, 1).wait()
+        k = k_buf[slot].astype(jnp.float32)            # [rows, 128]
+        v = v_buf[slot].astype(jnp.float32)
+
+        row = jax.lax.broadcasted_iota(jnp.int32, (1, rows), 1)
+        scores = []
+        for pk in range(pack):
+            sc = jax.lax.dot_general(
+                q_shifts[pk], k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)    # [G, rows]
+            pos = i * ps + row * pack + pk
+            scores.append(jnp.where(pos < kv_len, sc, NEG_INF))
+
+        m_new = m
+        for sc in scores:
+            m_new = jnp.maximum(m_new, jnp.max(sc, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l
+        acc_new = acc * alpha
+        for pk in range(pack):
+            p = jnp.exp(scores[pk] - m_new)            # [G, rows]
+            l_new = l_new + jnp.sum(p, axis=-1, keepdims=True)
+            contrib = jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)    # [G, 128]
+            # lanes outside segment pk are cross-residue junk — mask them
+            acc_new = acc_new + jnp.where(lane_masks[pk], contrib, 0.0)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((g, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    acc0 = jnp.zeros((g, pack * hd), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    o_ref[0, 0] = acc / l
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -105,35 +209,70 @@ def decode_paged_attention(
 ) -> jax.Array:
     """Returns [S, H, hd] attention of each decode token over its pages."""
     s, h, hd = q.shape
-    hkv, _, ps, _ = k_cache.shape
+    hkv, p, ps, _ = k_cache.shape
     g = h // hkv
     # padded decode slots carry kv_len 0; clamp so the page-0 warm-up DMA
     # and the 1/l normalization stay well-defined (their output is ignored)
     kv_lens = jnp.maximum(kv_lens, 1)
 
+    # group queries by kv head: [S, Hkv, G, hd]. The BlockSpec blocks over
+    # the kv-head dim so each program's q block minor dims (G, hd) are the
+    # full array extent — valid Mosaic layout for any G (see kernel docs).
+    qg = q.reshape(s, hkv, g, hd)
+
+    if hd < 128 and 128 % hd == 0 and ps % (128 // hd) == 0:
+        # lane-aligned packed path (see module docstring): view pages as
+        # [rows, 128] and fold the packed accumulator outside the kernel
+        pack = 128 // hd
+        rows = ps // pack
+        k_pk = k_cache.reshape(hkv, p, rows, 128)   # free row-major bitcast
+        v_pk = v_cache.reshape(hkv, p, rows, 128)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(s, hkv),
+            in_specs=[
+                pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((1, 1, g, 128),
+                                   lambda i, j, *_: (i, j, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, rows, 128), k_cache.dtype),
+                pltpu.VMEM((2, rows, 128), v_cache.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        )
+        packed = pl.pallas_call(
+            functools.partial(_decode_kernel_packed, ps, g, hd, pack),
+            out_shape=jax.ShapeDtypeStruct((s, hkv, g, 128), jnp.float32),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(page_table, kv_lens, qg, k_pk, v_pk)
+        return (packed.reshape(s, h, pack, hd).sum(axis=2).astype(q.dtype))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s, hkv),
         in_specs=[
-            # full-head block per sequence; kv-head j slices its G rows
-            # (same block for every j => stays resident across the j loop)
-            pl.BlockSpec((1, h, hd), lambda i, j, *_: (i, 0, 0)),
+            pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, h, hd), lambda i, j, *_: (i, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, ps, hd), k_cache.dtype),
             pltpu.VMEM((2, ps, hd), v_cache.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         functools.partial(_decode_kernel, ps, g),
-        out_shape=jax.ShapeDtypeStruct((s, h, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((s, hkv, g, hd), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(page_table, kv_lens, q, k_cache, v_cache)
+    )(page_table, kv_lens, qg, k_cache, v_cache)
+    return out.reshape(s, h, hd)
 
 
 def decode_paged_attention_sharded(
