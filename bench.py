@@ -32,7 +32,7 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "540"))  # hard deadline
 
 T0 = time.time()
 RESULT = {"metric": METRIC, "value": 0.0, "unit": "tokens/s/chip",
-          "vs_baseline": 0.0}
+          "vs_baseline": 0.0, "extras": {}}
 _emitted = threading.Event()
 
 
@@ -139,48 +139,89 @@ def main():
     model_cfg = dataclasses.replace(get_model_config(model_name),
                                     decode_kernel=kernel)
     slots = 8
+    decode_steps = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
     cfg = EngineConfig(
         page_size=64, num_pages=256, max_slots=slots, max_prefill_chunk=512,
-        prefill_buckets=(128,), max_model_len=2048)
+        prefill_buckets=(128,), max_model_len=2048,
+        decode_steps=decode_steps, max_prefill_batch=8)
+    RESULT["extras"].update(kernel=kernel, decode_steps=decode_steps,
+                            slots=slots)
 
-    prompt_len, gen_len = 128, 128
-    params = SamplingParams(max_tokens=gen_len + 64, temperature=0.0,
+    # max_tokens covers warmup (2 windows) + 6 timed chunks of ~80 steps so
+    # no slot runs dry mid-measurement (empty slots would deflate tok/s)
+    prompt_len = 128
+    params = SamplingParams(max_tokens=560, temperature=0.0,
                             ignore_eos=True)
 
     log("phase 3: building engine (init_params + init_cache compiles)")
     engine = NativeEngine(model_cfg, cfg, seed=0)
 
-    log("phase 4: warmup — prefill all slots (one 128 bucket) + 3 decode "
-        "steps")
-    for i in range(slots):
-        prompt = [(7 * i + j) % 1000 + 1 for j in range(prompt_len)]
-        engine.add_request(EngineRequest(f"bench-{i}", prompt, params))
+    def add_all(tag):
+        # prompts are distinct across tags so the TTFT phase can't ride the
+        # prefix cache built by warmup (that would fake a near-zero TTFT)
+        salt = sum(tag.encode()) * 131
+        for i in range(slots):
+            prompt = [(salt + 7 * i + j) % 1000 + 1
+                      for j in range(prompt_len)]
+            engine.add_request(EngineRequest(f"{tag}-{i}", prompt, params))
+
+    log(f"phase 4: warmup — batched prefill of all {slots} slots + 2 decode "
+        f"windows of {decode_steps}")
+    add_all("warm")
     n_pf = 0
     while engine.scheduler.waiting:
         engine.step()
         n_pf += 1
     log(f"prefill done ({n_pf} steps)")
-    for _ in range(3):
+    for _ in range(2):
         engine.step()
-    log("warmup done; first decode step compiled")
+    log("warmup done; decode window compiled")
 
     log("phase 5: timed decode chunks (adaptive; records best chunk)")
-    chunk_steps, max_chunks = 10, 6
+    chunk_windows = max(1, 80 // decode_steps)
+    max_chunks = 6
     best = 0.0
     for c in range(max_chunks):
         t0 = time.perf_counter()
         tokens = 0
-        for _ in range(chunk_steps):
-            tokens += len(engine.step())
+        for _ in range(chunk_windows):
+            tokens += sum(1 for ev in engine.step() if ev.token is not None)
         dt = time.perf_counter() - t0
         tok_s = tokens / dt
         best = max(best, tok_s)
         record(best, n_chips)
         log(f"chunk {c}: {tok_s:.1f} tok/s ({tokens} tokens / {dt:.3f}s); "
             f"best {best:.1f}")
-        if time.time() - T0 > BUDGET_S - 30:
-            log("approaching deadline; stopping early")
-            break
+        if time.time() - T0 > BUDGET_S - 60:
+            log("approaching deadline; skipping TTFT phase")
+            emit()
+            return
+    log("phase 6: TTFT — drain, then 8 fresh concurrent prompts "
+        "(batched prefill; north-star denominator, BASELINE.md)")
+    # drain current requests so the TTFT engine starts idle
+    for rid in list(engine.scheduler.params):
+        engine.abort(rid)
+    while engine.has_work():
+        engine.step()
+    t_add = time.perf_counter()
+    add_all("ttft")
+    first_token_at = {}
+    while engine.has_work() and len(first_token_at) < slots:
+        for ev in engine.step():
+            if ev.token is not None and ev.request_id not in first_token_at:
+                first_token_at[ev.request_id] = time.perf_counter() - t_add
+    if first_token_at:
+        ttfts = sorted(first_token_at.values())
+        p50 = ttfts[len(ttfts) // 2]
+        # all prompts prefill in one batched step: prefill throughput is
+        # total prompt tokens over the time to the LAST first-token
+        prefill_tok_s = slots * prompt_len / max(ttfts[-1], 1e-9)
+        RESULT["extras"].update(
+            ttft_p50_ms=round(p50 * 1000, 1),
+            ttft_p99_ms=round(ttfts[-1] * 1000, 1),
+            prefill_tok_s=round(prefill_tok_s, 1))
+        log(f"TTFT p50 {p50 * 1000:.1f} ms, max {ttfts[-1] * 1000:.1f} ms; "
+            f"prefill {prefill_tok_s:.0f} tok/s")
     emit()
 
 

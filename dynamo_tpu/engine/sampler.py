@@ -14,6 +14,42 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# static top-k width for logprob alternatives (OpenAI caps top_logprobs
+# lower in practice; one static width keeps the compiled program set small)
+TOP_LOGPROBS = 8
+
+
+def seen_token_mask(hist: jax.Array, vocab: int) -> jax.Array:
+    """[B, Hb] token-id history (pad >= vocab) -> [B, V] presence mask."""
+    b = hist.shape[0]
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    return jnp.zeros((b, vocab), bool).at[rows, hist].set(True, mode="drop")
+
+
+def apply_repetition_penalty(logits: jax.Array, seen: jax.Array,
+                             penalty: jax.Array) -> jax.Array:
+    """HF/vLLM semantics: for tokens already seen (prompt + generated),
+    divide positive logits by the penalty, multiply negative ones
+    (reference surface: nvext repetition_penalty,
+    lib/llm/src/protocols/openai/nvext.rs; engines apply it exactly so)."""
+    p = jnp.maximum(penalty, 1e-6)[:, None]
+    pen = jnp.where(logits > 0, logits / p, logits * p)
+    return jnp.where(seen, pen, logits)
+
+
+def compute_logprobs(logits: jax.Array, sampled: jax.Array):
+    """Per-row logprob of the sampled token + top-K alternatives.
+
+    Returns (sampled_lp [B], top_ids [B, K] int32, top_lps [B, K]) over the
+    UNMODIFIED (pre-temperature) distribution — the reference's engines
+    report logprobs of the model distribution, not the sampling one.
+    """
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    samp = jnp.take_along_axis(logp, sampled[:, None].astype(jnp.int32),
+                               axis=-1)[:, 0]
+    top_lps, top_ids = jax.lax.top_k(logp, TOP_LOGPROBS)
+    return samp, top_ids.astype(jnp.int32), top_lps
+
 
 def make_keys(seeds: jax.Array, counters: jax.Array) -> jax.Array:
     """Per-row PRNG keys: deterministic in (request seed, token index)."""

@@ -68,9 +68,13 @@ class BackendPostprocessor:
                  stop_strings: Sequence[str] = ()):
         self._decode = DecodeStream(tokenizer)
         self._jail = StopJail(stop_strings)
+        # per-token text pieces of the last process_tokens call (pre-jail):
+        # the logprobs response attributes text to tokens from these
+        self.last_pieces: List[str] = []
 
     def process_tokens(self, token_ids: Sequence[int]) -> PostprocessResult:
-        text = "".join(self._decode.step(t) for t in token_ids)
+        self.last_pieces = [self._decode.step(t) for t in token_ids]
+        text = "".join(self.last_pieces)
         emit, stopped = self._jail.push(text)
         if stopped:
             return PostprocessResult(emit, FinishReason.STOP)

@@ -10,6 +10,7 @@ chunks.
 """
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import AsyncIterator, Optional
 
@@ -28,6 +29,63 @@ from dynamo_tpu.protocols.openai import (
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 
 log = logging.getLogger("dynamo_tpu.pipeline")
+
+
+class _LogprobShaper:
+    """Per-choice logprob entries gated behind the stop-string jail.
+
+    Token pieces are decoded BEFORE the jail, but the OpenAI response must
+    never include logprob entries for text the jail suppressed (a matched
+    stop string) or has not emitted yet (a held partial-stop prefix). This
+    buffers entries and releases them only once the cumulative EMITTED text
+    covers them, so `tokens`/`content` and `text_offset` always agree with
+    the choice's actual text.
+    """
+
+    def __init__(self, kind: str, token_str, offset: int = 0):
+        self.kind = kind
+        self._token_str = token_str
+        self._pending = []       # (piece, logprob, top) not yet emitted
+        self._emitted_budget = 0  # chars of emitted text not yet attributed
+        self._offset = offset
+
+    def push(self, frame: EngineOutput, pieces, emitted_text: str):
+        """Feed one engine frame + its emitted text; returns the response
+        logprobs object covering entries that became emittable, or None."""
+        if frame.log_probs is not None:
+            tops = frame.top_logprobs or [[]] * len(frame.token_ids)
+            self._pending += list(zip(pieces, frame.log_probs, tops))
+        self._emitted_budget += len(emitted_text)
+        released = []
+        while self._pending and len(self._pending[0][0]) <= \
+                self._emitted_budget:
+            piece, lp, top = self._pending.pop(0)
+            self._emitted_budget -= len(piece)
+            released.append((piece, lp, top))
+        if not released:
+            return None
+        if self.kind == "chat":
+            content = []
+            for piece, lp, top in released:
+                alts = []
+                for t, v in top:
+                    s = self._token_str(int(t))
+                    alts.append({"token": s, "logprob": v,
+                                 "bytes": list(s.encode())})
+                content.append({"token": piece, "logprob": lp,
+                                "bytes": list(piece.encode()),
+                                "top_logprobs": alts})
+            return {"content": content}
+        obj = {"text_offset": [], "token_logprobs": [], "tokens": [],
+               "top_logprobs": []}
+        for piece, lp, top in released:
+            obj["text_offset"].append(self._offset)
+            self._offset += len(piece)
+            obj["token_logprobs"].append(lp)
+            obj["tokens"].append(piece)
+            obj["top_logprobs"].append(
+                {self._token_str(int(t)): v for t, v in top})
+        return obj
 
 
 class Pipeline:
@@ -49,14 +107,13 @@ class Pipeline:
         pre, annotations = self.preprocessor.preprocess_chat(
             request, context.id)
         gen = ChatDeltaGenerator(request.model)
-        post = BackendPostprocessor(self.preprocessor.tokenizer,
-                                    pre.stop.stop or ())
         # non-streaming responses always carry usage (OpenAI API behavior);
         # streaming only on stream_options.include_usage
         want_usage = not request.stream or bool(
             request.stream_options
             and request.stream_options.get("include_usage"))
-        async for chunk in self._drive(pre, context, gen, post, want_usage):
+        async for chunk in self._drive_n(pre, context, gen, "chat",
+                                         want_usage):
             yield chunk
 
     async def generate_completion(self, request: CompletionRequest,
@@ -64,42 +121,111 @@ class Pipeline:
         pre, annotations = self.preprocessor.preprocess_completion(
             request, context.id)
         gen = CompletionDeltaGenerator(request.model)
-        post = BackendPostprocessor(self.preprocessor.tokenizer,
-                                    pre.stop.stop or ())
         want_usage = not request.stream or bool(
             getattr(request, "stream_options", None)
             and request.stream_options.get("include_usage"))
-        async for chunk in self._drive(pre, context, gen, post, want_usage):
+        echo_text = None
+        if pre.output.echo:
+            # OpenAI completions echo: the prompt text leads each choice
+            echo_text = self.preprocessor.tokenizer.decode(pre.token_ids)
+        async for chunk in self._drive_n(pre, context, gen, "completion",
+                                         want_usage, echo_text):
             yield chunk
 
-    async def _drive(self, pre: PreprocessedRequest, context: Context,
-                     gen, post: BackendPostprocessor, want_usage: bool):
+    # -- logprobs response shaping --------------------------------------------
+
+    def _token_str(self, tid: int) -> str:
+        return self.preprocessor.tokenizer.decode([tid])
+
+    # -- stream driving -------------------------------------------------------
+
+    async def _drive_n(self, pre: PreprocessedRequest, context: Context,
+                       gen, kind: str, want_usage: bool,
+                       echo_text: Optional[str] = None):
+        """Drive n parallel engine streams (OpenAI `n` choices) into one
+        chunk stream. Choice i runs as its own engine request (distinct id
+        and seed — the reference hands `n` to its engines the same way);
+        per-choice stop strings stop only that choice's engine request."""
+        n = max(1, pre.sampling.n)
+        tokenizer = self.preprocessor.tokenizer
+        pres = [pre]
+        for i in range(1, n):
+            clone = pre.model_copy(deep=True)
+            clone.request_id = f"{pre.request_id}#{i}"
+            clone.sampling.seed = ((pre.sampling.seed or 0)
+                                   + i * 0x1F123BB5) & 0x7FFFFFFF
+            pres.append(clone)
+        ctxs = [Context(p.request_id, context.baggage) for p in pres]
+
+        async def cascade_stop():
+            await context.wait_stopped()
+            for c in ctxs:
+                c.stop_generating()
+
+        watcher = asyncio.create_task(cascade_stop())
+        q: asyncio.Queue = asyncio.Queue()
+
+        async def pump(i: int):
+            try:
+                async for raw in self._token_stream(pres[i], ctxs[i]):
+                    await q.put((i, raw, None))
+            except Exception as e:  # surface as an error frame
+                await q.put((i, None, e))
+            finally:
+                await q.put((i, None, None))
+
+        pumps = [asyncio.create_task(pump(i)) for i in range(n)]
+        posts = [BackendPostprocessor(tokenizer, pre.stop.stop or ())
+                 for _ in range(n)]
+        shapers = [_LogprobShaper(kind, self._token_str,
+                                  len(echo_text or "")) for _ in range(n)]
+        finishes: dict = {}
         n_out = 0
-        finish: Optional[str] = None
-        async for raw in self._token_stream(pre, context):
-            frame = EngineOutput.model_validate(raw)
-            n_out += len(frame.token_ids)
-            res = post.process(frame)
-            if res.text:
-                yield gen.text_chunk(res.text)
-            if res.finish_reason is not None:
-                finish = res.finish_reason.value
-                if res.finish_reason == FinishReason.STOP \
-                        and frame.finish_reason is None:
-                    # stop string matched frontend-side: stop the engine
-                    context.stop_generating()
-                break
-        if finish is None:
-            # stream ended with no finish frame: abnormal termination (worker
-            # died / stream lost), or the client stopped us — never report a
-            # clean "stop" for a truncated response
-            finish = (FinishReason.CANCELLED.value if context.is_stopped
-                      else FinishReason.ERROR.value)
-        usage = Usage(prompt_tokens=len(pre.token_ids),
-                      completion_tokens=n_out,
-                      total_tokens=len(pre.token_ids) + n_out) \
-            if want_usage else None
-        yield gen.finish_chunk(finish, usage=usage)
+        try:
+            if echo_text:
+                for i in range(n):
+                    yield gen.text_chunk(echo_text, index=i)
+            active = n
+            while active:
+                i, raw, err = await q.get()
+                if err is not None:
+                    log.error("stream %d failed: %s", i, err)
+                if raw is None and err is None:
+                    active -= 1
+                    if i not in finishes:
+                        # stream ended with no finish frame: abnormal
+                        # termination or client stop — never a clean "stop"
+                        finishes[i] = (FinishReason.CANCELLED.value
+                                       if context.is_stopped or
+                                       ctxs[i].is_stopped
+                                       else FinishReason.ERROR.value)
+                        yield gen.finish_chunk(finishes[i], index=i)
+                    continue
+                if err is not None or i in finishes:
+                    continue
+                frame = EngineOutput.model_validate(raw)
+                n_out += len(frame.token_ids)
+                res = posts[i].process(frame)
+                lp_obj = shapers[i].push(frame, posts[i].last_pieces,
+                                         res.text)
+                if res.text or lp_obj:
+                    yield gen.text_chunk(res.text, index=i, logprobs=lp_obj)
+                if res.finish_reason is not None:
+                    finishes[i] = res.finish_reason.value
+                    if res.finish_reason == FinishReason.STOP \
+                            and frame.finish_reason is None:
+                        # stop string matched frontend-side: stop the engine
+                        ctxs[i].stop_generating()
+                    yield gen.finish_chunk(finishes[i], index=i)
+        finally:
+            watcher.cancel()
+            for t in pumps:
+                t.cancel()
+        if want_usage:
+            usage = Usage(prompt_tokens=len(pre.token_ids),
+                          completion_tokens=n_out,
+                          total_tokens=len(pre.token_ids) + n_out)
+            yield gen.usage_chunk(usage)
 
 
 class LocalPipeline(Pipeline):

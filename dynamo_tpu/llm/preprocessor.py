@@ -117,9 +117,7 @@ class OpenAIPreprocessor:
                 ignore_eos=bool(ext.ignore_eos),
             ),
             output=OutputOptions(
-                logprobs=getattr(request, "top_logprobs", None)
-                or (request.logprobs if not isinstance(request.logprobs, bool)
-                    else None),
+                logprobs=self._logprobs_request(request),
                 echo=bool(getattr(request, "echo", False)),
             ),
             eos_token_ids=list(self.tokenizer.eos_token_ids),
@@ -127,6 +125,20 @@ class OpenAIPreprocessor:
             mdc_sum=self.card.mdcsum,
             annotations=list(ext.annotations or []),
         )
+
+    @staticmethod
+    def _logprobs_request(request) -> Optional[int]:
+        """OpenAI logprobs knobs -> internal count (None = off).
+
+        Chat: `logprobs: bool` turns the feature on, `top_logprobs: int`
+        adds alternatives. Completions: `logprobs: int` is the alternative
+        count directly (0 still returns sampled-token logprobs)."""
+        lp = request.logprobs
+        if isinstance(lp, bool):
+            if not lp:
+                return None
+            return getattr(request, "top_logprobs", None) or 0
+        return lp  # int or None (completions style)
 
     @staticmethod
     def _annotations(ext: Ext, prompt: str,

@@ -29,7 +29,7 @@ log = logging.getLogger("dynamo_tpu.worker")
 
 
 def _to_engine_request(pre: PreprocessedRequest) -> EngineRequest:
-    s, st = pre.sampling, pre.stop
+    s, st, out = pre.sampling, pre.stop, pre.output
     return EngineRequest(
         request_id=pre.request_id,
         prompt=list(pre.token_ids),
@@ -42,6 +42,8 @@ def _to_engine_request(pre: PreprocessedRequest) -> EngineRequest:
             ignore_eos=st.ignore_eos,
             stop_token_ids=tuple(st.stop_token_ids_hidden or ()),
             min_tokens=st.min_tokens or 0,
+            repetition_penalty=s.repetition_penalty or 1.0,
+            logprobs=out.logprobs,
         ))
 
 
@@ -177,6 +179,11 @@ class NativeEngineWorker(AsyncEngine):
                     continue
                 q.put_nowait(EngineOutput(
                     token_ids=[ev.token] if ev.token is not None else [],
+                    log_probs=([ev.logprob] if ev.logprob is not None
+                               else None),
+                    top_logprobs=([[[float(t), lp] for t, lp in
+                                    ev.top_logprobs]]
+                                  if ev.top_logprobs is not None else None),
                     finish_reason=(FinishReason(ev.finish_reason)
                                    if ev.finish_reason else None)))
             self.metrics_publisher.update(self.engine.metrics())

@@ -220,7 +220,7 @@ def decode_paged_attention(
     # full array extent — valid Mosaic layout for any G (see kernel docs).
     qg = q.reshape(s, hkv, g, hd)
 
-    if hd < 128 and 128 % hd == 0 and ps % (128 // hd) == 0:
+    if hd < 128 and kernel_supported(hd, ps):
         # lane-aligned packed path (see module docstring): view pages as
         # [rows, 128] and fold the packed accumulator outside the kernel
         pack = 128 // hd

@@ -69,10 +69,15 @@ class PreprocessedRequest(pydantic.BaseModel):
 class EngineOutput(pydantic.BaseModel):
     """One streamed frame from a worker back to the frontend.
 
-    Counterpart of the reference's BackendOutput/LLMEngineOutput.
+    Counterpart of the reference's BackendOutput/LLMEngineOutput (which also
+    carries per-token log_probs, lib/llm/src/protocols/common/llm_backend.rs).
     """
 
     token_ids: List[int] = []
     text: Optional[str] = None
     cum_log_probs: Optional[float] = None
+    # parallel to token_ids when the request asked for logprobs
+    log_probs: Optional[List[float]] = None
+    # per token: the top-k alternatives as [token_id, logprob] pairs
+    top_logprobs: Optional[List[List[List[float]]]] = None
     finish_reason: Optional[FinishReason] = None

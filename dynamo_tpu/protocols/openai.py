@@ -88,12 +88,15 @@ class ChatStreamChoice(pydantic.BaseModel):
     index: int = 0
     delta: ChatChoiceDelta = ChatChoiceDelta()
     finish_reason: Optional[str] = None
+    # {"content": [{token, logprob, bytes, top_logprobs: [...]}, ...]}
+    logprobs: Optional[Dict[str, Any]] = None
 
 
 class ChatChoice(pydantic.BaseModel):
     index: int = 0
     message: ChatMessage = ChatMessage(role="assistant", content="")
     finish_reason: Optional[str] = None
+    logprobs: Optional[Dict[str, Any]] = None
 
 
 class ChatCompletionResponse(pydantic.BaseModel):

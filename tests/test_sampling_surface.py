@@ -1,0 +1,259 @@
+"""Round-trip tests for the sampling/output surface the engines must honour:
+repetition_penalty, logprobs, n>1 fan-out, echo (VERDICT r2 missing #5;
+reference: lib/llm/src/protocols/common.rs SamplingOptions/OutputOptions and
+the OpenAI logprobs response fields, openai.rs).
+"""
+import asyncio
+import json
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import SamplingParams
+from dynamo_tpu.frontend.service import HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.pipeline import LocalPipeline
+from dynamo_tpu.llm.worker import NativeEngineWorker
+
+from tests.http_client import request
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+
+
+def make_engine(**kw):
+    defaults = dict(page_size=8, num_pages=64, max_slots=4,
+                    max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+                    max_model_len=512, decode_steps=4)
+    defaults.update(kw)
+    return NativeEngine(CFG, EngineConfig(**defaults), seed=0)
+
+
+def byte_card(name="tiny-model"):
+    return ModelDeploymentCard(name=name, arch="tiny", tokenizer_kind="byte",
+                               context_length=512, eos_token_ids=[2])
+
+
+# -- engine level --------------------------------------------------------------
+
+def test_repetition_penalty_changes_output():
+    """A strong penalty must change the greedy continuation vs rp=1.0 and
+    strictly reduce repeats (the tiny random model loops hard without it)."""
+    prompt = list(range(50, 66)) * 2  # repetitive prompt encourages loops
+    base = make_engine().generate(
+        prompt, SamplingParams(max_tokens=24, ignore_eos=True), "base")
+    pen = make_engine().generate(
+        prompt, SamplingParams(max_tokens=24, ignore_eos=True,
+                               repetition_penalty=1.8), "pen")
+    assert base != pen
+    # penalized run repeats less: count tokens emitted more than once
+    def repeats(toks):
+        return len(toks) - len(set(toks))
+    assert repeats(pen) <= repeats(base)
+
+
+def test_repetition_penalty_one_is_identity():
+    """rp=1.0 must take the unpenalized program and produce identical
+    output (the penalized variant is a separate compile; 1.0 must not
+    drift)."""
+    prompt = list(range(10, 30))
+    p1 = make_engine().generate(
+        prompt, SamplingParams(max_tokens=8, ignore_eos=True), "a")
+    p2 = make_engine().generate(
+        prompt, SamplingParams(max_tokens=8, ignore_eos=True,
+                               repetition_penalty=1.0), "b")
+    assert p1 == p2
+
+
+def test_logprobs_greedy_sampled_is_top1():
+    """Greedy decoding: the sampled token's logprob equals the top-1
+    alternative's, and the top-1 id is the sampled token."""
+    eng = make_engine()
+    eng.add_request(__import__("dynamo_tpu.engine.scheduler",
+                               fromlist=["EngineRequest"]).EngineRequest(
+        "lp", list(range(20, 40)),
+        SamplingParams(max_tokens=6, ignore_eos=True, logprobs=3)))
+    events = []
+    while eng.has_work():
+        events.extend(eng.step())
+    toks = [ev for ev in events if ev.token is not None]
+    assert toks, events
+    for ev in toks:
+        assert ev.logprob is not None
+        assert ev.top_logprobs is not None and len(ev.top_logprobs) == 3
+        top_id, top_lp = ev.top_logprobs[0]
+        assert top_id == ev.token
+        assert abs(top_lp - ev.logprob) < 1e-5
+        assert ev.logprob <= 0.0
+
+
+# -- HTTP round trips ----------------------------------------------------------
+
+def _serve_native(model="tiny-model"):
+    async def setup():
+        engine = make_engine()
+        worker = await NativeEngineWorker(engine).start()
+        pipe = LocalPipeline(byte_card(model), worker)
+        svc = await HttpService("127.0.0.1", 0).start()
+        svc.models.add(model, pipe, "both")
+        return svc, worker
+    return setup
+
+
+def test_completions_logprobs_and_echo_roundtrip():
+    async def main():
+        svc, worker = await _serve_native()()
+        status, body = await request(
+            "127.0.0.1", svc.port, "POST", "/v1/completions",
+            {"model": "tiny-model", "prompt": "hello", "max_tokens": 5,
+             "logprobs": 2, "echo": True,
+             "ext": {"ignore_eos": True}})
+        assert status == 200
+        choice = json.loads(body)["choices"][0]
+        # echo: response text leads with the prompt
+        assert choice["text"].startswith("hello")
+        lp = choice["logprobs"]
+        assert len(lp["tokens"]) == 5
+        assert len(lp["token_logprobs"]) == 5
+        assert all(v <= 0.0 for v in lp["token_logprobs"])
+        assert all(len(t) == 2 for t in lp["top_logprobs"])
+        # text_offset starts after the echoed prompt
+        assert lp["text_offset"][0] == len("hello")
+        await svc.stop()
+        await worker.stop()
+    asyncio.run(main())
+
+
+def test_chat_logprobs_roundtrip():
+    async def main():
+        svc, worker = await _serve_native()()
+        status, body = await request(
+            "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+            {"model": "tiny-model", "max_tokens": 4,
+             "messages": [{"role": "user", "content": "hi"}],
+             "logprobs": True, "top_logprobs": 2,
+             "ext": {"ignore_eos": True}})
+        assert status == 200
+        choice = json.loads(body)["choices"][0]
+        content = choice["logprobs"]["content"]
+        assert len(content) == 4
+        for entry in content:
+            assert entry["logprob"] <= 0.0
+            assert len(entry["top_logprobs"]) == 2
+            assert isinstance(entry["bytes"], list)
+        await svc.stop()
+        await worker.stop()
+    asyncio.run(main())
+
+
+def test_logprobs_jailed_by_stop_string():
+    """Logprob entries must never cover text a stop string suppressed:
+    tokens/text_offset agree exactly with the emitted choice text
+    (code-review finding: pre-jail pieces leaked through logprobs)."""
+    async def main():
+        from dynamo_tpu.llm.worker import EchoTokenEngine
+        pipe = LocalPipeline(byte_card("echo"), EchoTokenEngine())
+        svc = await HttpService("127.0.0.1", 0).start()
+        svc.models.add("echo", pipe, "completion")
+        status, body = await request(
+            "127.0.0.1", svc.port, "POST", "/v1/completions",
+            {"model": "echo", "prompt": "hello STOP world",
+             "max_tokens": 100, "stop": ["STOP"], "logprobs": 1})
+        assert status == 200
+        choice = json.loads(body)["choices"][0]
+        assert choice["text"] == "hello "
+        # EchoTokenEngine sends no logprobs -> the field is simply absent
+        lp = choice.get("logprobs")
+        assert lp is None or "".join(lp["tokens"]) in choice["text"]
+        await svc.stop()
+    asyncio.run(main())
+
+
+def test_logprobs_stop_string_alignment():
+    """Stop string + logprobs: the logprobs tokens exactly reconstruct the
+    emitted text — entries for jailed/suppressed tokens never appear
+    (code-review finding: pre-jail pieces leaked through logprobs)."""
+    from dynamo_tpu.protocols.common import EngineOutput, FinishReason
+
+    class AsciiLpEngine:
+        """Streams 'worldEND...' one ASCII byte per frame with logprobs."""
+
+        async def generate(self, request, context):
+            for ch in "worldEND rest":
+                tid = ord(ch) + 3  # ByteTokenizer: id = byte + 3
+                yield EngineOutput(
+                    token_ids=[tid], log_probs=[-0.5],
+                    top_logprobs=[[[float(tid), -0.5]]],
+                ).model_dump(exclude_none=True)
+            yield EngineOutput(finish_reason=FinishReason.LENGTH
+                               ).model_dump(exclude_none=True)
+
+    async def main():
+        pipe = LocalPipeline(byte_card("fake"), AsciiLpEngine())
+        svc = await HttpService("127.0.0.1", 0).start()
+        svc.models.add("fake", pipe, "completion")
+        status, body = await request(
+            "127.0.0.1", svc.port, "POST", "/v1/completions",
+            {"model": "fake", "prompt": "say", "max_tokens": 50,
+             "logprobs": 1, "stop": ["END"]})
+        assert status == 200
+        choice = json.loads(body)["choices"][0]
+        assert choice["text"] == "world"
+        assert choice["finish_reason"] == "stop"
+        lp = choice["logprobs"]
+        assert "".join(lp["tokens"]) == "world", lp
+        assert lp["text_offset"] == list(range(5))
+        # without the stop, every token's entry appears
+        status, body = await request(
+            "127.0.0.1", svc.port, "POST", "/v1/completions",
+            {"model": "fake", "prompt": "say", "max_tokens": 50,
+             "logprobs": 1})
+        choice = json.loads(body)["choices"][0]
+        assert "".join(choice["logprobs"]["tokens"]) == choice["text"]
+        await svc.stop()
+    asyncio.run(main())
+
+
+def test_n_choices_fan_out():
+    """n=3 returns 3 indexed choices, each its own engine sample; usage
+    counts completion tokens across all choices."""
+    async def main():
+        svc, worker = await _serve_native()()
+        status, body = await request(
+            "127.0.0.1", svc.port, "POST", "/v1/completions",
+            {"model": "tiny-model", "prompt": "abc", "max_tokens": 4,
+             "n": 3, "temperature": 0.9, "seed": 7,
+             "ext": {"ignore_eos": True}})
+        assert status == 200
+        out = json.loads(body)
+        idxs = sorted(c["index"] for c in out["choices"])
+        assert idxs == [0, 1, 2]
+        for c in out["choices"]:
+            assert c["finish_reason"] == "length"
+            assert c["text"]
+        assert out["usage"]["completion_tokens"] == 12
+        await svc.stop()
+        await worker.stop()
+    asyncio.run(main())
+
+
+def test_n_choices_streaming_indexes():
+    """Streaming with n=2: chunks carry distinct choice indexes and each
+    index gets a finish chunk."""
+    async def main():
+        from tests.http_client import sse_events
+        svc, worker = await _serve_native()()
+        seen, finished = set(), set()
+        async for _ev, data in sse_events(
+                "127.0.0.1", svc.port, "/v1/completions",
+                {"model": "tiny-model", "prompt": "xyz", "max_tokens": 3,
+                 "n": 2, "stream": True, "ext": {"ignore_eos": True}}):
+            if data == "[DONE]":
+                break
+            for c in json.loads(data)["choices"]:
+                seen.add(c["index"])
+                if c.get("finish_reason"):
+                    finished.add(c["index"])
+        assert seen == {0, 1}
+        assert finished == {0, 1}
+        await svc.stop()
+        await worker.stop()
+    asyncio.run(main())
