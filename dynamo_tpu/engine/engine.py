@@ -112,6 +112,7 @@ class NativeEngine:
         self.step_count = 0
         self._finished_cb = None
         self._last_logprobs = None  # (lp, top_ids, top_lps) of last step
+        self._dec_state = None      # device-resident decode window state
         # cumulative MoE capacity-drop counters (dispatch impl only)
         self.moe_dropped_tokens = 0.0
         self.moe_routed_tokens = 0.0
@@ -177,13 +178,14 @@ class NativeEngine:
             for rp in (False, True) for lp in (False, True)
         }
         self._decode_fns = {
-            (rp, lp): jax.jit(
+            (rp, lp, greedy): jax.jit(
                 functools.partial(_engine_decode_window, model_cfg,
                                   eos_tuple, kernel_mesh,
                                   max(1, engine_cfg.decode_steps),
-                                  engine_cfg.page_size, rp, lp),
+                                  engine_cfg.page_size, rp, lp, greedy),
                 donate_argnums=(1,))
             for rp in (False, True) for lp in (False, True)
+            for greedy in (False, True)
         }
         # disaggregation: whole-page gather/scatter on the
         # [L, Hkv, P, ps, hd] cache (the TPU equivalent of the reference's
@@ -365,17 +367,40 @@ class NativeEngine:
             self._sampling_arrays(plan.seqs)
         rp = self._rep_penalty_arrays(plan.seqs)
         with_lp = self._wants_logprobs(plan.seqs)
-        args = (self.params, self.cache,
-                jnp.asarray(plan.tokens[:, 0]),
-                jnp.asarray(plan.positions[:, 0]),
-                jnp.asarray(plan.page_table), jnp.asarray(plan.max_pos),
-                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-                jnp.asarray(seeds), jnp.asarray(counters),
-                jnp.asarray(min_toks))
+        greedy = all(t <= 0.0 for t in temp)
+        # device-resident decode state: if the slot set + page allocation
+        # are unchanged since the last window (and no penalty hist needs
+        # refreshing), reuse the device plan arrays and feed the last
+        # window's final (token, position, counter) device arrays straight
+        # back in — steady-state windows then upload NOTHING
+        sig = (tuple(s.request_id if s else None for s in plan.seqs),
+               tuple(len(s.pages) if s else 0 for s in plan.seqs),
+               plan.page_table.shape[1], rp is None, with_lp, greedy)
+        st = self._dec_state
+        if st is not None and st["sig"] == sig and rp is None:
+            dev = st["dev"]
+            tok_d, pos_d, ctr_d = st["next"]
+        else:
+            ign = np.array([
+                bool(self.scheduler.params[s.request_id].ignore_eos)
+                if s is not None else True for s in plan.seqs])
+            dev = (jnp.asarray(plan.page_table), jnp.asarray(plan.max_pos),
+                   jnp.asarray(temp), jnp.asarray(top_k),
+                   jnp.asarray(top_p), jnp.asarray(seeds),
+                   jnp.asarray(min_toks), jnp.asarray(ign))
+            tok_d = jnp.asarray(plan.tokens[:, 0])
+            pos_d = jnp.asarray(plan.positions[:, 0])
+            ctr_d = jnp.asarray(counters)
+        page_table_d, max_pos_d, temp_d, top_k_d, top_p_d, seeds_d, \
+            min_toks_d, ign_d = dev
+        args = (self.params, self.cache, tok_d, pos_d, page_table_d,
+                max_pos_d, temp_d, top_k_d, top_p_d, seeds_d, ctr_d,
+                min_toks_d, ign_d)
         if rp is not None:
             args += (jnp.asarray(rp[0]), jnp.asarray(rp[1]))
-        out = self._decode_fns[(rp is not None, with_lp)](*args)
-        toks, lps, top_ids, top_lps, self.cache, aux = out
+        out = self._decode_fns[(rp is not None, with_lp, greedy)](*args)
+        toks, lps, top_ids, top_lps, self.cache, aux, nxt = out
+        self._dec_state = {"sig": sig, "dev": dev, "next": nxt}
         toks, lps, top_ids, top_lps, aux = jax.device_get(
             (toks, lps, top_ids, top_lps, aux))
         if aux:
@@ -569,9 +594,30 @@ def _inject_pages(cache, ids, k_pages, v_pages):
             "v": cache["v"].at[:, :, ids].set(v_pages, mode="drop")}
 
 
+def _scatter_new_kv(cache, k_news, v_news, write_idx):
+    """One in-place scatter of all layers' new kv rows (deferred write).
+
+    cache {k,v}: [L, Hkv, P, ps, hd]; k_news/v_news [L, S, Hkv, hd];
+    write_idx [S] flat token slots (<0 = padding, dropped). Padding rows
+    get distinct out-of-range indices so unique_indices stays truthful.
+    """
+    l, hkv, p, ps, hd = cache["k"].shape
+    s = write_idx.shape[0]
+    safe = jnp.where(write_idx >= 0, write_idx,
+                     p * ps + jnp.arange(s, dtype=write_idx.dtype))
+    flat_k = cache["k"].reshape(l, hkv, p * ps, hd)
+    flat_v = cache["v"].reshape(l, hkv, p * ps, hd)
+    kn = k_news.transpose(0, 2, 1, 3).astype(flat_k.dtype)
+    vn = v_news.transpose(0, 2, 1, 3).astype(flat_v.dtype)
+    flat_k = flat_k.at[:, :, safe].set(kn, mode="drop", unique_indices=True)
+    flat_v = flat_v.at[:, :, safe].set(vn, mode="drop", unique_indices=True)
+    return {"k": flat_k.reshape(l, hkv, p, ps, hd),
+            "v": flat_v.reshape(l, hkv, p, ps, hd)}
+
+
 def _sample_logits(logits, eos_ids, temperature, top_k, top_p, seeds,
                    counters, min_tokens, seen=None, rep_penalty=None,
-                   with_lp=False):
+                   with_lp=False, greedy=False):
     """Shared tail of every engine step: repetition penalty (optional) +
     eos ban below min_tokens + sample (+ logprobs when with_lp).
 
@@ -589,8 +635,13 @@ def _sample_logits(logits, eos_ids, temperature, top_k, top_p, seeds,
         eos = jnp.asarray(eos_ids, jnp.int32)
         eos_mask = jnp.zeros((logits.shape[-1],), bool).at[eos].set(True)
         logits = jnp.where(ban & eos_mask[None, :], -1e30, logits)
-    keys = make_keys(seeds, counters)
-    toks = sample(logits, temperature, top_k, top_p, keys)
+    if greedy:
+        # all-greedy plan: argmax only — the full sampler's vocab sort
+        # costs ~1.5 ms/step on a 128k vocab (measured, v5e)
+        toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        keys = make_keys(seeds, counters)
+        toks = sample(logits, temperature, top_k, top_p, keys)
     if not with_lp:
         return toks, None, None, None
     samp_lp, top_ids, top_lps = compute_logprobs(basis, toks)
@@ -599,57 +650,85 @@ def _sample_logits(logits, eos_ids, temperature, top_k, top_p, seeds,
 
 def _engine_decode_window(cfg: ModelConfig, eos_ids: tuple, kernel_mesh,
                           n_steps: int, page_size: int, with_rp: bool,
-                          with_lp: bool,
+                          with_lp: bool, greedy: bool,
                           params, cache, tokens, positions, page_table,
                           max_pos, temperature, top_k, top_p, seeds,
-                          counters, min_tokens, hist=None, rep_penalty=None):
+                          counters, min_tokens, ignore_eos=None, hist=None,
+                          rep_penalty=None):
     """N fused decode iterations: forward + sample per step, the sampled
     token feeding the next step on device (lax.scan), so one dispatch and
     one [N, S] token download serve N tokens (VERDICT r2 weak #1 fix).
 
+    Each step uses the deferred-write decode path: the cache is read-only
+    during the layer scan (attention adds the current token via a
+    self-term) and all layers' new kv rows land in ONE in-place scatter —
+    threading cache slices through scan outputs made XLA copy the whole
+    cache every step (~8 ms on the 1B flagship).
+
     max_pos[i] is the highest position slot i may write (-1 for padding);
     positions clamp against it so a sequence that exhausts its max_tokens
-    budget mid-window drops its writes (mode="drop" via write_idx=-1) and
-    never reads pages beyond its table. Stop conditions are host-side: the
-    caller discards tokens after a stop, matching the reference's engines
-    which also overrun stop sequences by at most a bounded window.
+    budget mid-window drops its writes and never reads pages beyond its
+    table. Stop conditions are host-side: the caller discards tokens after
+    a stop, matching the reference's engines which also overrun stop
+    sequences by at most a bounded window.
 
-    with_rp: the repetition-penalty variant carries a [B, V] seen-token
-    mask (seeded from hist, updated with each sampled token on device);
-    compiled separately so the common path pays nothing for it.
+    with_rp / with_lp / greedy pick separately-compiled variants so the
+    common greedy path pays for neither the seen-token mask, the logprob
+    log_softmax+top_k, nor the full sampling sort.
     """
     s = tokens.shape[0]
     rows = jnp.arange(s)
     seen0 = (seen_token_mask(hist, cfg.vocab_size) if with_rp else
              jnp.zeros((s, 1), bool))
+    if ignore_eos is None:
+        ignore_eos = jnp.ones((s,), bool)
+    if eos_ids:
+        eos_vec = jnp.zeros((cfg.vocab_size,), bool).at[
+            jnp.asarray(eos_ids, jnp.int32)].set(True)
+    else:
+        eos_vec = None
 
     def body(carry, _):
-        cache, tok, pos, ctr, seen = carry
-        writable = pos <= max_pos
-        page = page_table[rows, jnp.minimum(pos, max_pos) // page_size]
+        cache, tok, pos, ctr, seen, alive = carry
+        # alive tracks device-detectable finishes (eos sampled, max_tokens
+        # via max_pos) so post-finish garbage steps neither write KV nor
+        # pollute MoE capacity/drop accounting (code-review r3 finding);
+        # hidden stop_token_ids finish host-side only — their tail tokens
+        # still count, a bounded and rare skew.
+        writable = (pos <= max_pos) & alive
+        prefix = jnp.clip(pos, 0, max_pos + 1)
+        logits, k_news, v_news, aux = llama.decode_forward(
+            params, cfg, tok, cache, page_table, prefix, pos,
+            valid=writable, mesh=kernel_mesh, with_aux=True)
+        page = page_table[rows, jnp.maximum(
+            jnp.minimum(pos, max_pos), 0) // page_size]
         write_idx = jnp.where(writable, page * page_size + pos % page_size,
                               -1)
-        meta = AttnMetadata(
-            positions=pos[:, None], page_table=page_table,
-            kv_lens=jnp.minimum(pos, max_pos) + 1,
-            write_idx=write_idx[:, None])
-        logits, cache, aux = llama.forward(params, cfg, tok[:, None], cache,
-                                           meta, mesh=kernel_mesh,
-                                           with_aux=True)
+        cache = _scatter_new_kv(cache, k_news, v_news, write_idx)
         nxt, lp, top_ids, top_lps = _sample_logits(
-            logits[:, 0], eos_ids, temperature, top_k, top_p, seeds, ctr,
+            logits, eos_ids, temperature, top_k, top_p, seeds, ctr,
             min_tokens, seen=seen if with_rp else None,
-            rep_penalty=rep_penalty if with_rp else None, with_lp=with_lp)
+            rep_penalty=rep_penalty if with_rp else None, with_lp=with_lp,
+            greedy=greedy)
         if with_rp:
             seen = seen.at[rows, nxt].set(True)
-        return (cache, nxt, pos + 1, ctr + 1, seen), \
+        if eos_vec is not None:
+            alive = alive & (ignore_eos | ~eos_vec[nxt])
+        return (cache, nxt, pos + 1, ctr + 1, seen, alive), \
             (nxt, lp, top_ids, top_lps, aux)
 
-    (cache, *_), (toks, lps, top_ids, top_lps, auxs) = jax.lax.scan(
-        body, (cache, tokens, positions, counters, seen0), None,
-        length=n_steps)
+    alive0 = max_pos >= 0
+    (cache, tok_f, pos_f, ctr_f, *_), \
+        (toks, lps, top_ids, top_lps, auxs) = \
+        jax.lax.scan(body,
+                     (cache, tokens, positions, counters, seen0, alive0),
+                     None, length=n_steps)
     aux = {k: jnp.sum(v) for k, v in auxs.items()}
-    return toks, lps, top_ids, top_lps, cache, aux
+    # final (token, position, counter) stay ON DEVICE: when the slot set and
+    # page allocation are unchanged, the engine feeds them straight into the
+    # next window — zero plan uploads per steady-state window (each host->
+    # device upload rides the serving host's dispatch latency)
+    return toks, lps, top_ids, top_lps, cache, aux, (tok_f, pos_f, ctr_f)
 
 
 def _engine_step(cfg: ModelConfig, eos_ids: tuple, sp_mesh, kernel_mesh,

@@ -546,7 +546,16 @@ class Scheduler:
         if not active:
             return None
         s_count = self.cfg.max_slots
-        max_pages = max(len(s.pages) for s in active)
+        # bucket the table width by each request's ADMISSION-TIME page limit
+        # (prompt + max_tokens), not its current allocation: the width then
+        # never changes mid-request, so the decode window compiles once per
+        # workload shape instead of recompiling at every pow2 page-count
+        # crossing (each recompile stalled the serving loop for seconds)
+        max_pages = max(
+            max(len(s.pages),
+                -(-(len(s.prompt) + self.params[s.request_id].max_tokens)
+                  // ps))
+            for s in active)
         pb = next_bucket(max_pages, self.page_buckets)
         tokens = np.zeros((s_count, 1), np.int32)
         positions = np.zeros((s_count, 1), np.int32)

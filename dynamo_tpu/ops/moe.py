@@ -17,8 +17,13 @@ default.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.parallel.mesh import shard_map_compat
 
 
 def moe_dispatch_mlp(x: jax.Array, lp, cfg, capacity_factor: float = 2.0,
@@ -76,5 +81,96 @@ def moe_dispatch_mlp(x: jax.Array, lp, cfg, capacity_factor: float = 2.0,
     if return_dropped:
         routed = jnp.sum(sel_flat)
         dropped = routed - jnp.sum(keep)
+        return out, (dropped, routed)
+    return out
+
+
+def _route(x, router, e, k, capacity_factor, valid):
+    """Shared routing: top-k selection, capacity positions, weights.
+
+    Returns (keep [B,S,E], pos_oh would be too big — positions [B,S,E],
+    weights_flat [B,S,1], cap) where S = T*k token-major flat choices.
+    All tensors are O(B·S·E) — NO capacity dim, so it is cheap to compute
+    replicated on every ep shard.
+    """
+    b, t, d = x.shape
+    f32 = jnp.float32
+    logits = jnp.einsum("btd,de->bte", x.astype(f32), router.astype(f32))
+    weights, idx = jax.lax.top_k(logits, k)
+    weights = jax.nn.softmax(weights, axis=-1)
+    sel = jax.nn.one_hot(idx, e, dtype=f32)
+    if valid is not None:
+        sel = sel * valid.astype(f32)[:, :, None, None]
+    sel_flat = sel.reshape(b, t * k, e)
+    pos = jnp.cumsum(sel_flat, axis=1) - 1.0
+    cap = max(int(t * k / e * capacity_factor), 1)
+    keep = (pos < cap) * sel_flat
+    w_flat = jnp.broadcast_to(weights[..., None],
+                              (b, t, k, 1)).reshape(b, t * k, 1)
+    return sel_flat, keep, pos, w_flat, cap
+
+
+def moe_dispatch_mlp_sharded(x, lp, cfg, mesh, capacity_factor: float = 2.0,
+                             return_dropped: bool = False, valid=None):
+    """Expert-parallel dispatch with O(E/ep) per-shard memory.
+
+    The dense moe_dispatch_mlp materializes [B, S, E, C] dispatch/combine
+    tensors per chip; under jit auto-sharding XLA does not reliably shard
+    their E axis, so Mixtral-class configs would allocate all-expert
+    capacity buffers everywhere (VERDICT r2 next #7). Here shard_map over
+    the "ep" axis makes the per-shard shapes explicit: routing (no C dim)
+    is computed replicated, each shard builds dispatch/combine only for its
+    OWN E/ep experts, runs their FFNs, and the combine psums partial
+    outputs over "ep" (+ "tp" for the FFN-dim shards). This is the
+    replicated-token EP pattern — the decode batch is small and whole per
+    shard (engine invariant), so a psum is the right collective; a ragged
+    all-to-all only pays when tokens themselves are sharded.
+    """
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    ep = mesh.shape.get("ep", 1)
+    f32 = jnp.float32
+    b, t, d = x.shape
+
+    def body(x, router, w_gate, w_up, w_down, valid_arr):
+        # runs per (dp, ep, tp) shard: x is the dp-local batch, w_* leading
+        # dim is E/ep, last dim F/tp
+        bl, tl, dl = x.shape
+        sel_flat, keep, pos, w_flat, cap = _route(
+            x, router, e, k, capacity_factor, valid_arr)
+        ei = jax.lax.axis_index("ep")
+        e_loc = e // ep
+        # slice MY experts' columns out of the replicated routing tensors
+        keep_l = jax.lax.dynamic_slice_in_dim(keep, ei * e_loc, e_loc, 2)
+        pos_l = jax.lax.dynamic_slice_in_dim(pos, ei * e_loc, e_loc, 2)
+        pos_oh = jax.nn.one_hot(pos_l.astype(jnp.int32), cap, dtype=f32)
+        dispatch = keep_l[..., None] * pos_oh          # [B, S, E/ep, C]
+        combine = dispatch * w_flat[..., None]
+        x_rep = jnp.repeat(x, k, axis=1)
+        xin = jnp.einsum("bsec,bsd->becd", dispatch,
+                         x_rep.astype(f32)).astype(x.dtype)
+        gate = jnp.einsum("becd,edf->becf", xin, w_gate)
+        up = jnp.einsum("becd,edf->becf", xin, w_up)
+        act = jax.nn.silu(gate.astype(f32)).astype(x.dtype) * up
+        y = jnp.einsum("becf,efd->becd", act, w_down)
+        out = jnp.einsum("bsec,becd->bsd", combine, y.astype(f32))
+        out = jax.lax.psum(out, ("ep", "tp"))
+        out = out.reshape(bl, tl, k, dl).sum(axis=2).astype(x.dtype)
+        routed = jax.lax.psum(jnp.sum(sel_flat), "dp")
+        dropped = routed - jax.lax.psum(jnp.sum(keep), "dp")
+        return out, dropped, routed
+
+    valid_in = valid if valid is not None else jnp.ones((b, t), bool)
+    specs = dict(
+        mesh=mesh,
+        # batch rides "dp" (whole per shard when dp=1), experts ride "ep",
+        # FFN dim rides "tp" — matching llama.param_shardings
+        in_specs=(P("dp"), P(), P("ep", None, "tp"), P("ep", None, "tp"),
+                  P("ep", "tp", None), P("dp")),
+        out_specs=(P("dp"), P(), P()),
+    )
+    f = shard_map_compat(body, **specs)
+    out, dropped, routed = f(x, lp["router"], lp["w_gate"], lp["w_up"],
+                             lp["w_down"], valid_in)
+    if return_dropped:
         return out, (dropped, routed)
     return out

@@ -253,3 +253,48 @@ def test_moe_dispatch_sharded_over_ep_mesh():
     got = jax.jit(lambda a, w: moe_dispatch_mlp(a, w, cfg, 4.0))(x, lp_sh)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_moe_dispatch_sharded_shard_map_matches_and_bounds_memory():
+    """The explicit shard_map EP dispatch (O(E/ep) per-shard buffers,
+    VERDICT r2 next #7) matches the dense dispatch, keeps drop accounting,
+    and its compiled per-shard dispatch tensors carry only E/ep experts."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.ops.moe import moe_dispatch_mlp, moe_dispatch_mlp_sharded
+    from dynamo_tpu.parallel.mesh import make_mesh
+
+    cfg = ModelConfig(name="tiny-moe", dtype="float32", num_experts=4,
+                      num_experts_per_tok=2)
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    lp = jax.tree.map(lambda a: a[0], params["layers"])
+    mesh = make_mesh(ep=4, tp=2)
+    shard = {
+        "router": NamedSharding(mesh, P(None, None)),
+        "w_gate": NamedSharding(mesh, P("ep", None, "tp")),
+        "w_up": NamedSharding(mesh, P("ep", None, "tp")),
+        "w_down": NamedSharding(mesh, P("ep", "tp", None)),
+    }
+    lp_sh = {k: (jax.device_put(v, shard[k]) if k in shard else v)
+             for k, v in lp.items()}
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((1, 16, cfg.hidden_size)),
+                    jnp.float32)
+    ref, (drop_ref, routed_ref) = moe_dispatch_mlp(
+        x, lp, cfg, capacity_factor=2.0, return_dropped=True)
+    fn = jax.jit(lambda a, w: moe_dispatch_mlp_sharded(
+        a, w, cfg, mesh, 2.0, return_dropped=True))
+    got, (drop, routed) = fn(x, lp_sh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    assert float(drop) == float(drop_ref)
+    assert float(routed) == float(routed_ref)
+    # compiled-HLO check: no per-shard buffer carries the FULL expert dim
+    # with a capacity axis — dispatch/combine must be [_, S, E/ep, C]
+    txt = fn.lower(x, lp_sh).compile().as_text()
+    s_tok, e, cap = 16 * 2, 4, 16  # S = T*k; cap = T*k/E*2.0
+    full = f"{s_tok},{e},{cap}"      # what the dense path would allocate
+    local = f"{s_tok},{e // 4},{cap}"
+    assert local.lower() in txt.lower().replace(" ", ""), "local dispatch missing"
+    assert full.lower() not in txt.lower().replace(" ", ""), \
+        "full-expert capacity buffer present on a shard"

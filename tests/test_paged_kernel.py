@@ -89,6 +89,51 @@ def test_kernel_hd128_unpacked_matches_oracle():
         rtol=1e-5, atol=1e-5)
 
 
+def test_prefix_kernel_plus_self_matches_oracle():
+    """decode_paged_attention_prefix + combine_self_attention (the
+    deferred-write hot path) == oracle attention over prefix + new token,
+    for hd=64 (packed) and hd=128 (pack=1), including empty prefixes."""
+    from dynamo_tpu.ops.paged_attention import (
+        combine_self_attention, decode_paged_attention_prefix,
+    )
+    rng = np.random.default_rng(7)
+    for hd in (64, 128):
+        s, h, hkv, L, p, ps, pb = 3, 8, 2, 2, 8, 64, 3
+        q = rng.standard_normal((s, h, hd)).astype(np.float32)
+        kc = rng.standard_normal((L, hkv, p, ps, hd)).astype(np.float32)
+        vc = rng.standard_normal((L, hkv, p, ps, hd)).astype(np.float32)
+        k_new = rng.standard_normal((s, hkv, hd)).astype(np.float32)
+        v_new = rng.standard_normal((s, hkv, hd)).astype(np.float32)
+        pt = ((np.arange(s * pb).reshape(s, pb) * 3) % p).astype(np.int32)
+        prefix = np.array([70, 0, 130], np.int32)  # incl. empty prefix
+        for layer in range(L):
+            acc, m, l = decode_paged_attention_prefix(
+                jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+                jnp.asarray([layer], jnp.int32), jnp.asarray(pt),
+                jnp.asarray(prefix), interpret=True)
+            out = combine_self_attention(
+                jnp.asarray(q), jnp.asarray(k_new), jnp.asarray(v_new),
+                acc, m, l)
+            g = h // hkv
+            ref = np.zeros_like(q)
+            for i in range(s):
+                n = prefix[i]
+                ks = np.concatenate([kc[layer][:, pg] for pg in pt[i]],
+                                    axis=1)[:, :n]
+                vs = np.concatenate([vc[layer][:, pg] for pg in pt[i]],
+                                    axis=1)[:, :n]
+                for head in range(h):
+                    j = head // g
+                    kk = np.concatenate([ks[j], k_new[i, j][None]], 0)
+                    vv = np.concatenate([vs[j], v_new[i, j][None]], 0)
+                    sc = (q[i, head] @ kk.T) * hd ** -0.5
+                    pr = np.exp(sc - sc.max())
+                    pr /= pr.sum()
+                    ref[i, head] = pr @ vv
+            np.testing.assert_allclose(np.asarray(out), ref,
+                                       rtol=2e-5, atol=2e-5)
+
+
 def test_kernel_padded_slots_no_nan():
     """kv_len=0 padding slots must produce finite output (clamped to 1)."""
     s, h, hkv, hd, p, ps, pb = 2, 4, 2, 16, 8, 8, 2
