@@ -1,0 +1,237 @@
+"""Durability for the control-plane server: write-ahead journal + snapshot.
+
+The reference outsources durability to etcd (raft-replicated KV) and NATS
+JetStream (file-backed work queues) — SURVEY.md §L0, the prefill queue rides
+JetStream precisely so queued work survives broker restarts
+(reference: docs/disagg_serving.md:57-59). Our single-binary control plane
+(transports/server.py) held everything in memory (ADVICE r2: non-durable
+SPOF). This module adds the file-backed layer:
+
+- DurablePlane wraps the in-memory plane and appends every *persistent*
+  mutation to an append-only journal: unleased KV puts/deletes and work-queue
+  push/pop. Lease-scoped keys are deliberately NOT persisted — as in etcd,
+  a lease cannot outlive the server that granted it; workers re-register on
+  reconnect (runtime/distributed.py lease keep-alive loop).
+- Pub/sub events are fire-and-forget (NATS core semantics), never journaled.
+- On open, state is rebuilt from the latest snapshot plus journal replay;
+  when the journal exceeds `compact_every` records a fresh snapshot is
+  written and the journal truncated (the JetStream file-store compaction
+  analogue, scaled down).
+
+Records are length-prefixed msgpack, crash-truncation-tolerant: a torn tail
+record is discarded on replay.
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+import struct
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.runtime.transports.memory import (
+    LatencyModel, MemoryKVStore, MemoryMessaging, MemoryPlane,
+)
+
+log = logging.getLogger("dynamo_tpu.journal")
+
+_LEN = struct.Struct("<I")
+
+
+def _append_record(f: io.BufferedWriter, rec: dict) -> None:
+    payload = msgpack.packb(rec)
+    f.write(_LEN.pack(len(payload)))
+    f.write(payload)
+    f.flush()
+
+
+def _read_records(path: str):
+    """Yield records; stop silently at a torn tail (crash mid-append)."""
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(_LEN.size)
+            if len(head) < _LEN.size:
+                return
+            (n,) = _LEN.unpack(head)
+            payload = f.read(n)
+            if len(payload) < n:
+                log.warning("journal %s: torn tail record dropped", path)
+                return
+            yield msgpack.unpackb(payload, raw=False)
+
+
+class DurableKVStore(MemoryKVStore):
+    def __init__(self, journal: "Journal",
+                 latency: Optional[LatencyModel] = None):
+        super().__init__(latency)
+        self._journal = journal
+
+    async def put(self, key, value, lease_id: int = 0):
+        prev = self._data.get(key)
+        await super().put(key, value, lease_id)
+        if not lease_id:  # lease-scoped keys die with the server, as in etcd
+            self._journal.append({"op": "put", "key": key, "value": value})
+        elif prev is not None and not prev.lease_id:
+            # a leased put shadowing a journaled unleased value: the old
+            # value is gone for good (the key now dies with the lease), so
+            # it must not resurrect from the journal after a restart
+            self._journal.append({"op": "del", "key": key})
+
+    async def delete(self, key):
+        existed = key in self._data
+        was_leased = existed and self._data[key].lease_id
+        await super().delete(key)
+        if existed and not was_leased:
+            self._journal.append({"op": "del", "key": key})
+
+
+class DurableMessaging(MemoryMessaging):
+    def __init__(self, journal: "Journal",
+                 latency: Optional[LatencyModel] = None):
+        super().__init__(latency)
+        self._journal = journal
+
+    async def queue_push(self, queue, payload):
+        await super().queue_push(queue, payload)
+        self._journal.append({"op": "qpush", "queue": queue,
+                              "payload": payload})
+
+    async def queue_pop(self, queue, timeout=None):
+        item = await super().queue_pop(queue, timeout)
+        if item is not None:
+            # logged post-hoc: replay drops one head per qpop, so only the
+            # surviving-queue *contents* must match, which FIFO guarantees
+            self._journal.append({"op": "qpop", "queue": queue})
+        return item
+
+
+class Journal:
+    """Append-only journal with snapshot compaction.
+
+    Crash-atomicity across compaction (code-review r3): queue replay is not
+    idempotent, so a crash between the snapshot rename and the journal
+    truncation must not replay pre-compaction records on top of the new
+    snapshot. Every fresh journal opens with a {"op": "jhead", "gen": G}
+    record and the snapshot stores the generation it expects; recovery
+    discards a journal whose generation doesn't match (it was already
+    folded into the snapshot)."""
+
+    def __init__(self, data_dir: str, compact_every: int = 10_000):
+        os.makedirs(data_dir, exist_ok=True)
+        self.snap_path = os.path.join(data_dir, "snapshot.bin")
+        self.journal_path = os.path.join(data_dir, "journal.bin")
+        self.compact_every = compact_every
+        self._since_compact = 0
+        self._gen = 0
+        self._file: Optional[io.BufferedWriter] = None
+        self._plane: Optional[MemoryPlane] = None
+
+    def attach(self, plane: MemoryPlane) -> None:
+        self._plane = plane
+
+    def append(self, rec: dict) -> None:
+        if self._file is None:
+            self._file = open(self.journal_path, "ab")
+            if os.path.getsize(self.journal_path) == 0:
+                _append_record(self._file, {"op": "jhead", "gen": self._gen})
+        _append_record(self._file, rec)
+        self._since_compact += 1
+        if self._since_compact >= self.compact_every:
+            self.compact()
+
+    # -- recovery -------------------------------------------------------------
+
+    def recover_into(self, kv: MemoryKVStore, mq: MemoryMessaging) -> int:
+        """Rebuild state from snapshot + journal. Returns records replayed."""
+        n = 0
+        snap_gen = 0
+        if os.path.exists(self.snap_path):
+            for rec in _read_records(self.snap_path):
+                snap_gen = rec.get("gen", 0)
+                for key, value in rec.get("kv", []):
+                    kv._data_restore(key, value)
+                for queue, items in rec.get("queues", []):
+                    for item in items:
+                        mq._queues[queue].put_nowait(item)
+        self._gen = snap_gen
+        if os.path.exists(self.journal_path):
+            records = _read_records(self.journal_path)
+            for rec in records:
+                if rec["op"] == "jhead":
+                    if rec["gen"] != snap_gen:
+                        # journal predates the snapshot: compaction crashed
+                        # after the snapshot rename but before truncation —
+                        # everything here is already in the snapshot
+                        log.warning("discarding stale journal (gen %s, "
+                                    "snapshot gen %s)", rec["gen"], snap_gen)
+                        open(self.journal_path, "wb").close()
+                        break
+                    continue
+                n += 1
+                op = rec["op"]
+                if op == "put":
+                    kv._data_restore(rec["key"], rec["value"])
+                elif op == "del":
+                    kv._data_drop(rec["key"])
+                elif op == "qpush":
+                    mq._queues[rec["queue"]].put_nowait(rec["payload"])
+                elif op == "qpop":
+                    q = mq._queues[rec["queue"]]
+                    if not q.empty():
+                        q.get_nowait()
+        # seed the compaction counter so repeated crash/restart cycles can't
+        # grow the journal past compact_every forever (code-review r3)
+        self._since_compact = n
+        return n
+
+    def compact(self) -> None:
+        """Write current persistent state as a snapshot, truncate journal."""
+        if self._plane is None:
+            return
+        kv, mq = self._plane.kv, self._plane.messaging
+        new_gen = self._gen + 1
+        snap = {
+            "gen": new_gen,
+            "kv": [[k, e.value] for k, e in sorted(kv._data.items())
+                   if not e.lease_id],
+            "queues": [[name, list(q._queue)]
+                       for name, q in mq._queues.items() if q.qsize()],
+        }
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            _append_record(f, snap)
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        # crash window here: old journal still on disk, but its jhead gen
+        # no longer matches the snapshot, so recovery discards it
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        self._gen = new_gen
+        with open(self.journal_path, "wb") as f:
+            _append_record(f, {"op": "jhead", "gen": new_gen})
+        self._since_compact = 0
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class DurablePlane(MemoryPlane):
+    """MemoryPlane + write-ahead journal; state survives server restarts."""
+
+    def __init__(self, data_dir: str, latency: Optional[LatencyModel] = None,
+                 compact_every: int = 10_000):
+        self.journal = Journal(data_dir, compact_every)
+        self.kv = DurableKVStore(self.journal, latency)
+        self.messaging = DurableMessaging(self.journal, latency)
+        self.journal.attach(self)
+        n = self.journal.recover_into(self.kv, self.messaging)
+        if n or os.path.exists(self.journal.snap_path):
+            log.info("control-plane state recovered (%d journal records)", n)
+
+    def close(self) -> None:
+        self.journal.close()

@@ -39,6 +39,14 @@ class MemoryKVStore(KVStore):
         self._lease_deadline: Dict[int, float] = {}
         self._latency = latency or LatencyModel()
 
+    def _data_restore(self, key: str, value: bytes) -> None:
+        """Recovery-path set: no journaling, no watcher notify (recovery runs
+        before any watcher can exist). Used by transports.journal."""
+        self._data[key] = KVEntry(key, value, 0)
+
+    def _data_drop(self, key: str) -> None:
+        self._data.pop(key, None)
+
     async def _notify(self, ev: WatchEvent):
         for prefix, q in list(self._watchers):
             if ev.key.startswith(prefix):

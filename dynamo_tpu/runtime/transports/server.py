@@ -224,9 +224,18 @@ class _Conn:
 
 
 class ControlPlaneServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT):
+    def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT,
+                 data_dir: str = None):
+        """data_dir enables durability: unleased KV state and work-queue
+        contents journal to disk and survive a server restart (the etcd /
+        JetStream file-store role; see transports/journal.py). Without it
+        the server is pure-memory, as before."""
         self.host, self.port = host, port
-        self.plane = MemoryPlane()
+        if data_dir:
+            from dynamo_tpu.runtime.transports.journal import DurablePlane
+            self.plane = DurablePlane(data_dir)
+        else:
+            self.plane = MemoryPlane()
         self.responders: Dict[str, _Conn] = {}
         self.leases: Dict[int, object] = {}
         self.ids = itertools.count(1)
@@ -245,6 +254,9 @@ class ControlPlaneServer:
         if self._server:
             self._server.close()
             await self._server.wait_closed()
+        close = getattr(self.plane, "close", None)
+        if close:
+            close()
 
     async def serve_forever(self):
         await self.start()
@@ -257,9 +269,12 @@ def main():
     ap = argparse.ArgumentParser(description="dynamo-tpu control plane server")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=DEFAULT_PORT)
+    ap.add_argument("--data-dir", default=None,
+                    help="enable durability: journal KV + queues here")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
-    asyncio.run(ControlPlaneServer(args.host, args.port).serve_forever())
+    asyncio.run(ControlPlaneServer(
+        args.host, args.port, data_dir=args.data_dir).serve_forever())
 
 
 if __name__ == "__main__":

@@ -185,7 +185,167 @@ def test_stats_scrape():
     run(main())
 
 
+def test_keepalive_survives_slow_first_token(monkeypatch):
+    """A responder whose first item takes longer than the requester's
+    inactivity timeout must NOT be killed: keepalive frames prove liveness
+    (VERDICT r2 weak #8)."""
+    from dynamo_tpu.runtime import dataplane
+
+    monkeypatch.setattr(dataplane, "KEEPALIVE_INTERVAL_S", 0.05)
+
+    async def main():
+        server = await dataplane.DataPlaneServer().start()
+        stream = server.register()
+        ctx = Context()
+
+        async def slow_gen():
+            await asyncio.sleep(0.5)  # >> per-frame timeout below
+            yield b"tok"
+
+        _, writer = await dataplane.call_home(
+            server.connection_info, stream.stream_id, ctx)
+        pump = asyncio.create_task(
+            dataplane.pump_stream(writer, slow_gen(), ctx))
+        # per-frame timeout far below the engine delay: only keepalives
+        # keep this stream alive
+        frames = [f async for f in server.stream_responses(
+            stream, timeout=0.2)]
+        assert frames == [b"tok"]
+        await pump
+        await server.stop()
+
+    run(main())
+
+
+def test_inactivity_raises_typed_error():
+    """A responder that never connects (dead peer) surfaces as
+    StreamInactiveError, not a bare timeout."""
+    from dynamo_tpu.runtime import dataplane
+
+    async def main():
+        server = await dataplane.DataPlaneServer().start()
+        stream = server.register()
+        with pytest.raises(dataplane.StreamInactiveError):
+            async for _ in server.stream_responses(stream, timeout=0.1):
+                pass
+        await server.stop()
+
+    run(main())
+
+
 # -- TCP control plane (integration, self-contained) --------------------------
+
+def test_control_plane_durability(tmp_path):
+    """ADVICE r2: control-plane state must survive a server death. Unleased
+    KV (model registry, config) and work-queue contents (the JetStream-like
+    prefill queue) are journaled and recovered; lease-scoped discovery keys
+    are deliberately ephemeral (etcd semantics: leases die with the server,
+    workers re-register on reconnect)."""
+    data_dir = str(tmp_path / "cp")
+
+    async def phase1():
+        server = await ControlPlaneServer(port=0, data_dir=data_dir).start()
+        try:
+            rt = await DistributedRuntime.connect("127.0.0.1", server.port, "w")
+            await rt.kv.put("models/m1", b"card1")
+            await rt.kv.put("models/m2", b"card2")
+            await rt.kv.delete("models/m2")
+            lease = await rt.kv.grant_lease(10.0)
+            await rt.kv.put("instances/w", b"ephemeral", lease.id)
+            for i in range(3):
+                await rt.messaging.queue_push("prefill", f"job{i}".encode())
+            assert await rt.messaging.queue_pop("prefill", 1.0) == b"job0"
+            await rt.shutdown()
+        finally:
+            await server.stop()
+
+    async def phase2():
+        server = await ControlPlaneServer(port=0, data_dir=data_dir).start()
+        try:
+            rt = await DistributedRuntime.connect("127.0.0.1", server.port, "w")
+            assert await rt.kv.get("models/m1") == b"card1"
+            assert await rt.kv.get("models/m2") is None
+            assert await rt.kv.get("instances/w") is None  # lease-scoped
+            assert await rt.messaging.queue_depth("prefill") == 2
+            assert await rt.messaging.queue_pop("prefill", 1.0) == b"job1"
+            await rt.shutdown()
+        finally:
+            await server.stop()
+
+    run(phase1())
+    run(phase2())
+
+
+def test_journal_compaction(tmp_path):
+    """Snapshot compaction truncates the journal but preserves state."""
+    from dynamo_tpu.runtime.transports.journal import DurablePlane
+
+    async def main():
+        plane = DurablePlane(str(tmp_path), compact_every=5)
+        for i in range(12):  # crosses two compactions
+            await plane.kv.put(f"k{i}", f"v{i}".encode())
+        await plane.messaging.queue_push("q", b"x")
+        plane.close()
+
+        plane2 = DurablePlane(str(tmp_path))
+        for i in range(12):
+            assert await plane2.kv.get(f"k{i}") == f"v{i}".encode()
+        assert await plane2.messaging.queue_depth("q") == 1
+        plane2.close()
+
+    run(main())
+
+
+def test_compaction_crash_window_no_queue_duplication(tmp_path):
+    """A crash between the snapshot rename and the journal truncation must
+    not replay pre-compaction records onto the new snapshot (queue replay
+    is not idempotent): the stale journal's generation header mismatches
+    the snapshot and it is discarded (code-review r3)."""
+    import shutil
+
+    from dynamo_tpu.runtime.transports.journal import DurablePlane
+
+    async def main():
+        d = str(tmp_path)
+        plane = DurablePlane(d, compact_every=1000)
+        for item in (b"a", b"b", b"c"):
+            await plane.messaging.queue_push("q", item)
+        assert await plane.messaging.queue_pop("q", 1.0) == b"a"
+        saved = d + "/journal.precompact"
+        shutil.copy(plane.journal.journal_path, saved)
+        plane.journal.compact()
+        # simulate the crash: the pre-compaction journal survives on disk
+        shutil.copy(saved, plane.journal.journal_path)
+        plane.close()
+
+        plane2 = DurablePlane(d)
+        assert await plane2.messaging.queue_depth("q") == 2
+        assert await plane2.messaging.queue_pop("q", 1.0) == b"b"
+        plane2.close()
+
+    run(main())
+
+
+def test_leased_put_shadowing_unleased_key_not_resurrected(tmp_path):
+    """Overwriting a journaled unleased key with a lease-scoped value kills
+    the old value for good — it must not resurrect on restart
+    (code-review r3)."""
+    from dynamo_tpu.runtime.transports.journal import DurablePlane
+
+    async def main():
+        d = str(tmp_path)
+        plane = DurablePlane(d)
+        await plane.kv.put("k", b"v1")
+        lease = await plane.kv.grant_lease(10.0)
+        await plane.kv.put("k", b"ephemeral", lease.id)
+        plane.close()
+
+        plane2 = DurablePlane(d)
+        assert await plane2.kv.get("k") is None
+        plane2.close()
+
+    run(main())
+
 
 def test_tcp_control_plane_end_to_end():
     async def main():
