@@ -101,8 +101,6 @@ def main():
     n_chips = len(devices)
     log(f"backend up: {devices} ({jax.default_backend()})")
 
-    import dataclasses
-
     import jax.numpy as jnp
 
     from dynamo_tpu.engine.config import EngineConfig, get_model_config
@@ -224,6 +222,62 @@ def main():
             prefill_tok_s=round(prefill_tok_s, 1))
         log(f"TTFT p50 {p50 * 1000:.1f} ms, max {ttfts[-1] * 1000:.1f} ms; "
             f"prefill {prefill_tok_s:.0f} tok/s")
+
+    if time.time() - T0 > BUDGET_S - 90:
+        log("approaching deadline; skipping agg-vs-disagg phase")
+        emit()
+        return
+    log("phase 7: agg-under-churn vs pure decode (the disagg ratio's "
+        "one-chip denominator/numerator, BASELINE.md north star)")
+    # Aggregated serving under continuous arrivals: every finished request
+    # is replaced by a fresh prompt, so prefill chunks steal device steps
+    # from decode — exactly the interference disaggregation removes (the
+    # reference's 1-node +30% claim, docs/architecture.md:57-61). The
+    # pure-decode number from phase 5 (all slots busy, no arrivals) is what
+    # a dedicated decode engine achieves; the ratio is the measured
+    # one-chip upper bound for disagg gain at this workload shape.
+    for rid in list(engine.scheduler.params):
+        engine.abort(rid)
+    while engine.has_work():
+        engine.step()
+    churn_params = SamplingParams(max_tokens=64, temperature=0.0,
+                                  ignore_eos=True)
+    next_id = 0
+
+    def add_fresh():
+        nonlocal next_id
+        salt = 977 * (next_id + 1)
+        engine.add_request(EngineRequest(
+            f"churn-{next_id}",
+            [(salt + 3 * j) % 1000 + 1 for j in range(prompt_len)],
+            churn_params))
+        next_id += 1
+
+    for _ in range(slots):
+        add_fresh()
+    # warm the churn mix (compiles any new bucket combos), then measure
+    for _ in range(6):
+        for ev in engine.step():
+            if ev.finished:
+                add_fresh()
+    t0 = time.perf_counter()
+    tokens = 0
+    deadline = t0 + 15.0
+    while time.perf_counter() < deadline:
+        for ev in engine.step():
+            if ev.token is not None:
+                tokens += 1
+            if ev.finished:
+                add_fresh()
+    dt = time.perf_counter() - t0
+    agg_tok_s = tokens / dt / max(1, n_chips)
+    pure = RESULT["value"]
+    RESULT["extras"].update(
+        agg_churn_tok_s=round(agg_tok_s, 1),
+        disagg_decode_gain=round(pure / agg_tok_s, 3) if agg_tok_s else None)
+    log(f"agg-under-churn {agg_tok_s:.1f} tok/s/chip vs pure decode "
+        f"{pure:.1f}; decode-side disagg gain bound "
+        f"{pure / max(agg_tok_s, 1e-9):.2f}x")
     emit()
 
 

@@ -12,7 +12,9 @@ from typing import List, Optional
 
 import pydantic
 
-from dynamo_tpu.protocols.common import SamplingOptions, StopConditions
+from dynamo_tpu.protocols.common import (
+    ImagePart, SamplingOptions, StopConditions,
+)
 
 
 class RemotePrefillRequest(pydantic.BaseModel):
@@ -32,6 +34,9 @@ class RemotePrefillRequest(pydantic.BaseModel):
     page_size: int = 0        # decode engine page size (must match prefill)
     # fully-qualified messaging subject for the PrefillCompletion notify
     notify_subject: str = ""
+    # multimodal: the prefill worker re-encodes these through its own vision
+    # tower (pixels travel, embeds don't — they're mesh-layout-dependent)
+    mm_parts: Optional[List[ImagePart]] = None
 
 
 class PrefillCompletion(pydantic.BaseModel):

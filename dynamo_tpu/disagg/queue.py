@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import msgpack
+
 from dynamo_tpu.disagg.protocols import RemotePrefillRequest
 
 
@@ -24,15 +26,18 @@ class PrefillQueue:
         self.name = queue_name(namespace, model)
 
     async def enqueue(self, req: RemotePrefillRequest) -> None:
+        # msgpack, not JSON: multimodal requests carry raw pixel bytes
+        # (ImagePart.data), which msgpack frames natively
         await self.messaging.queue_push(
-            self.name, req.model_dump_json().encode())
+            self.name, msgpack.packb(req.model_dump(), use_bin_type=True))
 
     async def dequeue(self, timeout: Optional[float] = None
                       ) -> Optional[RemotePrefillRequest]:
         payload = await self.messaging.queue_pop(self.name, timeout=timeout)
         if payload is None:
             return None
-        return RemotePrefillRequest.model_validate_json(payload)
+        return RemotePrefillRequest.model_validate(
+            msgpack.unpackb(payload, raw=False))
 
     async def depth(self) -> int:
         return await self.messaging.queue_depth(self.name)

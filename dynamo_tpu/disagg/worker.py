@@ -140,6 +140,7 @@ class DisaggDecodeWorker(NativeEngineWorker):
                 num_cached_tokens=alloc.num_cached_tokens,
                 page_size=self.engine.cfg.page_size,
                 notify_subject=self.notify_subject,
+                mm_parts=pre.mm_parts,
             ))
             stop_task = asyncio.create_task(context.wait_stopped())
             try:
@@ -285,7 +286,8 @@ class PrefillWorker:
             try:
                 pre = PreprocessedRequest(
                     request_id=rid, token_ids=req.token_ids,
-                    sampling=req.sampling, stop=req.stop)
+                    sampling=req.sampling, stop=req.stop,
+                    mm_parts=req.mm_parts)
                 er = _to_engine_request(pre)
                 er.prefill_only = True
                 self.worker._pending_adds.append(er)

@@ -93,6 +93,11 @@ class EngineConfig:
     # host-DRAM KV tier capacity in pages (0 = tier off); evicted HBM pages
     # spill here and return on prefix hits (engine/offload.py)
     host_pages: int = 0
+    # disk (NVMe-style) tier below DRAM: DRAM evictions spill down, prefix
+    # hits promote back up (reference: kv/storage.rs tier ladder). Requires
+    # host_pages > 0. disk_dir None = a temp directory.
+    disk_pages: int = 0
+    disk_dir: Optional[str] = None
     # mesh axes sizes: (dp, tp). dp>1 replicates the whole engine.
     tp: int = 1
     dp: int = 1
@@ -123,7 +128,10 @@ _CONFIGS = {
         name="tiny-moe", num_experts=4, num_experts_per_tok=2,
         intermediate_size=256,
     ),
-    "tiny-vl": ModelConfig(name="tiny-vl", vision=VisionConfig()),
+    "tiny-vl": ModelConfig(
+        name="tiny-vl", dtype="float32",
+        vision=VisionConfig(image_size=28, patch_size=14, hidden_size=32,
+                            intermediate_size=64, num_layers=2, num_heads=2)),
     # Llama-3.2-1B-class: the single-chip flagship (fits v5e-1 HBM with cache)
     "llama3-1b": ModelConfig(
         name="llama3-1b", vocab_size=128256, hidden_size=2048,

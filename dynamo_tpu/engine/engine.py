@@ -26,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
 from dynamo_tpu.engine.kv_cache import SequenceState
-from dynamo_tpu.engine.offload import HostKvPool
+from dynamo_tpu.engine.offload import CopyStream, HostKvPool
 from dynamo_tpu.engine.sampler import (
     apply_repetition_penalty, compute_logprobs, make_keys, sample,
     seen_token_mask,
@@ -67,6 +67,24 @@ class NativeEngine:
         seed: int = 0,
     ):
         self.mesh = mesh if mesh is not None else single_device_mesh()
+        # pipeline parallelism (mesh axis "pp", models/pp.py): layer-sharded
+        # params/cache, microbatched GPipe schedule. The pp path uses the
+        # gather attention everywhere and single-step decode (a multi-step
+        # window would re-enter the pipeline per token), so the decode
+        # kernel and the decode window are disabled below.
+        self.pp = self.mesh.shape.get("pp", 1)
+        if self.pp > 1:
+            if model_cfg.is_moe:
+                raise ValueError("pp requires a dense model; shard MoE "
+                                 "configs over the ep axis instead")
+            if engine_cfg.sp > 1:
+                raise ValueError("pp and sp (ring attention) do not compose")
+            if model_cfg.vision is not None:
+                raise ValueError("multimodal models are not supported on a "
+                                 "pp mesh; use tp/dp (pp_param_shardings "
+                                 "carries no vision subtree)")
+            model_cfg = dataclasses.replace(model_cfg, decode_kernel="off")
+            engine_cfg = dataclasses.replace(engine_cfg, decode_steps=1)
         # the compiled kernel has hard constraints the XLA gather path
         # doesn't: a lane-aligned DMA geometry (ops/paged_attention.py
         # kernel_supported) and, under shard_map, tp dividing the head
@@ -104,11 +122,15 @@ class NativeEngine:
                           engine_cfg.page_size, model_cfg.head_dim)
             np_dtype = jnp.empty((), model_cfg.dtype).dtype
             self.host_pool = HostKvPool(engine_cfg.host_pages, page_shape,
-                                        np_dtype)
+                                        np_dtype,
+                                        disk_pages=engine_cfg.disk_pages,
+                                        disk_dir=engine_cfg.disk_dir)
         self.scheduler = Scheduler(engine_cfg, host_pool=self.host_pool)
         self._pending_offloads: list = []
+        self._copy_stream = None
         if self.host_pool is not None:
             self.scheduler.allocator.on_evict = self._offload_page
+            self._copy_stream = CopyStream(self.host_pool)
         self.step_count = 0
         self._finished_cb = None
         self._last_logprobs = None  # (lp, top_ids, top_lps) of last step
@@ -118,9 +140,14 @@ class NativeEngine:
         self.moe_routed_tokens = 0.0
         self._moe_drop_warned = False
 
+        if self.pp > 1:
+            from dynamo_tpu.models.pp import pp_param_shardings
+            param_specs = pp_param_shardings(model_cfg)
+        else:
+            param_specs = llama.param_shardings(model_cfg)
         shardings = jax.tree.map(
             lambda spec: NamedSharding(self.mesh, spec),
-            llama.param_shardings(model_cfg),
+            param_specs,
             is_leaf=lambda x: isinstance(x, P),
         )
         if params is None:
@@ -132,7 +159,7 @@ class NativeEngine:
             params = jax.device_put(params, shardings)
         self.params = params
 
-        cache_shd = NamedSharding(self.mesh, llama.cache_sharding(model_cfg))
+        cache_shd = self.cache_sharding
         init_cache = jax.jit(
             functools.partial(
                 llama.init_cache, model_cfg,
@@ -170,12 +197,15 @@ class NativeEngine:
         # N forward+sample iterations fused into one device program
         # (lax.scan feeds the sampled token to the next step), so host work
         # amortizes over N tokens instead of paying per token.
+        pp_mesh = self.mesh if self.pp > 1 else None
         self._step_fns = {
-            (rp, lp): jax.jit(
+            (rp, lp, mm): jax.jit(
                 functools.partial(_engine_step, model_cfg, eos_tuple,
-                                  sp_mesh, kernel_mesh, rp, lp),
+                                  sp_mesh, kernel_mesh, rp, lp, mm,
+                                  pp_mesh),
                 donate_argnums=(1,))
             for rp in (False, True) for lp in (False, True)
+            for mm in (False, True)
         }
         self._decode_fns = {
             (rp, lp, greedy): jax.jit(
@@ -193,18 +223,69 @@ class NativeEngine:
         # out-of-range ids are dropped
         self._extract_fn = jax.jit(_extract_pages)
         self._inject_fn = jax.jit(_inject_pages, donate_argnums=(0,))
+        # multimodal: jitted vision tower (models/vision.py); the encoder
+        # runs at admission time (the "vision prefill"), its projected
+        # patch embeds feed the text prefill via PrefillPlan.mm_embeds
+        self._encode_fn = None
+        if model_cfg.vision is not None:
+            from dynamo_tpu.models import vision as _vision
+            self._encode_fn = jax.jit(
+                lambda p, px: _vision.encode(p, model_cfg, px))
+
+    def encode_image(self, pixels: np.ndarray) -> np.ndarray:
+        """pixels [H, W, 3] or [B, H, W, 3] float in [0,1] ->
+        [n_patches, D_text] (or [B, n_patches, D_text]) f32 embeds."""
+        if self._encode_fn is None:
+            raise ValueError(f"model {self.model_cfg.name!r} has no vision "
+                             "encoder configured")
+        single = pixels.ndim == 3
+        if single:
+            pixels = pixels[None]
+        out = np.asarray(jax.device_get(
+            self._encode_fn(self.params["vision"], jnp.asarray(pixels))))
+        return out[0] if single else out
 
     @property
     def cache_sharding(self) -> NamedSharding:
+        if self.pp > 1:
+            from dynamo_tpu.models.pp import pp_cache_sharding
+            return NamedSharding(self.mesh, pp_cache_sharding())
         return NamedSharding(self.mesh, llama.cache_sharding(self.model_cfg))
 
     # -- public API ----------------------------------------------------------
 
+    def _resolve_mm(self, req: EngineRequest) -> EngineRequest:
+        """Encode raw image pixels into text-space embeds (the "vision
+        prefill"). Salts derive from PIXEL bytes, not embeds, so both sides
+        of a disaggregated pair compute identical page hashes regardless of
+        vision-tower sharding numerics."""
+        if not req.mm_pixels:
+            return req
+        from dynamo_tpu.engine.kv_cache import content_salt
+        spans = list(req.mm_spans or [])
+        for off, px in req.mm_pixels:
+            px = np.asarray(px, np.float32)
+            spans.append((int(off), self.encode_image(px),
+                          content_salt(px.tobytes())))
+        return dataclasses.replace(req, mm_spans=spans, mm_pixels=None)
+
     def add_request(self, req: EngineRequest) -> None:
-        self.scheduler.add_request(req)
+        if self._copy_stream is not None:
+            # admission is the prefix-match point: settle in-flight offload
+            # copies so host-tier hits are never missed by a race. This is
+            # the only place the engine waits on the copy stream — the
+            # decode loop never does.
+            self._copy_stream.drain()
+        self.scheduler.add_request(self._resolve_mm(req))
 
     def abort(self, request_id: str) -> bool:
         return self.scheduler.abort(request_id)
+
+    def close(self) -> None:
+        """Release background resources (the host-tier copy thread)."""
+        if self._copy_stream is not None:
+            self._copy_stream.close()
+            self._copy_stream = None
 
     def has_work(self) -> bool:
         s = self.scheduler
@@ -317,6 +398,7 @@ class NativeEngine:
             self._sampling_arrays(reqs)
         rp = self._rep_penalty_arrays(reqs)
         with_lp = self._wants_logprobs(reqs)
+        mm = getattr(plan, "mm_embeds", None) is not None
         args = (self.params, self.cache,
                 jnp.asarray(plan.tokens), jnp.asarray(plan.positions),
                 jnp.asarray(plan.page_table), jnp.asarray(plan.kv_lens),
@@ -324,9 +406,14 @@ class NativeEngine:
                 jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
                 jnp.asarray(seeds), jnp.asarray(counters),
                 jnp.asarray(min_toks))
+        kwargs = {}
         if rp is not None:
-            args += (jnp.asarray(rp[0]), jnp.asarray(rp[1]))
-        out = self._step_fns[(rp is not None, with_lp)](*args)
+            kwargs.update(hist=jnp.asarray(rp[0]),
+                          rep_penalty=jnp.asarray(rp[1]))
+        if mm:
+            kwargs.update(mm_embeds=jnp.asarray(plan.mm_embeds),
+                          mm_mask=jnp.asarray(plan.mm_mask))
+        out = self._step_fns[(rp is not None, with_lp, mm)](*args, **kwargs)
         tokens, lp, top_ids, top_lps, self.cache, aux = out
         tokens, lp, top_ids, top_lps, aux = jax.device_get(
             (tokens, lp, top_ids, top_lps, aux))
@@ -363,6 +450,8 @@ class NativeEngine:
         return events
 
     def _run_decode(self, plan: DecodePlan) -> List[StepOutput]:
+        if self.pp > 1:
+            return self._run_decode_pp(plan)
         temp, top_k, top_p, seeds, counters, min_toks = \
             self._sampling_arrays(plan.seqs)
         rp = self._rep_penalty_arrays(plan.seqs)
@@ -373,7 +462,8 @@ class NativeEngine:
         # refreshing), reuse the device plan arrays and feed the last
         # window's final (token, position, counter) device arrays straight
         # back in — steady-state windows then upload NOTHING
-        sig = (tuple(s.request_id if s else None for s in plan.seqs),
+        sig = (tuple((s.request_id, s.epoch) if s else None
+                     for s in plan.seqs),
                tuple(len(s.pages) if s else 0 for s in plan.seqs),
                plan.page_table.shape[1], rp is None, with_lp, greedy)
         st = self._dec_state
@@ -429,6 +519,26 @@ class NativeEngine:
                     done.add(seq.request_id)
         return events
 
+    def _run_decode_pp(self, plan: DecodePlan) -> List[StepOutput]:
+        """Pipeline-parallel decode: one token per scheduler step through
+        the same fused program prefill uses (models/pp.pp_forward handles
+        the [S, 1] step; the multi-step window doesn't compose with a
+        pipeline, decode_steps is forced to 1 at init)."""
+        sampled = self._run_device_step(plan, plan.seqs)
+        lps = self._last_logprobs
+        events: List[StepOutput] = []
+        for i, seq in enumerate(plan.seqs):
+            if seq is None:
+                continue
+            self.scheduler.commit_decode_token(seq, int(sampled[i]))
+            if lps is not None:
+                events.append(self._postprocess(
+                    seq, seq.output[-1], float(lps[0][i]), lps[1][i],
+                    lps[2][i]))
+            else:
+                events.append(self._postprocess(seq, seq.output[-1]))
+        return events
+
     def _postprocess(self, seq: SequenceState, tok: int,
                      lp: Optional[float] = None, top_ids=None,
                      top_lps=None) -> StepOutput:
@@ -465,18 +575,19 @@ class NativeEngine:
         self._pending_offloads.append((pid, seq_hash))
 
     def _process_offloads(self) -> None:
-        """Batched extract + host put of all pages evicted since the last
-        device-cache write. Chunked to the largest page bucket — the pending
-        list is engine-wide and can exceed the per-sequence bucket range."""
+        """Batched extract of all pages evicted since the last device-cache
+        write. The extraction is *dispatched* here — before anything can
+        overwrite the evicted pages, preserving device-order correctness —
+        but the blocking device→host copy + host put run on the CopyStream
+        thread, so the step loop never stalls on an offload."""
         pending, self._pending_offloads = self._pending_offloads, []
+        if self._copy_stream is None:  # closed engine: offloads become no-ops
+            return
         max_b = self.scheduler.page_buckets[-1]
         for start in range(0, len(pending), max_b):
             chunk = pending[start:start + max_b]
             pages = self.extract_pages([pid for pid, _ in chunk])
-            k = np.asarray(jax.device_get(pages["k"]))
-            v = np.asarray(jax.device_get(pages["v"]))
-            for i, (_, seq_hash) in enumerate(chunk):
-                self.host_pool.put(seq_hash, k[:, :, i], v[:, :, i])
+            self._copy_stream.submit(pages, [h for _, h in chunk])
 
     def _process_onboards(self) -> None:
         """Inject host-tier pages claimed by _match_prefix into HBM before
@@ -489,7 +600,6 @@ class NativeEngine:
             ks, vs = [], []
             for _, h in chunk:
                 k, v = self.host_pool.get(h)
-                self.host_pool.unpin(h)
                 ks.append(k)
                 vs.append(v)
             nb = next_bucket(len(ids), self.scheduler.page_buckets)
@@ -501,6 +611,11 @@ class NativeEngine:
             for i, (k, v) in enumerate(zip(ks, vs)):
                 k_pages[:, :, i] = k
                 v_pages[:, :, i] = v
+            # unpin only AFTER copying out of the slab views: put() (on the
+            # CopyStream thread) never evicts pinned slots, so the views
+            # above were stable until here
+            for _, h in chunk:
+                self.host_pool.unpin(h)
             shd = self.cache_sharding
             self.inject_pages(
                 ids, jax.device_put(jnp.asarray(k_pages), shd),
@@ -517,7 +632,11 @@ class NativeEngine:
             # mid-sequence chunk the ring path must not see. SP engines are
             # the prefill side of disaggregation, not the decode side.
             return None
-        return self.scheduler.add_remote(req)
+        if self._copy_stream is not None:
+            # same admission barrier as add_request: this path also prefix-
+            # matches against the host tier (code-review r3)
+            self._copy_stream.drain()
+        return self.scheduler.add_remote(self._resolve_mm(req))
 
     def activate_remote(self, request_id: str, first_token: int) -> None:
         self.scheduler.activate_remote(request_id, first_token)
@@ -732,17 +851,31 @@ def _engine_decode_window(cfg: ModelConfig, eos_ids: tuple, kernel_mesh,
 
 
 def _engine_step(cfg: ModelConfig, eos_ids: tuple, sp_mesh, kernel_mesh,
-                 with_rp: bool, with_lp: bool,
+                 with_rp: bool, with_lp: bool, with_mm: bool, pp_mesh,
                  params, cache,
                  tokens, positions, page_table, kv_lens, write_idx, last_idx,
                  temperature, top_k, top_p, seeds, counters, min_tokens,
-                 hist=None, rep_penalty=None):
+                 hist=None, rep_penalty=None, mm_embeds=None, mm_mask=None):
     """forward + gather last logits + sample, fused into one XLA program."""
     meta = AttnMetadata(positions=positions, page_table=page_table,
                         kv_lens=kv_lens, write_idx=write_idx)
-    logits, cache, aux = llama.forward(params, cfg, tokens, cache, meta,
-                                       sp_mesh=sp_mesh, mesh=kernel_mesh,
-                                       with_aux=True)
+    if pp_mesh is not None:
+        from dynamo_tpu.models.pp import pp_forward
+        if with_mm:
+            # mm embeds mix happens before the pipeline; fold it here so
+            # pp_forward's stage-0 embed sees the final input rows
+            raise NotImplementedError(
+                "multimodal + pp is not supported yet (route vision "
+                "configs to tp/dp meshes)")
+        logits, cache = pp_forward(params, cfg, tokens, cache, meta,
+                                   pp_mesh)
+        aux = {}
+    else:
+        logits, cache, aux = llama.forward(
+            params, cfg, tokens, cache, meta,
+            input_embeds=mm_embeds if with_mm else None,
+            embeds_mask=mm_mask if with_mm else None,
+            sp_mesh=sp_mesh, mesh=kernel_mesh, with_aux=True)
     b = tokens.shape[0]
     last = logits[jnp.arange(b), last_idx]          # [B, V] f32
     seen = seen_token_mask(hist, cfg.vocab_size) if with_rp else None

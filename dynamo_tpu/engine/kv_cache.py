@@ -44,6 +44,15 @@ def tokens_hash(tokens: Sequence[int]) -> int:
     return h.intdigest()
 
 
+def content_salt(data: bytes) -> int:
+    """xxh3_64(seed 1337) over raw content bytes — the salt used to rewrite
+    multimodal placeholder token ids (engine._resolve_mm salts from pixels,
+    scheduler._admit from embeds as a fallback). ONE definition: both sides
+    of a disaggregated pair must derive identical salts or their page
+    hashes disagree (code-review r3)."""
+    return xxhash.xxh3_64(data, seed=1337).intdigest()
+
+
 @dataclasses.dataclass
 class PageInfo:
     ref_count: int = 0
@@ -178,6 +187,14 @@ class SequenceState:
     output: List[int] = dataclasses.field(default_factory=list)
     slot: int = -1            # decode slot id, -1 while prefilling
     prefill_only: bool = False  # park after prefill instead of decoding
+    # bumped on every preempt-and-readmit: lets the engine's device-resident
+    # decode-state signature distinguish a re-prefilled request from an
+    # uninterrupted one (same request_id, same slot, possibly the same page
+    # COUNT — but stale device token/position/page-table otherwise)
+    epoch: int = 0
+    # multimodal: [(prompt_offset, embeds [n, D])] — kept on the sequence so
+    # chunked prefill and preempt-and-re-prefill can rebuild embed rows
+    mm_spans: list = dataclasses.field(default_factory=list)
 
     @property
     def total_len(self) -> int:

@@ -59,6 +59,19 @@ class EngineRequest:
     # half of disaggregated serving (reference: prefill workers,
     # examples/llm/components/prefill_worker.py:38-155).
     prefill_only: bool = False
+    # multimodal: [(prompt_offset, embeds [n, D_text])] spans whose positions
+    # take vision-encoder output instead of token embeds; the prompt carries
+    # placeholder ids at those positions (rewritten to content-hash salts at
+    # admission so the prefix cache distinguishes different images). Items
+    # may be (offset, embeds) or (offset, embeds, salt_base) — the 3-tuple
+    # form carries a transfer-invariant salt (hashed from pixels) so the
+    # prefill and decode sides of a disaggregated pair agree on page hashes
+    # even if their vision towers differ numerically (tp relayout).
+    mm_spans: Optional[list] = None
+    # raw pixels [(prompt_offset, [H, W, 3] float array)]: encoded into
+    # mm_spans by the engine's vision tower at admission (NativeEngine.
+    # _resolve_mm); requests built above the engine use this form
+    mm_pixels: Optional[list] = None
 
 
 @dataclasses.dataclass
@@ -92,6 +105,9 @@ class PrefillPlan:
     last_idx: np.ndarray    # [Bb] index of last valid token in the chunk
     n_valid: List[int] = dataclasses.field(default_factory=list)   # per row
     is_last_chunk: List[bool] = dataclasses.field(default_factory=list)
+    # multimodal rows: embeds to mix in at masked positions (None = all-text)
+    mm_embeds: Optional[np.ndarray] = None  # [Bb, Tb, D] f32
+    mm_mask: Optional[np.ndarray] = None    # [Bb, Tb] bool
 
     @property
     def seq(self) -> SequenceState:
@@ -181,8 +197,33 @@ class Scheduler:
                 f"request {req.request_id}: len {len(req.prompt)} + "
                 f"max_tokens {req.params.max_tokens} exceeds max_model_len "
                 f"{self.cfg.max_model_len}")
-        seq = SequenceState(request_id=req.request_id, prompt=list(req.prompt),
-                            prefill_only=req.prefill_only)
+        prompt = list(req.prompt)
+        spans = []
+        if req.mm_spans:
+            # rewrite placeholder ids to image-content-hash salts: page
+            # hashes (prefix cache + router events) are computed over token
+            # ids, and identical placeholder ids for DIFFERENT images would
+            # alias their KV pages. The salted ids never feed the embedding
+            # table — the prefill step mixes in the span embeds at these
+            # positions (models/llama.forward embeds_mask).
+            from dynamo_tpu.engine.kv_cache import content_salt
+            for item in req.mm_spans:
+                off, emb = int(item[0]), np.asarray(item[1])
+                if off < 0 or off + emb.shape[0] > len(prompt):
+                    # ValueError (not IndexError): the worker's add path
+                    # converts it into a per-request error frame instead of
+                    # letting a bad wire offset kill the step loop
+                    raise ValueError(
+                        f"request {req.request_id}: image span "
+                        f"[{off}, {off + emb.shape[0]}) outside prompt of "
+                        f"{len(prompt)} tokens")
+                spans.append((off, emb))
+                base = item[2] if len(item) > 2 else content_salt(
+                    emb.tobytes())
+                for j in range(emb.shape[0]):
+                    prompt[off + j] = int((base + j) % 0x7FFFFFF0) + 1
+        seq = SequenceState(request_id=req.request_id, prompt=prompt,
+                            prefill_only=req.prefill_only, mm_spans=spans)
         self.params[req.request_id] = req.params
         self._match_prefix(seq)
         return seq
@@ -298,13 +339,15 @@ class Scheduler:
             pid = self.allocator.lookup(h)
             if pid is not None:
                 self.allocator.share(pid)
-            elif self.host_pool is not None and h in self.host_pool:
+            elif self.host_pool is not None:
                 # pull the page back into HBM: take a blank page now, the
-                # engine injects the payload before the next device step;
-                # pin the host entry so LRU can't drop it before the drain
+                # engine injects the payload before the next device step.
+                # pin() atomically checks residency AND pins, so a racing
+                # CopyStream eviction can't invalidate the claim
                 if not self.allocator.can_allocate(1):
                     break
-                self.host_pool.pin(h)
+                if not self.host_pool.pin(h):
+                    break  # not in the host tier either: prefix ends here
                 pid = self.allocator.allocate()
                 self.allocator.seal(pid, parent, toks)
                 self.pending_onboards.append((pid, h))
@@ -477,6 +520,7 @@ class Scheduler:
         page_table = np.zeros((bb, pb), np.int32)
         seqs: List[Optional[SequenceState]] = [None] * bb
         n_valid, is_last = [0] * bb, [False] * bb
+        mm_embeds = mm_mask = None
         for i, (seq, n, last_chunk) in enumerate(batch):
             start = seq.num_cached
             seqs[i] = seq
@@ -490,10 +534,22 @@ class Scheduler:
             page_table[i, :len(seq.pages)] = seq.pages
             kv_lens[i] = start + n
             last[i] = n - 1
+            # multimodal rows: copy the overlap of each image span with this
+            # chunk's [start, start+n) window into the plan's embed rows
+            for off, emb in seq.mm_spans:
+                lo, hi = max(off, start), min(off + emb.shape[0], start + n)
+                if lo >= hi:
+                    continue
+                if mm_embeds is None:
+                    mm_embeds = np.zeros((bb, tb, emb.shape[1]), np.float32)
+                    mm_mask = np.zeros((bb, tb), bool)
+                mm_embeds[i, lo - start:hi - start] = emb[lo - off:hi - off]
+                mm_mask[i, lo - start:hi - start] = True
         return PrefillPlan(
             seqs=seqs, tokens=tokens, positions=positions,
             page_table=page_table, kv_lens=kv_lens, write_idx=write_idx,
-            last_idx=last, n_valid=n_valid, is_last_chunk=is_last)
+            last_idx=last, n_valid=n_valid, is_last_chunk=is_last,
+            mm_embeds=mm_embeds, mm_mask=mm_mask)
 
     def commit_prefill_row(self, plan: PrefillPlan, i: int,
                            sampled_token: Optional[int]):
@@ -591,6 +647,7 @@ class Scheduler:
             raise MemoryError("KV cache exhausted with nothing to preempt")
         self.running[victim.slot] = None
         victim.slot = -1
+        victim.epoch += 1  # invalidate device-resident decode state reuse
         for pid in victim.pages:
             self.allocator.free(pid)
         victim.pages = []

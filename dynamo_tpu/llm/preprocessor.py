@@ -15,7 +15,8 @@ from typing import List, Optional, Tuple, Union
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
 from dynamo_tpu.llm.tokenizer import BaseTokenizer
 from dynamo_tpu.protocols.common import (
-    OutputOptions, PreprocessedRequest, SamplingOptions, StopConditions,
+    ImagePart, OutputOptions, PreprocessedRequest, SamplingOptions,
+    StopConditions,
 )
 from dynamo_tpu.protocols.openai import (
     ChatCompletionRequest, CompletionRequest, Ext,
@@ -24,6 +25,15 @@ from dynamo_tpu.protocols.sse import Annotated
 
 ANNOTATION_TOKEN_IDS = "token_ids"
 ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
+
+# literal marking an image's position in the rendered prompt; the string is
+# split on it and the segments tokenized separately, so no tokenizer ever
+# sees (or mangles) the marker
+IMAGE_MARKER = "\x00<|dynamo:image|>\x00"
+# placeholder token id occupying image-patch positions in token_ids; the
+# engine rewrites these to content-hash salts at admission and mixes in the
+# vision embeds, so the id itself never reaches the embedding table
+IMAGE_PLACEHOLDER_ID = 0
 
 DEFAULT_CHAT_TEMPLATE = (
     "{% for message in messages %}"
@@ -39,38 +49,141 @@ class OpenAIPreprocessor:
         self.card = card
         self.tokenizer = tokenizer or card.load_tokenizer()
         self._template = None
+        self._vision = "unset"  # cached card.model_config().vision
 
-    def _render_chat(self, request: ChatCompletionRequest) -> str:
+    @property
+    def vision(self):
+        """VisionConfig resolved once: card.model_config() can be expensive
+        (GGUF cards re-parse the container) and sits on the request path."""
+        if self._vision == "unset":
+            self._vision = self.card.model_config().vision
+        return self._vision
+
+    def _render_chat(self, request: ChatCompletionRequest):
+        """Render the chat template. Returns (prompt, images): image parts
+        become IMAGE_MARKER literals in the prompt and their decoded pixel
+        arrays (resized to the model's image_size) are collected in order of
+        appearance."""
         if self._template is None:
             import jinja2
             env = jinja2.Environment(keep_trailing_newline=True)
             env.globals["raise_exception"] = _raise_exception
             src = self.card.chat_template or DEFAULT_CHAT_TEMPLATE
             self._template = env.from_string(src)
-        msgs = []
+        msgs, images = [], []
+
+        def clean(text: str) -> str:
+            # user text must never inject the internal marker: it would
+            # desync the segment/image alignment in _splice_images
+            # (code-review r3: remote 500 / embed misplacement)
+            return text.replace(IMAGE_MARKER, "")
+
         for m in request.messages:
             content = m.content
-            if isinstance(content, list):  # multimodal parts: keep text parts
-                content = "".join(p.get("text", "") for p in content
-                                  if isinstance(p, dict))
+            if isinstance(content, list):  # multimodal content parts
+                pieces = []
+                for p in content:
+                    if not isinstance(p, dict):
+                        continue
+                    kind = p.get("type")
+                    if kind in ("image_url", "image"):
+                        images.append(self._decode_image(p))
+                        pieces.append(IMAGE_MARKER)
+                    else:
+                        pieces.append(clean(p.get("text", "")))
+                content = "".join(pieces)
+            elif isinstance(content, str):
+                content = clean(content)
             msgs.append({"role": m.role, "content": content or "",
                          **({"name": m.name} if m.name else {})})
-        return self._template.render(
+        prompt = self._template.render(
             messages=msgs, add_generation_prompt=True,
             bos_token="", eos_token="", tools=request.tools)
+        return prompt, images
+
+    def _decode_image(self, part: dict):
+        """Decode an OpenAI image content part into [S, S, 3] float pixels.
+
+        Accepted forms: {"type": "image_url", "image_url": {"url":
+        "data:...;base64,<b64 .npy>"}} (base64 of an np.save buffer) and
+        {"type": "image", "pixels": <nested lists>}. Pixels are resized to
+        the model's vision.image_size with nearest-neighbor sampling."""
+        import base64
+        import io
+
+        import numpy as np
+        vision = self.vision
+        if vision is None:
+            raise ValueError(
+                f"model {self.card.name!r} is text-only; image content "
+                "parts are not supported")
+        if part.get("type") == "image":
+            px = np.asarray(part["pixels"], np.float32)
+        else:
+            url = (part.get("image_url") or {}).get("url", "")
+            if ";base64," not in url:
+                raise ValueError(
+                    "image_url must be a base64 data URL (zero-egress "
+                    "deployment: remote fetch is not supported)")
+            raw = base64.b64decode(url.split(";base64,", 1)[1])
+            px = np.load(io.BytesIO(raw), allow_pickle=False)
+            px = np.asarray(px, np.float32)
+        if px.ndim != 3 or px.shape[-1] != 3:
+            raise ValueError(f"image pixels must be [H, W, 3], got "
+                             f"{px.shape}")
+        s = vision.image_size
+        if px.shape[:2] != (s, s):
+            yi = (np.arange(s) * px.shape[0] // s).clip(0, px.shape[0] - 1)
+            xi = (np.arange(s) * px.shape[1] // s).clip(0, px.shape[1] - 1)
+            px = px[yi][:, xi]
+        if px.max() > 1.5:   # 0-255 input: normalize
+            px = px / 255.0
+        return px
 
     def preprocess_chat(
         self, request: ChatCompletionRequest,
         request_id: Optional[str] = None,
     ) -> Tuple[PreprocessedRequest, List[Annotated]]:
         ext = request.ext or Ext()
+        mm_parts = None
         if ext.use_raw_prompt and request.messages:
             prompt = str(request.messages[-1].content or "")
+            token_ids = self.tokenizer.encode(prompt)
         else:
-            prompt = self._render_chat(request)
-        token_ids = self.tokenizer.encode(prompt)
+            prompt, images = self._render_chat(request)
+            if images:
+                token_ids, mm_parts = self._splice_images(prompt, images)
+            else:
+                token_ids = self.tokenizer.encode(prompt)
         pre = self._finish(request, token_ids, request_id)
+        if mm_parts:
+            pre.mm_parts = mm_parts
         return pre, self._annotations(ext, prompt, token_ids)
+
+    def _splice_images(self, prompt: str, images: list):
+        """Tokenize around IMAGE_MARKERs, inserting n_patches placeholder
+        ids per image and recording each image's token offset."""
+        from dynamo_tpu.models.vision import num_patches
+        n_patch = num_patches(self.vision)
+        segments = prompt.split(IMAGE_MARKER)
+        if len(segments) != len(images) + 1:
+            # chat template mangled/duplicated the marker — refuse rather
+            # than splice embeds at the wrong offsets
+            raise ValueError(
+                f"image marker count mismatch after template render: "
+                f"{len(segments) - 1} markers for {len(images)} images")
+        token_ids: List[int] = []
+        mm_parts: List[ImagePart] = []
+        for i, seg in enumerate(segments):
+            if seg:
+                token_ids.extend(self.tokenizer.encode(seg))
+            if i < len(images):
+                px = images[i]
+                mm_parts.append(ImagePart(
+                    offset=len(token_ids), shape=list(px.shape),
+                    dtype="float32", data=px.tobytes()))
+                token_ids.extend([IMAGE_PLACEHOLDER_ID] * n_patch)
+        return token_ids, mm_parts
 
     def preprocess_completion(
         self, request: CompletionRequest,

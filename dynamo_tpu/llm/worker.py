@@ -27,12 +27,24 @@ from dynamo_tpu.runtime.engine import AsyncEngine, Context
 
 log = logging.getLogger("dynamo_tpu.worker")
 
+# the process-wide JAX profiler session owner (see NativeEngineWorker.start)
+_PROFILE_OWNER = None
+
 
 def _to_engine_request(pre: PreprocessedRequest) -> EngineRequest:
     s, st, out = pre.sampling, pre.stop, pre.output
+    mm_pixels = None
+    if pre.mm_parts:
+        import numpy as np
+        mm_pixels = [
+            (p.offset,
+             np.frombuffer(p.data, dtype=np.dtype(p.dtype))
+             .reshape(p.shape).astype(np.float32))
+            for p in pre.mm_parts]
     return EngineRequest(
         request_id=pre.request_id,
         prompt=list(pre.token_ids),
+        mm_pixels=mm_pixels,
         params=SamplingParams(
             max_tokens=st.max_tokens or 16,
             temperature=s.temperature if s.temperature is not None else 0.0,
@@ -98,6 +110,7 @@ class NativeEngineWorker(AsyncEngine):
         # arbitrary staged engine ops (disagg page inject/extract/activate);
         # run FIFO between device steps
         self._pending_ops: list = []
+        self._profiling = False
 
     def submit(self, fn) -> asyncio.Future:
         """Stage `fn(engine)` to run between device steps; returns a future
@@ -109,6 +122,21 @@ class NativeEngineWorker(AsyncEngine):
         return fut
 
     async def start(self) -> "NativeEngineWorker":
+        # profiler hook (reference gap called out in SURVEY.md §5: no
+        # profiler backend; filled here with the JAX profiler): set
+        # DYN_JAX_PROFILE_DIR to capture a perfetto/tensorboard trace of
+        # the serving loop. The JAX trace is process-global, so only the
+        # FIRST worker in a process starts it (and only that owner stops
+        # it) — a second start_trace would raise and kill the worker.
+        import os
+        trace_dir = os.environ.get("DYN_JAX_PROFILE_DIR")
+        global _PROFILE_OWNER
+        if trace_dir and _PROFILE_OWNER is None:
+            import jax
+            jax.profiler.start_trace(trace_dir)
+            _PROFILE_OWNER = self
+            self._profiling = True
+            log.info("jax profiler tracing to %s", trace_dir)
         self._loop_task = asyncio.create_task(self._step_loop())
         return self
 
@@ -120,6 +148,15 @@ class NativeEngineWorker(AsyncEngine):
             except asyncio.CancelledError:
                 pass
             self._loop_task = None
+        close = getattr(self.engine, "close", None)
+        if close:
+            close()
+        global _PROFILE_OWNER
+        if self._profiling and _PROFILE_OWNER is self:
+            import jax
+            jax.profiler.stop_trace()
+            _PROFILE_OWNER = None
+            self._profiling = False
 
     # -- engine loop ----------------------------------------------------------
 

@@ -124,6 +124,9 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = dense(keys[9], (d, cfg.vocab_size), d)
+    if cfg.vision is not None:
+        from dynamo_tpu.models import vision
+        params["vision"] = vision.init_params(keys[10], cfg)
     return params
 
 
@@ -173,6 +176,9 @@ def param_shardings(cfg: ModelConfig) -> Params:
     }
     if not cfg.tie_word_embeddings:
         out["lm_head"] = P(None, "tp")
+    if cfg.vision is not None:
+        from dynamo_tpu.models import vision
+        out["vision"] = vision.param_shardings(cfg)
     return out
 
 
@@ -345,6 +351,7 @@ def forward(
     cache: Dict[str, jax.Array],  # {"k","v"}: [L, Hkv, P, ps, hd]
     meta: AttnMetadata,
     input_embeds: Optional[jax.Array] = None,  # [B, Tq, D] overrides tokens
+    embeds_mask: Optional[jax.Array] = None,   # [B, Tq] bool: mix per-token
     sp_mesh=None,  # Mesh with an "sp" axis: ring-attention prefill
     mesh=None,     # multi-device Mesh: shard_map the decode kernel over "tp"
     with_aux: bool = False,  # also return {"moe_dropped","moe_routed"}
@@ -364,6 +371,14 @@ def forward(
 
     if input_embeds is None:
         x = jnp.take(params["embed"], tokens, axis=0)
+    elif embeds_mask is not None:
+        # multimodal prefill: image-patch positions take the vision
+        # encoder's projected embeds, text positions take the token embeds
+        # (the token ids at masked positions are hashing salts, not real
+        # vocab ids — see scheduler._admit)
+        x = jnp.where(embeds_mask[..., None],
+                      input_embeds.astype(_dtype(cfg)),
+                      jnp.take(params["embed"], tokens, axis=0))
     else:
         x = input_embeds.astype(_dtype(cfg))
 

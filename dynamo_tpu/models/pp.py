@@ -1,0 +1,222 @@
+"""Pipeline-parallel forward pass (mesh axis "pp").
+
+The reference gets PP from vLLM only (`pipeline_parallel_size = num_nodes`,
+reference: container/deps/vllm patch vllm_inc.py:38; SURVEY.md §2.9 lists it
+as engine-delegated). Here it is first-class and TPU-idiomatic:
+
+- Parameters are already stacked over layers ([L, ...], models/llama.py), so
+  pipeline stages are just a PartitionSpec: layer axis sharded over "pp".
+  Same for the paged KV cache ([L, Hkv, P, ps, hd] → P("pp", "tp", ...)):
+  each stage owns the KV of its own layers, attention is stage-local, and
+  NO cross-stage KV traffic ever happens.
+- GPipe-style microbatching inside one shard_map: the batch splits into M
+  microbatches; at tick t, stage r works on microbatch (t - r), activations
+  hop to the next stage with a single `lax.ppermute` per tick. All stages
+  run the same SPMD program; fill/drain ticks compute on clamped indices
+  with KV writes masked off (write_idx = -1 rows are dropped by
+  write_kv_pages' scatter), so the bubble costs time, never correctness.
+- Stage-internal tensor parallelism composes: head/FFN dims shard over
+  "tp" and the body psums partial attention/MLP outputs over "tp"
+  explicitly (inside shard_map the Megatron all-reduce is manual).
+- Stage 0 embeds, every stage computes (vocab-sharded) logits but only the
+  last stage's are kept; a masked psum over "pp" broadcasts them.
+
+Scope: dense Llama-family models (the 70B scale-out config is dense). MoE
+dispatch and ring-attention prefill compose with tp/ep/sp meshes, not pp.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.models.llama import (
+    AttnMetadata, Params, _dtype, apply_rope, rms_norm,
+)
+from dynamo_tpu.ops.attention import paged_attention, write_kv_pages
+from dynamo_tpu.parallel.mesh import shard_map_compat
+
+
+def pp_param_shardings(cfg: ModelConfig) -> Params:
+    """Layer-stacked params: layer axis over "pp", head/FFN dims over "tp"."""
+    layers = {
+        "attn_norm": P("pp", None),
+        "wq": P("pp", None, "tp"),
+        "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"),
+        "wo": P("pp", "tp", None),
+        "mlp_norm": P("pp", None),
+        "w_gate": P("pp", None, "tp"),
+        "w_up": P("pp", None, "tp"),
+        "w_down": P("pp", "tp", None),
+    }
+    if cfg.attn_bias:
+        layers.update({
+            "wq_b": P("pp", "tp"),
+            "wk_b": P("pp", "tp"),
+            "wv_b": P("pp", "tp"),
+        })
+    out: Params = {
+        "embed": P(None, None),
+        "layers": layers,
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        out["lm_head"] = P(None, "tp")
+    return out
+
+
+def pp_cache_sharding() -> P:
+    """KV cache [L, Hkv, P, ps, hd]: layers over "pp", kv heads over "tp"."""
+    return P("pp", "tp", None, None, None)
+
+
+def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
+           meta: AttnMetadata):
+    """Run this stage's local layers (scan) on one microbatch.
+
+    Mirrors models/llama.forward's layer_step (gather attention path) with
+    manual Megatron psums over "tp"; kc/vc are the stage-local
+    [L/pp, Hkv/tp, ...] cache shards.
+    """
+    b, tq, _ = x.shape
+    h = cfg.num_heads // tp
+    hkv = cfg.num_kv_heads // tp
+    hd = cfg.head_dim
+
+    def layer_step(x, layer):
+        lp, kc, vc = layer
+        xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = jnp.einsum("btd,de->bte", xn, lp["wq"])
+        k = jnp.einsum("btd,de->bte", xn, lp["wk"])
+        v = jnp.einsum("btd,de->bte", xn, lp["wv"])
+        if cfg.attn_bias:
+            q, k, v = q + lp["wq_b"], k + lp["wk_b"], v + lp["wv_b"]
+        q = apply_rope(q.reshape(b, tq, h, hd), meta.positions, cfg.rope_theta)
+        k = apply_rope(k.reshape(b, tq, hkv, hd), meta.positions,
+                       cfg.rope_theta)
+        v = v.reshape(b, tq, hkv, hd)
+        kc, vc = write_kv_pages(kc, vc, k, v, meta.write_idx)
+        attn = paged_attention(q, kc, vc, meta.page_table, meta.kv_lens,
+                               meta.positions)
+        o = jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd), lp["wo"])
+        x = x + jax.lax.psum(o, "tp")
+        xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        gate = jnp.einsum("btd,df->btf", xn, lp["w_gate"])
+        up = jnp.einsum("btd,df->btf", xn, lp["w_up"])
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+        mlp = jnp.einsum("btf,fd->btd", act, lp["w_down"])
+        x = x + jax.lax.psum(mlp, "tp")
+        return x, (kc, vc)
+
+    x, (kc, vc) = jax.lax.scan(layer_step, x, (layers, kc, vc))
+    return x, kc, vc
+
+
+def pp_forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,            # [B, Tq] int32
+    cache: Dict[str, jax.Array],  # {"k","v"}: [L, Hkv, P, ps, hd]
+    meta: AttnMetadata,
+    mesh,
+    n_micro: int = 0,             # 0 = min(pp, B) microbatches; snapped to
+                                  # the largest divisor of B
+) -> tuple:
+    """Pipeline-parallel equivalent of models/llama.forward (dense path).
+
+    Returns (logits [B, Tq, V] f32, updated cache). Semantics are oracle-
+    identical to the single-mesh forward (tests/test_pp.py).
+    """
+    if cfg.is_moe:
+        raise NotImplementedError("pp composes with dense models; MoE "
+                                  "scale-out uses the ep axis (ops/moe.py)")
+    pp = mesh.shape["pp"]
+    tp = mesh.shape.get("tp", 1)
+    b = tokens.shape[0]
+    m = n_micro if n_micro > 0 else min(pp, b)
+    while b % m:
+        m -= 1
+    shardings = pp_param_shardings(cfg)
+    head = (params["embed"].T if cfg.tie_word_embeddings
+            else params["lm_head"])
+    head_spec = (P(None, None) if cfg.tie_word_embeddings
+                 else shardings["lm_head"])
+    fwd = functools.partial(_pp_body, cfg, pp, tp, m)
+    specs = dict(
+        mesh=mesh,
+        in_specs=(P(None, None), shardings["layers"], P(None), head_spec,
+                  pp_cache_sharding(), pp_cache_sharding(),
+                  P(), P(), P(), P(), P()),
+        # logits vocab-sharded over tp when the head is; cache back in place
+        out_specs=(P(None, None, "tp") if head_spec[1] == "tp" else P(),
+                   pp_cache_sharding(), pp_cache_sharding()),
+    )
+    logits, kc, vc = shard_map_compat(fwd, **specs)(
+        params["embed"], params["layers"], params["final_norm"], head,
+        cache["k"], cache["v"], tokens, meta.positions, meta.page_table,
+        meta.kv_lens, meta.write_idx)
+    return logits, {"k": kc, "v": vc}
+
+
+def _pp_body(cfg, pp, tp, m,
+             embed, layers, final_norm, head,
+             kc, vc, tokens, positions, page_table, kv_lens, write_idx):
+    """shard_map body: runs once per (pp, tp) shard with stage-local
+    layers/cache. One GPipe schedule of m microbatches over pp stages."""
+    r = jax.lax.axis_index("pp")
+    last = pp - 1
+    b, tq = tokens.shape
+    bm = b // m
+    ticks = m + pp - 1
+    v_loc = head.shape[1]
+    dt = _dtype(cfg)
+
+    def mb(arr):  # [B, ...] -> [M, bm, ...]
+        return arr.reshape((m, bm) + arr.shape[1:])
+
+    toks_mb = mb(tokens)
+    pos_mb = mb(positions)
+    pt_mb = mb(page_table)
+    kl_mb = mb(kv_lens)
+    wi_mb = mb(write_idx)
+
+    def tick(carry, t):
+        x_prev, kc, vc = carry
+        i = t - r                      # microbatch this stage works on
+        valid = (i >= 0) & (i < m)
+        ic = jnp.clip(i, 0, m - 1)
+        # stage 0 sources fresh embeddings; later stages consume the
+        # activation that arrived from the previous stage last tick
+        x0 = jnp.take(embed, toks_mb[ic], axis=0).astype(dt)
+        x_in = jnp.where(r == 0, x0, x_prev)
+        meta_t = AttnMetadata(
+            positions=pos_mb[ic], page_table=pt_mb[ic], kv_lens=kl_mb[ic],
+            # fill/drain ticks must not write KV: scatter drops idx < 0
+            write_idx=jnp.where(valid, wi_mb[ic], -1))
+        y, kc, vc = _stage(cfg, tp, x_in, layers, kc, vc, meta_t)
+        # the LAST stage finishes microbatch i at this tick
+        xf = rms_norm(y, final_norm, cfg.rms_norm_eps)
+        lg = jnp.einsum("btd,dv->btv", xf, head).astype(jnp.float32)
+        lg = jnp.where((r == last) & valid, lg, 0.0)
+        # hop activations to the next stage (ring; stage 0's recv is unused)
+        y_next = jax.lax.ppermute(
+            y, "pp", [(s, (s + 1) % pp) for s in range(pp)])
+        return (y_next, kc, vc), (lg, ic)
+
+    x0 = jnp.zeros((b // m, tq, cfg.hidden_size), dt)
+    (_, kc, vc), (lgs, idxs) = jax.lax.scan(
+        tick, (x0, kc, vc), jnp.arange(ticks))
+    # scatter each tick's logits into its microbatch slot: non-last stages
+    # and fill/drain ticks contributed zeros, and each microbatch's logits
+    # were produced exactly once (on the last stage, at tick i + pp - 1)
+    out = jnp.zeros((m, bm, tq, v_loc), jnp.float32)
+    out = out.at[idxs].add(lgs)
+    out = out.reshape(b, tq, v_loc)
+    # masked broadcast: only the last stage holds real logits
+    out = jax.lax.psum(out, "pp")
+    return out, kc, vc

@@ -1,0 +1,142 @@
+"""ViT vision encoder for multimodal (Qwen2-VL-class) serving.
+
+The reference serves multimodal models through its engines (vLLM et al.
+run the vision tower; the serving layer only routes); SURVEY.md §7 stage 7
+and BASELINE config #5 (Qwen2-VL) make the vision path part of the
+capability surface, so here it is a first-class JAX encoder.
+
+TPU-first design notes:
+- patchify is a reshape + one [P², D] matmul (not a conv): identical math
+  to a non-overlapping conv patch embed, and it lowers to a single MXU
+  matmul with no window overhead;
+- encoder layers are stacked and scanned (one compiled layer body, like
+  models/llama.py);
+- full (non-causal) attention over patches as one batched einsum — patch
+  counts are static per config, so XLA tiles it onto the MXU directly;
+- the projection to the text model's embedding space is part of the
+  encoder, so the engine receives ready-to-scatter [n_patches, D_text]
+  rows (the "mm embeds" the prefill step mixes in; models/llama.forward
+  input_embeds/embeds_mask path).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.engine.config import ModelConfig, VisionConfig
+
+Params = Dict[str, Any]
+
+
+def num_patches(vcfg: VisionConfig) -> int:
+    side = vcfg.image_size // vcfg.patch_size
+    return side * side
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Vision tower parameters (stacked over layers), dtype = text dtype."""
+    vcfg = cfg.vision
+    dt = jnp.dtype(cfg.dtype)
+    d, f, h = vcfg.hidden_size, vcfg.intermediate_size, vcfg.num_heads
+    hd = d // h
+    l = vcfg.num_layers
+    patch_dim = vcfg.patch_size * vcfg.patch_size * 3
+    keys = jax.random.split(rng, 10)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * fan_in ** -0.5).astype(dt)
+
+    return {
+        "patch_embed": dense(keys[0], (patch_dim, d), patch_dim),
+        "pos_embed": dense(keys[1], (num_patches(vcfg), d), d),
+        "layers": {
+            "attn_norm": jnp.ones((l, d), dt),
+            "wq": dense(keys[2], (l, d, h * hd), d),
+            "wk": dense(keys[3], (l, d, h * hd), d),
+            "wv": dense(keys[4], (l, d, h * hd), d),
+            "wo": dense(keys[5], (l, h * hd, d), h * hd),
+            "mlp_norm": jnp.ones((l, d), dt),
+            "w_up": dense(keys[6], (l, d, f), d),
+            "w_down": dense(keys[7], (l, f, d), f),
+        },
+        "final_norm": jnp.ones((d,), dt),
+        # projection into the TEXT embedding space
+        "proj": dense(keys[8], (d, cfg.hidden_size), d),
+    }
+
+
+def param_shardings(cfg: ModelConfig) -> Params:
+    """Vision tower shardings: attention heads / MLP hidden over "tp"
+    (same Megatron pattern as the text stack, models/llama.param_shardings)."""
+    return {
+        "patch_embed": P(None, None),
+        "pos_embed": P(None, None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(None, None),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+        "proj": P(None, None),
+    }
+
+
+def _layer_norm(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def patchify(pixels: jax.Array, patch: int) -> jax.Array:
+    """[B, H, W, 3] -> [B, n_patches, patch*patch*3] (row-major patches)."""
+    b, hh, ww, c = pixels.shape
+    gh, gw = hh // patch, ww // patch
+    x = pixels.reshape(b, gh, patch, gw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)          # [B, gh, gw, p, p, C]
+    return x.reshape(b, gh * gw, patch * patch * c)
+
+
+def encode(params: Params, cfg: ModelConfig, pixels: jax.Array) -> jax.Array:
+    """pixels [B, H, W, 3] float in [0, 1] -> embeds [B, n_patches, D_text].
+
+    H/W must equal vision.image_size (the preprocessor resizes host-side).
+    """
+    vcfg = cfg.vision
+    d, h = vcfg.hidden_size, vcfg.num_heads
+    hd = d // h
+    dt = jnp.dtype(cfg.dtype)
+
+    x = patchify(pixels.astype(dt), vcfg.patch_size)
+    x = jnp.einsum("bpe,ed->bpd", x, params["patch_embed"])
+    x = x + params["pos_embed"][None]
+    b, n, _ = x.shape
+
+    def layer_step(x, lp):
+        xn = _layer_norm(x, lp["attn_norm"])
+        q = jnp.einsum("bpd,de->bpe", xn, lp["wq"]).reshape(b, n, h, hd)
+        k = jnp.einsum("bpd,de->bpe", xn, lp["wk"]).reshape(b, n, h, hd)
+        v = jnp.einsum("bpd,de->bpe", xn, lp["wv"]).reshape(b, n, h, hd)
+        scores = jnp.einsum("bqhe,bkhe->bhqk", q, k).astype(jnp.float32)
+        attn = jax.nn.softmax(scores * hd ** -0.5, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bkhe->bqhe", attn, v).reshape(b, n, h * hd)
+        x = x + jnp.einsum("bpe,ed->bpd", o, lp["wo"])
+        xn = _layer_norm(x, lp["mlp_norm"])
+        up = jnp.einsum("bpd,df->bpf", xn, lp["w_up"])
+        x = x + jnp.einsum("bpf,fd->bpd",
+                           jax.nn.gelu(up.astype(jnp.float32)).astype(x.dtype),
+                           lp["w_down"])
+        return x, None
+
+    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+    x = _layer_norm(x, params["final_norm"])
+    return jnp.einsum("bpd,dt->bpt", x, params["proj"])

@@ -48,6 +48,21 @@ class OutputOptions(pydantic.BaseModel):
     echo: bool = False
 
 
+class ImagePart(pydantic.BaseModel):
+    """One image's pixels, positioned in the token stream.
+
+    `offset` points at the first of the image's placeholder token ids in
+    token_ids; `data` is the raw float32 pixel buffer [H, W, 3] in [0, 1]
+    (bytes ride msgpack natively — the engine-side vision tower encodes
+    them; reference capability: multimodal engines, SURVEY.md §7 stage 7).
+    """
+
+    offset: int
+    shape: List[int]          # [H, W, 3]
+    dtype: str = "float32"
+    data: bytes
+
+
 class PreprocessedRequest(pydantic.BaseModel):
     """What the frontend/processor sends to a worker (token-level request).
 
@@ -64,6 +79,8 @@ class PreprocessedRequest(pydantic.BaseModel):
     model: str = ""
     mdc_sum: str = ""
     annotations: List[str] = []
+    # multimodal: images to mix into the prefill at placeholder positions
+    mm_parts: Optional[List[ImagePart]] = None
 
 
 class EngineOutput(pydantic.BaseModel):

@@ -21,7 +21,12 @@ from dynamo_tpu.runtime.transports.memory import MemoryPlane
 
 log = logging.getLogger("dynamo_tpu.runtime")
 
-LEASE_TTL_S = 10.0
+# env-overridable like the reference's DYN_RUNTIME_* knobs (figment,
+# reference lib/runtime/src/config.rs): heavily-loaded single-core hosts
+# (CI) can starve the heartbeat task past TTL/3 and falsely expire leases
+import os as _os
+
+LEASE_TTL_S = float(_os.environ.get("DYN_LEASE_TTL_S", "10.0"))
 
 
 class DistributedRuntime:

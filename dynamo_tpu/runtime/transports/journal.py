@@ -116,7 +116,16 @@ class Journal:
     snapshot. Every fresh journal opens with a {"op": "jhead", "gen": G}
     record and the snapshot stores the generation it expects; recovery
     discards a journal whose generation doesn't match (it was already
-    folded into the snapshot)."""
+    folded into the snapshot).
+
+    Flush-behind writer thread (code-review r3): append() and compact()
+    run on the control-plane event loop, so all file I/O — including the
+    full snapshot rewrite — happens on a dedicated writer thread, in
+    order. The loop only packs bytes and enqueues; a compaction never
+    stalls leases/watches. Trade-off: a process crash can lose the last
+    few enqueued-but-unwritten records (never corrupting or reordering);
+    the reference accepts the same window via etcd/JetStream client-side
+    buffering."""
 
     def __init__(self, data_dir: str, compact_every: int = 10_000):
         os.makedirs(data_dir, exist_ok=True)
@@ -127,19 +136,74 @@ class Journal:
         self._gen = 0
         self._file: Optional[io.BufferedWriter] = None
         self._plane: Optional[MemoryPlane] = None
+        import queue as _queue
+        import threading as _threading
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._writer = _threading.Thread(
+            target=self._writer_loop, name="cp-journal", daemon=True)
+        self._writer.start()
 
     def attach(self, plane: MemoryPlane) -> None:
         self._plane = plane
 
     def append(self, rec: dict) -> None:
-        if self._file is None:
-            self._file = open(self.journal_path, "ab")
-            if os.path.getsize(self.journal_path) == 0:
-                _append_record(self._file, {"op": "jhead", "gen": self._gen})
-        _append_record(self._file, rec)
+        # the record carries the generation current at ENQUEUE time: the
+        # writer stamps a fresh journal's jhead from it, so records
+        # enqueued before a pending compaction never land under the new
+        # generation (which would discard them on recovery)
+        self._q.put(("rec", (msgpack.packb(rec), self._gen)))
         self._since_compact += 1
         if self._since_compact >= self.compact_every:
             self.compact()
+
+    def sync(self) -> None:
+        """Block until every enqueued write has reached the filesystem."""
+        self._q.join()
+
+    # -- writer thread --------------------------------------------------------
+
+    def _writer_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    if self._file is not None:
+                        self._file.close()
+                        self._file = None
+                    return
+                kind, payload = item
+                if kind == "rec":
+                    self._write_record(*payload)
+                else:  # ("snap", (gen, snapshot_bytes))
+                    self._write_snapshot(*payload)
+            except Exception:  # pragma: no cover — keep draining
+                log.exception("journal write failed")
+            finally:
+                self._q.task_done()
+
+    def _write_record(self, payload: bytes, gen: int) -> None:
+        if self._file is None:
+            self._file = open(self.journal_path, "ab")
+            if os.path.getsize(self.journal_path) == 0:
+                _append_record(self._file, {"op": "jhead", "gen": gen})
+        self._file.write(_LEN.pack(len(payload)))
+        self._file.write(payload)
+        self._file.flush()
+
+    def _write_snapshot(self, new_gen: int, snap_bytes: bytes) -> None:
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_LEN.pack(len(snap_bytes)))
+            f.write(snap_bytes)
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        # crash window here: old journal still on disk, but its jhead gen
+        # no longer matches the snapshot, so recovery discards it
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        with open(self.journal_path, "wb") as f:
+            _append_record(f, {"op": "jhead", "gen": new_gen})
 
     # -- recovery -------------------------------------------------------------
 
@@ -187,37 +251,30 @@ class Journal:
         return n
 
     def compact(self) -> None:
-        """Write current persistent state as a snapshot, truncate journal."""
+        """Snapshot current persistent state, truncate the journal.
+
+        The state capture (pure in-memory walk + msgpack) happens here, on
+        the caller's thread, so it is consistent with the mutation order;
+        the file rewrite happens on the writer thread behind any records
+        already enqueued."""
         if self._plane is None:
             return
         kv, mq = self._plane.kv, self._plane.messaging
-        new_gen = self._gen + 1
+        self._gen += 1
         snap = {
-            "gen": new_gen,
+            "gen": self._gen,
             "kv": [[k, e.value] for k, e in sorted(kv._data.items())
                    if not e.lease_id],
             "queues": [[name, list(q._queue)]
                        for name, q in mq._queues.items() if q.qsize()],
         }
-        tmp = self.snap_path + ".tmp"
-        with open(tmp, "wb") as f:
-            _append_record(f, snap)
-            os.fsync(f.fileno())
-        os.replace(tmp, self.snap_path)
-        # crash window here: old journal still on disk, but its jhead gen
-        # no longer matches the snapshot, so recovery discards it
-        if self._file is not None:
-            self._file.close()
-            self._file = None
-        self._gen = new_gen
-        with open(self.journal_path, "wb") as f:
-            _append_record(f, {"op": "jhead", "gen": new_gen})
+        self._q.put(("snap", (self._gen, msgpack.packb(snap))))
         self._since_compact = 0
 
     def close(self) -> None:
-        if self._file is not None:
-            self._file.close()
-            self._file = None
+        """Drain all pending writes and stop the writer thread."""
+        self._q.put(None)
+        self._writer.join(timeout=30)
 
 
 class DurablePlane(MemoryPlane):

@@ -18,6 +18,10 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the full suite on one CPU core can starve lease heartbeats past TTL/3,
+# falsely expiring workers mid-test (observed flake: kv-events test);
+# tests that exercise expiry override dist.LEASE_TTL_S directly
+os.environ.setdefault("DYN_LEASE_TTL_S", "60")
 
 import jax  # noqa: E402
 

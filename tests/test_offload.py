@@ -16,11 +16,12 @@ CFG = ModelConfig(dtype="float32", max_model_len=256)
 PAGE = 8
 
 
-def make_engine(num_pages, host_pages=0):
+def make_engine(num_pages, host_pages=0, disk_pages=0, disk_dir=None):
     return NativeEngine(CFG, EngineConfig(
         page_size=PAGE, num_pages=num_pages, max_slots=2,
         max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
-        max_model_len=256, host_pages=host_pages), seed=0)
+        max_model_len=256, host_pages=host_pages, disk_pages=disk_pages,
+        disk_dir=disk_dir), seed=0)
 
 
 def test_host_pool_lru():
@@ -73,6 +74,30 @@ def test_onboard_survives_pool_pressure():
     # evictions while the onboard is pending — must not crash or corrupt
     got_a2 = eng.generate(prompt_a, params, "a2")
     assert got_a2 == expect_a
+
+
+def test_disk_tier_spill_and_promote(tmp_path):
+    """Three-tier ladder (HBM -> DRAM -> disk, reference kv/storage.rs):
+    with a 2-page DRAM slab, workload B's eviction pressure pushes A's
+    pages down to disk; re-sending A promotes them back and produces
+    identical tokens."""
+    params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    prompt_a = list(range(10, 34))    # 3 pages
+    prompt_b = list(range(100, 140))  # 5 pages
+    expect_a = make_engine(num_pages=64).generate(prompt_a, params, "a")
+
+    # 6 HBM pages: B (5 prompt + 1 decode page) must reclaim every one of
+    # A's 3 sealed pages -> 3 offloads into a 2-page DRAM slab -> >=1 spill
+    eng = make_engine(num_pages=6, host_pages=2, disk_pages=16,
+                      disk_dir=str(tmp_path))
+    assert eng.generate(prompt_a, params, "a1") == expect_a
+    eng.generate(prompt_b, params, "b")   # evicts A: DRAM -> disk cascade
+    eng._copy_stream.drain()  # offload copies are flush-behind
+    st = eng.host_pool.stats
+    assert st.disk_offloaded > 0, "DRAM pressure must spill to disk"
+    got_a2 = eng.generate(prompt_a, params, "a2")
+    assert got_a2 == expect_a
+    assert st.disk_hits > 0, "re-prefill must promote from the disk tier"
 
 
 def test_offload_disabled_by_default():

@@ -129,3 +129,43 @@ def test_preemption_preserves_greedy_output():
             break
     assert len(done) == 2, "requests did not finish under memory pressure"
     assert [got[f"r{i}"] for i in range(2)] == expect
+
+
+def test_preempt_readmit_invalidates_device_decode_state():
+    """A request preempted and re-prefilled between decode windows must not
+    match the cached device decode-state signature: same request_id, same
+    slot, same page COUNT (single page here), but the device-side token/
+    position/page-table are stale (code-review r3). The admission epoch in
+    the sig forces a rebuild; greedy output must equal an undisturbed run."""
+    cfg = EngineConfig(page_size=64, num_pages=8, max_slots=2,
+                       max_prefill_chunk=16, prefill_buckets=(8, 16),
+                       max_model_len=128, decode_steps=4)
+    prompt = list(range(5, 13))
+    p = SamplingParams(max_tokens=12, temperature=0.0)
+    expect = NativeEngine(CFG, cfg, seed=0).generate(prompt, p, "ref")
+
+    eng = NativeEngine(CFG, cfg, seed=0)
+    eng.add_request(EngineRequest("r", prompt, p))
+    got = []
+    preempted = False
+    for _ in range(60):
+        for ev in eng.step():
+            if ev.token is not None:
+                got.append(ev.token)
+            if ev.finished:
+                break
+        else:
+            # after the first decode WINDOW (prefill emits 1 token, the
+            # window adds decode_steps more): forcibly preempt the running
+            # seq (the memory-pressure path self-evicts exactly like this).
+            # Preempting earlier would miss the bug — _dec_state is only
+            # populated once a decode window has run.
+            if not preempted and len(got) > cfg.decode_steps:
+                eng.scheduler._preempt_one()
+                preempted = True
+            continue
+        break
+    assert preempted
+    # re-prefill recomputes the KV; tokens already streamed must not be
+    # re-streamed, and the continuation must match the undisturbed run
+    assert got == expect

