@@ -178,19 +178,42 @@ class HttpService:
             finish("success")
             agg = (aggregate_chat_chunks if endpoint == "chat"
                    else aggregate_completion_chunks)(chunks)
+            if endpoint == "chat" and getattr(oai_req, "tools", None):
+                # a tools-carrying request may answer WITH a tool call:
+                # parse each choice's text into OpenAI tool_calls
+                # (reference: preprocessor/tools/response.rs)
+                from dynamo_tpu.llm.tool_calls import apply_tool_calls
+                for choice in agg.choices:
+                    choice.finish_reason = apply_tool_calls(
+                        choice.message, choice.finish_reason)
             return Response.json(agg.model_dump(exclude_none=True))
+
+        # a tools-carrying streaming request buffers until finish: the text
+        # may BE a tool invocation, and clients must receive it as
+        # delta.tool_calls + finish_reason "tool_calls" — identical to the
+        # unary behavior — not as prose deltas. Tool responses are short,
+        # so the lost streaming latency is the cost of correctness.
+        buffer_tools = (endpoint == "chat"
+                        and bool(getattr(oai_req, "tools", None)))
 
         async def sse_gen():
             status = "success"
+            held = []
             try:
                 async for chunk in chunk_gen:
                     if http_req.disconnected.is_set():
                         ctx.stop_generating()
                         status = "disconnect"
                         break
+                    if buffer_tools:
+                        held.append(chunk)
+                        continue
                     yield sse.encode_json_data(
                         chunk.model_dump(exclude_none=True)).encode()
                 else:
+                    for out_chunk in _resolve_held_chunks(held):
+                        yield sse.encode_json_data(
+                            out_chunk.model_dump(exclude_none=True)).encode()
                     yield sse.DONE_FRAME.encode()
             except asyncio.CancelledError:
                 ctx.stop_generating()
@@ -239,3 +262,48 @@ async def _ensure_aiter(maybe_coro):
     if asyncio.iscoroutine(maybe_coro):
         return await maybe_coro
     return maybe_coro
+
+
+def _resolve_held_chunks(held):
+    """Buffered tools-mode stream: if the aggregate parses as tool calls,
+    replace the content deltas with one tool_calls delta + a finish chunk;
+    otherwise replay the original chunks unchanged."""
+    if not held:
+        return
+    from dynamo_tpu.llm.tool_calls import parse_tool_calls
+    from dynamo_tpu.protocols.delta import aggregate_chat_chunks
+    from dynamo_tpu.protocols.openai import (
+        ChatCompletionChunk, ChatChoiceDelta, ChatStreamChoice,
+    )
+    agg = aggregate_chat_chunks(held)
+    calls_by_index = {}
+    for choice in agg.choices:
+        content = (choice.message.content
+                   if isinstance(choice.message.content, str) else None)
+        calls = parse_tool_calls(content or "")
+        if calls:
+            for i, c in enumerate(calls):
+                c["index"] = i
+            calls_by_index[choice.index] = calls
+    if not calls_by_index:
+        yield from held
+        return
+    proto = held[0]
+    # one delta chunk per choice (tool_calls or the full text for prose
+    # choices in a mixed n>1 fan-out), then one finish chunk for all
+    for choice in agg.choices:
+        calls = calls_by_index.get(choice.index)
+        delta = (ChatChoiceDelta(role="assistant", tool_calls=calls)
+                 if calls else
+                 ChatChoiceDelta(role="assistant",
+                                 content=choice.message.content or ""))
+        yield ChatCompletionChunk(
+            id=proto.id, created=proto.created, model=proto.model,
+            choices=[ChatStreamChoice(index=choice.index, delta=delta)])
+    yield ChatCompletionChunk(
+        id=proto.id, created=proto.created, model=proto.model,
+        choices=[ChatStreamChoice(
+            index=choice.index, delta=ChatChoiceDelta(),
+            finish_reason=("tool_calls" if choice.index in calls_by_index
+                           else choice.finish_reason))
+            for choice in agg.choices])

@@ -1,0 +1,358 @@
+"""GGUF model sourcing: metadata, tokenizer, and tensor loading.
+
+Role of the reference's GGUF support (reference: lib/llm/src/gguf.rs +
+gguf/{content,gguf_metadata,gguf_tokenizer}.rs, ~2k LoC; consumed by
+ModelDeploymentCard::from_gguf so llama.cpp-style single-file models work
+without HF artifacts). Same capability here, numpy-native:
+
+- `GGUFFile` parses the v2/v3 container: header, typed metadata KVs
+  (including nested arrays), tensor infos, and lazily mmaps tensor data.
+- `config_from_gguf` maps `llama.*` metadata keys onto ModelConfig.
+- `load_params_from_gguf` maps llama.cpp tensor names (token_embd, blk.N.*,
+  output_norm, output) onto the stacked-layer params pytree of
+  models/llama.py. Supported tensor types: F32, F16, BF16, and Q8_0
+  (dequantized on load); other quants raise with the type named.
+- `GGUFTokenizer` reconstructs a usable tokenizer from
+  `tokenizer.ggml.tokens`: greedy longest-match encode with byte fallback
+  (<0xXX> tokens), SentencePiece-style "▁" space handling on decode. This
+  is not a faithful BPE-merge reimplementation — encodes can differ from
+  llama.cpp's on rare strings — but round-trips text and matches vocab ids,
+  which is what serving needs.
+
+GGUF is little-endian; v3 adds no layout changes we depend on.
+"""
+from __future__ import annotations
+
+import mmap
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+GGUF_MAGIC = b"GGUF"
+
+# metadata value types
+_T_U8, _T_I8, _T_U16, _T_I16, _T_U32, _T_I32, _T_F32, _T_BOOL, _T_STR, \
+    _T_ARR, _T_U64, _T_I64, _T_F64 = range(13)
+
+_SCALARS = {
+    _T_U8: ("<B", 1), _T_I8: ("<b", 1), _T_U16: ("<H", 2), _T_I16: ("<h", 2),
+    _T_U32: ("<I", 4), _T_I32: ("<i", 4), _T_F32: ("<f", 4),
+    _T_BOOL: ("<?", 1), _T_U64: ("<Q", 8), _T_I64: ("<q", 8),
+    _T_F64: ("<d", 8),
+}
+
+# ggml tensor types we materialize (id -> (name, bytes per block, block len))
+GGML_F32, GGML_F16, GGML_Q8_0, GGML_BF16 = 0, 1, 8, 30
+_GGML_NAMES = {
+    0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0", 7: "Q5_1",
+    8: "Q8_0", 9: "Q8_1", 10: "Q2_K", 11: "Q3_K", 12: "Q4_K", 13: "Q5_K",
+    14: "Q6_K", 15: "Q8_K", 30: "BF16",
+}
+
+
+class _Reader:
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, fmt: str, size: int):
+        (v,) = struct.unpack_from(fmt, self.buf, self.pos)
+        self.pos += size
+        return v
+
+    def u32(self) -> int:
+        return self.read("<I", 4)
+
+    def u64(self) -> int:
+        return self.read("<Q", 8)
+
+    def string(self) -> str:
+        n = self.u64()
+        s = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return bytes(s).decode("utf-8", errors="replace")
+
+    def value(self, vtype: int):
+        if vtype in _SCALARS:
+            return self.read(*_SCALARS[vtype])
+        if vtype == _T_STR:
+            return self.string()
+        if vtype == _T_ARR:
+            etype = self.u32()
+            count = self.u64()
+            return [self.value(etype) for _ in range(count)]
+        raise ValueError(f"unknown gguf metadata type {vtype}")
+
+
+class TensorInfo:
+    def __init__(self, name: str, dims: List[int], ggml_type: int,
+                 offset: int):
+        self.name = name
+        self.dims = dims          # ne order: dims[0] varies fastest
+        self.ggml_type = ggml_type
+        self.offset = offset      # relative to the data section
+
+    @property
+    def n_elements(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+
+class GGUFFile:
+    """Parsed GGUF container with lazy tensor materialization."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "rb")
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        r = _Reader(self._mm)
+        if self._mm[:4] != GGUF_MAGIC:
+            raise ValueError(f"{path}: not a GGUF file")
+        r.pos = 4
+        self.version = r.u32()
+        if self.version not in (2, 3):
+            raise ValueError(f"{path}: unsupported GGUF version "
+                             f"{self.version}")
+        n_tensors = r.u64()
+        n_kv = r.u64()
+        self.metadata: Dict[str, Any] = {}
+        for _ in range(n_kv):
+            key = r.string()
+            vtype = r.u32()
+            self.metadata[key] = r.value(vtype)
+        self.tensors: Dict[str, TensorInfo] = {}
+        for _ in range(n_tensors):
+            name = r.string()
+            n_dims = r.u32()
+            dims = [r.u64() for _ in range(n_dims)]
+            ggml_type = r.u32()
+            offset = r.u64()
+            self.tensors[name] = TensorInfo(name, dims, ggml_type, offset)
+        align = int(self.metadata.get("general.alignment", 32))
+        self.data_start = (r.pos + align - 1) // align * align
+
+    def close(self) -> None:
+        self._mm.close()
+        self._f.close()
+
+    def tensor(self, name: str) -> np.ndarray:
+        """Materialize one tensor as float32 numpy, shape dims[::-1]
+        (row-major: ne[0] is the fastest-varying GGML dimension)."""
+        info = self.tensors.get(name)
+        if info is None:
+            raise KeyError(f"{self.path}: no tensor {name!r}")
+        start = self.data_start + info.offset
+        n = info.n_elements
+        shape = tuple(reversed(info.dims))
+        t = info.ggml_type
+        if t == GGML_F32:
+            arr = np.frombuffer(self._mm, np.float32, n, start)
+        elif t == GGML_F16:
+            arr = np.frombuffer(self._mm, np.float16, n, start)
+        elif t == GGML_BF16:
+            raw = np.frombuffer(self._mm, np.uint16, n, start)
+            arr = (raw.astype(np.uint32) << 16).view(np.float32)
+        elif t == GGML_Q8_0:
+            # blocks of 32: f16 scale + 32 x i8
+            nb = n // 32
+            raw = np.frombuffer(self._mm, np.uint8, nb * 34, start)
+            blocks = raw.reshape(nb, 34)
+            scales = blocks[:, :2].copy().view(np.float16).astype(np.float32)
+            qs = blocks[:, 2:].copy().view(np.int8).astype(np.float32)
+            arr = qs * scales  # [nb, 32] broadcast over the block
+        else:
+            raise ValueError(
+                f"{self.path}: tensor {name!r} has unsupported ggml type "
+                f"{_GGML_NAMES.get(t, t)}; supported: F32, F16, BF16, Q8_0")
+        # always copy out of the mmap: returned arrays must not pin the
+        # file mapping open (close() would raise BufferError)
+        return np.array(arr, np.float32, copy=True).reshape(shape)
+
+
+# -- config -------------------------------------------------------------------
+
+def config_from_gguf(g: GGUFFile, name: str = ""):
+    """Map `llama.*` GGUF metadata onto ModelConfig (the reference's
+    gguf_metadata.rs role)."""
+    from dynamo_tpu.engine.config import ModelConfig
+    md = g.metadata
+    arch = md.get("general.architecture", "llama")
+    if arch not in ("llama", "mistral", "qwen2"):
+        raise ValueError(f"unsupported gguf architecture {arch!r}")
+    p = arch  # key prefix
+
+    def key(suffix, default=None):
+        return md.get(f"{p}.{suffix}", default)
+
+    heads = int(key("attention.head_count"))
+    d = int(key("embedding_length"))
+    vocab = int(key("vocab_size",
+                    len(md.get("tokenizer.ggml.tokens", [])) or 0))
+    return ModelConfig(
+        name=name or md.get("general.name", arch),
+        vocab_size=vocab,
+        hidden_size=d,
+        intermediate_size=int(key("feed_forward_length")),
+        num_layers=int(key("block_count")),
+        num_heads=heads,
+        num_kv_heads=int(key("attention.head_count_kv", heads)),
+        head_dim=int(key("attention.key_length", d // heads)),
+        rope_theta=float(key("rope.freq_base", 10000.0)),
+        rms_norm_eps=float(key("attention.layer_norm_rms_epsilon", 1e-5)),
+        max_model_len=int(key("context_length", 2048)),
+        attn_bias=arch == "qwen2",
+        tie_word_embeddings="output.weight" not in g.tensors,
+    )
+
+
+def load_params_from_gguf(g: GGUFFile, cfg, dtype: str = "") -> Dict[str, Any]:
+    """llama.cpp tensor names -> our stacked params (models/llama.py).
+
+    GGUF stores projections [out, in] like HF (after the ne->numpy shape
+    reversal), so the same transposes as models/loader.py apply.
+    """
+    import jax.numpy as jnp
+    dt = jnp.empty((), dtype or cfg.dtype).dtype
+
+    def t(name):
+        return np.asarray(g.tensor(name).T, dtype=dt)
+
+    def w(name):
+        return np.asarray(g.tensor(name), dtype=dt)
+
+    def stack(fmt, fn):
+        return np.stack([fn(fmt.format(i)) for i in range(cfg.num_layers)])
+
+    layers: Dict[str, Any] = {
+        "attn_norm": stack("blk.{}.attn_norm.weight", w),
+        "wq": stack("blk.{}.attn_q.weight", t),
+        "wk": stack("blk.{}.attn_k.weight", t),
+        "wv": stack("blk.{}.attn_v.weight", t),
+        "wo": stack("blk.{}.attn_output.weight", t),
+        "mlp_norm": stack("blk.{}.ffn_norm.weight", w),
+        "w_gate": stack("blk.{}.ffn_gate.weight", t),
+        "w_up": stack("blk.{}.ffn_up.weight", t),
+        "w_down": stack("blk.{}.ffn_down.weight", t),
+    }
+    if cfg.attn_bias:
+        layers["wq_b"] = stack("blk.{}.attn_q.bias", w)
+        layers["wk_b"] = stack("blk.{}.attn_k.bias", w)
+        layers["wv_b"] = stack("blk.{}.attn_v.bias", w)
+    params: Dict[str, Any] = {
+        "embed": w("token_embd.weight"),
+        "layers": layers,
+        "final_norm": w("output_norm.weight"),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = t("output.weight")
+    return params
+
+
+# -- tokenizer ----------------------------------------------------------------
+
+from dynamo_tpu.llm.tokenizer import BaseTokenizer
+
+
+class GGUFTokenizer(BaseTokenizer):
+    """Tokenizer rebuilt from GGUF-embedded vocab (gguf_tokenizer.rs role).
+
+    Greedy longest-match over the vocab with SentencePiece conventions:
+    leading-space tokens use "▁", unknown bytes fall back to <0xXX> byte
+    tokens. Exact-id round trips for decode; encode is greedy (not
+    merge-rank BPE), which is id-compatible but can differ from llama.cpp
+    on adversarial strings.
+    """
+
+    SPACE = "▁"  # ▁
+
+    def __init__(self, g: GGUFFile):
+        md = g.metadata
+        self.tokens: List[str] = list(md.get("tokenizer.ggml.tokens", []))
+        if not self.tokens:
+            raise ValueError("gguf has no tokenizer.ggml.tokens")
+        bos = md.get("tokenizer.ggml.bos_token_id")
+        self.bos_token_id: Optional[int] = (
+            int(bos) if bos is not None else None)
+        eos = md.get("tokenizer.ggml.eos_token_id")
+        self.eos_token_ids = [int(eos)] if eos is not None else []
+        self._ids: Dict[str, int] = {}
+        for i, tok in enumerate(self.tokens):
+            self._ids.setdefault(tok, i)
+        self._byte_ids: Dict[int, int] = {}
+        for i, tok in enumerate(self.tokens):
+            if len(tok) == 6 and tok.startswith("<0x") and tok.endswith(">"):
+                self._byte_ids[int(tok[3:5], 16)] = i
+        self._max_len = max(len(t) for t in self.tokens)
+        unk = md.get("tokenizer.ggml.unknown_token_id")
+        self.unk_token_id = int(unk) if unk is not None else (
+            self._ids.get("<unk>", 0))
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.tokens)
+
+    def encode(self, text: str) -> List[int]:
+        s = text.replace(" ", self.SPACE)
+        if not s.startswith(self.SPACE):
+            s = self.SPACE + s  # SP adds a leading space marker
+        out: List[int] = []
+        i = 0
+        while i < len(s):
+            for ln in range(min(self._max_len, len(s) - i), 0, -1):
+                tid = self._ids.get(s[i:i + ln])
+                if tid is not None:
+                    out.append(tid)
+                    i += ln
+                    break
+            else:
+                # unmatched char: byte-fallback tokens, or unk — NEVER drop
+                # silently (the model would answer a different prompt)
+                encoded_any = False
+                for b in s[i].encode("utf-8"):
+                    bid = self._byte_ids.get(b)
+                    if bid is not None:
+                        out.append(bid)
+                        encoded_any = True
+                if not encoded_any:
+                    out.append(self.unk_token_id)
+                i += 1
+        return out
+
+    def decode(self, ids) -> str:
+        parts: List[str] = []
+        pending: List[int] = []
+
+        def flush():
+            if pending:
+                parts.append(bytes(pending).decode("utf-8",
+                                                   errors="replace"))
+                pending.clear()
+
+        byte_rev = {v: k for k, v in self._byte_ids.items()}
+        for tid in ids:
+            tid = int(tid)
+            if tid in byte_rev:
+                pending.append(byte_rev[tid])
+                continue
+            flush()
+            if 0 <= tid < len(self.tokens):
+                parts.append(self.tokens[tid])
+        flush()
+        # one global pass so space markers survive byte-fallback round
+        # trips too (a "▁" encoded as raw utf-8 bytes must still decode
+        # back to a space)
+        text = "".join(parts).replace(self.SPACE, " ")
+        return text[1:] if text.startswith(" ") else text
+
+
+def load_gguf(path: str, dtype: str = "") -> Tuple[Any, Dict[str, Any],
+                                                   GGUFTokenizer]:
+    """One-call GGUF sourcing: (ModelConfig, params, tokenizer)."""
+    g = GGUFFile(path)
+    cfg = config_from_gguf(g)
+    params = load_params_from_gguf(g, cfg, dtype=dtype)
+    tok = GGUFTokenizer(g)
+    return cfg, params, tok

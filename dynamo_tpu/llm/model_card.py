@@ -51,6 +51,13 @@ class ModelDeploymentCard:
         return cls(**d)
 
     def model_config(self) -> ModelConfig:
+        if self.tokenizer_kind == "gguf" and self.model_path:
+            from dynamo_tpu.llm.gguf import GGUFFile, config_from_gguf
+            g = GGUFFile(self.model_path)
+            try:
+                return config_from_gguf(g, name=self.name)
+            finally:
+                g.close()
         if self.hf_config is not None:
             from dynamo_tpu.models.loader import config_from_hf
             return config_from_hf(self.hf_config, name=self.name)
@@ -61,6 +68,10 @@ class ModelDeploymentCard:
         if self.tokenizer_kind == "hf":
             return HFTokenizer(self.tokenizer_path, self.eos_token_ids,
                                self.bos_token_id)
+        if self.tokenizer_kind == "gguf":
+            from dynamo_tpu.llm.gguf import GGUFFile, GGUFTokenizer
+            return GGUFTokenizer(GGUFFile(self.tokenizer_path
+                                          or self.model_path))
         return ByteTokenizer()
 
     @classmethod
@@ -99,3 +110,29 @@ class ModelDeploymentCard:
             hf_config=hf,
             model_path=path,
         )
+
+    @classmethod
+    def from_gguf(cls, path: str,
+                  name: Optional[str] = None) -> "ModelDeploymentCard":
+        """Build a card from a single GGUF file: config, tokenizer, and
+        chat template all come from the embedded metadata (reference:
+        ModelDeploymentCard::from_gguf, lib/llm/src/model_card/create.rs +
+        gguf.rs)."""
+        from dynamo_tpu.llm.gguf import GGUFFile, config_from_gguf
+        g = GGUFFile(path)
+        try:
+            cfg = config_from_gguf(g, name=name or "")
+            md = g.metadata
+            eos = md.get("tokenizer.ggml.eos_token_id")
+            return cls(
+                name=name or md.get("general.name",
+                                    os.path.basename(path)),
+                tokenizer_kind="gguf",
+                chat_template=md.get("tokenizer.chat_template"),
+                context_length=cfg.max_model_len,
+                eos_token_ids=[int(eos)] if eos is not None else [],
+                bos_token_id=md.get("tokenizer.ggml.bos_token_id"),
+                model_path=path,
+            )
+        finally:
+            g.close()

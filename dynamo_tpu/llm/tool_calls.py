@@ -1,0 +1,114 @@
+"""Tool-call response parsing: generated text -> OpenAI tool_calls.
+
+Role of the reference's tool response parser (reference:
+lib/llm/src/preprocessor/tools/response.rs): when a request carried `tools`,
+the model's output may BE a tool invocation rather than prose — emitted in
+one of several model-family dialects. This module detects and normalizes
+them into the OpenAI response shape
+`[{"id", "type": "function", "function": {"name", "arguments": <json str>}}]`.
+
+Dialects handled (same set the open ecosystem emits):
+- bare JSON object/array: `{"name": ..., "arguments"/"parameters": {...}}`
+- Hermes/Qwen tags:      `<tool_call>{...}</tool_call>` (repeatable)
+- Mistral:               `[TOOL_CALLS] [{...}, ...]`
+- fenced block:          ```json\n{...}\n``` wrapping any of the above
+
+Parsing is strict about shape (must produce a function name string) and
+returns None on anything else, so prose that merely mentions JSON never
+turns into a phantom tool call.
+"""
+from __future__ import annotations
+
+import json
+import re
+import uuid
+from typing import Any, Dict, List, Optional
+
+_TAG_RE = re.compile(r"<tool_call>\s*(.*?)\s*</tool_call>", re.DOTALL)
+_FENCE_RE = re.compile(r"```(?:json)?\s*(.*?)\s*```", re.DOTALL)
+_MISTRAL_PREFIX = "[TOOL_CALLS]"
+
+
+def _normalize_one(obj: Any) -> Optional[Dict[str, Any]]:
+    """{"name", "arguments"|"parameters"} (possibly under "function") ->
+    OpenAI tool-call dict, else None."""
+    if not isinstance(obj, dict):
+        return None
+    fn = obj.get("function") if isinstance(obj.get("function"), dict) else obj
+    name = fn.get("name")
+    if not isinstance(name, str) or not name:
+        return None
+    args = fn.get("arguments", fn.get("parameters", {}))
+    if isinstance(args, str):
+        try:
+            json.loads(args)
+        except json.JSONDecodeError:
+            return None
+        args_str = args
+    elif isinstance(args, dict):
+        args_str = json.dumps(args)
+    else:
+        return None
+    return {
+        "id": obj.get("id") or f"call_{uuid.uuid4().hex[:24]}",
+        "type": "function",
+        "function": {"name": name, "arguments": args_str},
+    }
+
+
+def _from_json_text(text: str) -> Optional[List[Dict[str, Any]]]:
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        return None
+    items = obj if isinstance(obj, list) else [obj]
+    calls = [_normalize_one(it) for it in items]
+    if calls and all(c is not None for c in calls):
+        return calls
+    return None
+
+
+def parse_tool_calls(text: str) -> Optional[List[Dict[str, Any]]]:
+    """Parse generated text into OpenAI tool_calls, or None if the text is
+    not a (pure) tool invocation."""
+    if not text:
+        return None
+    s = text.strip()
+
+    # Hermes/Qwen <tool_call> tags (one call per tag)
+    tags = _TAG_RE.findall(s)
+    if tags:
+        calls: List[Dict[str, Any]] = []
+        for body in tags:
+            got = _from_json_text(body)
+            if not got:
+                return None
+            calls.extend(got)
+        return calls or None
+
+    # Mistral [TOOL_CALLS] [...] prefix
+    if s.startswith(_MISTRAL_PREFIX):
+        return _from_json_text(s[len(_MISTRAL_PREFIX):].strip())
+
+    # fenced ```json block
+    fence = _FENCE_RE.fullmatch(s)
+    if fence:
+        return _from_json_text(fence.group(1))
+
+    # bare JSON
+    if s.startswith(("{", "[")):
+        return _from_json_text(s)
+    return None
+
+
+def apply_tool_calls(message, finish_reason: Optional[str]):
+    """If the message content parses as tool calls, rewrite it in place
+    (content -> None, tool_calls set) and return finish_reason
+    "tool_calls"; else return the original finish_reason."""
+    content = message.content if isinstance(message.content, str) else None
+    calls = parse_tool_calls(content or "")
+    if not calls:
+        return finish_reason
+    message.content = None
+    message.tool_calls = calls
+    return "tool_calls"

@@ -1,0 +1,203 @@
+"""Standalone metrics exporter: scrapes worker load metrics into Prometheus.
+
+Role-equivalent of the reference's `components/metrics` binary (reference:
+components/metrics/src/lib.rs:96-616 + main.rs): a separate process that
+watches a component's live instances, scrapes each worker's
+ForwardPassMetrics through the stats plane, folds them into
+ProcessedEndpoints, and serves Prometheus gauges (`llm_kv_blocks_*`,
+`llm_requests_*`, load avg/std) on GET /metrics. It also subscribes to the
+router's `kv-hit-rate` events (reference: KVHitRateEvent handling,
+lib.rs:433-512).
+
+Run: python -m dynamo_tpu.observability.exporter \
+        --coordinator 127.0.0.1:6230 --namespace ns --component worker \
+        --endpoint generate --port 9091
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from typing import Optional
+
+from dynamo_tpu.kv_router.publisher import (
+    KV_HIT_RATE_SUBJECT, KvMetricsAggregator,
+)
+from dynamo_tpu.observability.metrics import MetricsRegistry
+
+log = logging.getLogger("dynamo_tpu.metrics_exporter")
+
+PREFIX = "llm"
+
+
+class MetricsExporter:
+    """Aggregator + Prometheus endpoint for one component's worker fleet."""
+
+    def __init__(self, runtime, namespace: str, component: str,
+                 endpoint: str = "generate", port: int = 9091,
+                 scrape_interval_s: float = 0.5):
+        self.runtime = runtime
+        self.namespace, self.component_name = namespace, component
+        self.endpoint_name = endpoint
+        self.port = port
+        self._interval_s = scrape_interval_s
+        self.registry = MetricsRegistry()
+        labels = ("worker",)
+        r = self.registry
+        self.g_active_slots = r.gauge(
+            f"{PREFIX}_requests_active_slots",
+            "Decode slots currently generating", labels)
+        self.g_total_slots = r.gauge(
+            f"{PREFIX}_requests_total_slots", "Decode slot capacity", labels)
+        self.g_kv_active = r.gauge(
+            f"{PREFIX}_kv_blocks_active", "KV pages in use", labels)
+        self.g_kv_total = r.gauge(
+            f"{PREFIX}_kv_blocks_total", "KV page capacity", labels)
+        self.g_waiting = r.gauge(
+            f"{PREFIX}_requests_waiting", "Requests queued for prefill",
+            labels)
+        self.g_usage = r.gauge(
+            f"{PREFIX}_kv_cache_usage_percent",
+            "KV cache usage fraction [0,1]", labels)
+        self.g_hit_rate = r.gauge(
+            f"{PREFIX}_prefix_cache_hit_rate",
+            "Worker-reported prefix cache hit rate", labels)
+        self.g_load_avg = r.gauge(
+            f"{PREFIX}_load_avg", "Mean active KV blocks across workers")
+        self.g_load_std = r.gauge(
+            f"{PREFIX}_load_std", "Stddev of active KV blocks across workers")
+        self.g_workers = r.gauge(
+            f"{PREFIX}_workers", "Live worker instances")
+        self.g_router_hit = r.gauge(
+            f"{PREFIX}_router_kv_hit_rate",
+            "ISL-weighted router overlap rate (kv-hit-rate events)")
+        self._client = None
+        self._aggregator: Optional[KvMetricsAggregator] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._sub_task: Optional[asyncio.Task] = None
+        # cumulative KVHitRateEvent totals (reference lib.rs:433-512)
+        self._hit_isl = 0
+        self._hit_overlap = 0
+
+    async def start(self) -> "MetricsExporter":
+        ep = self.runtime.namespace(self.namespace).component(
+            self.component_name).endpoint(self.endpoint_name)
+        self._client = ep.client()
+        await self._client.start()
+        self._aggregator = KvMetricsAggregator(
+            self._client, interval_s=self._interval_s)
+        self._aggregator.on_update(self._on_update)
+        await self._aggregator.start()
+        # the router publishes kv-hit-rate on ITS component subject
+        # ({ns}.{router_component}.kv-hit-rate); subscribe with a namespace
+        # wildcard and filter, so the exporter needn't know the router name
+        raw = await self.runtime.messaging.subscribe(f"{self.namespace}.>")
+        self._sub_task = asyncio.create_task(self._consume_hit_rate(raw))
+        self._server = await asyncio.start_server(
+            self._serve_http, "0.0.0.0", self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._aggregator:
+            await self._aggregator.stop()
+        if self._sub_task:
+            self._sub_task.cancel()
+        if self._client is not None:
+            await self._client.stop()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- aggregation ----------------------------------------------------------
+
+    def _on_update(self, endpoints, removed) -> None:
+        for worker_id in removed:
+            for g in (self.g_active_slots, self.g_total_slots,
+                      self.g_kv_active, self.g_kv_total, self.g_waiting,
+                      self.g_usage, self.g_hit_rate):
+                g.remove(worker_id)
+        for worker_id, m in endpoints.workers.items():
+            self.g_active_slots.set(worker_id, value=m.request_active_slots)
+            self.g_total_slots.set(worker_id, value=m.request_total_slots)
+            self.g_kv_active.set(worker_id, value=m.kv_active_blocks)
+            self.g_kv_total.set(worker_id, value=m.kv_total_blocks)
+            self.g_waiting.set(worker_id, value=m.num_requests_waiting)
+            self.g_usage.set(worker_id, value=m.gpu_cache_usage_perc)
+            self.g_hit_rate.set(worker_id,
+                                value=m.gpu_prefix_cache_hit_rate)
+        self.g_load_avg.set(value=endpoints.load_avg)
+        self.g_load_std.set(value=endpoints.load_std)
+        self.g_workers.set(value=len(endpoints.workers))
+
+    async def _consume_hit_rate(self, sub) -> None:
+        import msgpack
+        try:
+            async for subject, payload in sub:
+                if not subject.endswith("." + KV_HIT_RATE_SUBJECT):
+                    continue
+                payload = msgpack.unpackb(payload, raw=False)
+                isl = int(payload.get("isl_blocks", 0))
+                overlap = int(payload.get("overlap_blocks", 0))
+                self._hit_isl += isl
+                self._hit_overlap += overlap
+                if self._hit_isl:
+                    self.g_router_hit.set(
+                        value=self._hit_overlap / self._hit_isl)
+        except asyncio.CancelledError:
+            pass
+
+    # -- http -----------------------------------------------------------------
+
+    async def _serve_http(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        try:
+            line = await reader.readline()
+            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                pass  # drain headers
+            if b"/metrics" in line:
+                body = self.registry.render().encode()
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\ncontent-type: text/plain; "
+                    b"version=0.0.4\r\ncontent-length: %d\r\n\r\n%s"
+                    % (len(body), body))
+            else:
+                writer.write(b"HTTP/1.1 404 Not Found\r\n"
+                             b"content-length: 0\r\n\r\n")
+            await writer.drain()
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+
+async def _amain(args) -> None:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    host, port = args.coordinator.rsplit(":", 1)
+    runtime = await DistributedRuntime.connect(host, int(port),
+                                               "metrics-exporter")
+    exporter = MetricsExporter(
+        runtime, args.namespace, args.component, endpoint=args.endpoint,
+        port=args.port, scrape_interval_s=args.interval)
+    await exporter.start()
+    log.info("metrics exporter on :%d scraping %s/%s/%s", exporter.port,
+             args.namespace, args.component, args.endpoint)
+    print(f"READY metrics=:{exporter.port}", flush=True)
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dynamo-tpu metrics exporter")
+    ap.add_argument("--coordinator", default="127.0.0.1:6230")
+    ap.add_argument("--namespace", required=True)
+    ap.add_argument("--component", required=True)
+    ap.add_argument("--endpoint", default="generate")
+    ap.add_argument("--port", type=int, default=9091)
+    ap.add_argument("--interval", type=float, default=0.5)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,12 @@ class _Metric:
             raise ValueError(
                 f"{self.name}: expected labels {self.label_names}, got {labels}")
 
+    def remove(self, *labels: str) -> None:
+        """Drop one label series (e.g. a departed worker instance)."""
+        self._check(labels)
+        with self._lock:
+            self._values.pop(labels, None)
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} {self.kind}"]

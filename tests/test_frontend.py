@@ -92,6 +92,105 @@ class TestHttpService:
 
         run(main())
 
+    def test_tools_request_parses_tool_call_response(self):
+        """A tools-carrying chat request whose generated text is a tool
+        invocation comes back as OpenAI tool_calls with finish_reason
+        'tool_calls' (reference: preprocessor/tools/response.rs)."""
+        class ToolEngine(CounterEngine):
+            async def generate_chat(self, request, context):
+                gen_id, created = new_response_id("chatcmpl"), now()
+                text = '{"name": "get_weather", "arguments": {"c": "Oslo"}}'
+                yield ChatCompletionChunk(
+                    id=gen_id, created=created, model=request.model,
+                    choices=[ChatStreamChoice(
+                        index=0,
+                        delta={"role": "assistant", "content": text})])
+                yield ChatCompletionChunk(
+                    id=gen_id, created=created, model=request.model,
+                    choices=[ChatStreamChoice(index=0, delta={},
+                                              finish_reason="stop")])
+
+        async def main():
+            svc = await HttpService("127.0.0.1", 0).start()
+            svc.models.add("m", ToolEngine())
+            body = {**CHAT_BODY,
+                    "tools": [{"type": "function",
+                               "function": {"name": "get_weather"}}]}
+            status, raw = await request(
+                "127.0.0.1", svc.port, "POST", "/v1/chat/completions", body)
+            assert status == 200
+            choice = json.loads(raw)["choices"][0]
+            assert choice["finish_reason"] == "tool_calls"
+            tc = choice["message"]["tool_calls"][0]
+            assert tc["function"]["name"] == "get_weather"
+            assert json.loads(tc["function"]["arguments"]) == {"c": "Oslo"}
+            assert "content" not in choice["message"]
+
+            # WITHOUT tools, the same text stays plain content
+            status2, raw2 = await request(
+                "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+                CHAT_BODY)
+            choice2 = json.loads(raw2)["choices"][0]
+            assert choice2["finish_reason"] == "stop"
+            assert choice2["message"]["content"].startswith('{"name"')
+            await svc.stop()
+
+        run(main())
+
+    def test_tools_streaming_emits_tool_call_deltas(self):
+        """stream=true with tools must behave like unary: the buffered
+        stream resolves into delta.tool_calls + finish 'tool_calls', and
+        plain prose replays as normal content deltas."""
+        class ToolEngine(CounterEngine):
+            def __init__(self, text):
+                super().__init__()
+                self.text = text
+
+            async def generate_chat(self, request, context):
+                gen_id, created = new_response_id("chatcmpl"), now()
+                for piece in (self.text[:8], self.text[8:]):
+                    yield ChatCompletionChunk(
+                        id=gen_id, created=created, model=request.model,
+                        choices=[ChatStreamChoice(
+                            index=0,
+                            delta={"role": "assistant", "content": piece})])
+                yield ChatCompletionChunk(
+                    id=gen_id, created=created, model=request.model,
+                    choices=[ChatStreamChoice(index=0, delta={},
+                                              finish_reason="stop")])
+
+        async def main():
+            svc = await HttpService("127.0.0.1", 0).start()
+            svc.models.add(
+                "m", ToolEngine('{"name": "f", "arguments": {"x": 1}}'))
+            svc.models.add("p", ToolEngine("just some prose here"))
+            body = {**CHAT_BODY, "stream": True,
+                    "tools": [{"type": "function",
+                               "function": {"name": "f"}}]}
+            datas = [json.loads(d) async for ev, d in sse_events(
+                "127.0.0.1", svc.port, "/v1/chat/completions", body)
+                if d != "[DONE]"]
+            deltas = [c["choices"][0] for c in datas if c["choices"]]
+            tool_delta = next(d for d in deltas
+                              if d["delta"].get("tool_calls"))
+            assert tool_delta["delta"]["tool_calls"][0]["function"][
+                "name"] == "f"
+            assert deltas[-1]["finish_reason"] == "tool_calls"
+            assert not any(d["delta"].get("content") for d in deltas)
+
+            # prose through the same buffered path replays as content
+            body2 = {**body, "model": "p"}
+            datas2 = [json.loads(d) async for ev, d in sse_events(
+                "127.0.0.1", svc.port, "/v1/chat/completions", body2)
+                if d != "[DONE]"]
+            text = "".join(
+                c["choices"][0]["delta"].get("content") or ""
+                for c in datas2 if c["choices"])
+            assert text == "just some prose here"
+            await svc.stop()
+
+        run(main())
+
     def test_streaming_sse_with_done(self):
         async def main():
             svc = await HttpService("127.0.0.1", 0).start()

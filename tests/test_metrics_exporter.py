@@ -1,0 +1,78 @@
+"""Standalone metrics exporter tests (VERDICT r2 next #9).
+
+The 'Done' bar: the exporter serves llm_kv_blocks_* for a 2-worker graph.
+Reference: components/metrics binary, components/metrics/src/lib.rs:96-616.
+"""
+import asyncio
+
+from dynamo_tpu.kv_router.publisher import KV_HIT_RATE_SUBJECT
+from dynamo_tpu.observability.exporter import MetricsExporter
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+
+async def fake_engine(request, context):
+    yield {"ok": True}
+
+
+def test_exporter_two_worker_graph():
+    async def main():
+        plane = MemoryPlane()
+        rts = []
+        for i, (active, total) in enumerate(((3, 16), (5, 16))):
+            rt = await DistributedRuntime.create_local(plane, f"w{i}")
+            ep = rt.namespace("ns").component("worker").endpoint("generate")
+            await ep.serve(
+                fake_engine,
+                stats_handler=lambda a=active, t=total: {
+                    "request_active_slots": 1, "request_total_slots": 4,
+                    "kv_active_blocks": a, "kv_total_blocks": t,
+                    "num_requests_waiting": 0,
+                    "gpu_cache_usage_perc": a / t,
+                    "gpu_prefix_cache_hit_rate": 0.5})
+            rts.append(rt)
+
+        ert = await DistributedRuntime.create_local(plane, "exporter")
+        exporter = MetricsExporter(ert, "ns", "worker", port=0,
+                                   scrape_interval_s=0.05)
+        await exporter.start()
+        try:
+            # router hit-rate event rides the component event plane
+            await rts[0].namespace("ns").component("router").publish(
+                KV_HIT_RATE_SUBJECT,
+                {"worker_id": "w0", "isl_blocks": 8, "overlap_blocks": 6})
+            await asyncio.sleep(0.3)  # a few scrape cycles
+
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", exporter.port)
+            writer.write(b"GET /metrics HTTP/1.1\r\nhost: x\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read(65536)
+            writer.close()
+            body = raw.decode()
+            assert "200 OK" in body
+            assert 'llm_kv_blocks_active{worker="w0"} 3' in body
+            assert 'llm_kv_blocks_active{worker="w1"} 5' in body
+            assert 'llm_kv_blocks_total{worker="w0"} 16' in body
+            assert "llm_workers 2" in body
+            assert "llm_load_avg 4" in body
+            assert "llm_router_kv_hit_rate 0.75" in body
+
+            # a worker going away drops its series
+            await rts[1].shutdown()
+            await asyncio.sleep(0.3)
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", exporter.port)
+            writer.write(b"GET /metrics HTTP/1.1\r\n\r\n")
+            await writer.drain()
+            body2 = (await reader.read(65536)).decode()
+            writer.close()
+            assert 'llm_kv_blocks_active{worker="w1"}' not in body2
+            assert "llm_workers 1" in body2
+        finally:
+            await exporter.stop()
+            for rt in rts:
+                await rt.shutdown()
+            await ert.shutdown()
+
+    asyncio.run(main())

@@ -1,0 +1,69 @@
+"""Tool-call response parsing tests (reference: preprocessor/tools/response.rs)."""
+import json
+
+from dynamo_tpu.llm.tool_calls import parse_tool_calls
+from dynamo_tpu.protocols.openai import ChatMessage
+from dynamo_tpu.llm.tool_calls import apply_tool_calls
+
+
+def test_bare_json_object():
+    calls = parse_tool_calls(
+        '{"name": "get_weather", "arguments": {"city": "Oslo"}}')
+    assert len(calls) == 1
+    c = calls[0]
+    assert c["type"] == "function"
+    assert c["function"]["name"] == "get_weather"
+    assert json.loads(c["function"]["arguments"]) == {"city": "Oslo"}
+    assert c["id"].startswith("call_")
+
+
+def test_bare_json_array_and_parameters_alias():
+    calls = parse_tool_calls(
+        '[{"name": "a", "parameters": {"x": 1}},'
+        ' {"function": {"name": "b", "arguments": "{\\"y\\": 2}"}}]')
+    assert [c["function"]["name"] for c in calls] == ["a", "b"]
+    assert json.loads(calls[1]["function"]["arguments"]) == {"y": 2}
+
+
+def test_hermes_qwen_tags():
+    text = ('<tool_call>\n{"name": "search", "arguments": {"q": "tpu"}}\n'
+            '</tool_call><tool_call>{"name": "open", "arguments": {}}'
+            '</tool_call>')
+    calls = parse_tool_calls(text)
+    assert [c["function"]["name"] for c in calls] == ["search", "open"]
+
+
+def test_mistral_prefix_and_fence():
+    calls = parse_tool_calls(
+        '[TOOL_CALLS] [{"name": "f", "arguments": {"a": true}}]')
+    assert calls[0]["function"]["name"] == "f"
+    calls2 = parse_tool_calls(
+        '```json\n{"name": "g", "arguments": {}}\n```')
+    assert calls2[0]["function"]["name"] == "g"
+
+
+def test_prose_and_malformed_rejected():
+    assert parse_tool_calls("The weather in Oslo is sunny.") is None
+    assert parse_tool_calls('{"no_name": true}') is None
+    assert parse_tool_calls('{"name": "", "arguments": {}}') is None
+    assert parse_tool_calls('{"name": "f", "arguments": "not json"}') is None
+    assert parse_tool_calls('Sure! {"name": "f", "arguments": {}}') is None
+    assert parse_tool_calls("") is None
+    # one bad tag poisons the whole parse (no partial tool calls)
+    assert parse_tool_calls(
+        '<tool_call>{"name": "ok", "arguments": {}}</tool_call>'
+        '<tool_call>oops</tool_call>') is None
+
+
+def test_apply_tool_calls_rewrites_message():
+    m = ChatMessage(role="assistant",
+                    content='{"name": "f", "arguments": {"k": 1}}')
+    reason = apply_tool_calls(m, "stop")
+    assert reason == "tool_calls"
+    assert m.content is None
+    assert m.tool_calls[0]["function"]["name"] == "f"
+
+    m2 = ChatMessage(role="assistant", content="plain prose")
+    assert apply_tool_calls(m2, "stop") == "stop"
+    assert m2.content == "plain prose"
+    assert m2.tool_calls is None
