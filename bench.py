@@ -141,7 +141,7 @@ def main():
     slots = 8
     decode_steps = int(os.environ.get("BENCH_DECODE_STEPS", "32"))
     cfg = EngineConfig(
-        page_size=64, num_pages=256, max_slots=slots, max_prefill_chunk=512,
+        page_size=64, num_pages=256, max_slots=slots, max_prefill_chunk=128,
         prefill_buckets=(128,), max_model_len=2048,
         decode_steps=decode_steps, max_prefill_batch=8)
     RESULT["extras"].update(kernel=kernel, decode_steps=decode_steps,
@@ -235,11 +235,14 @@ def main():
     # reference's 1-node +30% claim, docs/architecture.md:57-61). The
     # pure-decode number from phase 5 (all slots busy, no arrivals) is what
     # a dedicated decode engine achieves; the ratio is the measured
-    # one-chip upper bound for disagg gain at this workload shape.
+    # one-chip upper bound for disagg gain at this workload shape. Prompts
+    # are 8x the decode length (512:64) to approximate the reference's
+    # long-ISL/short-OSL benchmark shape (3K ISL / 150 OSL).
     for rid in list(engine.scheduler.params):
         engine.abort(rid)
     while engine.has_work():
         engine.step()
+    churn_isl = 4 * prompt_len  # 512
     churn_params = SamplingParams(max_tokens=64, temperature=0.0,
                                   ignore_eos=True)
     next_id = 0
@@ -249,7 +252,7 @@ def main():
         salt = 977 * (next_id + 1)
         engine.add_request(EngineRequest(
             f"churn-{next_id}",
-            [(salt + 3 * j) % 1000 + 1 for j in range(prompt_len)],
+            [(salt + 3 * j) % 1000 + 1 for j in range(churn_isl)],
             churn_params))
         next_id += 1
 

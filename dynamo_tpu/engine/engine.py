@@ -807,23 +807,40 @@ def _engine_decode_window(cfg: ModelConfig, eos_ids: tuple, kernel_mesh,
     else:
         eos_vec = None
 
-    def body(carry, _):
-        cache, tok, pos, ctr, seen, alive = carry
-        # alive tracks device-detectable finishes (eos sampled, max_tokens
-        # via max_pos) so post-finish garbage steps neither write KV nor
-        # pollute MoE capacity/drop accounting (code-review r3 finding);
-        # hidden stop_token_ids finish host-side only — their tail tokens
-        # still count, a bounded and rare skew.
-        writable = (pos <= max_pos) & alive
-        prefix = jnp.clip(pos, 0, max_pos + 1)
-        logits, k_news, v_news, aux = llama.decode_forward(
-            params, cfg, tok, cache, page_table, prefix, pos,
-            valid=writable, mesh=kernel_mesh, with_aux=True)
+    # Gather every slot's pages ONCE for the whole window (rows ordered by
+    # page-table position, so flat kv index == absolute position) and carry
+    # the [L, Hkv, S, Lk, hd] buffers through the step scan: attention then
+    # reads them directly. Per-step traffic drops from gather(read+write) +
+    # attention(read) to attention(read) — measured ~2.5 ms/step of page
+    # gather on the 1B flagship at batch 8. Each finished step scatters its
+    # rows into the carried buffer (next steps attend to them); the global
+    # paged cache is written ONCE at window end.
+    l, hkv_n, n_pages, ps, hd = cache["k"].shape
+    pb = page_table.shape[1]
+    lk = pb * page_size
+    # the Pallas-kernel decode path streams pages from the global cache
+    # itself — it keeps the original carry-the-cache window (per-step
+    # scatter); the pregathered fast path applies to the XLA gather mode
+    pregather = llama._decode_kernel_mode(cfg) is None
+
+    def gather_window(c):
+        g = jnp.take(c, page_table.reshape(-1), axis=2)
+        return g.reshape(l, hkv_n, s, pb, page_size, hd).reshape(
+            l, hkv_n, s, lk, hd)
+
+    if pregather:
+        kg0 = gather_window(cache["k"])
+        vg0 = gather_window(cache["v"])
+
+    def global_write_idx(pos, writable):
+        """Flat global-cache slot for this step's row (-1 = dropped)."""
         page = page_table[rows, jnp.maximum(
             jnp.minimum(pos, max_pos), 0) // page_size]
-        write_idx = jnp.where(writable, page * page_size + pos % page_size,
-                              -1)
-        cache = _scatter_new_kv(cache, k_news, v_news, write_idx)
+        return jnp.where(writable, page * page_size + pos % page_size, -1)
+
+    def sample_and_track(logits, ctr, seen, alive):
+        """Shared step tail: sampling + rep-penalty seen set + eos alive.
+        One definition so the kernel and pregather bodies can't diverge."""
         nxt, lp, top_ids, top_lps = _sample_logits(
             logits, eos_ids, temperature, top_k, top_p, seeds, ctr,
             min_tokens, seen=seen if with_rp else None,
@@ -833,16 +850,74 @@ def _engine_decode_window(cfg: ModelConfig, eos_ids: tuple, kernel_mesh,
             seen = seen.at[rows, nxt].set(True)
         if eos_vec is not None:
             alive = alive & (ignore_eos | ~eos_vec[nxt])
-        return (cache, nxt, pos + 1, ctr + 1, seen, alive), \
+        return nxt, lp, top_ids, top_lps, seen, alive
+
+    # alive (both bodies) tracks device-detectable finishes (eos sampled,
+    # max_tokens via max_pos) so post-finish garbage steps neither write KV
+    # nor pollute MoE capacity/drop accounting; hidden stop_token_ids
+    # finish host-side only — their tail tokens still count, a bounded and
+    # rare skew.
+    def body_kernel(carry, _):
+        """Kernel-mode window body: cache carried, scattered every step."""
+        cache_c, tok, pos, ctr, seen, alive = carry
+        writable = (pos <= max_pos) & alive
+        prefix = jnp.clip(pos, 0, max_pos + 1)
+        logits, k_news, v_news, aux = llama.decode_forward(
+            params, cfg, tok, cache_c, page_table, prefix, pos,
+            valid=writable, mesh=kernel_mesh, with_aux=True)
+        cache_c = _scatter_new_kv(cache_c, k_news, v_news,
+                                  global_write_idx(pos, writable))
+        nxt, lp, top_ids, top_lps, seen, alive = sample_and_track(
+            logits, ctr, seen, alive)
+        return (cache_c, nxt, pos + 1, ctr + 1, seen, alive), \
             (nxt, lp, top_ids, top_lps, aux)
 
+    def body(carry, _):
+        kg, vg, tok, pos, ctr, seen, alive = carry
+        writable = (pos <= max_pos) & alive
+        prefix = jnp.clip(pos, 0, max_pos + 1)
+        logits, k_news, v_news, aux = llama.decode_forward(
+            params, cfg, tok, cache, page_table, prefix, pos,
+            valid=writable, mesh=kernel_mesh, with_aux=True,
+            gathered=(kg, vg))
+        # scatter this step's rows into the carried window buffer (flat
+        # index == position; invalid rows get an out-of-range index and
+        # are dropped) and record the global-cache slot for the end-of-
+        # window writeback
+        buf_idx = jnp.where(writable, pos, lk)
+        kg = kg.at[:, :, rows, buf_idx].set(
+            k_news.transpose(0, 2, 1, 3).astype(kg.dtype), mode="drop")
+        vg = vg.at[:, :, rows, buf_idx].set(
+            v_news.transpose(0, 2, 1, 3).astype(vg.dtype), mode="drop")
+        nxt, lp, top_ids, top_lps, seen, alive = sample_and_track(
+            logits, ctr, seen, alive)
+        return (kg, vg, nxt, pos + 1, ctr + 1, seen, alive), \
+            (nxt, lp, top_ids, top_lps, aux, k_news, v_news,
+             global_write_idx(pos, writable))
+
     alive0 = max_pos >= 0
-    (cache, tok_f, pos_f, ctr_f, *_), \
-        (toks, lps, top_ids, top_lps, auxs) = \
+    if not pregather:
+        (cache, tok_f, pos_f, ctr_f, *_), \
+            (toks, lps, top_ids, top_lps, auxs) = \
+            jax.lax.scan(body_kernel,
+                         (cache, tokens, positions, counters, seen0,
+                          alive0), None, length=n_steps)
+        aux = {k: jnp.sum(v) for k, v in auxs.items()}
+        return (toks, lps, top_ids, top_lps, cache, aux,
+                (tok_f, pos_f, ctr_f))
+    (kg, vg, tok_f, pos_f, ctr_f, *_), \
+        (toks, lps, top_ids, top_lps, auxs, k_all, v_all, widx_all) = \
         jax.lax.scan(body,
-                     (cache, tokens, positions, counters, seen0, alive0),
+                     (kg0, vg0, tokens, positions, counters, seen0, alive0),
                      None, length=n_steps)
     aux = {k: jnp.sum(v) for k, v in auxs.items()}
+    # end-of-window writeback: all N steps' rows -> global paged cache in
+    # one scatter ([N, L, S, Hkv, hd] -> [L, N*S, Hkv, hd])
+    k_flat = k_all.transpose(1, 0, 2, 3, 4).reshape(l, n_steps * s,
+                                                    cfg.num_kv_heads, hd)
+    v_flat = v_all.transpose(1, 0, 2, 3, 4).reshape(l, n_steps * s,
+                                                    cfg.num_kv_heads, hd)
+    cache = _scatter_new_kv(cache, k_flat, v_flat, widx_all.reshape(-1))
     # final (token, position, counter) stay ON DEVICE: when the slot set and
     # page allocation are unchanged, the engine feeds them straight into the
     # next window — zero plan uploads per steady-state window (each host->

@@ -307,3 +307,8 @@ def _resolve_held_chunks(held):
             finish_reason=("tool_calls" if choice.index in calls_by_index
                            else choice.finish_reason))
             for choice in agg.choices])
+    # trailing usage-only chunks (stream_options.include_usage) must
+    # survive the rewrite
+    for c in held:
+        if c.usage is not None and not c.choices:
+            yield c

@@ -67,14 +67,12 @@ class RouterService:
             return
         overlap = self.router.find_matches_for_tokens(tokens)
         try:
-            worker_id = self.router.scheduler.schedule(len(tokens), overlap)
+            # KvRouter.schedule also drains + publishes kv-hit-rate events
+            # (publish_hit_events=True) — one implementation of that loop
+            worker_id = await self.router.schedule(tokens)
         except Exception as e:  # no live workers etc.
             yield {"error": f"{type(e).__name__}: {e}"}
             return
-        for ev in self.router.scheduler.drain_hit_events():
-            await self._worker_comp.publish("kv-hit-rate", {
-                "worker_id": ev.worker_id, "isl_blocks": ev.isl_blocks,
-                "overlap_blocks": ev.overlap_blocks})
         best = max(overlap.scores.values(), default=0)
         yield {"worker_id": worker_id,
                "overlap_blocks": int(overlap.scores.get(worker_id, 0)),

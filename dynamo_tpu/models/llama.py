@@ -25,7 +25,8 @@ from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.ops.attention import (
-    decode_attention_deferred, paged_attention, write_kv_pages,
+    decode_attention_deferred, decode_attention_pregathered, paged_attention,
+    write_kv_pages,
 )
 from dynamo_tpu.ops.moe import moe_dispatch_mlp, moe_dispatch_mlp_sharded
 from dynamo_tpu.ops.paged_attention import (
@@ -258,6 +259,7 @@ def decode_forward(
     valid: Optional[jax.Array] = None,  # [B] bool, real (non-pad) slots
     mesh=None,
     with_aux: bool = False,
+    gathered: Optional[tuple] = None,  # ([L,Hkv,B,Lk,hd] k, v): window buf
 ) -> tuple:
     """Deferred-write decode step: the KV cache is READ-ONLY.
 
@@ -269,6 +271,14 @@ def decode_forward(
     attention instead adds the current token via an explicit self-term
     (ops/attention.decode_attention_deferred, ops/paged_attention.
     combine_self_attention), which is exact because decode is causal.
+
+    `gathered`: window-decode fast path — the caller pre-gathered every
+    slot's pages ONCE for the whole decode window (flat index == position
+    because rows are page-table-ordered) and scatters each step's new kv
+    rows into the carried buffer AFTER this call returns. Attention reads
+    the buffer for positions < prefix_lens (same exclusive semantics as
+    the other paths) and the current token still contributes via the
+    self-term. Kills the per-step page gather (~2.5 ms/step, 1B @ b8).
     """
     b = tokens.shape[0]
     h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -279,7 +289,10 @@ def decode_forward(
     token_valid = valid[:, None] if (moe_aux and valid is not None) else None
 
     def layer_step(x, xs):
-        lp, lid = xs
+        if gathered is not None:
+            lp, lid, kg, vg = xs
+        else:
+            lp, lid = xs
         xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q = jnp.einsum("btd,de->bte", xn, lp["wq"])
         k = jnp.einsum("btd,de->bte", xn, lp["wk"])
@@ -292,7 +305,10 @@ def decode_forward(
                        cfg.rope_theta)
         v = v.reshape(b, 1, hkv, hd)
         k_new, v_new = k[:, 0], v[:, 0]                  # [B, Hkv, hd]
-        if kernel_mode is not None:
+        if gathered is not None:
+            attn = decode_attention_pregathered(
+                q[:, 0], kg, vg, k_new, v_new, prefix_lens)
+        elif kernel_mode is not None:
             interp = kernel_mode == "interpret"
             if mesh is not None and mesh.size > 1:
                 acc, m, l = decode_paged_attention_prefix_sharded(
@@ -328,7 +344,11 @@ def decode_forward(
         ys = (k_new, v_new, drop_stats) if moe_aux else (k_new, v_new)
         return x, ys
 
-    x, ys = jax.lax.scan(layer_step, x, (params["layers"], layer_ids))
+    if gathered is not None:
+        xs = (params["layers"], layer_ids, gathered[0], gathered[1])
+    else:
+        xs = (params["layers"], layer_ids)
+    x, ys = jax.lax.scan(layer_step, x, xs)
     if moe_aux:
         k_news, v_news, drops = ys
         aux = {"moe_dropped": jnp.sum(drops[0]),

@@ -152,8 +152,12 @@ class MetricsExporter:
     async def _serve_http(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
         try:
-            line = await reader.readline()
-            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+            # bounded reads: an idle probe connection must not pin the
+            # handler open (3.12 Server.wait_closed waits for ALL
+            # connections, so it would hang stop())
+            line = await asyncio.wait_for(reader.readline(), 5.0)
+            while (await asyncio.wait_for(reader.readline(), 5.0)) \
+                    not in (b"\r\n", b"\n", b""):
                 pass  # drain headers
             if b"/metrics" in line:
                 body = self.registry.render().encode()
@@ -165,7 +169,8 @@ class MetricsExporter:
                 writer.write(b"HTTP/1.1 404 Not Found\r\n"
                              b"content-length: 0\r\n\r\n")
             await writer.drain()
-        except (ConnectionResetError, asyncio.IncompleteReadError):
+        except (ConnectionResetError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError):
             pass
         finally:
             writer.close()

@@ -66,6 +66,50 @@ def paged_attention(
     return out.reshape(b, tq, h, hd).astype(q.dtype)
 
 
+def decode_attention_pregathered(
+    q: jax.Array,            # [B, H, hd] — one query token per sequence
+    k: jax.Array,            # [Hkv, B, Lk, hd] — window-gathered KV
+    v: jax.Array,
+    k_new: jax.Array,        # [B, Hkv, hd] — this step's kv (self-term)
+    v_new: jax.Array,
+    prefix_lens: jax.Array,  # [B] int32 — valid kv BEFORE this token
+) -> jax.Array:
+    """Decode attention over a window-carried pre-gathered KV buffer.
+
+    Same math as decode_attention_deferred minus the page gather: the
+    window decode loop gathers each slot's pages from the paged cache
+    ONCE per window and scatters each finished step's kv rows into the
+    carried buffer between steps (rows are ordered by page-table
+    position, so flat kv index == absolute position). The per-step page
+    gather — measured ~2.5 ms/step on the 1B flagship at batch 8 — is
+    gone; the current token still contributes via the self-term.
+    Returns [B, H, hd].
+    """
+    b, h, hd = q.shape
+    hkv = k.shape[0]
+    g = h // hkv
+    lk = k.shape[2]
+    qg = q.reshape(b, hkv, g, hd)
+    scores = jnp.einsum(
+        "bkgd,kbsd->bkgs", qg, k,
+        preferred_element_type=jnp.float32) * (hd ** -0.5)
+    kv_pos = jnp.arange(lk, dtype=jnp.int32)[None, :]
+    valid = kv_pos < prefix_lens[:, None]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    s_self = jnp.einsum(
+        "bkgd,bkd->bkg", qg, k_new,
+        preferred_element_type=jnp.float32) * (hd ** -0.5)
+    m = jnp.maximum(jnp.max(scores, axis=-1), s_self)
+    p = jnp.exp(scores - m[..., None])
+    p_self = jnp.exp(s_self - m)
+    denom = jnp.sum(p, axis=-1) + p_self
+    out = jnp.einsum("bkgs,kbsd->bkgd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    out = out + p_self[..., None] * v_new.astype(jnp.float32)[:, :, None, :]
+    out = out / denom[..., None]
+    return out.reshape(b, h, hd).astype(q.dtype)
+
+
 def decode_attention_deferred(
     q: jax.Array,            # [B, H, hd] — one query token per sequence
     k_cache: jax.Array,      # [Hkv, P, ps, hd]
