@@ -207,15 +207,20 @@ class NativeEngine:
             for rp in (False, True) for lp in (False, True)
             for mm in (False, True)
         }
+        # one variant per (rp, lp, greedy, window rung): the 3-rung ladder
+        # (full / quarter / 1) bounds the compiled-program set while the
+        # scheduler's adaptive choice keeps request tails off the big
+        # window (scheduler.window_ladder)
+        from dynamo_tpu.engine.scheduler import window_ladder
+        self._window_sizes = window_ladder(engine_cfg.decode_steps)
         self._decode_fns = {
-            (rp, lp, greedy): jax.jit(
+            (rp, lp, greedy, nw): jax.jit(
                 functools.partial(_engine_decode_window, model_cfg,
-                                  eos_tuple, kernel_mesh,
-                                  max(1, engine_cfg.decode_steps),
+                                  eos_tuple, kernel_mesh, nw,
                                   engine_cfg.page_size, rp, lp, greedy),
                 donate_argnums=(1,))
             for rp in (False, True) for lp in (False, True)
-            for greedy in (False, True)
+            for greedy in (False, True) for nw in self._window_sizes
         }
         # disaggregation: whole-page gather/scatter on the
         # [L, Hkv, P, ps, hd] cache (the TPU equivalent of the reference's
@@ -488,7 +493,9 @@ class NativeEngine:
                 min_toks_d, ign_d)
         if rp is not None:
             args += (jnp.asarray(rp[0]), jnp.asarray(rp[1]))
-        out = self._decode_fns[(rp is not None, with_lp, greedy)](*args)
+        nw = next((w for w in reversed(self._window_sizes)
+                   if w >= max(1, plan.n_window)), self._window_sizes[0])
+        out = self._decode_fns[(rp is not None, with_lp, greedy, nw)](*args)
         toks, lps, top_ids, top_lps, self.cache, aux, nxt = out
         self._dec_state = {"sig": sig, "dev": dev, "next": nxt}
         toks, lps, top_ids, top_lps, aux = jax.device_get(

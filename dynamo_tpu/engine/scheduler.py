@@ -130,6 +130,9 @@ class DecodePlan:
     # attention beyond it, so a sequence that exhausts max_tokens mid-window
     # can neither clobber sealed prefix pages nor read past its page table.
     max_pos: np.ndarray = None  # [S]
+    # adaptive window length chosen by the scheduler (pow2 <= decode_steps,
+    # clamped to the smallest remaining token budget across active slots)
+    n_window: int = 1
 
 
 @dataclasses.dataclass
@@ -145,6 +148,18 @@ class EngineMetrics:
     num_requests_waiting: int = 0
     gpu_cache_usage_perc: float = 0.0        # name kept for wire parity; HBM here
     gpu_prefix_cache_hit_rate: float = 0.0
+
+
+def window_ladder(decode_steps: int) -> List[int]:
+    """Decode-window sizes the engine compiles, descending: full window,
+    a quarter window for request tails, and 1. Three rungs bound the
+    compiled-program set (each first use of a rung is an XLA compile that
+    stalls the serving loop for seconds — the same hazard the page-bucket
+    scheme avoids); the scheduler rounds UP into the ladder, and writes
+    past a request's admission limit are dropped on device, so an
+    oversized rung only wastes bounded tail compute, never correctness."""
+    n = max(1, decode_steps)
+    return sorted({n, max(1, n // 4), 1}, reverse=True)
 
 
 def pow2_buckets(max_value: int, start: int = 1) -> List[int]:
@@ -585,7 +600,22 @@ class Scheduler:
         if not active:
             return None
         ps = self.cfg.page_size
-        n_window = max(1, self.cfg.decode_steps)
+        # adaptive window: pick the smallest LADDER rung covering the
+        # smallest remaining token budget across active slots. Steady-state
+        # long generations run the full window; near a request's end the
+        # window shrinks instead of burning post-finish garbage steps —
+        # big windows then amortize dispatch without penalizing mixed/short
+        # workloads (bench: 64-step windows lift pure decode 997 -> 1215
+        # tok/s/chip on v5e). The rung is what the engine EXECUTES, so page
+        # reservation below uses it verbatim — choosing any smaller value
+        # here would under-reserve and let tail steps scatter KV through
+        # zeroed page_table entries into page 0 (code-review r3).
+        ladder = window_ladder(self.cfg.decode_steps)
+        min_remaining = max(1, min(
+            len(s.prompt) + self.params[s.request_id].max_tokens
+            - s.total_len for s in active))
+        n_window = next((w for w in reversed(ladder) if w >= min_remaining),
+                        ladder[0])
         # make room for every token the decode window may write (bounded by
         # the request's own prompt+max_tokens limit, which _admit kept within
         # max_model_len), preempting (youngest-first) until the allocation
@@ -635,7 +665,8 @@ class Scheduler:
         return DecodePlan(
             seqs=seqs, tokens=tokens, positions=positions,
             page_table=page_table, kv_lens=kv_lens, write_idx=write_idx,
-            last_idx=np.zeros((s_count,), np.int32), max_pos=max_pos)
+            last_idx=np.zeros((s_count,), np.int32), max_pos=max_pos,
+            n_window=n_window)
 
     def _preempt_one(self) -> None:
         """Evict the youngest running seq back to waiting (recompute later)."""
