@@ -259,3 +259,49 @@ def test_prefill_streak_unbounded_when_disabled():
         kinds += "p"
         s.commit_prefill(plan, 9 if plan.is_last_chunk[0] else None)
     assert kinds == "p" * 10, kinds
+
+
+def test_adaptive_window_is_ladder_rung_with_covering_pages():
+    """The scheduler's adaptive decode window must (a) be a rung of the
+    compiled ladder — any other value would miss the engine's program set
+    and execute a LARGER window than pages were reserved for, scattering
+    tail KV writes through zeroed page_table entries into page 0
+    (code-review r3) — and (b) reserve pages covering the full rung for
+    every slot, up to each request's own admission limit."""
+    from dynamo_tpu.engine.scheduler import window_ladder
+
+    eng = make_engine(decode_steps=64, max_slots=2)
+    ladder = window_ladder(64)
+    assert eng._window_sizes == ladder
+    # one short-tail request (33 remaining) + one long one
+    eng.add_request(EngineRequest(
+        "short", list(range(10, 18)),
+        SamplingParams(max_tokens=34, temperature=0.0, ignore_eos=True)))
+    eng.add_request(EngineRequest(
+        "long", list(range(40, 48)),
+        SamplingParams(max_tokens=400, temperature=0.0, ignore_eos=True)))
+    while eng.scheduler.waiting:
+        eng.step()
+    windows_seen = set()
+    for _ in range(40):
+        plan = eng.scheduler.schedule()
+        if plan is None:
+            break
+        if not hasattr(plan, "n_window"):  # prefill plan
+            eng._run_prefill(plan)
+            continue
+        assert plan.n_window in ladder, plan.n_window
+        windows_seen.add(plan.n_window)
+        for seq in plan.seqs:
+            if seq is None:
+                continue
+            limit = (len(seq.prompt)
+                     + eng.scheduler.params[seq.request_id].max_tokens)
+            covered = len(seq.pages) * eng.cfg.page_size
+            need = min(seq.total_len + plan.n_window, limit)
+            assert covered >= need, (seq.request_id, covered, need)
+        eng._run_decode(plan)
+        if not any(s is not None for s in eng.scheduler.running):
+            break
+    # the short request's tail must have pulled the window below the max
+    assert len(windows_seen) > 1, windows_seen
