@@ -171,6 +171,25 @@ def pow2_buckets(max_value: int, start: int = 1) -> List[int]:
     return out
 
 
+def page_bucket_ladder(max_value: int) -> List[int]:
+    """Page-table width buckets with 1.5x intermediate rungs
+    (1,2,3,4,6,8,12,16,24,32,...): decode attention reads the FULL bucket
+    width (Lk = bucket * page_size), so pow2-only rungs pay up to 2x the
+    valid KV in HBM reads right after a crossing — intermediate rungs cap
+    the waste at ~1.5x. Widths are admission-time-fixed per request, so
+    extra rungs add compiled programs across workload shapes, never
+    steady-state recompiles."""
+    out, b = [], 1
+    while b < max_value:
+        out.append(b)
+        mid = b + b // 2
+        if b >= 2 and mid < max_value:
+            out.append(mid)
+        b *= 2
+    out.append(max_value)
+    return sorted(set(out))
+
+
 def next_bucket(n: int, buckets: Sequence[int]) -> int:
     for b in buckets:
         if b >= n:
@@ -198,7 +217,7 @@ class Scheduler:
         ps = cfg.page_size
         self.prefill_buckets = list(cfg.prefill_buckets)
         max_pages_per_seq = -(-cfg.max_model_len // ps)
-        self.page_buckets = pow2_buckets(max_pages_per_seq)
+        self.page_buckets = page_bucket_ladder(max_pages_per_seq)
         self._prefix_hits = 0
         self._prefix_lookups = 0
         self._prefill_streak = 0

@@ -110,8 +110,10 @@ def test_engine_prefill_only_parks_and_extracts():
     seq = eng.scheduler.parked["p1"]
     assert len(seq.pages) == 3  # ceil(20/8)
     pages = eng.extract_pages(seq.pages)
-    # bucketed to 4 pages: [L, Hkv, 4, ps, hd]
-    assert pages["k"].shape == (CFG.num_layers, CFG.num_kv_heads, 4, PAGE,
+    # page-count bucketed per the scheduler's ladder: [L, Hkv, Nb, ps, hd]
+    from dynamo_tpu.engine.scheduler import next_bucket
+    nb = next_bucket(3, eng.scheduler.page_buckets)
+    assert pages["k"].shape == (CFG.num_layers, CFG.num_kv_heads, nb, PAGE,
                                 CFG.head_dim)
     eng.release_parked("p1")
     assert "p1" not in eng.scheduler.parked
