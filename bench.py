@@ -12,28 +12,56 @@ bandwidth-roofline estimate for this model on one v5e chip
 => ~330 steps/s ceiling; at batch 8 with overheads a strong serving stack
 lands near ~40% of roofline). vs_baseline > 1.0 means we beat that.
 
-Robustness (round-1 rc=124 post-mortem, VERDICT.md weak #1): the axon TPU
-tunnel can stall for tens of minutes in backend init, and every compile rides
-the tunnel. So: per-phase stderr progress with elapsed time, a persistent
-compilation cache so retries are cheap, ONE engine build (the kernel choice is
-probed with a tiny pallas call first, not discovered by rebuilding), adaptive
-timed chunks that record a usable number early, and a hard watchdog deadline
-that emits the best measurement so far rather than dying silently.
+Robustness (round-3 rc=3 post-mortem, VERDICT.md missing #1): the axon TPU
+tunnel can stall *indefinitely* inside backend init, and a hung
+`jax.devices()` cannot be interrupted from within the process — round 3's
+in-process retry loop burned the whole 540 s budget in phase 1 and the
+watchdog emitted 0.0. So the bench is now a SUPERVISOR/WORKER pair:
+
+- The supervisor (this process, `python bench.py`) never imports jax. It
+  spawns the measurement as a child process group, watches phase-transition
+  heartbeats in a state file, and SIGKILLs + re-execs the child whenever a
+  phase exceeds its stall budget (init stalls are often transient, and the
+  persistent compilation cache makes retries cheap). It merges the best
+  partial result across attempts and always emits exactly one JSON line.
+- The worker (`python bench.py --worker`) runs the phases and writes the
+  state file atomically after every phase transition and every timed chunk,
+  so a kill at any point loses nothing already measured.
+- On exit the supervisor kills the whole child process group — no stray
+  process is left holding the single-slot axon tunnel for the next run.
 """
 import json
 import os
+import signal
+import subprocess
 import sys
-import threading
 import time
 
 NOMINAL_BASELINE_TOK_S = 1000.0  # ~40% of single-chip roofline at batch 8
 METRIC = "decode_tokens_per_sec_per_chip_llama3_1b_bf16_b8"
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "540"))  # hard deadline
+HERE = os.path.dirname(os.path.abspath(__file__))
+STATE_PATH = os.environ.get("BENCH_STATE",
+                            os.path.join(HERE, ".bench_state.json"))
+
+# Per-phase stall budgets (seconds without a phase transition or chunk
+# update before the supervisor kills and re-execs the worker). First-compile
+# phases get the long budgets; a warm .jax_cache makes retries ~10x cheaper.
+PHASE_STALL_S = {
+    "spawn": 45.0,          # worker process must write its first state
+    "import": 90.0,
+    "backend_init": 150.0,  # VERDICT r3: treat init as killable work
+    "kernel_probe": 150.0,
+    "engine_build": 300.0,
+    "warmup": 300.0,
+    "decode_chunks": 120.0,  # refreshed per chunk
+    "ttft": 150.0,
+    "churn": 150.0,
+}
+
+STALL_SCALE = float(os.environ.get("BENCH_STALL_SCALE", "1"))  # test hook
 
 T0 = time.time()
-RESULT = {"metric": METRIC, "value": 0.0, "unit": "tokens/s/chip",
-          "vs_baseline": 0.0, "extras": {}}
-_emitted = threading.Event()
 
 
 def log(*a):
@@ -41,33 +69,189 @@ def log(*a):
           flush=True)
 
 
-def emit():
-    if not _emitted.is_set():
-        _emitted.set()
-        print(json.dumps(RESULT), flush=True)
+def write_state(phase: str, result: dict):
+    tmp = STATE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"phase": phase, "t": time.time(), "result": result}, f)
+    os.replace(tmp, STATE_PATH)
 
 
-def record(tok_s: float, n_chips: int):
-    value = tok_s / max(1, n_chips)
-    RESULT["value"] = round(value, 2)
-    RESULT["vs_baseline"] = round(value / NOMINAL_BASELINE_TOK_S, 3)
+def read_state():
+    try:
+        with open(STATE_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return None
 
 
-def watchdog():
-    time.sleep(BUDGET_S)
-    log(f"DEADLINE ({BUDGET_S:.0f}s) hit; emitting best-available result",
-        RESULT)
-    emit()
-    os._exit(3)
+# --------------------------------------------------------------------------
+# Supervisor
+# --------------------------------------------------------------------------
+
+def supervise() -> int:
+    best = {"metric": METRIC, "value": 0.0, "unit": "tokens/s/chip",
+            "vs_baseline": 0.0, "extras": {}}
+
+    def merge(state):
+        r = state.get("result") or {}
+        if r.get("value", 0.0) > best["value"]:
+            best["value"] = r["value"]
+            best["vs_baseline"] = r["vs_baseline"]
+            best["metric"] = r.get("metric", METRIC)
+        # extras accumulate across attempts (ttft from one attempt, churn
+        # from another, etc.); later attempts win per key
+        best["extras"].update(r.get("extras") or {})
+
+    try:
+        os.unlink(STATE_PATH)
+    except OSError:
+        pass
+
+    child = None
+
+    def kill_child():
+        if child is not None and child.poll() is None:
+            try:
+                os.killpg(child.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                child.wait(timeout=10)
+            except Exception:
+                pass
+
+    attempt = 0
+    rc = None
+    fast_crashes = 0
+    try:
+        while True:
+            remaining = BUDGET_S - (time.time() - T0) - 10.0
+            # the first attempt always runs (a tiny-model CPU validation
+            # with a small BENCH_BUDGET_S must not exit without working)
+            if attempt > 0 and remaining < 60.0:
+                log("budget exhausted; emitting best-available result")
+                break
+            if fast_crashes >= 3:
+                log("worker crashed instantly 3x; giving up (deterministic "
+                    "failure, retries would only spam the tunnel)")
+                break
+            attempt += 1
+            log(f"supervisor: starting worker attempt {attempt} "
+                f"({remaining:.0f}s of budget left)")
+            # new session => whole process group is killable even if jax
+            # spawns helper threads/processes; stdout routed to stderr so
+            # only the supervisor writes the result line to stdout
+            env = dict(os.environ, BENCH_ATTEMPT=str(attempt))
+            child = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                stdout=sys.stderr, stderr=sys.stderr,
+                start_new_session=True, cwd=HERE, env=env)
+            spawn_t = time.time()
+            last_phase, last_t = "spawn", spawn_t
+            stalled = False
+            while True:
+                code = child.poll()
+                state = read_state()
+                if state:
+                    merge(state)
+                    # stale state from a killed prior attempt must not
+                    # count as this attempt's progress (or lack of it)
+                    if state["t"] >= spawn_t and (
+                            state["phase"] != last_phase
+                            or state["t"] > last_t):
+                        last_phase, last_t = state["phase"], state["t"]
+                if code is not None:
+                    log(f"worker exited rc={code} in phase {last_phase}")
+                    break
+                in_phase = time.time() - last_t
+                # escalate per attempt: a kill+retry fixes *transient*
+                # stalls cheaply, but when the tunnel is merely slow the
+                # retry must eventually wait it out rather than starving
+                escalate = min(attempt, 3)
+                stall_budget = (PHASE_STALL_S.get(last_phase, 120.0)
+                                * STALL_SCALE * escalate)
+                overall = time.time() - T0
+                if in_phase > stall_budget:
+                    log(f"supervisor: phase '{last_phase}' stalled "
+                        f"{in_phase:.0f}s (budget {stall_budget:.0f}s); "
+                        f"killing worker group")
+                    kill_child()
+                    stalled = True
+                    break
+                if overall > BUDGET_S - 15.0:
+                    log("supervisor: global deadline; killing worker")
+                    kill_child()
+                    stalled = True
+                    break
+                time.sleep(1.0)
+            state = read_state()
+            if state:
+                merge(state)
+            if not stalled and child.returncode == 0:
+                rc = 0
+                break
+            # crashed or stalled: re-exec if budget allows (loop condition).
+            # Deterministic crashes (instant nonzero exit) must not retry
+            # in a tight loop for the whole budget — count and cap them.
+            if not stalled and child.returncode != 0:
+                if time.time() - spawn_t < 15.0:
+                    fast_crashes += 1
+                    time.sleep(2.0)
+                else:
+                    fast_crashes = 0
+    except BaseException as e:
+        # the one-JSON-line contract holds even for supervisor bugs or
+        # SIGTERM: emit what we have, then re-raise
+        log(f"supervisor FATAL {type(e).__name__}: {e}")
+        raise
+    finally:
+        kill_child()
+        print(json.dumps(best), flush=True)
+        log("final:", best)
+
+    return 0 if (rc == 0 or best["value"] > 0) else 1
 
 
-def main():
-    threading.Thread(target=watchdog, daemon=True).start()
-    # persistent compilation cache: a re-run (or the driver's run after ours)
-    # skips every XLA compile that already happened once on this host
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_cache")
-    log("phase 0: importing jax")
+# --------------------------------------------------------------------------
+# Worker
+# --------------------------------------------------------------------------
+
+class WorkerState:
+    def __init__(self):
+        self.result = {"metric": METRIC, "value": 0.0,
+                       "unit": "tokens/s/chip", "vs_baseline": 0.0,
+                       "extras": {}}
+        self.phase = "import"
+
+    def set_phase(self, phase):
+        self.phase = phase
+        write_state(phase, self.result)
+        # fault injection for the supervisor's kill/re-exec path:
+        # BENCH_FAKE_STALL=<phase>:<n> hangs attempts 1..n in that phase,
+        # simulating an indefinite axon-tunnel stall (the round-3 failure)
+        fake = os.environ.get("BENCH_FAKE_STALL")
+        if fake:
+            p, _, n = fake.rpartition(":")
+            if p == phase and int(os.environ.get("BENCH_ATTEMPT", "1")) <= \
+                    int(n):
+                log(f"FAKE STALL injected in phase {phase}")
+                time.sleep(100000)
+
+    def touch(self):
+        write_state(self.phase, self.result)
+
+    def record(self, tok_s: float, n_chips: int):
+        value = tok_s / max(1, n_chips)
+        self.result["value"] = round(value, 2)
+        self.result["vs_baseline"] = round(value / NOMINAL_BASELINE_TOK_S, 3)
+        self.touch()
+
+
+def worker():
+    st = WorkerState()
+    st.set_phase("import")
+    cache_dir = os.path.join(HERE, ".jax_cache")
+    log("phase: importing jax")
     import jax
     # this image pins jax_platforms to the TPU tunnel programmatically;
     # honor an explicit JAX_PLATFORMS override (CPU validation runs)
@@ -83,21 +267,9 @@ def main():
     except Exception as e:  # cache is an optimization, never fatal
         log("compilation cache unavailable:", e)
 
-    log("phase 1: initializing backend (axon tunnel init can stall; "
-        "watchdog will fire at deadline)")
-    devices = None
-    for attempt in range(3):
-        try:
-            devices = jax.devices()
-            break
-        except Exception as e:
-            log(f"backend init attempt {attempt + 1} failed: "
-                f"{type(e).__name__}: {e}")
-            time.sleep(10)
-    if devices is None:
-        log("backend never initialized; emitting zero result")
-        emit()
-        return
+    st.set_phase("backend_init")
+    log("phase: initializing backend (supervisor kills on stall)")
+    devices = jax.devices()
     n_chips = len(devices)
     log(f"backend up: {devices} ({jax.default_backend()})")
 
@@ -107,7 +279,8 @@ def main():
     from dynamo_tpu.engine.engine import NativeEngine
     from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
 
-    log("phase 2: probing pallas decode kernel with a tiny call")
+    st.set_phase("kernel_probe")
+    log("phase: probing pallas decode kernel with a tiny call")
     # the engine's serving default is the deferred-write GATHER decode (the
     # measured winner on v5e — see models/llama._decode_kernel_mode); the
     # probe proves the Pallas kernel still compiles for the flagship's
@@ -135,7 +308,7 @@ def main():
     # the real bench always runs the llama3-1b flagship
     model_name = os.environ.get("BENCH_MODEL", "llama3-1b")
     if model_name != "llama3-1b":
-        RESULT["metric"] = (
+        st.result["metric"] = (
             f"decode_tokens_per_sec_per_chip_{model_name}_b8_validation")
     model_cfg = get_model_config(model_name)  # decode_kernel="auto" = gather
     slots = 8
@@ -152,8 +325,8 @@ def main():
         page_size=64, num_pages=256, max_slots=slots, max_prefill_chunk=128,
         prefill_buckets=(128,), max_model_len=2048,
         decode_steps=decode_steps, max_prefill_batch=8)
-    RESULT["extras"].update(kernel=kernel, decode_steps=decode_steps,
-                            slots=slots)
+    st.result["extras"].update(kernel=kernel, decode_steps=decode_steps,
+                               slots=slots)
 
     # max_tokens covers warmup (2 windows) + 6 timed chunks (>=1 window
     # each) so no slot runs dry mid-measurement (empty slots would deflate
@@ -166,7 +339,8 @@ def main():
     params = SamplingParams(max_tokens=max_toks, temperature=0.0,
                             ignore_eos=True)
 
-    log("phase 3: building engine (init_params + init_cache compiles)")
+    st.set_phase("engine_build")
+    log("phase: building engine (init_params + init_cache compiles)")
     engine = NativeEngine(model_cfg, cfg, seed=0)
 
     def add_all(tag):
@@ -178,7 +352,8 @@ def main():
                       for j in range(prompt_len)]
             engine.add_request(EngineRequest(f"{tag}-{i}", prompt, params))
 
-    log(f"phase 4: warmup — batched prefill of all {slots} slots + 2 decode "
+    st.set_phase("warmup")
+    log(f"phase: warmup — batched prefill of all {slots} slots + 2 decode "
         f"windows of {decode_steps}")
     add_all("warm")
     n_pf = 0
@@ -186,11 +361,14 @@ def main():
         engine.step()
         n_pf += 1
     log(f"prefill done ({n_pf} steps)")
+    st.touch()
     for _ in range(2):
         engine.step()
+        st.touch()
     log("warmup done; decode window compiled")
 
-    log("phase 5: timed decode chunks (adaptive; records best chunk)")
+    st.set_phase("decode_chunks")
+    log("phase: timed decode chunks (adaptive; records best chunk)")
     chunk_windows = max(1, 80 // decode_steps)
     max_chunks = 6
     best = 0.0
@@ -202,14 +380,12 @@ def main():
         dt = time.perf_counter() - t0
         tok_s = tokens / dt
         best = max(best, tok_s)
-        record(best, n_chips)
+        st.record(best, n_chips)
         log(f"chunk {c}: {tok_s:.1f} tok/s ({tokens} tokens / {dt:.3f}s); "
             f"best {best:.1f}")
-        if time.time() - T0 > BUDGET_S - 60:
-            log("approaching deadline; skipping TTFT phase")
-            emit()
-            return
-    log("phase 6: TTFT — drain, then 8 fresh concurrent prompts "
+
+    st.set_phase("ttft")
+    log("phase: TTFT — drain, then 8 fresh concurrent prompts "
         "(batched prefill; north-star denominator, BASELINE.md)")
     # drain current requests so the TTFT engine starts idle
     for rid in list(engine.scheduler.params):
@@ -229,25 +405,23 @@ def main():
         # all prompts prefill in one batched step: prefill throughput is
         # total prompt tokens over the time to the LAST first-token
         prefill_tok_s = slots * prompt_len / max(ttfts[-1], 1e-9)
-        RESULT["extras"].update(
+        st.result["extras"].update(
             ttft_p50_ms=round(p50 * 1000, 1),
             ttft_p99_ms=round(ttfts[-1] * 1000, 1),
             prefill_tok_s=round(prefill_tok_s, 1))
+        st.touch()
         log(f"TTFT p50 {p50 * 1000:.1f} ms, max {ttfts[-1] * 1000:.1f} ms; "
             f"prefill {prefill_tok_s:.0f} tok/s")
 
-    if time.time() - T0 > BUDGET_S - 90:
-        log("approaching deadline; skipping agg-vs-disagg phase")
-        emit()
-        return
-    log("phase 7: agg-under-churn vs pure decode (the disagg ratio's "
+    st.set_phase("churn")
+    log("phase: agg-under-churn vs pure decode (the disagg ratio's "
         "one-chip denominator/numerator, BASELINE.md north star)")
     # Aggregated serving under continuous arrivals: every finished request
     # is replaced by a fresh prompt, so prefill chunks steal device steps
     # from decode — exactly the interference disaggregation removes (the
     # reference's 1-node +30% claim, docs/architecture.md:57-61). The
-    # pure-decode number from phase 5 (all slots busy, no arrivals) is what
-    # a dedicated decode engine achieves; the ratio is the measured
+    # pure-decode number from the chunk phase (all slots busy, no arrivals)
+    # is what a dedicated decode engine achieves; the ratio is the measured
     # one-chip upper bound for disagg gain at this workload shape. Prompts
     # are 8x the decode length (512:64) to approximate the reference's
     # long-ISL/short-OSL benchmark shape (3K ISL / 150 OSL).
@@ -276,6 +450,7 @@ def main():
         for ev in engine.step():
             if ev.finished:
                 add_fresh()
+        st.touch()
     t0 = time.perf_counter()
     tokens = 0
     deadline = t0 + 15.0
@@ -287,20 +462,22 @@ def main():
                 add_fresh()
     dt = time.perf_counter() - t0
     agg_tok_s = tokens / dt / max(1, n_chips)
-    pure = RESULT["value"]
-    RESULT["extras"].update(
+    pure = st.result["value"]
+    st.result["extras"].update(
         agg_churn_tok_s=round(agg_tok_s, 1),
         disagg_decode_gain=round(pure / agg_tok_s, 3) if agg_tok_s else None)
     log(f"agg-under-churn {agg_tok_s:.1f} tok/s/chip vs pure decode "
         f"{pure:.1f}; decode-side disagg gain bound "
         f"{pure / max(agg_tok_s, 1e-9):.2f}x")
-    emit()
+    st.set_phase("done")
 
 
 if __name__ == "__main__":
-    try:
-        main()
-    except Exception as e:  # any unplanned failure still emits the JSON line
-        log(f"FATAL {type(e).__name__}: {e}")
-        emit()
-        raise
+    if "--worker" in sys.argv:
+        try:
+            worker()
+        except Exception as e:
+            log(f"worker FATAL {type(e).__name__}: {e}")
+            raise
+    else:
+        sys.exit(supervise())
