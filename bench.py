@@ -89,6 +89,13 @@ def read_state():
 # --------------------------------------------------------------------------
 
 def supervise() -> int:
+    # SIGTERM must take the finally path (emit best-so-far JSON + kill the
+    # worker group) — the default disposition would skip both, leaving a
+    # tunnel-holding child behind
+    def _on_term(*_):
+        raise SystemExit(143)
+
+    signal.signal(signal.SIGTERM, _on_term)
     best = {"metric": METRIC, "value": 0.0, "unit": "tokens/s/chip",
             "vs_baseline": 0.0, "extras": {}}
 

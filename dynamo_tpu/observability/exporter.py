@@ -62,6 +62,12 @@ class MetricsExporter:
         self.g_hit_rate = r.gauge(
             f"{PREFIX}_prefix_cache_hit_rate",
             "Worker-reported prefix cache hit rate", labels)
+        self.g_window_steps = r.gauge(
+            f"{PREFIX}_window_slot_steps",
+            "Cumulative decode-window (step, slot) pairs run", labels)
+        self.g_window_wasted = r.gauge(
+            f"{PREFIX}_window_wasted_steps",
+            "Of those, steps after the slot's request finished", labels)
         self.g_load_avg = r.gauge(
             f"{PREFIX}_load_avg", "Mean active KV blocks across workers")
         self.g_load_std = r.gauge(
@@ -115,7 +121,8 @@ class MetricsExporter:
         for worker_id in removed:
             for g in (self.g_active_slots, self.g_total_slots,
                       self.g_kv_active, self.g_kv_total, self.g_waiting,
-                      self.g_usage, self.g_hit_rate):
+                      self.g_usage, self.g_hit_rate, self.g_window_steps,
+                      self.g_window_wasted):
                 g.remove(worker_id)
         for worker_id, m in endpoints.workers.items():
             self.g_active_slots.set(worker_id, value=m.request_active_slots)
@@ -126,6 +133,9 @@ class MetricsExporter:
             self.g_usage.set(worker_id, value=m.gpu_cache_usage_perc)
             self.g_hit_rate.set(worker_id,
                                 value=m.gpu_prefix_cache_hit_rate)
+            self.g_window_steps.set(worker_id, value=m.window_slot_steps)
+            self.g_window_wasted.set(worker_id,
+                                     value=m.window_wasted_steps)
         self.g_load_avg.set(value=endpoints.load_avg)
         self.g_load_std.set(value=endpoints.load_std)
         self.g_workers.set(value=len(endpoints.workers))
@@ -200,7 +210,8 @@ def main() -> None:
     ap.add_argument("--port", type=int, default=9091)
     ap.add_argument("--interval", type=float, default=0.5)
     args = ap.parse_args()
-    logging.basicConfig(level=logging.INFO)
+    from dynamo_tpu.utils.logconfig import configure_logging
+    configure_logging()
     asyncio.run(_amain(args))
 
 

@@ -66,45 +66,65 @@ def paged_attention(
     return out.reshape(b, tq, h, hd).astype(q.dtype)
 
 
-def decode_attention_pregathered(
+def decode_attention_split(
     q: jax.Array,            # [B, H, hd] — one query token per sequence
-    k: jax.Array,            # [Hkv, B, Lk, hd] — window-gathered KV
-    v: jax.Array,
+    k_base: jax.Array,       # [Hkv, B, Lb, hd] — read-only pre-window KV
+    v_base: jax.Array,
+    k_win: jax.Array,        # [Hkv, B, Nw, hd] — in-window KV buffer
+    v_win: jax.Array,
     k_new: jax.Array,        # [B, Hkv, hd] — this step's kv (self-term)
     v_new: jax.Array,
-    prefix_lens: jax.Array,  # [B] int32 — valid kv BEFORE this token
+    base_lens: jax.Array,    # [B] int32 — valid kv at WINDOW start
+    win_lens: jax.Array,     # [B] int32 — tokens written in-window so far
 ) -> jax.Array:
-    """Decode attention over a window-carried pre-gathered KV buffer.
+    """Decode attention over a base-plus-window split KV view.
 
-    Same math as decode_attention_deferred minus the page gather: the
-    window decode loop gathers each slot's pages from the paged cache
-    ONCE per window and scatters each finished step's kv rows into the
-    carried buffer between steps (rows are ordered by page-table
-    position, so flat kv index == absolute position). The per-step page
-    gather — measured ~2.5 ms/step on the 1B flagship at batch 8 — is
-    gone; the current token still contributes via the self-term.
+    The window decode gathers each slot's VALID prefix pages once per
+    window into a read-only base buffer (positions [0, base_lens)) and
+    accumulates in-window tokens into a tiny [.., Nw, ..] buffer at the
+    step index (absolute position base_lens + j). The three score groups
+    — base, window, current-token self-term — merge in one joint softmax
+    (exact: decode is causal, so the union covers precisely the valid
+    prefix). Versus carrying one full-allocation-width gathered buffer
+    (the round-3 design), the base is sliced to the bucket of the TRUE
+    kv length (not the admission-time page allocation, which reserves
+    for max_tokens), and the only scan-carried KV state is the Nw-wide
+    window buffer — ~page_bucket*page_size/Nw times smaller.
     Returns [B, H, hd].
     """
     b, h, hd = q.shape
-    hkv = k.shape[0]
+    hkv = k_base.shape[0]
     g = h // hkv
-    lk = k.shape[2]
+    lb = k_base.shape[2]
+    nw = k_win.shape[2]
     qg = q.reshape(b, hkv, g, hd)
-    scores = jnp.einsum(
-        "bkgd,kbsd->bkgs", qg, k,
+    sb = jnp.einsum(
+        "bkgd,kbsd->bkgs", qg, k_base,
         preferred_element_type=jnp.float32) * (hd ** -0.5)
-    kv_pos = jnp.arange(lk, dtype=jnp.int32)[None, :]
-    valid = kv_pos < prefix_lens[:, None]
-    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    base_pos = jnp.arange(lb, dtype=jnp.int32)[None, :]
+    sb = jnp.where((base_pos < base_lens[:, None])[:, None, None, :],
+                   sb, NEG_INF)
+    sw = jnp.einsum(
+        "bkgd,kbsd->bkgs", qg, k_win,
+        preferred_element_type=jnp.float32) * (hd ** -0.5)
+    win_pos = jnp.arange(nw, dtype=jnp.int32)[None, :]
+    sw = jnp.where((win_pos < win_lens[:, None])[:, None, None, :],
+                   sw, NEG_INF)
     s_self = jnp.einsum(
         "bkgd,bkd->bkg", qg, k_new,
         preferred_element_type=jnp.float32) * (hd ** -0.5)
-    m = jnp.maximum(jnp.max(scores, axis=-1), s_self)
-    p = jnp.exp(scores - m[..., None])
+    # joint softmax across the three groups; s_self is always unmasked so
+    # the max is finite even for empty base/window (padding slots)
+    m = jnp.maximum(jnp.maximum(jnp.max(sb, axis=-1), jnp.max(sw, axis=-1)),
+                    s_self)
+    pb = jnp.exp(sb - m[..., None])
+    pw = jnp.exp(sw - m[..., None])
     p_self = jnp.exp(s_self - m)
-    denom = jnp.sum(p, axis=-1) + p_self
-    out = jnp.einsum("bkgs,kbsd->bkgd", p.astype(v.dtype), v,
+    denom = jnp.sum(pb, axis=-1) + jnp.sum(pw, axis=-1) + p_self
+    out = jnp.einsum("bkgs,kbsd->bkgd", pb.astype(v_base.dtype), v_base,
                      preferred_element_type=jnp.float32)
+    out = out + jnp.einsum("bkgs,kbsd->bkgd", pw.astype(v_win.dtype), v_win,
+                           preferred_element_type=jnp.float32)
     out = out + p_self[..., None] * v_new.astype(jnp.float32)[:, :, None, :]
     out = out / denom[..., None]
     return out.reshape(b, h, hd).astype(q.dtype)

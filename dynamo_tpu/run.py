@@ -174,8 +174,8 @@ async def amain() -> None:
     p.add_argument("--process-id", type=int, default=None)
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args()
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO)
+    from dynamo_tpu.utils.logconfig import configure_logging
+    configure_logging("debug" if args.verbose else "info")
     from dynamo_tpu.parallel.bootstrap import bootstrap_distributed
     bootstrap_distributed(args.coordinator, args.num_processes,
                           args.process_id)

@@ -23,6 +23,8 @@ record is discarded on replay.
 """
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
 import io
 import logging
 import os
@@ -95,8 +97,15 @@ class DurableMessaging(MemoryMessaging):
 
     async def queue_push(self, queue, payload):
         await super().queue_push(queue, payload)
-        self._journal.append({"op": "qpush", "queue": queue,
-                              "payload": payload})
+        # ack-after-durable: the server replies to queue_push only when
+        # this coroutine returns, so awaiting the group-commit makes an
+        # acknowledged push survive even a machine crash (VERDICT r3 #4;
+        # JetStream file-store semantics, SURVEY §L0). Concurrent pushes
+        # share one fsync via the writer thread's batch commit.
+        fut = self._journal.append(
+            {"op": "qpush", "queue": queue, "payload": payload}, ack=True)
+        if fut is not None:
+            await asyncio.wrap_future(fut)
 
     async def queue_pop(self, queue, timeout=None):
         item = await super().queue_pop(queue, timeout)
@@ -122,16 +131,27 @@ class Journal:
     run on the control-plane event loop, so all file I/O — including the
     full snapshot rewrite — happens on a dedicated writer thread, in
     order. The loop only packs bytes and enqueues; a compaction never
-    stalls leases/watches. Trade-off: a process crash can lose the last
-    few enqueued-but-unwritten records (never corrupting or reordering);
-    the reference accepts the same window via etcd/JetStream client-side
-    buffering."""
+    stalls leases/watches.
 
-    def __init__(self, data_dir: str, compact_every: int = 10_000):
+    Group-commit fsync (VERDICT r3 #4): with fsync=True (default) the
+    writer drains every queued record, writes them, and fsyncs ONCE per
+    batch — bounded latency under load, JetStream-file-store durability.
+    append(rec, ack=True) returns a Future resolved only after that
+    fsync, which queue_push awaits before the server acks: an
+    acknowledged push survives OS/power crash, not just process crash.
+    Fire-and-forget appends (KV puts) ride the same batches, so they are
+    fsync'd too; only the ack path waits. A process crash can still lose
+    enqueued-but-unwritten *unacked* records (never corrupting or
+    reordering) — the same window the reference accepts via
+    etcd/JetStream client-side buffering."""
+
+    def __init__(self, data_dir: str, compact_every: int = 10_000,
+                 fsync: bool = True):
         os.makedirs(data_dir, exist_ok=True)
         self.snap_path = os.path.join(data_dir, "snapshot.bin")
         self.journal_path = os.path.join(data_dir, "journal.bin")
         self.compact_every = compact_every
+        self.fsync = fsync
         self._since_compact = 0
         self._gen = 0
         self._file: Optional[io.BufferedWriter] = None
@@ -146,15 +166,18 @@ class Journal:
     def attach(self, plane: MemoryPlane) -> None:
         self._plane = plane
 
-    def append(self, rec: dict) -> None:
+    def append(self, rec: dict, ack: bool = False
+               ) -> Optional[concurrent.futures.Future]:
         # the record carries the generation current at ENQUEUE time: the
         # writer stamps a fresh journal's jhead from it, so records
         # enqueued before a pending compaction never land under the new
         # generation (which would discard them on recovery)
-        self._q.put(("rec", (msgpack.packb(rec), self._gen)))
+        fut = concurrent.futures.Future() if ack else None
+        self._q.put(("rec", (msgpack.packb(rec), self._gen, fut)))
         self._since_compact += 1
         if self._since_compact >= self.compact_every:
             self.compact()
+        return fut
 
     def sync(self) -> None:
         """Block until every enqueued write has reached the filesystem."""
@@ -163,23 +186,50 @@ class Journal:
     # -- writer thread --------------------------------------------------------
 
     def _writer_loop(self) -> None:
-        while True:
-            item = self._q.get()
-            try:
-                if item is None:
-                    if self._file is not None:
-                        self._file.close()
-                        self._file = None
-                    return
-                kind, payload = item
-                if kind == "rec":
-                    self._write_record(*payload)
-                else:  # ("snap", (gen, snapshot_bytes))
-                    self._write_snapshot(*payload)
-            except Exception:  # pragma: no cover — keep draining
-                log.exception("journal write failed")
-            finally:
-                self._q.task_done()
+        import queue as _queue
+        stop = False
+        while not stop:
+            # group-commit: take one item, then drain every immediately
+            # available record so a burst shares a single fsync
+            items = [self._q.get()]
+            while items[-1] is not None and items[-1][0] == "rec":
+                try:
+                    items.append(self._q.get_nowait())
+                except _queue.Empty:
+                    break
+            recs = [it[1] for it in items
+                    if it is not None and it[0] == "rec"]
+            tail = [it for it in items
+                    if it is None or it[0] != "rec"]  # <=1 by construction
+            if recs:
+                try:
+                    for payload, gen, _fut in recs:
+                        self._write_record(payload, gen)
+                    self._commit()
+                    for _, _, fut in recs:
+                        if fut is not None and not fut.done():
+                            fut.set_result(None)
+                except Exception as e:  # pragma: no cover — keep draining
+                    log.exception("journal write failed")
+                    for _, _, fut in recs:
+                        if fut is not None and not fut.done():
+                            fut.set_exception(e)
+                finally:
+                    for _ in recs:
+                        self._q.task_done()
+            for it in tail:
+                try:
+                    if it is None:
+                        if self._file is not None:
+                            self._file.close()
+                            self._file = None
+                        stop = True
+                    else:  # ("snap", (gen, snapshot_bytes))
+                        self._write_snapshot(*it[1])
+                except Exception:  # pragma: no cover — keep draining
+                    log.exception("journal write failed")
+                finally:
+                    self._q.task_done()
 
     def _write_record(self, payload: bytes, gen: int) -> None:
         if self._file is None:
@@ -188,7 +238,13 @@ class Journal:
                 _append_record(self._file, {"op": "jhead", "gen": gen})
         self._file.write(_LEN.pack(len(payload)))
         self._file.write(payload)
-        self._file.flush()
+
+    def _commit(self) -> None:
+        """Flush (and, in durable mode, fsync) the current journal batch."""
+        if self._file is not None:
+            self._file.flush()
+            if self.fsync:
+                os.fsync(self._file.fileno())
 
     def _write_snapshot(self, new_gen: int, snap_bytes: bytes) -> None:
         tmp = self.snap_path + ".tmp"
@@ -197,6 +253,16 @@ class Journal:
             f.write(snap_bytes)
             os.fsync(f.fileno())
         os.replace(tmp, self.snap_path)
+        if self.fsync:
+            # make the rename itself durable (directory entry update)
+            try:
+                dfd = os.open(os.path.dirname(self.snap_path), os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:  # pragma: no cover — platform-dependent
+                pass
         # crash window here: old journal still on disk, but its jhead gen
         # no longer matches the snapshot, so recovery discards it
         if self._file is not None:
@@ -204,6 +270,8 @@ class Journal:
             self._file = None
         with open(self.journal_path, "wb") as f:
             _append_record(f, {"op": "jhead", "gen": new_gen})
+            if self.fsync:
+                os.fsync(f.fileno())
 
     # -- recovery -------------------------------------------------------------
 
@@ -281,8 +349,8 @@ class DurablePlane(MemoryPlane):
     """MemoryPlane + write-ahead journal; state survives server restarts."""
 
     def __init__(self, data_dir: str, latency: Optional[LatencyModel] = None,
-                 compact_every: int = 10_000):
-        self.journal = Journal(data_dir, compact_every)
+                 compact_every: int = 10_000, fsync: bool = True):
+        self.journal = Journal(data_dir, compact_every, fsync=fsync)
         self.kv = DurableKVStore(self.journal, latency)
         self.messaging = DurableMessaging(self.journal, latency)
         self.journal.attach(self)

@@ -225,15 +225,18 @@ class _Conn:
 
 class ControlPlaneServer:
     def __init__(self, host: str = "127.0.0.1", port: int = DEFAULT_PORT,
-                 data_dir: str = None):
+                 data_dir: str = None, fsync: bool = True):
         """data_dir enables durability: unleased KV state and work-queue
         contents journal to disk and survive a server restart (the etcd /
         JetStream file-store role; see transports/journal.py). Without it
-        the server is pure-memory, as before."""
+        the server is pure-memory, as before. fsync=True (default)
+        group-commits journal batches to stable storage and acks
+        queue_push only after the fsync — machine-crash durable; pass
+        False to trade that for lower push latency (flush-only)."""
         self.host, self.port = host, port
         if data_dir:
             from dynamo_tpu.runtime.transports.journal import DurablePlane
-            self.plane = DurablePlane(data_dir)
+            self.plane = DurablePlane(data_dir, fsync=fsync)
         else:
             self.plane = MemoryPlane()
         self.responders: Dict[str, _Conn] = {}
@@ -271,10 +274,15 @@ def main():
     ap.add_argument("--port", type=int, default=DEFAULT_PORT)
     ap.add_argument("--data-dir", default=None,
                     help="enable durability: journal KV + queues here")
+    ap.add_argument("--no-fsync", action="store_true",
+                    help="flush-only journal (faster pushes; an OS crash "
+                         "may lose acknowledged writes)")
     args = ap.parse_args()
-    logging.basicConfig(level=logging.INFO)
+    from dynamo_tpu.utils.logconfig import configure_logging
+    configure_logging()
     asyncio.run(ControlPlaneServer(
-        args.host, args.port, data_dir=args.data_dir).serve_forever())
+        args.host, args.port, data_dir=args.data_dir,
+        fsync=not args.no_fsync).serve_forever())
 
 
 if __name__ == "__main__":

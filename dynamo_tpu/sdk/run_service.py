@@ -63,7 +63,8 @@ async def amain() -> None:
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
     args = p.parse_args()
-    logging.basicConfig(level=logging.INFO)
+    from dynamo_tpu.utils.logconfig import configure_logging
+    configure_logging()
     # honor the allocator's JAX_PLATFORMS assignment programmatically:
     # this image pins the TPU tunnel in sitecustomize, so the env var
     # alone does not move host-only services onto CPU

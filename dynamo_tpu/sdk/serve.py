@@ -71,7 +71,8 @@ async def amain() -> None:
     p.add_argument("--tpu-chips", type=int, default=0,
                    help="chips available for resources={'tpu': n} services")
     args = p.parse_args()
-    logging.basicConfig(level=logging.INFO)
+    from dynamo_tpu.utils.logconfig import configure_logging
+    configure_logging()
 
     root = resolve(args.graph)
     specs = collect_graph(root)

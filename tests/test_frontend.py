@@ -191,6 +191,105 @@ class TestHttpService:
 
         run(main())
 
+    def test_tools_streaming_prose_passes_through_live(self):
+        """VERDICT r3 weak #5: a tools-carrying stream whose head cannot
+        be a tool-call dialect must stream LIVE, not buffer-to-finish.
+        The engine refuses to emit its second chunk until the client has
+        observed the first prose delta — only real passthrough (flush on
+        the non-candidate head) can complete this exchange."""
+        gate = asyncio.Event()
+
+        class GatedProseEngine(CounterEngine):
+            async def generate_chat(self, request, context):
+                gen_id, created = new_response_id("chatcmpl"), now()
+                yield ChatCompletionChunk(
+                    id=gen_id, created=created, model=request.model,
+                    choices=[ChatStreamChoice(
+                        index=0,
+                        delta={"role": "assistant", "content": "Sure — "})])
+                await gate.wait()  # held forever under buffer-to-finish
+                yield ChatCompletionChunk(
+                    id=gen_id, created=created, model=request.model,
+                    choices=[ChatStreamChoice(
+                        index=0, delta={"content": "42."})])
+                yield ChatCompletionChunk(
+                    id=gen_id, created=created, model=request.model,
+                    choices=[ChatStreamChoice(index=0, delta={},
+                                              finish_reason="stop")])
+
+        async def main():
+            svc = await HttpService("127.0.0.1", 0).start()
+            svc.models.add("m", GatedProseEngine())
+            body = {**CHAT_BODY, "stream": True,
+                    "tools": [{"type": "function",
+                               "function": {"name": "f"}}]}
+            content_deltas = []
+            async for ev, d in sse_events(
+                    "127.0.0.1", svc.port, "/v1/chat/completions", body):
+                if d == "[DONE]":
+                    break
+                c = json.loads(d)
+                for ch in c["choices"]:
+                    if ch["delta"].get("content"):
+                        content_deltas.append(ch["delta"]["content"])
+                        gate.set()  # first delta arrived mid-generation
+            assert content_deltas == ["Sure — ", "42."]
+            await svc.stop()
+
+        run(asyncio.wait_for(main(), timeout=30))
+
+    def test_tools_streaming_mid_text_tag_resolves_like_unary(self):
+        """A Hermes-style <tool_call> tag AFTER prose (the one dialect the
+        unary parser matches anywhere in the text) must still come back as
+        delta.tool_calls + finish 'tool_calls' even though the prose head
+        already streamed live — the stream-mode tag watch holds from the
+        first possible tag start."""
+        pieces = ["Let me check. ", "<tool",
+                  '_call>{"name": "f", "arguments": {"x": 1}}</tool_call>']
+
+        class MidTagEngine(CounterEngine):
+            async def generate_chat(self, request, context):
+                gen_id, created = new_response_id("chatcmpl"), now()
+                for piece in pieces:
+                    yield ChatCompletionChunk(
+                        id=gen_id, created=created, model=request.model,
+                        choices=[ChatStreamChoice(
+                            index=0,
+                            delta={"role": "assistant", "content": piece})])
+                yield ChatCompletionChunk(
+                    id=gen_id, created=created, model=request.model,
+                    choices=[ChatStreamChoice(index=0, delta={},
+                                              finish_reason="stop")])
+
+        async def main():
+            svc = await HttpService("127.0.0.1", 0).start()
+            svc.models.add("m", MidTagEngine())
+            body = {**CHAT_BODY, "stream": True,
+                    "tools": [{"type": "function",
+                               "function": {"name": "f"}}]}
+            deltas = []
+            async for ev, d in sse_events(
+                    "127.0.0.1", svc.port, "/v1/chat/completions", body):
+                if d == "[DONE]":
+                    break
+                c = json.loads(d)
+                deltas.extend(c["choices"])
+            # the prose head streamed as content
+            assert any(ch["delta"].get("content") == "Let me check. "
+                       for ch in deltas)
+            tool_delta = next(ch for ch in deltas
+                              if ch["delta"].get("tool_calls"))
+            tc = tool_delta["delta"]["tool_calls"][0]
+            assert tc["function"]["name"] == "f"
+            assert json.loads(tc["function"]["arguments"]) == {"x": 1}
+            assert deltas[-1]["finish_reason"] == "tool_calls"
+            # the raw tag text never leaked as content
+            assert not any("<tool_call>" in (ch["delta"].get("content")
+                                             or "") for ch in deltas)
+            await svc.stop()
+
+        run(asyncio.wait_for(main(), timeout=30))
+
     def test_streaming_sse_with_done(self):
         async def main():
             svc = await HttpService("127.0.0.1", 0).start()

@@ -92,11 +92,25 @@ def _q8_0(arr):
 D, HEADS, KV, HD, L, F = 32, 4, 2, 8, 2, 64
 
 
+# SPM vocab with full merge chains: score-driven BPE (the faithful
+# llama.cpp algorithm) builds tokens bottom-up from characters, so every
+# intermediate piece must exist; scores encode the merge-rank priority
+# (higher = merged earlier), chars/specials score 0
+_SPM_MERGE_ORDER = ["he", "lo", "hel", "hello", "▁hello",
+                    "wo", "wor", "worl", "world", "▁world",
+                    "th", "the", "▁the"]
+
+
 def _vocab():
     toks = ["<unk>", "<s>", "</s>"]
     toks += [f"<0x{b:02X}>" for b in range(256)]
-    toks += ["▁hello", "▁world", "▁the", "lo", "wor"]
+    toks += list("▁helowrdt") + _SPM_MERGE_ORDER
     return toks
+
+
+def _spm_scores(toks):
+    return [float(-(_SPM_MERGE_ORDER.index(t) + 1))
+            if t in _SPM_MERGE_ORDER else 0.0 for t in toks]
 
 
 def make_tiny_gguf(path, embed_type=_f32):
@@ -137,6 +151,7 @@ def make_tiny_gguf(path, embed_type=_f32):
         "llama.context_length": (4, 256),
         "tokenizer.ggml.model": (8, "llama"),
         "tokenizer.ggml.tokens": (9, (8, toks)),
+        "tokenizer.ggml.scores": (9, (6, _spm_scores(toks))),
         "tokenizer.ggml.bos_token_id": (4, 1),
         "tokenizer.ggml.eos_token_id": (4, 2),
     }
@@ -229,11 +244,201 @@ def test_model_card_from_gguf(tmp_path):
 
 
 def test_unsupported_quant_named(tmp_path):
-    path = str(tmp_path / "q4.gguf")
-    arr = np.zeros((2, 32), np.float32)
+    path = str(tmp_path / "q2.gguf")
+    arr = np.zeros((1, 256), np.float32)
     write_gguf(path, {"general.architecture": (8, "llama")},
-               {"w": (2, arr, b"\x00" * 40)})  # Q4_0
+               {"w": (10, arr, b"\x00" * 84)})  # Q2_K
     g = GGUFFile(path)
-    with pytest.raises(ValueError, match="Q4_0"):
+    with pytest.raises(ValueError, match="Q2_K"):
         g.tensor("w")
+    g.close()
+
+
+def _ref_dequant_q4_0(raw: bytes, n: int) -> np.ndarray:
+    """Scalar reference straight from the llama.cpp formulas (independent
+    of the vectorized implementation under test)."""
+    out = np.empty(n, np.float32)
+    for b in range(n // 32):
+        blk = raw[b * 18:(b + 1) * 18]
+        d = np.frombuffer(blk[:2], np.float16)[0].astype(np.float32)
+        qs = blk[2:]
+        for i in range(16):
+            out[b * 32 + i] = ((qs[i] & 0x0F) - 8) * d
+            out[b * 32 + 16 + i] = ((qs[i] >> 4) - 8) * d
+    return out
+
+
+def _ref_dequant_q4_k(raw: bytes, n: int) -> np.ndarray:
+    def scale_min(j, sc):
+        if j < 4:
+            return sc[j] & 63, sc[j + 4] & 63
+        return ((sc[j + 4] & 0x0F) | ((sc[j - 4] >> 6) << 4),
+                (sc[j + 4] >> 4) | ((sc[j] >> 6) << 4))
+
+    out = np.empty(n, np.float32)
+    for b in range(n // 256):
+        blk = raw[b * 144:(b + 1) * 144]
+        d = np.frombuffer(blk[0:2], np.float16)[0].astype(np.float32)
+        dmin = np.frombuffer(blk[2:4], np.float16)[0].astype(np.float32)
+        sc = blk[4:16]
+        qs = blk[16:]
+        y = b * 256
+        for j64 in range(4):  # 64 values per strip
+            s1, m1 = scale_min(2 * j64, sc)
+            s2, m2 = scale_min(2 * j64 + 1, sc)
+            q = qs[j64 * 32:(j64 + 1) * 32]
+            for l in range(32):
+                out[y + l] = d * s1 * (q[l] & 0x0F) - dmin * m1
+                out[y + 32 + l] = d * s2 * (q[l] >> 4) - dmin * m2
+            y += 64
+    return out
+
+
+def _ref_dequant_q6_k(raw: bytes, n: int) -> np.ndarray:
+    out = np.empty(n, np.float32)
+    for b in range(n // 256):
+        blk = raw[b * 210:(b + 1) * 210]
+        ql, qh = blk[:128], blk[128:192]
+        sc = np.frombuffer(blk[192:208], np.int8)
+        d = np.frombuffer(blk[208:210], np.float16)[0].astype(np.float32)
+        y = b * 256
+        for half in range(2):
+            lo, h = ql[half * 64:half * 64 + 64], qh[half * 32:half * 32 + 32]
+            s = sc[half * 8:half * 8 + 8]
+            for l in range(32):
+                i = l // 16
+                q1 = ((lo[l] & 0x0F) | (((h[l] >> 0) & 3) << 4)) - 32
+                q2 = ((lo[l + 32] & 0x0F) | (((h[l] >> 2) & 3) << 4)) - 32
+                q3 = ((lo[l] >> 4) | (((h[l] >> 4) & 3) << 4)) - 32
+                q4 = ((lo[l + 32] >> 4) | (((h[l] >> 6) & 3) << 4)) - 32
+                out[y + l] = d * s[i] * q1
+                out[y + 32 + l] = d * s[i + 2] * q2
+                out[y + 64 + l] = d * s[i + 4] * q3
+                out[y + 96 + l] = d * s[i + 6] * q4
+            y += 128
+    return out
+
+
+@pytest.mark.parametrize("gtype,name,block_bytes,block_vals,ref", [
+    (2, "Q4_0", 18, 32, _ref_dequant_q4_0),
+    (12, "Q4_K", 144, 256, _ref_dequant_q4_k),
+    (14, "Q6_K", 210, 256, _ref_dequant_q6_k),
+])
+def test_quant_dequant_matches_scalar_reference(tmp_path, gtype, name,
+                                                block_bytes, block_vals,
+                                                ref):
+    """VERDICT r3 #5: Q4_0/Q4_K/Q6_K dequant — the vectorized loader must
+    agree bit-for-bit with a scalar re-derivation of the llama.cpp block
+    formulas on random block bytes."""
+    rng = np.random.RandomState(7 + gtype)
+    n = 2 * block_vals
+    raw = rng.randint(0, 256, 2 * block_bytes, dtype=np.uint8)
+    # keep the f16 scale fields finite (random bytes can encode NaN/inf)
+    for base in range(0, len(raw), block_bytes):
+        f16 = np.float16(rng.uniform(-2, 2))
+        scale_off = base + (208 if gtype == 14 else 0)
+        raw[scale_off:scale_off + 2] = np.frombuffer(
+            f16.tobytes(), np.uint8)
+        if gtype == 12:  # dmin
+            raw[base + 2:base + 4] = np.frombuffer(
+                np.float16(rng.uniform(0, 1)).tobytes(), np.uint8)
+    path = str(tmp_path / "q.gguf")
+    arr = np.zeros((2, block_vals), np.float32)
+    write_gguf(path, {"general.architecture": (8, "llama")},
+               {"w": (gtype, arr, raw.tobytes())})
+    g = GGUFFile(path)
+    got = g.tensor("w").reshape(-1)
+    want = ref(raw.tobytes(), n)
+    np.testing.assert_allclose(got, want, rtol=0, atol=0)
+    g.close()
+
+
+def _byte_level_vocab_and_merges():
+    """A tiny byte-level BPE: the 256-char ByteLevel alphabet as base
+    tokens plus a few merges (enough to check merge application and the
+    Ġ space convention)."""
+    from tokenizers import pre_tokenizers
+    alphabet = sorted(pre_tokenizers.ByteLevel.alphabet())
+    toks = list(alphabet)
+    merges = [("h", "e"), ("l", "l"), ("he", "ll"), ("hell", "o"),
+              ("Ġ", "w"), ("o", "r"), ("Ġw", "or"), ("l", "d"),
+              ("Ġwor", "ld")]
+    for a, b in merges:
+        toks.append(a + b)
+    return toks, [f"{a} {b}" for a, b in merges]
+
+
+def test_gpt2_gguf_tokenizer_matches_hf(tmp_path):
+    """ADVICE r3 medium + VERDICT r3 #5: a gpt2-model GGUF (llama-3/qwen2
+    style byte-level BPE with Ġ markers, no <0xXX> tokens) must tokenize
+    via real merges — byte-for-byte the ids an HF tokenizer built from
+    the same vocab+merges produces — instead of degrading to
+    unk-per-char on spaces."""
+    from tokenizers import Regex, Tokenizer, decoders, models
+    from tokenizers import pre_tokenizers as pt
+
+    toks, merges = _byte_level_vocab_and_merges()
+    special = "<|eot|>"
+    toks.append(special)
+    types = [1] * (len(toks) - 1) + [3]  # last token is control
+    path = str(tmp_path / "bpe.gguf")
+    write_gguf(path, {
+        "general.architecture": (8, "llama"),
+        "tokenizer.ggml.model": (8, "gpt2"),
+        "tokenizer.ggml.pre": (8, "llama-bpe"),
+        "tokenizer.ggml.tokens": (9, (8, toks)),
+        "tokenizer.ggml.merges": (9, (8, merges)),
+        "tokenizer.ggml.token_type": (9, (5, types)),
+        "tokenizer.ggml.eos_token_id": (4, len(toks) - 1),
+    }, {})
+    tok = GGUFTokenizer(GGUFFile(path))
+
+    # independent HF construction from the same vocab+merges (the
+    # reference's conversion target, gguf_tokenizer.rs:234)
+    pat = (r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|"
+           r"\p{N}{1,3}| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+")
+    hf = Tokenizer(models.BPE(
+        vocab={t: i for i, t in enumerate(toks)},
+        merges=[tuple(m.split(" ", 1)) for m in merges],
+        ignore_merges=True))
+    hf.pre_tokenizer = pt.Sequence([
+        pt.Split(Regex(pat), behavior="isolated"),
+        pt.ByteLevel(add_prefix_space=False, use_regex=False)])
+    hf.decoder = decoders.ByteLevel()
+
+    for text in ("hello world", "hello   world!", "I'm 12345 ok",
+                 "héllo wörld", "line\nbreak  x"):
+        assert tok.encode(text) == hf.encode(text).ids, text
+        assert tok.decode(tok.encode(text)) == text, text
+
+    # spaces must ride Ġ merges, not unk-per-char (the ADVICE bug)
+    ids = tok.encode("hello world")
+    assert toks.index("hello") in ids
+    assert toks.index("Ġworld") in ids
+
+    # control tokens encode atomically
+    ids2 = tok.encode(f"hello{special}")
+    assert ids2[-1] == len(toks) - 1
+
+
+def test_unknown_tokenizer_model_rejected(tmp_path):
+    path = str(tmp_path / "wp.gguf")
+    write_gguf(path, {
+        "general.architecture": (8, "llama"),
+        "tokenizer.ggml.model": (8, "bert"),
+        "tokenizer.ggml.tokens": (9, (8, ["a", "b"])),
+    }, {})
+    with pytest.raises(ValueError, match="bert"):
+        GGUFTokenizer(GGUFFile(path))
+
+
+def test_config_from_gguf_names_missing_keys(tmp_path):
+    path = str(tmp_path / "trunc.gguf")
+    write_gguf(path, {
+        "general.architecture": (8, "llama"),
+        "llama.embedding_length": (4, 32),
+    }, {})
+    g = GGUFFile(path)
+    with pytest.raises(ValueError, match="llama.attention.head_count"):
+        config_from_gguf(g)
     g.close()

@@ -129,3 +129,119 @@ def test_manifest_tpu_resources(tmp_path, monkeypatch):
     c = dep["spec"]["template"]["spec"]["containers"][0]
     assert c["resources"]["limits"]["google.com/tpu"] == "4"
     assert dep["spec"]["replicas"] == 2
+
+
+def test_validate_manifests_catches_render_bugs():
+    """VERDICT r3 #10: rendered YAML is schema-validated before writing."""
+    import pytest
+
+    from dynamo_tpu.sdk.build import validate_manifests
+
+    good = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "d", "namespace": "ns"},
+        "spec": {"replicas": 1,
+                 "selector": {"matchLabels": {"app": "d"}},
+                 "template": {
+                     "metadata": {"labels": {"app": "d"}},
+                     "spec": {"containers": [
+                         {"name": "c", "image": "img",
+                          "resources": {"limits": {"cpu": "1"}}}]}}},
+    }
+    validate_manifests([good])
+
+    import copy
+    broken = copy.deepcopy(good)
+    broken["spec"]["selector"]["matchLabels"]["app"] = "other"
+    with pytest.raises(ValueError, match="selector"):
+        validate_manifests([broken])
+
+    broken = copy.deepcopy(good)
+    del broken["spec"]["template"]["spec"]["containers"][0]["image"]
+    with pytest.raises(ValueError, match="name\\+image"):
+        validate_manifests([broken])
+
+    broken = copy.deepcopy(good)
+    broken["spec"]["template"]["spec"]["containers"][0]["resources"] = {
+        "limits": {"google.com/tpu": 4.5}}
+    with pytest.raises(ValueError, match="quantity"):
+        validate_manifests([broken])
+
+    with pytest.raises(ValueError, match="missing apiVersion"):
+        validate_manifests([{"kind": "Service", "metadata": {"name": "s"}}])
+
+
+def test_reconcile_loop_applies_on_drift(tmp_path, monkeypatch):
+    """VERDICT r3 #10 operator-lite: `deploy --watch` applies manifests,
+    stays idle in sync, and re-applies on cluster drift (scale-down) —
+    the reconcile role of the reference's Go operator
+    (dynamodeployment_controller.go), closed with idempotent kubectl
+    apply."""
+    import json as _json
+    import stat
+
+    monkeypatch.chdir(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from dynamo_tpu.sdk.build import render_manifests
+    from dynamo_tpu.sdk.reconcile import Reconciler
+
+    graph = "examples.disagg.graph:Frontend"
+    desired = render_manifests(graph, "img:v1")
+    deployments = [m for m in desired if m["kind"] == "Deployment"]
+
+    # stub kubectl: records invocations; `get deployments` serves a state
+    # file the test mutates to simulate the cluster
+    state = tmp_path / "cluster.json"
+    calls = tmp_path / "calls.log"
+    stub = tmp_path / "kubectl"
+
+    def cluster_state(scale_override=None, drop=None):
+        items = []
+        for m in deployments:
+            name = m["metadata"]["name"]
+            if name == drop:
+                continue
+            reps = m["spec"]["replicas"]
+            if scale_override and name in scale_override:
+                reps = scale_override[name]
+            items.append({
+                "metadata": {"name": name},
+                "spec": {"replicas": reps,
+                         "template": m["spec"]["template"]},
+                "status": {"readyReplicas": reps},
+            })
+        state.write_text(_json.dumps({"items": items}))
+
+    stub.write_text(f"""#!/bin/sh
+echo "$@" >> {calls}
+case "$1" in
+  get) cat {state} ;;
+  apply) : ;;
+esac
+""")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+
+    rec = Reconciler(graph, "img:v1", str(tmp_path / "k8s"),
+                     kubectl=str(stub))
+    cluster_state()
+    out1 = rec.step()  # first tick: initial apply
+    assert out1["applied"] and out1["reasons"] == ["initial apply"]
+    out2 = rec.step()  # in sync: no apply
+    assert not out2["applied"]
+    assert all(s.count("/") == 1 for s in out2["status"].values())
+
+    # drift: someone scaled a worker down by hand -> re-apply
+    victim = deployments[-1]["metadata"]["name"]
+    cluster_state(scale_override={victim: 0})
+    out3 = rec.step()
+    assert out3["applied"]
+    assert any("replicas 0" in r for r in out3["reasons"])
+
+    # drift: a Deployment was deleted -> re-apply
+    cluster_state(drop=victim)
+    out4 = rec.step()
+    assert out4["applied"] and any("missing" in r for r in out4["reasons"])
+
+    applies = [ln for ln in calls.read_text().splitlines()
+               if ln.startswith("apply")]
+    assert len(applies) == 3
