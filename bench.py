@@ -57,6 +57,7 @@ PHASE_STALL_S = {
     "decode_chunks": 120.0,  # refreshed per chunk
     "ttft": 150.0,
     "churn": 150.0,
+    "parity": 300.0,         # second engine build + single-step compiles
 }
 
 STALL_SCALE = float(os.environ.get("BENCH_STALL_SCALE", "1"))  # test hook
@@ -476,6 +477,48 @@ def worker():
     log(f"agg-under-churn {agg_tok_s:.1f} tok/s/chip vs pure decode "
         f"{pure:.1f}; decode-side disagg gain bound "
         f"{pure / max(agg_tok_s, 1e-9):.2f}x")
+
+    st.set_phase("parity")
+    log("phase: TPU numerical parity — 64-step split-KV window vs the "
+        "single-step decode path, token-for-token greedy (VERDICT r3 #3; "
+        "CPU tests can't see Mosaic/XLA-TPU divergence)")
+    if time.time() - T0 > BUDGET_S - 120:
+        log("approaching deadline; skipping parity phase")
+        st.result["extras"]["parity"] = "skipped"
+        st.set_phase("done")
+        return
+    # the window side: the measurement engine itself (decode_steps=64,
+    # split-KV pregather + deferred writeback + adaptive ladder), on a
+    # fresh prompt so no prefix/cache state from the perf phases leaks in.
+    # 96 tokens crosses a page boundary and exercises multiple ladder
+    # rungs (64 + smaller tails).
+    for rid in list(engine.scheduler.params):
+        engine.abort(rid)
+    while engine.has_work():
+        engine.step()
+    par_prompt = [(31 * j) % 1000 + 1 for j in range(64)]
+    par_params = SamplingParams(max_tokens=96, temperature=0.0,
+                                ignore_eos=True)
+    got = engine.generate(par_prompt, par_params, "parity-window")
+    del engine  # free HBM before building the single-step twin
+    st.touch()
+    cfg1 = EngineConfig(
+        page_size=64, num_pages=256, max_slots=slots, max_prefill_chunk=128,
+        prefill_buckets=(128,), max_model_len=2048, decode_steps=1,
+        max_prefill_batch=8)
+    e1 = NativeEngine(model_cfg, cfg1, seed=0)   # same seed => same params
+    st.touch()
+    ref = e1.generate(par_prompt, par_params, "parity-single")
+    if got == ref:
+        st.result["extras"]["parity"] = f"exact({len(ref)} tokens)"
+        log(f"parity OK: {len(ref)} greedy tokens identical")
+    else:
+        div = next((i for i, (a, b) in enumerate(zip(got, ref))
+                    if a != b), min(len(got), len(ref)))
+        st.result["extras"]["parity"] = f"DIVERGED@{div}"
+        log(f"parity FAILURE at token {div}: window={got[:div + 3]} "
+            f"single={ref[:div + 3]}")
+    st.touch()
     st.set_phase("done")
 
 

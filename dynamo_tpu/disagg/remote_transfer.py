@@ -142,18 +142,18 @@ class KvTransferServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
-            # non-blocking sentinel: if the consumer died anyway (bug,
-            # cancellation), a full queue must not block cleanup forever —
-            # make room, then deliver the sentinel
-            while True:
+            # deliver the shutdown sentinel without ever blocking on a
+            # dead consumer — but never by discarding a real frame a LIVE
+            # consumer still has to inject (that would corrupt the
+            # migrated prefix and desync acks). Back off while the live
+            # consumer drains; a consumer that already exited (crash/
+            # cancellation) needs no sentinel at all.
+            while not consumer.done():
                 try:
                     frames.put_nowait(None)
                     break
                 except asyncio.QueueFull:
-                    try:
-                        frames.get_nowait()
-                    except asyncio.QueueEmpty:
-                        pass
+                    await asyncio.sleep(0.01)
             await consumer
             writer.close()
 

@@ -152,6 +152,7 @@ class Journal:
         self.journal_path = os.path.join(data_dir, "journal.bin")
         self.compact_every = compact_every
         self.fsync = fsync
+        self._closed = False
         self._since_compact = 0
         self._gen = 0
         self._file: Optional[io.BufferedWriter] = None
@@ -173,6 +174,13 @@ class Journal:
         # enqueued before a pending compaction never land under the new
         # generation (which would discard them on recovery)
         fut = concurrent.futures.Future() if ack else None
+        if self._closed:
+            # a record enqueued after close() would never be processed —
+            # fail fast instead of letting an ack-awaiting queue_push hang
+            # its connection handler forever
+            if fut is not None:
+                fut.set_exception(RuntimeError("journal is closed"))
+            return fut
         self._q.put(("rec", (msgpack.packb(rec), self._gen, fut)))
         self._since_compact += 1
         if self._since_compact >= self.compact_every:
@@ -341,6 +349,7 @@ class Journal:
 
     def close(self) -> None:
         """Drain all pending writes and stop the writer thread."""
+        self._closed = True
         self._q.put(None)
         self._writer.join(timeout=30)
 

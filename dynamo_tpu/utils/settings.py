@@ -33,6 +33,19 @@ def _parse_scalar(text: str) -> Any:
         return text
 
 
+def _load_toml(body: str) -> Dict[str, Any]:
+    try:
+        import tomllib  # 3.11+
+    except ImportError:  # pragma: no cover — 3.10 fallback
+        try:
+            import tomli as tomllib
+        except ImportError as e:
+            raise RuntimeError(
+                "TOML config requires Python >= 3.11 (tomllib) or the "
+                "tomli package; use YAML or JSON instead") from e
+    return tomllib.loads(body)
+
+
 def _read_config_file(path: str) -> Dict[str, Any]:
     with open(path) as f:
         body = f.read()
@@ -40,8 +53,7 @@ def _read_config_file(path: str) -> Dict[str, Any]:
         import yaml
         return yaml.safe_load(body) or {}
     if path.endswith(".toml"):
-        import tomllib
-        return tomllib.loads(body)
+        return _load_toml(body)
     if path.endswith(".json"):
         return json.loads(body)
     # extension-less: try JSON, then YAML, then TOML
@@ -56,8 +68,7 @@ def _read_config_file(path: str) -> Dict[str, Any]:
             return out
     except Exception:  # noqa: BLE001 — fall through to TOML
         pass
-    import tomllib
-    return tomllib.loads(body)
+    return _load_toml(body)
 
 
 def _deep_merge(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
