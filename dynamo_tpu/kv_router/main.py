@@ -96,14 +96,19 @@ async def _amain(args) -> None:
 
 
 def main() -> None:
+    # layered defaults <- DYN_CONFIG file <- DYN_* env <- CLI flags
+    # (utils/settings.py; e.g. DYN_ROUTER__BLOCK_SIZE=128)
+    from dynamo_tpu.utils.settings import load_settings
+    s = load_settings({"router": {
+        "coordinator": "127.0.0.1:6230", "block_size": 64}}).router
     ap = argparse.ArgumentParser(description="dynamo-tpu standalone router")
-    ap.add_argument("--coordinator", default="127.0.0.1:6230")
+    ap.add_argument("--coordinator", default=s.coordinator)
     ap.add_argument("--namespace", required=True)
     ap.add_argument("--component", required=True,
                     help="worker component to route over")
     ap.add_argument("--router-component", default="router")
     ap.add_argument("--endpoint", default="generate")
-    ap.add_argument("--block-size", type=int, default=64)
+    ap.add_argument("--block-size", type=int, default=s.block_size)
     args = ap.parse_args()
     from dynamo_tpu.utils.logconfig import configure_logging
     configure_logging()

@@ -89,16 +89,25 @@ class _LogprobShaper:
 
 
 class Pipeline:
-    """Shared OpenAI-facing plumbing; subclasses provide the token stream."""
+    """Shared OpenAI-facing plumbing over a composable node graph.
+
+    The token-frame flow is a runtime.pipeline Segment
+    (Source -> Operator* -> Sink; reference nodes.rs:72-209): subclasses
+    link an engine Sink, callers may link extra Operators (tracing,
+    shadowing, routing), and discovery can hot-swap the sink via
+    `pipeline.segment.set_sink(...)` without touching OpenAI-side state.
+    """
 
     def __init__(self, card: ModelDeploymentCard):
         self.card = card
         self.preprocessor = OpenAIPreprocessor(card)
+        from dynamo_tpu.runtime.pipeline import Segment
+        self.segment = Segment()
 
     async def _token_stream(self, pre: PreprocessedRequest,
                             context: Context) -> AsyncIterator[dict]:
-        raise NotImplementedError
-        yield  # pragma: no cover
+        async for frame in self.segment.generate(pre, context):
+            yield frame
 
     # -- OpenAIEngine interface ----------------------------------------------
 
@@ -228,35 +237,27 @@ class Pipeline:
             yield gen.usage_chunk(usage)
 
 
-class LocalPipeline(Pipeline):
-    """Engine lives in-process (single-node serve, `run in=http out=native`)."""
+class LocalEngineSink:
+    """Sink node: an in-process AsyncEngine."""
 
-    def __init__(self, card: ModelDeploymentCard, engine: AsyncEngine):
-        super().__init__(card)
+    def __init__(self, engine: AsyncEngine):
         self.engine = engine
 
-    async def _token_stream(self, pre, context):
+    async def generate(self, pre, context):
         async for frame in self.engine.generate(
                 pre.model_dump(exclude_none=True), context):
             yield frame
 
 
-class RemotePipeline(Pipeline):
-    """Engine is a remote worker endpoint; optionally KV-aware routed.
+class RemoteEngineSink:
+    """Sink node: a remote worker endpoint, optionally KV-aware routed."""
 
-    This is what the discovery watcher builds per registered model: a runtime
-    Client plus (optionally) a KvRouter that picks the worker holding the
-    longest cached prefix (reference: discovery.rs:58-145 + kv_router).
-    """
-
-    def __init__(self, card: ModelDeploymentCard, client,
-                 router=None, policy: str = "round_robin"):
-        super().__init__(card)
+    def __init__(self, client, router=None, policy: str = "round_robin"):
         self.client = client
         self.router = router
         self.policy = policy
 
-    async def _token_stream(self, pre, context):
+    async def generate(self, pre, context):
         instance = None
         if self.router is not None:
             try:
@@ -269,3 +270,31 @@ class RemotePipeline(Pipeline):
             instance=instance, policy=self.policy)
         async for frame in stream:
             yield frame
+
+
+class LocalPipeline(Pipeline):
+    """Engine lives in-process (single-node serve, `run in=http out=native`)."""
+
+    def __init__(self, card: ModelDeploymentCard, engine: AsyncEngine):
+        super().__init__(card)
+        self.engine = engine
+        self.segment.link(LocalEngineSink(engine).generate)
+
+
+class RemotePipeline(Pipeline):
+    """Engine is a remote worker endpoint; optionally KV-aware routed.
+
+    This is what the discovery watcher builds per registered model: a runtime
+    Client plus (optionally) a KvRouter that picks the worker holding the
+    longest cached prefix (reference: discovery.rs:58-145 + kv_router).
+    The sink is a graph node, so discovery can rebind the model to a new
+    client/router with `pipeline.segment.set_sink(...)` in place.
+    """
+
+    def __init__(self, card: ModelDeploymentCard, client,
+                 router=None, policy: str = "round_robin"):
+        super().__init__(card)
+        self.client = client
+        self.router = router
+        self.policy = policy
+        self.segment.link(RemoteEngineSink(client, router, policy).generate)

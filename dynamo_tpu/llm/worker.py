@@ -34,17 +34,26 @@ _PROFILE_OWNER = None
 def _to_engine_request(pre: PreprocessedRequest) -> EngineRequest:
     s, st, out = pre.sampling, pre.stop, pre.output
     mm_pixels = None
+    mm_spans = None
     if pre.mm_parts:
         import numpy as np
-        mm_pixels = [
-            (p.offset,
-             np.frombuffer(p.data, dtype=np.dtype(p.dtype))
-             .reshape(p.shape).astype(np.float32))
-            for p in pre.mm_parts]
+        mm_pixels, mm_spans = [], []
+        for p in pre.mm_parts:
+            arr = (np.frombuffer(p.data, dtype=np.dtype(p.dtype))
+                   .reshape(p.shape).astype(np.float32))
+            if p.kind == "embeds" and p.salt is not None:
+                # pre-encoded patch embeds + transfer-invariant salt
+                # (disagg mm_transfer="embeds"): no vision tower run here
+                mm_spans.append((p.offset, arr, int(p.salt)))
+            else:
+                mm_pixels.append((p.offset, arr))
+        mm_pixels = mm_pixels or None
+        mm_spans = mm_spans or None
     return EngineRequest(
         request_id=pre.request_id,
         prompt=list(pre.token_ids),
         mm_pixels=mm_pixels,
+        mm_spans=mm_spans,
         params=SamplingParams(
             max_tokens=st.max_tokens or 16,
             temperature=s.temperature if s.temperature is not None else 0.0,

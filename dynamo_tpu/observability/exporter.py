@@ -202,13 +202,19 @@ async def _amain(args) -> None:
 
 
 def main() -> None:
+    # layered defaults <- DYN_CONFIG file <- DYN_* env <- CLI flags
+    # (utils/settings.py; e.g. DYN_METRICS__PORT=9095)
+    from dynamo_tpu.utils.settings import load_settings
+    s = load_settings({"metrics": {
+        "coordinator": "127.0.0.1:6230", "port": 9091,
+        "interval": 0.5}}).metrics
     ap = argparse.ArgumentParser(description="dynamo-tpu metrics exporter")
-    ap.add_argument("--coordinator", default="127.0.0.1:6230")
+    ap.add_argument("--coordinator", default=s.coordinator)
     ap.add_argument("--namespace", required=True)
     ap.add_argument("--component", required=True)
     ap.add_argument("--endpoint", default="generate")
-    ap.add_argument("--port", type=int, default=9091)
-    ap.add_argument("--interval", type=float, default=0.5)
+    ap.add_argument("--port", type=int, default=s.port)
+    ap.add_argument("--interval", type=float, default=s.interval)
     args = ap.parse_args()
     from dynamo_tpu.utils.logconfig import configure_logging
     configure_logging()

@@ -61,11 +61,21 @@ class DistributedRuntime:
     @classmethod
     async def connect(cls, host: str = "127.0.0.1", port: int = 6230,
                       worker_id: Optional[str] = None,
-                      advertise_host: str = "127.0.0.1"
-                      ) -> "DistributedRuntime":
-        """Connect to a standalone control-plane server."""
+                      advertise_host: str = "127.0.0.1",
+                      addrs=None) -> "DistributedRuntime":
+        """Connect to a standalone control-plane server.
+
+        HA pairs: pass `addrs=[(h1, p1), (h2, p2)]` — or a comma list in
+        `host` ("h1:p1,h2:p2", the DYN_COORD_ADDR form) — and the client
+        follows whichever member is primary, riding out a failover window
+        (transports/server.py standby_of)."""
         from dynamo_tpu.runtime.transports.tcp import ControlPlaneClient
-        client = await ControlPlaneClient(host, port).connect()
+        if addrs is None and "," in host:
+            addrs = []
+            for part in host.split(","):
+                h, _, p = part.strip().rpartition(":")
+                addrs.append((h or "127.0.0.1", int(p) if p else port))
+        client = await ControlPlaneClient(host, port, addrs=addrs).connect()
         rt = cls(client, client, worker_id, advertise_host)
         rt._client = client
         await rt._init_lease()

@@ -166,6 +166,10 @@ class Journal:
 
     def attach(self, plane: MemoryPlane) -> None:
         self._plane = plane
+        # replication tee (transports/ha role): called on the event-loop
+        # side with every persistent-mutation record, in append order —
+        # the hot-standby fanout point (server._fanout_record)
+        self.on_record = None
 
     def append(self, rec: dict, ack: bool = False
                ) -> Optional[concurrent.futures.Future]:
@@ -173,6 +177,9 @@ class Journal:
         # writer stamps a fresh journal's jhead from it, so records
         # enqueued before a pending compaction never land under the new
         # generation (which would discard them on recovery)
+        tee = getattr(self, "on_record", None)
+        if tee is not None:
+            tee(rec)
         fut = concurrent.futures.Future() if ack else None
         if self._closed:
             # a record enqueued after close() would never be processed —
@@ -354,6 +361,27 @@ class Journal:
         self._writer.join(timeout=30)
 
 
+async def apply_replicated(plane: "DurablePlane", rec: dict) -> None:
+    """Apply one replicated journal record through the plane's DURABLE
+    write paths, so a standby journals (and fsyncs) everything it applies
+    and can itself be restarted or promoted with no loss (transports/ha).
+    """
+    op = rec["op"]
+    if op == "put":
+        await plane.kv.put(rec["key"], rec["value"])
+    elif op == "del":
+        await plane.kv.delete(rec["key"])
+    elif op == "qpush":
+        await plane.messaging.queue_push(rec["queue"], rec["payload"])
+    elif op == "qpop":
+        q = plane.messaging._queues[rec["queue"]]
+        if not q.empty():
+            q.get_nowait()
+            plane.journal.append({"op": "qpop", "queue": rec["queue"]})
+    # jhead/unknown ops: compaction artifacts of the PRIMARY's journal —
+    # meaningless on the standby's own journal, skipped
+
+
 class DurablePlane(MemoryPlane):
     """MemoryPlane + write-ahead journal; state survives server restarts."""
 
@@ -366,6 +394,35 @@ class DurablePlane(MemoryPlane):
         n = self.journal.recover_into(self.kv, self.messaging)
         if n or os.path.exists(self.journal.snap_path):
             log.info("control-plane state recovered (%d journal records)", n)
+
+    def snapshot_state(self) -> dict:
+        """Persistent state as one transferable dict (replication bootstrap:
+        what a freshly-subscribed standby loads before streaming records).
+        Same content as the compaction snapshot: unleased KV + queues."""
+        return {
+            "kv": [[k, e.value] for k, e in sorted(self.kv._data.items())
+                   if not e.lease_id],
+            "queues": [[name, list(q._queue)]
+                       for name, q in self.messaging._queues.items()
+                       if q.qsize()],
+        }
+
+    async def load_snapshot(self, snap: dict) -> None:
+        """Replace persistent state with a primary's snapshot (standby
+        bootstrap), writing it through the durable paths so the standby's
+        own journal captures it."""
+        for key in [k for k, e in self.kv._data.items() if not e.lease_id]:
+            await self.kv.delete(key)
+        for name in list(self.messaging._queues):
+            q = self.messaging._queues[name]
+            while not q.empty():
+                q.get_nowait()
+                self.journal.append({"op": "qpop", "queue": name})
+        for key, value in snap.get("kv", []):
+            await self.kv.put(key, value)
+        for name, items in snap.get("queues", []):
+            for item in items:
+                await self.messaging.queue_push(name, item)
 
     def close(self) -> None:
         self.journal.close()

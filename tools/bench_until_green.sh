@@ -1,0 +1,33 @@
+#!/bin/sh
+# Retry bench.py until it captures a nonzero TPU number, then save the
+# result (+ log) as BENCH_SELF_r04.json / .log. The axon tunnel can stall
+# for hours; one supervisor run already retries internally (escalating
+# per-phase budgets), this loop spans tunnel outages across runs.
+# Usage: nohup tools/bench_until_green.sh & (repo root; single instance!)
+cd "$(dirname "$0")/.." || exit 1
+i=0
+while true; do
+  i=$((i + 1))
+  echo "[bench-retry] run $i: $(date -u +%H:%M:%S)" >&2
+  rm -f .bench_state.json
+  BENCH_BUDGET_S=${BENCH_BUDGET_S:-2400} python bench.py \
+      >/tmp/bench_try.json 2>/tmp/bench_try.log
+  value=$(python -c "import json;print(json.load(open('/tmp/bench_try.json'))['value'])" \
+      2>/dev/null || echo 0)
+  case "$value" in
+    0|0.0|"") echo "[bench-retry] run $i got no number; retrying" >&2 ;;
+    *)
+      stamp=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+      python - "$stamp" <<'EOF'
+import json, sys
+r = json.load(open("/tmp/bench_try.json"))
+r["timestamp"] = sys.argv[1]
+r["self_measured"] = True
+json.dump(r, open("BENCH_SELF_r04.json", "w"), indent=1)
+EOF
+      cp /tmp/bench_try.log BENCH_SELF_r04.log
+      echo "[bench-retry] captured $value tok/s/chip at $stamp" >&2
+      exit 0 ;;
+  esac
+  sleep 60
+done
