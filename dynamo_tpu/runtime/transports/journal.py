@@ -155,11 +155,19 @@ class Journal:
         self._closed = False
         self._since_compact = 0
         self._gen = 0
+        # fencing epoch (transports/ha): bumped on every standby promotion,
+        # persisted here so a restarted member rejoins at the epoch it held.
+        # 0 = never recorded (fresh data dir); the server treats that as 1.
+        self.epoch = 0
         self._file: Optional[io.BufferedWriter] = None
         self._plane: Optional[MemoryPlane] = None
         import queue as _queue
         import threading as _threading
         self._q: "_queue.Queue" = _queue.Queue()
+        # serializes append() against close(): without it a record can be
+        # enqueued after the None sentinel (writer already stopping) and
+        # silently never hit disk — with an ack future that never resolves
+        self._close_lock = _threading.Lock()
         self._writer = _threading.Thread(
             target=self._writer_loop, name="cp-journal", daemon=True)
         self._writer.start()
@@ -177,18 +185,23 @@ class Journal:
         # writer stamps a fresh journal's jhead from it, so records
         # enqueued before a pending compaction never land under the new
         # generation (which would discard them on recovery)
-        tee = getattr(self, "on_record", None)
-        if tee is not None:
-            tee(rec)
         fut = concurrent.futures.Future() if ack else None
-        if self._closed:
-            # a record enqueued after close() would never be processed —
-            # fail fast instead of letting an ack-awaiting queue_push hang
-            # its connection handler forever
-            if fut is not None:
-                fut.set_exception(RuntimeError("journal is closed"))
-            return fut
-        self._q.put(("rec", (msgpack.packb(rec), self._gen, fut)))
+        with self._close_lock:
+            # checked and enqueued under the same lock close() takes, so a
+            # record can never slip in behind the shutdown sentinel (where
+            # it would silently vanish and an ack future would never
+            # resolve) — ADVICE r4. The replication tee lives under the
+            # same gate: a record the closed journal refuses must not be
+            # streamed to standbys either (they would journal a write the
+            # primary never persisted — divergent histories).
+            if self._closed:
+                if fut is not None:
+                    fut.set_exception(RuntimeError("journal is closed"))
+                return fut
+            tee = getattr(self, "on_record", None)
+            if tee is not None:
+                tee(rec)
+            self._q.put(("rec", (msgpack.packb(rec), self._gen, fut)))
         self._since_compact += 1
         if self._since_compact >= self.compact_every:
             self.compact()
@@ -297,6 +310,7 @@ class Journal:
         if os.path.exists(self.snap_path):
             for rec in _read_records(self.snap_path):
                 snap_gen = rec.get("gen", 0)
+                self.epoch = rec.get("epoch", 0)
                 for key, value in rec.get("kv", []):
                     kv._data_restore(key, value)
                 for queue, items in rec.get("queues", []):
@@ -328,6 +342,8 @@ class Journal:
                     q = mq._queues[rec["queue"]]
                     if not q.empty():
                         q.get_nowait()
+                elif op == "epoch":
+                    self.epoch = max(self.epoch, rec["epoch"])
         # seed the compaction counter so repeated crash/restart cycles can't
         # grow the journal past compact_every forever (code-review r3)
         self._since_compact = n
@@ -342,22 +358,26 @@ class Journal:
         already enqueued."""
         if self._plane is None:
             return
-        kv, mq = self._plane.kv, self._plane.messaging
         self._gen += 1
-        snap = {
-            "gen": self._gen,
-            "kv": [[k, e.value] for k, e in sorted(kv._data.items())
-                   if not e.lease_id],
-            "queues": [[name, list(q._queue)]
-                       for name, q in mq._queues.items() if q.qsize()],
-        }
+        # one persistent-state builder (snapshot_state) serves both the
+        # compaction snapshot and the replication bootstrap — a field
+        # added to one cannot silently miss the other (code-review r5)
+        snap = {"gen": self._gen, **self._plane.snapshot_state()}
         self._q.put(("snap", (self._gen, msgpack.packb(snap))))
         self._since_compact = 0
 
+    def record_epoch(self, epoch: int) -> None:
+        """Persist a fencing-epoch change (standby promotion). The record
+        rides the normal append path, so it is replicated to any standbys
+        and survives restarts; compaction folds it into the snapshot."""
+        self.epoch = epoch
+        self.append({"op": "epoch", "epoch": epoch})
+
     def close(self) -> None:
         """Drain all pending writes and stop the writer thread."""
-        self._closed = True
-        self._q.put(None)
+        with self._close_lock:
+            self._closed = True
+            self._q.put(None)
         self._writer.join(timeout=30)
 
 
@@ -378,6 +398,10 @@ async def apply_replicated(plane: "DurablePlane", rec: dict) -> None:
         if not q.empty():
             q.get_nowait()
             plane.journal.append({"op": "qpop", "queue": rec["queue"]})
+    elif op == "epoch":
+        # the primary's fencing epoch advanced (it was itself promoted at
+        # some point): persist it so this standby rejoins at >= that epoch
+        plane.journal.record_epoch(max(plane.journal.epoch, rec["epoch"]))
     # jhead/unknown ops: compaction artifacts of the PRIMARY's journal —
     # meaningless on the standby's own journal, skipped
 
@@ -400,6 +424,7 @@ class DurablePlane(MemoryPlane):
         what a freshly-subscribed standby loads before streaming records).
         Same content as the compaction snapshot: unleased KV + queues."""
         return {
+            "epoch": self.journal.epoch,
             "kv": [[k, e.value] for k, e in sorted(self.kv._data.items())
                    if not e.lease_id],
             "queues": [[name, list(q._queue)]

@@ -33,3 +33,34 @@ async def read_frame(reader: asyncio.StreamReader) -> Dict[str, Any]:
 
 def write_frame(writer: asyncio.StreamWriter, msg: Dict[str, Any]) -> None:
     writer.write(pack(msg))
+
+
+async def oneshot_request(host: str, port: int, msg: Dict[str, Any],
+                          timeout: float = 5.0, keep_open: bool = False):
+    """Open a connection, send one id-tagged frame, await the matching
+    reply. Shared by role probes and HA fencing (tcp._probe_role,
+    server._primary_alive, server._fence_peer). Both the connect and the
+    reply read sit under `timeout`, so a blackholed or wedged peer costs
+    seconds, not the OS connect timeout's minutes. With keep_open=True
+    returns (reply, reader, writer) for the caller to adopt as a live
+    connection; otherwise closes and returns the reply alone."""
+    async def _go():
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            write_frame(writer, {"id": 1, **msg})
+            await writer.drain()
+            while True:
+                m = await read_frame(reader)
+                if m.get("id") == 1:
+                    return m, reader, writer
+        except BaseException:  # incl. the deadline's CancelledError
+            writer.close()
+            raise
+
+    # ONE deadline spans connect + request + reply (a peer that accepts
+    # slowly and then never replies costs `timeout` total, not 2x)
+    reply, reader, writer = await asyncio.wait_for(_go(), timeout)
+    if keep_open:
+        return reply, reader, writer
+    writer.close()
+    return reply

@@ -92,6 +92,200 @@ def test_standby_replicates_promotes_and_serves(tmp_path):
     run(main())
 
 
+def test_partition_fencing_no_divergent_acks(tmp_path):
+    """VERDICT r4 missing #4 / ADVICE r4 medium: a partition between the
+    pair must not yield two primaries silently accepting divergent writes.
+    Sever ONLY the replication link (both members stay up and reachable —
+    the dual-primary scenario): the standby promotes at a bumped epoch,
+    clients pick the higher-epoch primary, the promoted side's fencing
+    loop deposes the stale one, and the deposed member refuses every op —
+    so acknowledged writes never interleave across the two."""
+    async def main():
+        primary = await ControlPlaneServer(
+            port=0, data_dir=str(tmp_path / "a")).start()
+        c1 = await ControlPlaneClient("127.0.0.1", primary.port).connect()
+        await c1.put("k", b"v1")
+        assert c1.epoch == 1 and primary.epoch == 1
+
+        standby = await ControlPlaneServer(
+            port=0, data_dir=str(tmp_path / "b"),
+            standby_of=("127.0.0.1", primary.port)).start()
+        await wait_for(lambda: standby.synced, what="standby sync")
+
+        # PARTITION: the standby can no longer reach the primary AT ALL
+        # (probe-before-promote sees it as dead), but the primary keeps
+        # serving c1 and stays reachable for clients — the asymmetric
+        # split that yields two self-claimed primaries
+        async def _unreachable(host, port):
+            return False
+        standby._primary_alive = _unreachable
+        for _sid, (_q, conn) in list(primary.repl_subs.items()):
+            conn.writer.close()
+        await wait_for(lambda: standby.role == "primary", what="promotion")
+        assert standby.epoch == 2
+
+        # a fresh client that can reach BOTH self-claimed primaries
+        # enrolls with the higher epoch — never the stale side
+        both = [("127.0.0.1", primary.port), ("127.0.0.1", standby.port)]
+        c2 = await ControlPlaneClient(addrs=both).connect()
+        assert c2.port == standby.port and c2.epoch == 2
+        await c2.put("k", b"v2")
+
+        # the promoted side's fencing loop reaches the old primary
+        # (reachable here — the "healed" case) and deposes it
+        await wait_for(lambda: primary.role == "deposed", timeout=15,
+                       what="old primary deposed")
+        assert primary.epoch == 2
+
+        # the stale-enrolled client's writes are now REFUSED, not
+        # acknowledged into a divergent history
+        with pytest.raises((RuntimeError, ConnectionError)):
+            await c1.put("k", b"v-stale")
+
+        # an op carrying an older epoch is refused even before deposition
+        # semantics: the promoted primary rejects epoch-1 traffic outright
+        c2.epoch = 1
+        with pytest.raises(RuntimeError, match="stale epoch"):
+            await c2.put("k", b"v-old-epoch")
+        c2.epoch = 2
+
+        # the stale client reconnects via the pair and lands on the new
+        # primary, observing only the epoch-2 history
+        await c1.close()
+        c1b = await ControlPlaneClient(addrs=both).connect()
+        assert c1b.port == standby.port and c1b.epoch == 2
+        assert await c1b.get("k") == b"v2"
+
+        # a deposed member that RESTARTS from its data dir comes back as
+        # primary at its old epoch — and is re-fenced by the survivor's
+        # loop, so it can never re-enter service at a stale epoch
+        p_port = primary.port
+        await primary.stop()
+        reborn = await ControlPlaneServer(
+            host="127.0.0.1", port=p_port,
+            data_dir=str(tmp_path / "a")).start()
+        assert reborn.epoch == 1  # deposition deliberately not journaled
+        await wait_for(lambda: reborn.role == "deposed", timeout=15,
+                       what="reborn stale primary re-fenced")
+
+        await c1b.close()
+        await c2.close()
+        await reborn.stop()
+        await standby.stop()
+
+    run(main())
+
+
+def test_promoted_member_refuses_stale_snapshot_and_resumes_primacy(
+        tmp_path):
+    """Failback path (code-review r5): after B promoted at epoch 2 and
+    acknowledged writes, restarting B as --standby-of a STALE primary A
+    (still at epoch 1) must not wipe B's newer history with A's snapshot.
+    B refuses the stale snapshot, resumes primacy at its journaled epoch,
+    and fences A."""
+    async def main():
+        a = await ControlPlaneServer(
+            port=0, data_dir=str(tmp_path / "a")).start()
+        c = await ControlPlaneClient("127.0.0.1", a.port).connect()
+        await c.put("k", b"v1")
+        b = await ControlPlaneServer(
+            port=0, data_dir=str(tmp_path / "b"),
+            standby_of=("127.0.0.1", a.port)).start()
+        await wait_for(lambda: b.synced, what="sync")
+
+        # partition (standby cannot reach A, nor can its fencing traffic)
+        # -> B promotes at epoch 2
+        async def _unreachable(host, port):
+            return False
+
+        async def _no_fence(host, port):
+            await asyncio.Event().wait()
+
+        b._primary_alive = _unreachable
+        b._fence_peer = _no_fence
+        for _sid, (_q, conn) in list(a.repl_subs.items()):
+            conn.writer.close()
+        await wait_for(lambda: b.role == "primary", what="promotion")
+
+        # an epoch-2 acknowledged write lands on B, then B dies
+        c2 = await ControlPlaneClient("127.0.0.1", b.port).connect()
+        assert c2.epoch == 2
+        await c2.put("k", b"v2-acked")
+        await c2.close()
+        await b.stop()
+        assert a.role == "primary"  # the stale side never learned
+
+        # B restarts pointed at stale A: must refuse A's epoch-1 snapshot,
+        # resume primacy at epoch 2 with its history intact, and fence A
+        b2 = await ControlPlaneServer(
+            port=0, data_dir=str(tmp_path / "b"),
+            standby_of=("127.0.0.1", a.port)).start()
+        await wait_for(lambda: b2.role == "primary", timeout=15,
+                       what="resume primacy")
+        assert b2.epoch == 2
+        await wait_for(lambda: a.role == "deposed", timeout=15,
+                       what="stale primary fenced")
+        c3 = await ControlPlaneClient("127.0.0.1", b2.port).connect()
+        assert c3.epoch == 2
+        assert await c3.get("k") == b"v2-acked"
+
+        await c.close()
+        await c3.close()
+        await a.stop()
+        await b2.stop()
+
+    run(main())
+
+
+def test_evicted_standby_rebootstraps_without_promoting(tmp_path):
+    """A standby that falls behind the bounded replication queue is
+    evicted (connection closed by the primary). Because the primary is
+    still alive and answering, the standby's probe-before-promote must
+    re-bootstrap it from a fresh snapshot — NOT promote it onto a replica
+    missing records (code-review r5: eviction must not trigger failover
+    and then fence the healthy primary)."""
+    async def main():
+        primary = await ControlPlaneServer(
+            port=0, data_dir=str(tmp_path / "a")).start()
+        c1 = await ControlPlaneClient("127.0.0.1", primary.port).connect()
+        await c1.put("k", b"v1")
+        standby = await ControlPlaneServer(
+            port=0, data_dir=str(tmp_path / "b"),
+            standby_of=("127.0.0.1", primary.port)).start()
+        await wait_for(lambda: standby.synced, what="standby sync")
+
+        # overflow the subscriber's bounded queue in one synchronous
+        # burst (no awaits, so the pump can't drain), then deliver one
+        # more record -> eviction
+        sid, (q, _conn) = next(iter(primary.repl_subs.items()))
+        while True:
+            try:
+                q.put_nowait({"op": "noop"})
+            except asyncio.QueueFull:
+                break
+        primary._fanout_record({"op": "put", "key": "x", "value": b"y"})
+        assert sid not in primary.repl_subs
+
+        # the standby re-bootstraps: fresh subscription, still a standby
+        await wait_for(lambda: standby.synced
+                       and len(primary.repl_subs) == 1
+                       and sid not in primary.repl_subs,
+                       what="re-bootstrap")
+        assert standby.role == "standby" and standby.epoch == 1
+        assert primary.role == "primary"
+
+        # replication works again end-to-end after the re-bootstrap
+        await c1.put("k2", b"v2")
+        await wait_for(lambda: "k2" in standby.plane.kv._data,
+                       what="stream after re-bootstrap")
+
+        await c1.close()
+        await standby.stop()
+        await primary.stop()
+
+    run(main())
+
+
 def test_comma_addr_form_and_mid_failover_retry(tmp_path):
     """The DYN_COORD_ADDR comma form parses, and a client connecting
     DURING the failover window (primary down, standby not yet promoted)

@@ -1,12 +1,24 @@
 #!/bin/sh
 # Retry bench.py until it captures a nonzero TPU number, then save the
-# result (+ log) as BENCH_SELF_r04.json / .log. The axon tunnel can stall
+# result (+ log) as BENCH_SELF_r05.json / .log. The axon tunnel can stall
 # for hours; one supervisor run already retries internally (escalating
-# per-phase budgets), this loop spans tunnel outages across runs.
+# per-phase budgets), this loop spans tunnel outages across runs. Every
+# supervisor run also appends bare-probe outcomes to
+# tools/tpu_probe_log.jsonl — the triage artifact for a zero round.
 # Usage: nohup tools/bench_until_green.sh & (repo root; single instance!)
+# Exits after MAX_WALL_S (default 9.5 h) even without a capture so the
+# driver's own end-of-round bench never finds us holding the one-slot
+# tunnel.
 cd "$(dirname "$0")/.." || exit 1
+start=$(date +%s)
+MAX_WALL_S=${MAX_WALL_S:-34200}
 i=0
 while true; do
+  now=$(date +%s)
+  if [ $((now - start)) -gt "$MAX_WALL_S" ]; then
+    echo "[bench-retry] wall-clock cap reached with no capture; exiting" >&2
+    exit 1
+  fi
   i=$((i + 1))
   echo "[bench-retry] run $i: $(date -u +%H:%M:%S)" >&2
   rm -f .bench_state.json
@@ -15,7 +27,10 @@ while true; do
   value=$(python -c "import json;print(json.load(open('/tmp/bench_try.json'))['value'])" \
       2>/dev/null || echo 0)
   case "$value" in
-    0|0.0|"") echo "[bench-retry] run $i got no number; retrying" >&2 ;;
+    0|0.0|"")
+      fail=$(python -c "import json;print(json.load(open('/tmp/bench_try.json'))['extras'].get('failure',''))" \
+          2>/dev/null || echo "?")
+      echo "[bench-retry] run $i got no number ($fail); retrying" >&2 ;;
     *)
       stamp=$(date -u +%Y-%m-%dT%H:%M:%SZ)
       python - "$stamp" <<'EOF'
@@ -23,9 +38,9 @@ import json, sys
 r = json.load(open("/tmp/bench_try.json"))
 r["timestamp"] = sys.argv[1]
 r["self_measured"] = True
-json.dump(r, open("BENCH_SELF_r04.json", "w"), indent=1)
+json.dump(r, open("BENCH_SELF_r05.json", "w"), indent=1)
 EOF
-      cp /tmp/bench_try.log BENCH_SELF_r04.log
+      cp /tmp/bench_try.log BENCH_SELF_r05.log
       echo "[bench-retry] captured $value tok/s/chip at $stamp" >&2
       exit 0 ;;
   esac
