@@ -86,10 +86,18 @@ class NativeEngine:
                                  "carries no vision subtree)")
             model_cfg = dataclasses.replace(model_cfg, decode_kernel="off")
             if engine_cfg.max_slots % self.pp:
-                raise ValueError(
-                    f"max_slots={engine_cfg.max_slots} must divide by "
-                    f"pp={self.pp} (decode slot-groups are the pipeline "
-                    f"microbatches)")
+                # decode slot-groups are the pipeline microbatches, so the
+                # windowed pp decode needs slots % pp == 0. Round up
+                # instead of raising (ADVICE r4): per-token-path workloads
+                # never hit the constraint, and for windowed ones a few
+                # extra slots beat a config error
+                rounded = -(-engine_cfg.max_slots // self.pp) * self.pp
+                logging.getLogger(__name__).info(
+                    "pp=%d: rounding max_slots %d up to %d (decode "
+                    "slot-groups are the pipeline microbatches)",
+                    self.pp, engine_cfg.max_slots, rounded)
+                engine_cfg = dataclasses.replace(
+                    engine_cfg, max_slots=rounded)
         # the compiled kernel has hard constraints the XLA gather path
         # doesn't: a lane-aligned DMA geometry (ops/paged_attention.py
         # kernel_supported) and, under shard_map, tp dividing the head

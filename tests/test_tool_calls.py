@@ -67,3 +67,39 @@ def test_apply_tool_calls_rewrites_message():
     assert apply_tool_calls(m2, "stop") == "stop"
     assert m2.content == "plain prose"
     assert m2.tool_calls is None
+
+
+def test_streaming_candidacy_bound():
+    """ADVICE r4: candidacy must lapse for heads that can no longer parse
+    as a tool call, so tools-carrying streams of ordinary code answers
+    flush early instead of buffering to completion."""
+    from dynamo_tpu.llm.tool_calls import could_be_tool_call_prefix as cand
+
+    # undecided starts stay candidates
+    assert cand("")
+    assert cand("  ")
+    assert cand("`")
+    assert cand("``")
+    assert cand("```")
+    assert cand("```j")
+    assert cand("<tool")
+    assert cand("[TOOL_CA")
+    # JSON-ish and json fences stay candidates
+    assert cand('{"name": "f"')
+    assert cand('[{"name": "f"')
+    assert cand("```json")
+    assert cand('```json\n{"name"')
+    assert cand('```json{"name"')   # one-line fence
+    assert cand('```\n{"name"')     # info-less fence wrapping JSON
+    # the common code answer flushes as soon as the fence head shows it
+    assert not cand("```python")
+    assert not cand("```py")        # cannot grow into ```json either
+    assert not cand("```python\ndef f():")
+    assert not cand("```\nplain text")
+    assert not cand("```jsonp")
+    # prose flushes immediately
+    assert not cand("Sure, here's how")
+    # and even a JSON-looking head lapses past the byte bound
+    long_json_prose = '{"a": "' + "x" * 100 + '"'
+    assert cand(long_json_prose)
+    assert not cand(long_json_prose, max_head=64)
