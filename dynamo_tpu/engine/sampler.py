@@ -91,3 +91,38 @@ def sample(
         lambda k, row: jax.random.categorical(k, row)
     )(keys, masked).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy_tok, sampled)
+
+
+def sample_logits(logits, eos_ids, temperature, top_k, top_p, seeds,
+                  counters, min_tokens, seen=None, rep_penalty=None,
+                  with_lp=False, greedy=False):
+    """Shared tail of every engine step: repetition penalty (optional) +
+    eos ban below min_tokens + sample (+ logprobs when with_lp).
+
+    Returns (tokens [B], sampled_lp [B], top_ids [B, K], top_lps [B, K]);
+    the lp outputs are None unless with_lp — the full-vocab log_softmax +
+    top_k and their host transfer cost real decode latency, so the common
+    path must not pay for them. Logprobs are taken over the penalized (but
+    pre-temperature, pre-ban) distribution — what the reference's engines
+    report. Lives here (not engine.py) so the pipeline-parallel decode
+    window (models/pp.py) samples through the identical code path as the
+    single-mesh engine — oracle-exact at a fixed seed."""
+    if rep_penalty is not None:
+        logits = apply_repetition_penalty(logits, seen, rep_penalty)
+    basis = logits
+    if eos_ids:
+        ban = (counters < min_tokens)[:, None]      # [B, 1]
+        eos = jnp.asarray(eos_ids, jnp.int32)
+        eos_mask = jnp.zeros((logits.shape[-1],), bool).at[eos].set(True)
+        logits = jnp.where(ban & eos_mask[None, :], -1e30, logits)
+    if greedy:
+        # all-greedy plan: argmax only — the full sampler's vocab sort
+        # costs ~1.5 ms/step on a 128k vocab (measured, v5e)
+        toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        keys = make_keys(seeds, counters)
+        toks = sample(logits, temperature, top_k, top_p, keys)
+    if not with_lp:
+        return toks, None, None, None
+    samp_lp, top_ids, top_lps = compute_logprobs(basis, toks)
+    return toks, samp_lp, top_ids, top_lps

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.sampler import sample_logits
 from dynamo_tpu.models.llama import (
     AttnMetadata, Params, _dtype, apply_rope, rms_norm,
 )
@@ -228,6 +229,7 @@ def pp_decode_window(
     mesh,
     n_steps: int,
     page_size: int,
+    greedy: bool,
     params: Params,
     cache: Dict[str, jax.Array],
     tokens: jax.Array,       # [S] int32 — fed token per slot
@@ -238,8 +240,12 @@ def pp_decode_window(
     counters: jax.Array,     # [S] — tokens emitted so far
     ignore_eos: jax.Array,   # [S] bool
     stop_ids: jax.Array,     # [S, K] int32 (-1 padded; K may be 0)
+    temperature: jax.Array,  # [S] f32 (unused in the greedy variant)
+    top_k: jax.Array,        # [S] int32
+    top_p: jax.Array,        # [S] f32
+    seeds: jax.Array,        # [S] int32
 ) -> jax.Array:
-    """Greedy multi-token pipeline-parallel decode (VERDICT r3 weak #7).
+    """Multi-token pipeline-parallel decode (VERDICT r3 weak #7, r4 #6).
 
     Round-robins M = pp slot-group microbatches through the pipeline:
     stage r works on microbatch (t - r) mod M at token step (t - r) // M,
@@ -251,12 +257,22 @@ def pp_decode_window(
     (tick t+1), so the pipeline never stalls between a microbatch's
     consecutive tokens.
 
+    Sampling runs on the last stage through the SAME sample_logits tail
+    as the single-mesh window (engine/sampler.py), with per-slot
+    (seed, counter + step) PRNG keys — so sampled plans (temperature /
+    top-k / top-p) are oracle-exact against the single-mesh engine at a
+    fixed seed, and get windowed decode on pp meshes too (VERDICT r4 #6;
+    previously greedy-only, with sampled plans paying full host-dispatch
+    latency x pipeline bubble per token). `greedy` picks the
+    argmax-only compiled variant so all-greedy plans skip the sampler's
+    vocab sort. Logprob/penalty plans stay per-token (the engine routes
+    them to the fused single-step path).
+
     Device-side finish tracking mirrors the single-mesh decode window:
     eos (unless ignore_eos), hidden stop ids, and the max_pos budget all
-    clear a per-slot alive bit that masks later KV writes. Greedy only —
-    the engine routes sampled/logprob/penalty plans to the per-token pp
-    path. Returns sampled tokens [n_steps, S] (host discards post-finish
-    tails, as with the single-mesh window).
+    clear a per-slot alive bit that masks later KV writes. Returns
+    sampled tokens [n_steps, S] (host discards post-finish tails, as
+    with the single-mesh window).
 
     Reference bar: vLLM pipeline_parallel_size decode
     (container/deps/vllm patch vllm_inc.py:38); the microbatch
@@ -273,23 +289,26 @@ def pp_decode_window(
     head_spec = (P(None, None) if cfg.tie_word_embeddings
                  else shardings["lm_head"])
     fwd = functools.partial(_pp_decode_body, cfg, pp, tp, n_steps,
-                            page_size, eos_ids)
+                            page_size, eos_ids, greedy)
     out_toks, kc, vc = shard_map_compat(
         fwd, mesh=mesh,
         in_specs=(P(None, None), shardings["layers"], P(None), head_spec,
                   pp_cache_sharding(), pp_cache_sharding(),
-                  P(), P(), P(), P(), P(), P(), P(), P()),
+                  P(), P(), P(), P(), P(), P(), P(), P(),
+                  P(), P(), P(), P()),
         out_specs=(P(), pp_cache_sharding(), pp_cache_sharding()),
     )(params["embed"], params["layers"], params["final_norm"], head,
       cache["k"], cache["v"], tokens, positions, page_table, max_pos,
-      min_tokens, counters, ignore_eos, stop_ids)
+      min_tokens, counters, ignore_eos, stop_ids,
+      temperature, top_k, top_p, seeds)
     return out_toks, {"k": kc, "v": vc}
 
 
-def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids,
+def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
                     embed, layers, final_norm, head,
                     kc, vc, tokens, pos0, page_table, max_pos,
-                    min_tokens, counters, ignore_eos, stop_ids):
+                    min_tokens, counters, ignore_eos, stop_ids,
+                    temperature, top_k, top_p, seeds):
     r = jax.lax.axis_index("pp")
     last = pp - 1
     m = pp                      # microbatches == stages (see docstring)
@@ -305,6 +324,8 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids,
     pos_mb, pt_mb, mp_mb = mb(pos0), mb(page_table), mb(max_pos)
     mt_mb, ctr_mb, ign_mb = mb(min_tokens), mb(counters), mb(ignore_eos)
     stops_mb = mb(stop_ids)
+    temp_mb, tk_mb = mb(temperature), mb(top_k)
+    tp_mb, seed_mb = mb(top_p), mb(seeds)
     if eos_ids:
         eos_vec = jnp.zeros((cfg.vocab_size,), bool).at[
             jnp.asarray(eos_ids, jnp.int32)].set(True)
@@ -342,10 +363,13 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids,
         if tp > 1 and head.shape[1] != cfg.vocab_size:
             lg = jax.lax.all_gather(lg, "tp", axis=2, tiled=True)
         lg = lg[:, 0]                          # [bm, V]
-        if eos_vec is not None:
-            ban = ((ctr_mb[i] + k) < mt_mb[i])[:, None]
-            lg = jnp.where(ban & eos_vec[None, :], -1e30, lg)
-        sampled = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        # identical sampling tail to the single-mesh window: eos ban
+        # below min_tokens + greedy-or-sampled with (seed, ctr+k) keys.
+        # Every stage computes it but only the last stage's result is
+        # real (others see garbage logits); emit gates what rides out.
+        sampled, _, _, _ = sample_logits(
+            lg, eos_ids, temp_mb[i], tk_mb[i], tp_mb[i], seed_mb[i],
+            ctr_mb[i] + k, mt_mb[i], greedy=greedy)
         new_alive = alive_in
         if eos_vec is not None:
             new_alive = new_alive & (ign_mb[i] | ~eos_vec[sampled])

