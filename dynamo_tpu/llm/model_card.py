@@ -21,7 +21,7 @@ from dynamo_tpu.engine.config import ModelConfig, get_model_config
 @dataclasses.dataclass
 class ModelDeploymentCard:
     name: str
-    model_type: str = "chat"            # "chat" | "completion"
+    model_type: str = "chat"            # "chat" | "completion" | "both"
     arch: str = "tiny"                  # key into engine config registry
     tokenizer_kind: str = "byte"        # "hf" | "byte"
     tokenizer_path: Optional[str] = None
@@ -101,6 +101,10 @@ class ModelDeploymentCard:
         return cls(
             name=name or os.path.basename(path.rstrip("/")),
             arch=arch or "tiny",
+            # a text-generation checkpoint serves BOTH OpenAI endpoints
+            # (chat via the template or its default; raw /v1/completions
+            # always) — as the reference registers hub models
+            model_type="both",
             tokenizer_kind="hf" if os.path.exists(tok_json) else "byte",
             tokenizer_path=tok_json if os.path.exists(tok_json) else None,
             chat_template=chat_template,
@@ -127,6 +131,9 @@ class ModelDeploymentCard:
             return cls(
                 name=name or md.get("general.name",
                                     os.path.basename(path)),
+                # same rationale as from_hf_dir: a text-generation
+                # checkpoint serves both OpenAI endpoints
+                model_type="both",
                 tokenizer_kind="gguf",
                 chat_template=md.get("tokenizer.chat_template"),
                 context_length=cfg.max_model_len,

@@ -36,7 +36,7 @@ async def amain() -> None:
     pa.add_argument("endpoint", help="ns.component.endpoint")
     pa.add_argument("--arch", default="tiny")
     pa.add_argument("--model-type", default="chat",
-                    choices=("chat", "completion"))
+                    choices=("chat", "completion", "both"))
     pa.add_argument("--kv-routed", action="store_true")
 
     pr = sub.add_parser("remove", help="unregister a model")
