@@ -397,6 +397,17 @@ def worker():
         st.result["metric"] = (
             f"decode_tokens_per_sec_per_chip_{model_name}_b8_validation")
     model_cfg = get_model_config(model_name)  # decode_kernel="auto" = gather
+    # BENCH_QUANT=int8: weight-only int8 serving (ops/quant.py) — the
+    # decode path is weight-read-bound, so this measures the HBM-BW lever
+    quant = os.environ.get("BENCH_QUANT", "")
+    if quant:
+        if quant != "int8":
+            raise SystemExit(f"BENCH_QUANT={quant!r} unsupported "
+                             "(supported: int8)")
+        import dataclasses
+        model_cfg = dataclasses.replace(model_cfg, quant=quant)
+        st.result["metric"] += f"_{quant}"
+        st.result["extras"]["quant"] = quant
     slots = 8
     # 64-step windows: the window-pregathered decode amortizes its per-
     # window gather/writeback + host dispatch over more tokens (997 tok/s

@@ -30,6 +30,10 @@ class ModelConfig:
     max_model_len: int = 2048
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
+    # weight-only quantization for serving (ops/quant.py): "" = weights in
+    # `dtype`; "int8" = dense projections + lm_head stored int8 with
+    # per-output-channel scales (halves weight HBM + decode weight reads)
+    quant: str = ""
     # MoE (Mixtral-style); num_experts == 0 means dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2

@@ -162,17 +162,36 @@ class NativeEngine:
             param_specs = pp_param_shardings(model_cfg)
         else:
             param_specs = llama.param_shardings(model_cfg)
+        if model_cfg.quant == "int8":
+            from dynamo_tpu.ops.quant import (
+                quantize_params, quantize_shardings,
+            )
+            param_specs = quantize_shardings(param_specs, model_cfg)
+        elif model_cfg.quant:
+            raise ValueError(f"unknown quant mode {model_cfg.quant!r} "
+                             "(supported: int8)")
         shardings = jax.tree.map(
             lambda spec: NamedSharding(self.mesh, spec),
             param_specs,
             is_leaf=lambda x: isinstance(x, P),
         )
         if params is None:
-            init = jax.jit(
-                functools.partial(llama.init_params, cfg=model_cfg),
-                out_shardings=shardings)
+            if model_cfg.quant == "int8":
+                def init_q(key):
+                    return quantize_params(
+                        llama.init_params(key, model_cfg), model_cfg)
+                init = jax.jit(init_q, out_shardings=shardings)
+            else:
+                init = jax.jit(
+                    functools.partial(llama.init_params, cfg=model_cfg),
+                    out_shardings=shardings)
             params = init(jax.random.PRNGKey(seed))
         else:
+            if model_cfg.quant == "int8":
+                # quantize on HOST so the full-precision tree never
+                # stages through device memory (the loader hands numpy;
+                # a 70B bf16 tree would not fit next to its int8 twin)
+                params = quantize_params(params, model_cfg, xp=np)
             params = jax.device_put(params, shardings)
         self.params = params
 

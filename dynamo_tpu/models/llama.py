@@ -29,6 +29,7 @@ from dynamo_tpu.ops.attention import (
     write_kv_pages,
 )
 from dynamo_tpu.ops.moe import moe_dispatch_mlp, moe_dispatch_mlp_sharded
+from dynamo_tpu.ops.quant import wmat
 from dynamo_tpu.ops.paged_attention import (
     combine_self_attention, decode_paged_attention,
     decode_paged_attention_prefix, decode_paged_attention_prefix_sharded,
@@ -242,10 +243,10 @@ def _moe_mlp(x: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
 
 
 def _dense_mlp(x: jax.Array, lp: Params) -> jax.Array:
-    gate = jnp.einsum("btd,df->btf", x, lp["w_gate"])
-    up = jnp.einsum("btd,df->btf", x, lp["w_up"])
+    gate = jnp.einsum("btd,df->btf", x, wmat(lp["w_gate"], x.dtype))
+    up = jnp.einsum("btd,df->btf", x, wmat(lp["w_up"], x.dtype))
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-    return jnp.einsum("btf,fd->btd", act, lp["w_down"])
+    return jnp.einsum("btf,fd->btd", act, wmat(lp["w_down"], x.dtype))
 
 
 def decode_forward(
@@ -297,9 +298,9 @@ def decode_forward(
         else:
             lp, lid = xs
         xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("btd,de->bte", xn, lp["wq"])
-        k = jnp.einsum("btd,de->bte", xn, lp["wk"])
-        v = jnp.einsum("btd,de->bte", xn, lp["wv"])
+        q = jnp.einsum("btd,de->bte", xn, wmat(lp["wq"], xn.dtype))
+        k = jnp.einsum("btd,de->bte", xn, wmat(lp["wk"], xn.dtype))
+        v = jnp.einsum("btd,de->bte", xn, wmat(lp["wv"], xn.dtype))
         if cfg.attn_bias:
             q, k, v = q + lp["wq_b"], k + lp["wk_b"], v + lp["wv_b"]
         q = apply_rope(q.reshape(b, 1, h, hd), positions[:, None],
@@ -327,7 +328,8 @@ def decode_forward(
                 q[:, 0], cache["k"][lid], cache["v"][lid], k_new, v_new,
                 page_table, prefix_lens)
         x = x + jnp.einsum("bte,ed->btd",
-                           attn.reshape(b, 1, h * hd), lp["wo"])
+                           attn.reshape(b, 1, h * hd),
+                           wmat(lp["wo"], x.dtype))
         xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         drop_stats = None
         if not cfg.is_moe:
@@ -361,7 +363,8 @@ def decode_forward(
         k_news, v_news = ys
         aux = {}
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    head = (params["embed"].T if cfg.tie_word_embeddings
+            else wmat(params["lm_head"], x.dtype))
     logits = jnp.einsum("bd,dv->bv", x[:, 0], head).astype(jnp.float32)
     if with_aux:
         return logits, k_news, v_news, aux
@@ -423,9 +426,9 @@ def forward(
     def layer_step(x, layer):
         lp, kc, vc = layer
         xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("btd,de->bte", xn, lp["wq"])
-        k = jnp.einsum("btd,de->bte", xn, lp["wk"])
-        v = jnp.einsum("btd,de->bte", xn, lp["wv"])
+        q = jnp.einsum("btd,de->bte", xn, wmat(lp["wq"], xn.dtype))
+        k = jnp.einsum("btd,de->bte", xn, wmat(lp["wk"], xn.dtype))
+        v = jnp.einsum("btd,de->bte", xn, wmat(lp["wv"], xn.dtype))
         if cfg.attn_bias:
             q, k, v = q + lp["wq_b"], k + lp["wk_b"], v + lp["wv_b"]
         q = q.reshape(b, tq, h, hd)
@@ -451,7 +454,8 @@ def forward(
         else:
             attn = paged_attention(q, kc, vc, meta.page_table, meta.kv_lens,
                                    meta.positions)
-        x = x + jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd), lp["wo"])
+        x = x + jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd),
+                           wmat(lp["wo"], x.dtype))
 
         xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         drop_stats = None
@@ -486,7 +490,8 @@ def forward(
         aux = {}
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    head = (params["embed"].T if cfg.tie_word_embeddings
+            else wmat(params["lm_head"], x.dtype))
     logits = jnp.einsum("btd,dv->btv", x, head).astype(jnp.float32)
     if with_aux:
         return logits, {"k": new_k, "v": new_v}, aux

@@ -35,6 +35,7 @@ from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.engine.sampler import sample_logits
+from dynamo_tpu.ops.quant import is_quantized, quantize_shardings, wmat
 from dynamo_tpu.models.llama import (
     AttnMetadata, Params, _dtype, apply_rope, rms_norm,
 )
@@ -76,6 +77,21 @@ def pp_cache_sharding() -> P:
     return P("pp", "tp", None, None, None)
 
 
+def _head_and_specs(cfg: ModelConfig, params: Params):
+    """Shared spec selection for both pp entry points: returns
+    (layer+head shardings [quantized if the params are], head operand,
+    head in_spec, base head spec for out-spec decisions)."""
+    shardings = pp_param_shardings(cfg)
+    if is_quantized(params["layers"].get("wq")):
+        shardings = quantize_shardings(shardings, cfg)
+    head = (params["embed"].T if cfg.tie_word_embeddings
+            else params["lm_head"])
+    base_hs = (P(None, None) if cfg.tie_word_embeddings
+               else pp_param_shardings(cfg)["lm_head"])
+    head_spec = shardings["lm_head"] if is_quantized(head) else base_hs
+    return shardings, head, head_spec, base_hs
+
+
 def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
            meta: AttnMetadata):
     """Run this stage's local layers (scan) on one microbatch.
@@ -92,9 +108,9 @@ def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
     def layer_step(x, layer):
         lp, kc, vc = layer
         xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("btd,de->bte", xn, lp["wq"])
-        k = jnp.einsum("btd,de->bte", xn, lp["wk"])
-        v = jnp.einsum("btd,de->bte", xn, lp["wv"])
+        q = jnp.einsum("btd,de->bte", xn, wmat(lp["wq"], xn.dtype))
+        k = jnp.einsum("btd,de->bte", xn, wmat(lp["wk"], xn.dtype))
+        v = jnp.einsum("btd,de->bte", xn, wmat(lp["wv"], xn.dtype))
         if cfg.attn_bias:
             q, k, v = q + lp["wq_b"], k + lp["wk_b"], v + lp["wv_b"]
         q = apply_rope(q.reshape(b, tq, h, hd), meta.positions, cfg.rope_theta)
@@ -104,13 +120,14 @@ def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
         kc, vc = write_kv_pages(kc, vc, k, v, meta.write_idx)
         attn = paged_attention(q, kc, vc, meta.page_table, meta.kv_lens,
                                meta.positions)
-        o = jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd), lp["wo"])
+        o = jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd),
+                       wmat(lp["wo"], x.dtype))
         x = x + jax.lax.psum(o, "tp")
         xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        gate = jnp.einsum("btd,df->btf", xn, lp["w_gate"])
-        up = jnp.einsum("btd,df->btf", xn, lp["w_up"])
+        gate = jnp.einsum("btd,df->btf", xn, wmat(lp["w_gate"], xn.dtype))
+        up = jnp.einsum("btd,df->btf", xn, wmat(lp["w_up"], xn.dtype))
         act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-        mlp = jnp.einsum("btf,fd->btd", act, lp["w_down"])
+        mlp = jnp.einsum("btf,fd->btd", act, wmat(lp["w_down"], x.dtype))
         x = x + jax.lax.psum(mlp, "tp")
         return x, (kc, vc)
 
@@ -142,11 +159,7 @@ def pp_forward(
     m = n_micro if n_micro > 0 else min(pp, b)
     while b % m:
         m -= 1
-    shardings = pp_param_shardings(cfg)
-    head = (params["embed"].T if cfg.tie_word_embeddings
-            else params["lm_head"])
-    head_spec = (P(None, None) if cfg.tie_word_embeddings
-                 else shardings["lm_head"])
+    shardings, head, head_spec, base_hs = _head_and_specs(cfg, params)
     fwd = functools.partial(_pp_body, cfg, pp, tp, m)
     specs = dict(
         mesh=mesh,
@@ -154,7 +167,7 @@ def pp_forward(
                   pp_cache_sharding(), pp_cache_sharding(),
                   P(), P(), P(), P(), P()),
         # logits vocab-sharded over tp when the head is; cache back in place
-        out_specs=(P(None, None, "tp") if head_spec[1] == "tp" else P(),
+        out_specs=(P(None, None, "tp") if base_hs[1] == "tp" else P(),
                    pp_cache_sharding(), pp_cache_sharding()),
     )
     logits, kc, vc = shard_map_compat(fwd, **specs)(
@@ -174,8 +187,9 @@ def _pp_body(cfg, pp, tp, m,
     b, tq = tokens.shape
     bm = b // m
     ticks = m + pp - 1
-    v_loc = head.shape[1]
     dt = _dtype(cfg)
+    head = wmat(head, dt)  # int8-quantized head materializes per shard
+    v_loc = head.shape[1]
 
     def mb(arr):  # [B, ...] -> [M, bm, ...]
         return arr.reshape((m, bm) + arr.shape[1:])
@@ -283,11 +297,7 @@ def pp_decode_window(
     tp = mesh.shape.get("tp", 1)
     s = tokens.shape[0]
     assert s % pp == 0, (s, pp)
-    shardings = pp_param_shardings(cfg)
-    head = (params["embed"].T if cfg.tie_word_embeddings
-            else params["lm_head"])
-    head_spec = (P(None, None) if cfg.tie_word_embeddings
-                 else shardings["lm_head"])
+    shardings, head, head_spec, _ = _head_and_specs(cfg, params)
     fwd = functools.partial(_pp_decode_body, cfg, pp, tp, n_steps,
                             page_size, eos_ids, greedy)
     out_toks, kc, vc = shard_map_compat(
@@ -316,6 +326,7 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
     bm = s // m
     ticks = n_steps * m + pp - 1
     dt = _dtype(cfg)
+    head = wmat(head, dt)  # int8-quantized head materializes per shard
     ring = [(i, (i + 1) % pp) for i in range(pp)]
 
     def mb(arr):  # [S, ...] -> [M, bm, ...]

@@ -61,6 +61,9 @@ async def build_engine(out_spec: str, card: ModelDeploymentCard, args):
     from dynamo_tpu.engine.engine import NativeEngine
     from dynamo_tpu.parallel.mesh import make_mesh
     model_cfg = card.model_config()
+    if args.quant:
+        import dataclasses
+        model_cfg = dataclasses.replace(model_cfg, quant=args.quant)
     params = None
     if card.model_path and glob.glob(
             os.path.join(card.model_path, "*.safetensors")):
@@ -163,6 +166,9 @@ async def amain() -> None:
     p.add_argument("--max-slots", type=int, default=8)
     p.add_argument("--max-prefill-chunk", type=int, default=512)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--quant", default="", choices=("", "int8"),
+                   help="weight-only quantization: int8 halves weight HBM "
+                        "and decode weight reads (ops/quant.py)")
     p.add_argument("--host-pages", type=int, default=0)
     p.add_argument("--echo-delay", type=float, default=0.0)
     p.add_argument("--control-host", default="127.0.0.1")

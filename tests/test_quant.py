@@ -1,0 +1,107 @@
+"""Weight-only int8 quantized serving (ops/quant.py; VERDICT r4 weak #6).
+
+The reference delegates quantized serving to its engines (AWQ/GPTQ via
+vLLM/TRT-LLM, SURVEY.md §2.8); here `ModelConfig.quant="int8"` is a
+first-class engine mode: dense projections + lm_head live in HBM as int8
+with per-output-channel scales, dequantized inside the matmul producers.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import SamplingParams
+from dynamo_tpu.models import llama
+from dynamo_tpu.ops.quant import (
+    is_quantized, quantize_int8, quantize_params, wmat,
+)
+from dynamo_tpu.parallel.mesh import make_mesh
+
+CFG = ModelConfig(dtype="float32", quant="int8", max_model_len=256)
+ECFG = EngineConfig(page_size=8, num_pages=64, max_slots=2,
+                    max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+                    max_model_len=256)
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((4, 64, 96)).astype(np.float32)
+    for xp in (np, jnp):
+        qt = quantize_int8(w, xp=xp)
+        assert np.asarray(qt["q"]).dtype == np.int8
+        assert qt["s"].shape == (4, 1, 96)
+        back = np.asarray(wmat(jax.tree.map(jnp.asarray, qt), jnp.float32))
+        # symmetric per-channel int8: worst-case error is s/2 per entry
+        err = np.abs(back - w)
+        bound = np.broadcast_to(np.asarray(qt["s"]) / 2 + 1e-7, w.shape)
+        assert (err <= bound).all()
+        # and the dequantized matrix is a faithful overall approximation
+        rel = np.linalg.norm(back - w) / np.linalg.norm(w)
+        assert rel < 0.01, rel
+
+
+def test_quantized_forward_close_to_full_precision():
+    cfg_fp = ModelConfig(dtype="float32", max_model_len=256)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg_fp)
+    qparams = quantize_params(params, cfg_fp)
+    assert is_quantized(qparams["layers"]["wq"])
+    assert is_quantized(qparams["lm_head"])
+    assert qparams["layers"]["attn_norm"] is params["layers"]["attn_norm"]
+
+    cache = llama.init_cache(cfg_fp, num_pages=16, page_size=8)
+    tokens = jnp.arange(2 * 8, dtype=jnp.int32).reshape(2, 8) + 1
+    from dynamo_tpu.models.llama import AttnMetadata
+    meta = AttnMetadata(
+        positions=jnp.tile(jnp.arange(8, dtype=jnp.int32), (2, 1)),
+        page_table=jnp.arange(2 * 2, dtype=jnp.int32).reshape(2, 2),
+        kv_lens=jnp.full((2,), 8, jnp.int32),
+        write_idx=(jnp.arange(2 * 2, dtype=jnp.int32).reshape(2, 2)[
+            :, :1] * 8 + jnp.tile(jnp.arange(8, dtype=jnp.int32), (2, 1))))
+    ref, _, _ = (llama.forward(params, cfg_fp, tokens, cache, meta)[0],
+                 None, None)
+    got = llama.forward(qparams, cfg_fp, tokens, cache, meta)[0]
+    # int8 per-channel weight error compounds over 2 layers; logits stay
+    # close in absolute scale (they are O(1) at init)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=0.15)
+
+
+def test_quant_engine_serves_and_halves_weight_bytes():
+    eng = NativeEngine(CFG, ECFG, seed=0)
+    wq = eng.params["layers"]["wq"]
+    assert is_quantized(wq) and wq["q"].dtype == jnp.int8
+
+    def nbytes(t):
+        return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(t))
+
+    fp = NativeEngine(ModelConfig(dtype="float32", max_model_len=256),
+                      ECFG, seed=0)
+    q_proj = nbytes(eng.params["layers"]["wq"])
+    fp_proj = nbytes(fp.params["layers"]["wq"])
+    assert q_proj < fp_proj * 0.27  # int8 vs f32 + small scale overhead
+
+    out = eng.generate(list(range(20)),
+                       SamplingParams(max_tokens=6, ignore_eos=True), "q")
+    assert len(out) == 6
+    # same quantized weights -> decode path matches the prefill-consistent
+    # greedy continuation deterministically across engines
+    eng2 = NativeEngine(CFG, ECFG, seed=0)
+    assert eng2.generate(list(range(20)),
+                         SamplingParams(max_tokens=6, ignore_eos=True),
+                         "q2") == out
+
+
+def test_quant_engine_tp_and_pp_match_single_device():
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    prompt = list(range(30, 50))
+    oracle = NativeEngine(CFG, ECFG, seed=0).generate(prompt, params, "o")
+
+    tp_mesh = make_mesh(tp=2, devices=jax.devices()[:2])
+    got_tp = NativeEngine(CFG, ECFG, mesh=tp_mesh, seed=0).generate(
+        prompt, params, "tp")
+    assert got_tp == oracle, "int8 tp=2 diverged from single-device"
+
+    pp_mesh = make_mesh(pp=2, devices=jax.devices()[:2])
+    got_pp = NativeEngine(CFG, ECFG, mesh=pp_mesh, seed=0).generate(
+        prompt, params, "pp")
+    assert got_pp == oracle, "int8 pp=2 diverged from single-device"
