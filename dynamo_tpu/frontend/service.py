@@ -203,38 +203,49 @@ class HttpService:
                 TOOL_CALL_TAG, could_be_tool_call_prefix, tag_hold_len,
             )
             status = "success"
-            held = []
-            buffering = buffer_tools
-            heads = {}  # choice index -> accumulated content head
-            # post-flush tag watch: prose streams live, but a mid-text
-            # <tool_call> tag (the one dialect the unary parser matches
-            # anywhere) must still resolve to delta.tool_calls exactly as
-            # unary does — chunks are held while the accumulated tail is
-            # a (possible) tag start and released the moment it cannot be
-            pend = []
-            tails = {}  # choice index -> held-back tail text
-            tagged = False
+            # per-choice candidacy (VERDICT r4 weak #5): each choice
+            # buffers independently while ITS head could still be a tool
+            # call; a prose-answering choice in an n>1 fan-out streams
+            # live the moment its own head disqualifies, instead of
+            # waiting on sibling candidates. Chunks are split into
+            # single-choice chunks so releases never reorder any one
+            # choice's deltas (cross-choice interleaving carries no
+            # meaning in the OpenAI stream shape).
+            cand_held = {}   # choice index -> [single-choice chunks]
+            flushed = set()  # choice indexes streaming live
+            heads = {}       # choice index -> accumulated content head
+            usage_tail = []  # choice-less chunks (stream_options usage)
+            # post-flush tag watch, PER CHOICE: prose streams live, but a
+            # mid-text <tool_call> tag (the one dialect the unary parser
+            # matches anywhere) must still resolve to delta.tool_calls
+            # exactly as unary does — a choice's chunks are held while
+            # ITS accumulated tail is a (possible) tag start, released
+            # the moment it cannot be; sibling choices keep streaming
+            pend = {}    # choice index -> held chunks
+            tails = {}   # choice index -> held-back tail text
+            tagged = set()  # choice indexes committed to a mid-text tag
 
-            def scan(chunk):
-                """Stream-mode gate; returns the chunks safe to emit."""
-                nonlocal tagged
-                if buffer_tools:
-                    for ch in chunk.choices:
-                        c = ch.delta.content if ch.delta else None
-                        if not c or tagged:
-                            continue
-                        s = tails.get(ch.index, "") + c
-                        if TOOL_CALL_TAG in s:
-                            tagged = True
-                            tails[ch.index] = s
-                        else:
-                            k = tag_hold_len(s)
-                            tails[ch.index] = s[len(s) - k:] if k else ""
-                    if tagged or any(tails.values()):
-                        pend.append(chunk)
-                        return []
-                out = pend + [chunk]
-                pend.clear()
+            def scan(one):
+                """Stream-mode gate. In tools mode `one` is always a
+                single-choice chunk; returns the chunks safe to emit."""
+                if not buffer_tools:
+                    return [one]
+                ch = one.choices[0]
+                idx = ch.index
+                c = ch.delta.content if ch.delta else None
+                if idx not in tagged and c:
+                    s = tails.get(idx, "") + c
+                    if TOOL_CALL_TAG in s:
+                        tagged.add(idx)
+                        tails[idx] = s
+                    else:
+                        k = tag_hold_len(s)
+                        tails[idx] = s[len(s) - k:] if k else ""
+                if idx in tagged or tails.get(idx):
+                    pend.setdefault(idx, []).append(one)
+                    return []
+                out = pend.pop(idx, [])
+                out.append(one)
                 return out
 
             try:
@@ -243,40 +254,61 @@ class HttpService:
                         ctx.stop_generating()
                         status = "disconnect"
                         break
-                    if buffering:
-                        held.append(chunk)
+                    if buffer_tools:
+                        if not chunk.choices:
+                            usage_tail.append(chunk)
+                            continue
+                        outs = []
                         for ch in chunk.choices:
+                            # the common n=1 chunk is already
+                            # single-choice; skip the pydantic copy
+                            one = (chunk if len(chunk.choices) == 1
+                                   else chunk.model_copy(
+                                       update={"choices": [ch]}))
+                            idx = ch.index
+                            if idx in flushed:
+                                outs.extend(scan(one))
+                                continue
+                            cand_held.setdefault(idx, []).append(one)
                             if ch.delta and ch.delta.content:
-                                heads[ch.index] = (heads.get(ch.index, "")
-                                                   + ch.delta.content)
-                        # flush once NO choice can still become a tool
-                        # call (n>1: any remaining candidate keeps the
-                        # whole stream buffered — per-choice split
-                        # streams would reorder deltas)
-                        if heads and not any(could_be_tool_call_prefix(t)
-                                             for t in heads.values()):
-                            buffering = False
-                            # release through the tag watch so a flushed
-                            # head ending in a partial <tool_call> start
-                            # stays held rather than leaking as content
-                            for h in held:
-                                for out_chunk in scan(h):
-                                    yield sse.encode_json_data(
-                                        out_chunk.model_dump(
-                                            exclude_none=True)).encode()
-                            held = []
+                                heads[idx] = (heads.get(idx, "")
+                                              + ch.delta.content)
+                            if not could_be_tool_call_prefix(
+                                    heads.get(idx, "")):
+                                # this choice is prose: release it
+                                # through the tag watch (a head ending
+                                # in a partial <tool_call> start stays
+                                # held, never leaks as content) and
+                                # stream it live from here on
+                                flushed.add(idx)
+                                for h in cand_held.pop(idx):
+                                    outs.extend(scan(h))
+                        for out_chunk in outs:
+                            yield sse.encode_json_data(
+                                out_chunk.model_dump(
+                                    exclude_none=True)).encode()
                         continue
                     for out_chunk in scan(chunk):
                         yield sse.encode_json_data(
                             out_chunk.model_dump(exclude_none=True)).encode()
                 else:
-                    # whichever tail is still held resolves like unary:
-                    # probe-mode `held` (whole stream was a candidate) or
-                    # stream-mode `pend` (mid-text tag / partial tag);
-                    # prose replays unchanged either way
-                    for out_chunk in _resolve_held_chunks(held or pend):
+                    # whatever is still held resolves like unary, per
+                    # choice: end-of-stream candidates (cand_held) become
+                    # delta.tool_calls or replay as prose; tag-watch
+                    # holds (pend: mid-text tag / partial tag) resolve
+                    # the same way; usage-only chunks follow
+                    for idx in sorted(set(cand_held) | set(pend)):
+                        # a choice is either still a whole-stream
+                        # candidate (cand_held) or flushed with a
+                        # tag-watch hold (pend) — never both
+                        for out_chunk in _resolve_held_chunks(
+                                cand_held.get(idx) or pend.get(idx) or []):
+                            yield sse.encode_json_data(
+                                out_chunk.model_dump(
+                                    exclude_none=True)).encode()
+                    for u in usage_tail:
                         yield sse.encode_json_data(
-                            out_chunk.model_dump(exclude_none=True)).encode()
+                            u.model_dump(exclude_none=True)).encode()
                     yield sse.DONE_FRAME.encode()
             except asyncio.CancelledError:
                 ctx.stop_generating()

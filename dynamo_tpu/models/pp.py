@@ -81,13 +81,14 @@ def _head_and_specs(cfg: ModelConfig, params: Params):
     """Shared spec selection for both pp entry points: returns
     (layer+head shardings [quantized if the params are], head operand,
     head in_spec, base head spec for out-spec decisions)."""
-    shardings = pp_param_shardings(cfg)
+    base = pp_param_shardings(cfg)
+    shardings = base
     if is_quantized(params["layers"].get("wq")):
-        shardings = quantize_shardings(shardings, cfg)
+        shardings = quantize_shardings(base, cfg)  # does not mutate base
     head = (params["embed"].T if cfg.tie_word_embeddings
             else params["lm_head"])
     base_hs = (P(None, None) if cfg.tie_word_embeddings
-               else pp_param_shardings(cfg)["lm_head"])
+               else base["lm_head"])
     head_spec = shardings["lm_head"] if is_quantized(head) else base_hs
     return shardings, head, head_spec, base_hs
 

@@ -47,8 +47,16 @@ def per_device_mem(compiled) -> dict:
 
 
 def main():
+    import dataclasses
+
     pp, tp = 4, 4
     cfg = get_model_config("llama3-70b")  # bf16, 80 layers
+    if "--int8" in sys.argv:
+        # weight-only int8 (ops/quant.py): the dense projections become
+        # int8 + scales, roughly halving resident weight bytes — the
+        # 70B-on-fewer-chips story. pp2 x tp4 = 8 devices.
+        cfg = dataclasses.replace(cfg, quant="int8")
+        pp = 2
     mesh = make_mesh(pp=pp, tp=tp, devices=jax.devices()[:pp * tp])
 
     # serving shapes: 8 slots x 2048-token contexts, page 64
@@ -57,8 +65,13 @@ def main():
     pages_per_seq = ctx // page_size
     n_steps = 8  # scan length; pp window memory is step-count-invariant
 
-    params = jax.eval_shape(lambda k: llama.init_params(k, cfg),
-                            jax.random.PRNGKey(0))
+    from dynamo_tpu.ops.quant import quantize_params
+
+    def make_params(k):
+        p = llama.init_params(k, cfg)
+        return quantize_params(p, cfg) if cfg.quant == "int8" else p
+
+    params = jax.eval_shape(make_params, jax.random.PRNGKey(0))
     cache = jax.eval_shape(lambda: llama.init_cache(cfg, num_pages,
                                                     page_size))
     param_bytes = sum(np.prod(x.shape) * x.dtype.itemsize

@@ -27,19 +27,33 @@ V5E_HBM_BYTES = 16_000_000_000
 RESIDENT_BUDGET = int(V5E_HBM_BYTES * 0.75)
 
 
-def test_70b_pp4xtp4_resident_memory_fits_v5e(tmp_path):
+def _run_child(extra=()):
     child = os.path.join(os.path.dirname(__file__), "aot_70b_child.py")
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     out = subprocess.run(
-        [sys.executable, child], capture_output=True, text=True,
+        [sys.executable, child, *extra], capture_output=True, text=True,
         timeout=540, env=env)
     assert out.returncode == 0, out.stderr[-2000:]
-    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_70b_pp4xtp4_resident_memory_fits_v5e(tmp_path):
+    rep = _run_child()
     # sanity: this really is the 70B config, sharded (not replicated)
     assert rep["param_bytes_total"] > 140e9, rep
     per_dev_params_floor = rep["param_bytes_total"] / 16
     assert rep["decode"]["resident"] >= per_dev_params_floor, rep
     # the fit assertion: resident per device within the v5e budget for
     # BOTH the decode window and the batched prefill chunk
+    assert rep["decode"]["resident"] <= RESIDENT_BUDGET, rep
+    assert rep["prefill"]["resident"] <= RESIDENT_BUDGET, rep
+
+
+def test_70b_int8_pp2xtp4_fits_half_the_chips(tmp_path):
+    """int8 weight-only quantization (ops/quant.py) halves the weight
+    bytes, so the same 70B plan fits 8 v5e chips instead of 16."""
+    rep = _run_child(("--int8",))
+    assert rep["mesh"] == "pp2xtp4", rep
+    assert rep["param_bytes_total"] < 75e9, rep  # ~halved vs 141 GB bf16
     assert rep["decode"]["resident"] <= RESIDENT_BUDGET, rep
     assert rep["prefill"]["resident"] <= RESIDENT_BUDGET, rep
