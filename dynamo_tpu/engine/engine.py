@@ -188,10 +188,14 @@ class NativeEngine:
             params = init(jax.random.PRNGKey(seed))
         else:
             if model_cfg.quant == "int8":
-                # quantize on HOST so the full-precision tree never
-                # stages through device memory (the loader hands numpy;
-                # a 70B bf16 tree would not fit next to its int8 twin)
-                params = quantize_params(params, model_cfg, xp=np)
+                from dynamo_tpu.ops.quant import is_quantized
+                if not is_quantized(params["layers"].get("wq")):
+                    # quantize on HOST so the full-precision tree never
+                    # stages through device memory (a 70B bf16 tree
+                    # would not fit next to its int8 twin). Loaders may
+                    # hand an already-quantized tree (GGUF streams
+                    # per-projection quantization during load).
+                    params = quantize_params(params, model_cfg, xp=np)
             params = jax.device_put(params, shardings)
         self.params = params
 

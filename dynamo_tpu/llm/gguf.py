@@ -312,6 +312,15 @@ def load_params_from_gguf(g: GGUFFile, cfg, dtype: str = "") -> Dict[str, Any]:
     """
     import jax.numpy as jnp
     dt = jnp.empty((), dtype or cfg.dtype).dtype
+    # streaming int8 (cfg.quant): projections are quantized PER LAYER as
+    # they come off the mmap, so the transient full-precision footprint
+    # is one layer's projection (plus quantize_int8's f32 working copy —
+    # ~1.5 GB for a 70B FFN layer), never a whole dequantized stack; the
+    # resident result is the int8 tree. GGUF tensors are mmap-read on
+    # demand, so nothing else stays resident either.
+    from dynamo_tpu.ops.quant import quant_keys, quantize_int8
+    q_on = getattr(cfg, "quant", "") == "int8"
+    qkeys = quant_keys(cfg) if q_on else ()
 
     def t(name):
         return np.asarray(g.tensor(name).T, dtype=dt)
@@ -322,17 +331,28 @@ def load_params_from_gguf(g: GGUFFile, cfg, dtype: str = "") -> Dict[str, Any]:
     def stack(fmt, fn):
         return np.stack([fn(fmt.format(i)) for i in range(cfg.num_layers)])
 
-    layers: Dict[str, Any] = {
-        "attn_norm": stack("blk.{}.attn_norm.weight", w),
-        "wq": stack("blk.{}.attn_q.weight", t),
-        "wk": stack("blk.{}.attn_k.weight", t),
-        "wv": stack("blk.{}.attn_v.weight", t),
-        "wo": stack("blk.{}.attn_output.weight", t),
-        "mlp_norm": stack("blk.{}.ffn_norm.weight", w),
-        "w_gate": stack("blk.{}.ffn_gate.weight", t),
-        "w_up": stack("blk.{}.ffn_up.weight", t),
-        "w_down": stack("blk.{}.ffn_down.weight", t),
-    }
+    def stack_q(fmt):
+        qs, ss = [], []
+        for i in range(cfg.num_layers):
+            qt = quantize_int8(t(fmt.format(i)), xp=np)
+            qs.append(qt["q"])
+            ss.append(qt["s"])
+        return {"q": np.stack(qs), "s": np.stack(ss)}
+
+    layers: Dict[str, Any] = {}
+
+    def put(key, fmt, fn):
+        layers[key] = (stack_q(fmt) if key in qkeys else stack(fmt, fn))
+
+    put("attn_norm", "blk.{}.attn_norm.weight", w)
+    put("wq", "blk.{}.attn_q.weight", t)
+    put("wk", "blk.{}.attn_k.weight", t)
+    put("wv", "blk.{}.attn_v.weight", t)
+    put("wo", "blk.{}.attn_output.weight", t)
+    put("mlp_norm", "blk.{}.ffn_norm.weight", w)
+    put("w_gate", "blk.{}.ffn_gate.weight", t)
+    put("w_up", "blk.{}.ffn_up.weight", t)
+    put("w_down", "blk.{}.ffn_down.weight", t)
     if cfg.attn_bias:
         layers["wq_b"] = stack("blk.{}.attn_q.bias", w)
         layers["wk_b"] = stack("blk.{}.attn_k.bias", w)
@@ -343,7 +363,8 @@ def load_params_from_gguf(g: GGUFFile, cfg, dtype: str = "") -> Dict[str, Any]:
         "final_norm": w("output_norm.weight"),
     }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = t("output.weight")
+        head = t("output.weight")
+        params["lm_head"] = quantize_int8(head, xp=np) if q_on else head
     return params
 
 

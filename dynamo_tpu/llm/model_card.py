@@ -51,17 +51,25 @@ class ModelDeploymentCard:
         return cls(**d)
 
     def model_config(self) -> ModelConfig:
+        # memoized: the GGUF branch re-opens and walks the file's full
+        # metadata section (vocab/scores arrays included) on every call
+        cached = getattr(self, "_model_cfg", None)
+        if cached is not None:
+            return cached
         if self.tokenizer_kind == "gguf" and self.model_path:
             from dynamo_tpu.llm.gguf import GGUFFile, config_from_gguf
             g = GGUFFile(self.model_path)
             try:
-                return config_from_gguf(g, name=self.name)
+                cfg = config_from_gguf(g, name=self.name)
             finally:
                 g.close()
-        if self.hf_config is not None:
+        elif self.hf_config is not None:
             from dynamo_tpu.models.loader import config_from_hf
-            return config_from_hf(self.hf_config, name=self.name)
-        return get_model_config(self.arch)
+            cfg = config_from_hf(self.hf_config, name=self.name)
+        else:
+            cfg = get_model_config(self.arch)
+        object.__setattr__(self, "_model_cfg", cfg)
+        return cfg
 
     def load_tokenizer(self):
         from dynamo_tpu.llm.tokenizer import ByteTokenizer, HFTokenizer

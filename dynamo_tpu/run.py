@@ -47,6 +47,11 @@ log = logging.getLogger("dynamo_tpu.run")
 def build_card(model_spec: str) -> ModelDeploymentCard:
     if os.path.isdir(model_spec):
         return ModelDeploymentCard.from_hf_dir(model_spec)
+    if model_spec.endswith(".gguf") and os.path.isfile(model_spec):
+        # single-file serving, as the reference's `dynamo-run model.gguf`
+        # (launch/dynamo-run/src/opt.rs GGUF detection): config,
+        # tokenizer, chat template, and weights all from one file
+        return ModelDeploymentCard.from_gguf(model_spec)
     return ModelDeploymentCard(name=model_spec, arch=model_spec,
                                tokenizer_kind="byte")
 
@@ -65,7 +70,17 @@ async def build_engine(out_spec: str, card: ModelDeploymentCard, args):
         import dataclasses
         model_cfg = dataclasses.replace(model_cfg, quant=args.quant)
     params = None
-    if card.model_path and glob.glob(
+    if card.model_path and card.model_path.endswith(".gguf"):
+        from dynamo_tpu.llm.gguf import GGUFFile, load_params_from_gguf
+        log.info("loading weights from %s", card.model_path)
+        g = GGUFFile(card.model_path)
+        try:
+            # model_cfg already carries --quant, so the loader streams
+            # per-projection int8 quantization during the load
+            params = load_params_from_gguf(g, model_cfg)
+        finally:
+            g.close()
+    elif card.model_path and glob.glob(
             os.path.join(card.model_path, "*.safetensors")):
         from dynamo_tpu.models.loader import load_params_from_hf
         log.info("loading weights from %s", card.model_path)

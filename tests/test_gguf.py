@@ -6,6 +6,7 @@ spec), covering: typed metadata (scalars, strings, arrays), F32/F16/Q8_0
 tensors with alignment, config mapping, params loading into a generating
 engine, the embedded tokenizer, and ModelDeploymentCard.from_gguf.
 """
+import os
 import struct
 
 import numpy as np
@@ -442,3 +443,27 @@ def test_config_from_gguf_names_missing_keys(tmp_path):
     with pytest.raises(ValueError, match="llama.attention.head_count"):
         config_from_gguf(g)
     g.close()
+
+
+def test_run_launcher_serves_gguf_file_with_quant(tmp_path):
+    """`python -m dynamo_tpu.run in=stdin out=native model.gguf --quant
+    int8`: the single-file GGUF flow the reference's dynamo-run offers
+    (opt.rs GGUF detection), through the full launcher — card from the
+    file's metadata, streamed int8 quantization at load, one completion
+    out."""
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "m.gguf")
+    make_tiny_gguf(path)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.run", "in=stdin", "out=native",
+         path, "--quant", "int8", "--num-pages", "32", "--max-slots", "2",
+         "--max-tokens", "8"],
+        input="hello there", capture_output=True, text=True, timeout=420,
+        env={**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"},
+        cwd=repo)
+    assert out.returncode == 0, out.stderr[-2000:]
+    # random tiny weights: any decoded text proves the full path ran
+    assert out.stdout.strip() != ""
