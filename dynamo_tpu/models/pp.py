@@ -63,13 +63,28 @@ def pp_param_shardings(cfg: ModelConfig) -> Params:
             "wv_b": P("pp", "tp"),
         })
     out: Params = {
-        "embed": P(None, None),
+        # vocab rows over "tp": the embedding is the largest otherwise-
+        # replicated tensor in the 70B plan (2.1 GB/device at bf16);
+        # lookups are a masked local gather + psum (_embed_lookup)
+        "embed": P("tp", None),
         "layers": layers,
         "final_norm": P(None),
     }
     if not cfg.tie_word_embeddings:
         out["lm_head"] = P(None, "tp")
     return out
+
+
+def _embed_lookup(embed_loc: jax.Array, ids: jax.Array) -> jax.Array:
+    """Row lookup in a vocab-sharded embedding (inside shard_map): each
+    "tp" shard gathers the rows it owns, everything else contributes
+    zeros, and one psum assembles the full embeddings."""
+    vloc = embed_loc.shape[0]
+    local = ids - jax.lax.axis_index("tp") * vloc
+    ok = (local >= 0) & (local < vloc)
+    got = jnp.take(embed_loc, jnp.clip(local, 0, vloc - 1), axis=0)
+    got = jnp.where(ok[..., None], got, 0)
+    return jax.lax.psum(got, "tp")
 
 
 def pp_cache_sharding() -> P:
@@ -87,7 +102,9 @@ def _head_and_specs(cfg: ModelConfig, params: Params):
         shardings = quantize_shardings(base, cfg)  # does not mutate base
     head = (params["embed"].T if cfg.tie_word_embeddings
             else params["lm_head"])
-    base_hs = (P(None, None) if cfg.tie_word_embeddings
+    # tied head = embed.T: the vocab-sharded embedding rows become
+    # vocab-sharded head columns — same layout as an untied lm_head
+    base_hs = (P(None, "tp") if cfg.tie_word_embeddings
                else base["lm_head"])
     head_spec = shardings["lm_head"] if is_quantized(head) else base_hs
     return shardings, head, head_spec, base_hs
@@ -164,7 +181,7 @@ def pp_forward(
     fwd = functools.partial(_pp_body, cfg, pp, tp, m)
     specs = dict(
         mesh=mesh,
-        in_specs=(P(None, None), shardings["layers"], P(None), head_spec,
+        in_specs=(P("tp", None), shardings["layers"], P(None), head_spec,
                   pp_cache_sharding(), pp_cache_sharding(),
                   P(), P(), P(), P(), P()),
         # logits vocab-sharded over tp when the head is; cache back in place
@@ -200,6 +217,9 @@ def _pp_body(cfg, pp, tp, m,
     pt_mb = mb(page_table)
     kl_mb = mb(kv_lens)
     wi_mb = mb(write_idx)
+    # prefill token ids are all known up front: one gather+psum for the
+    # whole batch instead of a collective per scan tick (code-review r5)
+    x0_all = _embed_lookup(embed, toks_mb).astype(dt)
 
     def tick(carry, t):
         x_prev, kc, vc = carry
@@ -208,7 +228,7 @@ def _pp_body(cfg, pp, tp, m,
         ic = jnp.clip(i, 0, m - 1)
         # stage 0 sources fresh embeddings; later stages consume the
         # activation that arrived from the previous stage last tick
-        x0 = jnp.take(embed, toks_mb[ic], axis=0).astype(dt)
+        x0 = x0_all[ic]
         x_in = jnp.where(r == 0, x0, x_prev)
         meta_t = AttnMetadata(
             positions=pos_mb[ic], page_table=pt_mb[ic], kv_lens=kl_mb[ic],
@@ -303,7 +323,7 @@ def pp_decode_window(
                             page_size, eos_ids, greedy)
     out_toks, kc, vc = shard_map_compat(
         fwd, mesh=mesh,
-        in_specs=(P(None, None), shardings["layers"], P(None), head_spec,
+        in_specs=(P("tp", None), shardings["layers"], P(None), head_spec,
                   pp_cache_sharding(), pp_cache_sharding(),
                   P(), P(), P(), P(), P(), P(), P(), P(),
                   P(), P(), P(), P()),
@@ -359,7 +379,7 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
         alive_in = feed_alive[i]
         pos = pos_mb[i] + k
         writable = valid & alive_in & (pos <= mp_mb[i])
-        x0 = jnp.take(embed, tok_in, axis=0).astype(dt)[:, None]
+        x0 = _embed_lookup(embed, tok_in).astype(dt)[:, None]
         x_in = jnp.where(r == 0, x0, y_prev)
         w_in = jnp.where(r == 0, writable, w_prev)
         page = pt_mb[i][rows, jnp.clip(pos, 0, mp_mb[i]) // page_size]

@@ -168,6 +168,28 @@ def test_pp_engine_sampled_window_matches_oracle():
             f"sampled pp={pp} tp={tp} decode still per-token"
 
 
+def test_pp_tied_embeddings_engine_matches():
+    """tie_word_embeddings + pp: the vocab-sharded embedding (P("tp",
+    None) rows, _embed_lookup masked gather + psum) doubles as the
+    vocab-sharded head; tokens match the single-device engine."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import SamplingParams
+
+    cfg = ModelConfig(dtype="float32", max_model_len=128,
+                      tie_word_embeddings=True)
+    ecfg = EngineConfig(page_size=8, num_pages=64, max_slots=2,
+                        max_prefill_chunk=16, prefill_buckets=(8, 16),
+                        max_model_len=128)
+    p = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    prompt = list(range(9, 25))
+    oracle = NativeEngine(cfg, ecfg, seed=0).generate(prompt, p, "o")
+    mesh = make_mesh(pp=2, tp=2, devices=jax.devices()[:4])
+    got = NativeEngine(cfg, ecfg, mesh=mesh, seed=0).generate(
+        prompt, p, "t")
+    assert got == oracle
+
+
 def test_pp_decode_step_matches():
     """tq=1 decode-shaped step through the pipeline (the engine's pp decode
     path) against the single-mesh oracle, including the KV row it writes."""
