@@ -1,7 +1,7 @@
-"""Response-plane transport: direct TCP call-home streams.
+"""Response-plane transport: direct call-home streams (TCP, or UDS same-host).
 
 Like the reference, responses never transit the message broker: the requester
-registers a pending stream on its local TCP server and sends its address with
+registers a pending stream on its local server and sends its address with
 the request; the responder dials back ("call home"), sends a prologue
 (ok/error), then pumps response frames (reference:
 lib/runtime/src/pipeline/network/tcp/server.rs:74-380, tcp/client.rs:77-130,
@@ -9,11 +9,20 @@ egress/push.rs:104-166). The connection is bidirectional: the requester can
 send a {"stop": true} control frame to cancel generation mid-stream, and a
 dropped connection stops the responder's engine (the reference's
 monitor_for_disconnects / context kill path).
+
+Alternative same-host plane (the reference's ZMQ/IPC data-plane option,
+SURVEY.md §2.1): alongside TCP the server also listens on a unix-domain
+socket and advertises its path; a responder on the SAME machine (the path
+exists locally) dials the UDS instead — kernel-local streams with no TCP
+stack in the hot loop — and falls back to TCP on any UDS failure.
+`DYN_DATAPLANE=tcp` disables the UDS listener entirely.
 """
 from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import tempfile
 import uuid
 from typing import AsyncIterator, Dict, Optional, Tuple
 
@@ -31,6 +40,12 @@ _END = object()
 # VERDICT r2 weak #8).
 KEEPALIVE_INTERVAL_S = 15.0
 INACTIVITY_TIMEOUT_S = 60.0
+
+
+def _uds_enabled() -> bool:
+    """One policy switch for both ends: the server's UDS listener and the
+    responder's UDS dial (DYN_DATAPLANE=tcp disables both)."""
+    return os.environ.get("DYN_DATAPLANE", "auto") != "tcp"
 
 
 class StreamInactiveError(RuntimeError):
@@ -51,17 +66,36 @@ class DataPlaneServer:
     """Per-process TCP server accepting call-home response connections."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 uds: Optional[bool] = None):
         self.host, self.port = host, port
         self.advertise_host = advertise_host or host
         self._pending: Dict[str, PendingStream] = {}
         self._server = None
+        # same-host UDS listener (advertised alongside TCP); default on,
+        # DYN_DATAPLANE=tcp turns it off
+        if uds is None:
+            uds = _uds_enabled()
+        self._want_uds = uds
+        self._uds_server = None
+        self.uds_path: Optional[str] = None
+        self.uds_accepts = 0  # observability: streams that arrived via UDS
 
     async def start(self):
         if self._server is None:
             self._server = await asyncio.start_server(
                 self._on_connect, self.host, self.port)
             self.port = self._server.sockets[0].getsockname()[1]
+        if self._want_uds and self._uds_server is None:
+            path = os.path.join(
+                tempfile.gettempdir(),
+                f"dynamo-dp-{os.getpid()}-{uuid.uuid4().hex[:8]}.sock")
+            try:
+                self._uds_server = await asyncio.start_unix_server(
+                    self._on_uds_connect, path)
+                self.uds_path = path
+            except (OSError, NotImplementedError):  # pragma: no cover
+                log.warning("UDS data plane unavailable; TCP only")
         return self
 
     async def stop(self):
@@ -69,10 +103,28 @@ class DataPlaneServer:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+        if self._uds_server:
+            self._uds_server.close()
+            await self._uds_server.wait_closed()
+            self._uds_server = None
+        if self.uds_path:
+            try:
+                os.unlink(self.uds_path)
+            except OSError:
+                pass
+            self.uds_path = None
+
+    async def _on_uds_connect(self, reader, writer):
+        self.uds_accepts += 1
+        await self._on_connect(reader, writer)
 
     @property
     def connection_info(self) -> Dict[str, object]:
-        return {"host": self.advertise_host, "port": self.port}
+        info: Dict[str, object] = {"host": self.advertise_host,
+                                   "port": self.port}
+        if self.uds_path:
+            info["uds"] = self.uds_path
+        return info
 
     def register(self) -> PendingStream:
         stream = PendingStream(uuid.uuid4().hex)
@@ -158,11 +210,23 @@ async def call_home(
 ) -> Tuple[asyncio.StreamReader, asyncio.StreamWriter]:
     """Responder side: dial the requester and complete the handshake.
 
-    Also spawns a reader task that maps incoming {"stop": true} frames and
-    connection loss onto the request Context.
+    Prefers the requester's advertised unix socket when its path exists
+    on THIS machine (same-host fast path; falls back to TCP on any UDS
+    failure — the path existing doesn't prove it is the same requester,
+    e.g. after a host reboot reused a pid). Also spawns a reader task
+    that maps incoming {"stop": true} frames and connection loss onto
+    the request Context.
     """
-    reader, writer = await asyncio.open_connection(
-        connection_info["host"], int(connection_info["port"]))
+    reader = writer = None
+    uds = connection_info.get("uds")
+    if uds and os.path.exists(uds) and _uds_enabled():
+        try:
+            reader, writer = await asyncio.open_unix_connection(uds)
+        except (OSError, NotImplementedError):
+            reader = writer = None
+    if reader is None:
+        reader, writer = await asyncio.open_connection(
+            connection_info["host"], int(connection_info["port"]))
     write_frame(writer, {"stream_id": stream_id})
     await writer.drain()
     ack = await read_frame(reader)

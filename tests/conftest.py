@@ -28,3 +28,26 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import gc  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _finalize_asyncio_cycles_between_tests():
+    """Collect cyclic garbage after every test, BEFORE the next test
+    opens sockets. A test that abandons asyncio objects mid-flight (e.g.
+    after SIGKILLing a peer process, test_queue_push_survives_sigkill)
+    leaves transport<->protocol<->task cycles for the cycle collector;
+    if that collection happens during a LATER test's event loop, the
+    stale transports' __del__ close raw fd NUMBERS that the new loop has
+    since reused for its own sockets — observed as the next test's
+    streams silently hanging to their 30s/60s timeouts. The collect runs
+    at SETUP of the following test (pytest itself keeps the previous
+    item's frames referenced until the next one begins, so teardown-time
+    collection finds the cycles still live), closing those fds while the
+    numbers are still unused."""
+    gc.collect()
+    yield

@@ -318,6 +318,16 @@ def test_queue_push_survives_sigkill(tmp_path):
                         return
             except (ConnectionError, OSError, asyncio.IncompleteReadError):
                 pass  # server died mid-push: only acked items count
+            finally:
+                # close the runtime INSIDE this loop: transports/tasks
+                # abandoned at asyncio.run teardown are finalized by GC
+                # later — potentially during the NEXT test's loop, where
+                # a transport __del__ can close a since-reused fd (seen
+                # as a 30s+60s hang in whatever test follows)
+                try:
+                    await asyncio.wait_for(rt.shutdown(), 5)
+                except Exception:
+                    pass
 
         run(push_then_kill())
         proc.wait(timeout=10)
@@ -447,3 +457,36 @@ def test_tcp_control_plane_end_to_end():
             await server.stop()
 
     run(main())
+
+
+def test_dataplane_uses_uds_same_host_and_tcp_when_disabled(monkeypatch):
+    """SURVEY §2.1 alternative data plane (the reference's ZMQ/IPC
+    option): same-host call-home streams ride the requester's advertised
+    unix socket; DYN_DATAPLANE=tcp forces plain TCP."""
+    async def roundtrip():
+        plane = MemoryPlane()
+        server_rt = await DistributedRuntime.create_local(plane, "w")
+        client_rt = await DistributedRuntime.create_local(plane, "c")
+        ep = server_rt.namespace("ns").component("e").endpoint("g")
+        await ep.serve(echo_engine)
+        client = client_rt.namespace("ns").component("e").endpoint(
+            "g").client()
+        await client.start()
+        await client.wait_for_instances()
+        frames = [f async for f in await client.generate({"n": 3})]
+        dp = await client_rt.data_plane()
+        stats = (dp.uds_accepts, dp.uds_path)
+        await client_rt.shutdown()
+        await server_rt.shutdown()
+        assert [f["i"] for f in frames] == [0, 1, 2]
+        return stats
+
+    # default (auto): the stream arrives via the unix socket
+    monkeypatch.delenv("DYN_DATAPLANE", raising=False)
+    accepts, path = run(roundtrip())
+    assert path is not None and accepts >= 1
+
+    # forced TCP: no UDS listener, streaming still works
+    monkeypatch.setenv("DYN_DATAPLANE", "tcp")
+    accepts, path = run(roundtrip())
+    assert path is None and accepts == 0
