@@ -235,10 +235,11 @@ def _moe_mlp(x: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
     one_hot = jax.nn.one_hot(idx, e, dtype=jnp.float32)        # [B, T, k, E]
     combine = jnp.einsum("btk,btke->bte", weights, one_hot)    # [B, T, E]
 
-    gate = jnp.einsum("btd,edf->betf", x, lp["w_gate"])
-    up = jnp.einsum("btd,edf->betf", x, lp["w_up"])
+    gate = jnp.einsum("btd,edf->betf", x, wmat(lp["w_gate"], x.dtype))
+    up = jnp.einsum("btd,edf->betf", x, wmat(lp["w_up"], x.dtype))
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-    down = jnp.einsum("betf,efd->betd", act, lp["w_down"])     # [B, E, T, D]
+    down = jnp.einsum("betf,efd->betd", act,
+                      wmat(lp["w_down"], x.dtype))             # [B, E, T, D]
     return jnp.einsum("betd,bte->btd", down.astype(jnp.float32), combine).astype(x.dtype)
 
 

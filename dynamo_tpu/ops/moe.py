@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from dynamo_tpu.ops.quant import is_quantized, qspec, wmat
 from dynamo_tpu.parallel.mesh import shard_map_compat
 
 
@@ -71,10 +72,11 @@ def moe_dispatch_mlp(x: jax.Array, lp, cfg, capacity_factor: float = 2.0,
     xin = jnp.einsum("bsec,bsd->becd", dispatch, x_rep.astype(f32)
                      ).astype(x.dtype)               # [B, E, C, D]
 
-    gate = jnp.einsum("becd,edf->becf", xin, lp["w_gate"])
-    up = jnp.einsum("becd,edf->becf", xin, lp["w_up"])
+    gate = jnp.einsum("becd,edf->becf", xin, wmat(lp["w_gate"], x.dtype))
+    up = jnp.einsum("becd,edf->becf", xin, wmat(lp["w_up"], x.dtype))
     act = jax.nn.silu(gate.astype(f32)).astype(x.dtype) * up
-    y = jnp.einsum("becf,efd->becd", act, lp["w_down"])  # [B, E, C, D]
+    y = jnp.einsum("becf,efd->becd", act,
+                   wmat(lp["w_down"], x.dtype))  # [B, E, C, D]
 
     out = jnp.einsum("bsec,becd->bsd", combine, y.astype(f32))
     out = out.reshape(b, t, k, d).sum(axis=2).astype(x.dtype)
@@ -148,10 +150,10 @@ def moe_dispatch_mlp_sharded(x, lp, cfg, mesh, capacity_factor: float = 2.0,
         x_rep = jnp.repeat(x, k, axis=1)
         xin = jnp.einsum("bsec,bsd->becd", dispatch,
                          x_rep.astype(f32)).astype(x.dtype)
-        gate = jnp.einsum("becd,edf->becf", xin, w_gate)
-        up = jnp.einsum("becd,edf->becf", xin, w_up)
+        gate = jnp.einsum("becd,edf->becf", xin, wmat(w_gate, x.dtype))
+        up = jnp.einsum("becd,edf->becf", xin, wmat(w_up, x.dtype))
         act = jax.nn.silu(gate.astype(f32)).astype(x.dtype) * up
-        y = jnp.einsum("becf,efd->becd", act, w_down)
+        y = jnp.einsum("becf,efd->becd", act, wmat(w_down, x.dtype))
         out = jnp.einsum("bsec,becd->bsd", combine, y.astype(f32))
         out = jax.lax.psum(out, ("ep", "tp"))
         out = out.reshape(bl, tl, k, dl).sum(axis=2).astype(x.dtype)
@@ -160,12 +162,20 @@ def moe_dispatch_mlp_sharded(x, lp, cfg, mesh, capacity_factor: float = 2.0,
         return out, dropped, routed
 
     valid_in = valid if valid is not None else jnp.ones((b, t), bool)
+
+    def wspec(spec, w):
+        # int8-quantized expert tensor: qspec is the shared scale-spec
+        # rule (ops/quant.py)
+        return qspec(spec) if is_quantized(w) else spec
+
     specs = dict(
         mesh=mesh,
         # batch rides "dp" (whole per shard when dp=1), experts ride "ep",
         # FFN dim rides "tp" — matching llama.param_shardings
-        in_specs=(P("dp"), P(), P("ep", None, "tp"), P("ep", None, "tp"),
-                  P("ep", "tp", None), P("dp")),
+        in_specs=(P("dp"), P(),
+                  wspec(P("ep", None, "tp"), lp["w_gate"]),
+                  wspec(P("ep", None, "tp"), lp["w_up"]),
+                  wspec(P("ep", "tp", None), lp["w_down"]), P("dp")),
         out_specs=(P("dp"), P(), P()),
     )
     f = shard_map_compat(body, **specs)

@@ -13,12 +13,13 @@ d_out], scale s[..., 1, d_out] = max|w| / 127 over the contraction dim;
 q = round(w / s) in [-127, 127]. Per-channel symmetric int8 keeps greedy
 decode parity with bf16 in practice (relative weight error ~0.4%).
 
-What gets quantized: the seven dense projection matrices per layer
+What gets quantized: the seven projection matrices per layer
 (wq/wk/wv/wo/w_gate/w_up/w_down) and lm_head — together >95% of weight
-bytes. Norms, biases, and the embedding stay in the model dtype (embed
-is a gather, not a matmul). MoE expert tensors keep their dtype for now
-(the EP dispatch einsums are 3D-batched; quantizing them rides the same
-wmat hook later) — attention + lm_head still quantize on MoE models.
+bytes. On MoE models w_gate/w_up/w_down are the stacked expert tensors
+([L, E, d, f], per-(layer, expert, out-channel) scales) and quantize the
+same way through the EP dispatch (ops/moe.py). Norms, biases, the tiny
+router, and the embedding stay in the model dtype (embed is a gather,
+not a matmul).
 
 The reference delegates quantized serving entirely to its engines
 (vLLM/TRT-LLM load AWQ/GPTQ checkpoints; SURVEY.md §2.8); here it is a
@@ -35,15 +36,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-# per-layer dense projections worth quantizing (the FLOP/byte carriers)
+# per-layer projections worth quantizing (the FLOP/byte carriers); on MoE
+# models w_gate/w_up/w_down are the stacked expert tensors [L, E, d, f] —
+# the same axis=-2 contraction rule applies, giving per-(layer, expert,
+# out-channel) scales. The tiny router stays in model dtype.
 _DENSE_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
-_ATTN_KEYS = ("wq", "wk", "wv", "wo")
 
 
 def quant_keys(cfg) -> tuple:
-    """Layer-dict keys quantized for this config (MoE keeps expert FFNs
-    in model dtype for now; dense models quantize all seven)."""
-    return _ATTN_KEYS if cfg.is_moe else _DENSE_KEYS
+    """Layer-dict keys quantized for this config."""
+    del cfg
+    return _DENSE_KEYS
 
 
 def is_quantized(w: Any) -> bool:
@@ -85,16 +88,20 @@ def quantize_params(params: Dict[str, Any], cfg, xp=jnp) -> Dict[str, Any]:
     return out
 
 
+def qspec(spec: P) -> Dict[str, P]:
+    """PartitionSpec of a weight -> specs of its quantized {"q","s"} pair:
+    q keeps the weight's spec; the scale keeps the out-channel sharding
+    but its size-1 contraction dim (axis -2) must not be sharded. The ONE
+    place this rule lives — quantize_shardings and the MoE dispatch's
+    in_specs both use it."""
+    s = list(spec)
+    s[-2] = None
+    return {"q": spec, "s": P(*s)}
+
+
 def quantize_shardings(specs: Dict[str, Any], cfg) -> Dict[str, Any]:
     """Map a PartitionSpec tree (llama.param_shardings or
-    pp_param_shardings) onto the quantized tree layout: q keeps the
-    weight's spec; the scale keeps the out-channel sharding but its
-    size-1 contraction dim must not be sharded."""
-    def qspec(spec: P) -> Dict[str, P]:
-        s = list(spec)
-        s[-2] = None
-        return {"q": spec, "s": P(*s)}
-
+    pp_param_shardings) onto the quantized tree layout."""
     out = dict(specs)
     layers = dict(specs["layers"])
     for k in quant_keys(cfg):

@@ -105,3 +105,23 @@ def test_quant_engine_tp_and_pp_match_single_device():
     got_pp = NativeEngine(CFG, ECFG, mesh=pp_mesh, seed=0).generate(
         prompt, params, "pp")
     assert got_pp == oracle, "int8 pp=2 diverged from single-device"
+
+
+def test_quant_moe_engine_ep_matches_single_device():
+    """int8 extends to the stacked expert tensors ([L, E, d, f] with
+    per-(layer, expert, out-channel) scales): a quantized MoE engine on
+    an ep x tp mesh generates token-for-token with its single-device
+    twin, through the O(E/ep) shard_map dispatch (dict-aware in_specs)."""
+    moe_cfg = ModelConfig(dtype="float32", quant="int8", max_model_len=256,
+                          num_experts=4, num_experts_per_tok=2)
+    params = SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)
+    prompt = list(range(60, 84))
+    oracle = NativeEngine(moe_cfg, ECFG, seed=0)
+    assert is_quantized(oracle.params["layers"]["w_gate"])
+    assert oracle.params["layers"]["w_gate"]["s"].shape[1] == 4  # per-expert
+    expect = oracle.generate(prompt, params, "o")
+
+    ep_mesh = make_mesh(ep=4, tp=2, devices=jax.devices()[:8])
+    got = NativeEngine(moe_cfg, ECFG, mesh=ep_mesh, seed=0).generate(
+        prompt, params, "ep")
+    assert got == expect, "int8 ep4xtp2 MoE diverged from single-device"
