@@ -45,7 +45,14 @@ async def register_model(kv, name: str, namespace: str, component: str,
 
 
 async def unregister_model(kv, name: str, model_type: str = "chat") -> None:
-    await kv.delete(model_key(model_type, name))
+    if model_type == "both":
+        # a model may have been registered under any type key (cards from
+        # HF dirs / GGUF register as "both"; llmctl add defaults to
+        # "chat") — full removal clears every variant
+        for t in ("both", "chat", "completion"):
+            await kv.delete(model_key(t, name))
+    else:
+        await kv.delete(model_key(model_type, name))
 
 
 async def list_registered_models(kv) -> Dict[str, dict]:

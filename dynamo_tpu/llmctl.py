@@ -41,7 +41,11 @@ async def amain() -> None:
 
     pr = sub.add_parser("remove", help="unregister a model")
     pr.add_argument("name")
-    pr.add_argument("--model-type", default="chat")
+    # removal defaults to BOTH endpoints: cards registered as
+    # model_type="both" (HF dirs, GGUF) would otherwise leave their
+    # completion half behind
+    pr.add_argument("--model-type", default="both",
+                    choices=("chat", "completion", "both"))
 
     args = p.parse_args()
     runtime = await DistributedRuntime.connect(
