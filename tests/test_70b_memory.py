@@ -57,3 +57,28 @@ def test_70b_int8_pp2xtp4_fits_half_the_chips(tmp_path):
     assert rep["param_bytes_total"] < 75e9, rep  # ~halved vs 141 GB bf16
     assert rep["decode"]["resident"] <= RESIDENT_BUDGET, rep
     assert rep["prefill"]["resident"] <= RESIDENT_BUDGET, rep
+
+
+def test_mixtral_8x7b_ep4xtp2_fits_v5e8(tmp_path):
+    """The MoE flagship's scale-out plan: mixtral-8x7b on 8 v5e chips
+    (experts over ep, attention/FFN dims over tp). bf16 fits the raw
+    16 GB HBM; int8 (quantized attention + stacked expert tensors) fits
+    with the standard activation-headroom budget."""
+    child = os.path.join(os.path.dirname(__file__), "aot_mixtral_child.py")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+
+    def run(extra=()):
+        out = subprocess.run(
+            [sys.executable, child, *extra], capture_output=True,
+            text=True, timeout=540, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    bf16 = run()
+    assert bf16["param_bytes_total"] > 90e9, bf16
+    assert bf16["prefill"]["resident"] >= bf16["param_bytes_total"] / 8
+    assert bf16["prefill"]["resident"] <= V5E_HBM_BYTES, bf16
+
+    q = run(("--int8",))
+    assert q["param_bytes_total"] < 50e9, q
+    assert q["prefill"]["resident"] <= RESIDENT_BUDGET, q
