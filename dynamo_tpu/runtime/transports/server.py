@@ -16,6 +16,7 @@ import argparse
 import asyncio
 import itertools
 import logging
+import uuid
 from typing import Dict
 
 from dynamo_tpu.runtime.transports.memory import MemoryPlane
@@ -255,18 +256,39 @@ class _Conn:
 
     async def _op_fence(self, m):
         """A promoted member announces its epoch; a PRIMARY carrying an
-        older epoch steps down. Carried in `fence_epoch` (not `epoch`) so
-        it bypasses the client-echo gate — fencing must reach a member
-        regardless of its role. A standby/deposed member only tracks the
-        newer epoch: deposing a standby would silently kill its
-        _replicate loop (`while role == "standby"`) and leave the pair
-        with no replication at all (code-review r5)."""
+        older epoch steps down — and, when the fence names the winner's
+        port, REJOINS as its hot standby (self-healing pair: after a
+        partition heals or a stale member restarts, replication re-forms
+        without operator action). Carried in `fence_epoch` (not `epoch`)
+        so it bypasses the client-echo gate — fencing must reach a member
+        regardless of its role. A standby only tracks the newer epoch:
+        deposing it would silently kill its _replicate loop and leave the
+        pair with no replication at all (code-review r5)."""
         ep = m["fence_epoch"]
-        if ep > self.server.epoch:
+        rejoin = None
+        if m.get("port"):
+            # the winner as seen from THIS member: the fencing
+            # connection's source host + its advertised port
+            peer = self.writer.get_extra_info("peername")
+            if peer:
+                rejoin = (peer[0], int(m["port"]))
+        # equal-epoch tie-break on the per-promotion id: covers a reborn
+        # member whose journal carries the same epoch the winner holds.
+        # (It does NOT solve two sibling standbys promoting to the same
+        # epoch — they only fence their old primary, never each other;
+        # see the class docstring's multi-standby caveat.)
+        loses_tie = (ep == self.server.epoch
+                     and m.get("promo_id", "") > self.server.promo_id)
+        if ep > self.server.epoch or loses_tie:
             if self.server.role == "primary":
-                self.server.depose(ep)
+                self.server.depose(ep, rejoin=rejoin)
             else:
                 self.server.epoch = ep
+        elif (ep >= self.server.epoch and rejoin
+                and self.server.role == "deposed"):
+            # deposed earlier by a client op (which carries no address);
+            # the winner's fence now names one — late self-heal
+            self.server.depose(ep, rejoin=rejoin)
         return {"role": self.server.role, "epoch": self.server.epoch}
 
     async def _op_repl_subscribe(self, m):
@@ -331,17 +353,27 @@ class ControlPlaneServer:
         FENCED promotion (VERDICT r4 #4): every promotion bumps a
         monotonic epoch, persisted in the journal and returned by
         `role`. Clients echo their enrolled epoch on every op; a member
-        refuses ops from an older epoch, and STEPS DOWN
-        (role="deposed", refusing all further ops) the moment any op
-        proves a newer epoch exists. Clients pick the highest-epoch
+        refuses ops from an older epoch, and STEPS DOWN the moment any
+        op proves a newer epoch exists. Clients pick the highest-epoch
         primary among all members they can reach, so a partition
         between the pair cannot split epoch-aware clients between two
-        primaries: the first post-promotion client to touch the old
-        primary deposes it. What this is NOT: raft. A client that can
-        reach ONLY the old primary keeps writing at the old epoch until
-        any newer-epoch traffic arrives; the reference inherits quorum
-        from etcd (lib/runtime/src/transports/etcd.rs:90-120) and gives
-        up minority-side availability instead. The fence guarantees
+        primaries: the first post-promotion contact deposes the old
+        primary. SELF-HEALING: the winner's fence message names its
+        address, so a deposed durable member rejoins as the winner's
+        hot standby automatically (snapshot bootstrap discards its
+        divergent stale tail) — after a partition heals or a stale
+        member restarts, replication redundancy re-forms with no
+        operator action. An equal-epoch fence tie-breaks on a
+        per-promotion id (covers a reborn member whose journal holds the
+        winner's epoch). Known limitation: TWO standbys of one primary
+        that promote concurrently reach the same epoch and never fence
+        each other — run the pair topology (one standby), not a fan-out,
+        unless dual-primary-at-equal-epoch is acceptable.
+        What this is NOT: raft. A client that can reach ONLY the old
+        primary keeps writing at the old epoch until any newer-epoch
+        traffic arrives; the reference inherits quorum from etcd
+        (lib/runtime/src/transports/etcd.rs:90-120) and gives up
+        minority-side availability instead. The fence guarantees
         acknowledged writes never interleave across epochs on one
         member and that divergence is detectable (every write is
         epoch-tagged) — not that the minority side goes read-only
@@ -372,20 +404,46 @@ class ControlPlaneServer:
         self.epoch = max(1, journal.epoch) if journal is not None else 1
         if journal is not None:
             journal.epoch = self.epoch
+        # per-promotion id, the equal-epoch fence tie-break (two standbys
+        # of one primary can both promote to the same epoch)
+        self.promo_id = ""
 
-    def depose(self, newer_epoch: int) -> None:
-        """Step down: a client proved a newer promotion epoch exists (we
+    def depose(self, newer_epoch: int, rejoin: tuple = None) -> None:
+        """Step down: a peer proved a newer promotion epoch exists (we
         are the stale side of a partition). Refuse all further ops so our
         clients fail over to the real primary; remember the newer epoch so
         `role` reports it. Deliberately NOT journaled: a deposed member
         restarting comes back as primary at its OLD epoch and is re-fenced
         by the first epoch-tagged op — journaling the newer epoch would
-        instead resurrect it as a second primary AT the new epoch."""
+        instead resurrect it as a second primary AT the new epoch.
+
+        With `rejoin` (the winner's address, from its fence message) a
+        DURABLE member doesn't stay a dead end: it re-enters the pair as
+        the winner's hot standby — bootstrapping from its snapshot (which
+        discards our divergent stale-epoch tail; that divergence is the
+        documented non-raft trade) and streaming its journal — so
+        replication redundancy self-heals after a partition or a stale
+        restart, with no operator action."""
         if self.role == "primary":
-            log.warning("DEPOSED: op carried epoch %d > ours %d; refusing "
+            log.warning("DEPOSED: op carried epoch %d >= ours %d; refusing "
                         "all ops on :%d", newer_epoch, self.epoch, self.port)
         self.role = "deposed"
-        self.epoch = newer_epoch
+        self.epoch = max(self.epoch, newer_epoch)
+        # our own fencing loop (from a past promotion) must die with the
+        # primacy it defended: left running it would keep fencing with
+        # OUR stale promo_id at the now-shared epoch and could depose the
+        # healthy winner — two standbys of each other, no primary at all
+        # (code-review r5)
+        if self._fence_task is not None:
+            self._fence_task.cancel()
+            self._fence_task = None
+        if rejoin and hasattr(self.plane, "snapshot_state"):
+            log.warning("rejoining as hot standby of %s:%d", *rejoin)
+            self.standby_of = rejoin
+            self.synced = False
+            self.role = "standby"
+            if self._repl_task is None or self._repl_task.done():
+                self._repl_task = asyncio.create_task(self._replicate())
 
     def _fanout_record(self, rec: dict) -> None:
         for sid, (q, conn) in list(self.repl_subs.items()):
@@ -449,9 +507,9 @@ class ControlPlaneServer:
                                 "to sync — resuming primacy and fencing "
                                 "it", host, port, snap_ep, my_ep)
                             self.epoch = my_ep
+                            self.promo_id = uuid.uuid4().hex
                             self.role = "primary"
-                            self._fence_task = asyncio.create_task(
-                                self._fence_peer(host, port))
+                            self._arm_fence(host, port)
                             print(f"PROMOTED control-plane=:{self.port}",
                                   flush=True)
                             return
@@ -485,6 +543,7 @@ class ControlPlaneServer:
             if self.synced:
                 self.epoch += 1
                 self.plane.journal.record_epoch(self.epoch)
+                self.promo_id = uuid.uuid4().hex
                 self.role = "primary"
                 log.warning("replication link to %s:%d lost; PROMOTED to "
                             "primary on :%d at epoch %d", host, port,
@@ -494,8 +553,7 @@ class ControlPlaneServer:
                 # was a partition (old primary alive) or it later restarts
                 # from its data dir, it must learn the newer epoch and
                 # step down instead of serving old-epoch clients forever
-                self._fence_task = asyncio.create_task(
-                    self._fence_peer(host, port))
+                self._arm_fence(host, port)
                 return
             await asyncio.sleep(0.5)
 
@@ -511,6 +569,14 @@ class ControlPlaneServer:
                 asyncio.TimeoutError):
             return False
 
+    def _arm_fence(self, host, port):
+        """(Re)start the fencing loop toward the peer we superseded; any
+        loop from an earlier promotion is cancelled first so exactly one
+        fence task defends the current primacy."""
+        if self._fence_task is not None:
+            self._fence_task.cancel()
+        self._fence_task = asyncio.create_task(self._fence_peer(host, port))
+
     async def _fence_peer(self, host, port):
         # runs for the promoted member's whole life, not just until the
         # first successful fence: a deposed peer that RESTARTS from its
@@ -520,7 +586,9 @@ class ControlPlaneServer:
         while True:
             try:
                 m = await oneshot_request(
-                    host, port, {"op": "fence", "fence_epoch": self.epoch},
+                    host, port,
+                    {"op": "fence", "fence_epoch": self.epoch,
+                     "port": self.port, "promo_id": self.promo_id},
                     5.0)
                 now_fenced = m.get("role") != "primary"
                 if now_fenced and not fenced:

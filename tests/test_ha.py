@@ -132,10 +132,18 @@ def test_partition_fencing_no_divergent_acks(tmp_path):
         await c2.put("k", b"v2")
 
         # the promoted side's fencing loop reaches the old primary
-        # (reachable here — the "healed" case) and deposes it
-        await wait_for(lambda: primary.role == "deposed", timeout=15,
+        # (reachable here — the "healed" case): it steps down AND rejoins
+        # as the winner's hot standby (self-healing pair), re-syncing to
+        # the epoch-2 history
+        await wait_for(lambda: primary.role != "primary", timeout=15,
                        what="old primary deposed")
         assert primary.epoch == 2
+        await wait_for(lambda: primary.role == "standby" and primary.synced,
+                       timeout=15, what="old primary rejoined as standby")
+        await wait_for(
+            lambda: primary.plane.kv._data.get("k") is not None
+            and primary.plane.kv._data["k"].value == b"v2",
+            what="rejoined standby re-synced")
 
         # the stale-enrolled client's writes are now REFUSED, not
         # acknowledged into a divergent history
@@ -156,17 +164,18 @@ def test_partition_fencing_no_divergent_acks(tmp_path):
         assert c1b.port == standby.port and c1b.epoch == 2
         assert await c1b.get("k") == b"v2"
 
-        # a deposed member that RESTARTS from its data dir comes back as
-        # primary at its old epoch — and is re-fenced by the survivor's
-        # loop, so it can never re-enter service at a stale epoch
+        # a member that RESTARTS from its data dir comes back as primary
+        # at its old epoch — and is re-fenced by the survivor's loop into
+        # a standby again, so it can never re-enter service stale
         p_port = primary.port
         await primary.stop()
         reborn = await ControlPlaneServer(
             host="127.0.0.1", port=p_port,
             data_dir=str(tmp_path / "a")).start()
-        assert reborn.epoch == 1  # deposition deliberately not journaled
-        await wait_for(lambda: reborn.role == "deposed", timeout=15,
-                       what="reborn stale primary re-fenced")
+        assert reborn.epoch <= 2  # pre-rejoin journal state
+        await wait_for(lambda: reborn.role == "standby" and reborn.synced,
+                       timeout=15,
+                       what="reborn stale primary re-fenced to standby")
 
         await c1b.close()
         await c2.close()
@@ -223,8 +232,14 @@ def test_promoted_member_refuses_stale_snapshot_and_resumes_primacy(
         await wait_for(lambda: b2.role == "primary", timeout=15,
                        what="resume primacy")
         assert b2.epoch == 2
-        await wait_for(lambda: a.role == "deposed", timeout=15,
-                       what="stale primary fenced")
+        # the stale primary A is fenced and self-heals into B's standby,
+        # re-synced to the epoch-2 history (its divergent tail discarded)
+        await wait_for(lambda: a.role == "standby" and a.synced,
+                       timeout=15, what="stale primary fenced to standby")
+        await wait_for(
+            lambda: a.plane.kv._data.get("k") is not None
+            and a.plane.kv._data["k"].value == b"v2-acked",
+            what="rejoined standby holds the winner's history")
         c3 = await ControlPlaneClient("127.0.0.1", b2.port).connect()
         assert c3.epoch == 2
         assert await c3.get("k") == b"v2-acked"
