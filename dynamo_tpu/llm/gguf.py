@@ -301,6 +301,9 @@ def config_from_gguf(g: GGUFFile, name: str = ""):
         max_model_len=int(key("context_length", 2048)),
         attn_bias=arch == "qwen2",
         tie_word_embeddings="output.weight" not in g.tensors,
+        # MoE (Mixtral-class ggufs keep arch "llama" + expert_count)
+        num_experts=int(key("expert_count", 0) or 0),
+        num_experts_per_tok=int(key("expert_used_count", 2) or 2),
     )
 
 
@@ -328,13 +331,17 @@ def load_params_from_gguf(g: GGUFFile, cfg, dtype: str = "") -> Dict[str, Any]:
     def w(name):
         return np.asarray(g.tensor(name), dtype=dt)
 
+    def t3(name):
+        # fused expert tensor [E, A, B] (ne-reversed) -> ours [E, B, A]
+        return np.asarray(np.swapaxes(g.tensor(name), 1, 2), dtype=dt)
+
     def stack(fmt, fn):
         return np.stack([fn(fmt.format(i)) for i in range(cfg.num_layers)])
 
-    def stack_q(fmt):
+    def stack_q(fmt, fn):
         qs, ss = [], []
         for i in range(cfg.num_layers):
-            qt = quantize_int8(t(fmt.format(i)), xp=np)
+            qt = quantize_int8(fn(fmt.format(i)), xp=np)
             qs.append(qt["q"])
             ss.append(qt["s"])
         return {"q": np.stack(qs), "s": np.stack(ss)}
@@ -342,7 +349,8 @@ def load_params_from_gguf(g: GGUFFile, cfg, dtype: str = "") -> Dict[str, Any]:
     layers: Dict[str, Any] = {}
 
     def put(key, fmt, fn):
-        layers[key] = (stack_q(fmt) if key in qkeys else stack(fmt, fn))
+        layers[key] = (stack_q(fmt, fn) if key in qkeys
+                       else stack(fmt, fn))
 
     put("attn_norm", "blk.{}.attn_norm.weight", w)
     put("wq", "blk.{}.attn_q.weight", t)
@@ -350,9 +358,28 @@ def load_params_from_gguf(g: GGUFFile, cfg, dtype: str = "") -> Dict[str, Any]:
     put("wv", "blk.{}.attn_v.weight", t)
     put("wo", "blk.{}.attn_output.weight", t)
     put("mlp_norm", "blk.{}.ffn_norm.weight", w)
-    put("w_gate", "blk.{}.ffn_gate.weight", t)
-    put("w_up", "blk.{}.ffn_up.weight", t)
-    put("w_down", "blk.{}.ffn_down.weight", t)
+    if cfg.is_moe:
+        # Mixtral-class: llama.cpp fuses experts into one tensor per
+        # projection (blk.N.ffn_{gate,up,down}_exps.weight, [E, out, in]
+        # after the ne reversal) + the routing gate ffn_gate_inp
+        missing = [n for n in ("ffn_gate_inp", "ffn_gate_exps",
+                               "ffn_up_exps", "ffn_down_exps")
+                   if f"blk.0.{n}.weight" not in g.tensors]
+        if missing:
+            raise ValueError(
+                f"{g.path}: MoE gguf ({cfg.num_experts} experts) missing "
+                f"fused expert tensors {missing}; only the fused "
+                f"blk.N.ffn_*_exps layout (current llama.cpp converters) "
+                f"is supported — not the old per-expert "
+                f"blk.N.ffn_gate.{{e}} split")
+        put("router", "blk.{}.ffn_gate_inp.weight", t)
+        put("w_gate", "blk.{}.ffn_gate_exps.weight", t3)
+        put("w_up", "blk.{}.ffn_up_exps.weight", t3)
+        put("w_down", "blk.{}.ffn_down_exps.weight", t3)
+    else:
+        put("w_gate", "blk.{}.ffn_gate.weight", t)
+        put("w_up", "blk.{}.ffn_up.weight", t)
+        put("w_down", "blk.{}.ffn_down.weight", t)
     if cfg.attn_bias:
         layers["wq_b"] = stack("blk.{}.attn_q.bias", w)
         layers["wk_b"] = stack("blk.{}.attn_k.bias", w)
