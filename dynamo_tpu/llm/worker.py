@@ -305,3 +305,58 @@ async def serve_llm_worker(runtime, namespace: str, component: str,
     metadata = {"model_card": card.to_dict()} if card is not None else None
     served = await ep.serve(engine, metadata=metadata, stats_handler=stats)
     return served
+
+
+def install_graceful_drain(runtime, served, timeout_s: float = None) -> None:
+    """SIGTERM/SIGINT -> graceful drain for a serving worker process:
+    deregister the endpoint first (the instance key disappears, so
+    routers/clients stop sending new work here), let in-flight response
+    streams finish (bounded by DYN_DRAIN_TIMEOUT_S, default 30 s), then
+    shut the runtime down so the process exits cleanly.
+
+    The reference couples SIGTERM to its runtime cancellation token and
+    drains endpoints the same way (graceful shutdown for k8s rolling
+    restarts); without this, a SIGTERM kills mid-stream responses.
+    Installed by `dynamo_tpu.run in=endpoint` (worker mode); any embedder
+    of serve_llm_worker can call it too.
+    """
+    import os
+    import signal as _signal
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("DYN_DRAIN_TIMEOUT_S", "30"))
+    loop = asyncio.get_running_loop()
+    # the loop holds only weak task refs: an unreferenced drain task can
+    # be garbage-collected mid-await — keep it here. "force" lets a
+    # SECOND signal skip the in-flight wait (operator escalation).
+    state = {"task": None, "force": False}
+
+    async def drain():
+        log.warning("SIGTERM: draining — deregistering, then up to %.0fs "
+                    "for %d in-flight stream(s)", timeout_s,
+                    len(served.inflight))
+        try:
+            await served.shutdown()
+        except Exception:  # noqa: BLE001 — drain regardless
+            log.exception("deregistration failed; draining anyway")
+        deadline = loop.time() + timeout_s
+        while served.inflight and loop.time() < deadline \
+                and not state["force"]:
+            await asyncio.sleep(0.2)
+        if served.inflight:
+            log.warning("%s: %d stream(s) still in flight",
+                        "second signal" if state["force"]
+                        else "drain timeout", len(served.inflight))
+        await runtime.shutdown()
+
+    def on_signal():
+        if state["task"] is None:
+            state["task"] = asyncio.ensure_future(drain())
+        else:
+            state["force"] = True  # escalate: stop waiting on streams
+
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, on_signal)
+        except (NotImplementedError, RuntimeError):  # pragma: no cover
+            pass  # non-main thread / platform without signal support

@@ -164,9 +164,12 @@ async def run_endpoint(engine, card, spec: str, args) -> None:
         raise SystemExit("in=endpoint needs NS.COMPONENT.ENDPOINT")
     runtime = await DistributedRuntime.connect(
         args.control_host, args.control_port)
-    await serve_llm_worker(runtime, ns, comp, engine, endpoint=ep, card=card)
+    served = await serve_llm_worker(runtime, ns, comp, engine, endpoint=ep,
+                                    card=card)
     await register_model(runtime.kv, card.name, ns, comp, card, endpoint=ep,
                          model_type=card.model_type)
+    from dynamo_tpu.llm.worker import install_graceful_drain
+    install_graceful_drain(runtime, served)
     print(f"READY endpoint={spec} model={card.name}", flush=True)
     await runtime.shutdown_event.wait()
 

@@ -134,6 +134,9 @@ class Endpoint:
         rt = self._rt
         worker_id = rt.worker_id
         subject = self.subject_for(worker_id)
+        # live response-pump tasks, engine-agnostic: graceful drain
+        # (llm/worker.install_graceful_drain) waits for this to empty
+        inflight: set = set()
 
         async def handle(payload: bytes) -> bytes:
             env = msgpack.unpackb(payload, raw=False)
@@ -157,7 +160,9 @@ class Endpoint:
                 # generator-time failures are forwarded by pump_stream
                 await dataplane.pump_stream(writer, _packed(gen), ctx)
 
-            asyncio.create_task(run())
+            task = asyncio.create_task(run())
+            inflight.add(task)
+            task.add_done_callback(inflight.discard)
             return msgpack.packb({"ok": True})
 
         unserve = await rt.messaging.serve(subject, handle)
@@ -171,7 +176,8 @@ class Endpoint:
         }
         await rt.kv.put(self.key_for(worker_id), json.dumps(info).encode(),
                         rt.lease.id if rt.lease else 0)
-        served = ServedEndpoint(self, worker_id, unserve, stats_handler)
+        served = ServedEndpoint(self, worker_id, unserve, stats_handler,
+                                inflight=inflight)
         rt.register_served(served)
         if stats_handler is not None:
             stats_subject = f"$STATS.{subject}"
@@ -193,18 +199,29 @@ def _packed(gen) -> AsyncIterator[bytes]:
 
 class ServedEndpoint:
     def __init__(self, endpoint: Endpoint, worker_id: str, unserve,
-                 stats_handler=None):
+                 stats_handler=None, inflight: set = None):
         self.endpoint = endpoint
         self.worker_id = worker_id
         self._unserve = unserve
         self._unserve_stats = None
         self.stats_handler = stats_handler
+        # live response pumps (graceful drain waits on this emptying)
+        self.inflight: set = inflight if inflight is not None else set()
+        self._shut = False
 
     async def shutdown(self):
+        # idempotent (drain calls it, then runtime.shutdown sweeps all
+        # served endpoints again) and ordered: the instance KEY goes
+        # first so watching routers stop picking this instance BEFORE the
+        # request subject disappears — the other order hard-fails any
+        # request racing the drain with "no responder"
+        if self._shut:
+            return
+        self._shut = True
+        await self.endpoint._rt.kv.delete(self.endpoint.key_for(self.worker_id))
         await self._unserve()
         if self._unserve_stats is not None:
             await self._unserve_stats()
-        await self.endpoint._rt.kv.delete(self.endpoint.key_for(self.worker_id))
 
 
 class Client:

@@ -125,9 +125,14 @@ class DistributedRuntime:
     # -- lifecycle -----------------------------------------------------------
 
     async def shutdown(self):
-        if self.shutdown_event.is_set():
+        # re-entrancy is guarded by its own flag; the EVENT is set LAST —
+        # a caller awaiting shutdown_event (run.py worker mode) may exit
+        # the process the moment it fires, which would cancel this very
+        # coroutine mid-cleanup if the event were set up front
+        # (code-review r5: graceful drain lost its lease revoke)
+        if getattr(self, "_shutting_down", False):
             return
-        self.shutdown_event.set()
+        self._shutting_down = True
         for served in self._served:
             try:
                 await served.shutdown()
@@ -148,3 +153,4 @@ class DistributedRuntime:
         client = getattr(self, "_client", None)
         if client is not None:
             await client.close()
+        self.shutdown_event.set()

@@ -170,3 +170,68 @@ def test_client_stop_aborts_engine_request():
         await wrt.shutdown()
 
     asyncio.run(main())
+
+
+def test_sigterm_graceful_drain(tmp_path):
+    """k8s rolling-restart behavior (install_graceful_drain): SIGTERM to a
+    serving worker deregisters it immediately (no new routing) but lets
+    the in-flight stream FINISH before the process exits cleanly — the
+    reference's runtime-cancellation-token graceful shutdown."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    from dynamo_tpu.runtime.transports.server import ControlPlaneServer
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    async def main():
+        server = await ControlPlaneServer(port=0).start()
+        env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.run",
+             "in=endpoint:ns.echo.generate", "out=echo", "tiny",
+             "--echo-delay", "0.1", "--control-port", str(server.port)],
+            stdout=subprocess.PIPE, text=True, cwd=repo, env=env)
+        try:
+            # readline must not block the loop: the control plane serving
+            # the worker's connect runs IN this loop
+            line = await asyncio.get_running_loop().run_in_executor(
+                None, proc.stdout.readline)
+            assert "READY" in line, line
+            rt = await DistributedRuntime.connect(
+                "127.0.0.1", server.port, "cl")
+            client = rt.namespace("ns").component("echo").endpoint(
+                "generate").client()
+            await client.start()
+            await client.wait_for_instances()
+            req = {"request_id": "g1", "token_ids": list(range(30)),
+                   "stop": {"max_tokens": 30}}
+            frames = []
+            stream = await client.generate(req)
+            async for frame in stream:
+                frames.append(frame)
+                if len(frames) == 3:
+                    proc.send_signal(signal.SIGTERM)  # mid-stream
+            # the in-flight stream completed despite the SIGTERM
+            toks = [t for f in frames for t in f.get("token_ids", ())]
+            assert toks == list(range(30)), toks
+            assert frames[-1].get("finish_reason") == "length"
+            # worker exited cleanly after the drain (wait in an executor:
+            # the worker's shutdown RPCs need this loop's control plane)
+            rc = await asyncio.get_running_loop().run_in_executor(
+                None, proc.wait, 30)
+            assert rc == 0
+            # and its instance was deregistered
+            await asyncio.sleep(0.2)
+            assert await rt.kv.get_prefix("ns/") == [] or all(
+                "echo" not in e.key for e in
+                await rt.kv.get_prefix("ns/components/"))
+            await rt.shutdown()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            await server.stop()
+
+    asyncio.run(main())
