@@ -27,6 +27,11 @@ class ModelConfig:
     rope_theta: float = 500000.0
     rms_norm_eps: float = 1e-5
     attn_bias: bool = False      # q/k/v projection bias (Qwen2-style)
+    # Gemma-family architecture deltas (HF GemmaForCausalLM):
+    embed_scale: float = 0.0     # 0 = off; Gemma multiplies embeddings by
+    #                              sqrt(hidden_size) before the first layer
+    norm_plus_one: bool = False  # RMSNorm weight applied as (1 + w), in f32
+    mlp_act: str = "silu"        # "silu" | "gelu_tanh" (Gemma GeGLU)
     max_model_len: int = 2048
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"

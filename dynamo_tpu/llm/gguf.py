@@ -266,7 +266,7 @@ def config_from_gguf(g: GGUFFile, name: str = ""):
     from dynamo_tpu.engine.config import ModelConfig
     md = g.metadata
     arch = md.get("general.architecture", "llama")
-    if arch not in ("llama", "mistral", "qwen2"):
+    if arch not in ("llama", "mistral", "qwen2", "gemma"):
         raise ValueError(f"unsupported gguf architecture {arch!r}")
     p = arch  # key prefix
 
@@ -300,6 +300,12 @@ def config_from_gguf(g: GGUFFile, name: str = ""):
         rms_norm_eps=float(key("attention.layer_norm_rms_epsilon", 1e-5)),
         max_model_len=int(key("context_length", 2048)),
         attn_bias=arch == "qwen2",
+        # Gemma deltas: llama.cpp converters bake the +1 into the stored
+        # norm weights (undone at load, see norm_w below) and scale
+        # embeddings by sqrt(d) at graph build
+        embed_scale=float(d) ** 0.5 if arch == "gemma" else 0.0,
+        norm_plus_one=arch == "gemma",
+        mlp_act="gelu_tanh" if arch == "gemma" else "silu",
         tie_word_embeddings="output.weight" not in g.tensors,
         # MoE (Mixtral-class ggufs keep arch "llama" + expert_count)
         num_experts=int(key("expert_count", 0) or 0),
@@ -331,6 +337,15 @@ def load_params_from_gguf(g: GGUFFile, cfg, dtype: str = "") -> Dict[str, Any]:
     def w(name):
         return np.asarray(g.tensor(name), dtype=dt)
 
+    def norm_w(name):
+        # llama.cpp's Gemma converter bakes the +1 into every *norm.weight
+        # at conversion time; our runtime re-adds it (rms_norm plus_one),
+        # so undo the bake here to keep one convention across HF and GGUF
+        if cfg.norm_plus_one:
+            return np.asarray(
+                g.tensor(name).astype(np.float32) - 1.0, dtype=dt)
+        return w(name)
+
     def t3(name):
         # fused expert tensor [E, A, B] (ne-reversed) -> ours [E, B, A]
         return np.asarray(np.swapaxes(g.tensor(name), 1, 2), dtype=dt)
@@ -352,12 +367,12 @@ def load_params_from_gguf(g: GGUFFile, cfg, dtype: str = "") -> Dict[str, Any]:
         layers[key] = (stack_q(fmt, fn) if key in qkeys
                        else stack(fmt, fn))
 
-    put("attn_norm", "blk.{}.attn_norm.weight", w)
+    put("attn_norm", "blk.{}.attn_norm.weight", norm_w)
     put("wq", "blk.{}.attn_q.weight", t)
     put("wk", "blk.{}.attn_k.weight", t)
     put("wv", "blk.{}.attn_v.weight", t)
     put("wo", "blk.{}.attn_output.weight", t)
-    put("mlp_norm", "blk.{}.ffn_norm.weight", w)
+    put("mlp_norm", "blk.{}.ffn_norm.weight", norm_w)
     if cfg.is_moe:
         # Mixtral-class: llama.cpp fuses experts into one tensor per
         # projection (blk.N.ffn_{gate,up,down}_exps.weight, [E, out, in]
@@ -387,7 +402,7 @@ def load_params_from_gguf(g: GGUFFile, cfg, dtype: str = "") -> Dict[str, Any]:
     params: Dict[str, Any] = {
         "embed": w("token_embd.weight"),
         "layers": layers,
-        "final_norm": w("output_norm.weight"),
+        "final_norm": norm_w("output_norm.weight"),
     }
     if not cfg.tie_word_embeddings:
         head = t("output.weight")

@@ -201,10 +201,30 @@ def init_cache(cfg: ModelConfig, num_pages: int, page_size: int) -> Dict[str, ja
 
 # -- forward ------------------------------------------------------------------
 
-def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, w: jax.Array, eps: float,
+             plus_one: bool = False) -> jax.Array:
     xf = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    if plus_one:
+        # Gemma stores the norm weight as a delta from 1 and applies it in
+        # f32 before the downcast (HF GemmaRMSNorm)
+        return (xf * scale * (1.0 + w.astype(jnp.float32))).astype(x.dtype)
     return (xf * scale).astype(x.dtype) * w
+
+
+def mlp_activation(gate: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """GLU gate activation in f32: SiLU (llama) or tanh-GELU (Gemma)."""
+    gf = gate.astype(jnp.float32)
+    a = (jax.nn.gelu(gf, approximate=True) if cfg.mlp_act == "gelu_tanh"
+         else jax.nn.silu(gf))
+    return a.astype(gate.dtype)
+
+
+def scale_embeds(x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Gemma multiplies embedding outputs by sqrt(hidden) (in x.dtype)."""
+    if cfg.embed_scale:
+        return x * jnp.asarray(cfg.embed_scale, x.dtype)
+    return x
 
 
 def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -237,16 +257,16 @@ def _moe_mlp(x: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
 
     gate = jnp.einsum("btd,edf->betf", x, wmat(lp["w_gate"], x.dtype))
     up = jnp.einsum("btd,edf->betf", x, wmat(lp["w_up"], x.dtype))
-    act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    act = mlp_activation(gate, cfg) * up
     down = jnp.einsum("betf,efd->betd", act,
                       wmat(lp["w_down"], x.dtype))             # [B, E, T, D]
     return jnp.einsum("betd,bte->btd", down.astype(jnp.float32), combine).astype(x.dtype)
 
 
-def _dense_mlp(x: jax.Array, lp: Params) -> jax.Array:
+def _dense_mlp(x: jax.Array, lp: Params, cfg: ModelConfig) -> jax.Array:
     gate = jnp.einsum("btd,df->btf", x, wmat(lp["w_gate"], x.dtype))
     up = jnp.einsum("btd,df->btf", x, wmat(lp["w_up"], x.dtype))
-    act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    act = mlp_activation(gate, cfg) * up
     return jnp.einsum("btf,fd->btd", act, wmat(lp["w_down"], x.dtype))
 
 
@@ -288,7 +308,8 @@ def decode_forward(
     b = tokens.shape[0]
     h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     kernel_mode = _decode_kernel_mode(cfg)
-    x = jnp.take(params["embed"], tokens, axis=0)[:, None]   # [B, 1, D]
+    x = scale_embeds(jnp.take(params["embed"], tokens, axis=0),
+                     cfg)[:, None]  # [B, 1, D]
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
     moe_aux = cfg.is_moe and cfg.moe_impl == "dispatch"
     token_valid = valid[:, None] if (moe_aux and valid is not None) else None
@@ -298,7 +319,7 @@ def decode_forward(
             lp, lid, kb, vb, kw, vw = xs
         else:
             lp, lid = xs
-        xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         q = jnp.einsum("btd,de->bte", xn, wmat(lp["wq"], xn.dtype))
         k = jnp.einsum("btd,de->bte", xn, wmat(lp["wk"], xn.dtype))
         v = jnp.einsum("btd,de->bte", xn, wmat(lp["wv"], xn.dtype))
@@ -331,10 +352,10 @@ def decode_forward(
         x = x + jnp.einsum("bte,ed->btd",
                            attn.reshape(b, 1, h * hd),
                            wmat(lp["wo"], x.dtype))
-        xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         drop_stats = None
         if not cfg.is_moe:
-            mlp = _dense_mlp(xn, lp)
+            mlp = _dense_mlp(xn, lp, cfg)
         elif cfg.moe_impl == "dense":
             mlp = _moe_mlp(xn, lp, cfg)
         elif mesh is not None and mesh.shape.get("ep", 1) > 1:
@@ -363,7 +384,7 @@ def decode_forward(
     else:
         k_news, v_news = ys
         aux = {}
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
     head = (params["embed"].T if cfg.tie_word_embeddings
             else wmat(params["lm_head"], x.dtype))
     logits = jnp.einsum("bd,dv->bv", x[:, 0], head).astype(jnp.float32)
@@ -409,6 +430,9 @@ def forward(
                       jnp.take(params["embed"], tokens, axis=0))
     else:
         x = input_embeds.astype(_dtype(cfg))
+    # HF Gemma scales whatever enters the first layer (token embeds and
+    # caller-supplied inputs_embeds alike)
+    x = scale_embeds(x, cfg)
 
     use_kernel = tq == 1 and _decode_kernel_mode(cfg) is not None
     use_ring = sp_mesh is not None and tq > 1
@@ -426,7 +450,7 @@ def forward(
 
     def layer_step(x, layer):
         lp, kc, vc = layer
-        xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         q = jnp.einsum("btd,de->bte", xn, wmat(lp["wq"], xn.dtype))
         k = jnp.einsum("btd,de->bte", xn, wmat(lp["wk"], xn.dtype))
         v = jnp.einsum("btd,de->bte", xn, wmat(lp["wv"], xn.dtype))
@@ -458,10 +482,10 @@ def forward(
         x = x + jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd),
                            wmat(lp["wo"], x.dtype))
 
-        xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         drop_stats = None
         if not cfg.is_moe:
-            mlp = _dense_mlp(xn, lp)
+            mlp = _dense_mlp(xn, lp, cfg)
         elif cfg.moe_impl == "dense":
             mlp = _moe_mlp(xn, lp, cfg)
         elif mesh is not None and mesh.shape.get("ep", 1) > 1:
@@ -490,7 +514,7 @@ def forward(
             layer_step, x, (params["layers"], cache["k"], cache["v"]))
         aux = {}
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
     head = (params["embed"].T if cfg.tie_word_embeddings
             else wmat(params["lm_head"], x.dtype))
     logits = jnp.einsum("btd,dv->btv", x, head).astype(jnp.float32)

@@ -24,6 +24,8 @@ ARCHES = {
     "MistralForCausalLM": "llama",
     "Qwen2ForCausalLM": "qwen2",
     "MixtralForCausalLM": "mixtral",
+    "GemmaForCausalLM": "gemma",
+    "Phi3ForCausalLM": "phi3",
 }
 
 
@@ -36,6 +38,25 @@ def config_from_hf(hf: Dict[str, Any], name: str = "") -> ModelConfig:
     family = ARCHES[arch]
     heads = hf["num_attention_heads"]
     moe = family == "mixtral"
+    gemma = family == "gemma"
+    act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
+    if hf.get("rope_scaling"):
+        # e.g. phi-3 128k "longrope", llama-3.1 "llama3" scaling: silently
+        # using plain rope_theta would produce wrong logits past the
+        # original context, so refuse rather than mis-serve
+        kind = (hf["rope_scaling"].get("rope_type")
+                or hf["rope_scaling"].get("type") or "?")
+        raise ValueError(
+            f"rope_scaling={kind!r} is not supported; use a checkpoint "
+            f"without rope scaling (e.g. the base-context variant)")
+    max_len = int(hf.get("max_position_embeddings", 2048))
+    # Qwen2 configs carry sliding_window but disable it by default
+    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
+        # full attention == sliding-window attention while the context
+        # fits inside the window; cap the serving length there so models
+        # like phi-3-mini-4k (window 2047) / mistral-v0.1 (4096) stay
+        # exact instead of silently diverging past the window
+        max_len = min(max_len, int(hf["sliding_window"]))
     return ModelConfig(
         name=name or hf.get("model_type", family),
         vocab_size=hf["vocab_size"],
@@ -47,10 +68,15 @@ def config_from_hf(hf: Dict[str, Any], name: str = "") -> ModelConfig:
         head_dim=hf.get("head_dim") or hf["hidden_size"] // heads,
         rope_theta=float(hf.get("rope_theta", 10000.0)),
         rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
-        max_model_len=int(hf.get("max_position_embeddings", 2048)),
-        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        max_model_len=max_len,
+        # GemmaConfig ties embeddings by default and often omits the key
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", gemma)),
         attn_bias=(family == "qwen2") or bool(hf.get("attention_bias",
                                                      False)),
+        embed_scale=float(hf["hidden_size"]) ** 0.5 if gemma else 0.0,
+        norm_plus_one=gemma,
+        mlp_act="gelu_tanh" if act in ("gelu_pytorch_tanh", "gelu_tanh",
+                                       "gelu") else "silu",
         num_experts=int(hf.get("num_local_experts", 0)) if moe else 0,
         num_experts_per_tok=int(hf.get("num_experts_per_tok", 2)),
     )
@@ -99,13 +125,24 @@ def load_params_from_hf(path: str, cfg: ModelConfig,
     def stack(fn):
         return np.stack([fn(i) for i in range(cfg.num_layers)])
 
-    pre = "model.layers.{}"
+    fused_qkv = "model.layers.0.self_attn.qkv_proj.weight" in raw  # Phi-3
+    qo, ko = cfg.num_heads * cfg.head_dim, cfg.num_kv_heads * cfg.head_dim
+
+    def qkv(i, part):  # split Phi-3's fused [q|k|v, in] rows, then transpose
+        full = raw[f"model.layers.{i}.self_attn.qkv_proj.weight"]
+        lo, hi = {"q": (0, qo), "k": (qo, qo + ko),
+                  "v": (qo + ko, qo + 2 * ko)}[part]
+        return np.asarray(full[lo:hi].T, dtype=dt)
+
     layers: Dict[str, Any] = {
         "attn_norm": stack(
             lambda i: w(f"model.layers.{i}.input_layernorm.weight")),
-        "wq": stack(lambda i: t(f"model.layers.{i}.self_attn.q_proj.weight")),
-        "wk": stack(lambda i: t(f"model.layers.{i}.self_attn.k_proj.weight")),
-        "wv": stack(lambda i: t(f"model.layers.{i}.self_attn.v_proj.weight")),
+        "wq": stack((lambda i: qkv(i, "q")) if fused_qkv else
+                    (lambda i: t(f"model.layers.{i}.self_attn.q_proj.weight"))),
+        "wk": stack((lambda i: qkv(i, "k")) if fused_qkv else
+                    (lambda i: t(f"model.layers.{i}.self_attn.k_proj.weight"))),
+        "wv": stack((lambda i: qkv(i, "v")) if fused_qkv else
+                    (lambda i: t(f"model.layers.{i}.self_attn.v_proj.weight"))),
         "wo": stack(lambda i: t(f"model.layers.{i}.self_attn.o_proj.weight")),
         "mlp_norm": stack(
             lambda i: w(f"model.layers.{i}.post_attention_layernorm.weight")),
@@ -126,6 +163,17 @@ def load_params_from_hf(path: str, cfg: ModelConfig,
                 np.stack([t(moe.format(i) + f".experts.{e}.{theirs}.weight")
                           for e in range(cfg.num_experts)])
                 for i in range(cfg.num_layers)])
+    elif "model.layers.0.mlp.gate_up_proj.weight" in raw:  # Phi-3 fused GLU
+        f = cfg.intermediate_size
+
+        def gate_up(i, lo, hi):
+            full = raw[f"model.layers.{i}.mlp.gate_up_proj.weight"]
+            return np.asarray(full[lo:hi].T, dtype=dt)
+
+        layers["w_gate"] = stack(lambda i: gate_up(i, 0, f))
+        layers["w_up"] = stack(lambda i: gate_up(i, f, 2 * f))
+        layers["w_down"] = stack(
+            lambda i: t(f"model.layers.{i}.mlp.down_proj.weight"))
     else:
         layers["w_gate"] = stack(
             lambda i: t(f"model.layers.{i}.mlp.gate_proj.weight"))

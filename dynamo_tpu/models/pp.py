@@ -37,7 +37,8 @@ from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.engine.sampler import sample_logits
 from dynamo_tpu.ops.quant import is_quantized, quantize_shardings, wmat
 from dynamo_tpu.models.llama import (
-    AttnMetadata, Params, _dtype, apply_rope, rms_norm,
+    AttnMetadata, Params, _dtype, apply_rope, mlp_activation,
+    rms_norm, scale_embeds,
 )
 from dynamo_tpu.ops.attention import paged_attention, write_kv_pages
 from dynamo_tpu.parallel.mesh import shard_map_compat
@@ -125,7 +126,7 @@ def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
 
     def layer_step(x, layer):
         lp, kc, vc = layer
-        xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         q = jnp.einsum("btd,de->bte", xn, wmat(lp["wq"], xn.dtype))
         k = jnp.einsum("btd,de->bte", xn, wmat(lp["wk"], xn.dtype))
         v = jnp.einsum("btd,de->bte", xn, wmat(lp["wv"], xn.dtype))
@@ -141,10 +142,10 @@ def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
         o = jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd),
                        wmat(lp["wo"], x.dtype))
         x = x + jax.lax.psum(o, "tp")
-        xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         gate = jnp.einsum("btd,df->btf", xn, wmat(lp["w_gate"], xn.dtype))
         up = jnp.einsum("btd,df->btf", xn, wmat(lp["w_up"], xn.dtype))
-        act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+        act = mlp_activation(gate, cfg) * up
         mlp = jnp.einsum("btf,fd->btd", act, wmat(lp["w_down"], x.dtype))
         x = x + jax.lax.psum(mlp, "tp")
         return x, (kc, vc)
@@ -219,7 +220,7 @@ def _pp_body(cfg, pp, tp, m,
     wi_mb = mb(write_idx)
     # prefill token ids are all known up front: one gather+psum for the
     # whole batch instead of a collective per scan tick (code-review r5)
-    x0_all = _embed_lookup(embed, toks_mb).astype(dt)
+    x0_all = scale_embeds(_embed_lookup(embed, toks_mb).astype(dt), cfg)
 
     def tick(carry, t):
         x_prev, kc, vc = carry
@@ -236,7 +237,7 @@ def _pp_body(cfg, pp, tp, m,
             write_idx=jnp.where(valid, wi_mb[ic], -1))
         y, kc, vc = _stage(cfg, tp, x_in, layers, kc, vc, meta_t)
         # the LAST stage finishes microbatch i at this tick
-        xf = rms_norm(y, final_norm, cfg.rms_norm_eps)
+        xf = rms_norm(y, final_norm, cfg.rms_norm_eps, cfg.norm_plus_one)
         lg = jnp.einsum("btd,dv->btv", xf, head).astype(jnp.float32)
         lg = jnp.where((r == last) & valid, lg, 0.0)
         # hop activations to the next stage (ring; stage 0's recv is unused)
@@ -379,7 +380,7 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
         alive_in = feed_alive[i]
         pos = pos_mb[i] + k
         writable = valid & alive_in & (pos <= mp_mb[i])
-        x0 = _embed_lookup(embed, tok_in).astype(dt)[:, None]
+        x0 = scale_embeds(_embed_lookup(embed, tok_in).astype(dt), cfg)[:, None]
         x_in = jnp.where(r == 0, x0, y_prev)
         w_in = jnp.where(r == 0, writable, w_prev)
         page = pt_mb[i][rows, jnp.clip(pos, 0, mp_mb[i]) // page_size]
@@ -390,7 +391,7 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
                               kv_lens=kv_lens, write_idx=write_idx)
         y, kc, vc = _stage(cfg, tp, x_in, layers, kc, vc, meta_t)
         # last stage: greedy-sample this microbatch's token
-        xf = rms_norm(y, final_norm, cfg.rms_norm_eps)
+        xf = rms_norm(y, final_norm, cfg.rms_norm_eps, cfg.norm_plus_one)
         lg = jnp.einsum("btd,dv->btv", xf, head).astype(jnp.float32)
         if tp > 1 and head.shape[1] != cfg.vocab_size:
             lg = jax.lax.all_gather(lg, "tp", axis=2, tiled=True)

@@ -6,6 +6,7 @@ spec), covering: typed metadata (scalars, strings, arrays), F32/F16/Q8_0
 tensors with alignment, config mapping, params loading into a generating
 engine, the embedded tokenizer, and ModelDeploymentCard.from_gguf.
 """
+import dataclasses
 import os
 import struct
 
@@ -172,6 +173,118 @@ def test_parse_config_and_metadata(tmp_path):
     assert not cfg.tie_word_embeddings  # output.weight present
     assert g.metadata["general.name"] == "tiny-gguf"
     g.close()
+
+
+def test_gemma_gguf_config_flags(tmp_path):
+    """gemma-arch ggufs map to the Gemma architecture deltas (sqrt(d)
+    embed scale, (1+w) norms, tanh-GELU); tensor names are the same
+    llama.cpp blk.N.* layout so loading is shared with llama."""
+    path = str(tmp_path / "g.gguf")
+    toks = _vocab()
+    metadata = {
+        "general.architecture": (8, "gemma"),
+        "gemma.embedding_length": (4, 64),
+        "gemma.block_count": (4, 1),
+        "gemma.feed_forward_length": (4, 128),
+        "gemma.attention.head_count": (4, 4),
+        "gemma.attention.head_count_kv": (4, 1),
+        "gemma.attention.key_length": (4, 32),
+        "gemma.attention.layer_norm_rms_epsilon": (6, 1e-6),
+        "gemma.context_length": (4, 256),
+        "tokenizer.ggml.model": (8, "llama"),
+        "tokenizer.ggml.tokens": (9, (8, toks)),
+        "tokenizer.ggml.scores": (9, (6, _spm_scores(toks))),
+    }
+    write_gguf(path, metadata, {"token_embd.weight": _f32(
+        np.zeros((len(toks), 64), np.float32))})
+    g = GGUFFile(path)
+    cfg = config_from_gguf(g)
+    g.close()
+    assert cfg.norm_plus_one and cfg.mlp_act == "gelu_tanh"
+    assert abs(cfg.embed_scale - 8.0) < 1e-9
+    assert cfg.head_dim == 32 and cfg.num_kv_heads == 1
+    assert cfg.tie_word_embeddings  # no output.weight -> tied
+
+
+def test_gemma_gguf_logit_parity_with_hf(tmp_path):
+    """A gemma GGUF written the way llama.cpp's converter writes it
+    (norm weights stored WITH the baked +1) must produce the same logits
+    as the safetensors checkpoint through transformers: catches the
+    double-(1+w) bug class."""
+    torch = pytest.importorskip("torch")
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.llama import AttnMetadata
+    import jax.numpy as jnp
+
+    torch.manual_seed(0)
+    hf = GemmaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, head_dim=8,
+                     max_position_embeddings=64, rope_theta=10000.0)
+    m = GemmaForCausalLM(hf)
+    m.eval()
+    sd = {k: v.float().numpy() for k, v in m.state_dict().items()}
+
+    tensors = {
+        # converter bakes +1 into every norm weight
+        "token_embd.weight": _f32(sd["model.embed_tokens.weight"]),
+        "output_norm.weight": _f32(sd["model.norm.weight"] + 1.0),
+    }
+    for i in range(2):
+        p = f"model.layers.{i}."
+        tensors.update({
+            f"blk.{i}.attn_norm.weight": _f32(
+                sd[p + "input_layernorm.weight"] + 1.0),
+            f"blk.{i}.attn_q.weight": _f32(sd[p + "self_attn.q_proj.weight"]),
+            f"blk.{i}.attn_k.weight": _f32(sd[p + "self_attn.k_proj.weight"]),
+            f"blk.{i}.attn_v.weight": _f32(sd[p + "self_attn.v_proj.weight"]),
+            f"blk.{i}.attn_output.weight": _f32(
+                sd[p + "self_attn.o_proj.weight"]),
+            f"blk.{i}.ffn_norm.weight": _f32(
+                sd[p + "post_attention_layernorm.weight"] + 1.0),
+            f"blk.{i}.ffn_gate.weight": _f32(sd[p + "mlp.gate_proj.weight"]),
+            f"blk.{i}.ffn_up.weight": _f32(sd[p + "mlp.up_proj.weight"]),
+            f"blk.{i}.ffn_down.weight": _f32(sd[p + "mlp.down_proj.weight"]),
+        })
+    toks = _vocab()
+    metadata = {
+        "general.architecture": (8, "gemma"),
+        "gemma.embedding_length": (4, 32),
+        "gemma.block_count": (4, 2),
+        "gemma.feed_forward_length": (4, 64),
+        "gemma.attention.head_count": (4, 4),
+        "gemma.attention.head_count_kv": (4, 2),
+        "gemma.attention.key_length": (4, 8),
+        "gemma.attention.layer_norm_rms_epsilon": (6, hf.rms_norm_eps),
+        "gemma.rope.freq_base": (6, 10000.0),
+        "gemma.context_length": (4, 64),
+        "gemma.vocab_size": (4, 64),
+        "tokenizer.ggml.model": (8, "llama"),
+        "tokenizer.ggml.tokens": (9, (8, toks)),
+        "tokenizer.ggml.scores": (9, (6, _spm_scores(toks))),
+    }
+    path = str(tmp_path / "gemma.gguf")
+    write_gguf(path, metadata, tensors)
+    g = GGUFFile(path)
+    cfg = dataclasses.replace(config_from_gguf(g), dtype="float32")
+    params = load_params_from_gguf(g, cfg)
+    g.close()
+
+    ids = np.arange(1, 9, dtype=np.int32)
+    t = len(ids)
+    cache = llama.init_cache(cfg, 2, 8)
+    meta = AttnMetadata(
+        positions=jnp.arange(t, dtype=jnp.int32)[None],
+        page_table=jnp.arange(2, dtype=jnp.int32)[None],
+        kv_lens=jnp.asarray([t], jnp.int32),
+        write_idx=jnp.arange(t, dtype=jnp.int32)[None])
+    ours, _ = llama.forward(params, cfg, jnp.asarray(ids)[None], cache, meta)
+    with torch.no_grad():
+        theirs = m(torch.tensor(ids[None].astype(np.int64))).logits[0].numpy()
+    np.testing.assert_allclose(np.asarray(ours[0]), theirs,
+                               rtol=2e-4, atol=2e-4)
 
 
 def test_tensor_types_roundtrip(tmp_path):

@@ -154,6 +154,35 @@ def test_engine_serves_hf_checkpoint_greedy_parity(tmp_path):
     assert got == expect
 
 
+def test_gemma_checkpoint_parity(tmp_path):
+    """Gemma family: sqrt(d) embedding scale, (1+w) RMSNorm in f32,
+    tanh-GELU GLU, head_dim independent of hidden/heads, tied embeddings
+    (the HF GemmaConfig default)."""
+    from transformers import GemmaConfig, GemmaForCausalLM
+    hf = GemmaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, head_dim=24,
+                     max_position_embeddings=128, rope_theta=10000.0)
+    cfg = roundtrip(tmp_path, hf, GemmaForCausalLM)
+    assert cfg.tie_word_embeddings and cfg.norm_plus_one
+    assert cfg.mlp_act == "gelu_tanh" and cfg.head_dim == 24
+    assert abs(cfg.embed_scale - 8.0) < 1e-9
+
+
+def test_phi3_checkpoint_parity(tmp_path):
+    """Phi-3 family: fused qkv_proj / gate_up_proj tensors split by the
+    loader; otherwise llama-shaped (SiLU GLU, RMSNorm, untied head)."""
+    from transformers import Phi3Config, Phi3ForCausalLM
+    hf = Phi3Config(vocab_size=128, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=128,
+                    rope_theta=10000.0, tie_word_embeddings=False,
+                    pad_token_id=0)  # default 32000 breaks tiny vocabs
+    cfg = roundtrip(tmp_path, hf, Phi3ForCausalLM)
+    assert not cfg.attn_bias and cfg.mlp_act == "silu"
+    assert not cfg.norm_plus_one and cfg.embed_scale == 0.0
+
+
 def test_config_from_hf_rejects_unknown():
     with pytest.raises(ValueError, match="unsupported"):
         config_from_hf({"architectures": ["GPT2LMHeadModel"],
