@@ -432,6 +432,16 @@ _PRE_PATTERNS: Dict[str, str] = {
 _TOKEN_TYPE_CONTROL = 3  # llama.cpp LLAMA_TOKEN_TYPE_CONTROL
 
 
+def _spm_prepare(text: str, space: str, add_prefix: bool) -> str:
+    """The SPM pre-transform (space marker + optional leading marker),
+    shared by the Python and native encode paths so they can never
+    silently diverge on it."""
+    s = text.replace(" ", space)
+    if add_prefix and not s.startswith(space):
+        s = space + s
+    return s
+
+
 def _spm_encode(text: str, ids: Dict[str, int], scores: List[float],
                 byte_ids: Dict[int, int], unk: int, space: str,
                 add_prefix: bool) -> List[int]:
@@ -445,9 +455,7 @@ def _spm_encode(text: str, ids: Dict[str, int], scores: List[float],
     on pop), so long prompts stay O(n log n)."""
     import heapq
 
-    s = text.replace(" ", space)
-    if add_prefix and not s.startswith(space):
-        s = space + s
+    s = _spm_prepare(text, space, add_prefix)
     piece: List[str] = list(s)
     n = len(piece)
     if n == 0:
@@ -564,6 +572,12 @@ class GGUFTokenizer(BaseTokenizer):
                 self._scores = [0.0] * len(self.tokens)
             self._add_prefix = bool(
                 md.get("tokenizer.ggml.add_space_prefix", True))
+            # native C++ encoder when the toolchain can build it (exact
+            # parity with _spm_encode, fuzz-pinned in tests); None -> the
+            # Python path below
+            from dynamo_tpu.native.spm import make_encoder
+            self._native = make_encoder(self.tokens, self._scores,
+                                        self._byte_ids, self.unk_token_id)
 
     def _build_bpe(self, md: Dict[str, Any]):
         """tokens + merges -> an in-memory HF byte-level BPE tokenizer
@@ -610,6 +624,9 @@ class GGUFTokenizer(BaseTokenizer):
     def encode(self, text: str) -> List[int]:
         if self._hf is not None:
             return self._hf.encode(text, add_special_tokens=False).ids
+        if self._native is not None:
+            return self._native.encode(
+                _spm_prepare(text, self.SPACE, self._add_prefix))
         return _spm_encode(text, self._ids, self._scores, self._byte_ids,
                            self.unk_token_id, self.SPACE, self._add_prefix)
 
