@@ -32,6 +32,17 @@ class ModelConfig:
     #                              sqrt(hidden_size) before the first layer
     norm_plus_one: bool = False  # RMSNorm weight applied as (1 + w), in f32
     mlp_act: str = "silu"        # "silu" | "gelu_tanh" (Gemma GeGLU)
+    # Gemma-2 deltas:
+    post_norms: bool = False     # extra post-attention / post-ffw RMSNorms
+    attn_softcap: float = 0.0    # tanh soft-cap on attention logits (50.0)
+    final_softcap: float = 0.0   # tanh soft-cap on lm-head logits (30.0)
+    query_scale: float = 0.0     # q scaling; 0 = default head_dim**-0.5
+    #                              (Gemma-2 uses query_pre_attn_scalar**-0.5)
+    sliding_window: int = 0      # sliding-window attention width; 0 = full
+    # which layers use the sliding window (only meaningful when
+    # sliding_window > 0): "alternate" = even layers sliding, odd global
+    # (the Gemma-2 pattern); "all" = every layer sliding
+    sliding_pattern: str = "alternate"
     max_model_len: int = 2048
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
@@ -58,6 +69,19 @@ class ModelConfig:
     @property
     def q_per_kv(self) -> int:
         return self.num_heads // self.num_kv_heads
+
+    def layer_windows(self):
+        """Per-layer attention window as an int32 list: the sliding width
+        for sliding layers, a huge sentinel (2**30, effectively full) for
+        global layers. None when every layer is full-attention."""
+        if not self.sliding_window:
+            return None
+        full = 1 << 30
+        if self.sliding_pattern == "all":
+            return [self.sliding_window] * self.num_layers
+        # Gemma-2: even layers sliding, odd layers global
+        return [self.sliding_window if l % 2 == 0 else full
+                for l in range(self.num_layers)]
 
     @property
     def is_moe(self) -> bool:

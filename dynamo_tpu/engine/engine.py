@@ -84,6 +84,13 @@ class NativeEngine:
                 raise ValueError("multimodal models are not supported on a "
                                  "pp mesh; use tp/dp (pp_param_shardings "
                                  "carries no vision subtree)")
+            if (model_cfg.post_norms or model_cfg.attn_softcap
+                    or model_cfg.sliding_window or model_cfg.query_scale):
+                raise ValueError(
+                    "Gemma-2-class models (post-norms / logit soft-caps / "
+                    "sliding windows) are not supported on a pp mesh yet; "
+                    "use tp/dp meshes (models/pp.py stage body lacks the "
+                    "hooks)")
             model_cfg = dataclasses.replace(model_cfg, decode_kernel="off")
             if engine_cfg.max_slots % self.pp:
                 # decode slot-groups are the pipeline microbatches, so the
@@ -222,6 +229,12 @@ class NativeEngine:
                     "(whole-prompt prefill)")
             if any(b % engine_cfg.sp for b in engine_cfg.prefill_buckets):
                 raise ValueError("every prefill bucket must divide by sp")
+            if (model_cfg.attn_softcap or model_cfg.sliding_window
+                    or model_cfg.query_scale):
+                raise ValueError(
+                    "sp>1 (ring-attention prefill) does not support "
+                    "attention soft-caps / sliding windows / query-scale "
+                    "overrides; serve Gemma-2-class models with sp=1")
             sp_mesh = self.mesh
         # multi-device meshes hand the mesh to forward() so the Pallas decode
         # kernel runs under shard_map over "tp" instead of falling back to

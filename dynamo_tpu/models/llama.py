@@ -25,8 +25,8 @@ from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.ops.attention import (
-    decode_attention_deferred, decode_attention_split, paged_attention,
-    write_kv_pages,
+    _softcap, decode_attention_deferred, decode_attention_split,
+    paged_attention, write_kv_pages,
 )
 from dynamo_tpu.ops.moe import moe_dispatch_mlp, moe_dispatch_mlp_sharded
 from dynamo_tpu.ops.quant import wmat
@@ -56,6 +56,17 @@ def _decode_kernel_mode(cfg: ModelConfig) -> Optional[str]:
     "interpret" remains the CPU test path exercising the kernel code."""
     mode = cfg.decode_kernel
     if mode in ("off", "auto"):
+        return None
+    if cfg.attn_softcap or cfg.sliding_window or cfg.query_scale:
+        # Gemma-2 logit soft-caps / sliding windows live only in the
+        # gather paths; the Pallas kernel has no hook for them. Name the
+        # fallback when the kernel was explicitly requested (the engine's
+        # convention: silent fallbacks get misattributed).
+        import logging
+        logging.getLogger(__name__).warning(
+            "decode_kernel=%r requested but the model uses "
+            "soft-caps/sliding windows/query scaling the Pallas kernel "
+            "has no hooks for; using the XLA gather path", mode)
         return None
     if mode == "interpret":
         return "interpret"
@@ -99,6 +110,11 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         "wo": dense(keys[3], (l, h * hd, d), h * hd),
         "mlp_norm": jnp.ones((l, d), dt),
     }
+    if cfg.post_norms:
+        layers.update({
+            "post_attn_norm": jnp.ones((l, d), dt),
+            "post_mlp_norm": jnp.ones((l, d), dt),
+        })
     if cfg.attn_bias:
         layers.update({
             "wq_b": jnp.zeros((l, h * hd), dt),
@@ -150,6 +166,11 @@ def param_shardings(cfg: ModelConfig) -> Params:
         "wo": P(None, "tp", None),
         "mlp_norm": P(None, None),
     }
+    if cfg.post_norms:
+        layers.update({
+            "post_attn_norm": P(None, None),
+            "post_mlp_norm": P(None, None),
+        })
     if cfg.attn_bias:
         layers.update({
             "wq_b": P(None, "tp"),
@@ -308,6 +329,8 @@ def decode_forward(
     b = tokens.shape[0]
     h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     kernel_mode = _decode_kernel_mode(cfg)
+    lw = cfg.layer_windows()
+    layer_wnd = None if lw is None else jnp.asarray(lw, jnp.int32)
     x = scale_embeds(jnp.take(params["embed"], tokens, axis=0),
                      cfg)[:, None]  # [B, 1, D]
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
@@ -315,6 +338,10 @@ def decode_forward(
     token_valid = valid[:, None] if (moe_aux and valid is not None) else None
 
     def layer_step(x, xs):
+        if layer_wnd is not None:
+            xs, wnd = xs[:-1], xs[-1]
+        else:
+            wnd = None
         if window is not None:
             lp, lid, kb, vb, kw, vw = xs
         else:
@@ -333,7 +360,9 @@ def decode_forward(
         k_new, v_new = k[:, 0], v[:, 0]                  # [B, Hkv, hd]
         if window is not None:
             attn = decode_attention_split(
-                q[:, 0], kb, vb, kw, vw, k_new, v_new, base_lens, win_lens)
+                q[:, 0], kb, vb, kw, vw, k_new, v_new, base_lens, win_lens,
+                softcap=cfg.attn_softcap, window=wnd,
+                q_scale=cfg.query_scale)
         elif kernel_mode is not None:
             interp = kernel_mode == "interpret"
             if mesh is not None and mesh.size > 1:
@@ -348,10 +377,15 @@ def decode_forward(
         else:
             attn = decode_attention_deferred(
                 q[:, 0], cache["k"][lid], cache["v"][lid], k_new, v_new,
-                page_table, prefix_lens)
-        x = x + jnp.einsum("bte,ed->btd",
-                           attn.reshape(b, 1, h * hd),
-                           wmat(lp["wo"], x.dtype))
+                page_table, prefix_lens, softcap=cfg.attn_softcap,
+                window=wnd, q_scale=cfg.query_scale)
+        attn_out = jnp.einsum("bte,ed->btd",
+                              attn.reshape(b, 1, h * hd),
+                              wmat(lp["wo"], x.dtype))
+        if cfg.post_norms:
+            attn_out = rms_norm(attn_out, lp["post_attn_norm"],
+                                cfg.rms_norm_eps, cfg.norm_plus_one)
+        x = x + attn_out
         xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         drop_stats = None
         if not cfg.is_moe:
@@ -367,6 +401,9 @@ def decode_forward(
             mlp, drop_stats = moe_dispatch_mlp(
                 xn, lp, cfg, cfg.moe_capacity_factor, return_dropped=True,
                 valid=token_valid)
+        if cfg.post_norms:
+            mlp = rms_norm(mlp, lp["post_mlp_norm"], cfg.rms_norm_eps,
+                           cfg.norm_plus_one)
         x = x + mlp
         ys = (k_new, v_new, drop_stats) if moe_aux else (k_new, v_new)
         return x, ys
@@ -376,6 +413,8 @@ def decode_forward(
         xs = (params["layers"], layer_ids, kb_all, vb_all, kw_all, vw_all)
     else:
         xs = (params["layers"], layer_ids)
+    if layer_wnd is not None:
+        xs = xs + (layer_wnd,)
     x, ys = jax.lax.scan(layer_step, x, xs)
     if moe_aux:
         k_news, v_news, drops = ys
@@ -387,7 +426,8 @@ def decode_forward(
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
     head = (params["embed"].T if cfg.tie_word_embeddings
             else wmat(params["lm_head"], x.dtype))
-    logits = jnp.einsum("bd,dv->bv", x[:, 0], head).astype(jnp.float32)
+    logits = _softcap(jnp.einsum("bd,dv->bv", x[:, 0],
+                                 head).astype(jnp.float32), cfg.final_softcap)
     if with_aux:
         return logits, k_news, v_news, aux
     return logits, k_news, v_news
@@ -436,6 +476,14 @@ def forward(
 
     use_kernel = tq == 1 and _decode_kernel_mode(cfg) is not None
     use_ring = sp_mesh is not None and tq > 1
+    lw = cfg.layer_windows()
+    layer_wnd = None if lw is None else jnp.asarray(lw, jnp.int32)
+    if use_ring and (cfg.attn_softcap or cfg.query_scale
+                     or lw is not None):
+        raise NotImplementedError(
+            "ring-attention (sp) prefill does not support attention "
+            "soft-caps, sliding windows, or query-scale overrides; run "
+            "Gemma-2-class models with sp=1 (chunked paged prefill)")
     if use_ring:
         from jax.sharding import NamedSharding
         from dynamo_tpu.ops.ring_attention import ring_attention
@@ -449,7 +497,11 @@ def forward(
                                  meta.positions, -1)
 
     def layer_step(x, layer):
-        lp, kc, vc = layer
+        if layer_wnd is not None:
+            lp, kc, vc, wnd = layer
+        else:
+            lp, kc, vc = layer
+            wnd = None
         xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         q = jnp.einsum("btd,de->bte", xn, wmat(lp["wq"], xn.dtype))
         k = jnp.einsum("btd,de->bte", xn, wmat(lp["wk"], xn.dtype))
@@ -478,9 +530,14 @@ def forward(
                                   sp_mesh)
         else:
             attn = paged_attention(q, kc, vc, meta.page_table, meta.kv_lens,
-                                   meta.positions)
-        x = x + jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd),
-                           wmat(lp["wo"], x.dtype))
+                                   meta.positions, softcap=cfg.attn_softcap,
+                                   window=wnd, q_scale=cfg.query_scale)
+        attn_out = jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd),
+                              wmat(lp["wo"], x.dtype))
+        if cfg.post_norms:
+            attn_out = rms_norm(attn_out, lp["post_attn_norm"],
+                                cfg.rms_norm_eps, cfg.norm_plus_one)
+        x = x + attn_out
 
         xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         drop_stats = None
@@ -497,6 +554,9 @@ def forward(
             mlp, drop_stats = moe_dispatch_mlp(
                 xn, lp, cfg, cfg.moe_capacity_factor, return_dropped=True,
                 valid=token_valid)
+        if cfg.post_norms:
+            mlp = rms_norm(mlp, lp["post_mlp_norm"], cfg.rms_norm_eps,
+                           cfg.norm_plus_one)
         x = x + mlp
         ys = (kc, vc, drop_stats) if moe_aux else (kc, vc)
         return x, ys
@@ -504,20 +564,24 @@ def forward(
     moe_aux = cfg.is_moe and cfg.moe_impl == "dispatch"
     # real (non-padding) positions: padding slots carry write_idx < 0
     token_valid = meta.write_idx >= 0 if moe_aux else None
+    scan_xs = (params["layers"], cache["k"], cache["v"])
+    if layer_wnd is not None:
+        scan_xs = scan_xs + (layer_wnd,)
     if moe_aux:
-        x, (new_k, new_v, drops) = jax.lax.scan(
-            layer_step, x, (params["layers"], cache["k"], cache["v"]))
+        x, ys = jax.lax.scan(layer_step, x, scan_xs)
+        new_k, new_v, drops = ys[0], ys[1], ys[2]
         aux = {"moe_dropped": jnp.sum(drops[0]),
                "moe_routed": jnp.sum(drops[1])}
     else:
-        x, (new_k, new_v) = jax.lax.scan(
-            layer_step, x, (params["layers"], cache["k"], cache["v"]))
+        x, ys = jax.lax.scan(layer_step, x, scan_xs)
+        new_k, new_v = ys[0], ys[1]
         aux = {}
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
     head = (params["embed"].T if cfg.tie_word_embeddings
             else wmat(params["lm_head"], x.dtype))
-    logits = jnp.einsum("btd,dv->btv", x, head).astype(jnp.float32)
+    logits = _softcap(jnp.einsum("btd,dv->btv", x,
+                                 head).astype(jnp.float32), cfg.final_softcap)
     if with_aux:
         return logits, {"k": new_k, "v": new_v}, aux
     return logits, {"k": new_k, "v": new_v}

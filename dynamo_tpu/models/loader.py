@@ -25,6 +25,7 @@ ARCHES = {
     "Qwen2ForCausalLM": "qwen2",
     "MixtralForCausalLM": "mixtral",
     "GemmaForCausalLM": "gemma",
+    "Gemma2ForCausalLM": "gemma2",
     "Phi3ForCausalLM": "phi3",
 }
 
@@ -38,7 +39,8 @@ def config_from_hf(hf: Dict[str, Any], name: str = "") -> ModelConfig:
     family = ARCHES[arch]
     heads = hf["num_attention_heads"]
     moe = family == "mixtral"
-    gemma = family == "gemma"
+    gemma = family in ("gemma", "gemma2")
+    gemma2 = family == "gemma2"
     act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
     if hf.get("rope_scaling"):
         # e.g. phi-3 128k "longrope", llama-3.1 "llama3" scaling: silently
@@ -50,8 +52,23 @@ def config_from_hf(hf: Dict[str, Any], name: str = "") -> ModelConfig:
             f"rope_scaling={kind!r} is not supported; use a checkpoint "
             f"without rope scaling (e.g. the base-context variant)")
     max_len = int(hf.get("max_position_embeddings", 2048))
+    sliding = 0
+    sliding_pattern = "alternate"
+    if gemma2 and hf.get("sliding_window"):
+        # modeled natively: per-layer sliding/global alternation
+        sliding = int(hf["sliding_window"])
+        types = hf.get("layer_types")
+        if types is not None and all(t == "sliding_attention"
+                                     for t in types):
+            sliding_pattern = "all"
+        elif types is not None and types != [
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(hf["num_hidden_layers"])]:
+            raise ValueError(
+                "unsupported gemma2 layer_types pattern (only the "
+                "alternating default or all-sliding are modeled)")
     # Qwen2 configs carry sliding_window but disable it by default
-    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
+    elif hf.get("sliding_window") and hf.get("use_sliding_window", True):
         # full attention == sliding-window attention while the context
         # fits inside the window; cap the serving length there so models
         # like phi-3-mini-4k (window 2047) / mistral-v0.1 (4096) stay
@@ -77,6 +94,15 @@ def config_from_hf(hf: Dict[str, Any], name: str = "") -> ModelConfig:
         norm_plus_one=gemma,
         mlp_act="gelu_tanh" if act in ("gelu_pytorch_tanh", "gelu_tanh",
                                        "gelu") else "silu",
+        post_norms=gemma2,
+        attn_softcap=float(hf.get("attn_logit_softcapping") or 0.0)
+        if gemma2 else 0.0,
+        final_softcap=float(hf.get("final_logit_softcapping") or 0.0)
+        if gemma2 else 0.0,
+        query_scale=float(hf.get("query_pre_attn_scalar", 0)) ** -0.5
+        if gemma2 and hf.get("query_pre_attn_scalar") else 0.0,
+        sliding_window=sliding,
+        sliding_pattern=sliding_pattern,
         num_experts=int(hf.get("num_local_experts", 0)) if moe else 0,
         num_experts_per_tok=int(hf.get("num_experts_per_tok", 2)),
     )
@@ -144,9 +170,19 @@ def load_params_from_hf(path: str, cfg: ModelConfig,
         "wv": stack((lambda i: qkv(i, "v")) if fused_qkv else
                     (lambda i: t(f"model.layers.{i}.self_attn.v_proj.weight"))),
         "wo": stack(lambda i: t(f"model.layers.{i}.self_attn.o_proj.weight")),
+        # in llama-family checkpoints post_attention_layernorm is the
+        # PRE-MLP norm; in gemma2 (post_norms) it is a true post-attention
+        # norm and pre_feedforward_layernorm takes the pre-MLP role
         "mlp_norm": stack(
-            lambda i: w(f"model.layers.{i}.post_attention_layernorm.weight")),
+            lambda i: w(f"model.layers.{i}.pre_feedforward_layernorm.weight"
+                        if cfg.post_norms else
+                        f"model.layers.{i}.post_attention_layernorm.weight")),
     }
+    if cfg.post_norms:
+        layers["post_attn_norm"] = stack(
+            lambda i: w(f"model.layers.{i}.post_attention_layernorm.weight"))
+        layers["post_mlp_norm"] = stack(
+            lambda i: w(f"model.layers.{i}.post_feedforward_layernorm.weight"))
     if cfg.attn_bias:
         for ours, theirs in (("wq_b", "q_proj"), ("wk_b", "k_proj"),
                              ("wv_b", "v_proj")):

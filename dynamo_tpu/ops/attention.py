@@ -8,6 +8,15 @@ efficient dynamic-gather; a Pallas kernel that reads HBM pages directly (no
 materialized gather) lives in dynamo_tpu/ops/paged_attention.py and is used on
 TPU for decode (dispatch in models/llama.py).
 
+Gemma-2-class models add three knobs, threaded through every path here:
+- `softcap`: attention logits pass through tanh(s/cap)*cap before masking;
+- `window`: a per-call sliding-window width — keys with q_pos - k_pos >=
+  window are masked. Passed as a TRACED scalar so a lax.scan over layers
+  can alternate sliding/global layers (Gemma-2's pattern) with one
+  compiled body: global layers just carry a 2**30 sentinel width.
+- `q_scale`: query scaling override (query_pre_attn_scalar**-0.5);
+  0.0 selects the standard head_dim**-0.5.
+
 Reference equivalent: the engines' paged attention (vLLM/TRT-LLM internals) and
 the KV block layout in lib/llm/src/kv/layer.rs:100-616. We keep K and V as
 separate [n_kv_heads, num_pages, page_size, head_dim] arrays per layer
@@ -18,10 +27,23 @@ kv-head axis shard cleanly over the `tp` mesh axis.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+
+def _scale(hd: int, q_scale: float) -> float:
+    return q_scale if q_scale else hd ** -0.5
+
+
+def _softcap(scores: jax.Array, cap: float) -> jax.Array:
+    """tanh soft-cap (Gemma-2); identity when cap == 0 (trace-time)."""
+    if not cap:
+        return scores
+    return jnp.tanh(scores / cap) * cap
 
 
 def gather_pages(cache: jax.Array, page_table: jax.Array) -> jax.Array:
@@ -39,6 +61,9 @@ def paged_attention(
     page_table: jax.Array,   # [B, Pb] int32
     kv_lens: jax.Array,      # [B] int32 — valid kv length per sequence
     q_positions: jax.Array,  # [B, Tq] int32 — absolute position of each query
+    softcap: float = 0.0,
+    window: Optional[jax.Array] = None,  # scalar int32 sliding width
+    q_scale: float = 0.0,
 ) -> jax.Array:
     """Causal attention of q against the paged KV prefix. Returns [B, Tq, H, hd]."""
     b, tq, h, hd = q.shape
@@ -53,12 +78,15 @@ def paged_attention(
     scores = jnp.einsum(
         "btkgd,kbsd->bkgts", qg.astype(jnp.float32), k.astype(jnp.float32)
     )
-    scores = scores * (hd ** -0.5)
+    scores = _softcap(scores * _scale(hd, q_scale), softcap)
 
     kv_pos = jnp.arange(lk, dtype=jnp.int32)[None, :]          # [1, Lk]
     causal = kv_pos[:, None, :] <= q_positions[:, :, None]      # [B, Tq, Lk]
     valid = kv_pos < kv_lens[:, None]                           # [B, Lk]
     mask = causal & valid[:, None, :]                           # [B, Tq, Lk]
+    if window is not None:
+        # keep keys inside (q_pos - window, q_pos]
+        mask = mask & (q_positions[:, :, None] - kv_pos[:, None, :] < window)
     scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
@@ -76,6 +104,9 @@ def decode_attention_split(
     v_new: jax.Array,
     base_lens: jax.Array,    # [B] int32 — valid kv at WINDOW start
     win_lens: jax.Array,     # [B] int32 — tokens written in-window so far
+    softcap: float = 0.0,
+    window: Optional[jax.Array] = None,  # scalar int32 sliding width
+    q_scale: float = 0.0,
 ) -> jax.Array:
     """Decode attention over a base-plus-window split KV view.
 
@@ -90,29 +121,38 @@ def decode_attention_split(
     kv length (not the admission-time page allocation, which reserves
     for max_tokens), and the only scan-carried KV state is the Nw-wide
     window buffer — ~page_bucket*page_size/Nw times smaller.
-    Returns [B, H, hd].
+    Sliding-window masking uses the same absolute coordinates: the query
+    sits at base_lens + win_lens; base keys at their index, window-buffer
+    keys at base_lens + j. Returns [B, H, hd].
     """
     b, h, hd = q.shape
     hkv = k_base.shape[0]
     g = h // hkv
     lb = k_base.shape[2]
     nw = k_win.shape[2]
+    sc = _scale(hd, q_scale)
     qg = q.reshape(b, hkv, g, hd)
-    sb = jnp.einsum(
+    sb = _softcap(jnp.einsum(
         "bkgd,kbsd->bkgs", qg, k_base,
-        preferred_element_type=jnp.float32) * (hd ** -0.5)
+        preferred_element_type=jnp.float32) * sc, softcap)
     base_pos = jnp.arange(lb, dtype=jnp.int32)[None, :]
-    sb = jnp.where((base_pos < base_lens[:, None])[:, None, None, :],
-                   sb, NEG_INF)
-    sw = jnp.einsum(
+    base_mask = base_pos < base_lens[:, None]
+    if window is not None:
+        q_pos = (base_lens + win_lens)[:, None]      # [B, 1]
+        base_mask = base_mask & (q_pos - base_pos < window)
+    sb = jnp.where(base_mask[:, None, None, :], sb, NEG_INF)
+    sw = _softcap(jnp.einsum(
         "bkgd,kbsd->bkgs", qg, k_win,
-        preferred_element_type=jnp.float32) * (hd ** -0.5)
+        preferred_element_type=jnp.float32) * sc, softcap)
     win_pos = jnp.arange(nw, dtype=jnp.int32)[None, :]
-    sw = jnp.where((win_pos < win_lens[:, None])[:, None, None, :],
-                   sw, NEG_INF)
-    s_self = jnp.einsum(
+    win_mask = win_pos < win_lens[:, None]
+    if window is not None:
+        # q_pos - (base_lens + j) = win_lens - j
+        win_mask = win_mask & (win_lens[:, None] - win_pos < window)
+    sw = jnp.where(win_mask[:, None, None, :], sw, NEG_INF)
+    s_self = _softcap(jnp.einsum(
         "bkgd,bkd->bkg", qg, k_new,
-        preferred_element_type=jnp.float32) * (hd ** -0.5)
+        preferred_element_type=jnp.float32) * sc, softcap)
     # joint softmax across the three groups; s_self is always unmasked so
     # the max is finite even for empty base/window (padding slots)
     m = jnp.maximum(jnp.maximum(jnp.max(sb, axis=-1), jnp.max(sw, axis=-1)),
@@ -138,6 +178,9 @@ def decode_attention_deferred(
     v_new: jax.Array,
     page_table: jax.Array,   # [B, Pb] int32
     prefix_lens: jax.Array,  # [B] int32 — valid kv BEFORE this token
+    softcap: float = 0.0,
+    window: Optional[jax.Array] = None,  # scalar int32 sliding width
+    q_scale: float = 0.0,
 ) -> jax.Array:
     """Decode attention with the current token's kv appended in registers.
 
@@ -156,18 +199,22 @@ def decode_attention_deferred(
     v = gather_pages(v_cache, page_table)
     lk = k.shape[2]
 
+    sc = _scale(hd, q_scale)
     qg = q.reshape(b, hkv, g, hd)
     # dots stay in the cache dtype (bf16 on TPU: native MXU passes and half
     # the HBM read traffic of an f32 upcast) with f32 accumulation
-    scores = jnp.einsum(
+    scores = _softcap(jnp.einsum(
         "bkgd,kbsd->bkgs", qg, k,
-        preferred_element_type=jnp.float32) * (hd ** -0.5)
+        preferred_element_type=jnp.float32) * sc, softcap)
     kv_pos = jnp.arange(lk, dtype=jnp.int32)[None, :]     # [1, Lk]
     valid = kv_pos < prefix_lens[:, None]                 # [B, Lk]
+    if window is not None:
+        # the query's absolute position is prefix_lens
+        valid = valid & (prefix_lens[:, None] - kv_pos < window)
     scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
-    s_self = jnp.einsum(
+    s_self = _softcap(jnp.einsum(
         "bkgd,bkd->bkg", qg, k_new,
-        preferred_element_type=jnp.float32) * (hd ** -0.5)
+        preferred_element_type=jnp.float32) * sc, softcap)
 
     m = jnp.maximum(jnp.max(scores, axis=-1), s_self)     # [B, Hkv, G]
     p = jnp.exp(scores - m[..., None])                    # [B, Hkv, G, Lk]
@@ -203,17 +250,22 @@ def write_kv_pages(
 
 
 def dense_causal_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, positions: jax.Array
+    q: jax.Array, k: jax.Array, v: jax.Array, positions: jax.Array,
+    softcap: float = 0.0,
+    window: Optional[jax.Array] = None,
+    q_scale: float = 0.0,
 ) -> jax.Array:
     """Plain causal attention (no paging); [B, T, H, hd] each. Test oracle."""
     b, t, h, hd = q.shape
     hkv = k.shape[2]
     g = h // hkv
     qg = q.reshape(b, t, hkv, g, hd)
-    scores = jnp.einsum(
+    scores = _softcap(jnp.einsum(
         "btkgd,bskd->bkgts", qg.astype(jnp.float32), k.astype(jnp.float32)
-    ) * (hd ** -0.5)
+    ) * _scale(hd, q_scale), softcap)
     mask = positions[:, None, :] <= positions[:, :, None]  # [B, Tq, Tk]
+    if window is not None:
+        mask = mask & (positions[:, :, None] - positions[:, None, :] < window)
     scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
