@@ -84,13 +84,6 @@ class NativeEngine:
                 raise ValueError("multimodal models are not supported on a "
                                  "pp mesh; use tp/dp (pp_param_shardings "
                                  "carries no vision subtree)")
-            if (model_cfg.post_norms or model_cfg.attn_softcap
-                    or model_cfg.sliding_window or model_cfg.query_scale):
-                raise ValueError(
-                    "Gemma-2-class models (post-norms / logit soft-caps / "
-                    "sliding windows) are not supported on a pp mesh yet; "
-                    "use tp/dp meshes (models/pp.py stage body lacks the "
-                    "hooks)")
             model_cfg = dataclasses.replace(model_cfg, decode_kernel="off")
             if engine_cfg.max_slots % self.pp:
                 # decode slot-groups are the pipeline microbatches, so the

@@ -40,7 +40,9 @@ from dynamo_tpu.models.llama import (
     AttnMetadata, Params, _dtype, apply_rope, mlp_activation,
     rms_norm, scale_embeds,
 )
-from dynamo_tpu.ops.attention import paged_attention, write_kv_pages
+from dynamo_tpu.ops.attention import (
+    _softcap, paged_attention, write_kv_pages,
+)
 from dynamo_tpu.parallel.mesh import shard_map_compat
 
 
@@ -57,6 +59,11 @@ def pp_param_shardings(cfg: ModelConfig) -> Params:
         "w_up": P("pp", None, "tp"),
         "w_down": P("pp", "tp", None),
     }
+    if cfg.post_norms:
+        layers.update({
+            "post_attn_norm": P("pp", None),
+            "post_mlp_norm": P("pp", None),
+        })
     if cfg.attn_bias:
         layers.update({
             "wq_b": P("pp", "tp"),
@@ -112,12 +119,14 @@ def _head_and_specs(cfg: ModelConfig, params: Params):
 
 
 def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
-           meta: AttnMetadata):
+           meta: AttnMetadata, wnds=None):
     """Run this stage's local layers (scan) on one microbatch.
 
     Mirrors models/llama.forward's layer_step (gather attention path) with
     manual Megatron psums over "tp"; kc/vc are the stage-local
-    [L/pp, Hkv/tp, ...] cache shards.
+    [L/pp, Hkv/tp, ...] cache shards. `wnds` is the stage-local slice of
+    the per-layer sliding-window array (None = all layers full attention);
+    post-norms / soft-caps / query scaling follow models/llama.forward.
     """
     b, tq, _ = x.shape
     h = cfg.num_heads // tp
@@ -125,7 +134,11 @@ def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
     hd = cfg.head_dim
 
     def layer_step(x, layer):
-        lp, kc, vc = layer
+        if wnds is not None:
+            lp, kc, vc, wnd = layer
+        else:
+            lp, kc, vc = layer
+            wnd = None
         xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         q = jnp.einsum("btd,de->bte", xn, wmat(lp["wq"], xn.dtype))
         k = jnp.einsum("btd,de->bte", xn, wmat(lp["wk"], xn.dtype))
@@ -138,20 +151,32 @@ def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
         v = v.reshape(b, tq, hkv, hd)
         kc, vc = write_kv_pages(kc, vc, k, v, meta.write_idx)
         attn = paged_attention(q, kc, vc, meta.page_table, meta.kv_lens,
-                               meta.positions)
+                               meta.positions, softcap=cfg.attn_softcap,
+                               window=wnd, q_scale=cfg.query_scale)
         o = jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd),
                        wmat(lp["wo"], x.dtype))
-        x = x + jax.lax.psum(o, "tp")
+        # psum BEFORE the post-norm: rms_norm is nonlinear, so it must see
+        # the full attention output, not this tp shard's partial sum
+        o = jax.lax.psum(o, "tp")
+        if cfg.post_norms:
+            o = rms_norm(o, lp["post_attn_norm"], cfg.rms_norm_eps,
+                         cfg.norm_plus_one)
+        x = x + o
         xn = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         gate = jnp.einsum("btd,df->btf", xn, wmat(lp["w_gate"], xn.dtype))
         up = jnp.einsum("btd,df->btf", xn, wmat(lp["w_up"], xn.dtype))
         act = mlp_activation(gate, cfg) * up
         mlp = jnp.einsum("btf,fd->btd", act, wmat(lp["w_down"], x.dtype))
-        x = x + jax.lax.psum(mlp, "tp")
+        mlp = jax.lax.psum(mlp, "tp")
+        if cfg.post_norms:
+            mlp = rms_norm(mlp, lp["post_mlp_norm"], cfg.rms_norm_eps,
+                           cfg.norm_plus_one)
+        x = x + mlp
         return x, (kc, vc)
 
-    x, (kc, vc) = jax.lax.scan(layer_step, x, (layers, kc, vc))
-    return x, kc, vc
+    xs = (layers, kc, vc) if wnds is None else (layers, kc, vc, wnds)
+    x, ys = jax.lax.scan(layer_step, x, xs)
+    return x, ys[0], ys[1]
 
 
 def pp_forward(
@@ -179,26 +204,33 @@ def pp_forward(
     while b % m:
         m -= 1
     shardings, head, head_spec, base_hs = _head_and_specs(cfg, params)
+    lw = cfg.layer_windows()
+    wnds = None if lw is None else jnp.asarray(lw, jnp.int32)
     fwd = functools.partial(_pp_body, cfg, pp, tp, m)
+    in_specs = (P("tp", None), shardings["layers"], P(None), head_spec,
+                pp_cache_sharding(), pp_cache_sharding(),
+                P(), P(), P(), P(), P())
+    args = (params["embed"], params["layers"], params["final_norm"], head,
+            cache["k"], cache["v"], tokens, meta.positions, meta.page_table,
+            meta.kv_lens, meta.write_idx)
+    if wnds is not None:
+        in_specs = in_specs + (P("pp"),)
+        args = args + (wnds,)
     specs = dict(
         mesh=mesh,
-        in_specs=(P("tp", None), shardings["layers"], P(None), head_spec,
-                  pp_cache_sharding(), pp_cache_sharding(),
-                  P(), P(), P(), P(), P()),
+        in_specs=in_specs,
         # logits vocab-sharded over tp when the head is; cache back in place
         out_specs=(P(None, None, "tp") if base_hs[1] == "tp" else P(),
                    pp_cache_sharding(), pp_cache_sharding()),
     )
-    logits, kc, vc = shard_map_compat(fwd, **specs)(
-        params["embed"], params["layers"], params["final_norm"], head,
-        cache["k"], cache["v"], tokens, meta.positions, meta.page_table,
-        meta.kv_lens, meta.write_idx)
+    logits, kc, vc = shard_map_compat(fwd, **specs)(*args)
     return logits, {"k": kc, "v": vc}
 
 
 def _pp_body(cfg, pp, tp, m,
              embed, layers, final_norm, head,
-             kc, vc, tokens, positions, page_table, kv_lens, write_idx):
+             kc, vc, tokens, positions, page_table, kv_lens, write_idx,
+             wnds=None):
     """shard_map body: runs once per (pp, tp) shard with stage-local
     layers/cache. One GPipe schedule of m microbatches over pp stages."""
     r = jax.lax.axis_index("pp")
@@ -235,10 +267,11 @@ def _pp_body(cfg, pp, tp, m,
             positions=pos_mb[ic], page_table=pt_mb[ic], kv_lens=kl_mb[ic],
             # fill/drain ticks must not write KV: scatter drops idx < 0
             write_idx=jnp.where(valid, wi_mb[ic], -1))
-        y, kc, vc = _stage(cfg, tp, x_in, layers, kc, vc, meta_t)
+        y, kc, vc = _stage(cfg, tp, x_in, layers, kc, vc, meta_t, wnds)
         # the LAST stage finishes microbatch i at this tick
         xf = rms_norm(y, final_norm, cfg.rms_norm_eps, cfg.norm_plus_one)
-        lg = jnp.einsum("btd,dv->btv", xf, head).astype(jnp.float32)
+        lg = _softcap(jnp.einsum("btd,dv->btv", xf,
+                                 head).astype(jnp.float32), cfg.final_softcap)
         lg = jnp.where((r == last) & valid, lg, 0.0)
         # hop activations to the next stage (ring; stage 0's recv is unused)
         y_next = jax.lax.ppermute(
@@ -320,19 +353,26 @@ def pp_decode_window(
     s = tokens.shape[0]
     assert s % pp == 0, (s, pp)
     shardings, head, head_spec, _ = _head_and_specs(cfg, params)
+    lw = cfg.layer_windows()
+    wnds = None if lw is None else jnp.asarray(lw, jnp.int32)
     fwd = functools.partial(_pp_decode_body, cfg, pp, tp, n_steps,
                             page_size, eos_ids, greedy)
+    in_specs = (P("tp", None), shardings["layers"], P(None), head_spec,
+                pp_cache_sharding(), pp_cache_sharding(),
+                P(), P(), P(), P(), P(), P(), P(), P(),
+                P(), P(), P(), P())
+    args = (params["embed"], params["layers"], params["final_norm"], head,
+            cache["k"], cache["v"], tokens, positions, page_table, max_pos,
+            min_tokens, counters, ignore_eos, stop_ids,
+            temperature, top_k, top_p, seeds)
+    if wnds is not None:
+        in_specs = in_specs + (P("pp"),)
+        args = args + (wnds,)
     out_toks, kc, vc = shard_map_compat(
         fwd, mesh=mesh,
-        in_specs=(P("tp", None), shardings["layers"], P(None), head_spec,
-                  pp_cache_sharding(), pp_cache_sharding(),
-                  P(), P(), P(), P(), P(), P(), P(), P(),
-                  P(), P(), P(), P()),
+        in_specs=in_specs,
         out_specs=(P(), pp_cache_sharding(), pp_cache_sharding()),
-    )(params["embed"], params["layers"], params["final_norm"], head,
-      cache["k"], cache["v"], tokens, positions, page_table, max_pos,
-      min_tokens, counters, ignore_eos, stop_ids,
-      temperature, top_k, top_p, seeds)
+    )(*args)
     return out_toks, {"k": kc, "v": vc}
 
 
@@ -340,7 +380,7 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
                     embed, layers, final_norm, head,
                     kc, vc, tokens, pos0, page_table, max_pos,
                     min_tokens, counters, ignore_eos, stop_ids,
-                    temperature, top_k, top_p, seeds):
+                    temperature, top_k, top_p, seeds, wnds=None):
     r = jax.lax.axis_index("pp")
     last = pp - 1
     m = pp                      # microbatches == stages (see docstring)
@@ -389,10 +429,11 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
         kv_lens = jnp.clip(pos + 1, 0, mp_mb[i] + 1)
         meta_t = AttnMetadata(positions=pos[:, None], page_table=pt_mb[i],
                               kv_lens=kv_lens, write_idx=write_idx)
-        y, kc, vc = _stage(cfg, tp, x_in, layers, kc, vc, meta_t)
+        y, kc, vc = _stage(cfg, tp, x_in, layers, kc, vc, meta_t, wnds)
         # last stage: greedy-sample this microbatch's token
         xf = rms_norm(y, final_norm, cfg.rms_norm_eps, cfg.norm_plus_one)
-        lg = jnp.einsum("btd,dv->btv", xf, head).astype(jnp.float32)
+        lg = _softcap(jnp.einsum("btd,dv->btv", xf,
+                                 head).astype(jnp.float32), cfg.final_softcap)
         if tp > 1 and head.shape[1] != cfg.vocab_size:
             lg = jax.lax.all_gather(lg, "tp", axis=2, tiled=True)
         lg = lg[:, 0]                          # [bm, V]
