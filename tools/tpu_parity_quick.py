@@ -1,0 +1,108 @@
+"""Standalone TPU numerical-parity runner (VERDICT r4 #2/#3).
+
+Mirrors bench.py's parity phase without the perf phases in front of it,
+so it fits a short tunnel up-window: build the flagship window engine
+(decode_steps=64, split-KV pregather + deferred writeback + adaptive
+ladder), greedy-generate 96 tokens, rebuild as the single-step twin
+(decode_steps=1, same seed => identical params), and assert the token
+streams are identical. CPU tests can't see Mosaic/XLA-TPU divergence —
+this is the one check that must execute on hardware.
+
+Rides the persistent compilation cache bench.py populates (.jax_cache),
+so a run right after a bench capture only pays the single-step twin's
+compile. Writes PARITY_TPU_r05.json and exits 0 on exact parity, 1 on
+divergence, 2 when the backend never came up (caller retries later).
+
+Reference bar: the window decode path is our throughput headline
+(docs/architecture.md:57-61 analogue); an unnoticed numerics divergence
+there would invalidate it.
+"""
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+OUT = os.path.join(HERE, "PARITY_TPU_r05.json")
+
+
+def log(*a):
+    print("[parity]", *a, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    t0 = time.time()
+    import jax
+    # the image pins jax_platforms to the TPU tunnel programmatically;
+    # honor an explicit JAX_PLATFORMS override (CPU validation runs)
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(HERE, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass
+    devices = jax.devices()
+    backend = jax.default_backend()
+    log(f"backend up in {time.time() - t0:.1f}s: {devices} ({backend})")
+    if backend != "tpu" and os.environ.get("PARITY_ALLOW_CPU") != "1":
+        log("not a TPU backend; refusing (set PARITY_ALLOW_CPU=1 to force)")
+        return 2
+
+    from dynamo_tpu.engine.config import EngineConfig, get_model_config
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import SamplingParams
+
+    model_cfg = get_model_config(os.environ.get("BENCH_MODEL", "llama3-1b"))
+    prompt = [(31 * j) % 1000 + 1 for j in range(64)]
+    params = SamplingParams(max_tokens=96, temperature=0.0, ignore_eos=True)
+
+    def build(decode_steps):
+        cfg = EngineConfig(
+            page_size=64, num_pages=256, max_slots=8, max_prefill_chunk=128,
+            prefill_buckets=(128,), max_model_len=2048,
+            decode_steps=decode_steps, max_prefill_batch=8)
+        return NativeEngine(model_cfg, cfg, seed=0)
+
+    log("building window engine (decode_steps=64)")
+    engine = build(64)
+    t1 = time.time()
+    got = engine.generate(prompt, params, "parity-window")
+    log(f"window side: {len(got)} tokens in {time.time() - t1:.1f}s")
+    del engine  # free HBM before the twin
+
+    log("building single-step twin (decode_steps=1)")
+    e1 = build(1)
+    t2 = time.time()
+    ref = e1.generate(prompt, params, "parity-single")
+    log(f"single-step side: {len(ref)} tokens in {time.time() - t2:.1f}s")
+
+    if got == ref:
+        verdict = f"exact({len(ref)} tokens)"
+        rc = 0
+        log(f"parity OK: {len(ref)} greedy tokens identical")
+    else:
+        div = next((i for i, (a, b) in enumerate(zip(got, ref))
+                    if a != b), min(len(got), len(ref)))
+        verdict = f"DIVERGED@{div}"
+        rc = 1
+        log(f"parity FAILURE at token {div}: window={got[:div + 3]} "
+            f"single={ref[:div + 3]}")
+    json.dump({
+        "t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "backend": backend, "devices": [str(d) for d in devices],
+        "parity": verdict, "tokens": len(ref),
+        "window_decode_steps": 64, "elapsed_s": round(time.time() - t0, 1),
+    }, open(OUT, "w"), indent=1)
+    log(f"wrote {OUT}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

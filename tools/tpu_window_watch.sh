@@ -1,0 +1,82 @@
+#!/bin/sh
+# Tunnel-window watcher: the axon tunnel flaps (minutes-long up-windows
+# between hours of outage — TRIAGE_r05.md). This loop probes it and, the
+# moment a probe answers, runs the remaining TPU-evidence items in
+# priority order, each gated on a marker artifact so completed items are
+# never redone:
+#   1. PARITY_TPU_r05.json      — tools/tpu_parity_quick.py (window vs
+#                                 single-step greedy, token-for-token)
+#   2. real_ckpt_e2e_tpu.log    — tools/real_ckpt_e2e.py on the TPU
+#                                 backend (full-stack HTTP serve of a
+#                                 genuine HF checkpoint, transformers
+#                                 oracle)
+#   3. BENCH_SELF_r05_int8.json — BENCH_QUANT=int8 bench.py (weight-only
+#                                 int8: the HBM-bandwidth lever)
+# Single-slot tunnel: waits for any bench_until_green.sh / bench.py to
+# exit before touching it. Usage: nohup tools/tpu_window_watch.sh &
+cd "$(dirname "$0")/.." || exit 1
+start=$(date +%s)
+MAX_WALL_S=${MAX_WALL_S:-30600}
+while true; do
+  now=$(date +%s)
+  [ $((now - start)) -gt "$MAX_WALL_S" ] && { echo "[watch] wall cap; exit" >&2; exit 0; }
+  if [ -e PARITY_TPU_r05.json ] && [ -e real_ckpt_e2e_tpu.log ] \
+      && [ -e BENCH_SELF_r05_int8.json ]; then
+    echo "[watch] all TPU evidence captured; exiting" >&2
+    exit 0
+  fi
+  # one-slot tunnel: never probe while another bench holds it
+  if pgrep -f bench_until_green.sh >/dev/null 2>&1 \
+      || pgrep -f "bench.py" >/dev/null 2>&1; then
+    sleep 60
+    continue
+  fi
+  probe=$(timeout 75 python -c "
+import json, time
+t = time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())
+import jax
+ds = jax.devices()
+print(json.dumps({'t': t, 'ok': jax.default_backend() == 'tpu', 'n': len(ds)}))
+" 2>/dev/null | tail -1)
+  echo "{\"t\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"watch\": true, \"probe\": ${probe:-null}}" \
+      >> tools/tpu_probe_log.jsonl
+  case "$probe" in
+    *'"ok": true'*)
+      echo "[watch] tunnel UP at $(date -u +%H:%M:%S); running evidence items" >&2
+      if [ ! -e PARITY_TPU_r05.json ]; then
+        echo "[watch] -> parity" >&2
+        timeout 900 python tools/tpu_parity_quick.py >> tpu_parity_r5.log 2>&1 \
+          && echo "[watch] parity captured" >&2
+      fi
+      if [ ! -e real_ckpt_e2e_tpu.log ]; then
+        echo "[watch] -> real-checkpoint e2e on TPU" >&2
+        timeout 900 python tools/real_ckpt_e2e.py --out real_ckpt_e2e_tpu.log \
+          >> tpu_realckpt_r5.log 2>&1 \
+          && echo "[watch] real-ckpt TPU captured" >&2 \
+          || rm -f real_ckpt_e2e_tpu.log   # partial/failed run: retry next window
+      fi
+      if [ ! -e BENCH_SELF_r05_int8.json ]; then
+        echo "[watch] -> int8 bench" >&2
+        rm -f .bench_state.json
+        BENCH_QUANT=int8 BENCH_BUDGET_S=1200 python bench.py \
+            >/tmp/bench_q.json 2>>/tmp/bench_q.log
+        qvalue=$(python -c "import json;print(json.load(open('/tmp/bench_q.json'))['value'])" \
+            2>/dev/null || echo 0)
+        case "$qvalue" in
+          0|0.0|"") echo "[watch] int8 got no number" >&2 ;;
+          *)
+            python - "$(date -u +%Y-%m-%dT%H:%M:%SZ)" <<'EOF'
+import json, sys
+r = json.load(open("/tmp/bench_q.json"))
+r["timestamp"] = sys.argv[1]
+r["self_measured"] = True
+json.dump(r, open("BENCH_SELF_r05_int8.json", "w"), indent=1)
+EOF
+            cp /tmp/bench_q.log BENCH_SELF_r05_int8.log 2>/dev/null
+            echo "[watch] int8 captured: $qvalue" >&2 ;;
+        esac
+      fi ;;
+    *) : ;;  # down; loop
+  esac
+  sleep 45
+done
