@@ -333,6 +333,69 @@ class WorkerState:
         self.touch()
 
 
+# THE measurement engine geometry — one literal shared by the worker's
+# EngineConfig and run_parity's fresh-build/twin configs, so the parity
+# check can never silently compare engines built from diverging configs
+PAGE_KWARGS = dict(
+    page_size=64, num_pages=256, max_slots=8, max_prefill_chunk=128,
+    prefill_buckets=(128,), max_model_len=2048, max_prefill_batch=8)
+
+
+def run_parity(model_cfg, engine_box=None, touch=lambda: None, logf=None):
+    """Window-vs-single-step greedy token parity on the current backend.
+
+    ONE implementation shared by the bench parity phase and the standalone
+    window-runner (tools/tpu_parity_quick.py), so both always validate the
+    same configuration. The window side is the split-KV pregather +
+    deferred-writeback + adaptive-ladder engine (decode_steps=64) on a
+    fresh prompt; 96 tokens crosses a page boundary and exercises multiple
+    ladder rungs (64 + smaller tails). The single-step twin is built with
+    the same seed => identical params.
+
+    engine_box: a single-element list holding an already-built window
+    engine to reuse (the bench's measurement engine) — the list is emptied
+    here so the engine can be freed before the twin is built (HBM). None
+    builds a fresh decode_steps=64 engine. Returns the verdict string
+    ("exact(N tokens)" / "DIVERGED@i").
+    """
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import SamplingParams
+
+    logf = logf or log
+    prompt = [(31 * j) % 1000 + 1 for j in range(64)]
+    params = SamplingParams(max_tokens=96, temperature=0.0, ignore_eos=True)
+
+    if engine_box:
+        # reuse path: validates the measurement engine AS BUILT (whatever
+        # decode_steps the bench ran with)
+        engine = engine_box.pop()
+        # drain perf-phase state so no prefix/cache reuse leaks in
+        for rid in list(engine.scheduler.params):
+            engine.abort(rid)
+        while engine.has_work():
+            engine.step()
+    else:
+        engine = NativeEngine(
+            model_cfg, EngineConfig(decode_steps=64, **PAGE_KWARGS), seed=0)
+        touch()
+    got = engine.generate(prompt, params, "parity-window")
+    del engine  # free HBM before building the single-step twin
+    touch()
+    e1 = NativeEngine(
+        model_cfg, EngineConfig(decode_steps=1, **PAGE_KWARGS), seed=0)
+    touch()
+    ref = e1.generate(prompt, params, "parity-single")
+    if got == ref:
+        logf(f"parity OK: {len(ref)} greedy tokens identical")
+        return f"exact({len(ref)} tokens)"
+    div = next((i for i, (a, b) in enumerate(zip(got, ref))
+                if a != b), min(len(got), len(ref)))
+    logf(f"parity FAILURE at token {div}: window={got[:div + 3]} "
+         f"single={ref[:div + 3]}")
+    return f"DIVERGED@{div}"
+
+
 def worker():
     st = WorkerState()
     st.set_phase("import")
@@ -408,7 +471,7 @@ def worker():
         model_cfg = dataclasses.replace(model_cfg, quant=quant)
         st.result["metric"] += f"_{quant}"
         st.result["extras"]["quant"] = quant
-    slots = 8
+    slots = PAGE_KWARGS["max_slots"]  # engine geometry drives the workload
     # 64-step windows: the window-pregathered decode amortizes its per-
     # window gather/writeback + host dispatch over more tokens (997 tok/s
     # at 32 -> 1215 at 64 on v5e-1). Bigger windows keep helping in
@@ -418,10 +481,7 @@ def worker():
     # scheduler's adaptive clamp keeps short-remainder requests on smaller
     # compiled variants either way.
     decode_steps = int(os.environ.get("BENCH_DECODE_STEPS", "64"))
-    cfg = EngineConfig(
-        page_size=64, num_pages=256, max_slots=slots, max_prefill_chunk=128,
-        prefill_buckets=(128,), max_model_len=2048,
-        decode_steps=decode_steps, max_prefill_batch=8)
+    cfg = EngineConfig(decode_steps=decode_steps, **PAGE_KWARGS)
     st.result["extras"].update(kernel=kernel, decode_steps=decode_steps,
                                slots=slots)
 
@@ -576,37 +636,11 @@ def worker():
         st.result["extras"]["parity"] = "skipped"
         st.set_phase("done")
         return
-    # the window side: the measurement engine itself (decode_steps=64,
-    # split-KV pregather + deferred writeback + adaptive ladder), on a
-    # fresh prompt so no prefix/cache state from the perf phases leaks in.
-    # 96 tokens crosses a page boundary and exercises multiple ladder
-    # rungs (64 + smaller tails).
-    for rid in list(engine.scheduler.params):
-        engine.abort(rid)
-    while engine.has_work():
-        engine.step()
-    par_prompt = [(31 * j) % 1000 + 1 for j in range(64)]
-    par_params = SamplingParams(max_tokens=96, temperature=0.0,
-                                ignore_eos=True)
-    got = engine.generate(par_prompt, par_params, "parity-window")
-    del engine  # free HBM before building the single-step twin
-    st.touch()
-    cfg1 = EngineConfig(
-        page_size=64, num_pages=256, max_slots=slots, max_prefill_chunk=128,
-        prefill_buckets=(128,), max_model_len=2048, decode_steps=1,
-        max_prefill_batch=8)
-    e1 = NativeEngine(model_cfg, cfg1, seed=0)   # same seed => same params
-    st.touch()
-    ref = e1.generate(par_prompt, par_params, "parity-single")
-    if got == ref:
-        st.result["extras"]["parity"] = f"exact({len(ref)} tokens)"
-        log(f"parity OK: {len(ref)} greedy tokens identical")
-    else:
-        div = next((i for i, (a, b) in enumerate(zip(got, ref))
-                    if a != b), min(len(got), len(ref)))
-        st.result["extras"]["parity"] = f"DIVERGED@{div}"
-        log(f"parity FAILURE at token {div}: window={got[:div + 3]} "
-            f"single={ref[:div + 3]}")
+    box = [engine]
+    del engine  # run_parity must hold the only reference to free HBM
+    verdict = run_parity(model_cfg, engine_box=box,
+                         touch=st.touch, logf=log)
+    st.result["extras"]["parity"] = verdict
     st.touch()
     st.set_phase("done")
 

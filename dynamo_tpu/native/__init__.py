@@ -22,6 +22,12 @@ log = logging.getLogger("dynamo_tpu.native")
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LOCK = threading.Lock()
 _LIBS = {}
+# per-component extra compile/link args (after the source on the g++ line)
+_EXTRA_ARGS = {
+    # capi links the system xxhash (prototype declared in-source; no dev
+    # headers in the image) for the tokens_hash recipe
+    "capi": ["-l:libxxhash.so.0"],
+}
 
 
 def _build(name: str) -> Optional[str]:
@@ -35,7 +41,8 @@ def _build(name: str) -> Optional[str]:
         # process must never dlopen a partially-written .so
         tmp = f"{lib}.{os.getpid()}.tmp"
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src],
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src]
+            + _EXTRA_ARGS.get(name, []),
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, lib)
         return lib

@@ -1,17 +1,19 @@
 """Standalone TPU numerical-parity runner (VERDICT r4 #2/#3).
 
-Mirrors bench.py's parity phase without the perf phases in front of it,
-so it fits a short tunnel up-window: build the flagship window engine
-(decode_steps=64, split-KV pregather + deferred writeback + adaptive
-ladder), greedy-generate 96 tokens, rebuild as the single-step twin
-(decode_steps=1, same seed => identical params), and assert the token
-streams are identical. CPU tests can't see Mosaic/XLA-TPU divergence —
-this is the one check that must execute on hardware.
+Runs ONLY bench.py's parity phase (bench.run_parity — one shared
+implementation, so this always validates the exact configuration the
+bench measures) without the perf phases in front of it, so it fits a
+short tunnel up-window: window engine (decode_steps=64, split-KV
+pregather + deferred writeback + adaptive ladder) vs the single-step
+twin, 96 greedy tokens, token-for-token. CPU tests can't see
+Mosaic/XLA-TPU divergence — this is the one check that must execute on
+hardware.
 
 Rides the persistent compilation cache bench.py populates (.jax_cache),
 so a run right after a bench capture only pays the single-step twin's
 compile. Writes PARITY_TPU_r05.json and exits 0 on exact parity, 1 on
-divergence, 2 when the backend never came up (caller retries later).
+divergence, 2 when the backend never came up (caller retries later),
+3 on a configuration error (permanent; never retried).
 
 Reference bar: the window decode path is our throughput headline
 (docs/architecture.md:57-61 analogue); an unnoticed numerics divergence
@@ -55,53 +57,31 @@ def main() -> int:
         log("not a TPU backend; refusing (set PARITY_ALLOW_CPU=1 to force)")
         return 2
 
-    from dynamo_tpu.engine.config import EngineConfig, get_model_config
-    from dynamo_tpu.engine.engine import NativeEngine
-    from dynamo_tpu.engine.scheduler import SamplingParams
+    import bench
+    from dynamo_tpu.engine.config import get_model_config
 
     model_cfg = get_model_config(os.environ.get("BENCH_MODEL", "llama3-1b"))
-    prompt = [(31 * j) % 1000 + 1 for j in range(64)]
-    params = SamplingParams(max_tokens=96, temperature=0.0, ignore_eos=True)
-
-    def build(decode_steps):
-        cfg = EngineConfig(
-            page_size=64, num_pages=256, max_slots=8, max_prefill_chunk=128,
-            prefill_buckets=(128,), max_model_len=2048,
-            decode_steps=decode_steps, max_prefill_batch=8)
-        return NativeEngine(model_cfg, cfg, seed=0)
-
-    log("building window engine (decode_steps=64)")
-    engine = build(64)
-    t1 = time.time()
-    got = engine.generate(prompt, params, "parity-window")
-    log(f"window side: {len(got)} tokens in {time.time() - t1:.1f}s")
-    del engine  # free HBM before the twin
-
-    log("building single-step twin (decode_steps=1)")
-    e1 = build(1)
-    t2 = time.time()
-    ref = e1.generate(prompt, params, "parity-single")
-    log(f"single-step side: {len(ref)} tokens in {time.time() - t2:.1f}s")
-
-    if got == ref:
-        verdict = f"exact({len(ref)} tokens)"
-        rc = 0
-        log(f"parity OK: {len(ref)} greedy tokens identical")
-    else:
-        div = next((i for i, (a, b) in enumerate(zip(got, ref))
-                    if a != b), min(len(got), len(ref)))
-        verdict = f"DIVERGED@{div}"
-        rc = 1
-        log(f"parity FAILURE at token {div}: window={got[:div + 3]} "
-            f"single={ref[:div + 3]}")
-    json.dump({
+    # honor BENCH_QUANT exactly as the bench worker does, so an int8
+    # capture can get int8 parity evidence (not a bf16 run mislabeled)
+    quant = os.environ.get("BENCH_QUANT", "")
+    if quant:
+        if quant != "int8":
+            log(f"BENCH_QUANT={quant!r} unsupported (supported: int8)")
+            return 3  # config error: permanent, never retried
+        import dataclasses
+        model_cfg = dataclasses.replace(model_cfg, quant=quant)
+    verdict = bench.run_parity(model_cfg, logf=log)
+    record = {
         "t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "backend": backend, "devices": [str(d) for d in devices],
-        "parity": verdict, "tokens": len(ref),
-        "window_decode_steps": 64, "elapsed_s": round(time.time() - t0, 1),
-    }, open(OUT, "w"), indent=1)
+        "parity": verdict, "window_decode_steps": 64,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    if quant:
+        record["quant"] = quant
+    json.dump(record, open(OUT, "w"), indent=1)
     log(f"wrote {OUT}")
-    return rc
+    return 0 if verdict.startswith("exact") else 1
 
 
 if __name__ == "__main__":

@@ -58,21 +58,24 @@ print(json.dumps({'t': t, 'ok': jax.default_backend() == 'tpu', 'n': len(ds)}))
       if [ ! -e BENCH_SELF_r05_int8.json ]; then
         echo "[watch] -> int8 bench" >&2
         rm -f .bench_state.json
-        BENCH_QUANT=int8 BENCH_BUDGET_S=1200 python bench.py \
-            >/tmp/bench_q.json 2>>/tmp/bench_q.log
-        qvalue=$(python -c "import json;print(json.load(open('/tmp/bench_q.json'))['value'])" \
-            2>/dev/null || echo 0)
+        # per-attempt truncated, PID-unique paths: the published .log must
+        # contain exactly the run that produced the .json next to it
+        qj=/tmp/bench_q_$$.json ql=/tmp/bench_q_$$.log
+        BENCH_QUANT=int8 BENCH_BUDGET_S=1200 timeout 1500 python bench.py \
+            >"$qj" 2>"$ql"
+        qvalue=$(python -c "import json,sys;print(json.load(open(sys.argv[1]))['value'])" \
+            "$qj" 2>/dev/null || echo 0)
         case "$qvalue" in
           0|0.0|"") echo "[watch] int8 got no number" >&2 ;;
           *)
-            python - "$(date -u +%Y-%m-%dT%H:%M:%SZ)" <<'EOF'
+            python - "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$qj" <<'EOF'
 import json, sys
-r = json.load(open("/tmp/bench_q.json"))
+r = json.load(open(sys.argv[2]))
 r["timestamp"] = sys.argv[1]
 r["self_measured"] = True
 json.dump(r, open("BENCH_SELF_r05_int8.json", "w"), indent=1)
 EOF
-            cp /tmp/bench_q.log BENCH_SELF_r05_int8.log 2>/dev/null
+            cp "$ql" BENCH_SELF_r05_int8.log 2>/dev/null
             echo "[watch] int8 captured: $qvalue" >&2 ;;
         esac
       fi ;;
