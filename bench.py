@@ -41,6 +41,29 @@ NOMINAL_BASELINE_TOK_S = 1000.0  # ~40% of single-chip roofline at batch 8
 METRIC = "decode_tokens_per_sec_per_chip_llama3_1b_bf16_b8"
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "540"))  # hard deadline
 HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def worker_metric_name() -> str:
+    """Metric name for the current env (BENCH_MODEL/BENCH_QUANT): fixed at
+    process start so it can never change between a worker's state writes."""
+    name = METRIC
+    model = os.environ.get("BENCH_MODEL", "llama3-1b")
+    if model != "llama3-1b":
+        name = f"decode_tokens_per_sec_per_chip_{model}_b8_validation"
+    quant = os.environ.get("BENCH_QUANT", "")
+    if quant:
+        if quant != "int8":
+            # fail HERE (both supervisor and worker call this at startup)
+            # so a typo'd quant can never stamp an artifact labeled with a
+            # configuration that was rejected, not measured
+            raise SystemExit(f"BENCH_QUANT={quant!r} unsupported "
+                             "(supported: int8)")
+        # the flagship name carries the dtype: swap it rather than emit
+        # a self-contradictory "..._bf16_b8_int8" label (validation names
+        # carry no dtype — append there)
+        name = (name.replace("_bf16_", f"_{quant}_")
+                if "_bf16_" in name else f"{name}_{quant}")
+    return name
 STATE_PATH = os.environ.get("BENCH_STATE",
                             os.path.join(HERE, ".bench_state.json"))
 
@@ -143,18 +166,39 @@ def supervise() -> int:
         raise SystemExit(143)
 
     signal.signal(signal.SIGTERM, _on_term)
-    best = {"metric": METRIC, "value": 0.0, "unit": "tokens/s/chip",
-            "vs_baseline": 0.0, "extras": {}}
+    # the supervisor shares the worker's env, so it knows the exact metric
+    # its workers will report — seed the artifact label AND the foreign-
+    # state guard from it (a first-seen latch would let a foreign state
+    # that lands first lock out the real worker)
+    expected_metric = worker_metric_name()
+    best = {"metric": expected_metric, "value": 0.0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0, "extras": {}}
 
     def merge(state):
         r = state.get("result") or {}
+        m = r.get("metric")
+        if m is not None and m != expected_metric:
+            # a state from some OTHER bench (shared state path) must not
+            # be merged — it would publish mislabeled evidence (a tiny CPU
+            # validation number was nearly published as an int8 capture)
+            log(f"REFUSING foreign state: metric {m!r} != "
+                f"{expected_metric!r}")
+            return
         if r.get("value", 0.0) > best["value"]:
             best["value"] = r["value"]
             best["vs_baseline"] = r["vs_baseline"]
-            best["metric"] = r.get("metric", METRIC)
         # extras accumulate across attempts (ttft from one attempt, churn
         # from another, etc.); later attempts win per key
         best["extras"].update(r.get("extras") or {})
+
+    # pid-unique state file unless the caller pinned one: two concurrent
+    # supervisors (e.g. a CPU validation run beside a TPU capture loop)
+    # must never merge each other's states — a tiny-model CPU number
+    # merged into a TPU artifact is false evidence (found the hard way
+    # in r5: a tiny_b8_validation state got published as an int8 capture)
+    global STATE_PATH
+    if "BENCH_STATE" not in os.environ:
+        STATE_PATH = os.path.join(HERE, f".bench_state.{os.getpid()}.json")
 
     try:
         os.unlink(STATE_PATH)
@@ -210,7 +254,8 @@ def supervise() -> int:
             # new session => whole process group is killable even if jax
             # spawns helper threads/processes; stdout routed to stderr so
             # only the supervisor writes the result line to stdout
-            env = dict(os.environ, BENCH_ATTEMPT=str(attempt))
+            env = dict(os.environ, BENCH_ATTEMPT=str(attempt),
+                       BENCH_STATE=STATE_PATH)
             child = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__), "--worker"],
                 stdout=sys.stderr, stderr=sys.stderr,
@@ -224,10 +269,15 @@ def supervise() -> int:
                 if state:
                     merge(state)
                     # stale state from a killed prior attempt must not
-                    # count as this attempt's progress (or lack of it)
-                    if state["t"] >= spawn_t and (
-                            state["phase"] != last_phase
-                            or state["t"] > last_t):
+                    # count as this attempt's progress (or lack of it) —
+                    # nor may a FOREIGN bench's state on a shared pinned
+                    # path (merge refuses it; the stall heartbeat must
+                    # too, or a foreign writer masks our worker's hang)
+                    m = (state.get("result") or {}).get("metric")
+                    if (m in (None, expected_metric)
+                            and state["t"] >= spawn_t
+                            and (state["phase"] != last_phase
+                                 or state["t"] > last_t)):
                         last_phase, last_t = state["phase"], state["t"]
                 if code is not None:
                     log(f"worker exited rc={code} in phase {last_phase}")
@@ -294,6 +344,11 @@ def supervise() -> int:
             best["extras"]["tunnel_probes"] = probes
         print(json.dumps(best), flush=True)
         log("final:", best)
+        if "BENCH_STATE" not in os.environ:
+            try:
+                os.unlink(STATE_PATH)  # don't leave pid-unique files around
+            except OSError:
+                pass  # a caller-pinned path is left for inspection
 
     return 0 if (rc == 0 or best["value"] > 0) else 1
 
@@ -304,7 +359,12 @@ def supervise() -> int:
 
 class WorkerState:
     def __init__(self):
-        self.result = {"metric": METRIC, "value": 0.0,
+        # the metric name is fully determined by env at process start;
+        # fixing it BEFORE the first state write keeps it constant for the
+        # worker's whole lifetime — the supervisor's merge() refuses any
+        # state whose metric differs from the first it saw (its guard
+        # against foreign bench states leaking into the artifact)
+        self.result = {"metric": worker_metric_name(), "value": 0.0,
                        "unit": "tokens/s/chip", "vs_baseline": 0.0,
                        "extras": {}}
         self.phase = "import"
@@ -454,22 +514,16 @@ def worker():
         log(f"backend is {jax.default_backend()}, not tpu; skipping probe")
 
     # BENCH_MODEL=tiny lets CI validate every phase on CPU in seconds;
-    # the real bench always runs the llama3-1b flagship
+    # the real bench always runs the llama3-1b flagship. (The metric name
+    # was already derived from these env vars in WorkerState.__init__.)
     model_name = os.environ.get("BENCH_MODEL", "llama3-1b")
-    if model_name != "llama3-1b":
-        st.result["metric"] = (
-            f"decode_tokens_per_sec_per_chip_{model_name}_b8_validation")
     model_cfg = get_model_config(model_name)  # decode_kernel="auto" = gather
     # BENCH_QUANT=int8: weight-only int8 serving (ops/quant.py) — the
     # decode path is weight-read-bound, so this measures the HBM-BW lever
     quant = os.environ.get("BENCH_QUANT", "")
-    if quant:
-        if quant != "int8":
-            raise SystemExit(f"BENCH_QUANT={quant!r} unsupported "
-                             "(supported: int8)")
+    if quant:  # value already validated by worker_metric_name() at init
         import dataclasses
         model_cfg = dataclasses.replace(model_cfg, quant=quant)
-        st.result["metric"] += f"_{quant}"
         st.result["extras"]["quant"] = quant
     slots = PAGE_KWARGS["max_slots"]  # engine geometry drives the workload
     # 64-step windows: the window-pregathered decode amortizes its per-

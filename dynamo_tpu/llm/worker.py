@@ -298,9 +298,23 @@ class NativeEngineWorker(AsyncEngine):
 async def serve_llm_worker(runtime, namespace: str, component: str,
                            engine: AsyncEngine, endpoint: str = "generate",
                            card=None):
-    """Register + serve an LLM engine endpoint with stats wired up."""
+    """Register + serve an LLM engine endpoint with stats wired up.
+
+    Also wires the KV event publisher for engines that support one but
+    weren't given a component at construction (NativeEngineWorker and
+    subclasses built before the runtime existed — run.py endpoint mode,
+    the SDK example workers). Without it a kv-routed frontend receives no
+    overlap data from these workers and silently degrades to load
+    balancing (found by tools/routing_ttft_bench.py: ~50% prefix hit
+    instead of ~100%). The worker_id must be the runtime's — that is the
+    instance id routers see in the event stream and the instance table.
+    Reference analogue: workers construct their KvEventPublisher with
+    their own worker id at startup (publisher.rs:33-74).
+    """
     comp = runtime.namespace(namespace).component(component)
     ep = comp.endpoint(endpoint)
+    if getattr(engine, "event_publisher", "absent") is None:
+        engine.event_publisher = KvEventPublisher(comp, runtime.worker_id)
     stats = getattr(engine, "stats_handler", None)
     metadata = {"model_card": card.to_dict()} if card is not None else None
     served = await ep.serve(engine, metadata=metadata, stats_handler=stats)

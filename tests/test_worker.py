@@ -235,3 +235,47 @@ def test_sigterm_graceful_drain(tmp_path):
             await server.stop()
 
     asyncio.run(main())
+
+
+def test_serve_llm_worker_attaches_event_publisher():
+    """A NativeEngineWorker built WITHOUT a component (run.py endpoint
+    mode, the SDK example workers — the engine exists before the runtime
+    does) must still feed the KV event plane once served: serve_llm_worker
+    attaches a publisher under the runtime's worker id. Without this a
+    kv-routed frontend gets zero overlap data from launcher-started
+    workers and silently degrades to load balancing (caught by
+    tools/routing_ttft_bench.py)."""
+    async def main():
+        plane = MemoryPlane()
+        wrt = await DistributedRuntime.create_local(plane, "launcher-w")
+        worker = await NativeEngineWorker(make_engine()).start()
+        assert worker.event_publisher is None
+        await serve_llm_worker(wrt, "ns", "backend", worker)
+        assert worker.event_publisher is not None
+
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        sub = await crt.namespace("ns").component("backend").subscribe(
+            "kv_events")
+        client = crt.namespace("ns").component("backend").endpoint(
+            "generate").client()
+        await client.start()
+        await client.wait_for_instances()
+        prompt = list(range(100, 132))  # 4 full pages
+        async for _ in await client.generate(pre_request("ev1", prompt)):
+            pass
+
+        async def first_event():
+            async for _subj, payload in sub:
+                return payload
+
+        ev = await asyncio.wait_for(first_event(), 10)
+        # the event stream must carry the id routers see in the instance
+        # table, and the stored pages of the prompt
+        assert ev["worker_id"] == "launcher-w"
+        assert ev["data"]["kind"] == "stored"
+        assert len(ev["data"]["blocks"]) >= 1
+        await worker.stop()
+        await crt.shutdown()
+        await wrt.shutdown()
+
+    asyncio.run(main())
