@@ -1,0 +1,152 @@
+"""Fault-injection (chaos) harness over the in-process serving graph.
+
+SURVEY.md §5 notes the reference ships NO fault-injection framework and
+calls its mock network's injectable LatencyModel "the seed of one"
+(reference: lib/runtime/tests/common/mock.rs:31-60). This grows that
+seed into a harness: a seeded random-jitter latency model on EVERY
+control-plane op (KV, watch, messaging), a real router+workers serving
+graph, concurrent streams, mid-stream client aborts, and a mid-run
+worker death — asserting
+
+  * liveness: nothing hangs (every phase under a hard deadline),
+  * correctness: every COMPLETED greedy stream is token-identical to a
+    direct single-engine oracle (both workers share the init seed, so
+    chaos may delay or kill work but must never corrupt it),
+  * clean failure + recovery: only streams in flight on the killed
+    worker may error, and once its lease-scoped instance key is pruned,
+    new requests all land on the survivor and succeed.
+"""
+import asyncio
+import random
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import SamplingParams
+from dynamo_tpu.llm.worker import NativeEngineWorker, serve_llm_worker
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.memory import LatencyModel, MemoryPlane
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+PAGE = 8
+
+
+def make_engine():
+    return NativeEngine(CFG, EngineConfig(
+        page_size=PAGE, num_pages=64, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512), seed=0)
+
+
+class JitterLatency(LatencyModel):
+    """Seeded random delay per control-plane op — turns the in-memory
+    plane into a jittery 'network' that reorders interleavings."""
+
+    def __init__(self, seed: int, max_delay_s: float):
+        super().__init__(0.0)
+        self._rng = random.Random(seed)
+        self.max_delay_s = max_delay_s
+
+    async def apply(self):
+        await asyncio.sleep(self._rng.random() * self.max_delay_s)
+
+
+def pre_request(rid, prompt, max_tokens):
+    return PreprocessedRequest(
+        request_id=rid, token_ids=prompt,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    ).model_dump(exclude_none=True)
+
+
+def prompt_for(i):
+    return [(37 * i + j) % 400 + 3 for j in range(12 + (i % 3) * 4)]
+
+
+def test_chaos_jitter_abort_and_worker_death():
+    # oracle: same seed as both workers => identical params => identical
+    # greedy tokens, independent of which worker serves
+    oracle_engine = make_engine()
+    oracle = {}
+    for i in range(18):
+        oracle[i] = oracle_engine.generate(
+            prompt_for(i), SamplingParams(max_tokens=6, temperature=0.0,
+                                          ignore_eos=True), f"o{i}")
+
+    async def main():
+        plane = MemoryPlane(JitterLatency(seed=11, max_delay_s=0.02))
+        wrt1 = await DistributedRuntime.create_local(plane, "w1")
+        worker1 = await NativeEngineWorker(make_engine()).start()
+        await serve_llm_worker(wrt1, "ns", "backend", worker1)
+        wrt2 = await DistributedRuntime.create_local(plane, "w2")
+        worker2 = await NativeEngineWorker(make_engine()).start()
+        await serve_llm_worker(wrt2, "ns", "backend", worker2)
+
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("backend").endpoint(
+            "generate").client()
+        await client.start()
+        await client.wait_for_instances()
+
+        async def run_request(i, abort_after=None):
+            ctx = Context()
+            toks = []
+            async for frame in await client.generate(
+                    pre_request(f"r{i}", prompt_for(i), 6), ctx):
+                toks.extend(frame.get("token_ids", ()))
+                if abort_after is not None and len(toks) >= abort_after:
+                    ctx.stop_generating()
+                    return ("aborted", i, toks)
+            return ("done", i, toks)
+
+        # phase 1: concurrent load with jitter + mid-stream aborts
+        tasks = [run_request(i, abort_after=2 if i % 4 == 3 else None)
+                 for i in range(8)]
+        results = await asyncio.wait_for(
+            asyncio.gather(*tasks, return_exceptions=True), 300)
+        for r in results:
+            assert not isinstance(r, BaseException), r
+            kind, i, toks = r
+            if kind == "done":
+                assert toks == oracle[i], (i, toks, oracle[i])
+            else:  # aborted streams got a correct PREFIX before stopping
+                assert toks == oracle[i][:len(toks)], (i, toks)
+
+        # phase 2: kill worker2's runtime mid-flight (lease revoked,
+        # instance key gone — the crash-equivalent for the routing layer)
+        tasks = [run_request(8 + i) for i in range(5)]
+        kill = asyncio.create_task(wrt2.shutdown())
+        results = await asyncio.wait_for(
+            asyncio.gather(*tasks, return_exceptions=True), 300)
+        await kill
+        failed = 0
+        for r in results:
+            if isinstance(r, BaseException):
+                failed += 1  # in flight on the dying worker: clean error
+                continue
+            kind, i, toks = r
+            assert kind == "done"
+            assert toks == oracle[i], (i, toks, oracle[i])
+        # the healthy worker must keep serving THROUGH the kill: a dying
+        # peer may fail its own in-flight streams but must never take the
+        # whole component down
+        assert failed < len(results), "every request failed during the kill"
+
+        # phase 3: after the instance prunes, everything lands on the
+        # survivor and succeeds
+        for _ in range(100):
+            if len(client.instances) == 1:
+                break
+            await asyncio.sleep(0.1)
+        assert len(client.instances) == 1, client.instances
+        results = await asyncio.wait_for(
+            asyncio.gather(*(run_request(13 + i) for i in range(5))), 300)
+        for kind, i, toks in results:
+            assert kind == "done"
+            assert toks == oracle[i], (i, toks, oracle[i])
+
+        await worker1.stop()
+        await worker2.stop()
+        await crt.shutdown()
+        await wrt1.shutdown()
+
+    asyncio.run(main())

@@ -90,7 +90,6 @@ class Stack:
         logf = open(logpath, "w")
         p = subprocess.Popen(args, env=self.env, stdout=logf,
                              stderr=subprocess.STDOUT, cwd=HERE)
-        p._logpath = logpath
         logf.close()
         self.procs.append(p)
         if ready:
@@ -192,12 +191,6 @@ def run_mode(kv_routed: bool, args, workdir: str) -> dict:
     try:
         stack.start(os.path.join(workdir, tag))
         log(f"[{tag}] stack up (cp={stack.cp_port}, http={stack.http_port})")
-        # warm every prefill-length bucket the turns will hit, on every
-        # worker (first use of a bucket compiles; an unwarmed bucket would
-        # bill XLA compile time as TTFT). Distinct throwaway prompts: RR
-        # alternates them across workers; the KV router's optimistic
-        # active-slot bump spreads them too. 2x workers per length covers
-        # random tiebreaks with margin.
         # Warmup epoch: replay the EXACT workload shape with throwaway
         # conversations so every XLA program variant the measurement will
         # hit compiles here, not inside a timed TTFT. The program key is
@@ -279,7 +272,6 @@ def main() -> int:
         args.num_pages = int(pages_per_conv
                              * (args.conversations / args.workers) * 1.6)
 
-    import tempfile
     with tempfile.TemporaryDirectory() as workdir:
         rr = run_mode(False, args, workdir)
         kv = run_mode(True, args, workdir)
