@@ -25,9 +25,19 @@ while true; do
     echo "[watch] all TPU evidence captured; exiting" >&2
     exit 0
   fi
-  # one-slot tunnel: never probe while another bench holds it
-  if pgrep -f bench_until_green.sh >/dev/null 2>&1 \
-      || pgrep -f "bench.py" >/dev/null 2>&1; then
+  # one-slot tunnel: never probe while another bench holds it. Patterns
+  # must match actual INVOCATIONS, not any process whose argv merely
+  # mentions the filename (the round driver's prompt text contains
+  # "bench.py", which a bare `pgrep -f bench.py` matches — that blinded
+  # this watcher for a whole session).
+  # [b]racket trick: the pattern never matches its own pgrep process.
+  # Three patterns so any invocation spelling is caught: the retry loop
+  # by filename, a supervisor by interpreter+script adjacency, and the
+  # worker child by its --worker flag (always spawned with an absolute
+  # path, so it backstops exotic supervisor spellings).
+  if pgrep -f "[b]ench_until_green\.sh" >/dev/null 2>&1 \
+      || pgrep -f "python[^ ]* ([^ ]*/)?bench\.py" >/dev/null 2>&1 \
+      || pgrep -f "[b]ench\.py --worker" >/dev/null 2>&1; then
     sleep 60
     continue
   fi
