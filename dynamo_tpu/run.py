@@ -89,8 +89,10 @@ async def build_engine(out_spec: str, card: ModelDeploymentCard, args):
         page_size=card.kv_page_size, num_pages=args.num_pages,
         max_slots=args.max_slots, max_prefill_chunk=args.max_prefill_chunk,
         max_model_len=min(card.context_length, model_cfg.max_model_len),
-        tp=args.tp, host_pages=args.host_pages)
-    mesh = make_mesh(tp=args.tp) if args.tp > 1 else None
+        tp=args.tp, sp=args.sp, host_pages=args.host_pages)
+    n_mesh = args.tp * args.pp * args.ep * args.sp
+    mesh = (make_mesh(tp=args.tp, pp=args.pp, ep=args.ep, sp=args.sp)
+            if n_mesh > 1 else None)
     engine = NativeEngine(model_cfg, eng_cfg, mesh=mesh, params=params,
                           eos_token_ids=set(card.eos_token_ids))
     return await NativeEngineWorker(engine).start()
@@ -184,6 +186,16 @@ async def amain() -> None:
     p.add_argument("--max-slots", type=int, default=8)
     p.add_argument("--max-prefill-chunk", type=int, default=512)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel stages (layer-sharded params + "
+                        "cache, microbatched GPipe decode windows; "
+                        "models/pp.py)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel shards for MoE configs "
+                        "(ops/moe.py O(E/ep) dispatch)")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel shards for ring-attention "
+                        "prefill (ops/ring_attention.py)")
     p.add_argument("--quant", default="", choices=("", "int8"),
                    help="weight-only quantization: int8 halves weight HBM "
                         "and decode weight reads (ops/quant.py)")

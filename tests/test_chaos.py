@@ -135,17 +135,22 @@ def test_chaos_jitter_abort_and_worker_death():
         # instance): an immediate retry, bounded by the prune window, must
         # succeed with oracle-exact tokens — a systemic error (healthy
         # worker corrupted, router broken) would fail retries too
+        loop = asyncio.get_event_loop()
         for i in failed_ids:
-            deadline = asyncio.get_event_loop().time() + 60
+            deadline = loop.time() + 60
             while True:
                 try:
-                    kind, _, toks = await run_request(i)
+                    # bounded await: a retried stream that HANGS (rather
+                    # than erroring) must trip the deadline too, not
+                    # stall the harness past its own liveness invariant
+                    kind, _, toks = await asyncio.wait_for(
+                        run_request(i), max(1.0, deadline - loop.time()))
                     assert kind == "done" and toks == oracle[i], (i, toks)
                     break
                 except AssertionError:
                     raise
                 except Exception:
-                    if asyncio.get_event_loop().time() > deadline:
+                    if loop.time() > deadline:
                         raise
                     await asyncio.sleep(0.5)
 

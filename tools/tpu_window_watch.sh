@@ -35,9 +35,8 @@ while true; do
   # by filename, a supervisor by interpreter+script adjacency, and the
   # worker child by its --worker flag (always spawned with an absolute
   # path, so it backstops exotic supervisor spellings).
-  if pgrep -f "[b]ench_until_green\.sh" >/dev/null 2>&1 \
-      || pgrep -f "python[^ ]* ([^ ]*/)?bench\.py" >/dev/null 2>&1 \
-      || pgrep -f "[b]ench\.py --worker" >/dev/null 2>&1; then
+  if pgrep -f "^([^ ]*/)?(sh|bash) ([^ ]*/)?bench_until_green\.sh" >/dev/null 2>&1 \
+      || pgrep -f "^([^ ]*/)?python[^ ]* ([^ ]*/)?bench\.py" >/dev/null 2>&1; then
     sleep 60
     continue
   fi
