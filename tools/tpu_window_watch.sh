@@ -21,7 +21,8 @@ while true; do
   now=$(date +%s)
   [ $((now - start)) -gt "$MAX_WALL_S" ] && { echo "[watch] wall cap; exit" >&2; exit 0; }
   if [ -e PARITY_TPU_r05.json ] && [ -e real_ckpt_e2e_tpu.log ] \
-      && [ -e BENCH_SELF_r05_int8.json ]; then
+      && [ -e BENCH_SELF_r05_int8.json ] \
+      && [ -e BENCH_SELF_r05_w128.json ]; then
     echo "[watch] all TPU evidence captured; exiting" >&2
     exit 0
   fi
@@ -86,6 +87,33 @@ json.dump(r, open("BENCH_SELF_r05_int8.json", "w"), indent=1)
 EOF
             cp "$ql" BENCH_SELF_r05_int8.log 2>/dev/null
             echo "[watch] int8 captured: $qvalue" >&2 ;;
+        esac
+      fi
+      if [ ! -e BENCH_SELF_r05_w128.json ] \
+          && [ -e BENCH_SELF_r05_int8.json ]; then
+        # decode_steps=128 experiment: r3 pinned 64 as the knee BEFORE
+        # split-KV decoupled the base attention read from the allocation
+        # width; re-measure the window-size scaling on the new geometry
+        echo "[watch] -> decode_steps=128 bench" >&2
+        rm -f .bench_state.json
+        wj=/tmp/bench_w_$$.json wl=/tmp/bench_w_$$.log
+        BENCH_DECODE_STEPS=128 BENCH_BUDGET_S=1200 timeout 1500 \
+            python bench.py >"$wj" 2>"$wl"
+        wvalue=$(python -c "import json,sys;print(json.load(open(sys.argv[1]))['value'])" \
+            "$wj" 2>/dev/null || echo 0)
+        case "$wvalue" in
+          0|0.0|"") echo "[watch] w128 got no number" >&2 ;;
+          *)
+            python - "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$wj" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[2]))
+r["timestamp"] = sys.argv[1]
+r["self_measured"] = True
+r["decode_steps"] = 128
+json.dump(r, open("BENCH_SELF_r05_w128.json", "w"), indent=1)
+EOF
+            cp "$wl" BENCH_SELF_r05_w128.log 2>/dev/null
+            echo "[watch] w128 captured: $wvalue" >&2 ;;
         esac
       fi ;;
     *) : ;;  # down; loop
