@@ -453,7 +453,70 @@ def run_parity(model_cfg, engine_box=None, touch=lambda: None, logf=None):
                 if a != b), min(len(got), len(ref)))
     logf(f"parity FAILURE at token {div}: window={got[:div + 3]} "
          f"single={ref[:div + 3]}")
+    # attribution (r5 capture diverged@39 on TPU): the window and
+    # single-step paths are different-but-equivalent programs, so on bf16
+    # an argmax whose top-2 logit gap sits below the accumulation epsilon
+    # can flip without any path being wrong. Re-run the single-step twin
+    # with logprobs and report the gap at the divergence token: a tiny
+    # margin with the window's token as the runner-up is a benign
+    # near-tie; a large margin or a token outside the top-2 is a real bug.
+    del e1
+    touch()
+    margin = runner_up = None
+    try:
+        margin, runner_up = _parity_margin(model_cfg, prompt, params, div,
+                                           ref, touch, logf)
+    except Exception as e:  # the probe is diagnostics, never fatal
+        logf("margin probe failed:", e)
+    if margin is not None:
+        near = runner_up == got[div] and margin < 0.02
+        logf(f"divergence margin: top-2 logprob gap {margin:.3e} at token "
+             f"{div}; window took "
+             f"{'the runner-up' if runner_up == got[div] else 'a NON-top-2 token'}")
+        if near:
+            return (f"DIVERGED@{div}(near-tie: margin {margin:.2e}, "
+                    f"window took runner-up)")
+        return (f"DIVERGED@{div}(margin {margin:.2e}, "
+                f"runner_up={runner_up})")
     return f"DIVERGED@{div}"
+
+
+def _parity_margin(model_cfg, prompt, params, div, ref, touch, logf):
+    """Top-2 logprob gap at generated-token index ``div`` on the
+    single-step path, and the runner-up token id.
+
+    The probe compiles the with-logprobs decode variant — a THIRD
+    distinct program — so on bf16 it could itself flip a near-tie before
+    ``div`` and report a margin for the wrong token history. The replay
+    is therefore checked token-for-token against the single-step
+    reference up to ``div`` and the margin discarded on mismatch
+    (code-review r5)."""
+    import dataclasses
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import EngineRequest
+
+    e = NativeEngine(
+        model_cfg, EngineConfig(decode_steps=1, **PAGE_KWARGS), seed=0)
+    touch()
+    p2 = dataclasses.replace(params, logprobs=2)
+    e.add_request(EngineRequest("margin-probe", prompt, p2))
+    toks, tops = [], []
+    while len(tops) <= div and e.has_work():
+        for ev in e.step():
+            if ev.token is not None and ev.top_logprobs:
+                toks.append(ev.token)
+                tops.append(ev.top_logprobs)
+        touch()
+    if toks[:div] != list(ref[:div]):
+        logf("margin probe replay diverged from the single-step reference "
+             "before the divergence token; margin unattributable")
+        return None, None
+    top = tops[div]
+    if len(top) < 2:
+        return None, None
+    return top[0][1] - top[1][1], top[1][0]
 
 
 def worker():

@@ -143,6 +143,29 @@ class EngineConfig:
     # stop conditions are checked when the window returns; tokens past a
     # stop are discarded. 1 = the old step-per-token behavior.
     decode_steps: int = 8
+    # speculative decoding ("" = off, "ngram" = prompt-lookup drafts,
+    # engine/spec.py): greedy plans verify up to spec_k draft tokens per
+    # target forward — decode is weight-read-bound, so a K+1-token verify
+    # costs ~one decode step of HBM traffic and accepted drafts are free
+    # throughput. Speculative greedy output is token-for-token the plain
+    # greedy output up to floating-point near-ties (exact on CPU/f32; on
+    # TPU bf16 the verify and decode programs differ arithmetically, see
+    # engine/spec.py). Sampled / logprob / penalty plans and pp meshes
+    # use the normal decode window.
+    spec_decode: str = ""
+    spec_k: int = 4                     # draft tokens verified per forward
+    spec_min_ngram: int = 2             # shortest suffix n-gram to match
+    spec_max_ngram: int = 4             # longest suffix n-gram to match
+    # speculation-vs-window cost gate: a verify dispatch only beats the
+    # fused nw-step window when expected accepted drafts outweigh the
+    # window's dispatch amortization — (n_live + ema*drafts)*(nw + r) >
+    # n_live*nw*(1 + r), where r is the host-dispatch-to-forward time
+    # ratio (conservative default; decode forwards are ~weight-read time).
+    # Acceptance ema refreshes via a forced probe every spec_probe_every
+    # gate rejections, so a workload that turns lookup-friendly re-enables
+    # speculation.
+    spec_dispatch_ratio: float = 2.0
+    spec_probe_every: int = 32
     # longest run of consecutive prefill steps while decodes are active;
     # after the streak one decode step runs, so a long prompt can stall
     # running decodes by at most max_prefill_streak chunk-times (the

@@ -1,0 +1,77 @@
+"""Speculative decoding: n-gram prompt-lookup draft proposals.
+
+Decode on TPU is weight-read-bound: a forward over K+1 tokens costs almost
+the same HBM traffic as a forward over 1 (the MXU is idle either way), so
+verifying K cheap draft tokens in ONE target-model forward multiplies
+decode throughput by the mean accepted length. This module supplies the
+cheapest possible draft: prompt-lookup (n-gram) proposals, which need no
+second model — the longest suffix n-gram of the sequence so far is matched
+against its own earlier tokens and the continuation after the match is
+proposed. Summarization / RAG / code-edit workloads, where the output
+largely restates the context, accept most proposals; free-form generation
+falls back to the normal decode window when no n-gram matches.
+
+Greedy verification is exact up to floating-point near-ties: the engine's
+verify step recomputes the argmax (with the same min-tokens eos ban as
+sampler.sample_logits) at every draft position, accepts the longest
+matching prefix, and emits the model's own token at the first mismatch —
+so speculative greedy output is token-for-token identical to plain greedy
+output whenever both paths lower to the same arithmetic (CPU/f32 unit
+tests and the real-checkpoint e2e assert bit-exact equality). On TPU
+bf16, the verify forward (prefill-shaped attention) and the decode path
+(split-KV window / Pallas kernel) are different-but-equivalent programs,
+so an argmax whose top-2 logit gap is below the accumulation epsilon can
+flip — the same caveat the window-vs-single-step parity phase documents
+(tools/tpu_parity_quick.py). Draft quality itself never changes content,
+only speed.
+
+The reference delegates speculative decoding to its engines (vLLM's
+ngram/"prompt lookup" speculative mode — reference vLLM patch surface,
+SURVEY.md §2.8); here the native engine owns it, as it owns the rest of
+the decode loop.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def ngram_propose(tokens: Sequence[int], k: int, min_ngram: int = 2,
+                  max_ngram: int = 4, max_scan: int = 4096) -> List[int]:
+    """Propose up to ``k`` draft tokens by prompt lookup.
+
+    Finds the MOST RECENT earlier occurrence of the longest suffix n-gram
+    (lengths ``max_ngram`` down to ``min_ngram``) of ``tokens`` within the
+    last ``max_scan`` tokens, and returns the tokens that followed it.
+    Overlapping self-matches are allowed (a trailing run "a a a" proposes
+    more "a"s — the classic prompt-lookup behaviour). Returns [] when the
+    sequence is too short or nothing matches; the caller then uses the
+    normal decode path.
+    """
+    t = len(tokens)
+    if k <= 0 or t < min_ngram + 1:
+        return []
+    lo = max(0, t - max_scan)
+    arr = np.asarray(tokens[lo:], dtype=np.int64)
+    n_arr = len(arr)
+    best: List[int] = []
+    for n in range(min(max_ngram, n_arr - 1), min_ngram - 1, -1):
+        sfx = arr[n_arr - n:]
+        # candidate windows start at 0..n_arr-n-1: every occurrence except
+        # the terminal suffix itself (start n_arr-n)
+        win = np.lib.stride_tricks.sliding_window_view(arr[:n_arr - 1], n)
+        hits = np.nonzero((win == sfx).all(axis=1))[0]
+        if not len(hits):
+            continue
+        # most recent occurrence whose continuation has all k tokens; a
+        # longer match beats a shorter one, but an end-truncated draft
+        # (common for trailing runs) yields to a shorter-n full draft
+        full = hits[hits + n + k <= n_arr]
+        j = int(full[-1]) if len(full) else int(hits[-1])
+        cont = arr[j + n:j + n + k]
+        if len(cont) == k:
+            return [int(x) for x in cont]
+        if len(cont) > len(best):
+            best = [int(x) for x in cont]
+    return best

@@ -30,6 +30,9 @@ class WorkerMetrics:
     # windows and the post-finish tail among them
     window_slot_steps: int = 0
     window_wasted_steps: int = 0
+    # speculative decoding (engine/spec.py): acceptance = accepted/proposed
+    spec_proposed_tokens: int = 0
+    spec_accepted_tokens: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "WorkerMetrics":

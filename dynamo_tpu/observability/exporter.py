@@ -68,6 +68,12 @@ class MetricsExporter:
         self.g_window_wasted = r.gauge(
             f"{PREFIX}_window_wasted_steps",
             "Of those, steps after the slot's request finished", labels)
+        self.g_spec_proposed = r.gauge(
+            f"{PREFIX}_spec_proposed_tokens",
+            "Cumulative speculative draft tokens verified", labels)
+        self.g_spec_accepted = r.gauge(
+            f"{PREFIX}_spec_accepted_tokens",
+            "Of those, drafts accepted (free decode tokens)", labels)
         self.g_load_avg = r.gauge(
             f"{PREFIX}_load_avg", "Mean active KV blocks across workers")
         self.g_load_std = r.gauge(
@@ -122,7 +128,8 @@ class MetricsExporter:
             for g in (self.g_active_slots, self.g_total_slots,
                       self.g_kv_active, self.g_kv_total, self.g_waiting,
                       self.g_usage, self.g_hit_rate, self.g_window_steps,
-                      self.g_window_wasted):
+                      self.g_window_wasted, self.g_spec_proposed,
+                      self.g_spec_accepted):
                 g.remove(worker_id)
         for worker_id, m in endpoints.workers.items():
             self.g_active_slots.set(worker_id, value=m.request_active_slots)
@@ -136,6 +143,10 @@ class MetricsExporter:
             self.g_window_steps.set(worker_id, value=m.window_slot_steps)
             self.g_window_wasted.set(worker_id,
                                      value=m.window_wasted_steps)
+            self.g_spec_proposed.set(worker_id,
+                                     value=m.spec_proposed_tokens)
+            self.g_spec_accepted.set(worker_id,
+                                     value=m.spec_accepted_tokens)
         self.g_load_avg.set(value=endpoints.load_avg)
         self.g_load_std.set(value=endpoints.load_std)
         self.g_workers.set(value=len(endpoints.workers))

@@ -1,0 +1,269 @@
+"""Speculative decoding (engine/spec.py): exactness, acceptance, fallbacks.
+
+The invariant under test everywhere: speculative greedy output is
+token-for-token identical to plain greedy output — drafts only ever change
+speed, never content.
+"""
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+from dynamo_tpu.engine.spec import ngram_propose
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+
+
+def make_engine(**kw):
+    defaults = dict(
+        page_size=8, num_pages=64, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512)
+    defaults.update(kw)
+    return NativeEngine(CFG, EngineConfig(**defaults), seed=0)
+
+
+# -- proposer ------------------------------------------------------------------
+
+def test_ngram_propose_finds_continuation():
+    toks = [1, 2, 3, 4, 9, 9, 1, 2, 3]
+    # suffix 3-gram [1,2,3] matched at position 0 -> continuation [4, 9, 9]
+    assert ngram_propose(toks, k=3) == [4, 9, 9]
+    assert ngram_propose(toks, k=2) == [4, 9]
+
+
+def test_ngram_propose_prefers_most_recent_match():
+    toks = [1, 2, 5, 7, 1, 2, 6, 8, 1, 2]
+    # both occurrences of [1,2] qualify; the later one (-> 6) wins
+    assert ngram_propose(toks, k=1, max_ngram=2) == [6]
+
+
+def test_ngram_propose_overlapping_run():
+    # a trailing repeat proposes more of itself (overlap allowed); a
+    # shorter-n full-length draft beats an end-truncated longer match
+    assert ngram_propose([7, 7, 7, 7], k=2, min_ngram=2) == [7, 7]
+    assert ngram_propose([7, 7, 7, 7, 7], k=2, min_ngram=2) == [7, 7]
+
+
+def test_ngram_propose_no_match_or_short():
+    assert ngram_propose([1, 2, 3, 4, 5], k=4) == []
+    assert ngram_propose([1, 2], k=4) == []
+    assert ngram_propose([1, 2, 3], k=0) == []
+
+
+# -- exactness vs plain greedy -------------------------------------------------
+
+def repetitive_prompt():
+    """A prompt with internal repetition so prompt-lookup fires."""
+    phrase = [11, 12, 13, 14, 15, 16]
+    return phrase * 4 + [20, 21] + phrase * 2
+
+
+@pytest.mark.parametrize("prompt", [
+    repetitive_prompt(),
+    list(range(10, 40)),          # no repetition: near-zero acceptance
+    [5, 6, 5, 6, 5, 6, 5, 6],     # overlapping short-period repeats
+])
+def test_spec_exact_vs_plain(prompt):
+    p = SamplingParams(max_tokens=12, temperature=0.0)
+    plain = make_engine().generate(prompt, p, "plain")
+    spec = make_engine(spec_decode="ngram", spec_k=4)
+    out = spec.generate(prompt, p, "spec")
+    assert out == plain
+
+
+def test_spec_exact_concurrent_batch():
+    """Mixed concurrent requests (some lookup-friendly, some not) must each
+    match their solo plain-greedy output."""
+    prompts = [repetitive_prompt(), list(range(40, 60)),
+               [3, 4, 5] * 6]
+    p = SamplingParams(max_tokens=7, temperature=0.0)
+    solo = [make_engine().generate(pr, p, f"s{i}")
+            for i, pr in enumerate(prompts)]
+    eng = make_engine(spec_decode="ngram", spec_k=4)
+    for i, pr in enumerate(prompts):
+        eng.add_request(EngineRequest(f"r{i}", pr, p))
+    got = {f"r{i}": [] for i in range(len(prompts))}
+    done = set()
+    while len(done) < len(prompts):
+        for ev in eng.step():
+            if ev.token is not None:
+                got[ev.request_id].append(ev.token)
+            if ev.finished:
+                done.add(ev.request_id)
+    assert [got[f"r{i}"] for i in range(len(prompts))] == solo
+
+
+def test_spec_exact_min_tokens_and_stops():
+    """min_tokens eos ban and hidden stop ids must behave identically under
+    speculation (the verify program replays the eos ban per position)."""
+    prompt = repetitive_prompt()
+    plain_eng = make_engine()
+    p0 = SamplingParams(max_tokens=10, temperature=0.0)
+    plain = plain_eng.generate(prompt, p0, "probe")
+    # stop on a token the plain run actually emits, so the stop triggers
+    stop_tok = plain[len(plain) // 2]
+    for params in (
+        SamplingParams(max_tokens=10, temperature=0.0, min_tokens=5),
+        SamplingParams(max_tokens=10, temperature=0.0,
+                       stop_token_ids=(stop_tok,)),
+    ):
+        a = make_engine().generate(prompt, params, "a")
+        b = make_engine(spec_decode="ngram",
+                        spec_k=4).generate(prompt, params, "b")
+        assert b == a
+
+
+def test_spec_max_tokens_edges():
+    prompt = repetitive_prompt()
+    for mt in (1, 2, 3):
+        p = SamplingParams(max_tokens=mt, temperature=0.0)
+        a = make_engine().generate(prompt, p, "a")
+        b = make_engine(spec_decode="ngram",
+                        spec_k=4).generate(prompt, p, "b")
+        assert b == a
+        assert len(b) == mt
+
+
+# -- acceptance actually saves steps -------------------------------------------
+
+def test_spec_oracle_draft_accepts_fully(monkeypatch):
+    """With a draft source that proposes the true greedy continuation, every
+    draft is accepted: the spec engine finishes in far fewer device steps
+    and still emits the identical tokens. Proves the verify/accept path
+    does real multi-token progress, not one-token fallback."""
+    prompt = list(range(10, 30))
+    p = SamplingParams(max_tokens=12, temperature=0.0)
+    plain = make_engine().generate(prompt, p, "oracle")
+
+    def oracle_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096):
+        done = len(tokens) - len(prompt)
+        return plain[done:done + k]
+
+    import dynamo_tpu.engine.spec as spec_mod
+    monkeypatch.setattr(spec_mod, "ngram_propose", oracle_propose)
+    spec = make_engine(spec_decode="ngram", spec_k=4)
+    steps_before = spec.step_count
+    out = spec.generate(prompt, p, "spec")
+    assert out == plain
+    decode_steps = spec.step_count - steps_before - 1  # minus the prefill
+    # 12 tokens at <=5/step (4 drafts + bonus) needs >=3 decode dispatches;
+    # plain needs 12 single-token steps (window path would compress too,
+    # but the oracle asserts the SPEC path compresses)
+    assert decode_steps <= 5
+    assert spec.spec_accepted_tokens == spec.spec_proposed_tokens > 0
+    m = spec.metrics()
+    assert m.spec_accepted_tokens == spec.spec_accepted_tokens
+    assert m.spec_proposed_tokens == spec.spec_proposed_tokens
+
+
+def test_spec_wrong_drafts_all_rejected(monkeypatch):
+    """A maximally wrong draft source costs steps but never corrupts
+    output."""
+    prompt = list(range(10, 30))
+    p = SamplingParams(max_tokens=6, temperature=0.0)
+    plain = make_engine().generate(prompt, p, "plain")
+
+    import dynamo_tpu.engine.spec as spec_mod
+
+    def wrong_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096):
+        return [(tokens[-1] + 1) % 100] * k
+
+    monkeypatch.setattr(spec_mod, "ngram_propose", wrong_propose)
+    spec = make_engine(spec_decode="ngram", spec_k=4)
+    out = spec.generate(prompt, p, "spec")
+    assert out == plain
+    assert spec.spec_proposed_tokens > 0
+    assert spec.spec_accepted_tokens == 0
+
+
+# -- fallbacks -----------------------------------------------------------------
+
+def test_spec_sampled_plan_falls_back_to_window():
+    """Sampled plans bypass the verify path entirely and match the plain
+    engine's sampled output at a fixed seed."""
+    prompt = repetitive_prompt()
+    p = SamplingParams(max_tokens=8, temperature=0.8, top_k=20, seed=7)
+    a = make_engine().generate(prompt, p, "a")
+    spec = make_engine(spec_decode="ngram", spec_k=4)
+    b = spec.generate(prompt, p, "b")
+    assert b == a
+    assert spec.spec_steps == 0
+
+
+def test_spec_gate_returns_to_window_on_rejection(monkeypatch):
+    """With consistently rejected drafts the acceptance EMA collapses and
+    the cost gate hands the batch back to the fused window (one lucky
+    n-gram hit must not trade an nw-step window for one-shot verifies
+    forever — code-review r5). A forced probe still refreshes the EMA."""
+    prompt = list(range(10, 30))
+    p = SamplingParams(max_tokens=24, temperature=0.0)
+    plain = make_engine(decode_steps=8).generate(prompt, p, "plain")
+
+    import dynamo_tpu.engine.spec as spec_mod
+
+    def wrong_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096):
+        return [(tokens[-1] + 1) % 100] * k
+
+    monkeypatch.setattr(spec_mod, "ngram_propose", wrong_propose)
+    spec = make_engine(decode_steps=8, spec_decode="ngram", spec_k=4,
+                       spec_probe_every=1000)
+    out = spec.generate(prompt, p, "spec")
+    assert out == plain
+    # EMA decays 0.8^n from 1.0; the nw=8, r=2 gate needs
+    # (1 + ema*4)*10 > 24 i.e. ema > 0.35 -> ~5 big-window verify
+    # dispatches before the window takes over. Small tail rungs (nw<=2,
+    # where a verify is a strict superset of a single step) legitimately
+    # re-pass the gate, so allow a few more — but a pure-spec run would
+    # take 24 (one per token): well below that proves the gate engaged.
+    assert 1 <= spec.spec_steps <= 9
+    assert spec._spec_acc_ema < 0.35
+    # the probe path deterministically re-enables a verify on the Nth
+    # consecutive gate rejection (end-to-end step counts are fragile:
+    # tail rungs where verify is a superset re-pass the gate on their own)
+    import types
+    eng = make_engine(decode_steps=8, spec_decode="ngram", spec_k=4,
+                      spec_probe_every=3)
+    eng._spec_acc_ema = 0.0  # collapsed: big-window gate always rejects
+    plan8 = types.SimpleNamespace(seqs=[object()], n_window=8)
+    d = [[1, 2, 3, 4]]
+    assert not eng._spec_worthwhile(plan8, d)   # skip 1
+    assert not eng._spec_worthwhile(plan8, d)   # skip 2
+    assert eng._spec_worthwhile(plan8, d)       # skip 3 -> forced probe
+    assert not eng._spec_worthwhile(plan8, d)   # counter reset
+    # the bound precheck rejects without paying the n-gram scan, but
+    # still advances the probe cadence and lets the probe through
+    eng2 = make_engine(decode_steps=8, spec_decode="ngram", spec_k=4,
+                       spec_probe_every=3)
+    eng2._spec_acc_ema = 0.0
+    assert not eng2._spec_bound_ok(plan8)       # skip 1, scan avoided
+    assert not eng2._spec_bound_ok(plan8)       # skip 2
+    assert eng2._spec_bound_ok(plan8)           # probe due -> scan allowed
+    # with a healthy EMA the bound passes outright and no skip is counted
+    eng2._spec_acc_ema = 1.0
+    eng2._spec_gate_skips = 0
+    assert eng2._spec_bound_ok(plan8)
+    assert eng2._spec_gate_skips == 0
+
+
+def test_spec_config_validation():
+    with pytest.raises(ValueError, match="spec_decode"):
+        make_engine(spec_decode="eagle")
+    with pytest.raises(ValueError, match="spec_k"):
+        make_engine(spec_decode="ngram", spec_k=0)
+
+
+def test_spec_prefix_cache_hashes_unaffected():
+    """Sealed-page prefix hashes after a speculative run must equal the
+    plain run's (garbage KV from rejected drafts must never leak into
+    accounting)."""
+    prompt = repetitive_prompt()
+    p = SamplingParams(max_tokens=9, temperature=0.0)
+    a = make_engine()
+    b = make_engine(spec_decode="ngram", spec_k=4)
+    ra, rb = "ra", "rb"
+    assert a.generate(prompt, p, ra) == b.generate(prompt, p, rb)
+    # a second identical request must prefix-hit equally on both engines
+    sa = a.scheduler.peek_prefix(prompt)
+    sb = b.scheduler.peek_prefix(prompt)
+    assert sa == sb

@@ -114,7 +114,7 @@ def free_port() -> int:
     return port
 
 
-def serve_and_query(path: str):
+def serve_and_query(path: str, extra_args: tuple = ()):
     """One-command launch, then a streamed /v1/completions request.
     Returns (text, ttft_ms, model_name)."""
     import threading
@@ -123,7 +123,8 @@ def serve_and_query(path: str):
     env = {**os.environ, "PYTHONPATH": REPO}
     proc = subprocess.Popen(
         [sys.executable, "-m", "dynamo_tpu.run", f"in=http:{port}",
-         "out=native", path, "--num-pages", "64", "--max-slots", "4"],
+         "out=native", path, "--num-pages", "64", "--max-slots", "4",
+         *extra_args],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, cwd=REPO,
         env=env, text=True)
     model_name = None
@@ -190,6 +191,16 @@ def main():
     print(f"[e2e] served: {got!r} (ttft "
           f"{'n/a' if ttft_ms is None else f'{ttft_ms:.1f} ms'})",
           flush=True)
+    # speculative decoding on real weights: same stack with prompt-lookup
+    # drafts must stream the IDENTICAL text (engine/spec.py exactness on a
+    # genuine checkpoint, not just the random-weight unit tests)
+    print("[e2e] re-serving with --spec-decode ngram", flush=True)
+    spec_got, spec_ttft_ms, _ = serve_and_query(
+        args.dir, ("--spec-decode", "ngram"))
+    spec_ok = spec_got == got
+    print(f"[e2e] spec-decode text "
+          f"{'matches' if spec_ok else 'DIVERGES: ' + repr(spec_got)}",
+          flush=True)
     # determine the backend the server actually used AFTER it exited —
     # initializing jax in this parent while the server runs would
     # contend for the single-slot TPU tunnel. The probe must re-assert
@@ -214,13 +225,24 @@ def main():
         "ttft_ms": None if ttft_ms is None else round(ttft_ms, 1),
         "match": ok, "text": got,
         "oracle": expect if not ok else None,
+        "spec_decode_match": spec_ok,
+        "spec_ttft_ms": (None if spec_ttft_ms is None
+                         else round(spec_ttft_ms, 1)),
     }
     with open(args.out, "a") as f:
         f.write(json.dumps(record) + "\n")
-    print(f"[e2e] {'PASS' if ok else 'FAIL'}: full-stack greedy text "
-          f"{'matches' if ok else 'DIVERGES from'} transformers on "
-          f"backend={backend}; log -> {args.out}", flush=True)
-    sys.exit(0 if ok else 1)
+    # spec divergence on CPU/f32 is a real bug (both paths lower to the
+    # same arithmetic); on TPU bf16 a near-tie argmax flip between the
+    # verify and decode programs is the documented caveat (engine/spec.py)
+    # — record it, but do not fail the run or the watch loop would
+    # discard valid base evidence and rebuild forever (code-review r5)
+    spec_gates = spec_ok or backend == "tpu"
+    print(f"[e2e] {'PASS' if ok and spec_gates else 'FAIL'}: full-stack "
+          f"greedy text {'matches' if ok else 'DIVERGES from'} "
+          f"transformers on backend={backend}; spec-decode pass "
+          f"{'matches' if spec_ok else 'diverges (near-tie caveat on tpu; a BUG on cpu)'}; "
+          f"log -> {args.out}", flush=True)
+    sys.exit(0 if ok and spec_gates else 1)
 
 
 if __name__ == "__main__":

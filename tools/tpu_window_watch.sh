@@ -54,16 +54,11 @@ print(json.dumps({'t': t, 'ok': jax.default_backend() == 'tpu', 'n': len(ds)}))
     *'"ok": true'*)
       echo "[watch] tunnel UP at $(date -u +%H:%M:%S); running evidence items" >&2
       if [ ! -e PARITY_TPU_r05.json ]; then
+        # first capture (PARITY_TPU_r05_initial.json) DIVERGED@39 with no
+        # attribution; the tool now adds a top-2 margin probe — recapture
         echo "[watch] -> parity" >&2
         timeout 900 python tools/tpu_parity_quick.py >> tpu_parity_r5.log 2>&1 \
           && echo "[watch] parity captured" >&2
-      fi
-      if [ ! -e real_ckpt_e2e_tpu.log ]; then
-        echo "[watch] -> real-checkpoint e2e on TPU" >&2
-        timeout 900 python tools/real_ckpt_e2e.py --out real_ckpt_e2e_tpu.log \
-          >> tpu_realckpt_r5.log 2>&1 \
-          && echo "[watch] real-ckpt TPU captured" >&2 \
-          || rm -f real_ckpt_e2e_tpu.log   # partial/failed run: retry next window
       fi
       if [ ! -e BENCH_SELF_r05_int8.json ]; then
         echo "[watch] -> int8 bench" >&2
@@ -115,6 +110,16 @@ EOF
             cp "$wl" BENCH_SELF_r05_w128.log 2>/dev/null
             echo "[watch] w128 captured: $wvalue" >&2 ;;
         esac
+      fi
+      # LAST: the longest item (checkpoint build + serve + oracle) —
+      # ordered after the bench numbers so a short up-window is not
+      # consumed before the perf evidence lands (the 07:19 window was)
+      if [ ! -e real_ckpt_e2e_tpu.log ]; then
+        echo "[watch] -> real-checkpoint e2e on TPU" >&2
+        timeout 900 python tools/real_ckpt_e2e.py --out real_ckpt_e2e_tpu.log \
+          >> tpu_realckpt_r5.log 2>&1 \
+          && echo "[watch] real-ckpt TPU captured" >&2 \
+          || rm -f real_ckpt_e2e_tpu.log   # partial/failed run: retry next window
       fi ;;
     *) : ;;  # down; loop
   esac
