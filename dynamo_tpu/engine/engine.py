@@ -294,9 +294,17 @@ class NativeEngine:
                     "spec_decode does not compose with pp meshes (the "
                     "verify block would need a pipelined multi-token "
                     "forward); use tp/dp meshes or disable spec_decode")
+            if engine_cfg.sp > 1:
+                # llama.forward routes ANY Tq>1 forward on an sp mesh to
+                # ring attention, which attends only within the chunk —
+                # a verify block needs the paged KV prefix, so its logits
+                # would be silently wrong
+                raise ValueError(
+                    "spec_decode does not compose with sp (ring-attention "
+                    "prefill); use tp/dp meshes or disable spec_decode")
             self._verify_fn = jax.jit(
                 functools.partial(_engine_verify_step, model_cfg,
-                                  eos_tuple, sp_mesh, kernel_mesh),
+                                  eos_tuple, None, kernel_mesh),
                 donate_argnums=(1,))
         # pp decode windows: microbatch round-robin through the pipeline,
         # one variant per (window rung, greedy?) — greedy plans keep the
@@ -560,9 +568,16 @@ class NativeEngine:
         if (self._verify_fn is not None and greedy and not with_lp
                 and rp is None and self._spec_bound_ok(plan)):
             drafts = self._gather_drafts(plan)
-            if any(drafts) and self._spec_worthwhile(plan, drafts):
-                return self._run_spec_decode(plan, drafts, counters,
-                                             min_toks)
+            if any(drafts):
+                if self._spec_worthwhile(plan, drafts):
+                    return self._run_spec_decode(plan, drafts, counters,
+                                                 min_toks)
+            elif self._spec_gate_skips >= self.cfg.spec_probe_every:
+                # a probe-granted scan that found no drafts still spends
+                # the probe: otherwise the counter sticks at the threshold
+                # and the precheck admits the scan on every step forever
+                # (code-review r5)
+                self._spec_gate_skips = 0
         # split-KV window: the base gather covers only the VALID kv at
         # window start, sliced from the page table at the bucket of the
         # true page count — not the admission-time allocation width, which

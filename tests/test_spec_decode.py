@@ -94,24 +94,60 @@ def test_spec_exact_concurrent_batch():
     assert [got[f"r{i}"] for i in range(len(prompts))] == solo
 
 
-def test_spec_exact_min_tokens_and_stops():
+def test_spec_exact_min_tokens_and_stops(monkeypatch):
     """min_tokens eos ban and hidden stop ids must behave identically under
-    speculation (the verify program replays the eos ban per position)."""
+    speculation (the verify program replays the eos ban per position).
+
+    A random-weight model's generated tokens never repeat, so the real
+    n-gram proposer goes silent after the first token and the window path
+    would trivially pass — an oracle draft source (fed the plain engine's
+    own output) forces every stop/ban interaction through the VERIFY
+    commit path instead."""
     prompt = repetitive_prompt()
-    plain_eng = make_engine()
     p0 = SamplingParams(max_tokens=10, temperature=0.0)
-    plain = plain_eng.generate(prompt, p0, "probe")
-    # stop on a token the plain run actually emits, so the stop triggers
+    plain = make_engine().generate(prompt, p0, "probe")
+
+    import dynamo_tpu.engine.spec as spec_mod
+    oracle_seq: list = []
+
+    def oracle_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096):
+        done = len(tokens) - len(prompt)
+        return oracle_seq[done:done + k]
+
+    monkeypatch.setattr(spec_mod, "ngram_propose", oracle_propose)
+
+    def eng(eos=None, **kw):
+        defaults = dict(page_size=8, num_pages=64, max_slots=4,
+                        max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+                        max_model_len=512)
+        defaults.update(kw)
+        from dynamo_tpu.engine.engine import NativeEngine
+        return NativeEngine(CFG, EngineConfig(**defaults), seed=0,
+                            eos_token_ids=eos)
+
+    # hidden-stop leg: stop on a token the plain run actually emits
     stop_tok = plain[len(plain) // 2]
-    for params in (
-        SamplingParams(max_tokens=10, temperature=0.0, min_tokens=5),
-        SamplingParams(max_tokens=10, temperature=0.0,
-                       stop_token_ids=(stop_tok,)),
-    ):
-        a = make_engine().generate(prompt, params, "a")
-        b = make_engine(spec_decode="ngram",
-                        spec_k=4).generate(prompt, params, "b")
-        assert b == a
+    params = SamplingParams(max_tokens=10, temperature=0.0,
+                            stop_token_ids=(stop_tok,))
+    a = eng().generate(prompt, params, "a")
+    oracle_seq[:] = a
+    spec = eng(spec_decode="ngram", spec_k=4)
+    b = spec.generate(prompt, params, "b")
+    assert b == a
+    assert spec.spec_steps > 0  # the verify path actually ran
+
+    # eos-ban leg: a REAL eos id the greedy run hits early, so the
+    # min-tokens ban changes the continuation and the verify program's
+    # per-position replay of the ban is what keeps outputs identical
+    eos_tok = plain[2]
+    params = SamplingParams(max_tokens=10, temperature=0.0, min_tokens=5)
+    a = eng(eos={eos_tok}).generate(prompt, params, "a2")
+    assert len(a) >= 5  # the ban actually kept the request alive
+    oracle_seq[:] = a
+    spec = eng(eos={eos_tok}, spec_decode="ngram", spec_k=4)
+    b = spec.generate(prompt, params, "b2")
+    assert b == a
+    assert spec.spec_steps > 0
 
 
 def test_spec_max_tokens_edges():
@@ -246,11 +282,42 @@ def test_spec_gate_returns_to_window_on_rejection(monkeypatch):
     assert eng2._spec_gate_skips == 0
 
 
+def test_spec_empty_probe_resets_cadence(monkeypatch):
+    """A probe-granted scan that finds no drafts must spend the probe —
+    otherwise the skip counter sticks at the threshold and the precheck
+    admits the (pointless) n-gram scan on every step forever
+    (code-review r5)."""
+    import dynamo_tpu.engine.spec as spec_mod
+    monkeypatch.setattr(spec_mod, "ngram_propose",
+                        lambda *a, **k: [])
+    eng = make_engine(decode_steps=8, spec_decode="ngram", spec_k=4,
+                      spec_probe_every=4)
+    eng._spec_acc_ema = 0.0        # bound precheck rejects every step
+    eng._spec_gate_skips = 4       # probe due on the first decode step
+    p = SamplingParams(max_tokens=12, temperature=0.0)
+    eng.generate(list(range(10, 30)), p, "r")
+    assert eng.spec_steps == 0                 # nothing ever verified
+    assert eng._spec_gate_skips < 4            # cadence was reset
+
+
 def test_spec_config_validation():
     with pytest.raises(ValueError, match="spec_decode"):
         make_engine(spec_decode="eagle")
     with pytest.raises(ValueError, match="spec_k"):
         make_engine(spec_decode="ngram", spec_k=0)
+    # sp routes any Tq>1 forward to ring attention (chunk-internal only),
+    # which would silently drop the verify block's KV prefix — the engine
+    # must refuse the combination even on a VALID sp mesh
+    from dynamo_tpu.parallel.mesh import make_mesh
+    from dynamo_tpu.engine.engine import NativeEngine
+    with pytest.raises(ValueError, match="ring-attention"):
+        NativeEngine(
+            CFG,
+            EngineConfig(page_size=8, num_pages=64, max_slots=4,
+                         max_prefill_chunk=512,
+                         prefill_buckets=(8, 16, 32), max_model_len=512,
+                         sp=2, spec_decode="ngram"),
+            mesh=make_mesh(sp=2), seed=0)
 
 
 def test_spec_prefix_cache_hashes_unaffected():
