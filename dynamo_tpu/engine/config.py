@@ -143,17 +143,23 @@ class EngineConfig:
     # stop conditions are checked when the window returns; tokens past a
     # stop are discarded. 1 = the old step-per-token behavior.
     decode_steps: int = 8
-    # speculative decoding ("" = off, "ngram" = prompt-lookup drafts,
-    # engine/spec.py): greedy plans verify up to spec_k draft tokens per
-    # target forward — decode is weight-read-bound, so a K+1-token verify
-    # costs ~one decode step of HBM traffic and accepted drafts are free
-    # throughput. Speculative greedy output is token-for-token the plain
-    # greedy output up to floating-point near-ties (exact on CPU/f32; on
-    # TPU bf16 the verify and decode programs differ arithmetically, see
-    # engine/spec.py). Sampled / logprob / penalty plans and pp meshes
-    # use the normal decode window.
+    # speculative decoding ("" = off; "ngram" = prompt-lookup drafts;
+    # "draft" = a small draft model proposes, engine/spec.py): greedy
+    # plans verify up to spec_k draft tokens per target forward — decode
+    # is weight-read-bound, so a K+1-token verify costs ~one decode step
+    # of HBM traffic and accepted drafts are free throughput. Speculative
+    # greedy output is token-for-token the plain greedy output up to
+    # floating-point near-ties (exact on CPU/f32; on TPU bf16 the verify
+    # and decode programs differ arithmetically, see engine/spec.py).
+    # Sampled / logprob / penalty plans and pp meshes use the normal
+    # decode window.
     spec_decode: str = ""
     spec_k: int = 4                     # draft tokens verified per forward
+    # "draft" mode: the draft model — a registry name ("tiny",
+    # "llama3-1b", ...) random-initialized from the engine seed, or an HF
+    # checkpoint directory loaded via models/loader. Must share the
+    # target's vocabulary (its token ids feed the target's verify).
+    spec_draft_model: str = ""
     spec_min_ngram: int = 2             # shortest suffix n-gram to match
     spec_max_ngram: int = 4             # longest suffix n-gram to match
     # speculation-vs-window cost gate: a verify dispatch only beats the

@@ -282,11 +282,12 @@ class NativeEngine:
         # plans take the decode window (which already amortizes dispatch),
         # so speculation never has to reproduce the stochastic sampler.
         self._verify_fn = None
+        self._draft = None
         if engine_cfg.spec_decode:
-            if engine_cfg.spec_decode != "ngram":
+            if engine_cfg.spec_decode not in ("ngram", "draft"):
                 raise ValueError(
                     f"unknown spec_decode mode {engine_cfg.spec_decode!r} "
-                    "(supported: 'ngram')")
+                    "(supported: 'ngram', 'draft')")
             if engine_cfg.spec_k < 1:
                 raise ValueError("spec_decode requires spec_k >= 1")
             if self.pp > 1:
@@ -306,6 +307,31 @@ class NativeEngine:
                 functools.partial(_engine_verify_step, model_cfg,
                                   eos_tuple, None, kernel_mesh),
                 donate_argnums=(1,))
+            if engine_cfg.spec_decode == "draft":
+                import os as _os
+
+                from dynamo_tpu.engine.spec import DraftModel
+                name = engine_cfg.spec_draft_model
+                if not name:
+                    raise ValueError(
+                        "spec_decode='draft' requires spec_draft_model "
+                        "(a registry name or an HF checkpoint dir)")
+                dparams = None
+                if _os.path.isdir(name):
+                    from dynamo_tpu.models.loader import load_model_dir
+                    dcfg, dparams = load_model_dir(name)
+                else:
+                    from dynamo_tpu.engine.config import get_model_config
+                    dcfg = get_model_config(name)
+                if dcfg.vocab_size != model_cfg.vocab_size:
+                    raise ValueError(
+                        f"draft vocab {dcfg.vocab_size} != target vocab "
+                        f"{model_cfg.vocab_size}: the draft's token ids "
+                        "feed the target's verify block verbatim")
+                self._draft = DraftModel(
+                    dcfg, engine_cfg,
+                    self.mesh if self.mesh.size > 1 else None,
+                    params=dparams, seed=seed)
         # pp decode windows: microbatch round-robin through the pipeline,
         # one variant per (window rung, greedy?) — greedy plans keep the
         # argmax-only program, sampled plans get the full sampler tail
@@ -381,6 +407,8 @@ class NativeEngine:
         self.scheduler.add_request(self._resolve_mm(req))
 
     def abort(self, request_id: str) -> bool:
+        if self._draft is not None:
+            self._draft.forget(request_id)
         return self.scheduler.abort(request_id)
 
     def close(self) -> None:
@@ -559,25 +587,35 @@ class NativeEngine:
         rp = self._rep_penalty_arrays(plan.seqs)
         with_lp = self._wants_logprobs(plan.seqs)
         greedy = all(t <= 0.0 for t in temp)
-        # speculative decoding: greedy plans whose prompt-lookup drafts
-        # beat the window's dispatch amortization (acceptance-ema cost
-        # gate) verify the drafts in one forward instead of running the
-        # window; plans the verify program doesn't model (sampling,
-        # logprobs, penalties), draft-less steps, and low-expected-
-        # acceptance steps fall through
+        # speculative decoding: greedy plans whose drafts beat the
+        # window's dispatch amortization (acceptance-ema cost gate)
+        # verify the drafts in one forward instead of running the window;
+        # plans the verify program doesn't model (sampling, logprobs,
+        # penalties), draft-less steps, and low-expected-acceptance steps
+        # fall through
         if (self._verify_fn is not None and greedy and not with_lp
-                and rp is None and self._spec_bound_ok(plan)):
-            drafts = self._gather_drafts(plan)
-            if any(drafts):
-                if self._spec_worthwhile(plan, drafts):
+                and rp is None):
+            if self._draft is not None:
+                # draft-model mode: the proposal budget is known up
+                # front, so the gate runs before any draft compute
+                caps = self._draft.caps(plan)
+                if sum(caps) and self._spec_worthwhile(plan, sum(caps)):
+                    drafts = self._draft.propose(plan, caps)
                     return self._run_spec_decode(plan, drafts, counters,
                                                  min_toks)
-            elif self._spec_gate_skips >= self.cfg.spec_probe_every:
-                # a probe-granted scan that found no drafts still spends
-                # the probe: otherwise the counter sticks at the threshold
-                # and the precheck admits the scan on every step forever
-                # (code-review r5)
-                self._spec_gate_skips = 0
+            elif self._spec_bound_ok(plan):
+                drafts = self._gather_drafts(plan)
+                if any(drafts):
+                    if self._spec_worthwhile(
+                            plan, sum(len(d) for d in drafts)):
+                        return self._run_spec_decode(plan, drafts,
+                                                     counters, min_toks)
+                elif self._spec_gate_skips >= self.cfg.spec_probe_every:
+                    # a probe-granted scan that found no drafts still
+                    # spends the probe: otherwise the counter sticks at
+                    # the threshold and the precheck admits the scan on
+                    # every step forever (code-review r5)
+                    self._spec_gate_skips = 0
         # split-KV window: the base gather covers only the VALID kv at
         # window start, sliced from the page table at the bucket of the
         # true page count — not the admission-time allocation width, which
@@ -684,7 +722,7 @@ class NativeEngine:
         # branch resets it when the probe actually dispatches
         return self._spec_gate_skips >= self.cfg.spec_probe_every
 
-    def _spec_worthwhile(self, plan: DecodePlan, drafts: list) -> bool:
+    def _spec_worthwhile(self, plan: DecodePlan, d_total: int) -> bool:
         """Cost gate (code-review r5): one drafted slot must not pull the
         whole batch off the fused nw-step window. A verify dispatch costs
         ~one decode forward + one host dispatch; the window costs nw
@@ -698,7 +736,6 @@ class NativeEngine:
         draft). The ema only updates when verify runs, so every
         spec_probe_every-th rejection forces a probe to re-measure."""
         n_live, nw, r = self._spec_gate_terms(plan)
-        d_total = sum(len(d) for d in drafts)
         if ((n_live + self._spec_acc_ema * d_total) * (nw + r)
                 > n_live * nw * (1 + r)):
             self._spec_gate_skips = 0
@@ -770,12 +807,23 @@ class NativeEngine:
             if d:
                 self._spec_acc_ema = (0.8 * self._spec_acc_ema
                                       + 0.2 * (m / len(d)))
+            emitted, finished = 0, False
             for tok in list(d[:m]) + [int(pred[i, m])]:
                 self.scheduler.commit_decode_token(seq, tok)
+                emitted += 1
                 ev = self._postprocess(seq, seq.output[-1])
                 events.append(ev)
                 if ev.finished:
+                    finished = True
                     break
+            if self._draft is not None and not finished:
+                # draft-cache rows match committed history only through
+                # the accepted prefix; record coverage so the next sync
+                # replays from the right position. A FINISHED request was
+                # already forgotten by _postprocess — re-recording it
+                # would leak the entry forever and could poison a reused
+                # request id's coverage (code-review r5)
+                self._draft.committed(seq, m, emitted)
         self.spec_steps += 1
         return events
 
@@ -881,6 +929,8 @@ class NativeEngine:
             finish = "length"
         if finish is not None:
             self.scheduler.finish(seq)
+            if self._draft is not None:
+                self._draft.forget(seq.request_id)
         ev = StepOutput(seq.request_id, emit, finish is not None, finish)
         if p.logprobs is not None and emit is not None and lp is not None:
             ev.logprob = lp

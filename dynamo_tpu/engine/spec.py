@@ -1,4 +1,4 @@
-"""Speculative decoding: n-gram prompt-lookup draft proposals.
+"""Speculative decoding: draft proposal sources (n-gram and draft-model).
 
 Decode on TPU is weight-read-bound: a forward over K+1 tokens costs almost
 the same HBM traffic as a forward over 1 (the MXU is idle either way), so
@@ -25,15 +25,35 @@ flip — the same caveat the window-vs-single-step parity phase documents
 (tools/tpu_parity_quick.py). Draft quality itself never changes content,
 only speed.
 
+Two draft sources share the same verify/accept machinery
+(engine._run_spec_decode):
+
+- **ngram** (`ngram_propose`): prompt-lookup, no second model. Wins on
+  workloads whose output restates the context.
+- **draft** (`DraftModel`): a small model of the same family proposes K
+  greedy tokens per spec step. Wins on free-form generation where no
+  n-gram matches. TPU-first design: the draft's paged KV cache reuses
+  the TARGET's page table and page ids verbatim against its own (small)
+  cache arrays — no second allocator, no second scheduler. The draft
+  stays in sync lazily: before proposing, a catch-up forward replays
+  whatever committed tokens the draft has not yet seen (covers prompt
+  prefill, window-path interludes, preemption re-admissions, and
+  disaggregated decode-side activation in one mechanism). Stale draft
+  rows beyond the accepted length are overwritten before they can be
+  read, by the same argument as the target's own rejected-draft rows.
+
 The reference delegates speculative decoding to its engines (vLLM's
-ngram/"prompt lookup" speculative mode — reference vLLM patch surface,
-SURVEY.md §2.8); here the native engine owns it, as it owns the rest of
-the decode loop.
+ngram/"prompt lookup" and draft-model speculative modes — reference
+vLLM patch surface, SURVEY.md §2.8); here the native engine owns it, as
+it owns the rest of the decode loop.
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Sequence
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -75,3 +95,212 @@ def ngram_propose(tokens: Sequence[int], k: int, min_ngram: int = 2,
         if len(cont) > len(best):
             best = [int(x) for x in cont]
     return best
+
+
+# -- draft-model proposals -----------------------------------------------------
+
+def _draft_propose_step(dcfg, k_steps, page_size,
+                        params, cache, tokens, positions, page_table,
+                        max_write):
+    """K greedy draft steps fused into one program (lax.scan): feed the
+    slot's last committed token, argmax, feed the argmax — writing each
+    fed token's KV row into the draft cache through the TARGET's page
+    table. Returns (proposals [S, K] int32, cache). Rows past max_write
+    (page allocation ∧ max_tokens, computed host-side) drop their writes
+    and clamp their reads, mirroring the target window's budget guard."""
+    from dynamo_tpu.engine.engine import _scatter_new_kv
+    from dynamo_tpu.models import llama
+
+    rows = jnp.arange(tokens.shape[0])
+
+    def body(carry, _):
+        cache_c, tok, pos = carry
+        writable = pos <= max_write
+        prefix = jnp.clip(pos, 0, max_write + 1)
+        logits, k_news, v_news, _ = llama.decode_forward(
+            params, dcfg, tok, cache_c, page_table, prefix, pos,
+            valid=writable, with_aux=True)
+        page = page_table[rows, jnp.maximum(
+            jnp.minimum(pos, max_write), 0) // page_size]
+        widx = jnp.where(writable, page * page_size + pos % page_size, -1)
+        cache_c = _scatter_new_kv(cache_c, k_news, v_news, widx)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache_c, nxt, pos + 1), nxt
+
+    (cache, _, _), props = jax.lax.scan(
+        body, (cache, tokens, positions), None, length=k_steps)
+    return props.T, cache
+
+
+def _draft_catchup_step(dcfg, params, cache, tokens, positions, page_table,
+                        kv_lens, write_idx):
+    """Prefill-shaped draft forward that only exists for its KV writes:
+    replays committed tokens the draft has not seen (prompt prefill,
+    window-path interludes, re-admissions, disagg activation)."""
+    from dynamo_tpu.models import llama
+
+    meta = llama.AttnMetadata(positions=positions, page_table=page_table,
+                              kv_lens=kv_lens, write_idx=write_idx)
+    _, cache, _ = llama.forward(params, dcfg, tokens, cache, meta,
+                                with_aux=True)
+    return cache
+
+
+class DraftModel:
+    """Draft-model proposal source riding the target's page geometry.
+
+    The draft's paged KV cache is shaped by the DRAFT's dims but indexed
+    by the TARGET's page ids, so the scheduler's allocation, prefix
+    sharing, and preemption bookkeeping need no draft-side twin. Shared
+    prefix pages are benign: a catch-up replay writes the same tokens'
+    KV (deterministic), and a freed-then-reallocated page is rewritten by
+    the new request's own catch-up before any read. `pos` tracks, per
+    (request, admission epoch), the first position whose committed token
+    the draft has NOT yet folded into its cache; an epoch mismatch (the
+    scheduler bumps it on preempt-and-readmit, when pages may move)
+    resets coverage to zero and the catch-up replays from the start.
+    Params and cache are replicated across multi-device meshes — the
+    draft is small by construction, and replication keeps its programs
+    independent of the target's tp/pp layout.
+    """
+
+    def __init__(self, dcfg, engine_cfg, mesh, params=None, seed=0):
+        import dataclasses
+
+        from dynamo_tpu.models import llama
+
+        # the Pallas decode kernel needs the shard_map plumbing the target
+        # owns; the draft always takes the XLA gather path
+        self.cfg = dataclasses.replace(dcfg, decode_kernel="off")
+        self.k = engine_cfg.spec_k
+        self.page_size = engine_cfg.page_size
+        self.max_chunk = engine_cfg.max_prefill_chunk
+        from dynamo_tpu.engine.scheduler import next_bucket, pow2_buckets
+        self._chunk_buckets = pow2_buckets(self.max_chunk)
+        self._next_bucket = next_bucket
+        rep = None
+        if mesh is not None and mesh.size > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            rep = NamedSharding(mesh, P())
+        if params is None:
+            init = jax.jit(functools.partial(llama.init_params,
+                                             cfg=self.cfg),
+                           out_shardings=rep)
+            params = init(jax.random.PRNGKey(seed))
+        elif rep is not None:
+            params = jax.device_put(params, rep)
+        else:
+            params = jax.device_put(params)
+        self.params = params
+        init_cache = jax.jit(
+            functools.partial(llama.init_cache, self.cfg,
+                              num_pages=engine_cfg.num_pages,
+                              page_size=engine_cfg.page_size),
+            out_shardings=rep)
+        self.cache = init_cache()
+        self.pos = {}  # request_id -> (epoch, first position not in cache)
+        self._propose_fn = jax.jit(
+            functools.partial(_draft_propose_step, self.cfg, self.k,
+                              self.page_size),
+            donate_argnums=(1,))
+        self._catchup_fn = jax.jit(
+            functools.partial(_draft_catchup_step, self.cfg),
+            donate_argnums=(1,))
+
+    def forget(self, request_id: str) -> None:
+        self.pos.pop(request_id, None)
+
+    def _coverage(self, seq) -> int:
+        epoch, p = self.pos.get(seq.request_id, (seq.epoch, 0))
+        return p if epoch == seq.epoch else 0
+
+    def caps(self, plan) -> List[int]:
+        """Per-slot proposal budget: min(k, page allocation ∧ max_tokens
+        headroom) — known without running the draft, so the cost gate can
+        reject before any draft compute is spent."""
+        ps = self.page_size
+        out = []
+        for i, seq in enumerate(plan.seqs):
+            if seq is None:
+                out.append(0)
+                continue
+            pos0 = seq.total_len - 1
+            cap = min(len(seq.pages) * ps - 1, int(plan.max_pos[i]))
+            out.append(max(0, min(self.k, cap - pos0)))
+        return out
+
+    def sync(self, plan) -> None:
+        """Catch the draft cache up to every live slot's committed tokens
+        (bucketed batched replay; loops for lags beyond max_chunk)."""
+        ps = self.page_size
+        s = len(plan.seqs)
+        while True:
+            lags = [0] * s
+            for i, seq in enumerate(plan.seqs):
+                if seq is None:
+                    continue
+                lags[i] = max(0, (seq.total_len - 1) - self._coverage(seq))
+            m = max(lags)
+            if m == 0:
+                return
+            bucket = self._next_bucket(min(m, self.max_chunk),
+                                       self._chunk_buckets)
+            tokens = np.zeros((s, bucket), np.int32)
+            positions = np.zeros((s, bucket), np.int32)
+            write_idx = np.full((s, bucket), -1, np.int32)
+            kv_lens = np.zeros((s,), np.int32)
+            for i, seq in enumerate(plan.seqs):
+                if seq is None or lags[i] == 0:
+                    continue
+                start = self._coverage(seq)
+                n = min(lags[i], bucket)
+                tokens[i, :n] = seq.all_tokens[start:start + n]
+                positions[i, :] = start + n - 1
+                positions[i, :n] = np.arange(start, start + n)
+                for j in range(n):
+                    write_idx[i, j] = seq.flat_index(start + j, ps)
+                kv_lens[i] = start + n
+                self.pos[seq.request_id] = (seq.epoch, start + n)
+            self.cache = self._catchup_fn(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(plan.page_table),
+                jnp.asarray(kv_lens), jnp.asarray(write_idx))
+
+    def propose(self, plan, caps: List[int]) -> List[List[int]]:
+        """Sync, then run the fused K-step draft scan; returns per-slot
+        proposal lists clamped to ``caps`` (the engine's gate already
+        computed them via caps() — passing them through keeps the budget
+        formula in ONE place; max_write = pos0 + cap is the same bound,
+        since cap = min(k, page/max_tokens headroom))."""
+        self.sync(plan)
+        s = len(plan.seqs)
+        toks0 = np.zeros((s,), np.int32)
+        pos0s = np.zeros((s,), np.int32)
+        max_write = np.full((s,), -1, np.int32)
+        for i, seq in enumerate(plan.seqs):
+            if seq is None:
+                continue
+            toks0[i] = plan.tokens[i, 0]
+            pos0s[i] = seq.total_len - 1
+            max_write[i] = pos0s[i] + caps[i]
+        props, self.cache = self._propose_fn(
+            self.params, self.cache, jnp.asarray(toks0),
+            jnp.asarray(pos0s), jnp.asarray(plan.page_table),
+            jnp.asarray(max_write))
+        props = np.asarray(jax.device_get(props))
+        return [[int(x) for x in props[i, :caps[i]]] if caps[i] else []
+                for i in range(s)]
+
+    def committed(self, seq, accepted: int, emitted: int) -> None:
+        """Record draft-cache coverage after a verify step: rows hold the
+        draft's OWN tokens, which match committed history only through
+        the accepted prefix (the bonus/correction token was never fed to
+        the draft). The propose scan writes rows for its K FED tokens —
+        the slot's last token plus proposals 1..K-1 — so the Kth
+        proposal's row is never written even when fully accepted: cap
+        coverage at k-1 or the next propose reads a zero row (caught by
+        the identical-draft test's acceptance assertion)."""
+        pos0 = (seq.total_len - 1) - emitted  # position before the step
+        covered = pos0 + min(accepted, emitted, self.k - 1)
+        self.pos[seq.request_id] = (seq.epoch, covered + 1)

@@ -90,7 +90,8 @@ async def build_engine(out_spec: str, card: ModelDeploymentCard, args):
         max_slots=args.max_slots, max_prefill_chunk=args.max_prefill_chunk,
         max_model_len=min(card.context_length, model_cfg.max_model_len),
         tp=args.tp, sp=args.sp, host_pages=args.host_pages,
-        spec_decode=args.spec_decode, spec_k=args.spec_k)
+        spec_decode=args.spec_decode, spec_k=args.spec_k,
+        spec_draft_model=args.spec_draft)
     n_mesh = args.tp * args.pp * args.ep * args.sp
     mesh = (make_mesh(tp=args.tp, pp=args.pp, ep=args.ep, sp=args.sp)
             if n_mesh > 1 else None)
@@ -201,13 +202,19 @@ async def amain() -> None:
                    help="weight-only quantization: int8 halves weight HBM "
                         "and decode weight reads (ops/quant.py)")
     p.add_argument("--host-pages", type=int, default=0)
-    p.add_argument("--spec-decode", default="", choices=("", "ngram"),
+    p.add_argument("--spec-decode", default="",
+                   choices=("", "ngram", "draft"),
                    help="speculative decoding: 'ngram' verifies "
-                        "prompt-lookup drafts in one forward per window "
-                        "(greedy plans; exact output)")
+                        "prompt-lookup drafts, 'draft' verifies a small "
+                        "draft model's tokens, one target forward per "
+                        "window (greedy plans; exact output)")
     p.add_argument("--spec-k", type=int, default=4,
                    help="draft tokens verified per forward with "
                         "--spec-decode")
+    p.add_argument("--spec-draft", default="",
+                   help="draft model for --spec-decode draft: a registry "
+                        "name or an HF checkpoint dir (vocab must match "
+                        "the served model)")
     p.add_argument("--echo-delay", type=float, default=0.0)
     p.add_argument("--control-host", default="127.0.0.1")
     p.add_argument("--control-port", type=int, default=5550)
