@@ -674,20 +674,14 @@ class NativeEngine:
                                    top_lps)
 
     def _gather_drafts(self, plan: DecodePlan) -> list:
-        """Per-slot prompt-lookup proposals, clamped so every draft's KV
-        write stays inside the slot's page allocation AND its max_tokens
-        budget (positions pos0+1 .. pos0+d; the bonus token needs no
-        write)."""
-        from dynamo_tpu.engine.spec import ngram_propose
+        """Per-slot prompt-lookup proposals, clamped to the shared
+        draft_cap budget (spec.py: page allocation ∧ max_tokens)."""
+        from dynamo_tpu.engine.spec import draft_cap, ngram_propose
         ps = self.cfg.page_size
         drafts: list = []
         for i, seq in enumerate(plan.seqs):
-            if seq is None:
-                drafts.append([])
-                continue
-            pos0 = seq.total_len - 1
-            cap = min(len(seq.pages) * ps - 1, int(plan.max_pos[i]))
-            d_max = min(self.cfg.spec_k, cap - pos0)
+            d_max = (draft_cap(seq, plan.max_pos[i], ps, self.cfg.spec_k)
+                     if seq is not None else 0)
             if d_max <= 0:
                 drafts.append([])
                 continue

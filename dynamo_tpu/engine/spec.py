@@ -97,6 +97,18 @@ def ngram_propose(tokens: Sequence[int], k: int, min_ngram: int = 2,
     return best
 
 
+def draft_cap(seq, max_pos_i: int, page_size: int, k: int) -> int:
+    """Per-slot draft budget: every draft token's KV write (positions
+    pos0+1 .. pos0+d) must stay inside the slot's page allocation AND its
+    max_tokens budget; the bonus token needs no write. ONE definition for
+    both draft sources (ngram's _gather_drafts and DraftModel.caps) so
+    the gate, the scan's write clamp, and the returned proposal lengths
+    can never drift apart."""
+    pos0 = seq.total_len - 1
+    cap = min(len(seq.pages) * page_size - 1, int(max_pos_i))
+    return max(0, min(k, cap - pos0))
+
+
 # -- draft-model proposals -----------------------------------------------------
 
 def _draft_propose_step(dcfg, k_steps, page_size,
@@ -216,19 +228,12 @@ class DraftModel:
         return p if epoch == seq.epoch else 0
 
     def caps(self, plan) -> List[int]:
-        """Per-slot proposal budget: min(k, page allocation ∧ max_tokens
-        headroom) — known without running the draft, so the cost gate can
-        reject before any draft compute is spent."""
-        ps = self.page_size
-        out = []
-        for i, seq in enumerate(plan.seqs):
-            if seq is None:
-                out.append(0)
-                continue
-            pos0 = seq.total_len - 1
-            cap = min(len(seq.pages) * ps - 1, int(plan.max_pos[i]))
-            out.append(max(0, min(self.k, cap - pos0)))
-        return out
+        """Per-slot proposal budget (draft_cap) — known without running
+        the draft, so the cost gate can reject before any draft compute
+        is spent."""
+        return [draft_cap(seq, plan.max_pos[i], self.page_size, self.k)
+                if seq is not None else 0
+                for i, seq in enumerate(plan.seqs)]
 
     def sync(self, plan) -> None:
         """Catch the draft cache up to every live slot's committed tokens

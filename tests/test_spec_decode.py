@@ -433,6 +433,69 @@ def test_spec_draft_config_validation():
         cfg_mod._CONFIGS.pop("tiny-smallvocab", None)
 
 
+def test_spec_draft_disagg_decode_side(f32_draft):
+    """Disaggregated serving with a draft-speculating DECODE engine: the
+    remotely-prefilled prompt's KV never went through the draft, so the
+    first spec step's catch-up replays the whole prompt before proposing
+    (the docstring's 'disagg activation' claim, tested). Tokens must
+    match the aggregated oracle and — identical draft, f32 — every
+    post-catch-up draft must be accepted."""
+    import asyncio
+
+    from dynamo_tpu.disagg import (
+        DisaggDecodeWorker, DisaggregatedRouter, LocalTransferBackend,
+        PrefillQueue, PrefillWorker,
+    )
+    from dynamo_tpu.llm.worker import NativeEngineWorker
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+
+    decode_engine = make_engine(spec_decode="draft",
+                                spec_draft_model=f32_draft, spec_k=4)
+
+    async def main():
+        plane = MemoryPlane()
+        transfer = LocalTransferBackend()
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        router = DisaggregatedRouter(max_local_prefill_length=4,
+                                     max_prefill_queue_size=4,
+                                     model="tiny")
+        decode = DisaggDecodeWorker(decode_engine, plane.messaging, router,
+                                    queue, worker_id="dec-0",
+                                    prefill_timeout_s=30.0)
+        transfer.register("dec-0", decode)
+        prefill = PrefillWorker(NativeEngineWorker(make_engine()), queue,
+                                transfer, plane.messaging)
+        await decode.start()
+        await prefill.start()
+        try:
+            req = PreprocessedRequest(
+                request_id="r1", token_ids=prompt,
+                stop=StopConditions(max_tokens=6, ignore_eos=True))
+            toks = []
+            async for frame in decode.generate(
+                    req.model_dump(exclude_none=True), Context("r1")):
+                toks.extend(frame.get("token_ids", ()))
+        finally:
+            await prefill.stop()
+            await decode.stop()
+        return toks, decode.remote_prefills
+
+    toks, n_remote = asyncio.run(main())
+    assert n_remote == 1
+    assert toks == expect
+    assert decode_engine.spec_steps > 0
+    assert (decode_engine.spec_accepted_tokens
+            == decode_engine.spec_proposed_tokens > 0)
+
+
 def test_spec_prefix_cache_hashes_unaffected():
     """Sealed-page prefix hashes after a speculative run must equal the
     plain run's (garbage KV from rejected drafts must never leak into
