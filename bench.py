@@ -424,7 +424,10 @@ def run_parity(model_cfg, engine_box=None, touch=lambda: None, logf=None):
     from dynamo_tpu.engine.scheduler import SamplingParams
 
     logf = logf or log
-    prompt = [(31 * j) % 1000 + 1 for j in range(64)]
+    # modulus clamped inside the model vocab: BENCH_MODEL=tiny (vocab 256)
+    # validation runs would otherwise feed OOV ids the engine now rejects
+    pmod = min(1000, model_cfg.vocab_size - 2)
+    prompt = [(31 * j) % pmod + 1 for j in range(64)]
     params = SamplingParams(max_tokens=96, temperature=0.0, ignore_eos=True)
 
     if engine_box:
@@ -599,6 +602,8 @@ def worker():
     # scheduler's adaptive clamp keeps short-remainder requests on smaller
     # compiled variants either way.
     decode_steps = int(os.environ.get("BENCH_DECODE_STEPS", "64"))
+    # prompt-id modulus clamped inside the vocab (tiny validation runs)
+    pmod = min(1000, model_cfg.vocab_size - 2)
     cfg = EngineConfig(decode_steps=decode_steps, **PAGE_KWARGS)
     st.result["extras"].update(kernel=kernel, decode_steps=decode_steps,
                                slots=slots)
@@ -623,7 +628,7 @@ def worker():
         # prefix cache built by warmup (that would fake a near-zero TTFT)
         salt = sum(tag.encode()) * 131
         for i in range(slots):
-            prompt = [(salt + 7 * i + j) % 1000 + 1
+            prompt = [(salt + 7 * i + j) % pmod + 1
                       for j in range(prompt_len)]
             engine.add_request(EngineRequest(f"{tag}-{i}", prompt, params))
 
@@ -714,7 +719,7 @@ def worker():
         salt = 977 * (next_id + 1)
         engine.add_request(EngineRequest(
             f"churn-{next_id}",
-            [(salt + 3 * j) % 1000 + 1 for j in range(churn_isl)],
+            [(salt + 3 * j) % pmod + 1 for j in range(churn_isl)],
             churn_params))
         next_id += 1
 
@@ -759,7 +764,7 @@ def worker():
             engine.step()
         sp_params = SamplingParams(max_tokens=128, temperature=0.0,
                                    ignore_eos=True)
-        sp_prompts = [[(311 + 7 * i + 3 * j) % 1000 + 1
+        sp_prompts = [[(311 + 7 * i + 3 * j) % pmod + 1
                        for j in range(prompt_len)] for i in range(slots)]
 
         def timed_pass(eng, tag):

@@ -138,7 +138,17 @@ class DisaggDecodeWorker(NativeEngineWorker):
                               emb, np.float32).tobytes())
                 for off, emb, salt in req.mm_spans or []
             ]
-        alloc = await self.submit(lambda eng: eng.allocate_remote(req))
+        try:
+            alloc = await self.submit(lambda eng: eng.allocate_remote(req))
+        except ValueError as e:
+            # admission rejection (e.g. out-of-vocab token ids): surface
+            # the same per-request error frame the LOCAL path emits
+            # (llm/worker._apply_pending) instead of killing the stream
+            # with an unhandled exception (code-review r5)
+            yield EngineOutput(
+                finish_reason=FinishReason.ERROR,
+                text=str(e)).model_dump(exclude_none=True)
+            return
         if alloc is None:
             # no pages free right now: local path applies backpressure
             log.info("remote alloc failed for %s; local fallback", rid)

@@ -399,12 +399,39 @@ class NativeEngine:
                           content_salt(px.tobytes())))
         return dataclasses.replace(req, mm_spans=spans, mm_pixels=None)
 
+    def _validate_prompt(self, req: EngineRequest) -> EngineRequest:
+        """Reject out-of-vocab token ids at admission (ValueError -> the
+        worker's add path converts it into a per-request error frame).
+
+        An OOV id silently becomes NaN at the embedding gather (jnp.take
+        fills out-of-bounds reads), the NaN rides the forward into this
+        request's KV pages, and — the insidious part — freed NaN pages
+        then poison FUTURE well-formed requests whose masked attention
+        reads the recycled rows (0 * NaN = NaN; found by the chaos
+        harness as a request completing with another request's
+        degenerate argmax-0 tokens). Multimodal span positions are
+        exempt: their placeholder ids are rewritten to content-hash
+        salts that never feed the embedding table (scheduler._admit)."""
+        vocab = self.model_cfg.vocab_size
+        ids = np.asarray(req.prompt, dtype=np.int64)
+        bad = (ids < 0) | (ids >= vocab)
+        for item in (req.mm_spans or ()):
+            off, n = int(item[0]), np.asarray(item[1]).shape[0]
+            bad[off:off + n] = False
+        if bad.any():
+            i = int(np.argmax(bad))
+            raise ValueError(
+                f"request {req.request_id}: token id {req.prompt[i]} at "
+                f"position {i} is outside the model vocab [0, {vocab})")
+        return req
+
     def add_request(self, req: EngineRequest) -> None:
         # admission-time copy settling is per-hash and happens inside the
         # prefix walk (scheduler.settle_hashes -> CopyStream.settle): only
         # in-flight copies of pages this request could hit are awaited
         # (VERDICT r3 weak #4); the decode loop never waits at all
-        self.scheduler.add_request(self._resolve_mm(req))
+        self.scheduler.add_request(
+            self._validate_prompt(self._resolve_mm(req)))
 
     def abort(self, request_id: str) -> bool:
         if self._draft is not None:
@@ -1002,7 +1029,8 @@ class NativeEngine:
             return None
         # per-hash copy settling happens inside the prefix walk, as in
         # add_request (this path also matches against the host tier)
-        return self.scheduler.add_remote(self._resolve_mm(req))
+        return self.scheduler.add_remote(
+            self._validate_prompt(self._resolve_mm(req)))
 
     def activate_remote(self, request_id: str, first_token: int) -> None:
         self.scheduler.activate_remote(request_id, first_token)

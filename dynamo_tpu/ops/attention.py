@@ -90,7 +90,13 @@ def paged_attention(
     scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,kbsd->btkgd", probs, v.astype(jnp.float32))
+    # rows past kv_lens are whatever the recycled page last held — zero
+    # them so a stale non-finite value can't ride 0 * NaN through the
+    # masked probabilities (the mask already zeroes their probs; IEEE
+    # multiplication does not). Masked-out K is safe: the jnp.where on
+    # scores discards it before the softmax.
+    v = jnp.where(valid[None, :, :, None], v.astype(jnp.float32), 0.0)
+    out = jnp.einsum("bkgts,kbsd->btkgd", probs, v)
     return out.reshape(b, tq, h, hd).astype(q.dtype)
 
 
@@ -161,6 +167,12 @@ def decode_attention_split(
     pw = jnp.exp(sw - m[..., None])
     p_self = jnp.exp(s_self - m)
     denom = jnp.sum(pb, axis=-1) + jnp.sum(pw, axis=-1) + p_self
+    # base rows past base_lens sit in the bucket's stale tail (recycled
+    # pages), window rows past win_lens are last window's leftovers: zero
+    # them so non-finite stale values can't ride 0 * NaN through the
+    # masked probabilities (K is safe — the score where() discards it)
+    v_base = jnp.where(base_mask[None, :, :, None], v_base, 0)
+    v_win = jnp.where(win_mask[None, :, :, None], v_win, 0)
     out = jnp.einsum("bkgs,kbsd->bkgd", pb.astype(v_base.dtype), v_base,
                      preferred_element_type=jnp.float32)
     out = out + jnp.einsum("bkgs,kbsd->bkgd", pw.astype(v_win.dtype), v_win,
@@ -220,6 +232,9 @@ def decode_attention_deferred(
     p = jnp.exp(scores - m[..., None])                    # [B, Hkv, G, Lk]
     p_self = jnp.exp(s_self - m)                          # [B, Hkv, G]
     denom = jnp.sum(p, axis=-1) + p_self
+    # rows past prefix_lens hold recycled-page leftovers: zero them so a
+    # stale non-finite value can't ride 0 * NaN through the masked probs
+    v = jnp.where(valid[None, :, :, None], v, 0)
     out = jnp.einsum("bkgs,kbsd->bkgd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     out = out + p_self[..., None] * v_new.astype(jnp.float32)[:, :, None, :]

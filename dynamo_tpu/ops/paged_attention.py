@@ -89,6 +89,12 @@ def _decode_kernel(ps: int, g: int, pt_ref, lens_ref, q_ref, k_hbm, v_hbm,
         dma(i, slot, v_hbm, v_buf, 1).wait()
         k = k_buf[slot].astype(jnp.float32)            # [ps, hd]
         v = v_buf[slot].astype(jnp.float32)
+        # zero V rows past kv_len: the boundary page's tail holds whatever
+        # a recycled page last held, and p == 0 there does not survive a
+        # non-finite V (0 * NaN = NaN poisons the accumulator; same
+        # defense as the reference ops in ops/attention.py)
+        vrow = i * ps + jax.lax.broadcasted_iota(jnp.int32, (ps, 1), 0)
+        v = jnp.where(vrow < kv_len, v, 0.0)
 
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -161,6 +167,17 @@ def _decode_kernel_packed(ps: int, g: int, hd: int, pack: int,
         dma(i, slot, v_hbm, v_buf, 1).wait()
         k = k_buf[slot].astype(jnp.float32)            # [rows, 128]
         v = v_buf[slot].astype(jnp.float32)
+        # zero K AND V lanes of tokens past kv_len (recycled-page tail):
+        # p == 0 does not survive a non-finite V (0 * NaN = NaN), and the
+        # packed score dot contracts over ALL 128 lanes, so a non-finite
+        # K lane in a NEIGHBORING segment NaNs a VALID token's score
+        # through the zero-padded q_shifts (0 * NaN again) — lane segment
+        # pk of row r holds token i*ps + r*pack + pk
+        vrow = jax.lax.broadcasted_iota(jnp.int32, (rows, pack * hd), 0)
+        vlane = jax.lax.broadcasted_iota(jnp.int32, (rows, pack * hd), 1)
+        vpos = i * ps + vrow * pack + vlane // hd
+        k = jnp.where(vpos < kv_len, k, 0.0)
+        v = jnp.where(vpos < kv_len, v, 0.0)
 
         row = jax.lax.broadcasted_iota(jnp.int32, (1, rows), 1)
         scores = []

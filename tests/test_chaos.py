@@ -59,7 +59,13 @@ def pre_request(rid, prompt, max_tokens):
 
 
 def prompt_for(i):
-    return [(37 * i + j) % 400 + 3 for j in range(12 + (i % 3) * 4)]
+    # ids must stay inside the tiny model's vocab (256): an OOV id NaNs
+    # the embedding gather and the engine now rejects it at admission
+    # (the original % 400 here was exactly such a bug — r7's all-OOV
+    # prompt wrote NaN KV pages that poisoned later requests through
+    # page recycling; the chaos harness caught it as cross-request
+    # token corruption)
+    return [(37 * i + j) % 200 + 3 for j in range(12 + (i % 3) * 4)]
 
 
 def test_chaos_jitter_abort_and_worker_death():

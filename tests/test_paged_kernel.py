@@ -209,3 +209,41 @@ def test_sharded_kernel_matches_single_device():
             interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_kernel_nonfinite_stale_tail_rows_ignored():
+    """Recycled pages leave arbitrary (possibly non-finite) values in the
+    boundary page's rows past kv_len; the kernel's zero-probability rows
+    must not let them poison the accumulator (0 * NaN = NaN — the
+    round-5 page-poisoning class, ops/attention.py got the same fix)."""
+    rng = np.random.default_rng(3)
+    s, h, hkv, hd, p, ps, pb = 3, 8, 4, 32, 16, 8, 4
+    q = rng.standard_normal((s, h, hd)).astype(np.float32)
+    k = rng.standard_normal((hkv, p, ps, hd)).astype(np.float32)
+    v = rng.standard_normal((hkv, p, ps, hd)).astype(np.float32)
+    page_table = (np.arange(s * pb).reshape(s, pb) * 7) % p
+    kv_lens = np.array([5, 17, 32], np.int32)
+
+    clean = decode_paged_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(page_table, jnp.int32), jnp.asarray(kv_lens),
+        interpret=True)
+
+    # poison every row OUTSIDE each sequence's valid prefix (rows in
+    # pages it doesn't own are unread by construction; the dangerous
+    # ones are its own boundary-page tail rows)
+    k_bad, v_bad = k.copy(), v.copy()
+    valid = np.zeros((p * ps,), bool)
+    for i in range(s):
+        for j in range(int(kv_lens[i])):
+            valid[page_table[i, j // ps] * ps + j % ps] = True
+    k_bad.reshape(hkv, p * ps, hd)[:, ~valid] = np.nan
+    v_bad.reshape(hkv, p * ps, hd)[:, ~valid] = np.nan
+
+    poisoned = decode_paged_attention(
+        jnp.asarray(q), jnp.asarray(k_bad), jnp.asarray(v_bad),
+        jnp.asarray(page_table, jnp.int32), jnp.asarray(kv_lens),
+        interpret=True)
+    assert np.isfinite(np.asarray(poisoned)).all()
+    np.testing.assert_allclose(np.asarray(poisoned), np.asarray(clean),
+                               rtol=1e-5, atol=1e-5)

@@ -169,3 +169,100 @@ def test_preempt_readmit_invalidates_device_decode_state():
     # re-prefill recomputes the KV; tokens already streamed must not be
     # re-streamed, and the continuation must match the undisturbed run
     assert got == expect
+
+
+# -- round 5: NaN page poisoning through recycled KV pages ---------------------
+
+def _tiny_engine():
+    return NativeEngine(CFG, EngineConfig(
+        page_size=8, num_pages=64, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512), seed=0)
+
+
+def test_oov_token_ids_rejected_at_admission():
+    """An out-of-vocab token id silently becomes NaN at the embedding
+    gather (jnp.take fills OOB reads) and the NaN KV then poisons future
+    tenants of the freed pages. The engine must refuse such requests
+    with a clean ValueError (the worker turns it into an error frame)
+    instead of serving garbage. Found by the chaos harness: a request
+    completed with another request's degenerate argmax-0 tokens."""
+    eng = _tiny_engine()
+    bad = [3, 4, CFG.vocab_size + 10, 5]
+    with pytest.raises(ValueError, match="vocab"):
+        eng.add_request(EngineRequest("bad", bad, SamplingParams()))
+    # remote-allocation path validates too
+    with pytest.raises(ValueError, match="vocab"):
+        eng.allocate_remote(EngineRequest("bad2", bad, SamplingParams()))
+
+
+def test_nonfinite_recycled_pages_never_poison_requests():
+    """Defense in depth for the same failure class when NaN/Inf enters
+    the cache anyway (bf16 overflow on a real model, a buggy transfer):
+    masked attention must zero invalid V rows, because a 0-probability
+    times a NaN V row is NaN (IEEE), which rides into the logits and
+    collapses the argmax. Poison the ENTIRE cache; a fresh request only
+    ever reads its own written rows, so its output must match a clean
+    engine exactly — prefill (stale rows beyond kv_len inside the
+    page-table bucket) and decode windows (stale base-buffer tail) both
+    exercise the masked path."""
+    import jax.numpy as jnp
+
+    prompt = list(range(100, 120))
+    p = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    expect = _tiny_engine().generate(prompt, p, "clean")
+
+    eng = _tiny_engine()
+    eng.cache = {"k": jnp.full_like(eng.cache["k"], jnp.nan),
+                 "v": jnp.full_like(eng.cache["v"], jnp.nan)}
+    got = eng.generate(prompt, p, "poisoned")
+    assert got == expect
+
+
+def test_oov_rejection_remote_path_emits_error_frame():
+    """The disagg remote path must surface an admission rejection as the
+    same per-request ERROR frame the local path emits, not kill the
+    stream with an unhandled ValueError (code-review r5)."""
+    from dynamo_tpu.disagg import (
+        DisaggDecodeWorker, DisaggregatedRouter, LocalTransferBackend,
+        PrefillQueue, PrefillWorker,
+    )
+    from dynamo_tpu.llm.worker import NativeEngineWorker
+    from dynamo_tpu.protocols.common import (
+        FinishReason, PreprocessedRequest, StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    bad_prompt = [3] * 19 + [CFG.vocab_size + 7]  # long => routed remote
+
+    async def main():
+        plane = MemoryPlane()
+        transfer = LocalTransferBackend()
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        router = DisaggregatedRouter(max_local_prefill_length=4,
+                                     max_prefill_queue_size=4, model="tiny")
+        decode = DisaggDecodeWorker(_tiny_engine(), plane.messaging,
+                                    router, queue, worker_id="dec-0",
+                                    prefill_timeout_s=10.0)
+        transfer.register("dec-0", decode)
+        prefill = PrefillWorker(NativeEngineWorker(_tiny_engine()), queue,
+                                transfer, plane.messaging)
+        await decode.start()
+        await prefill.start()
+        try:
+            req = PreprocessedRequest(
+                request_id="bad", token_ids=bad_prompt,
+                stop=StopConditions(max_tokens=4, ignore_eos=True))
+            frames = []
+            async for frame in decode.generate(
+                    req.model_dump(exclude_none=True), Context("bad")):
+                frames.append(frame)
+        finally:
+            await prefill.stop()
+            await decode.stop()
+        return frames
+
+    frames = asyncio.run(main())
+    assert frames, "no frames at all"
+    assert frames[-1]["finish_reason"] == FinishReason.ERROR.value
+    assert "vocab" in frames[-1].get("text", "")
