@@ -1,0 +1,42 @@
+"""dynalint: project-specific static analysis + jaxpr invariant auditing.
+
+Two layers (see docs/ANALYSIS.md):
+
+- AST lint (ast_rules.py, R1-R6): source-level rules distilled from this
+  repo's actual bug history — unguarded vocab gathers, Pallas kernels
+  missing stale-tail K/V zeroing, blocking calls on async paths,
+  CancelledError-swallowing handlers, iterate-while-mutating, host syncs
+  in hot-path files.
+- jaxpr audit (jaxpr_audit.py, J1-J5): traces the engine's jitted entry
+  points with abstract bucket-shaped inputs and asserts invariants on
+  the jaxprs (no f64 leaks, donation consumable, trace-tight bucket
+  ladder, no host callbacks, no convert_element_type round-trips).
+
+CLI: `python tools/dynalint.py dynamo_tpu`. The checked-in baseline
+(tools/dynalint_baseline.json) suppresses pre-existing findings so the
+gate fails only on NEW ones; `tests/test_dynalint.py` makes the tier-1
+pytest run the CI gate.
+"""
+from dynamo_tpu.analysis.findings import (
+    Finding, filter_baseline, load_baseline, save_baseline,
+)
+from dynamo_tpu.analysis.runner import iter_py_files, lint_source, run_lint
+
+_JAXPR_EXPORTS = (
+    "audit_bucket_ladder", "audit_closed_jaxpr", "audit_donation",
+    "audit_engine_entry_points", "trace_and_audit",
+)
+
+__all__ = [
+    "Finding", "filter_baseline", "load_baseline", "save_baseline",
+    "iter_py_files", "lint_source", "run_lint", *_JAXPR_EXPORTS,
+]
+
+
+def __getattr__(name):
+    # the jaxpr layer imports jax; keep the AST-only path (CLI --no-jaxpr,
+    # editors, pre-commit) import-light by loading it lazily
+    if name in _JAXPR_EXPORTS:
+        from dynamo_tpu.analysis import jaxpr_audit
+        return getattr(jaxpr_audit, name)
+    raise AttributeError(name)

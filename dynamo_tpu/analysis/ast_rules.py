@@ -1,0 +1,389 @@
+"""Layer-1 dynalint: AST rules distilled from this repo's bug history.
+
+Each rule is a function (tree, lines, path) -> List[Finding] registered
+in RULES. Rules are deliberately project-specific pattern matchers, not
+general-purpose lints: every one encodes a bug class that actually cost
+a debug round here (ADVICE.md r1-r5), the way NVIDIA Dynamo leans on
+clippy for the classes Rust can express. False positives are expected
+to be rare and are handled by an inline `# dynalint: disable=Rn`
+annotation on the flagged line (with a justification) or by the
+checked-in baseline (findings.py).
+
+Rule ids (docs/ANALYSIS.md has the long-form description of each):
+
+- R1  unguarded token-id flow into embedding/vocab-sized gathers
+- R2  Pallas decode kernel contracting against K/V without stale-tail
+      masking (vpos/kv_len zeroing)
+- R3  blocking call inside `async def`
+- R4  bare/BaseException handler that can swallow CancelledError
+- R5  mutation of a dict/list while iterating it
+- R6  host-sync call in a file marked `# dynalint: hot-path`
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Callable, Dict, List, Optional
+
+from dynamo_tpu.analysis.findings import Finding
+
+RULES: Dict[str, Callable] = {}
+
+
+def rule(rid: str):
+    def deco(fn):
+        RULES[rid] = fn
+        return fn
+    return deco
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - very old ASTs only
+        return ast.dump(node)
+
+
+def _line(lines: List[str], lineno: int) -> str:
+    return lines[lineno - 1].strip() if 0 < lineno <= len(lines) else ""
+
+
+def _finding(rid: str, path: str, lines: List[str], node: ast.AST,
+             message: str, hint: str = "") -> Finding:
+    return Finding(rule=rid, path=path, line=node.lineno, message=message,
+                   hint=hint, line_text=_line(lines, node.lineno))
+
+
+def _call_name(node: ast.Call) -> str:
+    """Dotted name of the called expression ('' when not a plain name)."""
+    f = node.func
+    parts: List[str] = []
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_id_index(idx: ast.expr) -> bool:
+    """True when a subscript index looks like a token-id array (carries a
+    Name) rather than dimension plumbing (slices, None/... axis ops)."""
+    has_name = False
+    for n in ast.walk(idx):
+        if isinstance(n, ast.Slice):
+            return False
+        if isinstance(n, ast.Constant) and (n.value is None
+                                            or n.value is Ellipsis):
+            return False
+        if isinstance(n, ast.Name):
+            has_name = True
+    return has_name
+
+
+# -- R1: unguarded vocab gathers ----------------------------------------------
+
+# tables whose minor-0 axis is vocab-sized: an out-of-bounds take fills
+# (silently, on TPU/jnp) instead of raising — the NaN-cascade class
+# (spec.py salt-id bug, ADVICE r5 high)
+_EMBED_RE = re.compile(r"embed|wte|tok_table|vocab_table|lm_head", re.I)
+_GUARD_RE = re.compile(r"\bclip\b|\bminimum\b|\bmod\b|%")
+_PROPOSE_RE = re.compile(r"propose|_drafts\b|draft_tokens")
+_VOCAB_RE = re.compile(r"vocab", re.I)
+
+
+@rule("R1")
+def r1_unguarded_vocab_gather(tree: ast.AST, lines: List[str],
+                              path: str) -> List[Finding]:
+    out: List[Finding] = []
+    # pattern a: jnp.take / subscript into an embedding-named table with an
+    # index expression that carries no clamp
+    for node in ast.walk(tree):
+        table = idx = None
+        if isinstance(node, ast.Call) and _call_name(node).endswith("take") \
+                and len(node.args) >= 2:
+            table, idx = node.args[0], node.args[1]
+        elif isinstance(node, ast.Subscript) \
+                and not isinstance(node.slice, (ast.Constant, ast.Slice)) \
+                and _is_id_index(node.slice):
+            table, idx = node.value, node.slice
+        if table is None:
+            continue
+        if not _EMBED_RE.search(_unparse(table)):
+            continue
+        if _GUARD_RE.search(_unparse(idx)):
+            continue
+        out.append(_finding(
+            "R1", path, lines, node,
+            f"gather into vocab-sized table `{_unparse(table)}` with "
+            f"unclamped index `{_unparse(idx)}` — an out-of-vocab id "
+            "becomes NaN silently (jnp.take fills OOB reads)",
+            "clip the ids to [0, vocab) or validate them before the "
+            "gather (engine._validate_prompt is the admission-time "
+            "equivalent)"))
+    # pattern b: draft/proposal functions that return token ids scanned
+    # from raw sequence history without ever consulting the vocab bound —
+    # those ids feed the verify forward's embedding take verbatim
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _PROPOSE_RE.search(node.name):
+            continue
+        arg_names = {a.arg for a in node.args.args}
+        reads_history = "tokens" in arg_names or "token_ids" in arg_names \
+            or any(isinstance(n, ast.Attribute) and n.attr == "all_tokens"
+                   for n in ast.walk(node))
+        if not reads_history:
+            continue
+        body_src = _unparse(node)
+        if _VOCAB_RE.search(body_src) or "clip(" in body_src:
+            continue
+        out.append(_finding(
+            "R1", path, lines, node,
+            f"proposal function `{node.name}` returns token ids drawn "
+            "from sequence history without an in-vocab guard — history "
+            "may hold multimodal salt ids far outside the vocab",
+            "truncate the proposal at the first id outside "
+            "[0, vocab_size) before returning it"))
+    return out
+
+
+# -- R2: Pallas decode kernels missing stale-tail K/V zeroing -----------------
+
+_KERNEL_RE = re.compile(r"^_decode_kernel")
+_BUF_RE = re.compile(r"\b[kv]_buf\b")
+
+
+@rule("R2")
+def r2_kernel_stale_tail(tree: ast.AST, lines: List[str],
+                         path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef) \
+                or not _KERNEL_RE.search(fn.name):
+            continue
+        # packed kernels contract over all 128 lanes, so a non-finite K
+        # lane in a NEIGHBOURING token's segment poisons a valid score
+        # (0 * NaN); they need K zeroed too, not just V
+        packed = any(a.arg == "pack" for a in fn.args.args)
+        loads: Dict[str, List[int]] = {}    # name -> load linenos
+        wheres: Dict[str, List[int]] = {}   # name -> where-rebind linenos
+        dot_uses: Dict[str, List[int]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                src = _unparse(node.value)
+                if _BUF_RE.search(src) and "where" not in src:
+                    loads.setdefault(name, []).append(node.lineno)
+                elif "where" in src and re.search(
+                        rf"\b{re.escape(name)}\b", src):
+                    wheres.setdefault(name, []).append(node.lineno)
+            if isinstance(node, ast.Call) \
+                    and _call_name(node).endswith("dot_general"):
+                for arg in node.args:
+                    for n in ast.walk(arg):
+                        if isinstance(n, ast.Name):
+                            dot_uses.setdefault(n.id, []).append(node.lineno)
+        for name, load_lns in loads.items():
+            from_k = any("k_buf" in _line(lines, ln) for ln in load_lns)
+            if from_k and not packed:
+                # unpacked kernels mask K's scores with NEG_INF past
+                # kv_len instead; lanes never mix tokens there
+                continue
+            for ln in load_lns:
+                uses = [u for u in dot_uses.get(name, []) if u > ln]
+                if not uses:
+                    continue
+                first_use = min(uses)
+                if any(ln < w < first_use
+                       for w in wheres.get(name, [])):
+                    continue
+                out.append(Finding(
+                    rule="R2", path=path, line=ln,
+                    message=(
+                        f"`{fn.name}` contracts `{name}` (loaded from a "
+                        "K/V page buffer) without zeroing rows past the "
+                        "valid length — recycled-page tails poison the "
+                        "accumulator (0 * NaN = NaN)"),
+                    hint=("mask with jnp.where(vpos < kv_len, x, 0.0) "
+                          "before the dot_general, like "
+                          "_decode_kernel_packed"),
+                    line_text=_line(lines, ln)))
+    return out
+
+
+# -- R3: blocking calls on async paths ----------------------------------------
+
+_BLOCKING_EXACT = {
+    "time.sleep", "os.system", "socket.create_connection",
+    "urllib.request.urlopen",
+}
+_BLOCKING_PREFIX = ("subprocess.", "requests.")
+
+
+def _visit_async_body(fn: ast.AsyncFunctionDef):
+    """Yield nodes in an async function's own execution scope (skipping
+    nested function/class definitions, which run on their own terms)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@rule("R3")
+def r3_blocking_in_async(tree: ast.AST, lines: List[str],
+                         path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node in _visit_async_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name in _BLOCKING_EXACT \
+                    or name.startswith(_BLOCKING_PREFIX):
+                out.append(_finding(
+                    "R3", path, lines, node,
+                    f"blocking call `{name}` inside `async def "
+                    f"{fn.name}` stalls the whole event loop",
+                    "await an async equivalent (asyncio.sleep, "
+                    "create_subprocess_exec) or push it to a thread "
+                    "(asyncio.to_thread / run_in_executor)"))
+    return out
+
+
+# -- R4: handlers that can swallow CancelledError -----------------------------
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) and n.exc is None
+               for n in ast.walk(handler))
+
+
+def _catches_base(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    types = handler.type.elts if isinstance(handler.type, ast.Tuple) \
+        else [handler.type]
+    return any(_unparse(t).endswith("BaseException") for t in types)
+
+
+@rule("R4")
+def r4_swallows_cancellation(tree: ast.AST, lines: List[str],
+                             path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _catches_base(node) and not _handler_reraises(node):
+            what = "bare `except:`" if node.type is None \
+                else f"`except {_unparse(node.type)}`"
+            out.append(_finding(
+                "R4", path, lines, node,
+                f"{what} swallows asyncio.CancelledError — a cancelled "
+                "task keeps running and cancellation deadlocks",
+                "catch Exception instead, or re-raise: "
+                "`except BaseException: cleanup(); raise`"))
+    return out
+
+
+# -- R5: mutating a container while iterating it ------------------------------
+
+_MUTATORS = {"pop", "popitem", "clear", "remove", "insert", "update",
+             "append", "appendleft", "extend"}
+
+
+def _iter_root(node: ast.expr) -> Optional[str]:
+    """Name of the container a `for` iterates directly, if any: `x`,
+    `x.keys()/.values()/.items()`. Snapshot wrappers (list(x), tuple(x),
+    sorted(x)) return None — they are the sanctioned fix."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in ("keys", "values", "items") \
+            and isinstance(node.func.value, ast.Name):
+        return node.func.value.id
+    return None
+
+
+@rule("R5")
+def r5_mutate_while_iterating(tree: ast.AST, lines: List[str],
+                              path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor)):
+            continue
+        root = _iter_root(loop.iter)
+        if root is None:
+            continue
+        for node in ast.walk(loop):
+            bad = None
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == root \
+                    and node.func.attr in _MUTATORS:
+                bad = f"{root}.{node.func.attr}(...)"
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == root:
+                        bad = f"del {root}[...]"
+            if bad:
+                out.append(_finding(
+                    "R5", path, lines, node,
+                    f"`{bad}` mutates `{root}` while the `for` at line "
+                    f"{loop.lineno} iterates it — RuntimeError on "
+                    "dicts, skipped/repeated elements on lists",
+                    f"iterate a snapshot: `for ... in list({root}):`"))
+    return out
+
+
+# -- R6: host syncs in hot-path files -----------------------------------------
+
+HOT_PATH_RE = re.compile(r"#\s*dynalint:\s*hot-path")
+_SYNC_ATTRS = {"item", "block_until_ready"}
+_SYNC_CALLS = {"jax.device_get", "device_get"}
+
+
+@rule("R6")
+def r6_host_sync_in_hot_path(tree: ast.AST, lines: List[str],
+                             path: str) -> List[Finding]:
+    if not any(HOT_PATH_RE.search(line) for line in lines):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        sync = None
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_ATTRS:
+            sync = f".{node.func.attr}()"
+        elif name in _SYNC_CALLS:
+            sync = f"{name}()"
+        elif name == "float" and node.args \
+                and not isinstance(node.args[0], ast.Constant):
+            sync = "float()"
+        if sync:
+            out.append(_finding(
+                "R6", path, lines, node,
+                f"host sync `{sync}` in a hot-path file — blocks "
+                "dispatch until the device result is ready",
+                "keep values on device; move host reads to the step "
+                "boundary (one batched device_get per step)"))
+    return out
+
+
+def run_rules(tree: ast.AST, lines: List[str], path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for rid in sorted(RULES):
+        findings.extend(RULES[rid](tree, lines, path))
+    return findings

@@ -1,0 +1,84 @@
+"""Finding records + baseline suppression for dynalint.
+
+A finding is (rule, path, line, message, hint) plus a stable `key` used
+for baseline matching. The key deliberately ignores the line NUMBER and
+hashes the stripped source LINE TEXT instead: unrelated edits above a
+pre-existing finding must not un-suppress it, while any edit to the
+flagged line itself (presumably a fix attempt) surfaces it again.
+
+The checked-in baseline (tools/dynalint_baseline.json) is a list of
+{"rule", "path", "line_text", "count"} entries; up to `count` findings
+per (rule, path, line_text) triple are suppressed, so CI fails only on
+findings introduced AFTER the baseline was cut. Regenerate with
+`python tools/dynalint.py --write-baseline` (see docs/ANALYSIS.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str         # "R1".."R6" (AST layer) or "J1".."J5" (jaxpr layer)
+    path: str         # repo-relative file path, or "jaxpr:<entry-point>"
+    line: int         # 1-based line number (0 for jaxpr findings)
+    message: str      # one-line statement of the defect
+    hint: str = ""    # one-line fix hint
+    line_text: str = ""  # stripped source line (baseline key component)
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.line_text)
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        out = f"{loc}: {self.rule}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+def load_baseline(path: str) -> Counter:
+    """Baseline file -> Counter of suppression budgets per key."""
+    try:
+        with open(path) as f:
+            entries = json.load(f)
+    except FileNotFoundError:
+        return Counter()
+    budget: Counter = Counter()
+    for e in entries:
+        budget[(e["rule"], e["path"], e["line_text"])] += int(
+            e.get("count", 1))
+    return budget
+
+
+def save_baseline(path: str, findings: List[Finding]) -> None:
+    counts: Counter = Counter(f.key for f in findings)
+    entries = [
+        {"rule": rule, "path": fpath, "line_text": text, "count": n}
+        for (rule, fpath, text), n in sorted(counts.items())
+    ]
+    with open(path, "w") as f:
+        json.dump(entries, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def filter_baseline(findings: List[Finding],
+                    baseline: Optional[Counter]) -> List[Finding]:
+    """Drop findings covered by the baseline budget; keep the rest in
+    input order. Each baseline entry suppresses at most `count` findings
+    with the same key."""
+    if not baseline:
+        return list(findings)
+    spent: Dict[Tuple[str, str, str], int] = {}
+    fresh: List[Finding] = []
+    for f in findings:
+        used = spent.get(f.key, 0)
+        if used < baseline.get(f.key, 0):
+            spent[f.key] = used + 1
+        else:
+            fresh.append(f)
+    return fresh

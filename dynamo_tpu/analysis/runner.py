@@ -1,0 +1,86 @@
+"""dynalint driver: walk files, run AST rules, honor inline disables.
+
+Separated from the CLI (tools/dynalint.py) so tests and CI call the same
+entry points programmatically:
+
+    from dynamo_tpu.analysis import run_lint, load_baseline, filter_baseline
+    fresh = filter_baseline(run_lint(["dynamo_tpu"]), load_baseline(path))
+
+Inline suppression: a `# dynalint: disable=R1` (comma-separated ids
+allowed) on the FLAGGED line suppresses those rules for that line only —
+meant for intentional exceptions with a justification in the comment,
+while the baseline file absorbs bulk pre-existing findings.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, List
+
+from dynamo_tpu.analysis.ast_rules import run_rules
+from dynamo_tpu.analysis.findings import Finding
+
+_DISABLE_RE = re.compile(r"#\s*dynalint:\s*disable=([A-Za-z0-9,\s]+)")
+_DISABLE_NEXT_RE = re.compile(
+    r"#\s*dynalint:\s*disable-next-line=([A-Za-z0-9,\s]+)")
+
+
+def _ids(match) -> set:
+    return {tok.strip().upper() for tok in match.group(1).split(",")
+            if tok.strip()}
+
+
+def _disabled_rules(lines: List[str], lineno: int) -> set:
+    """Rules suppressed at `lineno`: a trailing `# dynalint: disable=Rn`
+    on the line itself, or `# dynalint: disable-next-line=Rn` on the
+    line above (for lines with no room for a trailing comment)."""
+    out: set = set()
+    if 0 < lineno <= len(lines):
+        m = _DISABLE_RE.search(lines[lineno - 1])
+        if m:
+            out |= _ids(m)
+    if 1 < lineno <= len(lines) + 1:
+        m = _DISABLE_NEXT_RE.search(lines[lineno - 2])
+        if m:
+            out |= _ids(m)
+    return out
+
+
+def lint_source(source: str, path: str) -> List[Finding]:
+    """Run every AST rule over one file's source text."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="E0", path=path, line=e.lineno or 0,
+                        message=f"syntax error: {e.msg}",
+                        line_text="")]
+    lines = source.splitlines()
+    findings = run_rules(tree, lines, path)
+    return [f for f in findings
+            if f.rule not in _disabled_rules(lines, f.line)]
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for fname in sorted(files):
+                if fname.endswith(".py"):
+                    yield os.path.join(root, fname)
+
+
+def run_lint(paths: Iterable[str], root: str = ".") -> List[Finding]:
+    """Lint every .py file under `paths`; finding paths are relative to
+    `root` so baselines are location-independent."""
+    findings: List[Finding] = []
+    for fpath in iter_py_files(paths):
+        with open(fpath, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(fpath, root).replace(os.sep, "/")
+        findings.extend(lint_source(source, rel))
+    return findings

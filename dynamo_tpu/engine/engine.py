@@ -702,7 +702,9 @@ class NativeEngine:
 
     def _gather_drafts(self, plan: DecodePlan) -> list:
         """Per-slot prompt-lookup proposals, clamped to the shared
-        draft_cap budget (spec.py: page allocation ∧ max_tokens)."""
+        draft_cap budget (spec.py: page allocation ∧ max_tokens) and
+        truncated to in-vocab ids (multimodal histories hold salt ids
+        the verify embedding must never see — ADVICE r5 high)."""
         from dynamo_tpu.engine.spec import draft_cap, ngram_propose
         ps = self.cfg.page_size
         drafts: list = []
@@ -714,7 +716,8 @@ class NativeEngine:
                 continue
             drafts.append(ngram_propose(
                 seq.all_tokens, d_max, self.cfg.spec_min_ngram,
-                self.cfg.spec_max_ngram))
+                self.cfg.spec_max_ngram,
+                vocab_size=self.model_cfg.vocab_size))
         return drafts
 
     def _spec_gate_terms(self, plan: DecodePlan):

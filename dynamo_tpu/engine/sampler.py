@@ -7,6 +7,8 @@ top_k, top_p, seed; greedy when nvext.greed_sampling or temperature==0).
 All-batch vectorized with static vocab: one descending sort powers both top-k
 (rank mask) and top-p (cumulative-probability mask); XLA fuses the rest.
 """
+# dynalint: hot-path — every op here runs inside jitted decode/prefill programs;
+# host syncs (.item(), device_get, float()) are dynalint R6 findings
 from __future__ import annotations
 
 import jax

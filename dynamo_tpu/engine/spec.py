@@ -50,15 +50,38 @@ it owns the rest of the decode loop.
 from __future__ import annotations
 
 import functools
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
+def truncate_to_vocab(cont: List[int],
+                      vocab_size: Optional[int]) -> List[int]:
+    """Cut a proposal at the first id outside [0, vocab_size).
+
+    Sequence history is NOT all vocab ids: the scheduler rewrites
+    multimodal span positions to content-hash salts far outside the
+    vocab (scheduler._admit), so a prompt-lookup continuation that
+    crosses an image span would propose salt ids. Those ids would feed
+    the verify forward's embedding take verbatim — an OOB `jnp.take`
+    fills NaN, the NaN K/V row lands INSIDE kv_lens, and the committed
+    "bonus" token becomes an argmax over NaN logits (ADVICE r5 high:
+    the salt-id NaN cascade). Truncating mirrors _validate_prompt's
+    admission-time guarantee for the draft path.
+    """
+    if vocab_size is None:
+        return cont
+    for i, x in enumerate(cont):
+        if not 0 <= x < vocab_size:
+            return cont[:i]
+    return cont
+
+
 def ngram_propose(tokens: Sequence[int], k: int, min_ngram: int = 2,
-                  max_ngram: int = 4, max_scan: int = 4096) -> List[int]:
+                  max_ngram: int = 4, max_scan: int = 4096,
+                  vocab_size: Optional[int] = None) -> List[int]:
     """Propose up to ``k`` draft tokens by prompt lookup.
 
     Finds the MOST RECENT earlier occurrence of the longest suffix n-gram
@@ -68,6 +91,13 @@ def ngram_propose(tokens: Sequence[int], k: int, min_ngram: int = 2,
     more "a"s — the classic prompt-lookup behaviour). Returns [] when the
     sequence is too short or nothing matches; the caller then uses the
     normal decode path.
+
+    ``vocab_size`` bounds the PROPOSED ids: a continuation is truncated
+    at its first out-of-vocab token (truncate_to_vocab) so multimodal
+    salt ids never reach the verify forward. Matching itself still runs
+    over the raw (salted) history — salts are stable per image content,
+    so an n-gram that includes them matches correctly; only the
+    continuation handed to the target must stay in-vocab.
     """
     t = len(tokens)
     if k <= 0 or t < min_ngram + 1:
@@ -89,11 +119,12 @@ def ngram_propose(tokens: Sequence[int], k: int, min_ngram: int = 2,
         # (common for trailing runs) yields to a shorter-n full draft
         full = hits[hits + n + k <= n_arr]
         j = int(full[-1]) if len(full) else int(hits[-1])
-        cont = arr[j + n:j + n + k]
+        cont = truncate_to_vocab(
+            [int(x) for x in arr[j + n:j + n + k]], vocab_size)
         if len(cont) == k:
-            return [int(x) for x in cont]
+            return cont
         if len(cont) > len(best):
-            best = [int(x) for x in cont]
+            best = cont
     return best
 
 
@@ -184,6 +215,7 @@ class DraftModel:
         # the Pallas decode kernel needs the shard_map plumbing the target
         # owns; the draft always takes the XLA gather path
         self.cfg = dataclasses.replace(dcfg, decode_kernel="off")
+        self.vocab = self.cfg.vocab_size
         self.k = engine_cfg.spec_k
         self.page_size = engine_cfg.page_size
         self.max_chunk = engine_cfg.max_prefill_chunk
@@ -261,6 +293,16 @@ class DraftModel:
                 start = self._coverage(seq)
                 n = min(lags[i], bucket)
                 tokens[i, :n] = seq.all_tokens[start:start + n]
+                # multimodal histories hold content-hash salt ids at image
+                # span positions (scheduler._admit); replaying them through
+                # the DRAFT's embedding take would NaN its cache rows for
+                # the request's lifetime — every later propose would emit
+                # NaN-driven degenerate drafts and drag the gate EMA to
+                # zero (ADVICE r5 low). Substitute an in-vocab sentinel:
+                # content stays exact (the target verify rejects any
+                # resulting bad proposal), only draft quality is at stake.
+                row = tokens[i, :n]
+                row[(row < 0) | (row >= self.vocab)] = 0
                 positions[i, :] = start + n - 1
                 positions[i, :n] = np.arange(start, start + n)
                 for j in range(n):
@@ -286,7 +328,12 @@ class DraftModel:
         for i, seq in enumerate(plan.seqs):
             if seq is None:
                 continue
-            toks0[i] = plan.tokens[i, 0]
+            tok0 = int(plan.tokens[i, 0])
+            # a prompt ending inside an image span leaves a salt id as the
+            # slot's last committed token; feed the draft the same in-vocab
+            # sentinel the sync replay uses (see sync) instead of NaNing
+            # its first scan step
+            toks0[i] = tok0 if 0 <= tok0 < self.vocab else 0
             pos0s[i] = seq.total_len - 1
             max_write[i] = pos0s[i] + caps[i]
         props, self.cache = self._propose_fn(

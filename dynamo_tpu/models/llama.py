@@ -14,6 +14,8 @@ reference's canonical + scale-out configs, reference:
 examples/llm/configs/disagg_router.yaml, BASELINE.md) and Mixtral-8x7B when
 cfg.num_experts > 0.
 """
+# dynalint: hot-path — every op here runs inside jitted decode/prefill programs;
+# host syncs (.item(), device_get, float()) are dynalint R6 findings
 from __future__ import annotations
 
 import dataclasses
@@ -331,6 +333,8 @@ def decode_forward(
     kernel_mode = _decode_kernel_mode(cfg)
     lw = cfg.layer_windows()
     layer_wnd = None if lw is None else jnp.asarray(lw, jnp.int32)
+    # ids validated at admission (_validate_prompt); decode feeds only
+    # committed sampler outputs  # dynalint: disable-next-line=R1
     x = scale_embeds(jnp.take(params["embed"], tokens, axis=0),
                      cfg)[:, None]  # [B, 1, D]
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
@@ -459,6 +463,7 @@ def forward(
     h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     if input_embeds is None:
+        # admission validated the ids  # dynalint: disable-next-line=R1
         x = jnp.take(params["embed"], tokens, axis=0)
     elif embeds_mask is not None:
         # multimodal prefill: image-patch positions take the vision
@@ -467,6 +472,9 @@ def forward(
         # vocab ids — see scheduler._admit)
         x = jnp.where(embeds_mask[..., None],
                       input_embeds.astype(_dtype(cfg)),
+                      # masked positions carry salts by design; the where
+                      # drops their NaN embed rows
+                      # dynalint: disable-next-line=R1
                       jnp.take(params["embed"], tokens, axis=0))
     else:
         x = input_embeds.astype(_dtype(cfg))

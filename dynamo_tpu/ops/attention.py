@@ -25,6 +25,8 @@ separate [n_kv_heads, num_pages, page_size, head_dim] arrays per layer
 (head, page) slice contiguous (the decode kernel's DMA unit) and lets the
 kv-head axis shard cleanly over the `tp` mesh axis.
 """
+# dynalint: hot-path — every op here runs inside jitted decode/prefill programs;
+# host syncs (.item(), device_get, float()) are dynalint R6 findings
 from __future__ import annotations
 
 from typing import Optional

@@ -15,6 +15,8 @@ every token, mask-combined) is exact but does E/k times the FLOPs — fine for
 tiny test models, wasteful for Mixtral (8/2 = 4x). Dispatch is the serving
 default.
 """
+# dynalint: hot-path — every op here runs inside jitted decode/prefill programs;
+# host syncs (.item(), device_get, float()) are dynalint R6 findings
 from __future__ import annotations
 
 import functools

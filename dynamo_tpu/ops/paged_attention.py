@@ -28,6 +28,8 @@ copies of q dotted against the packed block, and the flash accumulator is
 kept packed [G, 128] (each hd-lane segment accumulates its residue class),
 folded to [G, hd] by a reshape+sum outside the kernel.
 """
+# dynalint: hot-path — every op here runs inside jitted decode/prefill programs;
+# host syncs (.item(), device_get, float()) are dynalint R6 findings
 from __future__ import annotations
 
 import functools
@@ -278,11 +280,25 @@ def _decode_kernel_prefix(ps: int, hkv: int, g: int, hd: int, pack: int,
         dma(i, slot, k_hbm, k_buf, 0).wait()
         dma(i, slot, v_hbm, v_buf, 1).wait()
 
+        # zero K AND V lanes of tokens past the prefix (recycled-page
+        # tails hold arbitrary, possibly non-finite values): the packed
+        # score dot contracts over ALL 128 lanes, so a non-finite K lane
+        # in a NEIGHBOURING token's segment NaNs a VALID token's score
+        # through the zero-padded q_shifts (0 * NaN), and p == 0 on
+        # masked rows does not survive a non-finite V in the accumulator
+        # dot — same defense as _decode_kernel_packed (ADVICE r5 medium)
+        vrow = jax.lax.broadcasted_iota(jnp.int32, (rows, w), 0)
+        vlane = jax.lax.broadcasted_iota(jnp.int32, (rows, w), 1)
+        vpos = i * ps + vrow * pack + vlane // hd
+        tail_ok = vpos < prefix
+
         row = jax.lax.broadcasted_iota(jnp.int32, (1, rows), 1)
         ms_n, ls_n, accs_n = [], [], []
         for j in range(hkv):
             k = k_buf[slot, j].astype(jnp.float32)       # [rows, W]
             v = v_buf[slot, j].astype(jnp.float32)
+            k = jnp.where(tail_ok, k, 0.0)
+            v = jnp.where(tail_ok, v, 0.0)
             scores = []
             for pk in range(pack):
                 sc = jax.lax.dot_general(

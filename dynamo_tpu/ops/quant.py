@@ -28,6 +28,8 @@ the launcher's --quant flag). GGUF Q4/Q6 files keep their faithful
 dequant at load (llm/gguf.py) and then requantize to int8 for device
 residency — block-preserving on-device Q4_K is future work.
 """
+# dynalint: hot-path — every op here runs inside jitted decode/prefill programs;
+# host syncs (.item(), device_get, float()) are dynalint R6 findings
 from __future__ import annotations
 
 from typing import Any, Dict

@@ -13,6 +13,8 @@ Causality is enforced with absolute positions, so the same code handles
 interior blocks, the diagonal, and fully-masked pairs (which contribute
 zero via the running-max trick).
 """
+# dynalint: hot-path — every op here runs inside jitted decode/prefill programs;
+# host syncs (.item(), device_get, float()) are dynalint R6 findings
 from __future__ import annotations
 
 import functools

@@ -1,0 +1,440 @@
+"""dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
+
+Layout:
+- one positive AND one negative fixture per AST rule (R1-R6), the
+  positives for R1/R2 being faithful minimal copies of the PRE-FIX
+  ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
+  stale-tail zeroing) — the analyzer must flag both on the pre-fix
+  shapes and stay quiet on the fixed ones;
+- one positive and one negative per jaxpr invariant (J1-J5);
+- the gate: the analyzer over dynamo_tpu/ plus the engine entry-point
+  audit yields zero non-baseline findings, so this tier-1 pytest run IS
+  the CI gate for new findings.
+"""
+import json
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.analysis import (
+    audit_bucket_ladder, audit_donation, filter_baseline, lint_source,
+    load_baseline, run_lint, save_baseline, trace_and_audit,
+)
+from dynamo_tpu.analysis.findings import Finding
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "dynalint_baseline.json")
+
+
+def lint(src):
+    return lint_source(textwrap.dedent(src), "fixture.py")
+
+
+def rules(findings):
+    return {f.rule for f in findings}
+
+
+# -- R1: unguarded vocab gathers ----------------------------------------------
+
+# faithful minimal copy of the PRE-FIX ngram_propose shape (ADVICE r5
+# high): token ids sliced from raw history, returned with no vocab bound
+PREFIX_NGRAM = """
+    import numpy as np
+
+    def ngram_propose(tokens, k, min_ngram=2, max_ngram=4):
+        arr = np.asarray(tokens, dtype=np.int64)
+        cont = arr[len(arr) - k:]
+        return [int(x) for x in cont]
+"""
+
+
+def test_r1_flags_prefix_ngram_propose():
+    assert "R1" in rules(lint(PREFIX_NGRAM))
+
+
+def test_r1_quiet_on_fixed_ngram_propose():
+    fixed = """
+        import numpy as np
+
+        def ngram_propose(tokens, k, min_ngram=2, max_ngram=4,
+                          vocab_size=None):
+            arr = np.asarray(tokens, dtype=np.int64)
+            cont = [int(x) for x in arr[len(arr) - k:]]
+            if vocab_size is not None:
+                for i, x in enumerate(cont):
+                    if not 0 <= x < vocab_size:
+                        return cont[:i]
+            return cont
+    """
+    assert "R1" not in rules(lint(fixed))
+
+
+def test_r1_flags_unclamped_embedding_take():
+    pos = """
+        import jax.numpy as jnp
+
+        def embed(params, ids):
+            return jnp.take(params["embed"], ids, axis=0)
+    """
+    assert "R1" in rules(lint(pos))
+
+
+def test_r1_quiet_on_clamped_take_and_axis_subscripts():
+    neg = """
+        import jax.numpy as jnp
+
+        def embed(params, ids, vocab):
+            x = jnp.take(params["embed"], jnp.clip(ids, 0, vocab - 1),
+                         axis=0)
+            return x[:, None] + params["embed"][..., None].sum()
+    """
+    assert "R1" not in rules(lint(neg))
+
+
+def test_r1_live_on_current_spec_py():
+    """The satellite fix must keep spec.py / engine.py R1-clean."""
+    for rel in ("dynamo_tpu/engine/spec.py", "dynamo_tpu/engine/engine.py"):
+        with open(os.path.join(REPO, rel)) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R1"], rel
+
+
+# -- R2: Pallas kernels missing stale-tail K/V zeroing ------------------------
+
+# faithful minimal copy of the PRE-FIX _decode_kernel_prefix per-head
+# loop (ADVICE r5 medium): packed kernel contracting unmasked K and V
+PREFIX_KERNEL = """
+    import jax
+    import jax.numpy as jnp
+
+    def _decode_kernel_prefix(ps, hkv, g, hd, pack, q_shifts, k_buf,
+                              v_buf, slot, prefix):
+        outs = []
+        for j in range(hkv):
+            k = k_buf[slot, j].astype(jnp.float32)
+            v = v_buf[slot, j].astype(jnp.float32)
+            sc = jax.lax.dot_general(
+                q_shifts[j], k, (((1,), (1,)), ((), ())))
+            p = jnp.exp(sc)
+            outs.append(jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ()))))
+        return outs
+"""
+
+
+def test_r2_flags_prefix_kernel_without_masking():
+    found = [f for f in lint(PREFIX_KERNEL) if f.rule == "R2"]
+    assert len(found) == 2  # both the K and the V contraction
+
+
+def test_r2_quiet_when_vpos_masked():
+    fixed = """
+        import jax
+        import jax.numpy as jnp
+
+        def _decode_kernel_prefix(ps, hkv, g, hd, pack, q_shifts, k_buf,
+                                  v_buf, slot, prefix, tail_ok):
+            outs = []
+            for j in range(hkv):
+                k = k_buf[slot, j].astype(jnp.float32)
+                v = v_buf[slot, j].astype(jnp.float32)
+                k = jnp.where(tail_ok, k, 0.0)
+                v = jnp.where(tail_ok, v, 0.0)
+                sc = jax.lax.dot_general(
+                    q_shifts[j], k, (((1,), (1,)), ((), ())))
+                p = jnp.exp(sc)
+                outs.append(jax.lax.dot_general(
+                    p, v, (((1,), (0,)), ((), ()))))
+            return outs
+    """
+    assert "R2" not in rules(lint(fixed))
+
+
+def test_r2_unpacked_kernel_k_is_exempt():
+    """Non-packed kernels (no `pack` arg) mask K's scores with NEG_INF
+    instead — lanes never mix tokens, so only V needs zeroing."""
+    unpacked = """
+        import jax
+        import jax.numpy as jnp
+
+        def _decode_kernel(ps, g, q, k_buf, v_buf, slot, kv_len, vrow):
+            k = k_buf[slot].astype(jnp.float32)
+            v = v_buf[slot].astype(jnp.float32)
+            v = jnp.where(vrow < kv_len, v, 0.0)
+            sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+            p = jnp.exp(sc)
+            return jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))
+    """
+    assert "R2" not in rules(lint(unpacked))
+
+
+def test_r2_live_on_current_paged_attention():
+    with open(os.path.join(REPO, "dynamo_tpu/ops/paged_attention.py")) as f:
+        found = lint_source(f.read(), "dynamo_tpu/ops/paged_attention.py")
+    assert not [x for x in found if x.rule == "R2"]
+
+
+# -- R3: blocking calls in async defs -----------------------------------------
+
+def test_r3_flags_blocking_sleep_in_async():
+    pos = """
+        import time
+
+        async def handler():
+            time.sleep(1.0)
+    """
+    assert "R3" in rules(lint(pos))
+
+
+def test_r3_quiet_on_asyncio_sleep_and_sync_fns():
+    neg = """
+        import asyncio
+        import time
+
+        async def handler():
+            await asyncio.sleep(1.0)
+
+        def sync_loop():
+            time.sleep(1.0)
+
+        async def outer():
+            def helper():
+                time.sleep(0.1)  # runs in an executor, not the loop
+            return helper
+    """
+    assert "R3" not in rules(lint(neg))
+
+
+def test_r3_inline_disable():
+    src = """
+        import time
+
+        async def handler():
+            time.sleep(1.0)  # dynalint: disable=R3
+    """
+    assert "R3" not in rules(lint(src))
+
+
+# -- R4: CancelledError-swallowing handlers -----------------------------------
+
+def test_r4_flags_bare_and_base_exception():
+    pos = """
+        def f(work):
+            try:
+                work()
+            except:
+                pass
+
+        def g(work):
+            try:
+                work()
+            except BaseException:
+                return None
+    """
+    assert len([f for f in lint(pos) if f.rule == "R4"]) == 2
+
+
+def test_r4_quiet_on_reraise_and_exception():
+    neg = """
+        def f(work, cleanup):
+            try:
+                work()
+            except BaseException:
+                cleanup()
+                raise
+
+        def g(work):
+            try:
+                work()
+            except Exception:
+                pass  # CancelledError derives from BaseException: safe
+    """
+    assert "R4" not in rules(lint(neg))
+
+
+# -- R5: mutating a container while iterating it ------------------------------
+
+def test_r5_flags_mutation_while_iterating():
+    pos = """
+        def prune(d):
+            for k in d:
+                if k < 0:
+                    d.pop(k)
+
+        def prune_del(d):
+            for k in d.keys():
+                del d[k]
+    """
+    assert len([f for f in lint(pos) if f.rule == "R5"]) == 2
+
+
+def test_r5_quiet_on_snapshot_iteration():
+    neg = """
+        def prune(d):
+            for k in list(d):
+                if k < 0:
+                    d.pop(k)
+
+        def other(d, e):
+            for k in d:
+                e.pop(k, None)
+    """
+    assert "R5" not in rules(lint(neg))
+
+
+# -- R6: host syncs in hot-path files -----------------------------------------
+
+HOT_SRC = """
+    # dynalint: hot-path
+    import jax
+
+    def step(x):
+        return float(x.sum()) + x.max().item()
+"""
+
+
+def test_r6_flags_host_sync_in_hot_path_file():
+    assert len([f for f in lint(HOT_SRC) if f.rule == "R6"]) == 2
+
+
+def test_r6_quiet_without_marker():
+    assert "R6" not in rules(lint(HOT_SRC.replace("hot-path", "")))
+
+
+# -- jaxpr invariants ----------------------------------------------------------
+
+def test_j1_flags_float64_leak():
+    with jax.experimental.enable_x64(True):
+        found = trace_and_audit(
+            "j1pos", lambda x: jnp.asarray(np.float64(2.0)) * x,
+            jnp.zeros((4,), jnp.float32))
+    assert "J1" in rules(found)
+
+
+def test_j1_quiet_on_f32():
+    found = trace_and_audit("j1neg", lambda x: x * 2.0,
+                            jnp.zeros((4,), jnp.float32))
+    assert not found
+
+
+def test_j2_flags_unconsumable_donation():
+    found = audit_donation(
+        "j2pos", lambda a, b: a * 1.0, (1,),
+        jnp.zeros((4,), jnp.float32), jnp.zeros((8,), jnp.float32))
+    assert rules(found) == {"J2"}
+
+
+def test_j2_quiet_when_output_matches():
+    found = audit_donation(
+        "j2neg", lambda a, b: (a.sum(), b + 1.0), (1,),
+        jnp.zeros((4,), jnp.float32), jnp.zeros((8,), jnp.float32))
+    assert not found
+
+
+def test_j3_flags_dead_rung_and_escape():
+    from dynamo_tpu.engine.scheduler import next_bucket
+    dead = audit_bucket_ladder("j3dead", (16, 32), next_bucket, max_n=8)
+    assert "J3" in rules(dead)
+    escape = audit_bucket_ladder("j3esc", (4,), next_bucket, max_n=8)
+    assert "J3" in rules(escape)
+
+
+def test_j3_quiet_on_tight_ladder():
+    from dynamo_tpu.engine.scheduler import next_bucket
+    assert not audit_bucket_ladder("j3neg", (4, 8), next_bucket, max_n=8)
+
+
+def test_j4_flags_host_callback():
+    def f(x):
+        return jax.pure_callback(
+            lambda a: a, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    assert "J4" in rules(trace_and_audit("j4pos", f,
+                                         jnp.zeros((4,), jnp.float32)))
+
+
+def test_j4_quiet_without_callback():
+    assert not trace_and_audit("j4neg", lambda x: x + 1,
+                               jnp.zeros((4,), jnp.float32))
+
+
+def test_j5_flags_convert_round_trip():
+    found = trace_and_audit(
+        "j5pos", lambda x: x.astype(jnp.bfloat16).astype(jnp.float32),
+        jnp.zeros((4,), jnp.float32))
+    assert "J5" in rules(found)
+
+
+def test_j5_quiet_when_intermediate_is_used():
+    def f(x):
+        y = x.astype(jnp.bfloat16)
+        return y.astype(jnp.float32), y.sum()
+
+    assert "J5" not in rules(trace_and_audit(
+        "j5neg", f, jnp.zeros((4,), jnp.float32)))
+
+
+# -- baseline mechanics --------------------------------------------------------
+
+def test_baseline_suppresses_by_line_text_not_line_number(tmp_path):
+    f1 = Finding(rule="R3", path="a.py", line=10, message="m",
+                 line_text="time.sleep(1)")
+    path = str(tmp_path / "b.json")
+    save_baseline(path, [f1])
+    moved = Finding(rule="R3", path="a.py", line=99, message="m",
+                    line_text="time.sleep(1)")
+    other = Finding(rule="R3", path="a.py", line=11, message="m",
+                    line_text="time.sleep(2)")
+    fresh = filter_baseline([moved, other], load_baseline(path))
+    assert fresh == [other]
+
+
+def test_baseline_budget_is_per_occurrence(tmp_path):
+    f = Finding(rule="R4", path="a.py", line=1, message="m",
+                line_text="except:")
+    path = str(tmp_path / "b.json")
+    save_baseline(path, [f])
+    fresh = filter_baseline([f, f], load_baseline(path))
+    assert len(fresh) == 1  # budget 1 covers one; the second is new
+
+
+# -- the repo gate -------------------------------------------------------------
+
+def test_repo_ast_lint_is_clean_vs_baseline():
+    """Zero non-baseline AST findings over the whole package: this test
+    IS the CI gate for new findings (the committed baseline is empty —
+    the tree is clean after the r5 satellite fixes)."""
+    findings = run_lint([os.path.join(REPO, "dynamo_tpu")], root=REPO)
+    fresh = filter_baseline(findings, load_baseline(BASELINE))
+    assert not fresh, "\n".join(f.render() for f in fresh)
+
+
+def test_repo_jaxpr_audit_is_clean_vs_baseline():
+    """Engine entry points (decode window, verify, prefill, paged
+    attention, sampler, bucket ladder) trace clean on every invariant."""
+    from dynamo_tpu.analysis import audit_engine_entry_points
+    findings = audit_engine_entry_points()
+    fresh = filter_baseline(findings, load_baseline(BASELINE))
+    assert not fresh, "\n".join(f.render() for f in fresh)
+
+
+def test_baseline_file_is_valid_json():
+    with open(BASELINE) as f:
+        entries = json.load(f)
+    assert isinstance(entries, list)
+    for e in entries:
+        assert {"rule", "path", "line_text"} <= set(e)
+
+
+def test_cli_exits_zero_on_clean_tree():
+    import subprocess
+    import sys
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "dynalint.py"),
+         "--no-jaxpr"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -247,3 +247,54 @@ def test_kernel_nonfinite_stale_tail_rows_ignored():
     assert np.isfinite(np.asarray(poisoned)).all()
     np.testing.assert_allclose(np.asarray(poisoned), np.asarray(clean),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_prefix_kernel_nonfinite_stale_tail_ignored():
+    """decode_paged_attention_prefix (the TPU serving decode path) must
+    ignore non-finite recycled-page rows past each sequence's prefix —
+    the same defense _decode_kernel/_decode_kernel_packed already had
+    (ADVICE r5 medium): its per-head loop contracts zero-padded q_shifts
+    against ALL 128 lanes, so an unmasked non-finite K lane in a
+    NEIGHBOURING token's segment NaNs a VALID token's score, and p == 0
+    on masked rows does not survive an unmasked non-finite V."""
+    from dynamo_tpu.ops.paged_attention import (
+        combine_self_attention, decode_paged_attention_prefix,
+    )
+    rng = np.random.default_rng(11)
+    for hd in (64, 128):  # packed (pack=2) and unpacked (pack=1) paths
+        s, h, hkv, L, p, ps, pb = 3, 8, 2, 2, 8, 64, 3
+        q = rng.standard_normal((s, h, hd)).astype(np.float32)
+        kc = rng.standard_normal((L, hkv, p, ps, hd)).astype(np.float32)
+        vc = rng.standard_normal((L, hkv, p, ps, hd)).astype(np.float32)
+        k_new = rng.standard_normal((s, hkv, hd)).astype(np.float32)
+        v_new = rng.standard_normal((s, hkv, hd)).astype(np.float32)
+        pt = ((np.arange(s * pb).reshape(s, pb) * 3) % p).astype(np.int32)
+        prefix = np.array([70, 0, 130], np.int32)  # incl. empty prefix
+
+        def run(kc_, vc_, layer):
+            acc, m, l = decode_paged_attention_prefix(
+                jnp.asarray(q), jnp.asarray(kc_), jnp.asarray(vc_),
+                jnp.asarray([layer], jnp.int32), jnp.asarray(pt),
+                jnp.asarray(prefix), interpret=True)
+            return np.asarray(combine_self_attention(
+                jnp.asarray(q), jnp.asarray(k_new), jnp.asarray(v_new),
+                acc, m, l))
+
+        # poison every row OUTSIDE every sequence's valid prefix (the
+        # dangerous ones are each boundary page's tail rows and the
+        # empty-prefix slot's whole allocation)
+        valid = np.zeros((p * ps,), bool)
+        for i in range(s):
+            for j in range(int(prefix[i])):
+                valid[pt[i, j // ps] * ps + j % ps] = True
+        k_bad, v_bad = kc.copy(), vc.copy()
+        k_bad.reshape(L, hkv, p * ps, hd)[:, :, ~valid] = np.nan
+        v_bad.reshape(L, hkv, p * ps, hd)[:, :, ~valid] = np.nan
+
+        # one layer suffices: the masking is layer-independent (the layer
+        # index only selects which pages the DMA reads) and interpret-mode
+        # kernel runs dominate this test's budget
+        clean = run(kc, vc, 0)
+        poisoned = run(k_bad, v_bad, 0)
+        assert np.isfinite(poisoned).all(), f"hd={hd}"
+        np.testing.assert_allclose(poisoned, clean, rtol=2e-5, atol=2e-5)

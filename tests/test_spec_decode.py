@@ -110,7 +110,8 @@ def test_spec_exact_min_tokens_and_stops(monkeypatch):
     import dynamo_tpu.engine.spec as spec_mod
     oracle_seq: list = []
 
-    def oracle_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096):
+    def oracle_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096,
+                       vocab_size=None):
         done = len(tokens) - len(prompt)
         return oracle_seq[done:done + k]
 
@@ -172,7 +173,8 @@ def test_spec_oracle_draft_accepts_fully(monkeypatch):
     p = SamplingParams(max_tokens=12, temperature=0.0)
     plain = make_engine().generate(prompt, p, "oracle")
 
-    def oracle_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096):
+    def oracle_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096,
+                       vocab_size=None):
         done = len(tokens) - len(prompt)
         return plain[done:done + k]
 
@@ -202,7 +204,8 @@ def test_spec_wrong_drafts_all_rejected(monkeypatch):
 
     import dynamo_tpu.engine.spec as spec_mod
 
-    def wrong_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096):
+    def wrong_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096,
+                       vocab_size=None):
         return [(tokens[-1] + 1) % 100] * k
 
     monkeypatch.setattr(spec_mod, "ngram_propose", wrong_propose)
@@ -238,7 +241,8 @@ def test_spec_gate_returns_to_window_on_rejection(monkeypatch):
 
     import dynamo_tpu.engine.spec as spec_mod
 
-    def wrong_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096):
+    def wrong_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096,
+                       vocab_size=None):
         return [(tokens[-1] + 1) % 100] * k
 
     monkeypatch.setattr(spec_mod, "ngram_propose", wrong_propose)
@@ -541,7 +545,8 @@ def test_spec_composes_with_gemma2_class_attention(monkeypatch):
     # proposer nothing to match after the first token)
     seq_oracle = list(plain)
 
-    def oracle_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096):
+    def oracle_propose(tokens, k, min_ngram=2, max_ngram=4, max_scan=4096,
+                       vocab_size=None):
         done = len(tokens) - len(prompt)
         return seq_oracle[done:done + k]
 
@@ -565,3 +570,85 @@ def test_spec_prefix_cache_hashes_unaffected():
     sa = a.scheduler.peek_prefix(prompt)
     sb = b.scheduler.peek_prefix(prompt)
     assert sa == sb
+
+
+# -- multimodal x speculation --------------------------------------------------
+
+def test_ngram_propose_truncates_at_salt_ids():
+    """Prompt-lookup over a salted (multimodal) history must cut the
+    proposal at the first out-of-vocab id: the scheduler rewrites image
+    span positions to content-hash salts far outside the vocab, and a
+    continuation crossing the span would otherwise feed them to the
+    verify forward's embedding take (ADVICE r5 high — NaN cascade)."""
+    salt = 0x12345678  # representative content-hash salt id
+    toks = [11, 12, 13, 14, salt, salt + 1, 21, 22, 11, 12, 13, 14]
+    # suffix [11,12,13,14] matches position 0; its continuation IS the
+    # salted span — with the vocab bound nothing is proposable
+    assert ngram_propose(toks, k=3, vocab_size=256) == []
+    # without the bound the salts leak (the pre-fix behaviour)
+    assert ngram_propose(toks, k=3)[:2] == [salt, salt + 1]
+    # a continuation entering the span mid-way is truncated, not dropped
+    toks2 = [11, 12, 13, 14, 77, salt, 21, 11, 12, 13, 14]
+    assert ngram_propose(toks2, k=3, vocab_size=256) == [77]
+
+
+def test_spec_exact_when_draft_crosses_mm_span(monkeypatch):
+    """Speculative greedy output for a MULTIMODAL request must stay
+    token-identical to plain greedy even when a draft proposal's
+    continuation crosses the image span. The oracle proposer below
+    mimics a real prompt-lookup match sitting just before a span: two
+    correct tokens, then the sequence's actual salt ids. It routes
+    through the same vocab_size contract _gather_drafts passes to
+    ngram_propose — if the engine stopped passing vocab_size (or
+    truncate_to_vocab regressed), the salts reach the verify embedding
+    take, NaN the logits, and the outputs diverge."""
+    import dynamo_tpu.engine.spec as spec_mod
+    from dynamo_tpu.engine.config import VisionConfig
+
+    vcfg = VisionConfig(image_size=28, patch_size=14, hidden_size=32,
+                        intermediate_size=64, num_layers=2, num_heads=2)
+    cfg = ModelConfig(dtype="float32", max_model_len=256, vision=vcfg)
+    n_patch = 4
+    prompt = [5, 6, 7, 8] + [0] * n_patch + [9, 10, 11, 12]
+    params = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+
+    def make(**kw):
+        d = dict(page_size=8, num_pages=64, max_slots=2,
+                 max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+                 max_model_len=256)
+        d.update(kw)
+        return NativeEngine(cfg, EngineConfig(**d), seed=0)
+
+    rng = np.random.RandomState(3)
+    img = rng.rand(28, 28, 3).astype(np.float32)
+
+    def gen(eng, rid):
+        emb = eng.encode_image(img)
+        eng.add_request(EngineRequest(rid, prompt, params,
+                                      mm_spans=[(4, emb)]))
+        seq = next(s for s in eng.scheduler.waiting
+                   if s.request_id == rid)
+        salts = list(seq.prompt[4:4 + n_patch])
+        out = []
+        while eng.has_work():
+            for ev in eng.step():
+                if ev.token is not None:
+                    out.append(ev.token)
+        return out, salts
+
+    plain, salts = gen(make(), "plain")
+    assert any(not 0 <= s < cfg.vocab_size for s in salts), \
+        "admission must salt the span with out-of-vocab ids"
+
+    def span_crossing_propose(tokens, k, min_ngram=2, max_ngram=4,
+                              max_scan=4096, vocab_size=None):
+        done = len(tokens) - len(prompt)
+        cont = plain[done:done + 2] + salts
+        return spec_mod.truncate_to_vocab(cont, vocab_size)[:k]
+
+    monkeypatch.setattr(spec_mod, "ngram_propose", span_crossing_propose)
+    eng = make(spec_decode="ngram", spec_k=4)
+    spec, _ = gen(eng, "spec")
+    assert spec == plain
+    assert eng.spec_accepted_tokens > 0, \
+        "truncated drafts must still exercise the verify path"

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""dynalint CLI: project-specific static analysis + jaxpr invariant audit.
+
+Usage:
+    python tools/dynalint.py [paths...]          # lint + jaxpr audit
+    python tools/dynalint.py --no-jaxpr          # AST layer only
+    python tools/dynalint.py --write-baseline    # regenerate the baseline
+    python tools/dynalint.py --no-baseline       # show ALL findings
+
+Exit code 0 when every finding is covered by tools/dynalint_baseline.json
+(or inline `# dynalint: disable=Rn` annotations), 1 otherwise — so the
+command itself is CI-gateable; tests/test_dynalint.py runs the same
+entry points under the tier-1 pytest gate. See docs/ANALYSIS.md.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools",
+                                "dynalint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dynalint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO_ROOT, "dynamo_tpu")],
+                    help="files/directories to lint (default: dynamo_tpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/"
+                         "dynalint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; report every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as the new baseline "
+                         "and exit 0")
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="skip the layer-2 jaxpr audit (pure AST lint; "
+                         "no jax import)")
+    args = ap.parse_args(argv)
+
+    from dynamo_tpu.analysis import (
+        filter_baseline, load_baseline, run_lint, save_baseline,
+    )
+
+    findings = run_lint(args.paths, root=REPO_ROOT)
+    if not args.no_jaxpr:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from dynamo_tpu.analysis import audit_engine_entry_points
+        findings += audit_engine_entry_points()
+
+    if args.write_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = None if args.no_baseline else load_baseline(args.baseline)
+    fresh = filter_baseline(findings, baseline)
+    for f in fresh:
+        print(f.render())
+    suppressed = len(findings) - len(fresh)
+    tag = f" ({suppressed} baselined)" if suppressed else ""
+    print(f"dynalint: {len(fresh)} new finding(s){tag}")
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
