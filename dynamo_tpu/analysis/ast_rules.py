@@ -18,6 +18,8 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
 - R4  bare/BaseException handler that can swallow CancelledError
 - R5  mutation of a dict/list while iterating it
 - R6  host-sync call in a file marked `# dynalint: hot-path`
+- R7  unbounded await on a control-plane/transport round trip in the
+      serving layers (transports/, frontend/, disagg/)
 """
 from __future__ import annotations
 
@@ -379,6 +381,62 @@ def r6_host_sync_in_hot_path(tree: ast.AST, lines: List[str],
                 "dispatch until the device result is ready",
                 "keep values on device; move host reads to the step "
                 "boundary (one batched device_get per step)"))
+    return out
+
+
+# -- R7: unbounded control-plane/transport awaits in serving layers -----------
+
+# Only these directories are in scope: the layers whose awaits sit between
+# a client request and a remote peer, where an unbounded wait on a dead
+# peer wedges the whole serving path (the reliability layer's failure
+# model, docs/RESILIENCE.md). Engine/device code is exempt — device steps
+# are bounded by computation, not peers.
+_R7_SCOPE = ("transports/", "frontend/", "disagg/")
+
+# Awaited terminal attribute/function names that are REQUEST-RESPONSE round
+# trips against a remote peer (fire-and-forget publishes and local queue
+# mutations are not flagged). Kept in sync with the Messaging/KVStore
+# surface + asyncio dials.
+_R7_TARGETS = {
+    "request",              # Messaging.request (dispatch acks, stats)
+    "queue_pop", "queue_pop_leased",       # work-queue consumption
+    "dequeue", "dequeue_leased",           # PrefillQueue wrappers
+    "wait_for_instances",   # discovery convergence wait
+    "open_connection", "open_unix_connection",  # asyncio dials
+}
+
+# Awaiting one of these wrappers bounds whatever it wraps.
+_R7_WRAPPERS = {"wait_for", "with_deadline"}
+
+
+@rule("R7")
+def r7_unbounded_transport_await(tree: ast.AST, lines: List[str],
+                                 path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R7_SCOPE):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Await) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        name = _call_name(call)
+        terminal = name.rsplit(".", 1)[-1]
+        if terminal in _R7_WRAPPERS:
+            continue
+        if terminal not in _R7_TARGETS:
+            continue
+        if any(kw.arg == "timeout" for kw in call.keywords):
+            continue
+        out.append(_finding(
+            "R7", path, lines, node,
+            f"`await {name}(...)` is a control-plane/transport round "
+            "trip with no deadline — a dead peer wedges this coroutine "
+            "(and whatever stream it serves) forever",
+            "pass timeout=..., or wrap in asyncio.wait_for / "
+            "runtime.deadline.with_deadline bounded by the request "
+            "Context's remaining budget"))
     return out
 
 

@@ -48,5 +48,21 @@ class PrefillCompletion(pydantic.BaseModel):
     error: Optional[str] = None
 
 
+class PrefillCancel(pydantic.BaseModel):
+    """Broadcast by a decode worker when the client went away while its
+    remote prefill was queued or running: every prefill worker for the
+    model drops the item if still queued, or aborts it mid-run. Purely an
+    optimization — the decode-side `scheduler.remote` guard already makes
+    a late transfer fail safely — but without it an aborted 100k-token
+    prefill still burns a full prefill engine slot."""
+
+    request_id: str
+
+
 def completion_subject(engine_id: str) -> str:
     return f"disagg.prefill_done.{engine_id}"
+
+
+def cancel_subject(queue_name: str) -> str:
+    """Cancellation channel paired with a prefill work queue."""
+    return f"{queue_name}.cancel"

@@ -6,10 +6,17 @@ enqueue RemotePrefillRequests, any prefill worker dequeues — the queue load-
 balances prefill work and survives worker churn (elastic xPyD, reference:
 docs/disagg_serving.md:95-101). Rides the runtime Messaging queue primitives
 (memory plane in-process, control-plane server across processes).
+
+Consumption is LEASED (JetStream ack-wait semantics): `dequeue_leased`
+hands out an item under a redelivery lease and `ack` settles it. A prefill
+worker that dies between dequeue and ack no longer loses the item — the
+lease expires and the item becomes visible to surviving consumers
+(runtime/transports Messaging.queue_pop_leased). Plain `dequeue` remains
+for callers that accept at-most-once.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import msgpack
 
@@ -38,6 +45,25 @@ class PrefillQueue:
             return None
         return RemotePrefillRequest.model_validate(
             msgpack.unpackb(payload, raw=False))
+
+    async def dequeue_leased(
+            self, timeout: Optional[float] = None, lease_s: float = 30.0
+    ) -> Optional[Tuple[RemotePrefillRequest, str]]:
+        """Dequeue under a redelivery lease; returns (request, lease_token).
+        The item is re-enqueued if `ack(token)` doesn't arrive within
+        lease_s — size the lease above the worst-case prefill+transfer."""
+        got = await self.messaging.queue_pop_leased(
+            self.name, timeout=timeout, lease_s=lease_s)
+        if got is None:
+            return None
+        payload, token = got
+        return RemotePrefillRequest.model_validate(
+            msgpack.unpackb(payload, raw=False)), token
+
+    async def ack(self, token: str) -> None:
+        """Settle a leased item (done or terminally failed — either way it
+        must not be redelivered)."""
+        await self.messaging.queue_ack(self.name, token)
 
     async def depth(self) -> int:
         return await self.messaging.queue_depth(self.name)

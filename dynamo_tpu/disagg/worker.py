@@ -15,9 +15,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from collections import OrderedDict
 
 from dynamo_tpu.disagg.protocols import (
-    PrefillCompletion, RemotePrefillRequest, completion_subject,
+    PrefillCancel, PrefillCompletion, RemotePrefillRequest, cancel_subject,
+    completion_subject,
 )
 from dynamo_tpu.disagg.queue import PrefillQueue
 from dynamo_tpu.disagg.router import DisaggregatedRouter
@@ -187,6 +189,18 @@ class DisaggDecodeWorker(NativeEngineWorker):
                 stop_task.cancel()
             self._completions.pop(rid, None)
             if context.is_stopped:
+                # client went away while the prefill was queued/running:
+                # tell the prefill fleet to drop/abort it (a late transfer
+                # would fail safely on the scheduler.remote guard anyway,
+                # but without the broadcast the dead prefill still burns a
+                # whole engine slot)
+                try:
+                    await self.messaging.publish(
+                        cancel_subject(self.prefill_queue.name),
+                        PrefillCancel(
+                            request_id=rid).model_dump_json().encode())
+                except Exception:
+                    log.exception("prefill cancel publish failed for %s", rid)
                 yield EngineOutput(
                     finish_reason=FinishReason.CANCELLED).model_dump(
                         exclude_none=True)
@@ -247,46 +261,102 @@ class DisaggDecodeWorker(NativeEngineWorker):
 
 
 class PrefillWorker:
-    """Queue consumer running prefill-only requests on its own engine."""
+    """Queue consumer running prefill-only requests on its own engine.
+
+    Consumption is leased (PrefillQueue.dequeue_leased): the item is
+    ack'ed only after the completion notify (success or clean failure), so
+    a prefill worker that dies mid-item — between dequeue and notify —
+    leaves the lease to expire and the item is REDELIVERED to a surviving
+    consumer instead of vanishing (tests/test_disagg.py,
+    tests/test_chaos.py disagg chaos). Redelivery is at-least-once: a
+    duplicate run after a completed transfer fails safely on the decode
+    side's scheduler.remote guard.
+
+    It also subscribes to the queue's cancel subject: a PrefillCancel from
+    a decode worker (client disconnected) drops the item if it is still
+    queued, or aborts it mid-run — either way the lease is settled so the
+    dead item is never redelivered.
+    """
 
     def __init__(self, worker: NativeEngineWorker, queue: PrefillQueue,
                  transfer: TransferBackend, messaging,
-                 dequeue_timeout_s: float = 1.0, max_inflight: int = 4):
+                 dequeue_timeout_s: float = 1.0, max_inflight: int = 4,
+                 lease_s: float = 60.0):
         self.worker = worker
         self.queue = queue
         self.transfer = transfer
         self.messaging = messaging
         self.dequeue_timeout_s = dequeue_timeout_s
+        self.lease_s = lease_s
         # cap concurrent handlers so excess work stays in the durable queue,
         # where queue_depth() feeds the disagg routers' backpressure signal
         self._slots = asyncio.Semaphore(max_inflight)
         self._loop_task: asyncio.Task | None = None
+        self._cancel_task: asyncio.Task | None = None
         self._inflight: set[asyncio.Task] = set()
+        # rid -> (task, lease token) for in-flight cancellation
+        self._handling: dict[str, tuple] = {}
+        # cancels that arrived before their item was dequeued (bounded)
+        self._cancelled: "OrderedDict[str, None]" = OrderedDict()
         self.completed = 0
         self.failed = 0
+        self.cancelled = 0
 
     async def start(self) -> "PrefillWorker":
         await self.worker.start()
+        # subscribe BEFORE consuming so a cancel racing the first dequeue
+        # cannot be missed
+        sub = await self.messaging.subscribe(cancel_subject(self.queue.name))
+        self._cancel_task = asyncio.create_task(self._cancel_loop(sub))
         self._loop_task = asyncio.create_task(self._consume())
         return self
 
     async def stop(self) -> None:
-        if self._loop_task:
-            self._loop_task.cancel()
-            try:
-                await self._loop_task
-            except asyncio.CancelledError:
-                pass
-            self._loop_task = None
+        for attr in ("_loop_task", "_cancel_task"):
+            task = getattr(self, attr)
+            if task:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                setattr(self, attr, None)
         for t in list(self._inflight):
             t.cancel()
         await self.worker.stop()
+
+    def _note_cancelled(self, rid: str) -> None:
+        self._cancelled[rid] = None
+        while len(self._cancelled) > 1024:
+            self._cancelled.popitem(last=False)
+
+    async def _cancel_loop(self, sub) -> None:
+        async for _subject, payload in sub:
+            try:
+                cancel = PrefillCancel.model_validate_json(payload)
+            except Exception:
+                log.exception("malformed prefill cancel: %r", payload[:200])
+                continue
+            rid = cancel.request_id
+            entry = self._handling.get(rid)
+            if entry is None:
+                self._note_cancelled(rid)
+                continue
+            task, token = entry
+            self.cancelled += 1
+            log.info("prefill %s cancelled mid-run (client gone)", rid)
+            # settle the lease FIRST: an intentionally dropped item must
+            # never be redelivered
+            await self.queue.ack(token)
+            task.cancel()
+            await self.worker.submit(lambda eng, rid=rid: eng.abort(rid))
 
     async def _consume(self) -> None:
         while True:
             await self._slots.acquire()  # before dequeue: backpressure stays
             try:                         # visible in the queue depth
-                req = await self.queue.dequeue(timeout=self.dequeue_timeout_s)
+                got = await self.queue.dequeue_leased(
+                    timeout=self.dequeue_timeout_s, lease_s=self.lease_s)
             except asyncio.CancelledError:
                 self._slots.release()
                 raise
@@ -295,21 +365,32 @@ class PrefillWorker:
                 log.exception("prefill dequeue failed; retrying")
                 await asyncio.sleep(0.5)
                 continue
-            if req is None:
+            if got is None:
+                self._slots.release()
+                continue
+            req, token = got
+            if req.request_id in self._cancelled:
+                # client went away before we ever started: drop it
+                self._cancelled.pop(req.request_id, None)
+                self.cancelled += 1
+                await self.queue.ack(token)
                 self._slots.release()
                 continue
             # handle concurrently: the engine interleaves chunked prefills,
             # so a long prefill doesn't head-of-line-block the queue
-            task = asyncio.create_task(self._handle(req))
+            task = asyncio.create_task(self._handle(req, token))
             self._inflight.add(task)
+            self._handling[req.request_id] = (task, token)
 
-            def done(t, task=task):
+            def done(t, task=task, rid=req.request_id):
                 self._inflight.discard(task)
+                if self._handling.get(rid, (None,))[0] is task:
+                    self._handling.pop(rid, None)
                 self._slots.release()
 
             task.add_done_callback(done)
 
-    async def _handle(self, req: RemotePrefillRequest) -> None:
+    async def _handle(self, req: RemotePrefillRequest, token: str) -> None:
         rid = req.request_id
         try:
             eng_ps = self.worker.engine.cfg.page_size
@@ -347,7 +428,12 @@ class PrefillWorker:
             self.completed += 1
             await self._notify(req, PrefillCompletion(
                 request_id=rid, first_token=first_token))
+            await self.queue.ack(token)
         except asyncio.CancelledError:
+            # worker death (stop() / task cancel): NO ack — the lease
+            # expires and the item is redelivered to a surviving consumer.
+            # (The cancel-on-client-disconnect path acks before
+            # cancelling, so intentional drops never redeliver.)
             raise
         except Exception as e:
             log.exception("remote prefill %s failed", rid)
@@ -355,6 +441,9 @@ class PrefillWorker:
             await self.worker.submit(lambda eng: eng.abort(rid))
             await self._notify(req, PrefillCompletion(
                 request_id=rid, error=str(e)))
+            # clean failure: the decode side was told and falls back to a
+            # local prefill — redelivering would double-run the request
+            await self.queue.ack(token)
 
     async def _notify(self, req: RemotePrefillRequest,
                       done: PrefillCompletion) -> None:

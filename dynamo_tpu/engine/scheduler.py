@@ -244,6 +244,15 @@ class Scheduler:
 
     def _admit(self, req: EngineRequest) -> SequenceState:
         """Validate + create + register a sequence (shared local/remote)."""
+        if req.request_id in self.params:
+            # a duplicate id would alias two sequences onto one params
+            # entry: aborting one strands the other mid-decode with its
+            # params gone (KeyError in the planner, killing the whole
+            # step loop). Reject at admission — ValueError becomes a
+            # per-request error frame in the worker's add path.
+            raise ValueError(
+                f"request {req.request_id}: id already active on this "
+                "engine (duplicate dispatch?)")
         if len(req.prompt) + req.params.max_tokens > self.cfg.max_model_len:
             raise ValueError(
                 f"request {req.request_id}: len {len(req.prompt)} + "

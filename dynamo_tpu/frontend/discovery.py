@@ -68,14 +68,26 @@ async def list_registered_models(kv) -> Dict[str, dict]:
 class ModelWatcher:
     """Watches the model registry and (de)registers pipelines live."""
 
-    def __init__(self, runtime, model_manager, make_router=None):
+    def __init__(self, runtime, model_manager, make_router=None,
+                 reliability_metrics=None, reliability_policy=None):
         """make_router: optional async (component, client, card) -> KvRouter
-        enabling KV-aware routing for models registered kv_routed=True."""
+        enabling KV-aware routing for models registered kv_routed=True.
+        reliability_metrics / reliability_policy: shared across every
+        pipeline this watcher builds (frontend.service.HttpService exposes
+        its ReliabilityMetrics for this), so migrations/retries/breaker
+        events from all models land on one /metrics surface."""
         self.runtime = runtime
         self.models = model_manager
         self.make_router = make_router
+        self.reliability_metrics = reliability_metrics
+        self.reliability_policy = reliability_policy
         self._task: Optional[asyncio.Task] = None
         self._owned: Dict[str, tuple] = {}  # key -> (client, router)
+        # one reliability-snapshot publisher per namespace served: the
+        # standalone exporter (observability/exporter.py) subscribes
+        # "{ns}.>" and folds "{ns}.frontend.reliability" snapshots into
+        # llm_reliability_* gauges
+        self._rel_publishers: Dict[str, asyncio.Task] = {}
 
     async def start(self) -> "ModelWatcher":
         snapshot, events = await self.runtime.kv.watch_prefix(MODELS_PREFIX)
@@ -99,6 +111,9 @@ class ModelWatcher:
         if self._task:
             self._task.cancel()
             self._task = None
+        for task in self._rel_publishers.values():
+            task.cancel()
+        self._rel_publishers.clear()
         for client, router in self._owned.values():
             if router is not None:
                 await router.stop()
@@ -121,7 +136,21 @@ class ModelWatcher:
         router = None
         if info.get("kv_routed") and self.make_router is not None:
             router = await self.make_router(comp, client, card)
-        pipeline = RemotePipeline(card, client, router=router)
+        from dynamo_tpu.frontend.reliability import ReliableClient
+        reliable = ReliableClient(client, policy=self.reliability_policy,
+                                  router=router,
+                                  metrics=self.reliability_metrics)
+        if self.reliability_metrics is not None \
+                and info["namespace"] not in self._rel_publishers:
+            # component name carries this frontend's worker id: N frontends
+            # serving one namespace must not clobber each other's snapshot
+            # (the exporter labels gauges by the subject's source segment)
+            self._rel_publishers[info["namespace"]] = \
+                self.reliability_metrics.start_publishing(
+                    self.runtime.namespace(info["namespace"]).component(
+                        f"frontend-{self.runtime.worker_id}"))
+        pipeline = RemotePipeline(card, client, router=router,
+                                  reliability=reliable)
         self.models.add(info["name"], pipeline, info.get("model_type", "chat"))
         self._owned[key] = (client, router)
         log.info("model registered: %s -> %s/%s/%s%s", info["name"],

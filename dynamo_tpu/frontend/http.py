@@ -39,10 +39,12 @@ class Request:
 
 
 class HttpError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers = headers or {}
 
 
 class Response:
@@ -64,8 +66,13 @@ class Response:
         return cls(status, text.encode(), content_type)
 
     @classmethod
-    def error(cls, status: int, message: str) -> "Response":
-        return cls.json({"error": {"message": message, "code": status}}, status)
+    def error(cls, status: int, message: str,
+              headers: Optional[Dict[str, str]] = None) -> "Response":
+        resp = cls.json({"error": {"message": message, "code": status}},
+                        status)
+        if headers:
+            resp.headers.update(headers)
+        return resp
 
 
 class StreamingResponse:
@@ -81,7 +88,8 @@ Handler = Callable[[Request], Awaitable["Response | StreamingResponse"]]
 
 STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found",
                405: "Method Not Allowed", 422: "Unprocessable Entity",
-               500: "Internal Server Error", 503: "Service Unavailable"}
+               429: "Too Many Requests", 500: "Internal Server Error",
+               503: "Service Unavailable"}
 
 
 class HttpServer:
@@ -179,7 +187,8 @@ class HttpServer:
         try:
             result = await handler(req)
         except HttpError as e:
-            await self._write_response(writer, Response.error(e.status, e.message))
+            await self._write_response(
+                writer, Response.error(e.status, e.message, e.headers))
             return True
         except Exception as e:
             log.exception("handler error on %s %s", req.method, req.path)

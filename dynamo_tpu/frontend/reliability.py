@@ -1,0 +1,569 @@
+"""Request reliability layer: zero-drop serving over a churning worker fleet.
+
+The routing/transport stack below this module detects failure (lease prune,
+transport errors, stream inactivity) but still surfaces it to the client:
+before this layer, a worker death errored every stream in flight on it
+(tests/test_chaos.py's old contract). FlowKV/NetKV (PAPERS.md) treat
+request-level continuity under instance churn as first-class; this module
+is that layer for our stack. It wraps a runtime `Client` with:
+
+- **Mid-stream migration**: every streamed token is a *committed prefix*;
+  when the serving worker dies (dispatch failure, transport error, stall
+  past the per-stream deadline, worker-side ERROR frame), the request is
+  re-dispatched to a surviving instance as original prompt + committed
+  tokens with `PreprocessedRequest.resume_committed` set. The new worker
+  re-prefills the whole sequence and continues decoding, so the client
+  stream resumes with no duplicated or missing tokens; greedy streams stay
+  token-identical to an uninterrupted single-engine run (the engine's
+  next-token function depends only on the token sequence — verified by
+  tests/test_chaos.py against a single-engine oracle). Seeded sampling at
+  temperature > 0 resumes with the same seed but a reset step counter, so
+  a migrated sampled stream is a *valid* continuation, not a bit-identical
+  one (docs/RESILIENCE.md).
+- **Bounded retries** with exponential backoff + jitter; committed
+  progress resets the backoff (a worker that streamed tokens before dying
+  is evidence the request itself is healthy).
+- **Per-request deadlines** (runtime/engine.Context.set_deadline),
+  propagated over the wire and bounding every dispatch and frame wait.
+- **Per-instance circuit breaker**: N consecutive failures eject an
+  instance from selection (including kv_router scoring, via
+  KvRouter.schedule(exclude=...)); after a cooldown one probe dispatch is
+  admitted, and enough probe successes re-admit the instance.
+- **Load shedding** (AdmissionControl, used by frontend/service.py):
+  bounded concurrent admissions + a bounded wait queue; past the cap,
+  requests are shed immediately with 429 + Retry-After.
+
+The reference framework stops at failure *detection* (SURVEY §5); this is
+the recovery story layered on top.
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import random
+import time
+from typing import Dict, Optional, Set
+
+from dynamo_tpu.observability.metrics import MetricsRegistry
+from dynamo_tpu.protocols.common import (
+    EngineOutput, FinishReason, PreprocessedRequest,
+)
+from dynamo_tpu.runtime.deadline import DeadlineExceeded, with_deadline
+from dynamo_tpu.runtime.engine import Context
+
+log = logging.getLogger("dynamo_tpu.reliability")
+
+# event-plane subject (published under a component: "{ns}.{comp}.reliability")
+# carrying counter snapshots for the standalone metrics exporter
+RELIABILITY_SUBJECT = "reliability"
+
+
+@dataclasses.dataclass
+class ReliabilityPolicy:
+    """Knobs for the per-request reliability state machine (defaults sized
+    for production serving; tests shrink the timeouts)."""
+
+    # no COMMITTED frame for this long => the serving instance is presumed
+    # dead and the stream migrates (data-plane keepalives keep a merely
+    # slow worker alive at the transport layer, but a worker whose engine
+    # died keeps the transport open while producing nothing — this is the
+    # layer that catches it)
+    stall_timeout_s: float = 30.0
+    # bound on the dispatch round trip (instance pick + request-plane ack)
+    dispatch_timeout_s: float = 10.0
+    # dispatch attempts without any committed progress before giving up
+    max_attempts: int = 4
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    backoff_jitter: float = 0.5          # multiplicative jitter fraction
+    # default end-to-end deadline armed when the caller didn't set one
+    # (None = unbounded requests stay unbounded)
+    request_deadline_s: Optional[float] = None
+
+
+class ReliabilityMetrics:
+    """The reliability counters, on a (shared or private) registry.
+
+    `snapshot()` feeds the event-plane publication the standalone metrics
+    exporter consumes (observability/exporter.py)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        self.migrations = r.counter(
+            "llm_reliability_migrations_total",
+            "streams re-dispatched mid-stream after a worker death")
+        self.retries = r.counter(
+            "llm_reliability_retries_total",
+            "dispatch retries (no committed progress yet)")
+        self.breaker_opens = r.counter(
+            "llm_reliability_breaker_opens_total",
+            "circuit breaker open transitions (instance ejected)")
+        self.breaker_closes = r.counter(
+            "llm_reliability_breaker_closes_total",
+            "circuit breaker close transitions (instance re-admitted)")
+        self.shed_requests = r.counter(
+            "llm_reliability_shed_requests_total",
+            "requests shed at admission (429 + Retry-After)")
+        self.stall_fires = r.counter(
+            "llm_reliability_stall_deadline_total",
+            "per-stream stall deadlines fired")
+        self.deadline_exceeded = r.counter(
+            "llm_reliability_deadline_exceeded_total",
+            "requests failed by their end-to-end deadline")
+
+    FIELDS = ("migrations", "retries", "breaker_opens", "breaker_closes",
+              "shed_requests", "stall_fires", "deadline_exceeded")
+
+    def snapshot(self) -> Dict[str, float]:
+        return {name: getattr(self, name).get() for name in self.FIELDS}
+
+    async def publish(self, component) -> None:
+        """One counter snapshot onto the component's event plane (subject
+        `{ns}.{component}.reliability`); the exporter folds it into
+        llm_reliability_* gauges."""
+        await component.publish(RELIABILITY_SUBJECT, self.snapshot())
+
+    def start_publishing(self, component,
+                         interval_s: float = 2.0) -> asyncio.Task:
+        async def loop():
+            while True:
+                await asyncio.sleep(interval_s)
+                try:
+                    await self.publish(component)
+                except Exception:
+                    log.exception("reliability snapshot publish failed")
+
+        return asyncio.create_task(loop())
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _BreakerState:
+    state: str = "closed"            # closed | open | half_open
+    consecutive_failures: int = 0
+    probe_successes: int = 0
+    open_until: float = 0.0
+    probe_inflight: bool = False
+
+
+class CircuitBreaker:
+    """Per-instance dispatch gate (closed -> open -> half-open -> closed).
+
+    `failure_threshold` consecutive failures open the breaker: the
+    instance is ejected from selection (`blocked()` feeds both the local
+    pick and KvRouter.schedule(exclude=...)). After `cooldown_s` the
+    breaker goes half-open and admits ONE probe dispatch at a time;
+    `probe_successes` successful probes close it, any probe failure
+    re-opens it for another cooldown.
+    """
+
+    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 5.0,
+                 probe_successes: int = 1,
+                 metrics: Optional[ReliabilityMetrics] = None,
+                 clock=time.monotonic):
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self.probe_successes = probe_successes
+        self.metrics = metrics
+        self._clock = clock
+        self._states: Dict[str, _BreakerState] = {}
+
+    def _state(self, instance: str) -> _BreakerState:
+        return self._states.setdefault(instance, _BreakerState())
+
+    def _tick(self, st: _BreakerState) -> None:
+        if st.state == "open" and self._clock() >= st.open_until:
+            st.state = "half_open"
+            st.probe_inflight = False
+            st.probe_successes = 0
+
+    def allow(self, instance: str) -> bool:
+        """May this instance be dispatched to right now? (Does not consume
+        the half-open probe slot; call on_dispatch once committed.)"""
+        st = self._state(instance)
+        self._tick(st)
+        if st.state == "closed":
+            return True
+        if st.state == "half_open":
+            return not st.probe_inflight
+        return False
+
+    def blocked(self) -> Set[str]:
+        """Instances currently ineligible for dispatch."""
+        return {i for i in self._states if not self.allow(i)}
+
+    def on_dispatch(self, instance: str) -> None:
+        """Mark a dispatch to `instance` (consumes the half-open probe)."""
+        st = self._state(instance)
+        if st.state == "half_open":
+            st.probe_inflight = True
+
+    def record_success(self, instance: str) -> None:
+        st = self._state(instance)
+        self._tick(st)
+        st.consecutive_failures = 0
+        if st.state == "half_open":
+            st.probe_inflight = False
+            st.probe_successes += 1
+            if st.probe_successes >= self.probe_successes:
+                st.state = "closed"
+                if self.metrics:
+                    self.metrics.breaker_closes.inc()
+                log.info("breaker closed for %s (probes succeeded)", instance)
+
+    def release_probe(self, instance: str) -> None:
+        """Free a consumed half-open probe slot with NO outcome: the
+        attempt was abandoned for reasons unrelated to the instance
+        (caller cancel, request deadline). Without this, an abandoned
+        probe would leave probe_inflight set forever and the instance
+        permanently ejected."""
+        st = self._states.get(instance)
+        if st is not None and st.state == "half_open":
+            st.probe_inflight = False
+
+    def record_failure(self, instance: str) -> None:
+        st = self._state(instance)
+        self._tick(st)
+        st.consecutive_failures += 1
+        if st.state == "half_open" or (
+                st.state == "closed"
+                and st.consecutive_failures >= self.failure_threshold):
+            reopening = st.state == "half_open"
+            st.state = "open"
+            st.probe_inflight = False
+            st.open_until = self._clock() + self.cooldown_s
+            if not reopening and self.metrics:
+                self.metrics.breaker_opens.inc()
+            log.warning("breaker %s for %s after %d consecutive failures",
+                        "re-opened" if reopening else "opened", instance,
+                        st.consecutive_failures)
+
+    def forget(self, instance: str) -> None:
+        """Drop state for a departed instance (lease pruned for good)."""
+        self._states.pop(instance, None)
+
+
+# -- admission control (load shedding) ----------------------------------------
+
+
+class AdmissionShed(Exception):
+    """Raised by AdmissionControl.acquire when the request must be shed;
+    carries the Retry-After hint."""
+
+    def __init__(self, retry_after_s: int):
+        super().__init__("admission queue full")
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionControl:
+    """Bounded concurrent admissions + bounded FIFO wait queue.
+
+    Up to `max_inflight` requests run; up to `max_queued` more wait (at
+    most `queue_timeout_s`). Anything past that is shed immediately —
+    the caller maps AdmissionShed to HTTP 429 with Retry-After. Shedding
+    at the door keeps accepted requests' latency bounded instead of
+    letting an unbounded backlog time everyone out (ROADMAP: heavy
+    traffic from millions of users).
+    """
+
+    def __init__(self, max_inflight: int, max_queued: int = 0,
+                 queue_timeout_s: float = 5.0, retry_after_s: int = 1,
+                 metrics: Optional[ReliabilityMetrics] = None):
+        self.max_inflight = max_inflight
+        self.max_queued = max_queued
+        self.queue_timeout_s = queue_timeout_s
+        self.retry_after_s = retry_after_s
+        self.metrics = metrics
+        self.active = 0
+        self._waiters: "list[asyncio.Future]" = []
+
+    def _shed(self) -> AdmissionShed:
+        if self.metrics:
+            self.metrics.shed_requests.inc()
+        return AdmissionShed(self.retry_after_s)
+
+    async def acquire(self) -> None:
+        if self.active < self.max_inflight:
+            self.active += 1
+            return
+        if len(self._waiters) >= self.max_queued:
+            raise self._shed()
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            await asyncio.wait_for(fut, self.queue_timeout_s)
+        except asyncio.TimeoutError:
+            if fut in self._waiters:
+                self._waiters.remove(fut)
+                raise self._shed() from None
+            # lost the race: release() granted the slot as we timed out
+            return
+
+    def release(self) -> None:
+        while self._waiters:
+            fut = self._waiters.pop(0)
+            if not fut.done():
+                fut.set_result(None)   # slot transfers; active unchanged
+                return
+        self.active = max(0, self.active - 1)
+
+
+# -- the migrating client ------------------------------------------------------
+
+
+class _AttemptFailed(Exception):
+    """Internal: one dispatch attempt is dead; migrate/retry."""
+
+
+class ReliableClient:
+    """Wraps a runtime Client (and optional KvRouter) with the full
+    reliability state machine. `generate` matches Client.generate's frame
+    contract (decoded EngineOutput dicts), so it drops into
+    llm/pipeline.RemoteEngineSink and direct callers alike.
+    """
+
+    def __init__(self, client, policy: Optional[ReliabilityPolicy] = None,
+                 router=None, breaker: Optional[CircuitBreaker] = None,
+                 metrics: Optional[ReliabilityMetrics] = None,
+                 route_policy: str = "round_robin",
+                 rng: Optional[random.Random] = None):
+        self.client = client
+        self.policy = policy or ReliabilityPolicy()
+        self.router = router
+        self.metrics = metrics or ReliabilityMetrics()
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            metrics=self.metrics)
+        self.route_policy = route_policy
+        self._rng = rng or random.Random()
+        self._rr = 0
+
+    # -- instance selection ---------------------------------------------------
+
+    async def _pick_instance(self, pre: PreprocessedRequest,
+                             ctx: Context) -> str:
+        blocked = self.breaker.blocked()
+        if self.router is not None:
+            try:
+                wid = await self.router.schedule(pre.token_ids,
+                                                 exclude=blocked)
+                self.breaker.on_dispatch(wid)
+                return wid
+            except Exception:
+                log.exception("kv routing failed; falling back to %s",
+                              self.route_policy)
+        ids = [i for i in self.client.instance_ids() if i not in blocked]
+        if not ids:
+            ids = self.client.instance_ids()   # all ejected: probe anyway
+        if not ids:
+            rem = ctx.time_remaining()
+            await with_deadline(
+                self.client.wait_for_instances(
+                    timeout=min(5.0, rem) if rem is not None else 5.0),
+                None, ctx)
+            ids = self.client.instance_ids()
+        if self.route_policy == "round_robin":
+            self._rr = (self._rr + 1) % len(ids)
+            wid = sorted(ids)[self._rr]
+        else:
+            wid = self._rng.choice(ids)
+        self.breaker.on_dispatch(wid)
+        return wid
+
+    # -- migration bookkeeping ------------------------------------------------
+
+    @staticmethod
+    def _attempt_request(pre: PreprocessedRequest, committed: list,
+                         attempt_no: int) -> PreprocessedRequest:
+        if not committed and attempt_no == 1:
+            return pre
+        clone = pre.model_copy(deep=True)
+        # every re-dispatch gets a fresh engine-level id: the abandoned
+        # attempt may still be ACTIVE on its worker (stall, not death) and
+        # a round-robin/router re-pick can land the retry on that same
+        # worker — a duplicate id there is rejected at engine admission
+        clone.request_id = f"{pre.request_id}~a{attempt_no}"
+        if committed:
+            clone.token_ids = list(pre.token_ids) + list(committed)
+            clone.resume_committed = len(committed)
+        return clone
+
+    async def _backoff(self, failures: int, ctx: Context) -> None:
+        delay = min(self.policy.backoff_max_s,
+                    self.policy.backoff_base_s * (2 ** max(0, failures - 1)))
+        delay *= 1.0 + self.policy.backoff_jitter * self._rng.random()
+        rem = ctx.time_remaining()
+        if rem is not None:
+            delay = min(delay, rem)
+        if delay > 0:
+            await asyncio.sleep(delay)
+
+    # -- the state machine ----------------------------------------------------
+
+    async def generate(self, request, context: Optional[Context] = None):
+        """Yields EngineOutput frame dicts; the stream only ever ends with
+        a finish frame (never an exception) unless the caller cancels."""
+        pre = (request if isinstance(request, PreprocessedRequest)
+               else PreprocessedRequest.model_validate(request))
+        ctx = context or Context()
+        if ctx.time_remaining() is None \
+                and self.policy.request_deadline_s is not None:
+            ctx.set_deadline(self.policy.request_deadline_s)
+
+        committed: list = []
+        max_toks = pre.stop.max_tokens
+        failures = 0          # consecutive attempts without progress
+        attempt_no = 0        # total dispatches (unique engine-level ids)
+        last_error = "no instances"
+
+        while True:
+            if ctx.is_stopped:
+                yield _frame(FinishReason.CANCELLED)
+                return
+            if max_toks is not None and committed \
+                    and len(committed) >= max_toks:
+                # the dead worker delivered the full budget but not its
+                # finish frame; nothing left to resume
+                yield _frame(FinishReason.LENGTH)
+                return
+            if ctx.deadline_expired:
+                self.metrics.deadline_exceeded.inc()
+                yield _frame(FinishReason.ERROR,
+                             text=f"deadline exceeded ({last_error})")
+                return
+
+            attempt_no += 1
+            req = self._attempt_request(pre, committed, attempt_no)
+            sub_ctx = ctx.child()
+            instance = None
+            # breaker bookkeeping: every attempt must end in exactly one of
+            # record_success / record_failure / release_probe — an attempt
+            # abandoned for reasons unrelated to the instance (caller
+            # cancel, request deadline) must neither poison the breaker nor
+            # leak the half-open probe slot
+            outcome_recorded = False
+            try:
+                try:
+                    instance = await self._pick_instance(req, ctx)
+                    stream = await with_deadline(
+                        self.client.generate(
+                            req.model_dump(exclude_none=True), sub_ctx,
+                            instance=instance),
+                        self.policy.dispatch_timeout_s, ctx)
+                except asyncio.CancelledError:
+                    raise
+                except DeadlineExceeded:
+                    continue      # loop head reports deadline_exceeded
+                except Exception as e:
+                    last_error = f"dispatch to {instance}: {e}"
+                    if instance is not None:
+                        self.breaker.record_failure(instance)
+                        outcome_recorded = True
+                    failures += 1
+                    if failures >= self.policy.max_attempts:
+                        yield _frame(
+                            FinishReason.ERROR,
+                            text=f"gave up after {failures} attempts: "
+                                 f"{last_error}")
+                        return
+                    self.metrics.retries.inc()
+                    await self._backoff(failures, ctx)
+                    continue
+
+                error: Optional[str] = None
+                deadline_hit = False
+                try:
+                    it = stream.__aiter__()
+                    while True:
+                        try:
+                            frame = await with_deadline(
+                                it.__anext__(),
+                                self.policy.stall_timeout_s, ctx)
+                        except StopAsyncIteration:
+                            error = "stream ended without finish frame"
+                            break
+                        except DeadlineExceeded:
+                            deadline_hit = True
+                            break
+                        except asyncio.TimeoutError:
+                            self.metrics.stall_fires.inc()
+                            error = (f"stream stalled "
+                                     f">{self.policy.stall_timeout_s:.1f}s")
+                            break
+                        fr = frame.get("finish_reason")
+                        if fr == FinishReason.ERROR.value:
+                            if frame.get("retryable") is False:
+                                # deterministic per-REQUEST rejection
+                                # (admission/validation): retrying elsewhere
+                                # fails identically, and it is not the
+                                # instance's fault — forward it
+                                self.breaker.record_success(instance)
+                                outcome_recorded = True
+                                yield frame
+                                return
+                            error = frame.get("text") or "worker error frame"
+                            break
+                        if fr == FinishReason.CANCELLED.value \
+                                and not ctx.is_stopped:
+                            # responder-side teardown the CLIENT never asked
+                            # for (e.g. graceful drain escalated): migrate
+                            error = "worker cancelled the stream"
+                            break
+                        toks = frame.get("token_ids") or ()
+                        if toks:
+                            committed.extend(toks)
+                            failures = 0  # progress is evidence of health
+                        yield frame
+                        if fr is not None:
+                            self.breaker.record_success(instance)
+                            outcome_recorded = True
+                            return
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:   # transport error mid-stream
+                    error = f"{type(e).__name__}: {e}"
+                finally:
+                    # abandon this attempt cleanly: stop the (possibly
+                    # still live) responder, release the data-plane stream
+                    sub_ctx.stop_generating()
+                    aclose = getattr(stream, "aclose", None)
+                    if aclose is not None:
+                        try:
+                            await aclose()
+                        except Exception:
+                            pass
+
+                if deadline_hit:
+                    continue      # loop head reports deadline_exceeded
+                if ctx.is_stopped:
+                    yield _frame(FinishReason.CANCELLED)
+                    return
+                last_error = f"{instance}: {error}"
+                self.breaker.record_failure(instance)
+                outcome_recorded = True
+                failures += 1
+                if failures >= self.policy.max_attempts:
+                    yield _frame(
+                        FinishReason.ERROR,
+                        text=f"gave up after {failures} attempts "
+                             f"without progress: {last_error}")
+                    return
+                if committed:
+                    self.metrics.migrations.inc()
+                    log.warning("migrating %s (%d tokens committed): %s",
+                                ctx.id, len(committed), last_error)
+                else:
+                    self.metrics.retries.inc()
+                    log.warning("retrying %s: %s", ctx.id, last_error)
+                await self._backoff(failures, ctx)
+            finally:
+                if instance is not None and not outcome_recorded:
+                    self.breaker.release_probe(instance)
+
+
+def _frame(reason: FinishReason, text: Optional[str] = None) -> dict:
+    return EngineOutput(finish_reason=reason, text=text).model_dump(
+        exclude_none=True)

@@ -22,7 +22,23 @@ from dynamo_tpu.runtime.distributed import DistributedRuntime
 
 async def run_frontend(runtime, host: str = "0.0.0.0", port: int = 8080,
                        kv_routing: bool = True) -> HttpService:
-    service = await HttpService(host, port).start()
+    # load shedding + deadline knobs (DYN_* env, reference figment-style):
+    # DYN_MAX_INFLIGHT caps concurrently admitted requests (0/unset = no
+    # shedding), DYN_ADMISSION_QUEUE bounds the wait line behind the cap,
+    # DYN_REQUEST_DEADLINE_S arms an end-to-end deadline per request
+    import os
+    admission = None
+    max_inflight = int(os.environ.get("DYN_MAX_INFLIGHT", "0"))
+    if max_inflight > 0:
+        from dynamo_tpu.frontend.reliability import AdmissionControl
+        admission = AdmissionControl(
+            max_inflight,
+            max_queued=int(os.environ.get("DYN_ADMISSION_QUEUE", "64")),
+            retry_after_s=int(os.environ.get("DYN_RETRY_AFTER_S", "1")))
+    deadline = os.environ.get("DYN_REQUEST_DEADLINE_S")
+    service = await HttpService(
+        host, port, admission=admission,
+        default_deadline_s=float(deadline) if deadline else None).start()
 
     async def make_router(component, client, card):
         return await KvRouter(component, client,
@@ -30,7 +46,8 @@ async def run_frontend(runtime, host: str = "0.0.0.0", port: int = 8080,
 
     watcher = await ModelWatcher(
         runtime, service.models,
-        make_router=make_router if kv_routing else None).start()
+        make_router=make_router if kv_routing else None,
+        reliability_metrics=service.reliability).start()
     service._watcher = watcher  # keep alive / stoppable
     return service
 

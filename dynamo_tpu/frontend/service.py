@@ -67,10 +67,24 @@ class ModelManager:
 
 class HttpService:
     def __init__(self, host: str = "0.0.0.0", port: int = 8080,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 admission=None, default_deadline_s: Optional[float] = None):
+        """admission: an AdmissionControl (frontend/reliability.py) for
+        load shedding — past its caps, requests get 429 + Retry-After.
+        default_deadline_s: end-to-end deadline armed on every request's
+        Context (propagated to workers over the wire)."""
+        from dynamo_tpu.frontend.reliability import ReliabilityMetrics
         self.server = HttpServer(host, port)
         self.models = ModelManager()
         self.registry = registry or MetricsRegistry()
+        # reliability counters (migrations/retries/breaker/shed/stalls)
+        # render on this service's /metrics; pipelines built for this
+        # frontend should share it (discovery.ModelWatcher does)
+        self.reliability = ReliabilityMetrics(self.registry)
+        self.admission = admission
+        if self.admission is not None and self.admission.metrics is None:
+            self.admission.metrics = self.reliability
+        self.default_deadline_s = default_deadline_s
         m = self.registry
         self._requests = m.counter(
             "llm_http_service_requests_total",
@@ -145,7 +159,20 @@ class HttpService:
                    model: str, start_stream):
         request_type = "stream" if oai_req.stream else "unary"
         t0 = time.perf_counter()
+        admitted = False
+        if self.admission is not None:
+            from dynamo_tpu.frontend.reliability import AdmissionShed
+            try:
+                await self.admission.acquire()
+                admitted = True
+            except AdmissionShed as e:
+                self._requests.inc(model, endpoint, request_type, "shed")
+                raise HttpError(
+                    429, "server overloaded, retry later",
+                    headers={"retry-after": str(e.retry_after_s)})
         ctx = Context()
+        if self.default_deadline_s is not None:
+            ctx.set_deadline(self.default_deadline_s)
         self._inflight.inc(model)
 
         finished = False
@@ -157,6 +184,8 @@ class HttpService:
             if finished:
                 return
             finished = True
+            if admitted:
+                self.admission.release()
             self._inflight.dec(model)
             self._requests.inc(model, endpoint, request_type, status)
             self._duration.observe(model, value=time.perf_counter() - t0)

@@ -71,10 +71,14 @@ class KvRouter:
         return self.indexer.find_matches(
             compute_page_hashes(tokens, self.block_size))
 
-    async def schedule(self, tokens: Sequence[int]) -> str:
-        """Pick the best worker for this token sequence; returns worker_id."""
+    async def schedule(self, tokens: Sequence[int],
+                       exclude=()) -> str:
+        """Pick the best worker for this token sequence; returns worker_id.
+        `exclude`: instances currently ejected (circuit breaker open) —
+        dropped from scoring unless that would leave no candidates."""
         overlap = self.find_matches_for_tokens(tokens)
-        worker_id = self.scheduler.schedule(len(tokens), overlap)
+        worker_id = self.scheduler.schedule(len(tokens), overlap,
+                                            exclude=exclude)
         if self.publish_hit_events:
             for ev in self.scheduler.drain_hit_events():
                 await self.component.publish(KV_HIT_RATE_SUBJECT, {

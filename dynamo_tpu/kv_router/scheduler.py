@@ -114,9 +114,22 @@ class KvScheduler:
     def remove_worker(self, worker_id: str) -> None:
         self.endpoints.workers.pop(worker_id, None)
 
-    def schedule(self, isl_tokens: int, overlap: MatchResult) -> str:
+    def schedule(self, isl_tokens: int, overlap: MatchResult,
+                 exclude=()) -> str:
+        """Pick a worker; `exclude` drops workers from consideration (the
+        reliability layer's circuit breaker ejects flapping instances this
+        way). If exclusion would empty the candidate set, the full set is
+        used — a probe somewhere beats failing the request outright."""
+        endpoints = self.endpoints
+        if exclude:
+            kept = {w: m for w, m in endpoints.workers.items()
+                    if w not in exclude}
+            if kept:
+                # same WorkerMetrics objects: optimistic bumps below still
+                # land on the live snapshot
+                endpoints = ProcessedEndpoints(workers=kept)
         sel = self.selector.select_worker(
-            self.endpoints, SchedulingRequest(isl_tokens, overlap),
+            endpoints, SchedulingRequest(isl_tokens, overlap),
             self.block_size)
         m = self.endpoints.workers.get(sel.worker_id)
         if m is not None:

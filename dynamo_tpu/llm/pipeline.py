@@ -250,14 +250,34 @@ class LocalEngineSink:
 
 
 class RemoteEngineSink:
-    """Sink node: a remote worker endpoint, optionally KV-aware routed."""
+    """Sink node: a remote worker endpoint, optionally KV-aware routed.
 
-    def __init__(self, client, router=None, policy: str = "round_robin"):
+    By default requests run through the reliability layer
+    (frontend/reliability.ReliableClient): mid-stream migration on worker
+    death, bounded retries with backoff, a per-instance circuit breaker
+    that also ejects instances from kv_router scoring, and per-request
+    deadlines. Pass reliability=False for the raw single-dispatch path.
+    """
+
+    def __init__(self, client, router=None, policy: str = "round_robin",
+                 reliability=None):
         self.client = client
         self.router = router
         self.policy = policy
+        if reliability is False:
+            self.reliable = None
+        elif reliability is not None:
+            self.reliable = reliability
+        else:
+            from dynamo_tpu.frontend.reliability import ReliableClient
+            self.reliable = ReliableClient(client, router=router,
+                                           route_policy=policy)
 
     async def generate(self, pre, context):
+        if self.reliable is not None:
+            async for frame in self.reliable.generate(pre, context):
+                yield frame
+            return
         instance = None
         if self.router is not None:
             try:
@@ -292,9 +312,12 @@ class RemotePipeline(Pipeline):
     """
 
     def __init__(self, card: ModelDeploymentCard, client,
-                 router=None, policy: str = "round_robin"):
+                 router=None, policy: str = "round_robin",
+                 reliability=None):
         super().__init__(card)
         self.client = client
         self.router = router
         self.policy = policy
-        self.segment.link(RemoteEngineSink(client, router, policy).generate)
+        self.sink = RemoteEngineSink(client, router, policy,
+                                     reliability=reliability)
+        self.segment.link(self.sink.generate)

@@ -33,6 +33,12 @@ _PROFILE_OWNER = None
 
 def _to_engine_request(pre: PreprocessedRequest) -> EngineRequest:
     s, st, out = pre.sampling, pre.stop, pre.output
+    # resume-from-prefix (mid-stream migration): token_ids already carries
+    # prompt + committed tokens; the whole sequence re-prefills and decode
+    # continues from there, so the committed tokens are charged against
+    # the ORIGINAL stop budgets here. max(1, ...) is dead-man's defense —
+    # the reliability layer never dispatches an exhausted budget.
+    resume = pre.resume_committed or 0
     mm_pixels = None
     mm_spans = None
     if pre.mm_parts:
@@ -55,14 +61,14 @@ def _to_engine_request(pre: PreprocessedRequest) -> EngineRequest:
         mm_pixels=mm_pixels,
         mm_spans=mm_spans,
         params=SamplingParams(
-            max_tokens=st.max_tokens or 16,
+            max_tokens=max(1, (st.max_tokens or 16) - resume),
             temperature=s.temperature if s.temperature is not None else 0.0,
             top_k=s.top_k or 0,
             top_p=s.top_p if s.top_p is not None else 1.0,
             seed=s.seed or 0,
             ignore_eos=st.ignore_eos,
             stop_token_ids=tuple(st.stop_token_ids_hidden or ()),
-            min_tokens=st.min_tokens or 0,
+            min_tokens=max(0, (st.min_tokens or 0) - resume),
             repetition_penalty=s.repetition_penalty or 1.0,
             logprobs=out.logprobs,
         ))
@@ -80,9 +86,16 @@ class EchoTokenEngine(AsyncEngine):
 
     async def generate(self, request, context: Context):
         pre = PreprocessedRequest.model_validate(request)
-        n = pre.stop.max_tokens or len(pre.token_ids)
-        emitted = 0
-        for tok in pre.token_ids:
+        # resume-from-prefix: token_ids = original prompt + the committed
+        # tokens a dead worker already streamed; for echo those committed
+        # tokens are the prompt's own head, so the continuation restarts
+        # mid-prompt and the budget charges what was already emitted
+        resume = pre.resume_committed or 0
+        prompt = pre.token_ids[:len(pre.token_ids) - resume] if resume \
+            else pre.token_ids
+        n = pre.stop.max_tokens or len(prompt)
+        emitted = resume
+        for tok in prompt[resume:]:
             if emitted >= n or context.is_stopped:
                 break
             if self.delay_s:
@@ -188,8 +201,13 @@ class NativeEngineWorker(AsyncEngine):
             except (ValueError, MemoryError) as e:
                 q = self._queues.get(req.request_id)
                 if q is not None:
-                    q.put_nowait(EngineOutput(finish_reason=FinishReason.ERROR,
-                                              text=str(e)))
+                    # ValueError = deterministic request rejection (OOV id,
+                    # over max_model_len): not retryable elsewhere.
+                    # MemoryError = THIS worker is out of capacity: another
+                    # instance may well take it.
+                    q.put_nowait(EngineOutput(
+                        finish_reason=FinishReason.ERROR, text=str(e),
+                        retryable=isinstance(e, MemoryError)))
         aborts, self._pending_aborts = self._pending_aborts, []
         for rid in aborts:
             self.engine.abort(rid)
@@ -213,7 +231,7 @@ class NativeEngineWorker(AsyncEngine):
                 log.exception("engine step failed; failing active requests")
                 for q in self._queues.values():
                     q.put_nowait(EngineOutput(
-                        finish_reason=FinishReason.ERROR))
+                        finish_reason=FinishReason.ERROR, retryable=True))
                 self._queues.clear()
                 # requests staged during the failing step have no consumer
                 # anymore — drop them so they never occupy an engine slot
@@ -280,6 +298,17 @@ class NativeEngineWorker(AsyncEngine):
 
     async def generate(self, request, context: Context):
         pre = PreprocessedRequest.model_validate(request)
+        if pre.request_id in self._queues:
+            # a second dispatch of a live id would CLOBBER the first
+            # stream's frame queue (plain dict assignment in _register),
+            # starving it — reject before touching the registry. The
+            # engine's admission guard (scheduler._admit) is the backstop;
+            # this keeps the first stream intact too.
+            yield EngineOutput(
+                finish_reason=FinishReason.ERROR, retryable=False,
+                text=f"request {pre.request_id} already in flight on this "
+                     "worker").model_dump(exclude_none=True)
+            return
         q = self._register(pre.request_id)
         try:
             self._pending_adds.append(_to_engine_request(pre))

@@ -83,6 +83,15 @@ class MetricsExporter:
         self.g_router_hit = r.gauge(
             f"{PREFIX}_router_kv_hit_rate",
             "ISL-weighted router overlap rate (kv-hit-rate events)")
+        # reliability layer counters (frontend/reliability.py), published
+        # as snapshots on "{ns}.{component}.reliability" by each frontend;
+        # gauges mirror the source's counters, labeled by publisher
+        from dynamo_tpu.frontend.reliability import ReliabilityMetrics
+        self.g_reliability = {
+            name: r.gauge(f"{PREFIX}_reliability_{name}",
+                          f"reliability layer: cumulative {name} "
+                          "at the publishing frontend", ("source",))
+            for name in ReliabilityMetrics.FIELDS}
         self._client = None
         self._aggregator: Optional[KvMetricsAggregator] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -153,8 +162,20 @@ class MetricsExporter:
 
     async def _consume_hit_rate(self, sub) -> None:
         import msgpack
+
+        from dynamo_tpu.frontend.reliability import RELIABILITY_SUBJECT
         try:
             async for subject, payload in sub:
+                if subject.endswith("." + RELIABILITY_SUBJECT):
+                    # "{ns}.{source}.reliability": counter snapshot from a
+                    # frontend's reliability layer
+                    snap = msgpack.unpackb(payload, raw=False)
+                    source = subject.split(".")[-2] if subject.count(".") \
+                        >= 2 else "unknown"
+                    for name, gauge in self.g_reliability.items():
+                        if name in snap:
+                            gauge.set(source, value=float(snap[name]))
+                    continue
                 if not subject.endswith("." + KV_HIT_RATE_SUBJECT):
                     continue
                 payload = msgpack.unpackb(payload, raw=False)

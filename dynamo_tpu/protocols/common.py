@@ -90,6 +90,15 @@ class PreprocessedRequest(pydantic.BaseModel):
     annotations: List[str] = []
     # multimodal: images to mix into the prefill at placeholder positions
     mm_parts: Optional[List[ImagePart]] = None
+    # mid-stream migration (frontend/reliability.py): token_ids carries the
+    # original prompt PLUS the last `resume_committed` tokens already
+    # streamed to the client by a previous (dead) worker. The receiving
+    # engine re-prefills the whole sequence and continues decoding; its
+    # stop budgets (max_tokens/min_tokens) are interpreted as the ORIGINAL
+    # request's, so the worker charges the committed tokens against them
+    # (llm/worker._to_engine_request). Greedy continuations are
+    # token-identical to an uninterrupted run (tests/test_chaos.py).
+    resume_committed: int = 0
 
 
 class EngineOutput(pydantic.BaseModel):
@@ -107,3 +116,9 @@ class EngineOutput(pydantic.BaseModel):
     # per token: the top-k alternatives as [token_id, logprob] pairs
     top_logprobs: Optional[List[List[List[float]]]] = None
     finish_reason: Optional[FinishReason] = None
+    # ERROR frames only — False: deterministic per-REQUEST rejection
+    # (admission/validation); re-dispatching elsewhere fails identically,
+    # so the reliability layer forwards it instead of retrying. True/None:
+    # instance-scoped failure (engine died, out of capacity); retryable on
+    # another worker.
+    retryable: Optional[bool] = None

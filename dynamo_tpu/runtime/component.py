@@ -19,9 +19,15 @@ from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 import msgpack
 
 from dynamo_tpu.runtime import dataplane
+from dynamo_tpu.runtime.deadline import with_deadline
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, FnEngine
 
 log = logging.getLogger("dynamo_tpu.component")
+
+# upper bound on waiting for a dispatch ack (the request-plane round trip;
+# response frames ride the data plane with their own inactivity handling) —
+# tightened further by the request Context's deadline when one is armed
+DISPATCH_ACK_TIMEOUT_S = 30.0
 
 
 def instance_key(ns: str, comp: str, endpoint: str, worker_id: str) -> str:
@@ -141,6 +147,9 @@ class Endpoint:
         async def handle(payload: bytes) -> bytes:
             env = msgpack.unpackb(payload, raw=False)
             ctx = Context(env.get("request_id"), env.get("baggage") or {})
+            # deadlines cross the wire as remaining seconds (clocks differ)
+            if env.get("deadline_s") is not None:
+                ctx.set_deadline(float(env["deadline_s"]))
             try:
                 reader_writer = await dataplane.call_home(
                     env["connection_info"], env["stream_id"], ctx)
@@ -307,10 +316,15 @@ class Client:
             "payload": msgpack.packb(request),
             "connection_info": server.connection_info,
             "stream_id": stream.stream_id,
+            "deadline_s": ctx.time_remaining(),
         })
         try:
             ack = msgpack.unpackb(
-                await self._rt.messaging.request(subject, envelope), raw=False)
+                await with_deadline(
+                    self._rt.messaging.request(
+                        subject, envelope, timeout=DISPATCH_ACK_TIMEOUT_S),
+                    DISPATCH_ACK_TIMEOUT_S, ctx),
+                raw=False)
         except Exception:
             server.unregister(stream.stream_id)
             raise
@@ -349,7 +363,8 @@ class Client:
         async def one(worker_id: str):
             subject = f"$STATS.{self.endpoint.subject_for(worker_id)}"
             try:
-                raw = await self._rt.messaging.request(subject, b"", timeout)
+                raw = await self._rt.messaging.request(subject, b"",
+                                                       timeout=timeout)
                 return worker_id, msgpack.unpackb(raw, raw=False)
             except Exception:
                 return worker_id, None

@@ -40,6 +40,9 @@ _END = object()
 # VERDICT r2 weak #8).
 KEEPALIVE_INTERVAL_S = 15.0
 INACTIVITY_TIMEOUT_S = 60.0
+# call-home dial bound: a requester that vanished between dispatch and
+# dial-back must cost seconds, not the OS connect timeout's minutes
+CONNECT_TIMEOUT_S = 10.0
 
 
 def _uds_enabled() -> bool:
@@ -221,12 +224,15 @@ async def call_home(
     uds = connection_info.get("uds")
     if uds and os.path.exists(uds) and _uds_enabled():
         try:
-            reader, writer = await asyncio.open_unix_connection(uds)
-        except (OSError, NotImplementedError):
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_unix_connection(uds), CONNECT_TIMEOUT_S)
+        except (OSError, NotImplementedError, asyncio.TimeoutError):
             reader = writer = None
     if reader is None:
-        reader, writer = await asyncio.open_connection(
-            connection_info["host"], int(connection_info["port"]))
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(
+                connection_info["host"], int(connection_info["port"])),
+            CONNECT_TIMEOUT_S)
     write_frame(writer, {"stream_id": stream_id})
     await writer.drain()
     ack = await read_frame(reader)

@@ -9,33 +9,50 @@ from __future__ import annotations
 
 import abc
 import asyncio
+import time
 import uuid
+import weakref
 from typing import Any, AsyncIterator, Dict, Optional
 
 
 class Context:
-    """Request envelope: id, typed baggage, cooperative stop/kill signals.
+    """Request envelope: id, typed baggage, cooperative stop/kill signals,
+    and an optional end-to-end deadline.
 
     stop = "finish the current response gracefully and end the stream";
     kill = "abandon immediately" — the same split as the reference's
     AsyncEngineContext stop_generating/kill (reference:
     lib/runtime/src/engine.rs:47-85).
+
+    The deadline is an absolute time.monotonic() instant; it crosses
+    process boundaries as *remaining seconds* (component.Client.generate
+    ships `deadline_s`, the serving side rebuilds a local absolute
+    deadline), so clocks never need to agree.
     """
 
     def __init__(self, request_id: Optional[str] = None,
-                 baggage: Optional[Dict[str, Any]] = None):
+                 baggage: Optional[Dict[str, Any]] = None,
+                 deadline_s: Optional[float] = None):
         self.id = request_id or uuid.uuid4().hex
         self.baggage: Dict[str, Any] = dict(baggage or {})
         self._stopped = asyncio.Event()
         self._killed = asyncio.Event()
+        self._deadline: Optional[float] = None
+        self._children: "weakref.WeakSet[Context]" = weakref.WeakSet()
+        if deadline_s is not None:
+            self.set_deadline(deadline_s)
 
     # -- control -------------------------------------------------------------
     def stop_generating(self) -> None:
         self._stopped.set()
+        for c in list(self._children):
+            c.stop_generating()
 
     def kill(self) -> None:
         self._killed.set()
         self._stopped.set()
+        for c in list(self._children):
+            c.kill()
 
     @property
     def is_stopped(self) -> bool:
@@ -48,13 +65,40 @@ class Context:
     async def wait_stopped(self) -> None:
         await self._stopped.wait()
 
+    # -- deadline ------------------------------------------------------------
+    def set_deadline(self, timeout_s: float) -> None:
+        """Arm (or tighten) the end-to-end deadline: timeout_s from now."""
+        dl = time.monotonic() + timeout_s
+        if self._deadline is None or dl < self._deadline:
+            self._deadline = dl
+
+    @property
+    def deadline(self) -> Optional[float]:
+        """Absolute time.monotonic() deadline, or None when unbounded."""
+        return self._deadline
+
+    def time_remaining(self) -> Optional[float]:
+        """Seconds left before the deadline (>= 0), None when unbounded."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    @property
+    def deadline_expired(self) -> bool:
+        return self._deadline is not None \
+            and time.monotonic() >= self._deadline
+
     def child(self) -> "Context":
-        """Same id + baggage, linked cancellation (parent stop cascades)."""
+        """Same id + baggage + deadline, linked cancellation: a parent
+        stop/kill cascades into every live child (children are held
+        weakly, so an abandoned child never leaks)."""
         c = Context(self.id, self.baggage)
+        c._deadline = self._deadline
         if self.is_stopped:
             c._stopped.set()
         if self.is_killed:
             c._killed.set()
+        self._children.add(c)
         return c
 
 

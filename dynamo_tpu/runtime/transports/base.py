@@ -110,6 +110,28 @@ class Messaging(abc.ABC):
     @abc.abstractmethod
     async def queue_depth(self, queue: str) -> int: ...
 
+    # -- leased consumption (JetStream ack/redelivery semantics) --------------
+    # Default implementations degrade to plain pop with a no-op ack, so a
+    # Messaging backend without lease support still serves consumers that
+    # speak the leased protocol — they just lose redelivery on crash.
+
+    async def queue_pop_leased(
+            self, queue: str, timeout: Optional[float] = None,
+            lease_s: float = 30.0) -> Optional[Tuple[bytes, str]]:
+        """Pop one item under a redelivery lease.
+
+        Returns (payload, lease_token) or None on timeout. An item popped
+        but not queue_ack'ed within lease_s is re-enqueued (the consumer
+        died mid-item — reference: JetStream ack-wait redelivery), up to a
+        backend-defined redelivery cap, after which it is dropped and
+        logged (poison-message protection)."""
+        payload = await self.queue_pop(queue, timeout=timeout)
+        return None if payload is None else (payload, "")
+
+    async def queue_ack(self, queue: str, token: str) -> None:
+        """Settle a leased item: it is done (or terminally failed) and must
+        not be redelivered."""
+
 
 def subject_matches(pattern: str, subject: str) -> bool:
     """NATS-style: '>' matches any suffix."""

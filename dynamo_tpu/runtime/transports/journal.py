@@ -108,7 +108,7 @@ class DurableMessaging(MemoryMessaging):
             await asyncio.wrap_future(fut)
 
     async def queue_pop(self, queue, timeout=None):
-        item = await super().queue_pop(queue, timeout)
+        item = await super().queue_pop(queue, timeout=timeout)
         if item is not None:
             # logged post-hoc: replay drops one head per qpop, so only the
             # surviving-queue *contents* must match, which FIFO guarantees

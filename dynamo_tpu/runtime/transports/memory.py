@@ -9,13 +9,17 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 import time
+import uuid
 from collections import defaultdict
 from typing import AsyncIterator, Dict, List, Optional, Tuple
 
 from dynamo_tpu.runtime.transports.base import (
     KVEntry, KVStore, Lease, Messaging, WatchEvent, subject_matches,
 )
+
+log = logging.getLogger("dynamo_tpu.memory_plane")
 
 
 class LatencyModel:
@@ -142,11 +146,20 @@ class MemoryKVStore(KVStore):
 
 
 class MemoryMessaging(Messaging):
+    # redeliveries per item before it is dropped as poison
+    MAX_REDELIVERIES = 5
+
     def __init__(self, latency: Optional[LatencyModel] = None):
         self._handlers: Dict[str, callable] = {}
         self._subs: List[Tuple[str, asyncio.Queue]] = []
         self._queues: Dict[str, asyncio.Queue] = defaultdict(asyncio.Queue)
         self._latency = latency or LatencyModel()
+        # lease token -> (queue, payload, expiry_monotonic, prior_deliveries)
+        self._leased: Dict[str, Tuple[str, bytes, float, int]] = {}
+        # (queue, payload) -> redeliveries so far; survives pop/lease cycles
+        # (the token is fresh per delivery) so poison items can't loop
+        self._delivery_counts: Dict[Tuple[str, bytes], int] = {}
+        self.redeliveries = 0  # observability: total re-enqueues
 
     async def serve(self, subject, handler):
         self._handlers[subject] = handler
@@ -190,6 +203,7 @@ class MemoryMessaging(Messaging):
         self._queues[queue].put_nowait(payload)
 
     async def queue_pop(self, queue, timeout=None):
+        await self._sweep_leases()
         try:
             if timeout is None:
                 return await self._queues[queue].get()
@@ -198,7 +212,57 @@ class MemoryMessaging(Messaging):
             return None
 
     async def queue_depth(self, queue):
+        await self._sweep_leases()
         return self._queues[queue].qsize()
+
+    # -- leases: dequeued-but-unacked items become visible again --------------
+    # No background task: expiry is swept on every queue touch, which the
+    # polling consumers (disagg PrefillWorker dequeue loop, disagg router
+    # depth probes) provide; worst-case redelivery latency is one lease
+    # window plus one consumer poll interval.
+
+    async def _sweep_leases(self) -> None:
+        if not self._leased:
+            return
+        now = time.monotonic()
+        expired = [t for t, (_q, _p, dl, _n) in self._leased.items()
+                   if dl <= now]
+        for token in expired:
+            queue, payload, _dl, n = self._leased.pop(token)
+            if n + 1 > self.MAX_REDELIVERIES:
+                log.error("queue %s: item dropped after %d redeliveries "
+                          "(poison)", queue, n)
+                self._delivery_counts.pop((queue, payload), None)
+                continue
+            self._delivery_counts[(queue, payload)] = n + 1
+            self.redeliveries += 1
+            log.warning("queue %s: lease expired, redelivering item "
+                        "(delivery %d)", queue, n + 2)
+            # rides the real push path so durable planes re-journal it
+            await self.queue_push(queue, payload)
+
+    async def queue_pop_leased(self, queue, timeout=None, lease_s=30.0):
+        if timeout is None:
+            # bounded slices instead of one unbounded get(): each slice
+            # re-runs the lease sweep, so a lone blocked consumer still
+            # sees items whose lease expired while it was waiting
+            payload = None
+            while payload is None:
+                payload = await self.queue_pop(queue, timeout=1.0)
+        else:
+            payload = await self.queue_pop(queue, timeout=timeout)
+        if payload is None:
+            return None
+        token = uuid.uuid4().hex
+        self._leased[token] = (queue, payload,
+                               time.monotonic() + lease_s,
+                               self._delivery_counts.get((queue, payload), 0))
+        return payload, token
+
+    async def queue_ack(self, queue, token):
+        item = self._leased.pop(token, None)
+        if item is not None:
+            self._delivery_counts.pop((item[0], item[1]), None)
 
 
 class MemoryPlane:

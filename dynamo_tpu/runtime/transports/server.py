@@ -239,8 +239,20 @@ class _Conn:
 
     async def _op_queue_pop(self, m):
         payload = await self.server.plane.messaging.queue_pop(
-            m["queue"], m.get("timeout"))
+            m["queue"], timeout=m.get("timeout"))
         return {"payload": payload}
+
+    async def _op_queue_pop_leased(self, m):
+        got = await self.server.plane.messaging.queue_pop_leased(
+            m["queue"], timeout=m.get("timeout"),
+            lease_s=m.get("lease_s") or 30.0)
+        if got is None:
+            return {"payload": None, "token": None}
+        return {"payload": got[0], "token": got[1]}
+
+    async def _op_queue_ack(self, m):
+        await self.server.plane.messaging.queue_ack(m["queue"], m["token"])
+        return {}
 
     async def _op_queue_depth(self, m):
         return {"depth": await self.server.plane.messaging.queue_depth(m["queue"])}
@@ -478,8 +490,9 @@ class ControlPlaneServer:
         host, port = self.standby_of
         while self.role == "standby":
             try:
-                reader, writer = await asyncio.open_connection(host, port)
-            except OSError:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, port), 5.0)
+            except (OSError, asyncio.TimeoutError):
                 await asyncio.sleep(0.5)
                 continue
             try:

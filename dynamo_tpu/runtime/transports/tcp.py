@@ -290,5 +290,17 @@ class ControlPlaneClient(KVStore, Messaging):
                                  "timeout": timeout}, timeout=rpc_timeout)
         return reply["payload"]
 
+    async def queue_pop_leased(self, queue, timeout=None, lease_s=30.0):
+        rpc_timeout = (timeout + 5) if timeout is not None else 3600.0
+        reply = await self._rpc(
+            {"op": "queue_pop_leased", "queue": queue, "timeout": timeout,
+             "lease_s": lease_s}, timeout=rpc_timeout)
+        if reply.get("payload") is None:
+            return None
+        return reply["payload"], reply["token"]
+
+    async def queue_ack(self, queue, token):
+        await self._rpc({"op": "queue_ack", "queue": queue, "token": token})
+
     async def queue_depth(self, queue):
         return (await self._rpc({"op": "queue_depth", "queue": queue}))["depth"]

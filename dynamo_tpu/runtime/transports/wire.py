@@ -45,6 +45,9 @@ async def oneshot_request(host: str, port: int, msg: Dict[str, Any],
     returns (reply, reader, writer) for the caller to adopt as a live
     connection; otherwise closes and returns the reply alone."""
     async def _go():
+        # the whole _go() body (connect included) runs under the single
+        # wait_for(timeout) below
+        # dynalint: disable-next-line=R7
         reader, writer = await asyncio.open_connection(host, port)
         try:
             write_frame(writer, {"id": 1, **msg})

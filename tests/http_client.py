@@ -27,8 +27,9 @@ def _request_bytes(method: str, path: str, host: str,
 
 
 async def request(host: str, port: int, method: str, path: str,
-                  body=None) -> Tuple[int, bytes]:
-    """Unary request; returns (status, full body bytes)."""
+                  body=None, return_headers: bool = False):
+    """Unary request; returns (status, full body bytes) — or
+    (status, body, headers) with return_headers=True."""
     if isinstance(body, (dict, list)):
         body = json.dumps(body).encode()
     reader, writer = await asyncio.open_connection(host, port)
@@ -46,9 +47,11 @@ async def request(host: str, port: int, method: str, path: str,
                     break
                 out += await reader.readexactly(size)
                 await reader.readexactly(2)
-            return status, out
+            return (status, out, headers) if return_headers \
+                else (status, out)
         length = int(headers.get("content-length", "0"))
-        return status, (await reader.readexactly(length) if length else b"")
+        out = await reader.readexactly(length) if length else b""
+        return (status, out, headers) if return_headers else (status, out)
     finally:
         writer.close()
         try:

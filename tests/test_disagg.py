@@ -287,6 +287,164 @@ def test_disagg_remote_first_token_hidden_stop_not_emitted():
     assert reason == "stop"
 
 
+def test_disagg_client_abort_cancels_remote_prefill():
+    """Client disconnect while the remote prefill is queued/running must
+    cancel BOTH sides: the decode stream ends CANCELLED and releases its
+    up-front allocation, and the prefill fleet drops the item — whether it
+    is still queued (skip on dequeue) or mid-run (abort) — without ever
+    transferring or redelivering it."""
+    from dynamo_tpu.engine.kv_cache import PageAllocator  # noqa: F401
+
+    prompt = list(range(100, 120))
+
+    class GatedTransfer(LocalTransferBackend):
+        def __init__(self):
+            super().__init__()
+            self.gate = asyncio.Event()
+            self.sent = []
+
+        async def send_pages(self, engine_id, request_id, *a, **k):
+            await self.gate.wait()
+            self.sent.append(request_id)
+            await super().send_pages(engine_id, request_id, *a, **k)
+
+    async def main():
+        plane = MemoryPlane()
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        router = DisaggregatedRouter(max_local_prefill_length=4,
+                                     max_prefill_queue_size=16)
+        decode = DisaggDecodeWorker(
+            make_engine(), plane.messaging, router, queue,
+            worker_id="dec-0", prefill_timeout_s=30.0)
+        transfer = GatedTransfer()
+        transfer.register("dec-0", decode)
+        # one handler slot: item A occupies it mid-run, item B stays queued
+        prefill = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue, transfer,
+            plane.messaging, dequeue_timeout_s=0.1, max_inflight=1,
+            lease_s=30.0)
+        await decode.start()
+        await prefill.start()
+
+        async def drive(rid, ctx):
+            toks, reason = [], None
+            async for frame in decode.generate(
+                    pre_request(rid, prompt).model_dump(exclude_none=True),
+                    ctx):
+                toks.extend(frame.get("token_ids", ()))
+                if frame.get("finish_reason") not in (None, "prefill_done"):
+                    reason = frame["finish_reason"]
+            return toks, reason
+
+        ctx_a, ctx_b = Context("abortA"), Context("abortB")
+        task_a = asyncio.create_task(drive("abortA", ctx_a))
+        # A is being handled (held at the transfer gate) before B arrives
+        deadline = asyncio.get_event_loop().time() + 20
+        while "abortA" not in prefill._handling:
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        task_b = asyncio.create_task(drive("abortB", ctx_b))
+        while await queue.depth() < 1:    # B parked in the queue
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.02)
+
+        # both clients disconnect
+        ctx_a.stop_generating()
+        ctx_b.stop_generating()
+        (toks_a, reason_a), (toks_b, reason_b) = await asyncio.wait_for(
+            asyncio.gather(task_a, task_b), 30)
+        assert (toks_a, reason_a) == ([], "cancelled")
+        assert (toks_b, reason_b) == ([], "cancelled")
+
+        # mid-run item A was aborted at the gate; open it and give the
+        # worker time — the transfer must never happen, and queued item B
+        # must be skipped on dequeue, not run
+        transfer.gate.set()
+        for _ in range(100):
+            if prefill.cancelled >= 2:
+                break
+            await asyncio.sleep(0.02)
+        assert prefill.cancelled == 2, prefill.cancelled
+        assert transfer.sent == []
+        assert prefill.completed == 0
+
+        # the decode side released its up-front allocations
+        def remote_state(eng):
+            return (len(eng.scheduler.remote),
+                    eng.scheduler.allocator.num_free)
+        for _ in range(100):
+            n_remote, _free = await decode.submit(remote_state)
+            if n_remote == 0:
+                break
+            await asyncio.sleep(0.02)
+        n_remote, num_free = await decode.submit(remote_state)
+        assert n_remote == 0
+        assert num_free == decode.engine.cfg.num_pages
+
+        # and nothing redelivers later (leases were settled by the cancel)
+        await asyncio.sleep(0.2)
+        assert await queue.depth() == 0
+        await prefill.stop()
+        await decode.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_disagg_prefill_worker_death_mid_item_redelivers():
+    """Satellite: a prefill worker that dies after dequeue but before
+    completion must NOT lose the item — the lease expires and a surviving
+    worker re-runs it; the decode stream completes oracle-exact."""
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+
+    class WedgedTransfer(LocalTransferBackend):
+        async def send_pages(self, *a, **k):
+            await asyncio.Event().wait()
+
+    async def main():
+        plane = MemoryPlane()
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        router = DisaggregatedRouter(max_local_prefill_length=4,
+                                     max_prefill_queue_size=16)
+        decode = DisaggDecodeWorker(
+            make_engine(), plane.messaging, router, queue,
+            worker_id="dec-0", prefill_timeout_s=60.0)
+        transfer = LocalTransferBackend()
+        transfer.register("dec-0", decode)
+        doomed = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue, WedgedTransfer(),
+            plane.messaging, dequeue_timeout_s=0.1, lease_s=0.3)
+        await decode.start()
+        await doomed.start()
+
+        task = asyncio.create_task(_drive(decode.generate(
+            pre_request("r1", prompt).model_dump(exclude_none=True),
+            Context("r1"))))
+        deadline = asyncio.get_event_loop().time() + 20
+        while "r1" not in doomed._handling:   # dequeued, wedged mid-item
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        await doomed.stop()                   # dies holding the item
+
+        survivor = await PrefillWorker(
+            NativeEngineWorker(make_engine()), queue, transfer,
+            plane.messaging, dequeue_timeout_s=0.1, lease_s=10.0).start()
+        toks, reason = await asyncio.wait_for(task, 60)
+        redelivered = plane.messaging.redeliveries
+        completed = survivor.completed
+        await survivor.stop()
+        await decode.stop()
+        return toks, reason, redelivered, completed
+
+    toks, reason, redelivered, completed = asyncio.run(
+        asyncio.wait_for(main(), 120))
+    assert redelivered >= 1
+    assert completed == 1
+    assert reason == "length"
+    assert toks == expect
+
+
 def test_disagg_prefill_failure_falls_back_local():
     """Transfer failure -> decode releases the allocation and recomputes."""
     prompt = list(range(100, 120))

@@ -305,6 +305,77 @@ def test_r6_quiet_without_marker():
     assert "R6" not in rules(lint(HOT_SRC.replace("hot-path", "")))
 
 
+# -- R7: unbounded transport awaits in serving layers -------------------------
+
+R7_SRC = """
+    import asyncio
+
+    async def dispatch(messaging, subject, payload):
+        return await messaging.request(subject, payload)
+
+    async def consume(queue):
+        return await queue.dequeue_leased()
+
+    async def dial(host, port):
+        return await asyncio.open_connection(host, port)
+"""
+
+
+def test_r7_flags_unbounded_transport_awaits_in_scope():
+    found = lint_source(textwrap.dedent(R7_SRC),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert len([f for f in found if f.rule == "R7"]) == 3
+
+
+def test_r7_quiet_outside_serving_layers():
+    # same awaits in engine/device code: exempt (bounded by computation,
+    # not by a remote peer)
+    found = lint_source(textwrap.dedent(R7_SRC),
+                        "dynamo_tpu/engine/fixture.py")
+    assert "R7" not in rules(found)
+
+
+def test_r7_quiet_on_bounded_awaits():
+    neg = """
+        import asyncio
+        from dynamo_tpu.runtime.deadline import with_deadline
+
+        async def dispatch(messaging, subject, payload, ctx):
+            return await with_deadline(
+                messaging.request(subject, payload, timeout=30.0),
+                30.0, ctx)
+
+        async def consume(queue):
+            return await queue.dequeue_leased(timeout=1.0, lease_s=30.0)
+
+        async def dial(host, port):
+            return await asyncio.wait_for(
+                asyncio.open_connection(host, port), 10.0)
+
+        async def fire_and_forget(messaging, subject, payload):
+            await messaging.publish(subject, payload)  # not a round trip
+    """
+    found = lint_source(textwrap.dedent(neg),
+                        "dynamo_tpu/disagg/fixture.py")
+    assert "R7" not in rules(found)
+
+
+def test_r7_live_on_current_serving_layers():
+    """The reliability PR must keep the serving layers R7-clean (every
+    control-plane round trip bounded)."""
+    import glob
+    scoped = []
+    for pat in ("dynamo_tpu/runtime/transports/*.py",
+                "dynamo_tpu/frontend/*.py", "dynamo_tpu/disagg/*.py"):
+        scoped.extend(glob.glob(os.path.join(REPO, pat)))
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R7"], rel
+
+
 # -- jaxpr invariants ----------------------------------------------------------
 
 def test_j1_flags_float64_leak():

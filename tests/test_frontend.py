@@ -423,6 +423,68 @@ class TestHttpService:
 
         run(main())
 
+    def test_load_shedding_429_with_retry_after(self):
+        """Admission control: past max_inflight + max_queued the service
+        sheds with 429 + Retry-After, and every ACCEPTED request still
+        completes once capacity frees up."""
+        from dynamo_tpu.frontend.reliability import AdmissionControl
+
+        class GatedEngine(CounterEngine):
+            def __init__(self):
+                super().__init__(n=1)
+                self.gate = asyncio.Event()
+                self.started = 0
+
+            async def generate_chat(self, request, context):
+                self.started += 1
+                await self.gate.wait()
+                async for c in super().generate_chat(request, context):
+                    yield c
+
+        async def main():
+            eng = GatedEngine()
+            svc = await HttpService(
+                "127.0.0.1", 0,
+                admission=AdmissionControl(max_inflight=1, max_queued=1,
+                                           queue_timeout_s=10.0,
+                                           retry_after_s=3)).start()
+            svc.models.add("m", eng)
+
+            t1 = asyncio.create_task(request(
+                "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+                CHAT_BODY))
+            for _ in range(200):   # t1 admitted and inside the engine
+                if eng.started:
+                    break
+                await asyncio.sleep(0.01)
+            t2 = asyncio.create_task(request(     # queued behind t1
+                "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+                CHAT_BODY))
+            await asyncio.sleep(0.05)
+            # queue full: this one is shed immediately
+            status, body, headers = await request(
+                "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+                CHAT_BODY, return_headers=True)
+            assert status == 429, body
+            assert headers.get("retry-after") == "3"
+            assert json.loads(body)["error"]["code"] == 429
+            assert svc.reliability.shed_requests.get() == 1
+            assert svc._requests.get("m", "chat", "unary", "shed") == 1
+
+            eng.gate.set()   # capacity frees: both accepted requests finish
+            (s1, b1), (s2, b2) = await asyncio.wait_for(
+                asyncio.gather(t1, t2), 15)
+            assert s1 == 200 and s2 == 200
+            for b in (b1, b2):
+                assert json.loads(b)["choices"][0]["message"]["content"] \
+                    == "c0 "
+            assert svc.admission.active == 0
+            # shed requests never touched inflight accounting
+            assert svc._inflight.get("m") == 0
+            await svc.stop()
+
+        run(asyncio.wait_for(main(), 30))
+
     def test_models_and_metrics_routes(self):
         async def main():
             svc = await HttpService("127.0.0.1", 0).start()

@@ -58,6 +58,19 @@ def test_exporter_two_worker_graph():
             assert "llm_load_avg 4" in body
             assert "llm_router_kv_hit_rate 0.75" in body
 
+            # reliability counter snapshots ride the event plane the same
+            # way ({ns}.{source}.reliability) and fold into gauges labeled
+            # by the publishing frontend
+            from dynamo_tpu.frontend.reliability import ReliabilityMetrics
+            rm = ReliabilityMetrics()
+            rm.migrations.inc(value=3)
+            rm.retries.inc(value=2)
+            rm.breaker_opens.inc()
+            rm.shed_requests.inc(value=5)
+            rm.stall_fires.inc()
+            await rm.publish(rts[0].namespace("ns").component("front0"))
+            await asyncio.sleep(0.2)
+
             # a worker going away drops its series
             await rts[1].shutdown()
             await asyncio.sleep(0.3)
@@ -69,6 +82,17 @@ def test_exporter_two_worker_graph():
             writer.close()
             assert 'llm_kv_blocks_active{worker="w1"}' not in body2
             assert "llm_workers 1" in body2
+            assert 'llm_reliability_migrations{source="front0"} 3' in body2
+            assert 'llm_reliability_retries{source="front0"} 2' in body2
+            assert 'llm_reliability_breaker_opens{source="front0"} 1' \
+                in body2
+            assert 'llm_reliability_breaker_closes{source="front0"} 0' \
+                in body2
+            assert 'llm_reliability_shed_requests{source="front0"} 5' \
+                in body2
+            assert 'llm_reliability_stall_fires{source="front0"} 1' in body2
+            assert 'llm_reliability_deadline_exceeded{source="front0"} 0' \
+                in body2
         finally:
             await exporter.stop()
             for rt in rts:

@@ -1,0 +1,444 @@
+"""Reliability layer unit tests (frontend/reliability.py).
+
+The chaos harness (tests/test_chaos.py) proves the end-to-end zero-drop
+property on real engines; these tests pin the mechanisms one at a time on
+fast fakes: circuit breaker state machine (no sleeps > ~1s), mid-stream
+migration exactness over echo workers, bounded dispatch retries, deadline
+propagation and enforcement, admission-control shedding, and the leased
+prefill-queue redelivery primitives.
+"""
+import asyncio
+
+import pytest
+
+from dynamo_tpu.frontend.reliability import (
+    AdmissionControl, AdmissionShed, CircuitBreaker, ReliabilityMetrics,
+    ReliabilityPolicy, ReliableClient,
+)
+from dynamo_tpu.llm.worker import EchoTokenEngine, serve_llm_worker
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def pre_request(rid, prompt, max_tokens):
+    return PreprocessedRequest(
+        request_id=rid, token_ids=prompt,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    ).model_dump(exclude_none=True)
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+def test_breaker_opens_after_n_failures_and_readmits_after_probe():
+    """Acceptance: a worker failing N consecutive dispatches is ejected;
+    successful probes re-admit it. Simulated clock — no sleeps."""
+    clock = [0.0]
+    metrics = ReliabilityMetrics()
+    br = CircuitBreaker(failure_threshold=3, cooldown_s=5.0,
+                        probe_successes=2, metrics=metrics,
+                        clock=lambda: clock[0])
+    assert br.allow("w")
+    br.record_failure("w")
+    br.record_failure("w")
+    assert br.allow("w")           # still below threshold
+    br.record_failure("w")
+    assert not br.allow("w")       # open: ejected
+    assert br.blocked() == {"w"}
+    assert metrics.breaker_opens.get() == 1
+
+    clock[0] = 4.9
+    assert not br.allow("w")       # cooldown not elapsed
+    clock[0] = 5.1
+    assert br.allow("w")           # half-open: one probe admitted
+    br.on_dispatch("w")
+    assert not br.allow("w")       # probe in flight: no pile-on
+    br.record_failure("w")         # probe failed: re-open
+    assert not br.allow("w")
+    assert metrics.breaker_opens.get() == 1  # re-open is not a new open
+
+    clock[0] = 10.2
+    assert br.allow("w")
+    br.on_dispatch("w")
+    br.record_success("w")         # probe 1/2
+    assert br.allow("w")
+    br.on_dispatch("w")
+    br.record_success("w")         # probe 2/2: closed
+    assert br.allow("w")
+    assert br.blocked() == set()
+    assert metrics.breaker_closes.get() == 1
+    # healthy instance is unaffected throughout
+    assert br.allow("other")
+
+
+def test_breaker_abandoned_probe_is_released_not_leaked():
+    """An attempt abandoned with no outcome (caller cancel, request
+    deadline) must free the half-open probe slot, or the instance stays
+    ejected forever."""
+    clock = [0.0]
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=1.0,
+                        clock=lambda: clock[0])
+    br.record_failure("w")
+    clock[0] = 1.5
+    assert br.allow("w")
+    br.on_dispatch("w")
+    assert not br.allow("w")
+    br.release_probe("w")          # abandoned, no outcome
+    assert br.allow("w")           # slot free for the next probe
+    br.on_dispatch("w")
+    br.record_success("w")
+    assert br.blocked() == set()
+
+
+def test_breaker_success_resets_failure_streak():
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=1.0)
+    for _ in range(5):
+        br.record_failure("w")
+        br.record_success("w")
+    assert br.allow("w")           # never opened: failures not consecutive
+
+
+# -- admission control (load shedding) ----------------------------------------
+
+
+def test_admission_caps_and_sheds():
+    async def main():
+        metrics = ReliabilityMetrics()
+        adm = AdmissionControl(max_inflight=1, max_queued=1,
+                               queue_timeout_s=5.0, retry_after_s=7,
+                               metrics=metrics)
+        await adm.acquire()                       # slot 1: runs
+        waiter = asyncio.create_task(adm.acquire())   # queued
+        await asyncio.sleep(0.01)
+        with pytest.raises(AdmissionShed) as exc:     # queue full: shed
+            await adm.acquire()
+        assert exc.value.retry_after_s == 7
+        assert metrics.shed_requests.get() == 1
+        adm.release()                             # slot transfers to waiter
+        await asyncio.wait_for(waiter, 1.0)
+        adm.release()
+        assert adm.active == 0
+
+    run(main())
+
+
+def test_admission_queue_timeout_sheds():
+    async def main():
+        adm = AdmissionControl(max_inflight=1, max_queued=4,
+                               queue_timeout_s=0.05)
+        await adm.acquire()
+        with pytest.raises(AdmissionShed):
+            await adm.acquire()     # waits 0.05s, never released: shed
+        adm.release()
+        assert adm.active == 0
+
+    run(main())
+
+
+# -- migration / retry over real wire (echo workers) --------------------------
+
+
+class FlakyEngine(EchoTokenEngine):
+    """Streams `hang_after` tokens then hangs forever — the shape of a
+    worker whose engine died while its transport stayed up."""
+
+    def __init__(self, hang_after=3):
+        super().__init__()
+        self.hang_after = hang_after
+
+    async def generate(self, request, context):
+        n = 0
+        async for frame in super().generate(request, context):
+            yield frame
+            n += len(frame.get("token_ids", ()))
+            if n >= self.hang_after:
+                await asyncio.Event().wait()
+
+
+async def _serving_pair(plane, flaky_after=3):
+    w1 = await DistributedRuntime.create_local(plane, "flaky")
+    await serve_llm_worker(w1, "ns", "backend", FlakyEngine(flaky_after))
+    w2 = await DistributedRuntime.create_local(plane, "good")
+    await serve_llm_worker(w2, "ns", "backend", EchoTokenEngine())
+    crt = await DistributedRuntime.create_local(plane, "cl")
+    client = crt.namespace("ns").component("backend").endpoint(
+        "generate").client()
+    await client.start()
+    await client.wait_for_instances()
+    return [w1, w2, crt], client
+
+
+def test_mid_stream_migration_no_dup_no_gap():
+    """A stream stalling mid-flight resumes on the other instance with the
+    committed prefix: the client sees every token exactly once."""
+    async def main():
+        rts, client = await _serving_pair(MemoryPlane())
+        metrics = ReliabilityMetrics()
+        rel = ReliableClient(
+            client,
+            ReliabilityPolicy(stall_timeout_s=0.2, max_attempts=6,
+                              backoff_base_s=0.01),
+            breaker=CircuitBreaker(failure_threshold=1, cooldown_s=30.0,
+                                   metrics=metrics),
+            metrics=metrics)
+        prompt = list(range(10, 22))
+        try:
+            for i in range(4):   # round robin is forced through both
+                toks, finishes = [], []
+                async for frame in rel.generate(
+                        pre_request(f"m{i}", prompt, 12), Context(f"m{i}")):
+                    toks.extend(frame.get("token_ids", ()))
+                    if frame.get("finish_reason"):
+                        finishes.append(frame["finish_reason"])
+                assert toks == prompt, (i, toks)
+                assert finishes == ["length"], finishes
+        finally:
+            for rt in rts:
+                await rt.shutdown()
+        return metrics.snapshot()
+
+    snap = run(main())
+    assert snap["migrations"] >= 1
+    assert snap["stall_fires"] >= 1
+    assert snap["breaker_opens"] == 1   # flaky ejected after first stall
+
+
+def test_dispatch_retry_exhaustion_yields_error_frame():
+    """With no serving instance, the layer retries with backoff and ends
+    the stream with an ERROR frame — never an exception."""
+    async def main():
+        plane = MemoryPlane()
+        wrt = await DistributedRuntime.create_local(plane, "w")
+        served = await serve_llm_worker(wrt, "ns", "backend",
+                                        EchoTokenEngine())
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("backend").endpoint(
+            "generate").client()
+        await client.start()
+        await client.wait_for_instances()
+        await served.shutdown()   # gone before the first dispatch
+        metrics = ReliabilityMetrics()
+        rel = ReliableClient(
+            client, ReliabilityPolicy(max_attempts=3, backoff_base_s=0.01,
+                                      dispatch_timeout_s=0.5),
+            metrics=metrics)
+        frames = []
+        async for frame in rel.generate(
+                pre_request("x", [1, 2, 3], 3), Context("x")):
+            frames.append(frame)
+        await crt.shutdown()
+        await wrt.shutdown()
+        return frames, metrics.snapshot()
+
+    frames, snap = run(main())
+    assert len(frames) == 1
+    assert frames[0]["finish_reason"] == "error"
+    assert snap["retries"] == 2   # attempts 2 and 3
+
+
+def test_request_scoped_error_forwarded_not_retried():
+    """A deterministic per-request rejection (ERROR frame with
+    retryable=False, e.g. OOV prompt at engine admission) must be
+    forwarded once — no retries, and no breaker damage to the healthy
+    worker that correctly rejected it."""
+    from dynamo_tpu.protocols.common import EngineOutput, FinishReason
+    from dynamo_tpu.runtime.engine import FnEngine
+
+    calls = {"n": 0}
+
+    async def rejecting(request, context):
+        calls["n"] += 1
+        yield EngineOutput(finish_reason=FinishReason.ERROR, retryable=False,
+                           text="token id 999 outside the model vocab"
+                           ).model_dump(exclude_none=True)
+
+    async def main():
+        plane = MemoryPlane()
+        wrt = await DistributedRuntime.create_local(plane, "w")
+        await serve_llm_worker(wrt, "ns", "backend", FnEngine(rejecting))
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("backend").endpoint(
+            "generate").client()
+        await client.start()
+        await client.wait_for_instances()
+        metrics = ReliabilityMetrics()
+        breaker = CircuitBreaker(failure_threshold=1, metrics=metrics)
+        rel = ReliableClient(client,
+                             ReliabilityPolicy(backoff_base_s=0.01),
+                             breaker=breaker, metrics=metrics)
+        frames = [f async for f in rel.generate(
+            pre_request("oov", [1, 2, 3], 3), Context("oov"))]
+        await crt.shutdown()
+        await wrt.shutdown()
+        return frames, breaker.blocked(), metrics.snapshot()
+
+    frames, blocked, snap = run(main())
+    assert calls["n"] == 1                      # exactly one dispatch
+    assert frames[-1]["finish_reason"] == "error"
+    assert "vocab" in frames[-1]["text"]
+    assert blocked == set()                     # worker not ejected
+    assert snap["retries"] == 0 and snap["migrations"] == 0
+
+
+def test_duplicate_in_flight_id_rejected_without_clobbering():
+    """A second dispatch of a live request id is rejected with a
+    non-retryable ERROR frame and the FIRST stream keeps its frames."""
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.llm.worker import NativeEngineWorker
+
+    async def main():
+        engine = NativeEngine(
+            ModelConfig(dtype="float32", max_model_len=512),
+            EngineConfig(page_size=8, num_pages=64, max_slots=4,
+                         max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+                         max_model_len=512), seed=0)
+        worker = await NativeEngineWorker(engine).start()
+        try:
+            req = pre_request("dup", list(range(10, 26)), 4)
+            first_toks, dup_frames = [], []
+
+            async def first():
+                async for f in worker.generate(req, Context("dup")):
+                    first_toks.extend(f.get("token_ids", ()))
+                    if f.get("finish_reason"):
+                        return f["finish_reason"]
+
+            t = asyncio.create_task(first())
+            await asyncio.sleep(0.05)   # first stream is live
+            async for f in worker.generate(req, Context("dup2")):
+                dup_frames.append(f)
+            reason = await asyncio.wait_for(t, 60)
+        finally:
+            await worker.stop()
+        return first_toks, reason, dup_frames
+
+    first_toks, reason, dup_frames = run(main())
+    assert reason == "length" and len(first_toks) == 4   # survived intact
+    assert dup_frames[-1]["finish_reason"] == "error"
+    assert dup_frames[-1]["retryable"] is False
+    assert "already in flight" in dup_frames[-1]["text"]
+
+
+def test_deadline_propagates_and_fails_cleanly():
+    """An armed Context deadline bounds the whole request: a wedged worker
+    turns into an ERROR frame once the budget is spent, and the deadline
+    crosses the wire to the worker's Context."""
+    seen = {}
+
+    class WedgedEngine(EchoTokenEngine):
+        async def generate(self, request, context):
+            seen["remaining"] = context.time_remaining()
+            await asyncio.Event().wait()
+            yield  # pragma: no cover
+
+    async def main():
+        plane = MemoryPlane()
+        wrt = await DistributedRuntime.create_local(plane, "w")
+        await serve_llm_worker(wrt, "ns", "backend", WedgedEngine())
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("backend").endpoint(
+            "generate").client()
+        await client.start()
+        await client.wait_for_instances()
+        metrics = ReliabilityMetrics()
+        rel = ReliableClient(
+            client, ReliabilityPolicy(stall_timeout_s=10.0,
+                                      request_deadline_s=0.4,
+                                      backoff_base_s=0.01),
+            metrics=metrics)
+        ctx = Context("d1")
+        frames = []
+        t0 = asyncio.get_event_loop().time()
+        async for frame in rel.generate(pre_request("d1", [1, 2, 3], 3),
+                                        ctx):
+            frames.append(frame)
+        elapsed = asyncio.get_event_loop().time() - t0
+        await crt.shutdown()
+        await wrt.shutdown()
+        return frames, elapsed, metrics.snapshot()
+
+    frames, elapsed, snap = run(main())
+    assert frames[-1]["finish_reason"] == "error"
+    assert "deadline" in frames[-1]["text"]
+    assert elapsed < 5.0          # the 10s stall timeout did NOT govern
+    assert snap["deadline_exceeded"] == 1
+    # the worker-side Context carried the (remaining) deadline
+    assert seen["remaining"] is not None and 0 < seen["remaining"] <= 0.4
+
+
+def test_caller_abort_mid_migration_stays_cancelled():
+    """A client abort during a stall/migration window ends the stream with
+    CANCELLED, not with a retry storm."""
+    async def main():
+        rts, client = await _serving_pair(MemoryPlane(), flaky_after=2)
+        rel = ReliableClient(
+            client,
+            ReliabilityPolicy(stall_timeout_s=0.3, max_attempts=10,
+                              backoff_base_s=0.2),
+            breaker=CircuitBreaker(failure_threshold=1, cooldown_s=30.0))
+        ctx = Context("a1")
+        prompt = list(range(5, 17))
+        toks, finishes = [], []
+        try:
+            async for frame in rel.generate(
+                    pre_request("a1", prompt, 12), ctx):
+                toks.extend(frame.get("token_ids", ()))
+                if frame.get("finish_reason"):
+                    finishes.append(frame["finish_reason"])
+                if len(toks) == 2:
+                    ctx.stop_generating()
+        finally:
+            for rt in rts:
+                await rt.shutdown()
+        return toks, finishes
+
+    toks, finishes = run(main())
+    assert toks[:2] == [5, 6]
+    assert finishes[-1] == "cancelled"
+
+
+# -- leased work queue (durability primitive) ---------------------------------
+
+
+def test_queue_lease_redelivery_and_ack():
+    async def main():
+        plane = MemoryPlane()
+        mq = plane.messaging
+        await mq.queue_push("q", b"item")
+        got = await mq.queue_pop_leased("q", timeout=0.2, lease_s=0.1)
+        assert got is not None and got[0] == b"item"
+        assert await mq.queue_depth("q") == 0
+        # lease expires unacked -> redelivered
+        await asyncio.sleep(0.15)
+        got2 = await mq.queue_pop_leased("q", timeout=1.0, lease_s=5.0)
+        assert got2 is not None and got2[0] == b"item"
+        assert mq.redeliveries == 1
+        # ack settles it for good
+        await mq.queue_ack("q", got2[1])
+        await asyncio.sleep(0.02)
+        assert await mq.queue_pop_leased("q", timeout=0.05) is None
+
+    run(main())
+
+
+def test_queue_poison_item_dropped_after_max_redeliveries():
+    async def main():
+        plane = MemoryPlane()
+        mq = plane.messaging
+        mq.MAX_REDELIVERIES = 2
+        await mq.queue_push("q", b"poison")
+        for _ in range(3):   # initial delivery + 2 redeliveries
+            got = await mq.queue_pop_leased("q", timeout=0.5, lease_s=0.01)
+            assert got is not None
+            await asyncio.sleep(0.02)   # let the lease lapse, never ack
+        assert await mq.queue_pop_leased("q", timeout=0.05) is None
+        assert mq.redeliveries == 2
+
+    run(main())
