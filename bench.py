@@ -95,6 +95,9 @@ def log(*a):
 
 
 def write_state(phase: str, result: dict):
+    # crash-recovery SCRATCH state, not an evidence artifact: the atomic
+    # tmp+replace is correct here and exempt from the final-name/append-only
+    # policy that tools/artifacts.py enforces for evidence files
     tmp = STATE_PATH + ".tmp"
     with open(tmp, "w") as f:
         json.dump({"phase": phase, "t": time.time(), "result": result}, f)
@@ -663,6 +666,21 @@ def worker():
         st.record(best, n_chips)
         log(f"chunk {c}: {tok_s:.1f} tok/s ({tokens} tokens / {dt:.3f}s); "
             f"best {best:.1f}")
+    # decode pipeline occupancy for this capture (docs/PERF.md): how many
+    # windows committed while a follow-up executed on device, how many
+    # reconciliation fallbacks, and whether steady-state windows really
+    # stayed plan-upload-free — the attribution companion to the tok/s
+    # number (the full phase split comes from tools/decode_profile.py)
+    st.result["extras"]["decode_pipeline"] = {
+        "depth": engine.cfg.pipeline_depth,
+        "windows": engine.decode_windows,
+        "pipelined": engine.pipeline_windows,
+        "overlapped": engine.pipeline_overlapped,
+        "fallbacks": engine.pipeline_fallbacks,
+        "host_syncs": engine.decode_host_syncs,
+        "plan_uploads": engine.decode_plan_uploads,
+    }
+    st.touch()
 
     st.set_phase("ttft")
     log("phase: TTFT — drain, then 8 fresh concurrent prompts "

@@ -20,6 +20,10 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
 - R6  host-sync call in a file marked `# dynalint: hot-path`
 - R7  unbounded await on a control-plane/transport round trip in the
       serving layers (transports/, frontend/, disagg/)
+- R8  blocking device sync (jax.device_get / .block_until_ready() /
+      np.asarray(<device array>)) inside a `# dynalint: hot-path-begin`
+      .. `hot-path-end` region without an explicit
+      `# dynalint: sync-point` justification
 """
 from __future__ import annotations
 
@@ -350,7 +354,9 @@ def r5_mutate_while_iterating(tree: ast.AST, lines: List[str],
 
 # -- R6: host syncs in hot-path files -----------------------------------------
 
-HOT_PATH_RE = re.compile(r"#\s*dynalint:\s*hot-path")
+# file-level marker only: must NOT match the R8 region markers
+# (hot-path-begin / hot-path-end), which scope a REGION, not the file
+HOT_PATH_RE = re.compile(r"#\s*dynalint:\s*hot-path(?![-\w])")
 _SYNC_ATTRS = {"item", "block_until_ready"}
 _SYNC_CALLS = {"jax.device_get", "device_get"}
 
@@ -437,6 +443,95 @@ def r7_unbounded_transport_await(tree: ast.AST, lines: List[str],
             "pass timeout=..., or wrap in asyncio.wait_for / "
             "runtime.deadline.with_deadline bounded by the request "
             "Context's remaining budget"))
+    return out
+
+
+# -- R8: blocking device syncs inside hot-path REGIONS ------------------------
+
+# Region markers scope the rule to the exact stretch of code between two
+# decode-window dispatches (engine/engine.py's staging/pipeline section):
+# any blocking sync there is serving latency the device cannot hide. The
+# escape hatch is deliberate and auditable — `# dynalint: sync-point`
+# (with a justification) on the call's line or the line above marks an
+# INTENTIONAL synchronization point, e.g. the single per-window output
+# fetch of the pipelined decode loop.
+_R8_BEGIN_RE = re.compile(r"#\s*dynalint:\s*hot-path-begin")
+_R8_END_RE = re.compile(r"#\s*dynalint:\s*hot-path-end")
+_R8_SYNC_POINT_RE = re.compile(r"#\s*dynalint:\s*sync-point")
+_R8_SYNC_CALLS = {"jax.device_get", "device_get"}
+
+
+def _hot_path_regions(lines: List[str]) -> List[tuple]:
+    regions, start = [], None
+    for i, line in enumerate(lines, 1):
+        if _R8_BEGIN_RE.search(line):
+            start = i
+        elif _R8_END_RE.search(line) and start is not None:
+            regions.append((start, i))
+            start = None
+    if start is not None:   # unclosed region runs to EOF
+        regions.append((start, len(lines)))
+    return regions
+
+
+def _host_side_names(tree: ast.AST) -> set:
+    """Names bound from numpy calls or from a device_get — already host
+    memory, so np.asarray over them is a free view, not a sync."""
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        name = _call_name(node.value)
+        if name.startswith(("np.", "numpy.")) or name in _R8_SYNC_CALLS:
+            for tgt in node.targets:
+                for t in ast.walk(tgt):
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+@rule("R8")
+def r8_sync_in_hot_path_region(tree: ast.AST, lines: List[str],
+                               path: str) -> List[Finding]:
+    regions = _hot_path_regions(lines)
+    if not regions:
+        return []
+
+    def in_region(ln: int) -> bool:
+        return any(a <= ln <= b for a, b in regions)
+
+    def annotated(ln: int) -> bool:
+        return any(_R8_SYNC_POINT_RE.search(_line(lines, x))
+                   for x in (ln, ln - 1))
+
+    host_names = _host_side_names(tree)
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not in_region(node.lineno):
+            continue
+        name = _call_name(node)
+        sync = None
+        if name in _R8_SYNC_CALLS:
+            sync = f"{name}(...)"
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "block_until_ready":
+            sync = f"{_unparse(node.func.value)}.block_until_ready()"
+        elif name in ("np.asarray", "numpy.asarray") and node.args \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id not in host_names:
+            sync = f"{name}({node.args[0].id})"
+        if sync is None or annotated(node.lineno):
+            continue
+        out.append(_finding(
+            "R8", path, lines, node,
+            f"blocking sync `{sync}` inside a hot-path region — the "
+            "host stalls here while the device drains, then the device "
+            "idles while the host catches up (the exact bubble the "
+            "pipelined decode loop exists to remove)",
+            "move the read to the window's single fetch, start an async "
+            "copy (copy_to_host_async) instead, or annotate the line "
+            "with `# dynalint: sync-point(<why this must block>)`"))
     return out
 
 

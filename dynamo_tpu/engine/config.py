@@ -143,6 +143,19 @@ class EngineConfig:
     # stop conditions are checked when the window returns; tokens past a
     # stop are discarded. 1 = the old step-per-token behavior.
     decode_steps: int = 8
+    # decode pipeline depth: 2 = the overlapped host/device loop (engine
+    # step N+1 is dispatched while step N's outputs transfer to host
+    # asynchronously, so the commit/stop/detokenize path for window N runs
+    # concurrently with device execution of window N+1 — docs/PERF.md);
+    # 1 = the fully synchronous dispatch -> fetch -> commit loop. Greedy
+    # and seeded-sampled streams are token-identical at any depth: the
+    # engine falls back to a synchronous window whenever committed results
+    # change slot membership (stop/eos/abort/length), and logprob /
+    # repetition-penalty / spec-decode plans never pipeline. Values > 2
+    # only deepen the scheduler's page-allocation lookahead (the in-flight
+    # window count stays at one; the page tables staged on device bound
+    # how far ahead the engine can run without a host re-plan).
+    pipeline_depth: int = 2
     # speculative decoding ("" = off; "ngram" = prompt-lookup drafts;
     # "draft" = a small draft model proposes, engine/spec.py): greedy
     # plans verify up to spec_k draft tokens per target forward — decode

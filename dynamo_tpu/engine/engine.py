@@ -28,6 +28,7 @@ from dynamo_tpu.engine.config import EngineConfig, ModelConfig
 from dynamo_tpu.engine.kv_cache import SequenceState
 from dynamo_tpu.engine.offload import CopyStream, HostKvPool
 from dynamo_tpu.engine.sampler import (
+    RepPenaltyCache, SamplingArrayCache,
     sample_logits as _sample_logits, seen_token_mask,
 )
 from dynamo_tpu.engine.scheduler import (
@@ -159,6 +160,30 @@ class NativeEngine:
         self._finished_cb = None
         self._last_logprobs = None  # (lp, top_ids, top_lps) of last step
         self._dec_state = None      # device-resident decode window state
+        # overlapped decode pipeline (docs/PERF.md): the in-flight window
+        # record — dispatched, outputs transferring to host asynchronously,
+        # commit deferred to the next step() so host bookkeeping for window
+        # N runs concurrently with device execution of window N+1
+        self._pipeline = None
+        # host staging caches: static sampling-param blocks and incremental
+        # repetition-penalty history rebuild only when the slot set changes
+        self._samp_cache = SamplingArrayCache()
+        self._rp_cache = RepPenaltyCache()
+        # decode phase attribution (tools/decode_profile.py reads this);
+        # profile_sync=True makes the dispatch phase block until the
+        # device finishes, isolating "device" from "fetch" — attribution
+        # harness mode only, it defeats the pipeline's overlap
+        from dynamo_tpu.observability.metrics import PhaseTimer
+        self.phases = PhaseTimer()
+        self.profile_sync = False
+        # pipeline occupancy counters (EngineMetrics / /metrics gauges)
+        self.decode_windows = 0       # windows dispatched via the window path
+        self.decode_host_syncs = 0    # blocking output fetches in decode
+        self.decode_plan_uploads = 0  # windows that staged fresh host arrays
+        self.pipeline_windows = 0     # windows committed via the pipeline
+        self.pipeline_overlapped = 0  # commits with a follow-up in flight
+        self.pipeline_fallbacks = 0   # in-flight windows discarded on
+        #                               membership change (reconciliation)
         # cumulative MoE capacity-drop counters (dispatch impl only)
         self.moe_dropped_tokens = 0.0
         self.moe_routed_tokens = 0.0
@@ -446,11 +471,23 @@ class NativeEngine:
 
     def has_work(self) -> bool:
         s = self.scheduler
-        return bool(s.waiting) or any(x is not None for x in s.running)
+        return (self._pipeline is not None or bool(s.waiting)
+                or any(x is not None for x in s.running))
 
     def step(self) -> List[StepOutput]:
-        """Run one scheduler step on the device; returns per-request events."""
-        plan = self.scheduler.schedule()
+        """Run one scheduler step on the device; returns per-request events.
+
+        With pipeline_depth >= 2 the decode loop is two-deep: a step that
+        finds an in-flight window dispatches its follow-up FIRST (zero new
+        host arrays — the device carry feeds it), then fetches and commits
+        the in-flight window's outputs while the follow-up executes on
+        device. Events for a pipelined window therefore arrive one step()
+        call after its dispatch; greedy and seeded-sampled streams stay
+        token-identical to the synchronous loop (docs/PERF.md)."""
+        if self._pipeline is not None:
+            return self._pipeline_step()
+        with self.phases.phase("plan"):
+            plan = self.scheduler.schedule()
         self._process_offloads()  # save evicted pages before any overwrite
         self._process_onboards()  # host-tier pages the plan may read
         if plan is None:
@@ -458,6 +495,10 @@ class NativeEngine:
         self.step_count += 1
         if isinstance(plan, PrefillPlan):
             return self._run_prefill(plan)
+        if self._pipeline_ok(plan):
+            events = self._prime_pipeline(plan)
+            if events is not None:
+                return events
         return self._run_decode(plan)
 
     def generate(self, prompt: List[int], params: SamplingParams,
@@ -482,52 +523,24 @@ class NativeEngine:
     # -- internals -----------------------------------------------------------
 
     def _sampling_arrays(self, reqs: List[Optional[SequenceState]]):
-        n = len(reqs)
-        temp = np.zeros((n,), np.float32)
-        top_k = np.zeros((n,), np.int32)
-        top_p = np.ones((n,), np.float32)
-        seeds = np.zeros((n,), np.int32)
-        counters = np.zeros((n,), np.int32)
-        min_toks = np.zeros((n,), np.int32)
-        for i, seq in enumerate(reqs):
-            if seq is None:
-                continue
-            p = self.scheduler.params[seq.request_id]
-            temp[i] = p.temperature
-            top_k[i] = p.top_k
-            top_p[i] = p.top_p
-            seeds[i] = p.seed & 0x7FFFFFFF
-            counters[i] = len(seq.output)
-            min_toks[i] = p.min_tokens
-        return temp, top_k, top_p, seeds, counters, min_toks
+        """(temp, top_k, top_p, seeds, counters, min_toks) per slot. The
+        static block is cached per slot set (sampler.SamplingArrayCache):
+        per-request params are immutable, so only the counters column is
+        rebuilt per step."""
+        return self._samp_cache.arrays(
+            reqs, lambda rid: self.scheduler.params[rid])
 
     def _rep_penalty_arrays(self, reqs: List[Optional[SequenceState]]):
         """(hist [S, Hb], rep_penalty [S]) when any request penalizes
         repetition, else None. hist rows are each sequence's seen tokens
         (prompt + generated), padded with vocab_size (dropped on scatter);
-        Hb is bucketed so the compiled-program set stays small."""
-        pens = np.ones((len(reqs),), np.float32)
-        seen_any = False
-        longest = 1
-        for i, seq in enumerate(reqs):
-            if seq is None:
-                continue
-            p = self.scheduler.params[seq.request_id]
-            if p.repetition_penalty and p.repetition_penalty != 1.0:
-                seen_any = True
-                pens[i] = p.repetition_penalty
-            longest = max(longest, seq.total_len)
-        if not seen_any:
-            return None
-        hb = next_bucket(longest,
-                         pow2_buckets(self.cfg.max_model_len))
-        hist = np.full((len(reqs), hb), self.model_cfg.vocab_size, np.int32)
-        for i, seq in enumerate(reqs):
-            if seq is None:
-                continue
-            toks = seq.all_tokens
-            hist[i, :len(toks)] = toks
-        return hist, pens
+        Hb is bucketed so the compiled-program set stays small. Rows are
+        updated incrementally across steps (sampler.RepPenaltyCache) —
+        only tokens generated since the last call are appended."""
+        return self._rp_cache.arrays(
+            reqs, lambda rid: self.scheduler.params[rid],
+            self.model_cfg.vocab_size,
+            lambda n: next_bucket(n, pow2_buckets(self.cfg.max_model_len)))
 
     def _account_moe(self, aux) -> None:
         """MoE capacity-drop accounting (GShard dispatch drops tokens over
@@ -643,21 +656,45 @@ class NativeEngine:
                     # the threshold and the precheck admits the scan on
                     # every step forever (code-review r5)
                     self._spec_gate_skips = 0
-        # split-KV window: the base gather covers only the VALID kv at
-        # window start, sliced from the page table at the bucket of the
-        # true page count — not the admission-time allocation width, which
-        # reserves pages for max_tokens and made attention read up to 2x
-        # the valid KV (VERDICT r3 missing #2)
+        staged = self._stage_window(plan, (temp, top_k, top_p, seeds,
+                                           counters, min_toks), rp,
+                                    with_lp, greedy)
+        outs, nxt = self._dispatch_staged(staged, staged["first"], rp)
+        self._dec_state = {"sig": staged["sig"], "dev": staged["dev"],
+                           "next": nxt}
+        return self._fetch_and_commit(plan, outs)
+
+    # -- decode window staging / dispatch ------------------------------------
+    # dynalint: hot-path-begin — every host op between two decode-window
+    # dispatches is serving latency the device cannot hide; blocking syncs
+    # here need an explicit `# dynalint: sync-point` justification (R8)
+
+    def _window_rung(self, plan: DecodePlan) -> int:
+        """Smallest compiled ladder rung covering the plan's window."""
+        return next((w for w in reversed(self._window_sizes)
+                     if w >= max(1, plan.n_window)), self._window_sizes[0])
+
+    def _stage_window(self, plan: DecodePlan, samp, rp, with_lp: bool,
+                      greedy: bool) -> dict:
+        """Stage the device-side plan arrays for a decode window.
+
+        Split-KV base width (VERDICT r3 missing #2): the base gather covers
+        only the VALID kv at window start, sliced from the page table at
+        the bucket of the true page count — not the admission-time
+        allocation width, which reserves pages for max_tokens and made
+        attention read up to 2x the valid KV.
+
+        Device-resident decode state: if the slot set + page allocation are
+        unchanged since the last window (and no penalty hist needs
+        refreshing), reuse the device plan arrays and feed the last
+        window's final (token, position, counter) device arrays straight
+        back in — steady-state windows then upload NOTHING."""
+        temp, top_k, top_p, seeds, counters, min_toks = samp
         ps = self.cfg.page_size
         base_lens = np.clip(plan.positions[:, 0], 0, plan.max_pos + 1)
         base_pages = max(1, int(-(-int(base_lens.max()) // ps)))
         base_pb = min(next_bucket(base_pages, self.scheduler.page_buckets),
                       plan.page_table.shape[1])
-        # device-resident decode state: if the slot set + page allocation
-        # are unchanged since the last window (and no penalty hist needs
-        # refreshing), reuse the device plan arrays and feed the last
-        # window's final (token, position, counter) device arrays straight
-        # back in — steady-state windows then upload NOTHING
         sig = (tuple((s.request_id, s.epoch) if s else None
                      for s in plan.seqs),
                tuple(len(s.pages) if s else 0 for s in plan.seqs),
@@ -666,39 +703,280 @@ class NativeEngine:
         st = self._dec_state
         if st is not None and st["sig"] == sig and rp is None:
             dev = st["dev"]
-            tok_d, pos_d, ctr_d = st["next"]
+            first = st["next"]
         else:
-            ign = np.array([
-                bool(self.scheduler.params[s.request_id].ignore_eos)
-                if s is not None else True for s in plan.seqs])
-            dev = (jnp.asarray(plan.page_table),
-                   jnp.asarray(plan.page_table[:, :base_pb]),
-                   jnp.asarray(plan.max_pos),
-                   jnp.asarray(temp), jnp.asarray(top_k),
-                   jnp.asarray(top_p), jnp.asarray(seeds),
-                   jnp.asarray(min_toks), jnp.asarray(ign),
-                   jnp.asarray(plan.stop_ids))
-            tok_d = jnp.asarray(plan.tokens[:, 0])
-            pos_d = jnp.asarray(plan.positions[:, 0])
-            ctr_d = jnp.asarray(counters)
-        page_table_d, base_table_d, max_pos_d, temp_d, top_k_d, top_p_d, \
-            seeds_d, min_toks_d, ign_d, stop_ids_d = dev
-        args = (self.params, self.cache, tok_d, pos_d, page_table_d,
-                base_table_d, max_pos_d, temp_d, top_k_d, top_p_d, seeds_d,
-                ctr_d, min_toks_d, ign_d, stop_ids_d)
-        if rp is not None:
-            args += (jnp.asarray(rp[0]), jnp.asarray(rp[1]))
-        nw = next((w for w in reversed(self._window_sizes)
-                   if w >= max(1, plan.n_window)), self._window_sizes[0])
-        out = self._decode_fns[(rp is not None, with_lp, greedy, nw)](*args)
-        toks, lps, top_ids, top_lps, self.cache, aux, nxt = out
-        self._dec_state = {"sig": sig, "dev": dev, "next": nxt}
-        toks, lps, top_ids, top_lps, aux = jax.device_get(
-            (toks, lps, top_ids, top_lps, aux))
+            with self.phases.phase("upload"):
+                ign = np.array([
+                    bool(self.scheduler.params[s.request_id].ignore_eos)
+                    if s is not None else True for s in plan.seqs])
+                dev = (jnp.asarray(plan.page_table),
+                       jnp.asarray(plan.page_table[:, :base_pb]),
+                       jnp.asarray(plan.max_pos),
+                       jnp.asarray(temp), jnp.asarray(top_k),
+                       jnp.asarray(top_p), jnp.asarray(seeds),
+                       jnp.asarray(min_toks), jnp.asarray(ign),
+                       jnp.asarray(plan.stop_ids))
+                first = (jnp.asarray(plan.tokens[:, 0]),
+                         jnp.asarray(plan.positions[:, 0]),
+                         jnp.asarray(counters))
+            self.decode_plan_uploads += 1
+        nw = self._window_rung(plan)
+        pregather = llama._decode_kernel_mode(self.model_cfg) is None
+        return {"sig": sig, "dev": dev, "first": first, "nw": nw,
+                "key": (rp is not None, with_lp, greedy, nw),
+                # valid-KV capacity of the staged base table; the kernel
+                # path streams from the global cache and has no base cap
+                "base_cap": base_pb * ps if pregather else None,
+                "pp": False}
+
+    def _stage_pp_window(self, plan: DecodePlan, samp,
+                         greedy: bool) -> dict:
+        """Stage a pipeline-parallel decode window (models/pp.py). Same
+        device-resident reuse contract as _stage_window: an unchanged slot
+        set + page allocation feeds the previous window's (token, position,
+        counter) carry back in with zero host array uploads."""
+        temp, top_k, top_p, seeds, counters, min_toks = samp
+        sig = (tuple((s.request_id, s.epoch) if s else None
+                     for s in plan.seqs),
+               tuple(len(s.pages) if s else 0 for s in plan.seqs),
+               plan.page_table.shape[1], plan.stop_ids.shape[1],
+               "pp", greedy)
+        st = self._dec_state
+        if st is not None and st["sig"] == sig:
+            dev = st["dev"]
+            first = st["next"]
+        else:
+            with self.phases.phase("upload"):
+                ign = np.array([
+                    bool(self.scheduler.params[s.request_id].ignore_eos)
+                    if s is not None else True for s in plan.seqs])
+                dev = (jnp.asarray(plan.page_table),
+                       jnp.asarray(plan.max_pos),
+                       jnp.asarray(min_toks), jnp.asarray(ign),
+                       jnp.asarray(plan.stop_ids), jnp.asarray(temp),
+                       jnp.asarray(top_k), jnp.asarray(top_p),
+                       jnp.asarray(seeds))
+                first = (jnp.asarray(plan.tokens[:, 0]),
+                         jnp.asarray(plan.positions[:, 0]),
+                         jnp.asarray(counters))
+            self.decode_plan_uploads += 1
+        nw = self._window_rung(plan)
+        return {"sig": sig, "dev": dev, "first": first, "nw": nw,
+                "key": (nw, greedy), "base_cap": None, "pp": True}
+
+    def _dispatch_staged(self, staged: dict, carry, rp=None):
+        """Dispatch one decode window from staged device arrays + a
+        (token, position, counter) carry. Returns (outs, next_carry) with
+        outs still ON DEVICE — the caller decides when to sync."""
+        tok_d, pos_d, ctr_d = carry
+        with self.phases.phase("dispatch"):
+            if staged["pp"]:
+                nw, greedy = staged["key"]
+                (page_table_d, max_pos_d, min_toks_d, ign_d, stop_ids_d,
+                 temp_d, top_k_d, top_p_d, seeds_d) = staged["dev"]
+                toks, self.cache, nxt = self._pp_decode_fns[nw, greedy](
+                    self.params, self.cache, tok_d, pos_d, page_table_d,
+                    max_pos_d, min_toks_d, ctr_d, ign_d, stop_ids_d,
+                    temp_d, top_k_d, top_p_d, seeds_d)
+                outs = (toks, None, None, None, {})
+            else:
+                (page_table_d, base_table_d, max_pos_d, temp_d, top_k_d,
+                 top_p_d, seeds_d, min_toks_d, ign_d, stop_ids_d) = \
+                    staged["dev"]
+                args = (self.params, self.cache, tok_d, pos_d, page_table_d,
+                        base_table_d, max_pos_d, temp_d, top_k_d, top_p_d,
+                        seeds_d, ctr_d, min_toks_d, ign_d, stop_ids_d)
+                if rp is not None:
+                    args += (jnp.asarray(rp[0]), jnp.asarray(rp[1]))
+                out = self._decode_fns[staged["key"]](*args)
+                toks, lps, top_ids, top_lps, self.cache, aux, nxt = out
+                outs = (toks, lps, top_ids, top_lps, aux)
+        self.decode_windows += 1
+        if self.profile_sync:
+            # attribution harness mode (tools/decode_profile.py): isolate
+            # device execution from the fetch phase; serving never sets it
+            with self.phases.phase("device"):
+                # dynalint: sync-point(profile_sync attribution mode only)
+                jax.block_until_ready(outs)
+        return outs, nxt
+
+    def _fetch_and_commit(self, plan: DecodePlan,
+                          outs) -> List[StepOutput]:
+        """Blocking output fetch + host commit for one window."""
+        with self.phases.phase("fetch"):
+            toks, lps, top_ids, top_lps, aux = \
+                jax.device_get(outs)  # dynalint: sync-point — the one
+            #   intended host sync per decode window: [N, S] sampled ids
+            #   (+ optional logprobs) are all that crosses to host
+        self.decode_host_syncs += 1
         if aux:
             self._account_moe(aux)
-        return self._commit_window(plan, np.asarray(toks), lps, top_ids,
-                                   top_lps)
+        with self.phases.phase("commit"):
+            return self._commit_window(plan, np.asarray(toks), lps,
+                                       top_ids, top_lps)
+
+    # -- overlapped decode pipeline ------------------------------------------
+
+    def _pipeline_ok(self, plan) -> bool:
+        """May `plan` enter the overlapped pipeline? Conservative: only
+        hot-path windows (no logprobs / penalties / spec-decode handoff),
+        only when a follow-up window could actually be dispatched off this
+        plan's staged page tables (otherwise deferring the commit buys no
+        overlap and only delays events)."""
+        if self.cfg.pipeline_depth < 2 or not isinstance(plan, DecodePlan):
+            return False
+        if self._verify_fn is not None or self._draft is not None:
+            return False   # spec-decode handoff stays synchronous
+        if self.pp > 1 and plan.n_window <= 1:
+            return False   # pp per-token fallback path
+        if self.scheduler.waiting or self.scheduler.pending_onboards \
+                or self._pending_offloads:
+            return False
+        if self._wants_logprobs(plan.seqs) \
+                or self._rep_penalty_arrays(plan.seqs) is not None:
+            return False
+        return self._followup_fits(plan, next_index=1)
+
+    def _followup_fits(self, plan: DecodePlan, next_index: int) -> bool:
+        """Can speculative window `next_index` (0 = the plan's own window)
+        run entirely against the plan's staged page tables? Its writes
+        must land in pages listed at staging time, and (pregather path)
+        its valid-KV prefix must fit the staged base-table width."""
+        nw = self._window_rung(plan)
+        live = np.array([s is not None for s in plan.seqs])
+        if not live.any():
+            return False
+        pos0 = plan.positions[:, 0]
+        start = pos0 + next_index * nw
+        if np.all(start[live] > plan.max_pos[live]):
+            return False   # every slot is out of budget: pure garbage
+        covered = np.array([len(s.pages) if s is not None else 0
+                            for s in plan.seqs]) * self.cfg.page_size
+        # exclusive end of this window's writes, clamped by each request's
+        # admission budget (writes beyond max_pos are dropped on device)
+        need = np.minimum(start + nw, plan.max_pos + 1)
+        if np.any(need[live] > covered[live]):
+            return False
+        if not self.pp > 1:
+            pregather = llama._decode_kernel_mode(self.model_cfg) is None
+            if pregather:
+                ps = self.cfg.page_size
+                base_lens = np.clip(plan.positions[:, 0], 0,
+                                    plan.max_pos + 1)
+                base_pb = min(
+                    next_bucket(max(1, int(-(-int(base_lens.max()) // ps))),
+                                self.scheduler.page_buckets),
+                    plan.page_table.shape[1])
+                base_need = np.clip(start, 0, plan.max_pos + 1)
+                if int(base_need[live].max()) > base_pb * ps:
+                    return False
+        return True
+
+    def _prime_pipeline(self, plan: DecodePlan
+                        ) -> Optional[List[StepOutput]]:
+        """Dispatch `plan`'s window and DEFER its commit: outputs start an
+        async device->host copy and the events surface on the next step()
+        call, which dispatches the follow-up window before fetching them.
+        Returns None when the plan turns out ineligible (caller falls back
+        to the synchronous path)."""
+        samp = self._sampling_arrays(plan.seqs)
+        greedy = self._samp_cache.all_greedy
+        if self.pp > 1:
+            staged = self._stage_pp_window(plan, samp, greedy)
+        else:
+            staged = self._stage_window(plan, samp, None, False, greedy)
+        outs, nxt = self._dispatch_staged(staged, staged["first"])
+        self._dec_state = {"sig": staged["sig"], "dev": staged["dev"],
+                           "next": nxt}
+        self._copy_outs_async(outs)
+        self._pipeline = {
+            "plan": plan, "staged": staged, "outs": outs, "nxt": nxt,
+            # index of the in-flight window relative to the staged plan:
+            # 0 = the plan's own window, each follow-up increments it
+            "j": 0,
+            "t_dispatch": time.perf_counter(),
+        }
+        return []
+
+    @staticmethod
+    def _copy_outs_async(outs) -> None:
+        """Start the device->host transfer of window outputs without
+        blocking: by the time the next step() fetches them the copy has
+        ridden the device's execution of the window itself."""
+        for leaf in jax.tree.leaves(outs):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+
+    def _membership_intact(self, plan: DecodePlan) -> bool:
+        """True while every slot of `plan` still maps to the same live
+        sequence object (no finish, abort, or preemption since staging) —
+        the validity condition for results computed off the staged state."""
+        running = self.scheduler.running
+        for i, seq in enumerate(plan.seqs):
+            if seq is None:
+                if running[i] is not None:
+                    return False
+            elif running[i] is not seq:
+                return False
+        return True
+
+    def _pipeline_step(self) -> List[StepOutput]:
+        """Advance the two-deep decode pipeline by one step():
+
+        1. dispatch the follow-up window (device carry only — zero host
+           array uploads) while the in-flight window's outputs are still
+           transferring;
+        2. fetch the in-flight window's outputs (the one host sync);
+        3. commit them on host — CONCURRENT with device execution of the
+           follow-up dispatched in (1);
+        4. reconcile: if the commit changed slot membership (stop/eos/
+           length/abort), the follow-up was computed off a stale plan —
+           discard its results and fall back to a synchronous re-plan.
+           Its KV writes are harmless: they land past every committed
+           position, inside pages the staged table owned, and are
+           overwritten by the deterministic re-run (docs/PERF.md has the
+           full exactness argument)."""
+        pend, self._pipeline = self._pipeline, None
+        self.step_count += 1
+        self._process_offloads()
+        self._process_onboards()
+        plan, staged = pend["plan"], pend["staged"]
+        follow = None
+        if self.scheduler.waiting or self.scheduler.pending_onboards:
+            pass        # admission pending: drain the pipeline first
+        elif not self._membership_intact(plan):
+            pass        # abort mid-window: commit what's valid, re-plan
+        elif self._followup_fits(plan, pend["j"] + 1):
+            follow_outs, follow_nxt = self._dispatch_staged(
+                staged, pend["nxt"])
+            self._copy_outs_async(follow_outs)
+            follow = {"plan": plan, "staged": staged, "outs": follow_outs,
+                      "nxt": follow_nxt, "j": pend["j"] + 1,
+                      "t_dispatch": time.perf_counter()}
+        events = self._fetch_and_commit(plan, pend["outs"])
+        self.pipeline_windows += 1
+        intact = self._membership_intact(plan)
+        if follow is not None:
+            if intact:
+                # true overlap: the commit above ran while the follow-up
+                # executed on device
+                self.pipeline_overlapped += 1
+                self._pipeline = follow
+                self._dec_state = {"sig": staged["sig"],
+                                   "dev": staged["dev"],
+                                   "next": follow["nxt"]}
+            else:
+                # reconciliation fallback: the follow-up's results assume
+                # a slot set the commit just changed — drop them (the
+                # donated cache already advanced; its garbage KV writes
+                # are overwritten by the synchronous re-plan)
+                self.pipeline_fallbacks += 1
+                self._dec_state = None
+        elif not intact:
+            self._dec_state = None
+        return events
+
+    # dynalint: hot-path-end
 
     def _gather_drafts(self, plan: DecodePlan) -> list:
         """Per-slot prompt-lookup proposals, clamped to the shared
@@ -862,10 +1140,18 @@ class NativeEngine:
         events: List[StepOutput] = []
         done: Set[str] = set()
         finish_step: Dict[str, int] = {}
-        n_live = sum(1 for s in plan.seqs if s is not None)
+        # identity guard for the pipelined loop: a slot aborted while its
+        # window was in flight is no longer backed by this seq — committing
+        # its tokens would double-free pages (or poison a reused request
+        # id); the synchronous path commits immediately after scheduling,
+        # so the guard is vacuous there
+        running = self.scheduler.running
+        live = [seq is not None and running[i] is seq
+                for i, seq in enumerate(plan.seqs)]
+        n_live = sum(live)
         for step in range(n_steps):
             for i, seq in enumerate(plan.seqs):
-                if seq is None or seq.request_id in done:
+                if not live[i] or seq.request_id in done:
                     continue
                 self.scheduler.commit_decode_token(seq, int(toks[step, i]))
                 if lps is not None:
@@ -899,27 +1185,16 @@ class NativeEngine:
         (models/pp.pp_decode_window; VERDICT r3 weak #7 + r4 #6).
         Logprob / penalty plans take one token per dispatch through the
         same fused program prefill uses."""
-        temp, top_k, top_p, seeds, counters, min_toks = \
-            self._sampling_arrays(plan.seqs)
-        greedy = all(t <= 0.0 for t in temp)
+        samp = self._sampling_arrays(plan.seqs)
+        greedy = self._samp_cache.all_greedy
         if plan.n_window > 1 \
                 and not self._wants_logprobs(plan.seqs) \
                 and self._rep_penalty_arrays(plan.seqs) is None:
-            ign = np.array([
-                bool(self.scheduler.params[s.request_id].ignore_eos)
-                if s is not None else True for s in plan.seqs])
-            nw = next((w for w in reversed(self._window_sizes)
-                       if w >= max(1, plan.n_window)),
-                      self._window_sizes[0])
-            toks, self.cache = self._pp_decode_fns[nw, greedy](
-                self.params, self.cache, jnp.asarray(plan.tokens[:, 0]),
-                jnp.asarray(plan.positions[:, 0]),
-                jnp.asarray(plan.page_table), jnp.asarray(plan.max_pos),
-                jnp.asarray(min_toks), jnp.asarray(counters),
-                jnp.asarray(ign), jnp.asarray(plan.stop_ids),
-                jnp.asarray(temp), jnp.asarray(top_k),
-                jnp.asarray(top_p), jnp.asarray(seeds))
-            return self._commit_window(plan, np.asarray(toks))
+            staged = self._stage_pp_window(plan, samp, greedy)
+            outs, nxt = self._dispatch_staged(staged, staged["first"])
+            self._dec_state = {"sig": staged["sig"], "dev": staged["dev"],
+                               "next": nxt}
+            return self._fetch_and_commit(plan, outs)
         sampled = self._run_device_step(plan, plan.seqs)
         lps = self._last_logprobs
         events: List[StepOutput] = []
@@ -1090,6 +1365,12 @@ class NativeEngine:
         m.window_wasted_steps = self.window_wasted_steps
         m.spec_proposed_tokens = self.spec_proposed_tokens
         m.spec_accepted_tokens = self.spec_accepted_tokens
+        m.decode_windows = self.decode_windows
+        m.pipeline_windows = self.pipeline_windows
+        m.pipeline_overlapped = self.pipeline_overlapped
+        m.pipeline_fallbacks = self.pipeline_fallbacks
+        m.decode_host_syncs = self.decode_host_syncs
+        m.decode_plan_uploads = self.decode_plan_uploads
         return m
 
     def moe_drop_rate(self) -> float:

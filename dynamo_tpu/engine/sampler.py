@@ -13,12 +13,143 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NEG_INF = -1e30
 
 # static top-k width for logprob alternatives (OpenAI caps top_logprobs
 # lower in practice; one static width keeps the compiled program set small)
 TOP_LOGPROBS = 8
+
+
+def _slot_key(reqs) -> tuple:
+    """Cache key for a decode slot set: (request_id, epoch) per slot.
+
+    The epoch distinguishes a preempted-and-readmitted request from an
+    uninterrupted one (its params are the same but its output restarted)."""
+    return tuple((s.request_id, s.epoch) if s is not None else None
+                 for s in reqs)
+
+
+class SamplingArrayCache:
+    """Host staging for per-slot sampling parameter arrays.
+
+    The decode loop used to rebuild (temperature, top_k, top_p, seed,
+    min_tokens) from the per-request SamplingParams dict on EVERY window —
+    pure host latency on the hot path, paid even when the slot set had not
+    changed. Parameters are immutable per request, so the static block is
+    rebuilt only when the slot -> request mapping changes; the per-step
+    counters column (tokens emitted so far) is the only array built per
+    call. Used by engine._sampling_arrays; the cached block also backs the
+    pipelined decode loop's "greedy plan" check without a params scan."""
+
+    def __init__(self):
+        self._key = None
+        self._static = None
+        self._greedy = True
+
+    def invalidate(self) -> None:
+        self._key = None
+
+    def arrays(self, reqs, params_of):
+        """(temp, top_k, top_p, seeds, counters, min_toks) float32/int32
+        numpy arrays, one row per slot; params_of maps request_id ->
+        SamplingParams."""
+        key = _slot_key(reqs)
+        if key != self._key:
+            n = len(reqs)
+            temp = np.zeros((n,), np.float32)
+            top_k = np.zeros((n,), np.int32)
+            top_p = np.ones((n,), np.float32)
+            seeds = np.zeros((n,), np.int32)
+            min_toks = np.zeros((n,), np.int32)
+            for i, seq in enumerate(reqs):
+                if seq is None:
+                    continue
+                p = params_of(seq.request_id)
+                temp[i] = p.temperature
+                top_k[i] = p.top_k
+                top_p[i] = p.top_p
+                seeds[i] = p.seed & 0x7FFFFFFF
+                min_toks[i] = p.min_tokens
+            self._static = (temp, top_k, top_p, seeds, min_toks)
+            self._greedy = bool(np.all(temp <= 0.0))
+            self._key = key
+        temp, top_k, top_p, seeds, min_toks = self._static
+        counters = np.fromiter(
+            (len(s.output) if s is not None else 0 for s in reqs),
+            np.int32, count=len(reqs))
+        return temp, top_k, top_p, seeds, counters, min_toks
+
+    @property
+    def all_greedy(self) -> bool:
+        """Every slot in the last-built set samples greedily."""
+        return self._greedy
+
+
+class RepPenaltyCache:
+    """Incremental host staging for repetition-penalty history rows.
+
+    hist rows are each sequence's seen tokens (prompt + generated) padded
+    with vocab_size; rebuilding the full [S, Hb] block every window is
+    O(total tokens) host work per step. Instead the block persists across
+    windows: on a slot-set hit only the tokens generated since the last
+    call are appended per row; the block is rebuilt only when the slot set
+    changes or the length bucket Hb grows."""
+
+    def __init__(self):
+        self._key = None
+        self._any = False
+        self._pens = None
+        self._hist = None
+        self._filled = None   # tokens already staged per row
+
+    def invalidate(self) -> None:
+        self._key = None
+
+    @staticmethod
+    def _tail(seq, start: int):
+        """seq.all_tokens[start:] without materializing the full concat."""
+        n_prompt = len(seq.prompt)
+        if start < n_prompt:
+            return seq.prompt[start:] + seq.output
+        return seq.output[start - n_prompt:]
+
+    def arrays(self, reqs, params_of, vocab_size: int, bucket_of):
+        """(hist [S, Hb], rep_penalty [S]) or None when no slot penalizes.
+        bucket_of maps a length to its padded bucket Hb."""
+        key = _slot_key(reqs)
+        if key != self._key:
+            pens = np.ones((len(reqs),), np.float32)
+            self._any = False
+            for i, seq in enumerate(reqs):
+                if seq is None:
+                    continue
+                rp = params_of(seq.request_id).repetition_penalty
+                if rp and rp != 1.0:
+                    self._any = True
+                    pens[i] = rp
+            self._pens = pens
+            self._hist = None
+            self._filled = None
+            self._key = key
+        if not self._any:
+            return None
+        longest = max((s.total_len for s in reqs if s is not None),
+                      default=1)
+        hb = bucket_of(max(1, longest))
+        if self._hist is None or hb > self._hist.shape[1]:
+            self._hist = np.full((len(reqs), hb), vocab_size, np.int32)
+            self._filled = np.zeros((len(reqs),), np.int64)
+        hist, filled = self._hist, self._filled
+        for i, seq in enumerate(reqs):
+            if seq is None:
+                continue
+            have, want = int(filled[i]), seq.total_len
+            if want > have:
+                hist[i, have:want] = self._tail(seq, have)
+                filled[i] = want
+        return hist, self._pens
 
 
 def seen_token_mask(hist: jax.Array, vocab: int) -> jax.Array:

@@ -163,6 +163,18 @@ class EngineMetrics:
     # workload's prompt-lookup friendliness (0/0 when spec_decode is off)
     spec_proposed_tokens: int = 0
     spec_accepted_tokens: int = 0
+    # decode pipeline occupancy (engine pipelined loop, docs/PERF.md):
+    # windows dispatched / committed while a follow-up window was already
+    # in flight on device (true host/device overlap) / reconciliation
+    # fallbacks (the in-flight window was discarded because commit changed
+    # slot membership) / blocking output fetches / windows that staged
+    # fresh host plan arrays (0-upload steady state when this stays flat)
+    decode_windows: int = 0
+    pipeline_windows: int = 0
+    pipeline_overlapped: int = 0
+    pipeline_fallbacks: int = 0
+    decode_host_syncs: int = 0
+    decode_plan_uploads: int = 0
 
 
 def window_ladder(decode_steps: int) -> List[int]:
@@ -685,6 +697,20 @@ class Scheduler:
         active = [s for s in self.running if s is not None]
         if not active:
             return None
+        # pipeline lookahead (engine pipelined decode loop, docs/PERF.md):
+        # the engine dispatches up to pipeline_depth windows against THIS
+        # plan's page table before the first commits, so the speculative
+        # windows need their pages allocated — and listed in the table —
+        # now. Best-effort only: speculation must never preempt a running
+        # request, so a failed allocation just means the engine won't
+        # chain a follow-up window off this plan.
+        if self.cfg.pipeline_depth > 1:
+            for seq in active:
+                limit = (len(seq.prompt)
+                         + self.params[seq.request_id].max_tokens)
+                self._ensure_pages(seq, min(
+                    seq.total_len + n_window * self.cfg.pipeline_depth,
+                    limit))
         s_count = self.cfg.max_slots
         # bucket the table width by each request's ADMISSION-TIME page limit
         # (prompt + max_tokens), not its current allocation: the width then

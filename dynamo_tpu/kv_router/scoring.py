@@ -33,6 +33,16 @@ class WorkerMetrics:
     # speculative decoding (engine/spec.py): acceptance = accepted/proposed
     spec_proposed_tokens: int = 0
     spec_accepted_tokens: int = 0
+    # overlapped decode pipeline occupancy (engine pipelined loop,
+    # docs/PERF.md): dispatched windows / committed via the pipeline /
+    # committed while a follow-up ran on device / reconciliation
+    # fallbacks / blocking fetches / fresh host plan stagings
+    decode_windows: int = 0
+    pipeline_windows: int = 0
+    pipeline_overlapped: int = 0
+    pipeline_fallbacks: int = 0
+    decode_host_syncs: int = 0
+    decode_plan_uploads: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "WorkerMetrics":

@@ -12,12 +12,14 @@ without HF artifacts). Same capability here, numpy-native:
   output_norm, output) onto the stacked-layer params pytree of
   models/llama.py. Supported tensor types: F32, F16, BF16, and Q8_0
   (dequantized on load); other quants raise with the type named.
-- `GGUFTokenizer` reconstructs a usable tokenizer from
-  `tokenizer.ggml.tokens`: greedy longest-match encode with byte fallback
-  (<0xXX> tokens), SentencePiece-style "▁" space handling on decode. This
-  is not a faithful BPE-merge reimplementation — encodes can differ from
-  llama.cpp's on rare strings — but round-trips text and matches vocab ids,
-  which is what serving needs.
+- `GGUFTokenizer` rebuilds a faithful tokenizer from the embedded vocab,
+  dispatching on `tokenizer.ggml.model` (see the class docstring below):
+  "gpt2" vocabs get a real byte-level BPE built from tokens + merges
+  (pre-tokenizer split selected by `tokenizer.ggml.pre`); "llama" vocabs
+  get a score-driven SentencePiece bigram-merge encode with <0xXX> byte
+  fallback — HF id-for-id parity is pinned in tests/test_gguf.py. (The
+  pre-r4 greedy longest-match stopgap this docstring used to describe is
+  gone.)
 
 GGUF is little-endian; v3 adds no layout changes we depend on.
 """

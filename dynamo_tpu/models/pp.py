@@ -340,8 +340,8 @@ def pp_decode_window(
     Device-side finish tracking mirrors the single-mesh decode window:
     eos (unless ignore_eos), hidden stop ids, and the max_pos budget all
     clear a per-slot alive bit that masks later KV writes. Returns
-    sampled tokens [n_steps, S] (host discards post-finish tails, as
-    with the single-mesh window).
+    (sampled tokens [n_steps, S], cache, next-window carry) — the host
+    discards post-finish tails, as with the single-mesh window.
 
     Reference bar: vLLM pipeline_parallel_size decode
     (container/deps/vllm patch vllm_inc.py:38); the microbatch
@@ -373,7 +373,13 @@ def pp_decode_window(
         in_specs=in_specs,
         out_specs=(P(), pp_cache_sharding(), pp_cache_sharding()),
     )(*args)
-    return out_toks, {"k": kc, "v": vc}
+    # next-window carry (engine overlapped decode pipeline, docs/PERF.md):
+    # the final sampled token per slot plus advanced position/counter
+    # columns stay ON DEVICE, so an unchanged slot set dispatches the next
+    # window with zero host array uploads — same contract as the
+    # single-mesh window's (tok_f, pos_f, ctr_f) carry
+    nxt = (out_toks[n_steps - 1], positions + n_steps, counters + n_steps)
+    return out_toks, {"k": kc, "v": vc}, nxt
 
 
 def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,

@@ -74,6 +74,23 @@ class MetricsExporter:
         self.g_spec_accepted = r.gauge(
             f"{PREFIX}_spec_accepted_tokens",
             "Of those, drafts accepted (free decode tokens)", labels)
+        # overlapped decode pipeline occupancy (engine pipelined loop):
+        # overlapped/pipelined is the live host-overlap rate; fallbacks
+        # count reconciliation discards; plan_uploads staying flat while
+        # windows climbs is the zero-upload steady-state invariant
+        self.g_pipe = {
+            name: r.gauge(f"{PREFIX}_decode_{name}", help_, labels)
+            for name, help_ in (
+                ("windows", "Decode windows dispatched"),
+                ("pipeline_windows",
+                 "Of those, committed via the overlapped pipeline"),
+                ("pipeline_overlapped",
+                 "Commits that ran while a follow-up window executed"),
+                ("pipeline_fallbacks",
+                 "In-flight windows discarded on membership change"),
+                ("host_syncs", "Blocking output fetches in decode"),
+                ("plan_uploads", "Windows that staged fresh host arrays"),
+            )}
         self.g_load_avg = r.gauge(
             f"{PREFIX}_load_avg", "Mean active KV blocks across workers")
         self.g_load_std = r.gauge(
@@ -138,7 +155,7 @@ class MetricsExporter:
                       self.g_kv_active, self.g_kv_total, self.g_waiting,
                       self.g_usage, self.g_hit_rate, self.g_window_steps,
                       self.g_window_wasted, self.g_spec_proposed,
-                      self.g_spec_accepted):
+                      self.g_spec_accepted, *self.g_pipe.values()):
                 g.remove(worker_id)
         for worker_id, m in endpoints.workers.items():
             self.g_active_slots.set(worker_id, value=m.request_active_slots)
@@ -156,6 +173,17 @@ class MetricsExporter:
                                      value=m.spec_proposed_tokens)
             self.g_spec_accepted.set(worker_id,
                                      value=m.spec_accepted_tokens)
+            self.g_pipe["windows"].set(worker_id, value=m.decode_windows)
+            self.g_pipe["pipeline_windows"].set(
+                worker_id, value=m.pipeline_windows)
+            self.g_pipe["pipeline_overlapped"].set(
+                worker_id, value=m.pipeline_overlapped)
+            self.g_pipe["pipeline_fallbacks"].set(
+                worker_id, value=m.pipeline_fallbacks)
+            self.g_pipe["host_syncs"].set(
+                worker_id, value=m.decode_host_syncs)
+            self.g_pipe["plan_uploads"].set(
+                worker_id, value=m.decode_plan_uploads)
         self.g_load_avg.set(value=endpoints.load_avg)
         self.g_load_std.set(value=endpoints.load_std)
         self.g_workers.set(value=len(endpoints.workers))

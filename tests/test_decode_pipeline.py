@@ -1,0 +1,219 @@
+"""Overlapped decode pipeline (engine two-deep host/device loop).
+
+Exactness bar: pipelined streams must be TOKEN-IDENTICAL to the
+synchronous loop — greedy and seeded-sampling, including stop-mid-window
+and abort-mid-window, both of which force the reconciliation fallback
+(the in-flight follow-up window is discarded and the engine re-plans).
+Invariant bar (the CPU microbench): the pipelined loop issues exactly one
+blocking host sync per committed window, and steady-state windows upload
+zero plan arrays. docs/PERF.md has the design and exactness argument.
+
+The two engines (depth=1 reference, depth=2 pipelined) are module-scoped
+and reused across tests — engine rebuilds recompile every jitted program
+(~4s each on CPU), and serving-realism-wise a reused engine IS the
+scenario the pipeline must survive: counter assertions therefore diff
+against a snapshot instead of assuming zero.
+"""
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+
+
+def make_engine(depth, **kw):
+    defaults = dict(
+        page_size=64, num_pages=32, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512, decode_steps=4,
+        pipeline_depth=depth)
+    defaults.update(kw)
+    return NativeEngine(CFG, EngineConfig(**defaults), seed=0)
+
+
+@pytest.fixture(scope="module")
+def eng_sync():
+    return make_engine(1)
+
+
+@pytest.fixture(scope="module")
+def eng_pipe():
+    return make_engine(2)
+
+
+def snap(eng):
+    return {k: getattr(eng, k) for k in (
+        "decode_windows", "pipeline_windows", "pipeline_overlapped",
+        "pipeline_fallbacks", "decode_host_syncs", "decode_plan_uploads")}
+
+
+def delta(eng, before):
+    return {k: getattr(eng, k) - v for k, v in before.items()}
+
+
+def drive(eng, prompts, params_list, tag):
+    got = {}
+    for i, (pr, p) in enumerate(zip(prompts, params_list)):
+        eng.add_request(EngineRequest(f"{tag}{i}", pr, p))
+        got[f"{tag}{i}"] = []
+    done = set()
+    while len(done) < len(prompts):
+        for ev in eng.step():
+            if ev.token is not None:
+                got[ev.request_id].append(ev.token)
+            if ev.finished:
+                done.add(ev.request_id)
+    return [got[f"{tag}{i}"] for i in range(len(prompts))]
+
+
+def test_pipelined_token_identity_greedy_and_sampled(eng_sync, eng_pipe):
+    """depth=2 streams match depth=1 exactly, greedy and seeded-sampled,
+    with concurrent requests of different budgets (mid-window finishes
+    exercise the reconciliation fallback)."""
+    prompts = [list(range(3, 19)), list(range(40, 50))]
+    for tag, params in (
+        ("g", [SamplingParams(max_tokens=13, temperature=0.0,
+                              ignore_eos=True),
+               SamplingParams(max_tokens=6, temperature=0.0,
+                              ignore_eos=True)]),
+        ("s", [SamplingParams(max_tokens=9, temperature=0.9, top_k=12,
+                              seed=7, ignore_eos=True),
+               SamplingParams(max_tokens=9, temperature=0.7, top_p=0.8,
+                              seed=3, ignore_eos=True)]),
+    ):
+        before = snap(eng_pipe)
+        sync = drive(eng_sync, prompts, params, f"id_{tag}_s")
+        pipe = drive(eng_pipe, prompts, params, f"id_{tag}_p")
+        assert pipe == sync
+        # the pipeline actually engaged: windows committed while their
+        # follow-up executed on device
+        d = delta(eng_pipe, before)
+        assert d["pipeline_windows"] > 0
+        assert d["pipeline_overlapped"] > 0
+
+
+def test_stop_mid_window_fallback_token_identity(eng_sync, eng_pipe):
+    """A hidden stop id sampled mid-window changes slot membership at
+    commit: the in-flight follow-up must be discarded (fallback counter)
+    and the stream must still equal the synchronous loop's."""
+    prompt = list(range(10, 26))
+    ref = eng_sync.generate(
+        prompt, SamplingParams(max_tokens=12, ignore_eos=True), "probe")
+    stop = ref[5]  # mid-second-window (windows of 4; ref[0] is prefill's)
+    p = SamplingParams(max_tokens=12, ignore_eos=True,
+                       stop_token_ids=(stop,))
+    sync = eng_sync.generate(prompt, p, "stop_s")
+    before = snap(eng_pipe)
+    pipe = eng_pipe.generate(prompt, p, "stop_p")
+    assert pipe == sync == ref[:5]
+    assert delta(eng_pipe, before)["pipeline_fallbacks"] >= 1
+
+
+def test_abort_mid_window_drops_cleanly(eng_sync, eng_pipe):
+    """Aborting a request while its window is in flight must drop its
+    tokens without corrupting the surviving request's stream (the commit
+    identity guard) or the allocator (no double-free)."""
+    p = SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True)
+    prompts = [list(range(3, 19)), list(range(40, 50))]
+    solo = eng_sync.generate(prompts[0], p, "ab_solo")
+
+    eng = eng_pipe
+    for i, pr in enumerate(prompts):
+        eng.add_request(EngineRequest(f"ab{i}", pr, p))
+    got = {"ab0": [], "ab1": []}
+    aborted = False
+    finished = set()
+    while eng.has_work():
+        if eng._pipeline is not None and not aborted \
+                and len(got["ab1"]) >= 2:
+            # a window is in flight and ab1 has streamed: abort it now
+            assert eng.abort("ab1")
+            aborted = True
+        for ev in eng.step():
+            got[ev.request_id].append(ev.token)
+            if ev.finished:
+                finished.add(ev.request_id)
+    assert aborted
+    assert "ab0" in finished and "ab1" not in finished
+    # survivor is exact; victim never emitted again after the abort
+    assert [t for t in got["ab0"] if t is not None] == solo
+    free = eng.scheduler.allocator.num_free
+    # ab0 finished too, so every page is back exactly once
+    assert free == eng.cfg.num_pages
+
+
+def test_microbench_one_sync_per_window_zero_uploads(eng_pipe,
+                                                     monkeypatch):
+    """Regression guard on the overlap invariant: with a stable slot set
+    whose pages are fully allocated at the first decode plan, the
+    pipelined loop issues exactly ONE blocking host sync per committed
+    window and uploads plan arrays exactly once."""
+    import jax
+
+    eng = eng_pipe
+    p = SamplingParams(max_tokens=32, temperature=0.0, ignore_eos=True)
+    eng.add_request(EngineRequest("micro", list(range(10, 30)), p))
+    while eng.scheduler.waiting:
+        eng.step()
+    before = snap(eng)
+
+    syncs = {"n": 0}
+    real_get = jax.device_get
+
+    def counting_get(x):
+        syncs["n"] += 1
+        return real_get(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+    while eng.has_work():
+        eng.step()
+    d = delta(eng, before)
+    windows_committed = d["pipeline_windows"]
+    assert windows_committed == 32 // eng.cfg.decode_steps
+    # <= 1 host sync per window, measured at the jax boundary
+    assert syncs["n"] <= windows_committed
+    assert d["decode_host_syncs"] == windows_committed
+    # prompt(20) + max_tokens(32) fit one 64-token page: allocation never
+    # grows mid-request, so only the FIRST window staged host arrays
+    assert d["decode_plan_uploads"] == 1
+    # and every window after the first committed while its follow-up ran
+    assert d["pipeline_overlapped"] >= windows_committed - 2
+
+
+def test_pipeline_counters_on_metrics(eng_pipe):
+    """EngineMetrics carries the pipeline occupancy counters and they
+    ADVANCE across a run (the /metrics source of truth; the exporter
+    gauge rendering is covered in test_metrics_exporter.py)."""
+    eng = eng_pipe
+    m0 = eng.metrics()
+    p = SamplingParams(max_tokens=16, temperature=0.0, ignore_eos=True)
+    eng.generate(list(range(5, 21)), p, "metrics")
+    m1 = eng.metrics()
+    assert m1.decode_windows > m0.decode_windows
+    assert m1.pipeline_windows > m0.pipeline_windows
+    assert m1.pipeline_overlapped > m0.pipeline_overlapped
+    assert m1.decode_host_syncs > m0.decode_host_syncs
+    assert m1.decode_plan_uploads > m0.decode_plan_uploads
+    # the wire path keeps them: WorkerMetrics.from_dict round-trip
+    import dataclasses
+
+    from dynamo_tpu.kv_router.scoring import WorkerMetrics
+    w = WorkerMetrics.from_dict(dataclasses.asdict(m1))
+    assert w.pipeline_overlapped == m1.pipeline_overlapped
+    assert w.decode_plan_uploads == m1.decode_plan_uploads
+
+
+def test_depth_one_is_fully_synchronous(eng_sync):
+    """pipeline_depth=1 keeps the old loop: no deferred commits, no
+    pipeline counters, events in the same step as the dispatch."""
+    eng = eng_sync
+    before = snap(eng)
+    p = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    out = eng.generate(list(range(5, 21)), p, "d1")
+    assert len(out) == 8
+    assert eng._pipeline is None
+    d = delta(eng, before)
+    assert d["pipeline_windows"] == 0
+    assert d["decode_host_syncs"] == d["decode_windows"] > 0

@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R6), the
+- one positive AND one negative fixture per AST rule (R1-R8), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -374,6 +374,82 @@ def test_r7_live_on_current_serving_layers():
         with open(path) as f:
             found = lint_source(f.read(), rel)
         assert not [x for x in found if x.rule == "R7"], rel
+
+
+# -- R8: blocking device syncs inside hot-path regions ------------------------
+
+R8_SRC = """
+    import jax
+    import numpy as np
+
+    def commit(outs, dev_aux):
+        # dynalint: hot-path-begin
+        toks = jax.device_get(outs)
+        dev_aux.block_until_ready()
+        host = np.asarray(dev_aux)
+        # dynalint: hot-path-end
+        return toks, host
+"""
+
+
+def test_r8_flags_syncs_in_region():
+    assert len([f for f in lint(R8_SRC) if f.rule == "R8"]) == 3
+
+
+def test_r8_quiet_outside_region():
+    # same code with no region markers: R8 does not apply (R6 needs the
+    # file-level marker, which this fixture also lacks)
+    stripped = R8_SRC.replace("hot-path-begin", "").replace(
+        "hot-path-end", "")
+    assert "R8" not in rules(lint(stripped))
+
+
+def test_r8_quiet_on_annotated_sync_point():
+    neg = """
+        import jax
+        import numpy as np
+
+        def commit(outs, other):
+            # dynalint: hot-path-begin
+            toks = jax.device_get(outs)  # dynalint: sync-point — the one
+            #   intended per-window output fetch
+            host = np.asarray(toks)   # toks came from device_get: host view
+            counts = np.zeros((4,), np.int32)
+            counts2 = np.asarray(counts)  # numpy-born: free view, no sync
+            # dynalint: hot-path-end
+            return host, counts2
+    """
+    assert "R8" not in rules(lint(neg))
+
+
+def test_r8_region_does_not_trip_file_level_r6():
+    # hot-path-begin/end scope a REGION for R8; they must not opt the
+    # whole file into R6 (which would flag host code outside the region)
+    src = """
+        import jax
+
+        def region(outs):
+            # dynalint: hot-path-begin
+            x = outs
+            # dynalint: hot-path-end
+            return x
+
+        def boundary(outs):
+            return jax.device_get(outs)
+    """
+    assert "R6" not in rules(lint(src))
+
+
+def test_r8_live_on_engine_decode_region():
+    """The pipelined decode staging/dispatch region in engine/engine.py
+    must stay R8-clean: every blocking sync there carries an explicit
+    `# dynalint: sync-point` justification."""
+    path = os.path.join(REPO, "dynamo_tpu", "engine", "engine.py")
+    with open(path) as f:
+        src = f.read()
+    assert "# dynalint: hot-path-begin" in src   # the region exists
+    found = lint_source(src, "dynamo_tpu/engine/engine.py")
+    assert not [f for f in found if f.rule == "R8"]
 
 
 # -- jaxpr invariants ----------------------------------------------------------

@@ -19,17 +19,24 @@ def test_exporter_two_worker_graph():
     async def main():
         plane = MemoryPlane()
         rts = []
+        # w0 also reports decode-pipeline occupancy, through a mutable
+        # dict so the test can advance it mid-run (what a live engine's
+        # step loop does) and assert the gauges follow
+        pipe_stats = {"decode_windows": 4, "pipeline_windows": 3,
+                      "pipeline_overlapped": 2, "pipeline_fallbacks": 1,
+                      "decode_host_syncs": 4, "decode_plan_uploads": 1}
         for i, (active, total) in enumerate(((3, 16), (5, 16))):
             rt = await DistributedRuntime.create_local(plane, f"w{i}")
             ep = rt.namespace("ns").component("worker").endpoint("generate")
+            extra = pipe_stats if i == 0 else {}
             await ep.serve(
                 fake_engine,
-                stats_handler=lambda a=active, t=total: {
+                stats_handler=lambda a=active, t=total, e=extra: {
                     "request_active_slots": 1, "request_total_slots": 4,
                     "kv_active_blocks": a, "kv_total_blocks": t,
                     "num_requests_waiting": 0,
                     "gpu_cache_usage_perc": a / t,
-                    "gpu_prefix_cache_hit_rate": 0.5})
+                    "gpu_prefix_cache_hit_rate": 0.5, **e})
             rts.append(rt)
 
         ert = await DistributedRuntime.create_local(plane, "exporter")
@@ -57,6 +64,15 @@ def test_exporter_two_worker_graph():
             assert "llm_workers 2" in body
             assert "llm_load_avg 4" in body
             assert "llm_router_kv_hit_rate 0.75" in body
+            # decode-pipeline occupancy gauges (overlap counters)
+            assert 'llm_decode_windows{worker="w0"} 4' in body
+            assert 'llm_decode_pipeline_overlapped{worker="w0"} 2' in body
+            assert 'llm_decode_pipeline_fallbacks{worker="w0"} 1' in body
+            assert 'llm_decode_plan_uploads{worker="w0"} 1' in body
+            # the engine keeps committing overlapped windows: the gauges
+            # must ADVANCE with the next scrape
+            pipe_stats.update(decode_windows=11, pipeline_windows=10,
+                              pipeline_overlapped=9, decode_host_syncs=10)
 
             # reliability counter snapshots ride the event plane the same
             # way ({ns}.{source}.reliability) and fold into gauges labeled
@@ -82,6 +98,9 @@ def test_exporter_two_worker_graph():
             writer.close()
             assert 'llm_kv_blocks_active{worker="w1"}' not in body2
             assert "llm_workers 1" in body2
+            assert 'llm_decode_windows{worker="w0"} 11' in body2
+            assert 'llm_decode_pipeline_overlapped{worker="w0"} 9' in body2
+            assert 'llm_decode_host_syncs{worker="w0"} 10' in body2
             assert 'llm_reliability_migrations{source="front0"} 3' in body2
             assert 'llm_reliability_retries{source="front0"} 2' in body2
             assert 'llm_reliability_breaker_opens{source="front0"} 1' \

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""decode_profile: phase-attributed decode-loop profiling harness.
+
+VERDICT r5 weak #2: decode throughput sat at ~60% (bf16) / ~45% (int8) of
+the weight-bound roofline with the byte-independent remainder — host plan
+building, per-window uploads, the blocking output fetch, commit/detok
+bookkeeping — never attributed. This tool turns that gap into a measured
+breakdown:
+
+1. **Attribution pass** (pipeline_depth=1, engine.profile_sync=True): the
+   engine's PhaseTimer splits each decode window's wall time into
+   plan / upload / dispatch / device / fetch / commit, and the harness
+   times detokenization of the emitted events — the full
+   "plan/upload/device/fetch/commit/detok" split per window.
+2. **Overlap pass** (pipeline_depth=2): the same workload through the
+   overlapped pipeline; reports wall-time speedup, the pipeline occupancy
+   counters (windows / overlapped / fallbacks / host syncs / plan
+   uploads), and the host seconds that executed concurrently with device
+   compute.
+
+The record is appended (append-only, final name — tools/artifacts.py
+policy, VERDICT r5 weak #7) to DECODE_PROFILE.jsonl at the repo root.
+Optionally wraps the timed loops in a jax.profiler trace (--trace-dir)
+for op-level drill-down in TensorBoard/XProf.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/decode_profile.py            # tiny, CPU
+    python tools/decode_profile.py --model llama3-1b --slots 8 \
+        --decode-steps 64 --windows 20 --trace-dir /tmp/xprof
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools.artifacts import append_jsonl  # noqa: E402
+
+DEFAULT_OUT = os.path.join(REPO_ROOT, "DECODE_PROFILE.jsonl")
+
+
+def build_engine(args, depth: int):
+    import dataclasses
+
+    from dynamo_tpu.engine.config import (
+        EngineConfig, ModelConfig, get_model_config,
+    )
+    from dynamo_tpu.engine.engine import NativeEngine
+
+    if args.model == "tiny-f32":
+        mcfg = ModelConfig(dtype="float32", max_model_len=2048)
+    else:
+        mcfg = get_model_config(args.model)
+    if args.quant:
+        mcfg = dataclasses.replace(mcfg, quant=args.quant)
+    ecfg = EngineConfig(
+        page_size=args.page_size,
+        num_pages=args.num_pages,
+        max_slots=args.slots,
+        max_prefill_chunk=512,
+        max_model_len=min(mcfg.max_model_len, 2048),
+        decode_steps=args.decode_steps,
+        pipeline_depth=depth,
+    )
+    return NativeEngine(mcfg, ecfg, seed=0)
+
+
+def run_pass(args, depth: int, profile_sync: bool, trace_dir=None) -> dict:
+    """One measured decode run; returns phases + counters + wall time."""
+    import jax
+
+    from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+
+    eng = build_engine(args, depth)
+    max_tokens = args.windows * args.decode_steps
+    params = SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                            ignore_eos=True)
+    for i in range(args.slots):
+        prompt = [(131 * i + j) % (eng.model_cfg.vocab_size - 1) + 1
+                  for j in range(args.prompt_len)]
+        eng.add_request(EngineRequest(f"p{i}", prompt, params))
+    # warmup: prefill + two windows so every program is compiled before
+    # the timed loop (first-use XLA compiles would swamp the phases)
+    while eng.scheduler.waiting:
+        eng.step()
+    for _ in range(2):
+        eng.step()
+    eng.phases.reset()
+    eng.profile_sync = profile_sync
+
+    detok_buf = []
+    tokens = 0
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
+    t0 = time.perf_counter()
+    while eng.has_work():
+        events = eng.step()
+        # the detokenize leg of the commit path: what llm/worker.py does
+        # with each event before the bytes can leave the process
+        with eng.phases.phase("detok"):
+            for ev in events:
+                if ev.token is not None:
+                    detok_buf.append(f"<{ev.token}>")
+                    tokens += 1
+    wall = time.perf_counter() - t0
+    if trace_dir:
+        jax.profiler.stop_trace()
+
+    return {
+        "depth": depth,
+        "profile_sync": profile_sync,
+        "wall_s": round(wall, 4),
+        "tokens": tokens,
+        "tok_s": round(tokens / wall, 1) if wall else 0.0,
+        "phases": eng.phases.split(),
+        "counters": {
+            "decode_windows": eng.decode_windows,
+            "pipeline_windows": eng.pipeline_windows,
+            "pipeline_overlapped": eng.pipeline_overlapped,
+            "pipeline_fallbacks": eng.pipeline_fallbacks,
+            "decode_host_syncs": eng.decode_host_syncs,
+            "decode_plan_uploads": eng.decode_plan_uploads,
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", default="tiny-f32",
+                    help="registry name, or tiny-f32 (default: CPU-sized)")
+    ap.add_argument("--quant", default="", help="'' or int8")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--prompt-len", type=int, default=64)
+    ap.add_argument("--decode-steps", type=int, default=8)
+    ap.add_argument("--windows", type=int, default=12,
+                    help="decode windows per request in the timed loop")
+    ap.add_argument("--page-size", type=int, default=64)
+    ap.add_argument("--num-pages", type=int, default=512)
+    ap.add_argument("--out", default=DEFAULT_OUT,
+                    help="append-only JSONL artifact (final name)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="also capture a jax.profiler trace here")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    # 1. attribution: synchronous loop, device time isolated per phase
+    attribution = run_pass(args, depth=1, profile_sync=True,
+                           trace_dir=args.trace_dir)
+    # 2. overlap: the pipelined loop on the same workload
+    pipelined = run_pass(args, depth=2, profile_sync=False)
+
+    host_phases = ("plan", "upload", "commit", "detok")
+    hidden_s = sum(pipelined["phases"].get(p, {}).get("seconds", 0.0)
+                   for p in host_phases)
+    c = pipelined["counters"]
+    record = {
+        "t": time.time(),
+        "argv": vars(args),
+        "jax": jax.__version__,
+        "platform": jax.devices()[0].platform,
+        "device_count": jax.device_count(),
+        "attribution": attribution,
+        "pipelined": pipelined,
+        "overlap": {
+            # host seconds that executed while the device ran a window
+            "host_s_overlapped_with_device": round(hidden_s, 4),
+            "overlap_fraction": round(
+                c["pipeline_overlapped"] / c["pipeline_windows"], 4)
+            if c["pipeline_windows"] else 0.0,
+            "speedup": round(
+                attribution["wall_s"] / pipelined["wall_s"], 3)
+            if pipelined["wall_s"] else 0.0,
+        },
+    }
+    append_jsonl(args.out, record)
+    print(json.dumps(record["overlap"]))
+    print(f"appended record to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -133,7 +133,8 @@ def main() -> int:
             off["revisit_ttft_p50_ms"] / on["revisit_ttft_p50_ms"], 2)
         if on["revisit_ttft_p50_ms"] else None,
     }
-    json.dump(result, open(args.out, "w"), indent=1)
+    from tools.artifacts import write_json
+    write_json(args.out, result, overwrite=True)  # final name, no renames
     log("wrote", args.out)
     print(json.dumps(result))
     return 0

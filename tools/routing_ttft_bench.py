@@ -302,7 +302,8 @@ def main() -> int:
             rr["latency_mean_ms"] / kv["latency_mean_ms"], 2)
         if kv["latency_mean_ms"] else None,
     }
-    json.dump(result, open(args.out, "w"), indent=1)
+    from tools.artifacts import write_json
+    write_json(args.out, result, overwrite=True)  # final name, no renames
     log("wrote", args.out)
     print(json.dumps(result))
     return 0

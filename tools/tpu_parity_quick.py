@@ -79,7 +79,11 @@ def main() -> int:
     }
     if quant:
         record["quant"] = quant
-    json.dump(record, open(OUT, "w"), indent=1)
+    # evidence-artifact policy (tools/artifacts.py, VERDICT r5 weak #7):
+    # final name, written once; a re-run of the same capture overwrites
+    # deliberately rather than renaming the old file aside
+    from tools.artifacts import write_json
+    write_json(OUT, record, overwrite=True)
     log(f"wrote {OUT}")
     return 0 if verdict.startswith("exact") else 1
 
