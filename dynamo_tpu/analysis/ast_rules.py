@@ -24,6 +24,9 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       np.asarray(<device array>)) inside a `# dynalint: hot-path-begin`
       .. `hot-path-end` region without an explicit
       `# dynalint: sync-point` justification
+- R9  `except Exception:` in the serving layers (runtime/, disagg/,
+      frontend/) whose body only passes or logs-and-continues, without a
+      `# dynalint: swallow-ok=<reason>` annotation
 """
 from __future__ import annotations
 
@@ -532,6 +535,77 @@ def r8_sync_in_hot_path_region(tree: ast.AST, lines: List[str],
             "move the read to the window's single fetch, start an async "
             "copy (copy_to_host_async) instead, or annotate the line "
             "with `# dynalint: sync-point(<why this must block>)`"))
+    return out
+
+
+# -- R9: silently swallowed exceptions in the serving layers ------------------
+
+# Scope: the layers where a swallowed exception hides a *peer's* failure
+# from every recovery mechanism built to observe it — a lost heartbeat,
+# a dropped completion notify, a failed eviction all degrade silently.
+# The faults PR made this concrete: an injected FaultInjected that lands
+# in an unannotated `except Exception: pass` simply vanishes, and the
+# chaos run "passes" without the recovery path ever running. Engine code
+# is out of scope (exceptions there surface through the step loop).
+_R9_SCOPE = ("runtime/", "disagg/", "frontend/")
+_R9_ANNOT_RE = re.compile(r"#\s*dynalint:\s*swallow-ok=\S+")
+_R9_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                   "critical"}
+
+
+def _only_passes_or_logs(body: List[ast.stmt]) -> bool:
+    """True when the handler body does NO handling: just pass/continue/
+    bare-return and logging calls. Anything else (fallback logic,
+    cleanup, state mutation, re-raise) counts as real handling."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return) and (
+                stmt.value is None
+                or (isinstance(stmt.value, ast.Constant)
+                    and stmt.value.value is None)):
+            continue
+        if isinstance(stmt, ast.Expr) \
+                and isinstance(stmt.value, ast.Call) \
+                and isinstance(stmt.value.func, ast.Attribute) \
+                and stmt.value.func.attr in _R9_LOG_METHODS:
+            continue
+        return False
+    return True
+
+
+@rule("R9")
+def r9_swallowed_exception(tree: ast.AST, lines: List[str],
+                           path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R9_SCOPE):
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R9_ANNOT_RE.search(_line(lines, x))
+                   for x in (ln, ln - 1))
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler) or node.type is None:
+            continue   # bare `except:` is R4's territory
+        types = node.type.elts if isinstance(node.type, ast.Tuple) \
+            else [node.type]
+        if not any(_unparse(t) == "Exception" for t in types):
+            continue   # narrow typed handlers are deliberate
+        if not _only_passes_or_logs(node.body):
+            continue
+        if annotated(node.lineno):
+            continue
+        out.append(_finding(
+            "R9", path, lines, node,
+            "`except Exception` swallows the error (pass/log-and-"
+            "continue) on a serving path — a peer failure, or an "
+            "injected fault, degrades this layer silently and no "
+            "recovery mechanism ever observes it",
+            "handle it (retry/fallback/cleanup), re-raise, or annotate "
+            "with `# dynalint: swallow-ok=<why losing this error is "
+            "correct>`"))
     return out
 
 

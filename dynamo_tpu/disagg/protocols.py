@@ -34,6 +34,13 @@ class RemotePrefillRequest(pydantic.BaseModel):
     page_size: int = 0        # decode engine page size (must match prefill)
     # fully-qualified messaging subject for the PrefillCompletion notify
     notify_subject: str = ""
+    # client deadline as an absolute unix timestamp (time.time()); the
+    # request Context's monotonic deadline can't cross processes, so the
+    # decode worker converts remaining seconds at enqueue. A queued item
+    # whose deadline has passed is dropped AT DEQUEUE — an expired
+    # client must not burn a prefill engine slot. Wall clocks only need
+    # to agree to within the lease/backoff noise this already tolerates.
+    deadline_unix: Optional[float] = None
     # multimodal: the prefill worker re-encodes these through its own vision
     # tower (pixels travel, embeds don't — they're mesh-layout-dependent)
     mm_parts: Optional[List[ImagePart]] = None

@@ -21,6 +21,7 @@ from typing import Optional, Tuple
 import msgpack
 
 from dynamo_tpu.disagg.protocols import RemotePrefillRequest
+from dynamo_tpu.runtime import faults
 
 
 def queue_name(namespace: str, model: str) -> str:
@@ -40,6 +41,11 @@ class PrefillQueue:
 
     async def dequeue(self, timeout: Optional[float] = None
                       ) -> Optional[RemotePrefillRequest]:
+        # `queue.dequeue` failpoint fires BEFORE the pop, so an injected
+        # drop/delay can never lose a dequeued item — consumers retry
+        # and the item is still queued
+        if faults.REGISTRY.enabled:
+            await faults.REGISTRY.fire("queue.dequeue")
         payload = await self.messaging.queue_pop(self.name, timeout=timeout)
         if payload is None:
             return None
@@ -52,6 +58,8 @@ class PrefillQueue:
         """Dequeue under a redelivery lease; returns (request, lease_token).
         The item is re-enqueued if `ack(token)` doesn't arrive within
         lease_s — size the lease above the worst-case prefill+transfer."""
+        if faults.REGISTRY.enabled:  # pre-pop: injected faults lose nothing
+            await faults.REGISTRY.fire("queue.dequeue")
         got = await self.messaging.queue_pop_leased(
             self.name, timeout=timeout, lease_s=lease_s)
         if got is None:

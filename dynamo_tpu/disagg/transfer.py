@@ -19,9 +19,17 @@ Backends:
 from __future__ import annotations
 
 import abc
+import logging
 from typing import Dict
 
 import jax
+
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.integrity import (
+    STATS as INTEGRITY, IntegrityError, page_checksum,
+)
+
+log = logging.getLogger("dynamo_tpu.disagg.transfer")
 
 
 class TransferBackend(abc.ABC):
@@ -61,13 +69,22 @@ class LocalTransferBackend(TransferBackend):
         worker = self._receivers.get(engine_id)
         if worker is None:
             raise KeyError(f"unknown decode engine {engine_id!r}")
+        ids = list(dst_page_ids)
+        if faults.REGISTRY.enabled \
+                and faults.REGISTRY.armed("remote_transfer.fetch_page"):
+            # chaos mode: route through a host staging hop so the
+            # transfer failpoint has real bytes to corrupt, with the
+            # same capture-checksum/verify/bounded-re-fetch contract as
+            # the TCP backend (zero cost when the site is disarmed —
+            # the fast path below never leaves the device)
+            k_pages, v_pages = await self._verified_stage(
+                request_id, ids, k_pages, v_pages)
         # The cross-mesh move + relayout: place the pages with the decode
         # engine's cache sharding (ICI/DCN transfer; resharding handles
         # prefill-TP != decode-TP, the kv_rearrange equivalent).
         shd = worker.engine.cache_sharding
         k = jax.device_put(k_pages, shd)
         v = jax.device_put(v_pages, shd)
-        ids = list(dst_page_ids)
 
         def inject(eng):
             # guard against decode-side timeout/release: the pages may have
@@ -79,3 +96,40 @@ class LocalTransferBackend(TransferBackend):
             eng.inject_pages(ids, k, v)
 
         await worker.submit(inject)
+
+    @staticmethod
+    async def _verified_stage(request_id: str, ids, k_pages, v_pages,
+                              max_refetch: int = 2):
+        """Chaos-mode staging hop: device -> host (checksums at capture)
+        -> transfer failpoint -> verify -> host arrays for device_put.
+        A mismatch re-fetches from the still-authoritative device copy;
+        past the budget the transfer is abandoned (IntegrityError) and
+        the decode side re-prefills."""
+        import asyncio
+
+        import numpy as np
+        for attempt in range(max_refetch + 1):
+            k_np, v_np = await asyncio.to_thread(
+                lambda: (np.asarray(jax.device_get(k_pages)),
+                         np.asarray(jax.device_get(v_pages))))
+            sums = [page_checksum(k_np[:, :, i], v_np[:, :, i])
+                    for i in range(len(ids))]
+            INTEGRITY.pages_hashed += len(ids)
+            k_bytes = faults.REGISTRY.corrupt_bytes(
+                "remote_transfer.fetch_page", k_np.tobytes())
+            k_np = np.frombuffer(k_bytes, k_np.dtype).reshape(k_np.shape)
+            bad = [ids[i] for i in range(len(ids))
+                   if page_checksum(k_np[:, :, i], v_np[:, :, i])
+                   != sums[i]]
+            if not bad:
+                INTEGRITY.pages_verified += len(ids)
+                return k_np, v_np
+            INTEGRITY.mismatches += len(bad)
+            if attempt < max_refetch:
+                INTEGRITY.refetches += 1
+                log.warning("local kv transfer integrity mismatch for "
+                            "%s; re-fetch %d/%d", request_id, attempt + 1,
+                            max_refetch)
+        INTEGRITY.quarantined += len(ids)
+        INTEGRITY.reprefills += 1
+        raise IntegrityError(f"local transfer for {request_id!r}", bad)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import OrderedDict
 
 from dynamo_tpu.disagg.protocols import (
@@ -86,7 +87,7 @@ class DisaggDecodeWorker(NativeEngineWorker):
         async for _subject, payload in sub:
             try:
                 done = PrefillCompletion.model_validate_json(payload)
-            except Exception:
+            except Exception:  # dynalint: swallow-ok=malformed-peer-frame-logged
                 log.exception("malformed prefill completion: %r",
                               payload[:200])
                 continue
@@ -111,7 +112,7 @@ class DisaggDecodeWorker(NativeEngineWorker):
                 depth = await self.prefill_queue.depth()
                 use_remote = self.disagg_router.prefill_remote(
                     len(req.prompt), prefix_hit, depth)
-            except Exception:
+            except Exception:  # dynalint: swallow-ok=falls-back-to-local-prefill
                 log.exception("disagg decision failed; prefilling locally")
         if not use_remote:
             self.local_prefills += 1
@@ -167,6 +168,10 @@ class DisaggDecodeWorker(NativeEngineWorker):
             self.remote_prefills += 1
             fut: asyncio.Future = asyncio.get_running_loop().create_future()
             self._completions[rid] = fut
+            # propagate the client deadline into the queued item as an
+            # absolute wall-clock instant, so a prefill worker dequeuing
+            # it after expiry drops it instead of burning compute
+            remaining = context.time_remaining()
             await self.prefill_queue.enqueue(RemotePrefillRequest(
                 engine_id=self.engine_id,
                 request_id=rid,
@@ -178,6 +183,8 @@ class DisaggDecodeWorker(NativeEngineWorker):
                 page_size=self.engine.cfg.page_size,
                 notify_subject=self.notify_subject,
                 mm_parts=mm_parts,
+                deadline_unix=(time.time() + remaining
+                               if remaining is not None else None),
             ))
             stop_task = asyncio.create_task(context.wait_stopped())
             try:
@@ -199,7 +206,7 @@ class DisaggDecodeWorker(NativeEngineWorker):
                         cancel_subject(self.prefill_queue.name),
                         PrefillCancel(
                             request_id=rid).model_dump_json().encode())
-                except Exception:
+                except Exception:  # dynalint: swallow-ok=best-effort-cancel-broadcast
                     log.exception("prefill cancel publish failed for %s", rid)
                 yield EngineOutput(
                     finish_reason=FinishReason.CANCELLED).model_dump(
@@ -207,6 +214,17 @@ class DisaggDecodeWorker(NativeEngineWorker):
                 return
             completion = fut.result() if fut.done() else None
             if completion is None or completion.error:
+                if context.deadline_expired:
+                    # the client budget is spent (the queue-side expiry
+                    # drop lands here too): a local re-prefill would burn
+                    # decode compute for a dead stream
+                    await self.submit(lambda eng: eng.release_remote(rid))
+                    holding = False
+                    yield EngineOutput(
+                        finish_reason=FinishReason.ERROR,
+                        text="deadline exceeded during remote prefill",
+                    ).model_dump(exclude_none=True)
+                    return
                 # remote prefill failed or timed out: recompute locally
                 log.warning("remote prefill failed for %s (%s); local "
                             "fallback", rid,
@@ -301,6 +319,7 @@ class PrefillWorker:
         self.completed = 0
         self.failed = 0
         self.cancelled = 0
+        self.expired = 0  # items dropped at dequeue: client deadline passed
 
     async def start(self) -> "PrefillWorker":
         await self.worker.start()
@@ -325,6 +344,37 @@ class PrefillWorker:
             t.cancel()
         await self.worker.stop()
 
+    async def drain(self, timeout_s: float = 30.0,
+                    poll_s: float = 0.05) -> dict:
+        """Planned-maintenance shutdown: stop consuming the queue first
+        (queued work stays durable for surviving consumers), give
+        in-flight items up to timeout_s to finish+ack, then stop. Items
+        still unacked at the deadline are cancelled WITHOUT an ack — the
+        lease expires and they are RE-LEASED to a surviving prefill
+        worker, so a rolling restart drops no queued prefill
+        (docs/RESILIENCE.md runbook)."""
+        from dynamo_tpu.runtime.component import DRAIN_STATS
+        DRAIN_STATS.drains_started += 1
+        if self._loop_task:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+            self._loop_task = None
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        while self._inflight \
+                and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(poll_s)
+        releasing = len(self._inflight)
+        if releasing:
+            log.warning("prefill drain: %d item(s) past the deadline; "
+                        "leases will redeliver them", releasing)
+        DRAIN_STATS.cancelled_streams += releasing
+        await self.stop()
+        DRAIN_STATS.drains_completed += 1
+        return {"re_leased": releasing}
+
     def _note_cancelled(self, rid: str) -> None:
         self._cancelled[rid] = None
         while len(self._cancelled) > 1024:
@@ -334,7 +384,7 @@ class PrefillWorker:
         async for _subject, payload in sub:
             try:
                 cancel = PrefillCancel.model_validate_json(payload)
-            except Exception:
+            except Exception:  # dynalint: swallow-ok=malformed-peer-frame-logged
                 log.exception("malformed prefill cancel: %r", payload[:200])
                 continue
             rid = cancel.request_id
@@ -374,6 +424,22 @@ class PrefillWorker:
                 self._cancelled.pop(req.request_id, None)
                 self.cancelled += 1
                 await self.queue.ack(token)
+                self._slots.release()
+                continue
+            if req.deadline_unix is not None \
+                    and time.time() >= req.deadline_unix:
+                # the client's deadline passed while the item sat queued:
+                # running the prefill now burns an engine slot for a
+                # stream that is already dead. Settle the lease and tell
+                # the decode side (which stops waiting immediately
+                # instead of riding out prefill_timeout_s).
+                self.expired += 1
+                log.info("prefill %s expired in queue (deadline passed); "
+                         "dropped at dequeue", req.request_id)
+                await self.queue.ack(token)
+                await self._notify(req, PrefillCompletion(
+                    request_id=req.request_id,
+                    error="deadline exceeded before prefill started"))
                 self._slots.release()
                 continue
             # handle concurrently: the engine interleaves chunked prefills,
@@ -450,5 +516,5 @@ class PrefillWorker:
         try:
             await self.messaging.publish(
                 req.notify_subject, done.model_dump_json().encode())
-        except Exception:
+        except Exception:  # dynalint: swallow-ok=decode-timeout-covers-lost-notify
             log.exception("completion notify failed for %s", req.request_id)

@@ -19,11 +19,17 @@ is the planned upgrade path for overlap; the tier protocol stays the same.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.integrity import STATS as INTEGRITY, page_checksum
+
+log = logging.getLogger("dynamo_tpu.offload")
 
 
 @dataclasses.dataclass
@@ -64,19 +70,26 @@ class DiskKvPool:
         self._hash_at: List[Optional[int]] = [None] * capacity
         self._free: List[int] = list(range(capacity - 1, -1, -1))
         self._lru: Dict[int, None] = {}
+        # capture-time checksum per slot: travels WITH the page across
+        # tiers (never recomputed from a possibly-corrupt copy)
+        self._sum_at: List[Optional[int]] = [None] * capacity
 
     def __contains__(self, seq_hash: int) -> bool:
         return seq_hash in self._by_hash
 
-    def put(self, seq_hash: int, k_page: np.ndarray, v_page: np.ndarray
-            ) -> bool:
+    def put(self, seq_hash: int, k_page: np.ndarray, v_page: np.ndarray,
+            sum_: Optional[int] = None) -> bool:
         """Store (LRU-evicting); returns True when an existing entry was
-        evicted to make room."""
+        evicted to make room. `sum_` is the page's capture-time checksum
+        (computed fresh for direct callers without one)."""
         if seq_hash in self._by_hash:
             slot = self._by_hash[seq_hash]
             self._lru.pop(slot, None)
             self._lru[slot] = None
             return False
+        if sum_ is None:
+            sum_ = page_checksum(k_page, v_page)
+            INTEGRITY.pages_hashed += 1
         evicted = False
         if self._free:
             slot = self._free.pop()
@@ -87,21 +100,41 @@ class DiskKvPool:
             evicted = True
         self.k_slab[slot] = k_page
         self.v_slab[slot] = v_page
+        self._sum_at[slot] = sum_
+        if faults.REGISTRY.enabled:   # at-rest rot in the disk tier
+            faults.REGISTRY.corrupt_array("offload.write_tier",
+                                          self.k_slab[slot])
         self._by_hash[seq_hash] = slot
         self._hash_at[slot] = seq_hash
         self._lru[slot] = None
         return evicted
 
     def take(self, seq_hash: int
-             ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-        """Read AND remove (promote-to-DRAM semantics): returns copies."""
+             ) -> Optional[Tuple[np.ndarray, np.ndarray, int]]:
+        """Read AND remove (promote-to-DRAM semantics): returns verified
+        copies plus the traveling checksum, or None on a miss OR an
+        integrity mismatch (the rotten entry is quarantined — already
+        removed — and the page will be recomputed)."""
         slot = self._by_hash.pop(seq_hash, None)
         if slot is None:
             return None
         self._hash_at[slot] = None
         self._lru.pop(slot, None)
         self._free.append(slot)
-        return np.array(self.k_slab[slot]), np.array(self.v_slab[slot])
+        k = np.array(self.k_slab[slot])
+        v = np.array(self.v_slab[slot])
+        if faults.REGISTRY.enabled:   # rot surfacing on the read path
+            faults.REGISTRY.corrupt_array("offload.read_tier", k)
+        sum_ = self._sum_at[slot]
+        self._sum_at[slot] = None
+        if sum_ is not None and page_checksum(k, v) != sum_:
+            INTEGRITY.mismatches += 1
+            INTEGRITY.quarantined += 1
+            log.warning("disk kv page %x failed integrity check; "
+                        "quarantined (will recompute)", seq_hash)
+            return None
+        INTEGRITY.pages_verified += 1
+        return k, v, sum_
 
 
 class HostKvPool:
@@ -125,6 +158,9 @@ class HostKvPool:
         self._free: List[int] = list(range(capacity - 1, -1, -1))
         # insertion-ordered dict as an O(1) LRU (oldest = first key)
         self._lru: Dict[int, None] = {}
+        # capture-time checksum per slot (runtime/integrity.py): verified
+        # at pin/get, carried down to the disk tier on spill
+        self._sum_at: List[Optional[int]] = [None] * capacity
         # pin counts by hash: pinned entries are claimed by a pending
         # onboard (an HBM page was already sealed expecting this payload)
         # and must survive LRU until drained
@@ -152,12 +188,50 @@ class HostKvPool:
         tier if needed. Returns False if the entry is in neither tier —
         the containment check and the pin must be one atomic step, or a
         concurrent CopyStream put() can evict the slot in between
-        (code-review r3)."""
+        (code-review r3).
+
+        The pin is also the integrity gate: the entry's bytes are
+        verified against the capture-time checksum HERE, before the
+        prefix walk can claim the page (an HBM page gets sealed
+        expecting this payload). A mismatch quarantines the entry and
+        returns False — the walk treats it as a miss and the page is
+        recomputed; corrupted bytes can never reach the device cache."""
         with self._mu:
             if seq_hash not in self._by_hash and not self._promote(seq_hash):
                 return False
+            slot = self._by_hash[seq_hash]
+            if seq_hash not in self._pins and not self._verify(slot):
+                self._quarantine(seq_hash, slot)
+                return False
             self._pins[seq_hash] = self._pins.get(seq_hash, 0) + 1
             return True
+
+    def _verify(self, slot: int) -> bool:
+        """Lock held: fire the read-tier failpoint and check the slot's
+        bytes against its capture-time checksum."""
+        if faults.REGISTRY.enabled:   # rot surfacing on the read path
+            faults.REGISTRY.corrupt_array("offload.read_tier",
+                                          self.k_slab[slot])
+        sum_ = self._sum_at[slot]
+        if sum_ is None:
+            return True
+        if page_checksum(self.k_slab[slot], self.v_slab[slot]) != sum_:
+            INTEGRITY.mismatches += 1
+            return False
+        INTEGRITY.pages_verified += 1
+        return True
+
+    def _quarantine(self, seq_hash: int, slot: int) -> None:
+        """Lock held: drop a corrupt entry so the walk misses and the
+        page is recomputed — never served."""
+        del self._by_hash[seq_hash]
+        self._hash_at[slot] = None
+        self._sum_at[slot] = None
+        self._lru.pop(slot, None)
+        self._free.append(slot)
+        INTEGRITY.quarantined += 1
+        log.warning("host kv page %x failed integrity check; quarantined "
+                    "(will recompute)", seq_hash)
 
     def unpin(self, seq_hash: int) -> None:
         with self._mu:
@@ -168,22 +242,26 @@ class HostKvPool:
                 self._pins[seq_hash] = n
 
     def _promote(self, seq_hash: int) -> bool:
-        """Lock held: move a disk-tier page up into the DRAM slab."""
+        """Lock held: move a disk-tier page up into the DRAM slab (the
+        disk take verifies integrity; a quarantined entry is a miss)."""
         if self.disk is None:
             return False
         got = self.disk.take(seq_hash)
         if got is None:
             return False
-        if not self._insert(seq_hash, got[0], got[1]):
+        k, v, sum_ = got
+        if not self._insert(seq_hash, k, v, sum_):
             # DRAM fully pinned: return the page to disk, don't lose it
-            self.disk.put(seq_hash, got[0], got[1])
+            self.disk.put(seq_hash, k, v, sum_)
             return False
         self.stats.disk_hits += 1
         return True
 
-    def _insert(self, seq_hash: int, k_page, v_page) -> bool:
+    def _insert(self, seq_hash: int, k_page, v_page,
+                sum_: Optional[int]) -> bool:
         """Lock held: place a page in the DRAM slab, spilling the LRU
-        victim down to the disk tier when one exists."""
+        victim down to the disk tier when one exists. `sum_` is the
+        capture-time checksum traveling with the page."""
         if seq_hash in self._by_hash:
             self._touch(self._by_hash[seq_hash])
             return True
@@ -204,14 +282,21 @@ class HostKvPool:
                 del self._by_hash[old]
                 if self.disk is not None:
                     # spill down instead of dropping (multi-tier ladder,
-                    # reference kv/storage.rs tier roles)
+                    # reference kv/storage.rs tier roles); the DRAM slot's
+                    # checksum travels down with the page, so corruption
+                    # in this tier cannot be laundered by the spill
                     if self.disk.put(old, self.k_slab[slot],
-                                     self.v_slab[slot]):
+                                     self.v_slab[slot],
+                                     self._sum_at[slot]):
                         self.stats.disk_evicted += 1
                     self.stats.disk_offloaded += 1
             self.stats.evicted += 1
         self.k_slab[slot] = k_page
         self.v_slab[slot] = v_page
+        self._sum_at[slot] = sum_
+        if faults.REGISTRY.enabled:   # at-rest rot in the DRAM tier
+            faults.REGISTRY.corrupt_array("offload.write_tier",
+                                          self.k_slab[slot])
         self._by_hash[seq_hash] = slot
         self._hash_at[slot] = seq_hash
         self._lru[slot] = None
@@ -219,21 +304,32 @@ class HostKvPool:
 
     def put(self, seq_hash: int, k_page: np.ndarray, v_page: np.ndarray
             ) -> None:
+        # checksum at CAPTURE: k/v here are the authoritative copy just
+        # pulled off the device (CopyStream); everything downstream —
+        # slab residency, disk spills, promotions — verifies against it
         with self._mu:
             if seq_hash in self._by_hash:   # duplicate: refresh LRU only,
                 self._touch(self._by_hash[seq_hash])  # don't count as a
                 return                                # new offload
-            if self._insert(seq_hash, k_page, v_page):
+            sum_ = page_checksum(k_page, v_page)
+            INTEGRITY.pages_hashed += 1
+            if self._insert(seq_hash, k_page, v_page, sum_):
                 self.stats.offloaded += 1
 
     def get(self, seq_hash: int
             ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Pinned entries were verified at pin() and their slots are
+        stable (put never evicts pinned slots), so they return directly;
+        an unpinned get re-verifies and quarantines on mismatch."""
         with self._mu:
             slot = self._by_hash.get(seq_hash)
             if slot is None:
                 if not self._promote(seq_hash):
                     return None
                 slot = self._by_hash[seq_hash]
+            if seq_hash not in self._pins and not self._verify(slot):
+                self._quarantine(seq_hash, slot)
+                return None
             self._touch(slot)
             return self.k_slab[slot], self.v_slab[slot]
 

@@ -16,6 +16,7 @@ interference (reference: docs/disagg_serving.md).
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from collections import deque
 from typing import Dict, List, Optional, Sequence
 
@@ -251,6 +252,16 @@ class Scheduler:
         self._prefix_hits = 0
         self._prefix_lookups = 0
         self._prefill_streak = 0
+        # monotonic epoch source shared by admission AND preemption: the
+        # engine's device-resident decode carry and the sampler's host
+        # array caches key slots by (request_id, epoch), so every
+        # (re)admission must get an epoch no earlier sequence ever held.
+        # Epoch 0 for every admission let a request REUSING a finished
+        # request's id (stable client ids, retries) collide with the dead
+        # request's signature and decode from its stale device carry —
+        # silently wrong tokens (found by the fault-injection PR's
+        # integrity tests sharing an oracle engine).
+        self._epoch_seq = itertools.count(1)
 
     # -- request lifecycle ---------------------------------------------------
 
@@ -296,7 +307,8 @@ class Scheduler:
                 for j in range(emb.shape[0]):
                     prompt[off + j] = int((base + j) % 0x7FFFFFF0) + 1
         seq = SequenceState(request_id=req.request_id, prompt=prompt,
-                            prefill_only=req.prefill_only, mm_spans=spans)
+                            prefill_only=req.prefill_only, mm_spans=spans,
+                            epoch=next(self._epoch_seq))
         self.params[req.request_id] = req.params
         self._match_prefix(seq)
         return seq
@@ -768,7 +780,9 @@ class Scheduler:
             raise MemoryError("KV cache exhausted with nothing to preempt")
         self.running[victim.slot] = None
         victim.slot = -1
-        victim.epoch += 1  # invalidate device-resident decode state reuse
+        # fresh GLOBAL epoch (not +=1): a bumped epoch must never equal
+        # one a later same-id admission draws from the shared source
+        victim.epoch = next(self._epoch_seq)
         for pid in victim.pages:
             self.allocator.free(pid)
         victim.pages = []

@@ -101,7 +101,7 @@ class ModelWatcher:
                         await self._on_put(ev.key, ev.value)
                     else:
                         await self._on_delete(ev.key)
-                except Exception:
+                except Exception:  # dynalint: swallow-ok=watch-pump-must-outlive-bad-event
                     log.exception("model watch event failed: %s", ev.key)
 
         self._task = asyncio.create_task(pump())

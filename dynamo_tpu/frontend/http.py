@@ -134,13 +134,13 @@ class HttpServer:
                     break
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
-        except Exception:
+        except Exception:  # dynalint: swallow-ok=connection-scoped-error-logged
             log.exception("connection handler error")
         finally:
             try:
                 writer.close()
                 await writer.wait_closed()
-            except Exception:
+            except Exception:  # dynalint: swallow-ok=best-effort-socket-close
                 pass
 
     async def _read_request(self, reader: asyncio.StreamReader
@@ -231,7 +231,7 @@ class HttpServer:
         async def monitor():
             try:
                 await reader.read(1)
-            except Exception:
+            except Exception:  # dynalint: swallow-ok=errors-and-eof-both-mean-disconnect
                 pass
             req.disconnected.set()
 
@@ -255,5 +255,5 @@ class HttpServer:
             if gen_close is not None:
                 try:
                     await gen_close()
-                except Exception:
+                except Exception:  # dynalint: swallow-ok=best-effort-stream-close
                     pass

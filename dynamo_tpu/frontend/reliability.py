@@ -132,7 +132,7 @@ class ReliabilityMetrics:
                 await asyncio.sleep(interval_s)
                 try:
                     await self.publish(component)
-                except Exception:
+                except Exception:  # dynalint: swallow-ok=periodic-publish-retries-next-tick
                     log.exception("reliability snapshot publish failed")
 
         return asyncio.create_task(loop())
@@ -352,7 +352,7 @@ class ReliableClient:
                                                  exclude=blocked)
                 self.breaker.on_dispatch(wid)
                 return wid
-            except Exception:
+            except Exception:  # dynalint: swallow-ok=falls-back-to-load-balancing
                 log.exception("kv routing failed; falling back to %s",
                               self.route_policy)
         ids = [i for i in self.client.instance_ids() if i not in blocked]
@@ -533,7 +533,7 @@ class ReliableClient:
                     if aclose is not None:
                         try:
                             await aclose()
-                        except Exception:
+                        except Exception:  # dynalint: swallow-ok=best-effort-stream-close
                             pass
 
                 if deadline_hit:

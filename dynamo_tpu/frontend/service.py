@@ -96,6 +96,25 @@ class HttpService:
         self._duration = m.histogram(
             "llm_http_service_request_duration_seconds",
             "request duration", ("model",))
+        # robustness surfaces (process-local): fault-injection hits,
+        # KV data-plane integrity counters, graceful-drain counters.
+        # Refreshed from their global stats objects at render time —
+        # the sources are plain ints incremented on hot paths, the
+        # gauge conversion costs only the /metrics scrape.
+        self._fault_hits = m.gauge(
+            "llm_fault_site_hits", "failpoint site evaluations", ("site",))
+        self._fault_injected = m.gauge(
+            "llm_fault_injections", "faults actually injected", ("site",))
+        self._integrity = {
+            name: m.gauge(f"llm_kv_integrity_{name}",
+                          f"kv data-plane integrity: {name}")
+            for name in ("pages_hashed", "pages_verified", "mismatches",
+                         "refetches", "quarantined", "reprefills")}
+        self._drain = {
+            name: m.gauge(f"llm_drain_{name}",
+                          f"graceful drain: {name}")
+            for name in ("drains_started", "drains_completed",
+                         "drained_streams", "cancelled_streams")}
         s = self.server
         s.route("POST", "/v1/chat/completions", self._chat)
         s.route("POST", "/v1/completions", self._completions)
@@ -127,8 +146,27 @@ class HttpService:
         return Response.json(self.models.list_models().model_dump())
 
     async def _metrics(self, req: Request) -> Response:
+        self._refresh_robustness_gauges()
         return Response.text(self.registry.render(),
                              content_type="text/plain; version=0.0.4")
+
+    def _refresh_robustness_gauges(self) -> None:
+        """Fold the process-global fault/integrity/drain counters into
+        this registry's gauges (called per /metrics render)."""
+        from dynamo_tpu.runtime import faults
+        from dynamo_tpu.runtime.component import DRAIN_STATS
+        from dynamo_tpu.runtime.integrity import STATS as integrity_stats
+        snap = faults.REGISTRY.snapshot()
+        for site, n in snap["hits"].items():
+            self._fault_hits.set(site, value=n)
+        for site, n in snap["injected"].items():
+            self._fault_injected.set(site, value=n)
+        for name, value in integrity_stats.snapshot().items():
+            if name in self._integrity:
+                self._integrity[name].set(value=value)
+        for name, value in DRAIN_STATS.snapshot().items():
+            if name in self._drain:
+                self._drain[name].set(value=value)
 
     async def _chat(self, req: Request):
         try:

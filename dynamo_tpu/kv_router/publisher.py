@@ -132,8 +132,15 @@ class KvMetricsAggregator:
         # scheduler's optimistic bump has teeth (zero totals would make it
         # look permanently idle and attract the whole request stream between
         # scrapes). Either way a live instance must never count as removed —
-        # removal purges its radix-index entries.
-        for worker_id in set(self.client.instances) - set(workers):
+        # removal purges its radix-index entries. DRAINING instances are
+        # deliberately NOT carried forward (instance_ids excludes them):
+        # they fall into `removed`, which fences their index entries and
+        # drops them from scheduling until they come back ready.
+        # (getattr: scrape-only client doubles in tests lack the
+        # lifecycle-aware instance_ids surface)
+        list_ids = getattr(self.client, "instance_ids", None)
+        live = list_ids() if list_ids is not None else self.client.instances
+        for worker_id in set(live) - set(workers):
             last = self._last_scraped.get(worker_id)
             workers[worker_id] = (dataclasses.replace(last)
                                   if last is not None else WorkerMetrics(

@@ -56,6 +56,30 @@ class KvRouter:
                 self.indexer.revive_worker(worker_id)
 
         self.aggregator.on_update(on_metrics)
+
+        def on_instance(kind, worker_id, info):
+            # watch-event-time eviction: the moment discovery drops an
+            # instance (deregistration or lease expiry) its cached-prefix
+            # scores and endpoint entry go — NOT at the next metrics
+            # scrape. Before this, a dead worker's radix-index overlap
+            # kept out-scoring live workers for every warm prefix, so
+            # each such stream burned one failed dispatch on the corpse
+            # until the circuit breaker tripped.
+            from dynamo_tpu.runtime.component import (
+                STATUS_DRAINING, instance_status,
+            )
+            if kind == "delete":
+                self.indexer.remove_worker(worker_id)
+                self.scheduler.remove_worker(worker_id)
+            elif kind == "put" \
+                    and instance_status(info) == STATUS_DRAINING:
+                # drain fence: keep the worker out of prefix scoring so
+                # cached-overlap can't pull new streams onto it; its
+                # in-flight streams keep running untouched
+                self.indexer.remove_worker(worker_id)
+
+        if hasattr(self.client, "add_listener"):
+            self.client.add_listener(on_instance)
         await self.aggregator.start()
         return self
 
@@ -75,7 +99,14 @@ class KvRouter:
                        exclude=()) -> str:
         """Pick the best worker for this token sequence; returns worker_id.
         `exclude`: instances currently ejected (circuit breaker open) —
-        dropped from scoring unless that would leave no candidates."""
+        dropped from scoring unless that would leave no candidates.
+        DRAINING instances join the exclusion the same way (planned
+        maintenance takes no new assignments)."""
+        draining = getattr(self.client, "draining_ids", None)
+        if draining is not None:
+            drains = draining()
+            if drains:
+                exclude = set(exclude) | set(drains)
         overlap = self.find_matches_for_tokens(tokens)
         worker_id = self.scheduler.schedule(len(tokens), overlap,
                                             exclude=exclude)

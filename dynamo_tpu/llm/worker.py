@@ -352,10 +352,13 @@ async def serve_llm_worker(runtime, namespace: str, component: str,
 
 def install_graceful_drain(runtime, served, timeout_s: float = None) -> None:
     """SIGTERM/SIGINT -> graceful drain for a serving worker process:
-    deregister the endpoint first (the instance key disappears, so
-    routers/clients stop sending new work here), let in-flight response
-    streams finish (bounded by DYN_DRAIN_TIMEOUT_S, default 30 s), then
-    shut the runtime down so the process exits cleanly.
+    mark the instance DRAINING first (routers and the kv_router fence it
+    out of NEW assignments while the request subject stays up), let
+    in-flight response streams finish (bounded by DYN_DRAIN_TIMEOUT_S,
+    default 30 s), cut whatever is left (those streams migrate through
+    the reliability layer, token-identical), deregister, then shut the
+    runtime down so the process exits cleanly. This is one leg of a
+    zero-drop rolling restart (docs/RESILIENCE.md runbook).
 
     The reference couples SIGTERM to its runtime cancellation token and
     drains endpoints the same way (graceful shutdown for k8s rolling
@@ -375,21 +378,14 @@ def install_graceful_drain(runtime, served, timeout_s: float = None) -> None:
     state = {"task": None, "force": False}
 
     async def drain():
-        log.warning("SIGTERM: draining — deregistering, then up to %.0fs "
-                    "for %d in-flight stream(s)", timeout_s,
+        log.warning("SIGTERM: draining — fencing instance, then up to "
+                    "%.0fs for %d in-flight stream(s)", timeout_s,
                     len(served.inflight))
         try:
-            await served.shutdown()
-        except Exception:  # noqa: BLE001 — drain regardless
-            log.exception("deregistration failed; draining anyway")
-        deadline = loop.time() + timeout_s
-        while served.inflight and loop.time() < deadline \
-                and not state["force"]:
-            await asyncio.sleep(0.2)
-        if served.inflight:
-            log.warning("%s: %d stream(s) still in flight",
-                        "second signal" if state["force"]
-                        else "drain timeout", len(served.inflight))
+            await served.drain(timeout_s=timeout_s, poll_s=0.2,
+                               force=lambda: state["force"])
+        except Exception:  # noqa: BLE001 — exit cleanly regardless
+            log.exception("drain failed; shutting down anyway")
         await runtime.shutdown()
 
     def on_signal():

@@ -29,6 +29,37 @@ log = logging.getLogger("dynamo_tpu.component")
 # tightened further by the request Context's deadline when one is armed
 DISPATCH_ACK_TIMEOUT_S = 30.0
 
+# instance lifecycle states carried in the instance-key JSON ("status").
+# READY is implicit (absent == ready, so pre-drain registrations need no
+# migration); DRAINING = planned maintenance: routers stop NEW
+# assignments, in-flight streams finish within the drain deadline or
+# migrate via the reliability layer (docs/RESILIENCE.md runbook).
+STATUS_READY = "ready"
+STATUS_DRAINING = "draining"
+
+
+class DrainStats:
+    """Process-local drain counters (/metrics: llm_drain_*)."""
+
+    def __init__(self):
+        self.drains_started = 0
+        self.drains_completed = 0
+        self.drained_streams = 0       # finished within the deadline
+        self.cancelled_streams = 0     # cut at the deadline (migrate)
+
+    def snapshot(self):
+        return dict(self.__dict__)
+
+
+DRAIN_STATS = DrainStats()
+
+
+def instance_status(info: Optional[Dict[str, Any]]) -> str:
+    """Lifecycle status of an instance-key value (absent => ready)."""
+    if not info:
+        return STATUS_READY
+    return info.get("status", STATUS_READY)
+
 
 def instance_key(ns: str, comp: str, endpoint: str, worker_id: str) -> str:
     return f"{ns}/components/{comp}/{endpoint}:{worker_id}"
@@ -186,7 +217,7 @@ class Endpoint:
         await rt.kv.put(self.key_for(worker_id), json.dumps(info).encode(),
                         rt.lease.id if rt.lease else 0)
         served = ServedEndpoint(self, worker_id, unserve, stats_handler,
-                                inflight=inflight)
+                                inflight=inflight, info=info)
         rt.register_served(served)
         if stats_handler is not None:
             stats_subject = f"$STATS.{subject}"
@@ -208,7 +239,7 @@ def _packed(gen) -> AsyncIterator[bytes]:
 
 class ServedEndpoint:
     def __init__(self, endpoint: Endpoint, worker_id: str, unserve,
-                 stats_handler=None, inflight: set = None):
+                 stats_handler=None, inflight: set = None, info=None):
         self.endpoint = endpoint
         self.worker_id = worker_id
         self._unserve = unserve
@@ -216,7 +247,65 @@ class ServedEndpoint:
         self.stats_handler = stats_handler
         # live response pumps (graceful drain waits on this emptying)
         self.inflight: set = inflight if inflight is not None else set()
+        self.info: Dict[str, Any] = dict(info or {})
         self._shut = False
+        self.draining = False
+
+    async def mark_draining(self) -> None:
+        """Flip this instance to DRAINING: the instance key is re-put
+        with status=draining (same lease), so every watching client and
+        router fences it out of NEW assignments while the request
+        subject stays up for in-flight streams."""
+        self.draining = True
+        rt = self.endpoint._rt
+        info = {**self.info, "status": STATUS_DRAINING}
+        await rt.kv.put(self.endpoint.key_for(self.worker_id),
+                        json.dumps(info).encode(),
+                        rt.lease.id if rt.lease else 0)
+
+    async def drain(self, timeout_s: float = 30.0,
+                    poll_s: float = 0.05,
+                    force: Optional[Callable[[], bool]] = None) -> dict:
+        """Zero-drop maintenance shutdown of this instance.
+
+        1. mark DRAINING (routers stop picking it — kv_router fences its
+           indexer entries, clients drop it from selection);
+        2. wait up to timeout_s for in-flight response streams to finish
+           (`force()` returning True skips the wait — the double-SIGTERM
+           operator escalation);
+        3. cancel whatever is left — the client side sees the stream cut
+           WITHOUT a finish frame and the reliability layer migrates it,
+           committed prefix intact (token-identical, docs/RESILIENCE.md);
+        4. deregister + unserve (shutdown()).
+
+        Returns a summary dict; counters land on DRAIN_STATS.
+        """
+        DRAIN_STATS.drains_started += 1
+        started_with = len(self.inflight)
+        try:
+            await self.mark_draining()
+        except Exception:  # dynalint: swallow-ok=drain-proceeds-without-fence
+            log.exception("drain: marking %s draining failed; "
+                          "draining anyway", self.worker_id)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        while self.inflight and loop.time() < deadline \
+                and not (force is not None and force()):
+            await asyncio.sleep(poll_s)
+        cancelled = len(self.inflight)
+        for task in list(self.inflight):
+            # the cut stream migrates via the reliability layer; a raw
+            # client sees a reset, same as a worker death
+            task.cancel()
+        DRAIN_STATS.drained_streams += max(0, started_with - cancelled)
+        DRAIN_STATS.cancelled_streams += cancelled
+        if cancelled:
+            log.warning("drain %s: %d stream(s) cut at the deadline "
+                        "(migrating)", self.worker_id, cancelled)
+        await self.shutdown()
+        DRAIN_STATS.drains_completed += 1
+        return {"worker_id": self.worker_id, "inflight_at_start":
+                started_with, "cancelled": cancelled}
 
     async def shutdown(self):
         # idempotent (drain calls it, then runtime.shutdown sweeps all
@@ -249,6 +338,20 @@ class Client:
         self._rr = 0
         self._watch_task: Optional[asyncio.Task] = None
         self._ready = asyncio.Event()
+        # instance-change listeners: cb(kind, worker_id, info) fired on
+        # every watch event AS IT ARRIVES — the kv_router evicts a dead
+        # worker's indexer entries here, immediately, instead of waiting
+        # for the next metrics scrape to notice (a dead worker's cached-
+        # prefix score otherwise keeps attracting routes until the
+        # circuit breaker trips)
+        self._listeners: List[Callable[[str, str, Optional[dict]], None]] \
+            = []
+
+    def add_listener(self,
+                     cb: Callable[[str, str, Optional[dict]], None]) -> None:
+        """Register cb(kind, worker_id, info); kind is "put"/"delete".
+        Called synchronously from the watch pump — keep it cheap."""
+        self._listeners.append(cb)
 
     async def start(self) -> "Client":
         prefix = instance_key(self.endpoint.ns, self.endpoint.component.name,
@@ -267,13 +370,20 @@ class Client:
 
     def _apply(self, kind: str, key: str, value: Optional[bytes]):
         worker_id = key.rsplit(":", 1)[-1]
+        info: Optional[Dict[str, Any]] = None
         if kind == "put" and value is not None:
             try:
-                self.instances[worker_id] = json.loads(value)
+                info = json.loads(value)
             except (ValueError, TypeError):
-                pass
+                return
+            self.instances[worker_id] = info
         elif kind == "delete":
             self.instances.pop(worker_id, None)
+        for cb in self._listeners:
+            try:
+                cb(kind, worker_id, info)
+            except Exception:  # dynalint: swallow-ok=listener-fault-must-not-kill-watch
+                log.exception("instance listener failed for %s", worker_id)
 
     async def wait_for_instances(self, timeout: float = 10.0) -> None:
         deadline = asyncio.get_running_loop().time() + timeout
@@ -283,8 +393,21 @@ class Client:
                     f"no instances of {self.endpoint.subject_for('*')}")
             await asyncio.sleep(0.02)
 
-    def instance_ids(self) -> List[str]:
-        return sorted(self.instances)
+    def instance_ids(self, include_draining: bool = False) -> List[str]:
+        """Dispatchable instance ids. DRAINING instances are excluded —
+        planned maintenance must attract no new assignments — UNLESS
+        every live instance is draining (a probe on a draining-but-alive
+        worker beats failing the request outright, the same fallback
+        shape as the circuit breaker's all-ejected case)."""
+        if include_draining:
+            return sorted(self.instances)
+        ready = sorted(w for w, info in self.instances.items()
+                       if instance_status(info) != STATUS_DRAINING)
+        return ready if ready else sorted(self.instances)
+
+    def draining_ids(self) -> List[str]:
+        return sorted(w for w, info in self.instances.items()
+                      if instance_status(info) == STATUS_DRAINING)
 
     # -- routing -------------------------------------------------------------
 

@@ -136,7 +136,7 @@ class DistributedRuntime:
         for served in self._served:
             try:
                 await served.shutdown()
-            except Exception:
+            except Exception:  # dynalint: swallow-ok=shutdown-sweep-continues
                 pass
         if self._lease_watch:
             self._lease_watch.cancel()
@@ -146,7 +146,7 @@ class DistributedRuntime:
         if self.lease is not None:
             try:
                 await self.lease.revoke()
-            except Exception:
+            except Exception:  # dynalint: swallow-ok=lease-expiry-covers-failed-revoke
                 pass
         if self._data_plane is not None:
             await self._data_plane.stop()

@@ -1,0 +1,77 @@
+"""KV data-plane integrity: per-page checksums, verify-on-fetch, quarantine.
+
+The reference's data plane (NIXL/RDMA KV transfer, multi-tier offload)
+silently trusts every byte; FlowKV and LMCache (PAPERS.md) both report
+that once KV pages cross transports and storage tiers, corruption and
+stale/partial pages become the dominant correctness hazard — not
+crashes. The contract this module anchors: **a corrupted transfer or
+tier read may cost latency, but can never change emitted tokens.**
+
+Mechanics (the state machine is drawn out in docs/RESILIENCE.md):
+
+- a checksum is computed **at capture** — the moment page bytes leave
+  the authoritative copy (staged on the prefill host for a transfer,
+  handed to the host pool for an offload) — and travels WITH the page
+  across every hop and tier; it is never recomputed from a copy that
+  could already be corrupt (recomputing would launder corruption).
+- every consumer **verifies on fetch** (transfer inject, tier read)
+  before the bytes can reach the device cache.
+- a transfer mismatch triggers a **bounded re-fetch** (the sender still
+  holds the authoritative pages); a tier mismatch **quarantines** the
+  entry (dropped from the tier, counted) so the prefix walk misses and
+  the page is recomputed.
+- persistent transfer mismatch gives up on the remote path entirely and
+  falls back to **re-prefill** (the PR 2 `resume_committed`/local
+  recompute machinery) — degraded latency, identical tokens.
+
+Counters live on the process-global ``STATS`` and render on /metrics
+(frontend/service.py) as ``llm_kv_integrity_*``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import numpy as np
+import xxhash
+
+
+def page_checksum(*arrays) -> int:
+    """xxh3-64 over the concatenated raw bytes of one page's arrays
+    (k then v). Computed at capture; verified at every fetch."""
+    h = xxhash.xxh3_64(seed=0)
+    for a in arrays:
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.intdigest()
+
+
+class IntegrityError(ValueError):
+    """A fetched page's bytes do not match its capture-time checksum."""
+
+    def __init__(self, where: str, pages):
+        self.where = where
+        self.pages = list(pages)
+        super().__init__(
+            f"kv integrity mismatch at {where}: page(s) {self.pages}")
+
+
+@dataclasses.dataclass
+class IntegrityStats:
+    """Process-global counters (/metrics: llm_kv_integrity_*)."""
+
+    pages_hashed: int = 0      # checksums computed at capture
+    pages_verified: int = 0    # fetch-time verifications that passed
+    mismatches: int = 0        # fetch-time verifications that failed
+    refetches: int = 0         # transfer retries triggered by a mismatch
+    quarantined: int = 0       # tier entries dropped on verify failure
+    reprefills: int = 0        # remote paths abandoned for local recompute
+
+    def snapshot(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+    def reset(self) -> None:
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, 0)
+
+
+STATS = IntegrityStats()

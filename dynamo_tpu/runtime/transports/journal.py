@@ -254,7 +254,7 @@ class Journal:
                         stop = True
                     else:  # ("snap", (gen, snapshot_bytes))
                         self._write_snapshot(*it[1])
-                except Exception:  # pragma: no cover — keep draining
+                except Exception:  # pragma: no cover — keep draining  # dynalint: swallow-ok=writer-thread-must-keep-draining
                     log.exception("journal write failed")
                 finally:
                     self._q.task_done()

@@ -15,11 +15,22 @@ import uuid
 from collections import defaultdict
 from typing import AsyncIterator, Dict, List, Optional, Tuple
 
+from dynamo_tpu.runtime import faults
 from dynamo_tpu.runtime.transports.base import (
     KVEntry, KVStore, Lease, Messaging, WatchEvent, subject_matches,
 )
 
 log = logging.getLogger("dynamo_tpu.memory_plane")
+
+
+async def _lossy_fire(site: str):
+    """Failpoint hook for fire-and-forget deliveries: a drop loses the
+    message instead of raising (pub/sub has no error channel). Returns
+    None when the message is lost, else the Outcome."""
+    try:
+        return await faults.REGISTRY.fire(site)
+    except faults.FaultInjected:
+        return None
 
 
 class LatencyModel:
@@ -58,6 +69,8 @@ class MemoryKVStore(KVStore):
 
     async def put(self, key: str, value: bytes, lease_id: int = 0) -> None:
         await self._latency.apply()
+        if faults.REGISTRY.enabled:   # drop => ConnectionError to caller
+            await faults.REGISTRY.fire("transport.send")
         self._data[key] = KVEntry(key, value, lease_id)
         if lease_id:
             self._lease_keys[lease_id].add(key)
@@ -72,15 +85,21 @@ class MemoryKVStore(KVStore):
 
     async def get(self, key: str) -> Optional[bytes]:
         await self._latency.apply()
+        if faults.REGISTRY.enabled:
+            await faults.REGISTRY.fire("transport.send")
         e = self._data.get(key)
         return e.value if e else None
 
     async def get_prefix(self, prefix: str) -> List[KVEntry]:
         await self._latency.apply()
+        if faults.REGISTRY.enabled:
+            await faults.REGISTRY.fire("transport.send")
         return [e for k, e in sorted(self._data.items()) if k.startswith(prefix)]
 
     async def delete(self, key: str) -> None:
         await self._latency.apply()
+        if faults.REGISTRY.enabled:
+            await faults.REGISTRY.fire("transport.send")
         e = self._data.pop(key, None)
         if e is not None:
             if e.lease_id:
@@ -100,6 +119,11 @@ class MemoryKVStore(KVStore):
         return lease
 
     def _keep_alive(self, lease_id: int, ttl: float):
+        if faults.REGISTRY.enabled:
+            try:
+                faults.REGISTRY.fire_sync("discovery.heartbeat")
+            except faults.FaultInjected:
+                return  # heartbeat lost: deadline not refreshed
         if lease_id in self._lease_deadline:
             self._lease_deadline[lease_id] = time.monotonic() + ttl
 
@@ -172,6 +196,8 @@ class MemoryMessaging(Messaging):
 
     async def request(self, subject, payload, timeout: float = 30.0):
         await self._latency.apply()
+        if faults.REGISTRY.enabled:   # drop => ConnectionError, retried by
+            await faults.REGISTRY.fire("transport.send")  # reliability layer
         handler = self._handlers.get(subject)
         if handler is None:
             raise ConnectionError(f"no responder on subject {subject!r}")
@@ -179,9 +205,23 @@ class MemoryMessaging(Messaging):
 
     async def publish(self, subject, payload):
         await self._latency.apply()
+        send_dup = False
+        if faults.REGISTRY.enabled:
+            out = await _lossy_fire("transport.send")
+            if out is None:
+                return  # event lost on the wire: fire-and-forget
+            send_dup = out.duplicate
         for pattern, q in list(self._subs):
             if subject_matches(pattern, subject):
-                q.put_nowait((subject, payload))
+                if faults.REGISTRY.enabled:
+                    out = await _lossy_fire("transport.recv")
+                    if out is None:
+                        continue  # lost for THIS subscriber only
+                    q.put_nowait((subject, payload))
+                    if out.duplicate or send_dup:
+                        q.put_nowait((subject, payload))
+                else:
+                    q.put_nowait((subject, payload))
 
     async def subscribe(self, subject):
         q: asyncio.Queue = asyncio.Queue()

@@ -104,6 +104,9 @@ class ControlPlaneClient(KVStore, Messaging):
     # -- plumbing ------------------------------------------------------------
 
     async def _send(self, msg):
+        from dynamo_tpu.runtime import faults
+        if faults.REGISTRY.enabled:   # drop => ConnectionError (FaultInjected)
+            await faults.REGISTRY.fire("transport.send")
         async with self._write_lock:
             write_frame(self._writer, msg)
             await self._writer.drain()
@@ -205,9 +208,15 @@ class ControlPlaneClient(KVStore, Messaging):
         """Heartbeat at ttl/3; a lost lease fires lease.lost (the runtime
         couples that to shutdown, as the reference couples its primary etcd
         lease to the cancellation token)."""
+        from dynamo_tpu.runtime import faults
         try:
             while True:
                 await asyncio.sleep(ttl / 3)
+                if faults.REGISTRY.enabled:
+                    try:
+                        await faults.REGISTRY.fire("discovery.heartbeat")
+                    except faults.FaultInjected:
+                        continue  # this heartbeat round is lost
                 try:
                     ok = (await self._rpc({"op": "lease_keepalive",
                                            "lease": lease_id}, timeout=ttl))["ok"]
@@ -234,7 +243,7 @@ class ControlPlaneClient(KVStore, Messaging):
                 self._watch_queues.pop(wid, None)
                 try:
                     await self._rpc({"op": "unwatch", "watch_id": wid})
-                except Exception:
+                except Exception:  # dynalint: swallow-ok=best-effort-unwatch-on-close
                     pass
 
         return snapshot, gen()
@@ -275,7 +284,7 @@ class ControlPlaneClient(KVStore, Messaging):
                 self._sub_queues.pop(sid, None)
                 try:
                     await self._rpc({"op": "unsubscribe", "sub_id": sid})
-                except Exception:
+                except Exception:  # dynalint: swallow-ok=best-effort-unsubscribe-on-close
                     pass
 
         return gen()

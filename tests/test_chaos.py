@@ -1,30 +1,38 @@
-"""Fault-injection (chaos) harness over the in-process serving graph.
+"""Chaos harness over the in-process serving graph, rebased onto the
+failpoint registry (runtime/faults.py).
 
 SURVEY.md §5 notes the reference ships NO fault-injection framework and
 calls its mock network's injectable LatencyModel "the seed of one"
-(reference: lib/runtime/tests/common/mock.rs:31-60). This harness grows
-that seed: a seeded random-jitter latency model on EVERY control-plane op
-(KV, watch, messaging), a real router+workers serving graph behind the
-reliability layer (frontend/reliability.py), concurrent streams,
-mid-stream client aborts, and mid-run worker deaths — asserting
+(reference: lib/runtime/tests/common/mock.rs:31-60). Earlier rounds grew
+that seed into ad-hoc monkeypatching plus a jittery latency model; this
+round replaces both with **seeded fault schedules armed on named
+failpoint sites** — every scenario's fault plan is a serializable
+artifact (`{site: {seed, specs}}`), the same plan replays the same
+faults, and `tools/chaos_replay.py` re-runs any scenario from a recorded
+plan JSON.
+
+Each scenario is a plain function taking a plan dict (the pytest tests
+run the committed default plans; the replay tool runs recorded ones) and
+asserts its own contract internally:
 
   * liveness: nothing hangs (every phase under a hard deadline),
   * correctness: every greedy stream is token-identical to a direct
-    single-engine oracle (both workers share the init seed, so chaos may
-    delay or MIGRATE work but must never corrupt it),
-  * zero drop: a worker death is never client-visible. Streams in flight
-    on the killed worker migrate — prompt + committed prefix re-dispatch
-    to the survivor (PreprocessedRequest.resume_committed) — and continue
-    with no duplicated or missing token at the migration boundary. This
-    upgrades the original harness's contract ("only streams on the killed
-    worker may error") to "no stream errors, ever".
+    single-engine oracle (workers share the init seed, so chaos may
+    delay, MIGRATE, or re-prefill work but must never corrupt it),
+  * zero drop: neither an unplanned worker death NOR a planned drain is
+    ever client-visible. In-flight streams migrate — prompt + committed
+    prefix re-dispatch to a survivor (resume_committed) — and continue
+    with no duplicated or missing token at the boundary.
 
-The disaggregated (xPyD) graph gets its own seeded chaos test below:
-a prefill worker killed mid-item, recovered by the prefill queue's
-lease/redelivery (disagg/queue.py).
+Scenarios: the aggregated jitter/abort/worker-death run, the
+disaggregated (xPyD) prefill-worker death recovered by queue lease
+redelivery, and the rolling restart — every worker drained and replaced
+one at a time under live streaming load (the planned-maintenance leg of
+the zero-drop story, docs/RESILIENCE.md runbook).
 """
 import asyncio
-import random
+
+import pytest
 
 from dynamo_tpu.engine.config import EngineConfig, ModelConfig
 from dynamo_tpu.engine.engine import NativeEngine
@@ -34,31 +42,58 @@ from dynamo_tpu.frontend.reliability import (
 )
 from dynamo_tpu.llm.worker import NativeEngineWorker, serve_llm_worker
 from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.component import DRAIN_STATS
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.engine import Context
-from dynamo_tpu.runtime.transports.memory import LatencyModel, MemoryPlane
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
 
 CFG = ModelConfig(dtype="float32", max_model_len=512)
 PAGE = 8
+
+# -- the committed fault plans -------------------------------------------------
+# Every chaos scenario's faults come from one of these plan dicts: site ->
+# FaultSchedule dict. Seeded delays on the transport sites reproduce the
+# old JitterLatency's "jittery network" — but as a replayable artifact
+# (tools/chaos_replay.py re-arms a recorded plan byte-for-byte).
+
+AGGREGATED_PLAN = {
+    "transport.send": {"seed": 11, "specs": [
+        {"kind": "delay", "p": 1.0, "delay_s": 0.02}]},
+    "transport.recv": {"seed": 211, "specs": [
+        {"kind": "delay", "p": 1.0, "delay_s": 0.01}]},
+}
+
+DISAGG_PLAN = {
+    "transport.send": {"seed": 23, "specs": [
+        {"kind": "delay", "p": 1.0, "delay_s": 0.01}]},
+    "transport.recv": {"seed": 223, "specs": [
+        {"kind": "delay", "p": 1.0, "delay_s": 0.005}]},
+    # jitter the durable-queue consumption too: dequeue delays must only
+    # move work between consumers, never lose it
+    "queue.dequeue": {"seed": 323, "specs": [
+        {"kind": "delay", "p": 0.5, "delay_s": 0.01}]},
+}
+
+ROLLING_PLAN = {
+    "transport.send": {"seed": 31, "specs": [
+        {"kind": "delay", "p": 1.0, "delay_s": 0.005}]},
+    "transport.recv": {"seed": 231, "specs": [
+        {"kind": "delay", "p": 1.0, "delay_s": 0.003}]},
+}
+
+
+@pytest.fixture(autouse=True)
+def disarm_after():
+    yield
+    faults.REGISTRY.disarm()
+    faults.REGISTRY.reset_counters()
 
 
 def make_engine():
     return NativeEngine(CFG, EngineConfig(
         page_size=PAGE, num_pages=64, max_slots=4, max_prefill_chunk=32,
         prefill_buckets=(8, 16, 32), max_model_len=512), seed=0)
-
-
-class JitterLatency(LatencyModel):
-    """Seeded random delay per control-plane op — turns the in-memory
-    plane into a jittery 'network' that reorders interleavings."""
-
-    def __init__(self, seed: int, max_delay_s: float):
-        super().__init__(0.0)
-        self._rng = random.Random(seed)
-        self.max_delay_s = max_delay_s
-
-    async def apply(self):
-        await asyncio.sleep(self._rng.random() * self.max_delay_s)
 
 
 def pre_request(rid, prompt, max_tokens):
@@ -78,19 +113,42 @@ def prompt_for(i):
     return [(37 * i + j) % 200 + 3 for j in range(12 + (i % 3) * 4)]
 
 
-def test_chaos_jitter_abort_and_worker_death_zero_drop():
+_ORACLE_CACHE: dict = {}
+
+
+def greedy_oracle(n, max_tokens=6):
+    """Single-engine greedy oracle, cached across scenarios (engine
+    seed and sampling are fixed, so the expected streams are too)."""
+    missing = [i for i in range(n) if i not in _ORACLE_CACHE]
+    if missing:
+        eng = make_engine()
+        for i in missing:
+            _ORACLE_CACHE[i] = eng.generate(
+                prompt_for(i), SamplingParams(max_tokens=max_tokens,
+                                              temperature=0.0,
+                                              ignore_eos=True), f"o{i}")
+    return {i: _ORACLE_CACHE[i] for i in range(n)}
+
+
+def run_scenario(name, plan=None):
+    """Entry point shared with tools/chaos_replay.py: run one named
+    scenario under `plan` (default: its committed plan). Raises
+    AssertionError on any contract violation; returns a summary dict."""
+    fn, default_plan = SCENARIOS[name]
+    return fn(plan if plan is not None else default_plan)
+
+
+# -- scenario: aggregated jitter + aborts + unplanned worker death -------------
+
+def run_aggregated_zero_drop(plan):
     # oracle: same seed as both workers => identical params => identical
     # greedy tokens, independent of which worker serves — or whether the
     # stream migrated between workers mid-flight
-    oracle_engine = make_engine()
-    oracle = {}
-    for i in range(18):
-        oracle[i] = oracle_engine.generate(
-            prompt_for(i), SamplingParams(max_tokens=6, temperature=0.0,
-                                          ignore_eos=True), f"o{i}")
+    oracle = greedy_oracle(18)
 
     async def main():
-        plane = MemoryPlane(JitterLatency(seed=11, max_delay_s=0.02))
+        faults.REGISTRY.arm_from_dict(plan)
+        plane = MemoryPlane()
         wrt1 = await DistributedRuntime.create_local(plane, "w1")
         worker1 = await NativeEngineWorker(make_engine()).start()
         await serve_llm_worker(wrt1, "ns", "backend", worker1)
@@ -181,14 +239,24 @@ def test_chaos_jitter_abort_and_worker_death_zero_drop():
         await wrt1.shutdown()
         return metrics.snapshot()
 
-    snap = asyncio.run(main())
+    try:
+        snap = asyncio.run(main())
+    finally:
+        faults.REGISTRY.disarm()
     # the kill was observed and handled by the reliability layer, not
     # absorbed by luck: something stalled/retried/migrated during phase 2
     assert snap["migrations"] + snap["retries"] >= 1, snap
+    return {"reliability": snap, "faults": faults.REGISTRY.snapshot()}
 
 
-def test_chaos_disagg_prefill_worker_death_zero_drop():
-    """Disaggregated (xPyD) chaos: a prefill worker dies mid-item with
+def test_chaos_jitter_abort_and_worker_death_zero_drop():
+    run_scenario("aggregated_zero_drop")
+
+
+# -- scenario: disaggregated prefill worker death ------------------------------
+
+def run_disagg_prefill_death(plan):
+    """Disaggregated (xPyD) chaos: a prefill worker dies mid-item with a
     jittered control plane. The dequeued-but-unacked queue item's lease
     expires, it is REDELIVERED to the surviving prefill worker, and every
     client stream completes token-identical to the oracle — the decode
@@ -211,7 +279,8 @@ def test_chaos_disagg_prefill_worker_death_zero_drop():
             await asyncio.Event().wait()
 
     async def main():
-        plane = MemoryPlane(JitterLatency(seed=23, max_delay_s=0.01))
+        faults.REGISTRY.arm_from_dict(plan)
+        plane = MemoryPlane()
         queue = PrefillQueue(plane.messaging, "ns", "tiny")
         router = DisaggregatedRouter(max_local_prefill_length=4,
                                      max_prefill_queue_size=16)
@@ -258,7 +327,148 @@ def test_chaos_disagg_prefill_worker_death_zero_drop():
         await decode.stop()
         return redelivered, completed, decode.remote_prefills
 
-    redelivered, completed, remote = asyncio.run(main())
+    try:
+        redelivered, completed, remote = asyncio.run(main())
+    finally:
+        faults.REGISTRY.disarm()
     assert remote == len(prompts)          # everything went remote
     assert redelivered >= 1, "no queue item was ever redelivered"
     assert completed >= 1, "survivor never completed a redelivered item"
+    return {"redelivered": redelivered, "survivor_completed": completed,
+            "remote_prefills": remote,
+            "faults": faults.REGISTRY.snapshot()}
+
+
+def test_chaos_disagg_prefill_worker_death_zero_drop():
+    run_scenario("disagg_prefill_death")
+
+
+# -- scenario: rolling restart of every worker under load ----------------------
+
+def run_rolling_restart(plan):
+    """Planned maintenance: every worker drained and REPLACED one at a
+    time while streams run. mark_draining fences each instance out of
+    new assignments (routers see status=draining), in-flight streams
+    either finish within the drain deadline or are cut and MIGRATE via
+    the reliability layer — zero client-visible errors, every stream
+    token-identical to the undisturbed oracle."""
+    oracle = greedy_oracle(12)
+    drains_before = DRAIN_STATS.drains_completed
+
+    async def main():
+        faults.REGISTRY.arm_from_dict(plan)
+        plane = MemoryPlane()
+        fleet = {}   # tag -> (runtime, engine worker, served endpoint)
+
+        async def spawn(tag):
+            rt = await DistributedRuntime.create_local(plane, tag)
+            eng = make_engine()
+            # pay the jit compile BEFORE the instance registers: a cold
+            # replacement stalls its first streams for the compile time,
+            # which the reliability layer cannot tell from a wedged
+            # worker — 12 streams migrating between two compiling
+            # replacements is a retry storm, not a rolling restart
+            # (real deployments warm up before readiness the same way)
+            await asyncio.to_thread(
+                eng.generate, prompt_for(0),
+                SamplingParams(max_tokens=2, temperature=0.0,
+                               ignore_eos=True), f"warmup-{tag}")
+            w = await NativeEngineWorker(eng).start()
+            served = await serve_llm_worker(rt, "ns", "backend", w)
+            fleet[tag] = (rt, w, served)
+
+        await spawn("w1")
+        await spawn("w2")
+
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("backend").endpoint(
+            "generate").client()
+        await client.start()
+        await client.wait_for_instances()
+
+        metrics = ReliabilityMetrics()
+        rel = ReliableClient(
+            client,
+            # stall headroom above the healthy worst case: 12 queued
+            # streams on 2 CPU engines mid-drain can legitimately gap
+            # frames for seconds; too low wastes migrations (and under
+            # pile-up can cascade), never corrupts
+            ReliabilityPolicy(stall_timeout_s=4.0, dispatch_timeout_s=5.0,
+                              max_attempts=8, backoff_base_s=0.05,
+                              backoff_max_s=0.5),
+            breaker=CircuitBreaker(failure_threshold=2, cooldown_s=5.0,
+                                   metrics=metrics),
+            metrics=metrics)
+
+        async def run_request(i):
+            toks = []
+            async for frame in rel.generate(
+                    pre_request(f"r{i}", prompt_for(i), 6), Context()):
+                assert frame.get("finish_reason") != "error", (i, frame)
+                toks.extend(frame.get("token_ids", ()))
+            return i, toks
+
+        tasks = [asyncio.create_task(run_request(i)) for i in range(12)]
+        await asyncio.sleep(0.05)    # streams dispatched, some in flight
+
+        # the rolling restart: drain + replace each original worker in
+        # turn. The replacement registers BEFORE the next drain starts,
+        # so capacity never reaches zero.
+        for n, tag in enumerate(("w1", "w2")):
+            rt, w, served = fleet.pop(tag)
+            # a short deadline on the first drain forces the cut+migrate
+            # leg; the second drain gets room to finish cleanly
+            await served.drain(timeout_s=0.5 if n == 0 else 10.0,
+                               poll_s=0.02)
+            await w.stop()
+            await rt.shutdown()
+            await spawn(f"{tag}-replacement")
+
+        results = await asyncio.wait_for(
+            asyncio.gather(*tasks, return_exceptions=True), 300)
+        for r in results:
+            assert not isinstance(r, BaseException), r
+            i, toks = r
+            assert toks == oracle[i], (i, toks, oracle[i])
+
+        # the fleet is whole again: both replacements serving, originals
+        # gone from discovery
+        for _ in range(100):
+            if sorted(client.instance_ids()) == ["w1-replacement",
+                                                 "w2-replacement"]:
+                break
+            await asyncio.sleep(0.1)
+        assert sorted(client.instance_ids()) == ["w1-replacement",
+                                                 "w2-replacement"], \
+            client.instances
+
+        # a fresh request on the restarted fleet still works
+        i, toks = await asyncio.wait_for(run_request(11), 60)
+        assert toks == oracle[11]
+
+        for rt, w, served in fleet.values():
+            await w.stop()
+            await rt.shutdown()
+        await crt.shutdown()
+        return metrics.snapshot()
+
+    try:
+        snap = asyncio.run(main())
+    finally:
+        faults.REGISTRY.disarm()
+    assert DRAIN_STATS.drains_completed >= drains_before + 2
+    return {"reliability": snap,
+            "drains": DRAIN_STATS.snapshot(),
+            "faults": faults.REGISTRY.snapshot()}
+
+
+def test_chaos_rolling_restart_zero_drop_token_identical():
+    run_scenario("rolling_restart")
+
+
+# name -> (runner, committed default plan); tools/chaos_replay.py's menu
+SCENARIOS = {
+    "aggregated_zero_drop": (run_aggregated_zero_drop, AGGREGATED_PLAN),
+    "disagg_prefill_death": (run_disagg_prefill_death, DISAGG_PLAN),
+    "rolling_restart": (run_rolling_restart, ROLLING_PLAN),
+}

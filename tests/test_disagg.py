@@ -481,3 +481,99 @@ def test_disagg_prefill_failure_falls_back_local():
     assert n_failed == 1 and n_local == 1
     assert reason == "length"
     assert toks == expect
+
+
+def test_expired_deadline_dropped_at_dequeue_not_prefilled():
+    """Satellite: the client deadline rides into the queued item
+    (RemotePrefillRequest.deadline_unix); a prefill worker dequeuing an
+    already-expired item drops it — lease settled (no redelivery), decode
+    side notified immediately — instead of burning an engine slot on a
+    stream that is already dead."""
+    import time
+
+    from dynamo_tpu.disagg.protocols import PrefillCompletion
+
+    async def main():
+        plane = MemoryPlane()
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        notify = "ns.completions.dec-0"
+        sub = await plane.messaging.subscribe(notify)
+        prefill = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue,
+            LocalTransferBackend(), plane.messaging, dequeue_timeout_s=0.1)
+        await queue.enqueue(RemotePrefillRequest(
+            engine_id="dec-0", request_id="r-expired",
+            token_ids=list(range(100, 120)), page_ids=[0, 1, 2],
+            page_size=PAGE, notify_subject=notify,
+            deadline_unix=time.time() - 1.0))   # expired while queued
+        await prefill.start()
+        agen = sub.__aiter__()
+        _subject, payload = await asyncio.wait_for(agen.__anext__(), 30)
+        done = PrefillCompletion.model_validate_json(payload)
+        counters = (prefill.expired, prefill.completed, prefill.failed)
+        depth = await queue.depth()
+        await prefill.stop()
+        return done, counters, depth, plane.messaging.redeliveries
+
+    done, (expired, completed, failed), depth, redelivered = asyncio.run(
+        asyncio.wait_for(main(), 60))
+    assert done.request_id == "r-expired"
+    assert done.error and "deadline" in done.error
+    assert expired == 1 and completed == 0 and failed == 0
+    assert depth == 0 and redelivered == 0   # acked: settled, not re-leased
+
+
+def test_prefill_worker_drain_releases_unfinished_items():
+    """Planned-maintenance drain of a prefill worker: it stops consuming
+    the queue, waits out the deadline, and leaves unfinished items to
+    their LEASES — no ack, so they are re-leased to a surviving worker
+    and the decode stream completes oracle-exact (rolling-restart leg of
+    docs/RESILIENCE.md; unplanned death is the sibling test above)."""
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+
+    class WedgedTransfer(LocalTransferBackend):
+        async def send_pages(self, *a, **k):
+            await asyncio.Event().wait()
+
+    async def main():
+        plane = MemoryPlane()
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        router = DisaggregatedRouter(max_local_prefill_length=4,
+                                     max_prefill_queue_size=16)
+        decode = DisaggDecodeWorker(
+            make_engine(), plane.messaging, router, queue,
+            worker_id="dec-0", prefill_timeout_s=60.0)
+        transfer = LocalTransferBackend()
+        transfer.register("dec-0", decode)
+        draining = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue, WedgedTransfer(),
+            plane.messaging, dequeue_timeout_s=0.1, lease_s=0.3)
+        await decode.start()
+        await draining.start()
+
+        task = asyncio.create_task(_drive(decode.generate(
+            pre_request("r1", prompt).model_dump(exclude_none=True),
+            Context("r1"))))
+        deadline = asyncio.get_event_loop().time() + 20
+        while "r1" not in draining._handling:   # dequeued, wedged mid-item
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        summary = await draining.drain(timeout_s=0.2)
+
+        survivor = await PrefillWorker(
+            NativeEngineWorker(make_engine()), queue, transfer,
+            plane.messaging, dequeue_timeout_s=0.1, lease_s=10.0).start()
+        toks, reason = await asyncio.wait_for(task, 60)
+        completed = survivor.completed
+        await survivor.stop()
+        await decode.stop()
+        return summary, toks, reason, completed
+
+    summary, toks, reason, completed = asyncio.run(
+        asyncio.wait_for(main(), 120))
+    assert summary["re_leased"] == 1     # cut at the drain deadline
+    assert completed == 1                # survivor re-ran the re-leased item
+    assert reason == "length"
+    assert toks == expect

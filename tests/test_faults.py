@@ -1,0 +1,311 @@
+"""Failpoint registry (runtime/faults.py): determinism units + the
+per-site wiring smoke.
+
+Two layers of guarantees:
+
+- **determinism**: a FaultSchedule is a pure function of (seed, specs,
+  hit index) — the same seed replays the same faults in the same order,
+  survives serialization (`to_dict`/`from_dict`, the chaos_replay
+  artifact format) and `reset()`. This is what makes every chaos
+  scenario a replayable artifact instead of a flake.
+- **wiring**: one tier-1-safe smoke per failpoint site class, arming the
+  REAL call site (memory plane ops, prefill queue, offload tiers, the
+  transfer staging hop, lease keep-alive) and asserting the fault
+  lands. This is the bit-rot guard: a refactor that silently unthreads
+  a site from the registry fails here, not in a 3-minute chaos run.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.faults import (
+    FaultInjected, FaultRegistry, FaultSchedule, FaultSpec, REGISTRY, SITES,
+)
+from dynamo_tpu.runtime.integrity import STATS as INTEGRITY
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    """Every test starts and ends disarmed with zeroed counters — a
+    leaked armed site would contaminate every later test in the
+    process (the registry is process-global by design)."""
+    REGISTRY.disarm()
+    REGISTRY.reset_counters()
+    INTEGRITY.reset()
+    yield
+    REGISTRY.disarm()
+    REGISTRY.reset_counters()
+    INTEGRITY.reset()
+
+
+# -- schedule determinism ------------------------------------------------------
+
+def drain(sched: FaultSchedule, n: int = 64):
+    return [sched.decide() for _ in range(n)]
+
+
+def test_same_seed_same_decisions():
+    specs = [FaultSpec("drop", p=0.3), FaultSpec("delay", p=0.5,
+                                                 delay_s=0.01)]
+    a = drain(FaultSchedule(7, specs))
+    b = drain(FaultSchedule(7, specs))
+    assert a == b
+    assert any(o.fired for o in a)      # the seed actually fires things
+
+
+def test_different_seed_different_decisions():
+    specs = [FaultSpec("drop", p=0.5)]
+    assert drain(FaultSchedule(1, specs)) != drain(FaultSchedule(2, specs))
+
+
+def test_serialization_round_trip_replays():
+    sched = FaultSchedule(42, [FaultSpec("corrupt", p=0.4, n=3, nbytes=2),
+                               FaultSpec("drop", p=0.1)])
+    clone = FaultSchedule.from_dict(sched.to_dict())
+    assert drain(sched) == drain(clone)
+
+
+def test_reset_rewinds_to_hit_zero():
+    sched = FaultSchedule(13, [FaultSpec("drop", p=0.5)])
+    first = drain(sched)
+    sched.reset()
+    assert drain(sched) == first
+
+
+def test_fail_n_fails_exactly_first_n():
+    sched = FaultSchedule(0, [FaultSpec("fail_n", n=3)])
+    outs = drain(sched, 10)
+    assert [o.drop for o in outs] == [True] * 3 + [False] * 7
+
+
+def test_bounded_corrupt_fires_at_most_n_times():
+    sched = FaultSchedule(5, [FaultSpec("corrupt", p=1.0, n=2)])
+    outs = drain(sched, 20)
+    assert sum(o.corrupt for o in outs) == 2
+    assert all(o.corrupt for o in outs[:2])   # p=1: the first two hits
+
+
+def test_outcomes_do_not_shift_the_stream():
+    """A spec exhausting its budget must not change LATER specs'
+    decisions: hit k's outcome is a function of k alone (the property
+    that makes a recorded schedule replayable against code that hits
+    the site a different number of times before the interesting
+    window)."""
+    with_budget = FaultSchedule(9, [FaultSpec("fail_n", n=2),
+                                    FaultSpec("drop", p=0.5)])
+    # same seed, first spec replaced by one that never fires but still
+    # consumes its one draw per hit
+    inert_first = FaultSchedule(9, [FaultSpec("drop", p=0.0),
+                                    FaultSpec("drop", p=0.5)])
+    a = drain(with_budget, 32)
+    b = drain(inert_first, 32)
+    # past the fail_n budget, the second spec's pattern is identical
+    assert [x.drop for x in a[2:]] == [x.drop for x in b[2:]]
+
+
+def test_unknown_kind_and_site_rejected():
+    with pytest.raises(ValueError):
+        FaultSpec("explode")
+    with pytest.raises(ValueError):
+        FaultRegistry().arm("transport.teleport",
+                            FaultSchedule(0, [FaultSpec("drop")]))
+
+
+def test_disarmed_registry_is_inert():
+    reg = FaultRegistry()
+    assert not reg.enabled
+    assert asyncio.run(reg.fire("transport.send")) == faults.Outcome()
+    assert reg.fire_sync("queue.dequeue") == faults.Outcome()
+    payload = b"untouched"
+    assert reg.corrupt_bytes("remote_transfer.fetch_page", payload) \
+        is payload
+    reg.arm("transport.send", FaultSchedule(0, [FaultSpec("drop")]))
+    assert reg.enabled
+    reg.disarm()
+    assert not reg.enabled
+
+
+def test_registry_plan_round_trip():
+    reg = FaultRegistry()
+    reg.arm("transport.send", FaultSchedule(3, [FaultSpec("drop", p=0.5)]))
+    reg.arm("queue.dequeue", FaultSchedule(4, [FaultSpec("delay",
+                                                         delay_s=0.01)]))
+    clone = FaultRegistry()
+    clone.arm_from_dict(reg.to_dict())
+    assert clone.to_dict() == reg.to_dict()
+    assert set(clone.to_dict()) == {"transport.send", "queue.dequeue"}
+
+
+def test_counters_distinguish_hits_from_injections():
+    reg = FaultRegistry()
+    reg.arm("transport.send", FaultSchedule(0, [FaultSpec("fail_n", n=1)]))
+    with pytest.raises(FaultInjected):
+        reg.fire_sync("transport.send")
+    reg.fire_sync("transport.send")   # budget spent: passes
+    snap = reg.snapshot()
+    assert snap["hits"]["transport.send"] == 2
+    assert snap["injected"]["transport.send"] == 1
+
+
+# -- per-site wiring smoke -----------------------------------------------------
+# One armed failpoint per site class, against the REAL call site. Cheap
+# enough for tier-1; failing here means a refactor unthreaded the site.
+
+def arm(site, *specs, seed=0):
+    REGISTRY.arm(site, FaultSchedule(seed, list(specs)))
+
+
+def test_site_transport_send_drop_reaches_kv_caller():
+    from dynamo_tpu.runtime.transports.memory import MemoryKVStore
+
+    async def main():
+        kv = MemoryKVStore()
+        arm("transport.send", FaultSpec("fail_n", n=1))
+        with pytest.raises(ConnectionError):   # FaultInjected IS one
+            await kv.put("k", b"v")
+        await kv.put("k", b"v")                # budget spent: succeeds
+        assert await kv.get("k") == b"v"
+
+    asyncio.run(main())
+    assert REGISTRY.snapshot()["injected"]["transport.send"] == 1
+
+
+def test_site_transport_recv_drops_and_duplicates_deliveries():
+    from dynamo_tpu.runtime.transports.memory import MemoryMessaging
+
+    async def main():
+        msg = MemoryMessaging()
+        sub = await msg.subscribe("ev.>")
+        agen = sub.__aiter__()
+        # fail_n drops the first delivery; the duplicate spec fires on
+        # the first two hits, but hit 1's drop wins (a lost frame can't
+        # also arrive twice), so only hit 2 actually doubles
+        arm("transport.recv", FaultSpec("fail_n", n=1),
+            FaultSpec("duplicate", p=1.0, n=2))
+        await msg.publish("ev.a", b"lost")        # dropped for this sub
+        await msg.publish("ev.a", b"doubled")     # duplicated
+        await msg.publish("ev.a", b"normal")
+        got = [await asyncio.wait_for(agen.__anext__(), 5)
+               for _ in range(3)]
+        assert [p for _, p in got] == [b"doubled", b"doubled", b"normal"]
+
+    asyncio.run(main())
+
+
+def test_site_queue_dequeue_fault_loses_no_items():
+    from dynamo_tpu.disagg.protocols import RemotePrefillRequest
+    from dynamo_tpu.disagg.queue import PrefillQueue
+    from dynamo_tpu.runtime.transports.memory import MemoryMessaging
+
+    async def main():
+        q = PrefillQueue(MemoryMessaging(), "ns", "tiny")
+        await q.enqueue(RemotePrefillRequest(
+            engine_id="e", request_id="r1", token_ids=[1, 2, 3],
+            page_ids=[0]))
+        arm("queue.dequeue", FaultSpec("fail_n", n=1))
+        with pytest.raises(FaultInjected):
+            await q.dequeue(timeout=0.1)
+        # the failpoint fires BEFORE the pop: the item is still queued
+        got = await q.dequeue(timeout=1.0)
+        assert got is not None and got.request_id == "r1"
+
+    asyncio.run(main())
+
+
+def test_site_offload_write_tier_corruption_is_quarantined_on_read():
+    from dynamo_tpu.engine.offload import HostKvPool
+    arm("offload.write_tier", FaultSpec("corrupt", p=1.0, n=1))
+    pool = HostKvPool(capacity=4, page_shape=(2, 8), dtype=np.float32)
+    page = np.arange(16, dtype=np.float32).reshape(2, 8)
+    pool.put(0xAB, page, page + 1)    # write-tier rot flips stored bytes
+    assert pool.get(0xAB) is None     # verify-on-fetch: quarantined
+    assert INTEGRITY.quarantined == 1 and INTEGRITY.mismatches == 1
+    assert pool.get(0xAB) is None     # gone, not resurrectable
+
+
+def test_site_offload_read_tier_corruption_is_quarantined():
+    from dynamo_tpu.engine.offload import HostKvPool
+    pool = HostKvPool(capacity=4, page_shape=(2, 8), dtype=np.float32)
+    page = np.arange(16, dtype=np.float32).reshape(2, 8)
+    pool.put(0xCD, page, page + 1)    # clean write
+    arm("offload.read_tier", FaultSpec("corrupt", p=1.0, n=1))
+    assert pool.get(0xCD) is None     # rot surfaced at read: quarantined
+    assert INTEGRITY.quarantined == 1
+
+
+def test_site_remote_transfer_corruption_refetches_then_succeeds():
+    import jax.numpy as jnp
+
+    from dynamo_tpu.disagg.transfer import LocalTransferBackend
+    arm("remote_transfer.fetch_page", FaultSpec("corrupt", p=1.0, n=1))
+    k = jnp.arange(2 * 2 * 2 * 4, dtype=jnp.float32).reshape(2, 2, 2, 4)
+    v = k + 100.0
+    k_np, v_np = asyncio.run(LocalTransferBackend._verified_stage(
+        "r1", [0, 1], k, v))
+    # the single bounded corruption was absorbed by one re-fetch and the
+    # verified bytes match the authoritative device copy
+    np.testing.assert_array_equal(k_np, np.asarray(k))
+    np.testing.assert_array_equal(v_np, np.asarray(v))
+    assert INTEGRITY.refetches == 1 and INTEGRITY.mismatches >= 1
+    assert INTEGRITY.quarantined == 0
+
+
+def test_site_discovery_heartbeat_drop_skips_lease_refresh():
+    from dynamo_tpu.runtime.transports.memory import MemoryKVStore
+
+    async def main():
+        kv = MemoryKVStore()
+        lease = await kv.grant_lease(ttl=30.0)
+        before = kv._lease_deadline[lease.id]
+        arm("discovery.heartbeat", FaultSpec("fail_n", n=1))
+        lease.keep_alive()            # heartbeat lost: no refresh
+        assert kv._lease_deadline[lease.id] == before
+        lease.keep_alive()            # budget spent: refresh lands
+        assert kv._lease_deadline[lease.id] > before
+        await lease.revoke()
+
+    asyncio.run(main())
+    snap = REGISTRY.snapshot()
+    assert snap["injected"]["discovery.heartbeat"] == 1
+
+
+def test_every_catalogued_site_is_armable():
+    for site in SITES:
+        arm(site, FaultSpec("drop", p=0.0))
+        assert REGISTRY.armed(site)
+
+
+# -- chaos_replay tool ---------------------------------------------------------
+
+def _load_chaos_replay():
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "chaos_replay.py")
+    spec = importlib.util.spec_from_file_location("chaos_replay", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_replay_scenario_names_in_sync():
+    """The replay tool's static menu (kept import-light for --list) must
+    track the harness's actual scenario registry."""
+    import test_chaos
+    mod = _load_chaos_replay()
+    assert set(mod.SCENARIO_NAMES) == set(test_chaos.SCENARIOS)
+
+
+def test_chaos_replay_cli_list_is_cheap_and_clean():
+    import os
+    import subprocess
+    import sys
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "chaos_replay.py")
+    proc = subprocess.run([sys.executable, path, "--list"],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    names = proc.stdout.split()
+    assert "rolling_restart" in names and len(names) >= 3

@@ -509,6 +509,52 @@ class TestHttpService:
 
         run(main())
 
+    def test_metrics_surface_fault_integrity_drain_counters(self):
+        """The robustness counters — failpoint hits/injections, KV
+        integrity, graceful drain — are folded into /metrics at render
+        time from their process-global stats objects."""
+        from dynamo_tpu.runtime import faults
+        from dynamo_tpu.runtime.component import DRAIN_STATS
+        from dynamo_tpu.runtime.faults import (
+            FaultInjected, FaultSchedule, FaultSpec,
+        )
+        from dynamo_tpu.runtime.integrity import STATS as integrity
+
+        async def main():
+            svc = await HttpService("127.0.0.1", 0).start()
+            faults.REGISTRY.arm("queue.dequeue", FaultSchedule(
+                0, [FaultSpec("fail_n", n=1)]))
+            with pytest.raises(FaultInjected):
+                faults.REGISTRY.fire_sync("queue.dequeue")
+            integrity.pages_hashed += 3
+            integrity.quarantined += 1
+            DRAIN_STATS.drains_started += 1
+            DRAIN_STATS.drains_completed += 1
+            try:
+                status, body = await request("127.0.0.1", svc.port, "GET",
+                                             "/metrics")
+                text = body.decode()
+                assert status == 200
+                hits = faults.REGISTRY.site_hits["queue.dequeue"]
+                inj = faults.REGISTRY.injected["queue.dequeue"]
+                assert f'llm_fault_site_hits{{site="queue.dequeue"}} ' \
+                    f'{hits}' in text
+                assert f'llm_fault_injections{{site="queue.dequeue"}} ' \
+                    f'{inj}' in text
+                assert f"llm_kv_integrity_pages_hashed " \
+                    f"{integrity.pages_hashed}" in text
+                assert f"llm_kv_integrity_quarantined " \
+                    f"{integrity.quarantined}" in text
+                assert f"llm_drain_drains_completed " \
+                    f"{DRAIN_STATS.drains_completed}" in text
+            finally:
+                faults.REGISTRY.disarm()
+                faults.REGISTRY.reset_counters()
+                integrity.reset()
+                await svc.stop()
+
+        run(main())
+
 
 def byte_card(name="echo-model", **kw):
     return ModelDeploymentCard(name=name, arch="tiny", tokenizer_kind="byte",

@@ -1,0 +1,283 @@
+"""KV data-plane integrity (runtime/integrity.py): the contract that a
+corrupted transfer or tier read may cost latency but can NEVER change
+emitted tokens.
+
+Coverage, one test per leg of the state machine (docs/RESILIENCE.md):
+
+- corrupt ON THE WIRE (remote TCP transfer): decode-side verify rejects
+  the chunk, the sender re-fetches from its still-authoritative device
+  copy, tokens stay oracle-exact;
+- PERSISTENT wire corruption: the bounded re-fetch budget exhausts, the
+  remote path is abandoned (quarantine counted) and the decode side
+  falls back to a LOCAL re-prefill — degraded latency, identical tokens;
+- corrupt AT REST in the offload tiers (host DRAM slab, disk slab): the
+  verify-on-fetch gate quarantines the entry, the prefix walk misses,
+  the pages are recomputed — identical tokens, never served rot.
+
+Faults are injected through the failpoint registry (seeded, replayable);
+every test asserts both the token contract and the integrity counters
+that surface on /metrics as llm_kv_integrity_*.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import SamplingParams
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.faults import FaultSchedule, FaultSpec, REGISTRY
+from dynamo_tpu.runtime.integrity import (
+    STATS as INTEGRITY, IntegrityError, page_checksum,
+)
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+PAGE = 8
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    REGISTRY.disarm()
+    REGISTRY.reset_counters()
+    INTEGRITY.reset()
+    yield
+    REGISTRY.disarm()
+    REGISTRY.reset_counters()
+    INTEGRITY.reset()
+
+
+def arm(site, *specs, seed=0):
+    REGISTRY.arm(site, FaultSchedule(seed, list(specs)))
+
+
+def make_engine(num_pages=64, **kw):
+    return NativeEngine(CFG, EngineConfig(
+        page_size=PAGE, num_pages=num_pages, max_slots=4,
+        max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+        max_model_len=512, **kw), seed=0)
+
+
+_ORACLE = []
+
+
+def oracle(prompt, params, rid):
+    """Greedy expectations off ONE shared engine (deterministic; pages
+    release at completion) — a fresh engine per expectation would pay
+    the jit compile several times over in this file alone."""
+    if not _ORACLE:
+        _ORACLE.append(make_engine())
+    return _ORACLE[0].generate(prompt, params, rid)
+
+
+# -- checksum primitive --------------------------------------------------------
+
+def test_page_checksum_is_deterministic_and_content_sensitive():
+    k = np.arange(32, dtype=np.float32).reshape(4, 8)
+    v = k + 1
+    a = page_checksum(k, v)
+    assert a == page_checksum(k.copy(), v.copy())
+    flipped = k.copy()
+    flipped.view(np.uint8)[3] ^= 0xFF
+    assert page_checksum(flipped, v) != a
+    assert page_checksum(v, k) != a      # order (k then v) matters
+
+
+# -- corrupt on the wire: bounded re-fetch -------------------------------------
+
+def _disagg_remote_stack(plane, integrity_retries=2):
+    from dynamo_tpu.disagg import (
+        DisaggDecodeWorker, DisaggregatedRouter, KvTransferServer,
+        PrefillQueue, PrefillWorker, RemoteTransferBackend,
+    )
+    from dynamo_tpu.llm.worker import NativeEngineWorker
+
+    async def build():
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        router = DisaggregatedRouter(max_local_prefill_length=4,
+                                     max_prefill_queue_size=8, model="tiny")
+        decode = DisaggDecodeWorker(
+            make_engine(), plane.messaging, router, queue,
+            worker_id="dec-0", prefill_timeout_s=30.0)
+        server = await KvTransferServer(decode, "dec-0").start()
+        await server.register(plane.kv)
+        transfer = RemoteTransferBackend(
+            plane.kv, integrity_retries=integrity_retries)
+        prefill = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue, transfer,
+            plane.messaging)
+        return decode, prefill, server, transfer
+
+    return build()
+
+
+def _pre(rid, prompt, max_tokens=6):
+    from dynamo_tpu.protocols.common import PreprocessedRequest, \
+        StopConditions
+    return PreprocessedRequest(
+        request_id=rid, token_ids=prompt,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    ).model_dump(exclude_none=True)
+
+
+async def _drive(gen):
+    toks, reasons = [], []
+    async for frame in gen:
+        toks.extend(frame.get("token_ids", ()))
+        if frame.get("finish_reason") not in (None, "prefill_done"):
+            reasons.append(frame["finish_reason"])
+    return toks, reasons
+
+
+def test_wire_corruption_absorbed_by_refetch_tokens_identical():
+    """A transient corruption (one seeded flip burst) on the transfer
+    wire: the decode side's verify rejects the chunk, one re-fetch
+    re-stages clean bytes, and the stream is token-identical — the
+    corruption cost a round trip, nothing else."""
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = oracle(prompt, params, "oracle")
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, server, transfer = await _disagg_remote_stack(
+            plane)
+        await decode.start()
+        await prefill.start()
+        # nbytes=16 spreads flips across the (pow2-padded) chunk so at
+        # least one lands inside a real page's bytes; n=1 bounds the
+        # burst to the first send — the re-fetch goes out clean
+        arm("remote_transfer.fetch_page",
+            FaultSpec("corrupt", p=1.0, n=1, nbytes=16))
+        try:
+            toks, reasons = await asyncio.wait_for(_drive(
+                decode.generate(_pre("r1", prompt), Context("r1"))), 120)
+        finally:
+            await prefill.stop()
+            await decode.stop()
+            await transfer.close()
+            await server.stop()
+        return toks, reasons
+
+    toks, reasons = asyncio.run(main())
+    assert toks == expect, (toks, expect)
+    assert reasons == ["length"]
+    assert INTEGRITY.mismatches >= 1, "corruption was never detected"
+    assert INTEGRITY.refetches >= 1, "no re-fetch was attempted"
+    assert INTEGRITY.quarantined == 0   # transient: absorbed, not abandoned
+    assert INTEGRITY.reprefills == 0
+
+
+def test_persistent_wire_corruption_falls_back_to_local_prefill():
+    """EVERY transfer attempt corrupts: the bounded re-fetch budget
+    exhausts, the sender abandons the remote path (pages quarantined,
+    counted), the prefill item fails cleanly, and the decode side
+    re-prefills LOCALLY — the client stream still finishes with
+    oracle-exact tokens."""
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    prompt = list(range(40, 60))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = oracle(prompt, params, "oracle")
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, server, transfer = await _disagg_remote_stack(
+            plane, integrity_retries=1)
+        await decode.start()
+        await prefill.start()
+        # unbounded (n=0) corruption: every send attempt rots on the wire
+        arm("remote_transfer.fetch_page",
+            FaultSpec("corrupt", p=1.0, n=0, nbytes=16))
+        try:
+            toks, reasons = await asyncio.wait_for(_drive(
+                decode.generate(_pre("r2", prompt), Context("r2"))), 120)
+        finally:
+            await prefill.stop()
+            await decode.stop()
+            await transfer.close()
+            await server.stop()
+        return toks, reasons, decode.remote_prefills, decode.local_prefills
+
+    toks, reasons, remote, fallbacks = asyncio.run(main())
+    assert toks == expect, (toks, expect)
+    assert reasons == ["length"]
+    assert remote == 1 and fallbacks == 1
+    assert INTEGRITY.refetches >= 1       # the budget was actually spent
+    assert INTEGRITY.quarantined >= 1     # then the source pages quarantined
+    assert INTEGRITY.reprefills >= 1      # and the remote path abandoned
+
+
+# -- corrupt at rest: offload tiers --------------------------------------------
+
+def test_host_tier_rot_quarantines_and_recomputes_tokens_identical():
+    """A->B->A offload roundtrip with the host DRAM tier rotting at
+    read time: the pin-time verify quarantines every touched entry, the
+    prefix walk misses, pages are recomputed — tokens identical, rot is
+    never served."""
+    params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    prompt_a = list(range(10, 34))    # 3 pages
+    prompt_b = list(range(100, 140))  # 5 pages — evicts A's pages
+    expect_a = oracle(prompt_a, params, "oracle-a")
+
+    eng = make_engine(num_pages=8, host_pages=16)
+    assert eng.generate(prompt_a, params, "a1") == expect_a
+    eng.generate(prompt_b, params, "b")
+    assert eng.host_pool.stats.offloaded > 0, "eviction must offload"
+    # every read of the DRAM slab from here on surfaces at-rest rot
+    arm("offload.read_tier", FaultSpec("corrupt", p=1.0, n=0))
+    got_a2 = eng.generate(prompt_a, params, "a2")
+    assert got_a2 == expect_a
+    assert INTEGRITY.mismatches >= 1
+    assert INTEGRITY.quarantined >= 1
+    # the quarantined entries are really gone, not just skipped once
+    REGISTRY.disarm()
+    assert eng.host_pool.stats.onboarded == 0
+
+
+def test_disk_tier_rot_quarantined_at_promotion(tmp_path):
+    from dynamo_tpu.engine.offload import DiskKvPool
+    pool = DiskKvPool(4, (2, 8), np.float32, str(tmp_path))
+    page = np.arange(16, dtype=np.float32).reshape(2, 8)
+    pool.put(0x1, page, page + 1)
+    arm("offload.read_tier", FaultSpec("corrupt", p=1.0, n=1))
+    assert pool.take(0x1) is None         # rot at read: quarantined
+    assert INTEGRITY.quarantined == 1
+    # a clean entry still promotes with its traveling checksum
+    pool.put(0x2, page * 2, page * 3)
+    got = pool.take(0x2)
+    assert got is not None
+    k, v, sum_ = got
+    np.testing.assert_array_equal(k, page * 2)
+    assert sum_ == page_checksum(page * 2, page * 3)
+
+
+def test_spill_carries_checksum_so_dram_rot_cannot_launder(tmp_path):
+    """The checksum travels DOWN on spill: a page that rots in DRAM and
+    then spills to disk must still fail verification when promoted (the
+    spill must not recompute a checksum over rotten bytes)."""
+    from dynamo_tpu.engine.offload import HostKvPool
+    pool = HostKvPool(1, (2, 8), np.float32)
+    from dynamo_tpu.engine.offload import DiskKvPool
+    pool.disk = DiskKvPool(4, (2, 8), np.float32, str(tmp_path))
+    page = np.arange(16, dtype=np.float32).reshape(2, 8)
+    pool.put(0xA, page, page)
+    # rot the DRAM slab byte directly (at-rest corruption between
+    # writes), then force a spill by inserting a second entry
+    pool.k_slab[0].view(np.uint8)[0, 5] ^= 0xFF
+    pool.put(0xB, page * 2, page * 2)     # evicts 0xA -> disk, rot and all
+    assert pool.stats.disk_offloaded == 1
+    # promotion verifies against the CAPTURE-time checksum: quarantined
+    assert pool.get(0xA) is None
+    assert INTEGRITY.quarantined == 1
+    assert 0xA not in pool
+
+
+def test_integrity_error_carries_pages():
+    err = IntegrityError("transfer into 'dec-0'", [3, 7])
+    assert err.pages == [3, 7]
+    assert "dec-0" in str(err) and "3, 7" in str(err)

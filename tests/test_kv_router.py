@@ -314,6 +314,82 @@ class TestRouterEndToEnd:
         asyncio.run(main())
 
 
+class TestInstanceLifecycleEviction:
+    def test_dereg_evicts_index_immediately_and_drain_fences(self):
+        """Satellite fix: a dead worker's radix-index entries go at
+        WATCH-EVENT time (deregistration/lease-expiry), not at the next
+        metrics scrape — before this, its cached-prefix score kept
+        attracting routes until the circuit breaker tripped, one failed
+        dispatch per stream. DRAINING does the same fence while the
+        instance stays alive for its in-flight streams."""
+        async def main():
+            plane = MemoryPlane()
+            worker_rts, serveds, pubs = [], {}, {}
+            for wid in ("w1", "w2"):
+                rt = await DistributedRuntime.create_local(plane, wid)
+                comp = rt.namespace("ns").component("worker")
+                mpub = KvMetricsPublisher()
+                mpub.update(WorkerMetrics(
+                    request_active_slots=0, request_total_slots=8,
+                    kv_active_blocks=0, kv_total_blocks=100))
+
+                async def engine(request, context, wid=wid):
+                    yield {"worker": wid}
+
+                serveds[wid] = await comp.endpoint("generate").serve(
+                    engine, stats_handler=mpub.stats_handler)
+                pubs[wid] = comp
+                worker_rts.append(rt)
+
+            rrt = await DistributedRuntime.create_local(plane, "router")
+            comp = rrt.namespace("ns").component("worker")
+            client = comp.endpoint("generate").client()
+            await client.start()
+            await client.wait_for_instances()
+            # scrape interval >> test length: the initial scrape seeds the
+            # scheduler, then ONLY the watch listener can evict — which is
+            # exactly what this test pins down
+            router = await KvRouter(comp, client, block_size=4,
+                                    scrape_interval_s=60.0).start()
+            await router.aggregator.scrape_once()   # seed deterministically
+            assert set(router.scheduler.endpoints.workers) == {"w1", "w2"}
+
+            toks = list(range(100, 116))
+            alloc = PageAllocator(8, 4)
+            pids = [alloc.allocate(), alloc.allocate()]
+            parent = 0
+            for i, pid in enumerate(pids):
+                parent = alloc.seal(pid, parent, toks[i * 4:(i + 1) * 4])
+            await KvEventPublisher(pubs["w2"], "w2").publish_allocator_events(
+                alloc.drain_events())
+            await asyncio.sleep(0.1)  # event pump
+            assert router.find_matches_for_tokens(toks).scores == {"w2": 2}
+            assert await router.schedule(toks) == "w2"
+
+            # DRAIN: the fence lands on the watch put, with no scrape —
+            # prefix scores gone, schedule avoids w2, instance still alive
+            await serveds["w2"].mark_draining()
+            await asyncio.sleep(0.1)
+            assert router.find_matches_for_tokens(toks).scores == {}
+            assert client.draining_ids() == ["w2"]
+            assert await router.schedule(toks) == "w1"
+            assert "w2" in client.instances   # alive for in-flight streams
+
+            # DEREGISTRATION (lease gone): purged from index AND scheduler
+            # at watch-delete time, again without any scrape
+            await worker_rts[1].shutdown()
+            await asyncio.sleep(0.2)
+            assert router.find_matches_for_tokens(toks).scores == {}
+            assert set(router.scheduler.endpoints.workers) == {"w1"}
+            assert await router.schedule(toks) == "w1"
+
+            await router.stop()
+            await rrt.shutdown()
+            await worker_rts[0].shutdown()
+
+        asyncio.run(asyncio.wait_for(main(), 60))
+
+
 class TestAggregatorStatlessWorkers:
     def test_live_statless_instance_never_counts_removed(self):
         """A live instance whose $STATS scrape fails (e.g. an engine with no

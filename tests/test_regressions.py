@@ -266,3 +266,29 @@ def test_oov_rejection_remote_path_emits_error_frame():
     assert frames, "no frames at all"
     assert frames[-1]["finish_reason"] == FinishReason.ERROR.value
     assert "vocab" in frames[-1].get("text", "")
+
+
+def test_completed_id_reuse_never_resumes_stale_device_state():
+    """A new request REUSING a finished request's id (stable client ids,
+    retried jobs) must decode from ITS OWN prefill, not the dead
+    request's device-resident carry. Before the per-admission epoch
+    (scheduler._epoch_seq), both admissions keyed the decode-state
+    signature as (id, epoch=0); with the same slot and page count the
+    stale signature matched and the engine fed the finished request's
+    final (token, position, counter) device arrays back in — silently
+    wrong tokens from position 1 on (found by the integrity tests
+    sharing an oracle engine across scenarios)."""
+    gen_cfg = dict(page_size=8, num_pages=64, max_slots=4,
+                   max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+                   max_model_len=512)
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    # same lengths => same page counts => identical sig apart from epoch
+    p1, p2 = list(range(100, 120)), list(range(40, 60))
+    expect = NativeEngine(CFG, EngineConfig(**gen_cfg),
+                          seed=0).generate(p2, params, "fresh")
+
+    eng = NativeEngine(CFG, EngineConfig(**gen_cfg), seed=0)
+    eng.generate(p1, params, "stable-id")
+    assert eng.generate(p2, params, "stable-id") == expect
+    # and a third reuse, now with p2's pages warm in the prefix cache
+    assert eng.generate(p2, params, "stable-id") == expect

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""chaos_replay: re-run a chaos scenario from a recorded fault plan.
+
+Every chaos scenario (tests/test_chaos.py) takes its faults from a plan
+dict — `{site: {"seed": int, "specs": [{kind, p, n, ...}]}}` — armed on
+the failpoint registry (dynamo_tpu/runtime/faults.py). The same plan
+replays the same faults in the same order, so a failure seen once is a
+failure you can hand someone as a JSON file.
+
+Usage:
+    python tools/chaos_replay.py --list
+        name the scenarios (no heavy imports — safe for shell tabbing)
+    python tools/chaos_replay.py <scenario> --dump-plan
+        print the committed default plan JSON (edit it, feed it back)
+    python tools/chaos_replay.py <scenario> [--plan plan.json]
+        run the scenario under the given (or default) plan; the
+        scenario's own assertions are the pass/fail contract
+    python tools/chaos_replay.py <scenario> --record
+        also append {scenario, plan, summary} to CHAOS_REPLAY.jsonl —
+        append-only, final name, via tools/artifacts.py (the
+        evidence-write policy: re-runs add records, never rewrite)
+
+Exit code 0 on a clean run, 1 on a contract violation (AssertionError),
+2 on usage errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Kept in sync with tests/test_chaos.py SCENARIOS (tests/test_faults.py
+# asserts the two lists match) so --list never imports jax/the engine.
+SCENARIO_NAMES = (
+    "aggregated_zero_drop",
+    "disagg_prefill_death",
+    "rolling_restart",
+)
+
+DEFAULT_LOG = os.path.join(REPO_ROOT, "CHAOS_REPLAY.jsonl")
+
+
+def _load_scenarios():
+    """Heavy import (jax + engine), deferred past --list/--help."""
+    sys.path.insert(0, REPO_ROOT)
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import test_chaos
+    return test_chaos
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="chaos_replay", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("scenario", nargs="?", choices=SCENARIO_NAMES,
+                    help="scenario to run (see --list)")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenario names and exit")
+    ap.add_argument("--plan", metavar="PLAN_JSON",
+                    help="fault plan JSON file ({site: {seed, specs}}); "
+                         "default: the scenario's committed plan")
+    ap.add_argument("--dump-plan", action="store_true",
+                    help="print the scenario's committed default plan "
+                         "and exit (a starting point for --plan edits)")
+    ap.add_argument("--record", action="store_true",
+                    help=f"append the run record to {DEFAULT_LOG}")
+    ap.add_argument("--record-to", default=DEFAULT_LOG,
+                    help="append-only JSONL evidence log (default: "
+                         "CHAOS_REPLAY.jsonl)")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in SCENARIO_NAMES:
+            print(name)
+        return 0
+    if not args.scenario:
+        ap.error("a scenario name (or --list) is required")
+
+    test_chaos = _load_scenarios()
+    assert set(test_chaos.SCENARIOS) == set(SCENARIO_NAMES), \
+        "tools/chaos_replay.py SCENARIO_NAMES is stale vs tests/test_chaos"
+    _, default_plan = test_chaos.SCENARIOS[args.scenario]
+
+    if args.dump_plan:
+        print(json.dumps(default_plan, indent=1))
+        return 0
+
+    plan = default_plan
+    if args.plan:
+        with open(args.plan) as f:
+            plan = json.load(f)
+
+    started = time.time()
+    try:
+        summary = test_chaos.run_scenario(args.scenario, plan)
+        ok, error = True, None
+    except AssertionError as e:
+        summary, ok, error = None, False, f"{e}"
+    elapsed = time.time() - started
+
+    record = {"scenario": args.scenario, "plan": plan, "ok": ok,
+              "error": error, "summary": summary,
+              "started_unix": round(started, 3),
+              "elapsed_s": round(elapsed, 3)}
+    print(json.dumps(record, indent=1))
+    if args.record:
+        from tools.artifacts import append_jsonl
+        append_jsonl(args.record_to, record)
+        print(f"recorded to {args.record_to}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
