@@ -27,6 +27,11 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
 - R9  `except Exception:` in the serving layers (runtime/, disagg/,
       frontend/) whose body only passes or logs-and-continues, without a
       `# dynalint: swallow-ok=<reason>` annotation
+- R10 schedule()-reachable plan builders allocating per-step arrays
+      with an unbucketed (data-dependent `len(...)`) leading dim — every
+      distinct shape mints a new compiled XLA program, so an admission-
+      dependent dim recompiles the serving loop per arrival — without a
+      `# dynalint: bucketed` annotation
 """
 from __future__ import annotations
 
@@ -606,6 +611,72 @@ def r9_swallowed_exception(tree: ast.AST, lines: List[str],
             "handle it (retry/fallback/cleanup), re-raise, or annotate "
             "with `# dynalint: swallow-ok=<why losing this error is "
             "correct>`"))
+    return out
+
+
+# -- R10: unbucketed leading dims in schedule()-reachable plan builders -------
+
+# Scope: the engine's planning layer — the scheduler and the engine step
+# path — where every array built per step becomes a jitted program's
+# input shape. A leading dim taken straight from `len(...)` tracks the
+# live batch/slot/row count, so EVERY admission or finish changes the
+# shape and XLA compiles a fresh program mid-serving (seconds of stall —
+# the exact hazard the pow2/page bucket ladders exist to prevent). The
+# sanctioned shapes route through next_bucket()/pow2_buckets()/
+# page_bucket_ladder() first; a deliberate exception is annotated
+# `# dynalint: bucketed` (with why the shape is admission-stable).
+_R10_SCOPE = ("engine/scheduler", "engine/engine")
+_R10_FUNC_RE = re.compile(r"^(schedule$|_schedule|_build|_stage)")
+_R10_ALLOCS = {"np.zeros", "np.ones", "np.full", "np.empty",
+               "numpy.zeros", "numpy.ones", "numpy.full", "numpy.empty",
+               "jnp.zeros", "jnp.ones", "jnp.full", "jnp.empty"}
+_R10_ANNOT_RE = re.compile(r"#\s*dynalint:\s*bucketed")
+
+
+def _contains_len_call(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and _call_name(n) == "len"
+               for n in ast.walk(node))
+
+
+@rule("R10")
+def r10_unbucketed_plan_dims(tree: ast.AST, lines: List[str],
+                             path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R10_SCOPE):
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R10_ANNOT_RE.search(_line(lines, x))
+                   for x in (ln, ln - 1))
+
+    out: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or not _R10_FUNC_RE.search(fn.name):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) \
+                    or _call_name(node) not in _R10_ALLOCS \
+                    or not node.args:
+                continue
+            shape = node.args[0]
+            lead = shape.elts[0] if (isinstance(shape, ast.Tuple)
+                                     and shape.elts) else shape
+            if not _contains_len_call(lead):
+                continue
+            if annotated(node.lineno):
+                continue
+            out.append(_finding(
+                "R10", path, lines, node,
+                f"per-step array in `{fn.name}` allocated with "
+                f"data-dependent leading dim `{_unparse(lead)}` — the "
+                "shape tracks the live batch, so every admission mints "
+                "a NEW compiled XLA program (seconds-long serving "
+                "stall)",
+                "round the dim through next_bucket()/pow2_buckets() "
+                "like the plan builders do, or annotate with "
+                "`# dynalint: bucketed` and say why the shape is "
+                "admission-stable"))
     return out
 
 

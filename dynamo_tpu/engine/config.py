@@ -185,12 +185,37 @@ class EngineConfig:
     # speculation.
     spec_dispatch_ratio: float = 2.0
     spec_probe_every: int = 32
-    # longest run of consecutive prefill steps while decodes are active;
-    # after the streak one decode step runs, so a long prompt can stall
-    # running decodes by at most max_prefill_streak chunk-times (the
-    # aggregated-mode answer to prefill/decode interference; the reference
-    # delegates this to its engines' chunked-prefill interleaving,
-    # docs/architecture.md:57-61). 0 = unbounded (old prefill-priority).
+    # Sarathi-style mixed prefill+decode steps (docs/PERF.md): when
+    # requests are waiting while decodes run, the scheduler plans ONE
+    # [Bb, Tb] device step holding every running decode slot as a
+    # single-token row plus a token-budgeted prefill chunk, so decode
+    # emits a token on EVERY step and prefill rides the batch's spare
+    # compute instead of preempting it (the aggregated-mode answer to
+    # prefill/decode interference — the 3.19x agg-under-churn collapse
+    # in BENCH_SELF_r05). The budget is device compute tokens per step:
+    # every row is charged the full Tb-wide bucket it occupies (decode
+    # rows pad to the chunk's token bucket), and the prefill chunk takes
+    # the remainder — the chunk bucket is the largest prefill_buckets
+    # rung with Tb * (n_decode_rows + 1) <= mixed_token_budget (the
+    # smallest rung when nothing fits, so prefill always progresses).
+    # 0 = legacy alternating prefill/decode steps (streak-bounded below).
+    # sp>1 engines always use the legacy path (ring-attention prefill
+    # cannot share a step with paged decode rows).
+    mixed_token_budget: int = 512
+    # bounded skip-ahead for the prefill queue: a head blocked on slots
+    # or memory no longer blocks later waiting requests that could run —
+    # up to this many blocked/mismatched entries are scanned past (queue
+    # order itself is never reordered, and the head is reconsidered
+    # first on every pass, so it runs as soon as its resources free).
+    # 0 = strict head-only (the old head-of-line-blocking behavior).
+    prefill_skip_ahead: int = 4
+    # COMPAT ALIAS (legacy alternating scheduler only, i.e.
+    # mixed_token_budget=0): longest run of consecutive prefill steps
+    # while decodes are active; after the streak one decode step runs,
+    # so a long prompt can stall running decodes by at most
+    # max_prefill_streak chunk-times. Mixed-step scheduling retires the
+    # knob — decode rows ride every step, so there is no streak to
+    # bound. 0 = unbounded (old prefill-priority).
     max_prefill_streak: int = 2
 
 

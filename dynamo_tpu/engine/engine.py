@@ -32,8 +32,8 @@ from dynamo_tpu.engine.sampler import (
     sample_logits as _sample_logits, seen_token_mask,
 )
 from dynamo_tpu.engine.scheduler import (
-    DecodePlan, EngineRequest, PrefillPlan, SamplingParams, Scheduler,
-    next_bucket, pow2_buckets,
+    DecodePlan, EngineRequest, MixedPlan, PrefillPlan, SamplingParams,
+    Scheduler, next_bucket, pow2_buckets,
 )
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.llama import AttnMetadata
@@ -166,9 +166,15 @@ class NativeEngine:
         # N runs concurrently with device execution of window N+1
         self._pipeline = None
         # host staging caches: static sampling-param blocks and incremental
-        # repetition-penalty history rebuild only when the slot set changes
+        # repetition-penalty history rebuild only when the slot set changes.
+        # Mixed steps get their OWN cache pair: a mixed step's row set
+        # (decode slots + prefill rows) interleaves with the decode
+        # window's slot set, and one shared cache would rebuild on every
+        # alternation between the two step kinds
         self._samp_cache = SamplingArrayCache()
         self._rp_cache = RepPenaltyCache()
+        self._mixed_samp_cache = SamplingArrayCache()
+        self._mixed_rp_cache = RepPenaltyCache()
         # decode phase attribution (tools/decode_profile.py reads this);
         # profile_sync=True makes the dispatch phase block until the
         # device finishes, isolating "device" from "fetch" — attribution
@@ -184,6 +190,13 @@ class NativeEngine:
         self.pipeline_overlapped = 0  # commits with a follow-up in flight
         self.pipeline_fallbacks = 0   # in-flight windows discarded on
         #                               membership change (reconciliation)
+        # mixed prefill+decode steps (docs/PERF.md): fused [Bb, Tb] steps
+        # run, and the stall counter — device steps where >= 1 running
+        # request emitted nothing because the step carried no decode rows
+        # (the interference tax the mixed scheduler removes; stays ~0
+        # with mixed on, counts the alternating baseline's prefill tax)
+        self.mixed_steps = 0
+        self.decode_stall_steps = 0
         # cumulative MoE capacity-drop counters (dispatch impl only)
         self.moe_dropped_tokens = 0.0
         self.moe_routed_tokens = 0.0
@@ -493,7 +506,14 @@ class NativeEngine:
         if plan is None:
             return []
         self.step_count += 1
+        if isinstance(plan, MixedPlan):
+            return self._run_mixed(plan)
         if isinstance(plan, PrefillPlan):
+            # decode-stall accounting: a pure prefill step while decode
+            # slots are live starves every running stream for this step
+            # (exactly what mixed steps remove — bench.py churn phase)
+            if any(s is not None for s in self.scheduler.running):
+                self.decode_stall_steps += 1
             return self._run_prefill(plan)
         if self._pipeline_ok(plan):
             events = self._prime_pipeline(plan)
@@ -522,22 +542,27 @@ class NativeEngine:
 
     # -- internals -----------------------------------------------------------
 
-    def _sampling_arrays(self, reqs: List[Optional[SequenceState]]):
+    def _sampling_arrays(self, reqs: List[Optional[SequenceState]],
+                         mixed: bool = False):
         """(temp, top_k, top_p, seeds, counters, min_toks) per slot. The
         static block is cached per slot set (sampler.SamplingArrayCache):
         per-request params are immutable, so only the counters column is
-        rebuilt per step."""
-        return self._samp_cache.arrays(
-            reqs, lambda rid: self.scheduler.params[rid])
+        rebuilt per step. Mixed steps use their own cache instance so the
+        mixed row set and the decode window's slot set don't evict each
+        other on every step-kind alternation."""
+        cache = self._mixed_samp_cache if mixed else self._samp_cache
+        return cache.arrays(reqs, lambda rid: self.scheduler.params[rid])
 
-    def _rep_penalty_arrays(self, reqs: List[Optional[SequenceState]]):
+    def _rep_penalty_arrays(self, reqs: List[Optional[SequenceState]],
+                            mixed: bool = False):
         """(hist [S, Hb], rep_penalty [S]) when any request penalizes
         repetition, else None. hist rows are each sequence's seen tokens
         (prompt + generated), padded with vocab_size (dropped on scatter);
         Hb is bucketed so the compiled-program set stays small. Rows are
         updated incrementally across steps (sampler.RepPenaltyCache) —
         only tokens generated since the last call are appended."""
-        return self._rp_cache.arrays(
+        cache = self._mixed_rp_cache if mixed else self._rp_cache
+        return cache.arrays(
             reqs, lambda rid: self.scheduler.params[rid],
             self.model_cfg.vocab_size,
             lambda n: next_bucket(n, pow2_buckets(self.cfg.max_model_len)))
@@ -563,10 +588,10 @@ class NativeEngine:
                    self.scheduler.params[seq.request_id].logprobs is not None
                    for seq in reqs)
 
-    def _run_device_step(self, plan, reqs):
+    def _run_device_step(self, plan, reqs, mixed: bool = False):
         temp, top_k, top_p, seeds, counters, min_toks = \
-            self._sampling_arrays(reqs)
-        rp = self._rep_penalty_arrays(reqs)
+            self._sampling_arrays(reqs, mixed=mixed)
+        rp = self._rep_penalty_arrays(reqs, mixed=mixed)
         with_lp = self._wants_logprobs(reqs)
         mm = getattr(plan, "mm_embeds", None) is not None
         args = (self.params, self.cache,
@@ -617,6 +642,60 @@ class NativeEngine:
                     seq, tok, float(lps[0][i]), lps[1][i], lps[2][i]))
             else:
                 events.append(self._postprocess(seq, tok))
+        return events
+
+    def _run_mixed(self, plan: MixedPlan) -> List[StepOutput]:
+        """One fused prefill+decode step (docs/PERF.md): decode rows and
+        prefill chunk rows share a single [Bb, Tb] forward+sample program
+        (the same _step_fns variant prefill uses — a decode row is a
+        one-token causal chunk, so the program set gains no new member).
+
+        Exactness: decode rows sample through the identical
+        sample_logits tail with the same (seed, counter) the decode
+        window would use, so greedy and seeded-sampled streams are
+        token-identical to the alternating scheduler (CPU/f32 exact; on
+        TPU bf16 the prefill-shaped forward and the window program
+        differ arithmetically at near-tie level, the same caveat as the
+        spec-decode verify path)."""
+        sampled = self._run_device_step(plan, plan.seqs, mixed=True)
+        lps = self._last_logprobs
+        events: List[StepOutput] = []
+        # decode rows first (slot order, the decode path's commit order);
+        # a finish here frees slots the prefill rows never relied on —
+        # their slot reservations were taken at planning time
+        for i, seq in enumerate(plan.seqs):
+            if seq is None or not plan.is_decode[i]:
+                continue
+            self.scheduler.commit_decode_token(seq, int(sampled[i]))
+            if lps is not None:
+                events.append(self._postprocess(
+                    seq, seq.output[-1], float(lps[0][i]), lps[1][i],
+                    lps[2][i]))
+            else:
+                events.append(self._postprocess(seq, seq.output[-1]))
+        # prefill rows commit in REVERSE order: continuing multi-chunk
+        # rows re-queue with appendleft, so reverse iteration keeps the
+        # earliest-arrived row at the head (FIFO, as _run_prefill)
+        for i in reversed(range(len(plan.seqs))):
+            seq = plan.seqs[i]
+            if seq is None or plan.is_decode[i]:
+                continue
+            tok = self.scheduler.commit_prefill_row(
+                plan, i, int(sampled[i]) if plan.is_last_chunk[i] else None)
+            if tok is None:
+                continue
+            if seq.prefill_only:
+                events.append(
+                    StepOutput(seq.request_id, tok, True, "prefill_done"))
+            elif lps is not None:
+                events.append(self._postprocess(
+                    seq, tok, float(lps[0][i]), lps[1][i], lps[2][i]))
+            else:
+                events.append(self._postprocess(seq, tok))
+        # the decode rows advanced outside the window program: any saved
+        # device-resident window carry (token/position/counter) is stale
+        self._dec_state = None
+        self.mixed_steps += 1
         return events
 
     def _run_decode(self, plan: DecodePlan) -> List[StepOutput]:
@@ -908,17 +987,31 @@ class NativeEngine:
                 leaf.copy_to_host_async()
 
     def _membership_intact(self, plan: DecodePlan) -> bool:
-        """True while every slot of `plan` still maps to the same live
+        """True while every ROW of `plan` still maps to the same live
         sequence object (no finish, abort, or preemption since staging) —
-        the validity condition for results computed off the staged state."""
+        the validity condition for results computed off the staged state.
+
+        Deliberately per-row (the mixed-step membership-guard extension):
+        an admission that fills a slot the plan staged as PADDING does
+        not invalidate the in-flight window — its results for the staged
+        rows are exact, the padding row computed nothing (max_pos=-1
+        keeps it !alive with no KV writes) — so the window is COMMITTED,
+        not discarded. Whether the pipeline may keep chaining off the
+        staged plan is a separate question (_slots_grown): a grown slot
+        set needs a re-plan so the new arrival joins the next window."""
         running = self.scheduler.running
         for i, seq in enumerate(plan.seqs):
-            if seq is None:
-                if running[i] is not None:
-                    return False
-            elif running[i] is not seq:
+            if seq is not None and running[i] is not seq:
                 return False
         return True
+
+    def _slots_grown(self, plan: DecodePlan) -> bool:
+        """A slot the staged plan held as padding is now occupied (an
+        admission landed since staging): in-flight results stay valid,
+        but further windows off this plan would starve the newcomer."""
+        running = self.scheduler.running
+        return any(seq is None and running[i] is not None
+                   for i, seq in enumerate(plan.seqs))
 
     def _pipeline_step(self) -> List[StepOutput]:
         """Advance the two-deep decode pipeline by one step():
@@ -942,10 +1035,20 @@ class NativeEngine:
         self._process_onboards()
         plan, staged = pend["plan"], pend["staged"]
         follow = None
-        if self.scheduler.waiting or self.scheduler.pending_onboards:
-            pass        # admission pending: drain the pipeline first
+        if pend.get("drain"):
+            pass        # flagged reconcile: commit, then force a re-plan
+        elif self.scheduler.waiting or self.scheduler.pending_onboards:
+            pass        # admission pending: drain the pipeline — the
+            #             in-flight window is COMMITTED below (reconciled,
+            #             never discarded) and the next step() plans a
+            #             mixed prefill+decode step, so the arrival costs
+            #             steady decode at most this one un-overlapped
+            #             window before the pipeline re-primes
         elif not self._membership_intact(plan):
             pass        # abort mid-window: commit what's valid, re-plan
+        elif self._slots_grown(plan):
+            pass        # an admission filled a staged-padding slot: the
+            #             newcomer needs the next plan, stop chaining
         elif self._followup_fits(plan, pend["j"] + 1):
             follow_outs, follow_nxt = self._dispatch_staged(
                 staged, pend["nxt"])
@@ -961,13 +1064,20 @@ class NativeEngine:
                 # true overlap: the commit above ran while the follow-up
                 # executed on device
                 self.pipeline_overlapped += 1
+                if self._slots_grown(plan):
+                    # reconcile, don't discard: the follow-up's results
+                    # are exact for every staged row (the newly filled
+                    # slot was padding — no compute, no KV writes), so
+                    # commit it next step, then re-plan so the arrival
+                    # joins the decode set
+                    follow["drain"] = True
                 self._pipeline = follow
                 self._dec_state = {"sig": staged["sig"],
                                    "dev": staged["dev"],
                                    "next": follow["nxt"]}
             else:
                 # reconciliation fallback: the follow-up's results assume
-                # a slot set the commit just changed — drop them (the
+                # row occupants the commit just changed — drop them (the
                 # donated cache already advanced; its garbage KV writes
                 # are overwritten by the synchronous re-plan)
                 self.pipeline_fallbacks += 1
@@ -1371,6 +1481,8 @@ class NativeEngine:
         m.pipeline_fallbacks = self.pipeline_fallbacks
         m.decode_host_syncs = self.decode_host_syncs
         m.decode_plan_uploads = self.decode_plan_uploads
+        m.mixed_steps = self.mixed_steps
+        m.decode_stall_steps = self.decode_stall_steps
         return m
 
     def moe_drop_rate(self) -> float:

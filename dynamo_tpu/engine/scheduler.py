@@ -7,11 +7,18 @@ static shape, so the scheduler buckets prefill chunk lengths and page counts to
 a small fixed set (powers of two) and pads decode to a fixed slot count —
 XLA compiles one program per bucket and never recompiles in steady state.
 
-Step policy: prefill-priority, one prefill chunk at a time (bounded by
-max_prefill_chunk), otherwise one decode step over all active slots. The
-disaggregated deployment sends long prefills to dedicated prefill workers
-(dynamo_tpu/disagg/), which is the reference's answer to prefill/decode
-interference (reference: docs/disagg_serving.md).
+Step policy (mixed_token_budget > 0, the default): Sarathi-style fused
+steps — whenever requests are waiting while decodes run, one [Bb, Tb]
+MixedPlan carries every running slot as a single-token decode row plus a
+token-budgeted prefill chunk, so decode emits on EVERY step and prefill
+rides the batch's spare compute instead of preempting it (docs/PERF.md).
+Pure prefill runs only with no active decode; pure decode (the pipelined
+window path) runs whenever nothing is waiting. Legacy alternating policy
+(mixed_token_budget=0, and always under sp>1): prefill-priority with a
+bounded streak. The disaggregated deployment still sends long prefills
+to dedicated prefill workers (dynamo_tpu/disagg/), the reference's
+answer to prefill/decode interference (reference: docs/disagg_serving.md);
+mixed steps close the same gap for the aggregated single-worker shape.
 """
 from __future__ import annotations
 
@@ -117,6 +124,26 @@ class PrefillPlan:
 
 
 @dataclasses.dataclass
+class MixedPlan(PrefillPlan):
+    """One fused prefill+decode device step (Sarathi-style, docs/PERF.md).
+
+    Layout is a PrefillPlan [Bb, Tb] whose leading rows are the running
+    decode slots — each a single-token causal row (token at column 0,
+    write_idx -1 elsewhere, kv_lens = position + 1) — followed by the
+    token-budgeted prefill chunk rows. AttnMetadata already carries
+    per-row positions/kv_lens/write_idx, so the ordinary paged-attention
+    prefill program executes both row kinds in one forward pass: a
+    decode row's causal mask over [0, pos] is exactly the decode
+    attention set, and sampling at last_idx=0 with the request's
+    (seed, counter) reproduces the decode path's token. Every dim is
+    bucketed (Bb pow2 over a fixed cap, Tb from prefill_buckets, Pb
+    from the page ladder) so admissions reuse compiled programs.
+    """
+
+    is_decode: List[bool] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
 class DecodePlan:
     seqs: List[Optional[SequenceState]]  # per slot
     tokens: np.ndarray      # [S, 1]
@@ -176,6 +203,14 @@ class EngineMetrics:
     pipeline_fallbacks: int = 0
     decode_host_syncs: int = 0
     decode_plan_uploads: int = 0
+    # mixed prefill+decode steps (docs/PERF.md): fused [Bb, Tb] steps
+    # run, and decode stall steps — device steps where >= 1 running
+    # request emitted nothing because the step carried no decode rows
+    # (the prefill/decode interference the mixed scheduler removes;
+    # ~0 with mixed steps on, the alternating baseline's prefill tax
+    # otherwise)
+    mixed_steps: int = 0
+    decode_stall_steps: int = 0
 
 
 def window_ladder(decode_steps: int) -> List[int]:
@@ -252,6 +287,12 @@ class Scheduler:
         self._prefix_hits = 0
         self._prefix_lookups = 0
         self._prefill_streak = 0
+        # mixed-step budget, runtime-flippable (bench.py's churn phase
+        # A/Bs mixed vs alternating on one engine without recompiling;
+        # 0 = legacy alternating). Ring-attention prefill (sp > 1) cannot
+        # share a step with paged decode rows, so sp engines stay legacy.
+        self.mixed_token_budget = (cfg.mixed_token_budget
+                                   if cfg.sp == 1 else 0)
         # monotonic epoch source shared by admission AND preemption: the
         # engine's device-resident decode carry and the sampler's host
         # array caches key slots by (request_id, epoch), so every
@@ -517,12 +558,36 @@ class Scheduler:
             seq.page_hashes.append(h)
 
     def schedule(self):
-        """Return a PrefillPlan, DecodePlan, or None (idle).
+        """Return a MixedPlan, PrefillPlan, DecodePlan, or None (idle).
 
-        Prefill-priority with a bounded streak: after max_prefill_streak
-        consecutive prefill chunks, one decode step runs (when any decode
-        is active) so running requests keep emitting tokens while a long
-        prompt prefills (VERDICT r1 weak #3)."""
+        Mixed-step mode (mixed_token_budget > 0, the default): whenever
+        requests are waiting while decodes run, ONE fused [Bb, Tb] step
+        carries every running slot as a single-token decode row plus a
+        token-budgeted prefill chunk, so decode emits on every step and
+        the streak logic is moot. Pure prefill runs only when no decode
+        is active; pure decode (the pipelined window path) runs whenever
+        nothing is waiting.
+
+        Legacy alternating mode (mixed_token_budget=0, and always under
+        sp>1): prefill-priority with a bounded streak — after
+        max_prefill_streak consecutive prefill chunks, one decode step
+        runs (when any decode is active) so running requests keep
+        emitting tokens while a long prompt prefills (VERDICT r1 weak
+        #3)."""
+        if self.mixed_token_budget > 0 and self.cfg.sp == 1:
+            decode_active = any(s is not None for s in self.running)
+            if self.waiting and decode_active:
+                plan = self._schedule_mixed()
+                if plan is not None:
+                    return plan
+                # no admissible prefill row right now (slots/memory):
+                # decode alone — never a decode-stalling pure prefill
+                return self._schedule_decode()
+            if self.waiting:
+                plan = self._schedule_prefill()
+                if plan is not None:
+                    return plan
+            return self._schedule_decode()
         limit = self.cfg.max_prefill_streak
         if limit and self._prefill_streak >= limit \
                 and any(s is not None for s in self.running):
@@ -537,14 +602,20 @@ class Scheduler:
         self._prefill_streak = 0
         return self._schedule_decode()
 
-    def _prefill_admissible(self, seq: SequenceState, slots_left: int):
+    def _prefill_admissible(self, seq: SequenceState, slots_left: int,
+                            chunk_cap: Optional[int] = None):
         """Can this waiting seq's next chunk run now? Returns (n, is_last,
-        takes_slot) or a string reason ("slot" | "memory")."""
+        takes_slot) or a string reason ("slot" | "memory"). chunk_cap
+        further clamps the chunk below max_prefill_chunk (mixed steps
+        bound it by the per-step token budget)."""
         n_toks = len(seq.all_tokens)
         if seq.num_cached >= n_toks:
             # fully cached prefix was trimmed to len-1 in _match_prefix
             raise AssertionError("prefix match must leave >=1 token")
-        n = min(n_toks - seq.num_cached, self.cfg.max_prefill_chunk)
+        cap = self.cfg.max_prefill_chunk
+        if chunk_cap is not None:
+            cap = min(cap, chunk_cap)
+        n = min(n_toks - seq.num_cached, cap)
         is_last = seq.num_cached + n == n_toks
         takes_slot = is_last and not seq.prefill_only
         if takes_slot and slots_left <= 0:
@@ -555,66 +626,174 @@ class Scheduler:
             return "memory"
         return n, is_last, takes_slot
 
+    def _collect_prefill_batch(self, slots_left: int,
+                               chunk_cap: Optional[int] = None,
+                               max_rows: Optional[int] = None):
+        """Pop admissible waiting seqs whose next chunk shares one token
+        bucket; returns (batch [(seq, n, is_last)], tb, head_block).
+
+        Bounded skip-ahead (head-of-line fix): a head blocked on slots or
+        memory — or mid-scan candidates whose chunk lands in a different
+        bucket — no longer block later waiting requests that could run.
+        Up to prefill_skip_ahead blocked/mismatched entries are scanned
+        past; the queue itself is never reordered and every pass rescans
+        from the true head, so a blocked head runs the moment its
+        resources free (no starvation). head_block is the original
+        head's blocking reason ("slot" | "memory" | None) for the
+        caller's dead-end accounting."""
+        bound = max(0, self.cfg.prefill_skip_ahead)
+        max_b = max(1, self.cfg.max_prefill_batch)
+        if max_rows is not None:
+            max_b = min(max_b, max(1, max_rows))
+        if self.cfg.sp > 1:
+            max_b = 1  # ring-attention prefill: one whole-prompt row
+            bound = 0  # whole-prompt ordering must stay strictly FIFO
+        batch, tb, head_block = [], None, None
+        i = skipped = 0
+        while len(batch) < max_b and i < len(self.waiting):
+            cand = self.waiting[i]
+            res = None
+            if tb is not None:
+                cap = self.cfg.max_prefill_chunk
+                if chunk_cap is not None:
+                    cap = min(cap, chunk_cap)
+                nc = min(len(cand.all_tokens) - cand.num_cached, cap)
+                if next_bucket(nc, self.prefill_buckets) != tb:
+                    res = "bucket"  # only same-bucket chunks share a step
+            if res is None:
+                res = self._prefill_admissible(cand, slots_left, chunk_cap)
+            if isinstance(res, str):
+                if i == 0 and not batch and res != "bucket":
+                    head_block = res
+                skipped += 1
+                if skipped > bound:
+                    break
+                i += 1
+                continue
+            n, is_last, takes_slot = res
+            if tb is None:
+                tb = next_bucket(n, self.prefill_buckets)
+            slots_left -= takes_slot
+            batch.append((cand, n, is_last))
+            del self.waiting[i]  # later entries shift left; i stays put
+        return batch, tb, head_block
+
     def _schedule_prefill(self) -> Optional[PrefillPlan]:
         if not self.waiting:
             return None
         slots_left = sum(1 for s in self.running if s is None)
-        head = self.waiting[0]
-        res = self._prefill_admissible(head, slots_left)
-        if res == "slot":
-            return None
-        if res == "memory":
-            # only a true dead end raises: no running decode, no parked
-            # or remote sequence whose pages will be released shortly
-            if not any(s is not None for s in self.running) \
-                    and not self.parked and not self.remote:
-                raise MemoryError(
-                    f"prompt of {len(head.all_tokens)} tokens cannot fit in "
-                    f"{self.cfg.num_pages} pages of {self.cfg.page_size}")
-            return None  # memory pressure: let pages drain
-        n, is_last, takes_slot = res
-        tb = next_bucket(n, self.prefill_buckets)
-        batch = [(head, n, is_last)]
-        slots_left -= takes_slot
-        self.waiting.popleft()
-        # pack more waiting seqs whose next chunk fits the SAME token bucket
-        # (keeps the compiled-program set small: one program per (Bb, Tb,
-        # Pb) triple, and same-bucket chunks waste no pad compute). Seqs
-        # that can't join stay queued in FIFO order.
-        max_b = max(1, self.cfg.max_prefill_batch)
-        if self.cfg.sp > 1:
-            max_b = 1  # ring-attention prefill: one whole-prompt row
-        while len(batch) < max_b and self.waiting:
-            cand = self.waiting[0]
-            nc = min(len(cand.all_tokens) - cand.num_cached,
-                     self.cfg.max_prefill_chunk)
-            if next_bucket(nc, self.prefill_buckets) != tb:
-                break
-            res = self._prefill_admissible(cand, slots_left)
-            if not isinstance(res, tuple):
-                break
-            nc, last_c, slot_c = res
-            slots_left -= slot_c
-            batch.append((cand, nc, last_c))
-            self.waiting.popleft()
+        batch, tb, head_block = self._collect_prefill_batch(slots_left)
+        if not batch:
+            if head_block == "memory":
+                # only a true dead end raises: no running decode, no
+                # parked or remote sequence whose pages will be released
+                # shortly
+                head = self.waiting[0]
+                if not any(s is not None for s in self.running) \
+                        and not self.parked and not self.remote:
+                    raise MemoryError(
+                        f"prompt of {len(head.all_tokens)} tokens cannot "
+                        f"fit in {self.cfg.num_pages} pages of "
+                        f"{self.cfg.page_size}")
+            return None  # blocked (slots, or memory pressure draining)
         return self._build_prefill(batch, tb)
 
-    def _build_prefill(self, batch, tb: int) -> PrefillPlan:
+    def _schedule_mixed(self) -> Optional[MixedPlan]:
+        """One fused prefill+decode step (MixedPlan), or None when no
+        prefill row is admissible right now.
+
+        Budget accounting (docs/PERF.md): the per-step token budget is
+        total [rows x Tb] device compute. Decode rows are charged the
+        full Tb-wide window each occupies (their padding compute is real
+        and charged honestly); the prefill chunk takes the remainder —
+        the chunk bucket is the largest rung with
+        Tb * (n_decode + n_prefill_rows) <= mixed_token_budget, falling
+        back to the smallest rung so prefill always progresses."""
+        # decode-side page guarantee for ONE token per running slot, the
+        # same invariant (and preemption fallback) the decode planner
+        # maintains per window
+        active = [s for s in self.running if s is not None]
+        for seq in active:
+            # total_len+1 even past the request's own budget (the old
+            # single-step invariant): an overrun caller still gets its
+            # fed-token slot
+            while seq.slot >= 0 \
+                    and not self._ensure_pages(seq, seq.total_len + 1):
+                self._preempt_one()
+        active = [s for s in self.running if s is not None]
+        if not active:
+            return None  # everything preempted; caller re-plans
+        n_decode = len(active)
+        budget = self.mixed_token_budget
+        cap = self.prefill_buckets[0]  # progress guarantee
+        for rung in reversed(self.prefill_buckets):
+            if rung * (n_decode + 1) <= budget:
+                cap = rung
+                break
+        slots_left = sum(1 for s in self.running if s is None)
+        # budget bounds extra prefill rows too: every row costs cap
+        max_rows = max(1, budget // cap - n_decode)
+        batch, tb, _ = self._collect_prefill_batch(slots_left, cap,
+                                                   max_rows)
+        if not batch:
+            return None
+        return self._build_prefill(batch, tb, decode_rows=active)
+
+    def _build_prefill(self, batch, tb: int,
+                       decode_rows: Sequence[SequenceState] = ()
+                       ) -> PrefillPlan:
+        """Build a [Bb, Tb] prefill plan; with decode_rows, a MixedPlan
+        whose leading rows are those running slots as single-token decode
+        rows (fused prefill+decode step). All leading dims are bucketed
+        — Bb over a FIXED pow2 ladder (its cap does not move with the
+        live row count), Tb from prefill_buckets, Pb from the page
+        ladder — so an admission reuses compiled programs instead of
+        minting one per batch shape (dynalint R10)."""
         ps = self.cfg.page_size
-        bb = next_bucket(len(batch), pow2_buckets(
-            max(len(batch), self.cfg.max_prefill_batch)))
+        nd = len(decode_rows)
+        n_rows = nd + len(batch)
+        row_cap = self.cfg.max_prefill_batch
+        if nd:
+            # mixed steps can carry every slot plus prefill rows; the
+            # ladder cap is config-fixed so Bb stays on stable rungs
+            row_cap = self.cfg.max_slots + max(1, self.cfg.max_prefill_batch)
+        bb = next_bucket(n_rows, pow2_buckets(max(n_rows, row_cap)))
         tokens = np.zeros((bb, tb), np.int32)
         positions = np.zeros((bb, tb), np.int32)
         write_idx = np.full((bb, tb), -1, np.int32)
         kv_lens = np.zeros((bb,), np.int32)
         last = np.zeros((bb,), np.int32)
-        pb = next_bucket(max(max(len(s.pages) for s, _, _ in batch), 1),
-                         self.page_buckets)
+        max_pages = max(max(len(s.pages) for s, _, _ in batch), 1)
+        for seq in decode_rows:
+            # admission-time width (prompt + max_tokens), as the decode
+            # planner buckets it: the width never moves mid-request, so
+            # mixed steps reuse the same Pb rungs across a request's life
+            max_pages = max(
+                max_pages, len(seq.pages),
+                -(-(len(seq.prompt) + self.params[seq.request_id].max_tokens)
+                  // ps))
+        pb = next_bucket(max_pages, self.page_buckets)
         page_table = np.zeros((bb, pb), np.int32)
         seqs: List[Optional[SequenceState]] = [None] * bb
         n_valid, is_last = [0] * bb, [False] * bb
+        is_decode = [False] * bb
         mm_embeds = mm_mask = None
-        for i, (seq, n, last_chunk) in enumerate(batch):
+        for i, seq in enumerate(decode_rows):
+            # one-token causal decode row: feed the last sampled token at
+            # its position; padding columns carry the same position (the
+            # _build_prefill pad convention) and write nothing
+            seqs[i] = seq
+            is_decode[i] = True
+            n_valid[i] = 1
+            pos = seq.total_len - 1
+            tokens[i, 0] = seq.output[-1] if seq.output else seq.prompt[-1]
+            positions[i, :] = pos
+            write_idx[i, 0] = seq.flat_index(pos, ps)
+            page_table[i, :len(seq.pages)] = seq.pages
+            kv_lens[i] = pos + 1
+            last[i] = 0
+        for j, (seq, n, last_chunk) in enumerate(batch):
+            i = nd + j
             start = seq.num_cached
             seqs[i] = seq
             n_valid[i] = n
@@ -622,8 +801,8 @@ class Scheduler:
             tokens[i, :n] = seq.all_tokens[start:start + n]
             positions[i, :] = max(start + n - 1, 0)
             positions[i, :n] = np.arange(start, start + n)
-            for j in range(n):
-                write_idx[i, j] = seq.flat_index(start + j, ps)
+            for t in range(n):
+                write_idx[i, t] = seq.flat_index(start + t, ps)
             page_table[i, :len(seq.pages)] = seq.pages
             kv_lens[i] = start + n
             last[i] = n - 1
@@ -638,11 +817,14 @@ class Scheduler:
                     mm_mask = np.zeros((bb, tb), bool)
                 mm_embeds[i, lo - start:hi - start] = emb[lo - off:hi - off]
                 mm_mask[i, lo - start:hi - start] = True
-        return PrefillPlan(
+        kw = dict(
             seqs=seqs, tokens=tokens, positions=positions,
             page_table=page_table, kv_lens=kv_lens, write_idx=write_idx,
             last_idx=last, n_valid=n_valid, is_last_chunk=is_last,
             mm_embeds=mm_embeds, mm_mask=mm_mask)
+        if nd:
+            return MixedPlan(is_decode=is_decode, **kw)
+        return PrefillPlan(**kw)
 
     def commit_prefill_row(self, plan: PrefillPlan, i: int,
                            sampled_token: Optional[int]):

@@ -90,6 +90,11 @@ class MetricsExporter:
                  "In-flight windows discarded on membership change"),
                 ("host_syncs", "Blocking output fetches in decode"),
                 ("plan_uploads", "Windows that staged fresh host arrays"),
+                ("mixed_steps",
+                 "Fused prefill+decode device steps run"),
+                ("stall_steps",
+                 "Steps where running streams emitted nothing (decode "
+                 "stalled by a prefill-only step)"),
             )}
         self.g_load_avg = r.gauge(
             f"{PREFIX}_load_avg", "Mean active KV blocks across workers")
@@ -184,6 +189,10 @@ class MetricsExporter:
                 worker_id, value=m.decode_host_syncs)
             self.g_pipe["plan_uploads"].set(
                 worker_id, value=m.decode_plan_uploads)
+            self.g_pipe["mixed_steps"].set(
+                worker_id, value=m.mixed_steps)
+            self.g_pipe["stall_steps"].set(
+                worker_id, value=m.decode_stall_steps)
         self.g_load_avg.set(value=endpoints.load_avg)
         self.g_load_std.set(value=endpoints.load_std)
         self.g_workers.set(value=len(endpoints.workers))

@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R9), the
+- one positive AND one negative fixture per AST rule (R1-R10), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -531,6 +531,68 @@ def test_r9_live_on_current_serving_layers():
         with open(path) as f:
             found = lint_source(f.read(), rel)
         assert not [x for x in found if x.rule == "R9"], rel
+
+
+# -- R10: unbucketed leading dims in schedule()-reachable plan builders -------
+
+R10_SRC = """
+    import numpy as np
+
+    def _build_mixed(batch, tb):
+        tokens = np.zeros((len(batch), tb), np.int32)
+        return tokens
+"""
+
+
+def test_r10_flags_unbucketed_leading_dim_in_plan_builder():
+    found = lint_source(textwrap.dedent(R10_SRC),
+                        "dynamo_tpu/engine/scheduler_fixture.py")
+    assert "R10" in rules(found)
+
+
+def test_r10_quiet_outside_planning_scope_and_functions():
+    # same shape outside the engine planning layer: not schedule()-
+    # reachable, out of scope
+    found = lint_source(textwrap.dedent(R10_SRC),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert "R10" not in rules(found)
+    # helper not matching the planner naming (not schedule()-reachable
+    # plan construction): quiet even in scope
+    helper = """
+        import numpy as np
+
+        def pack_payload(items):
+            return np.zeros((len(items),), np.int32)
+    """
+    found = lint_source(textwrap.dedent(helper),
+                        "dynamo_tpu/engine/scheduler_fixture.py")
+    assert "R10" not in rules(found)
+
+
+def test_r10_quiet_on_bucketed_dims_and_annotation():
+    neg = """
+        import numpy as np
+
+        def _build_prefill(batch, tb, buckets):
+            bb = next_bucket(len(batch), buckets)
+            tokens = np.zeros((bb, tb), np.int32)
+            # dynalint: bucketed — row count is config-fixed max_slots
+            extra = np.full((len(batch), 1), -1, np.int32)
+            return tokens, extra
+    """
+    found = lint_source(textwrap.dedent(neg),
+                        "dynamo_tpu/engine/scheduler_fixture.py")
+    assert "R10" not in rules(found)
+
+
+def test_r10_live_on_current_planning_layer():
+    """The mixed-step planner (and everything else schedule()-reachable)
+    builds only bucketed per-step arrays."""
+    for rel in ("dynamo_tpu/engine/scheduler.py",
+                "dynamo_tpu/engine/engine.py"):
+        with open(os.path.join(REPO, rel)) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R10"], rel
 
 
 # -- jaxpr invariants ----------------------------------------------------------

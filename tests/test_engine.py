@@ -209,7 +209,8 @@ def test_prefill_streak_capped_decode_interleaves():
 
     cfg = EngineConfig(page_size=8, num_pages=128, max_slots=2,
                        max_prefill_chunk=8, prefill_buckets=(8,),
-                       max_model_len=512, max_prefill_streak=2)
+                       max_model_len=512, max_prefill_streak=2,
+                       mixed_token_budget=0)  # legacy alternating mode
     s = Scheduler(cfg)
     s.add_request(EngineRequest("a", list(range(2, 10)), SamplingParams(
         max_tokens=50, ignore_eos=True)))
@@ -243,7 +244,8 @@ def test_prefill_streak_unbounded_when_disabled():
 
     cfg = EngineConfig(page_size=8, num_pages=128, max_slots=2,
                        max_prefill_chunk=8, prefill_buckets=(8,),
-                       max_model_len=512, max_prefill_streak=0)
+                       max_model_len=512, max_prefill_streak=0,
+                       mixed_token_budget=0)  # legacy alternating mode
     s = Scheduler(cfg)
     s.add_request(EngineRequest("a", list(range(2, 10)), SamplingParams(
         max_tokens=50, ignore_eos=True)))

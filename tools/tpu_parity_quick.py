@@ -26,7 +26,11 @@ import time
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
-OUT = os.path.join(HERE, "PARITY_TPU_r05.json")
+# PARITY_OUT: alternate artifact name so variant captures (e.g. the int8
+# parity item in tools/tpu_window_watch.sh's ladder) don't overwrite the
+# bf16 evidence
+OUT = os.path.join(HERE, os.environ.get("PARITY_OUT",
+                                        "PARITY_TPU_r05.json"))
 
 
 def log(*a):
