@@ -404,6 +404,283 @@ PAGE_KWARGS = dict(
     page_size=64, num_pages=256, max_slots=8, max_prefill_chunk=128,
     prefill_buckets=(128,), max_model_len=2048, max_prefill_batch=8)
 
+# kv_quant parity gate thresholds (ONE definition — tests/test_kv_quant.py
+# and tools/tpu_parity_quick.py both import these, so the committed gate
+# and the TPU ladder can never drift apart): the logit drift must stay
+# under atol + rtol * max|logit| (per-row int8 error is ~0.4% relative;
+# the bound leaves ~10x headroom so only a real codec bug trips it),
+# and the DECISIVE greedy-match rate — argmax agreement at positions
+# whose reference top-2 margin exceeds 2x the drift bound, i.e. where a
+# bounded perturbation could never legitimately flip the choice — must
+# be >= KVQ_MATCH_MIN. Near-tie positions (margin <= 2x bound) are
+# reported in the raw rate but not gated: any epsilon perturbation
+# flips them by definition (the §3b bf16 caveat, docs/PERF.md).
+KVQ_MATCH_MIN = 0.99
+KVQ_DRIFT_RTOL = 0.05
+KVQ_DRIFT_ATOL = 0.05
+
+
+def run_kv_quant_parity(model_cfg, engine_kwargs=None, n_tokens=64,
+                        n_prompts=3, logf=None):
+    """kv_quant="int8" exactness gate: TEACHER-FORCED greedy-match rate
+    vs the unquantized twin plus bounded logit drift.
+
+    ONE implementation shared by the tier-1 gate (tests/test_kv_quant.py)
+    and the TPU ladder (tools/tpu_parity_quick.py with
+    PARITY_KV_QUANT=int8), so the committed thresholds are exactly what
+    runs on hardware.
+
+    Why teacher-forced: on a free-running greedy stream, ONE near-tie
+    argmax flip permanently diverges the context and every later token
+    "mismatches" — the rate then measures butterfly effects, not codec
+    error (observed: a single flip at token 2 of a 64-token tiny-model
+    stream scored 0.05). Instead the reference engine free-runs
+    n_tokens greedily, and both representations replay the SAME
+    (prompt + reference continuation) through one prefill-shaped
+    forward over shared params; the match rate is per-POSITION argmax
+    agreement at every decision point — exactly "how often does int8
+    KV flip a greedy decision", cascade-free. Drift is the max abs
+    logit delta over the same decision points, bounded by
+    KVQ_DRIFT_ATOL + KVQ_DRIFT_RTOL * max|logit|.
+
+    Returns a verdict dict: {pass, greedy_match_rate, max_logit_drift,
+    drift_bound, n_tokens, per_prompt}.
+    """
+    import dataclasses
+
+    import numpy as np
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import SamplingParams
+
+    logf = logf or log
+    kw = dict(engine_kwargs or PAGE_KWARGS)
+    pmod = min(1000, model_cfg.vocab_size - 2)
+    prompts = [[(31 * j + 97 * i) % pmod + 1 for j in range(48)]
+               for i in range(n_prompts)]
+    params = SamplingParams(max_tokens=n_tokens, temperature=0.0,
+                            ignore_eos=True)
+
+    # teacher streams from the REAL unquantized engine (the serving path
+    # writes/reads its pages exactly as deployed)
+    ref_eng = NativeEngine(model_cfg, EngineConfig(**kw), seed=0)
+    refs = [ref_eng.generate(p, params, f"kvq-ref-{i}")
+            for i, p in enumerate(prompts)]
+    del ref_eng  # free HBM before the replay forwards
+
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.llama import AttnMetadata
+    cfg_q = dataclasses.replace(model_cfg, kv_quant="int8")
+    ps = kw.get("page_size", 64)
+    prm = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+
+    def replay_logits(cfg, seq):
+        """One prefill-shaped forward over the whole teacher sequence:
+        pages are written (quantized under cfg_q) and read back by the
+        chunk's own causal attention — the codec round-trip at every
+        position."""
+        t = len(seq)
+        n_pages_row = -(-t // ps)
+        meta = AttnMetadata(
+            positions=jnp.asarray([list(range(t))], jnp.int32),
+            page_table=jnp.asarray([list(range(n_pages_row))], jnp.int32),
+            kv_lens=jnp.asarray([t], jnp.int32),
+            write_idx=jnp.asarray([list(range(t))], jnp.int32))
+        cache = llama.init_cache(cfg, n_pages_row, ps)
+        lg = jax.jit(lambda p, c: llama.forward(
+            p, cfg, jnp.asarray([seq], jnp.int32), c, meta)[0])(prm, cache)
+        return np.asarray(lg[0], np.float32)
+
+    rows = []   # (margins, agree, drift_row_max, |logit| max) per prompt
+    for prompt, ref in zip(prompts, refs):
+        seq = list(prompt) + list(ref)
+        lg_ref = replay_logits(model_cfg, seq)
+        lg_q = replay_logits(cfg_q, seq)
+        # decision points: positions that predicted each generated token
+        lo, hi = len(prompt) - 1, len(seq) - 1
+        a = lg_ref[lo:hi]
+        agree = a.argmax(axis=-1) == lg_q[lo:hi].argmax(axis=-1)
+        top2 = np.sort(a, axis=-1)[:, -2:]
+        rows.append((top2[:, 1] - top2[:, 0], agree,
+                     float(np.abs(lg_q[lo:hi] - a).max()),
+                     float(np.abs(a).max())))
+    del prm
+    drift = max(r[2] for r in rows)
+    bound = KVQ_DRIFT_ATOL + KVQ_DRIFT_RTOL * max(r[3] for r in rows)
+    margins = np.concatenate([r[0] for r in rows])
+    agree = np.concatenate([r[1] for r in rows])
+    total = len(agree)
+    raw_rate = float(agree.mean()) if total else 1.0
+    # decisive positions: the top-2 margin exceeds what a bound-respecting
+    # perturbation could ever flip (top1 loses <= bound, runner-up gains
+    # <= bound). A flip HERE is a codec bug, not a near-tie.
+    decisive = margins > 2 * bound
+    dec_rate = (float(agree[decisive].mean()) if decisive.any() else 1.0)
+    per_prompt = [round(float(r[1].mean()), 4) for r in rows]
+    ok = dec_rate >= KVQ_MATCH_MIN and drift <= bound
+    logf(f"kv_quant parity (teacher-forced): decisive greedy match "
+         f"{dec_rate:.4f} over {int(decisive.sum())}/{total} decisive "
+         f"positions (min {KVQ_MATCH_MIN}; raw incl. near-ties "
+         f"{raw_rate:.4f}), logit drift {drift:.4f} (bound {bound:.4f}) "
+         f"-> {'OK' if ok else 'FAIL'}")
+    return {"pass": ok, "greedy_match_rate": round(dec_rate, 4),
+            "raw_match_rate": round(raw_rate, 4),
+            "decisive_positions": int(decisive.sum()),
+            "max_logit_drift": round(drift, 5),
+            "drift_bound": round(bound, 5), "n_tokens": total,
+            "per_prompt": per_prompt}
+
+
+def run_kv_quant_ab(model_cfg, base_kwargs=None, *, seconds=10.0,
+                    n_chips=1, touch=lambda: None, logf=None):
+    """kv_quant A/B evidence for extras["kv_quant"]: capacity at a fixed
+    HBM page-byte budget + an int8-KV churn pass.
+
+    Capacity phase: both modes get the SAME HBM byte budget (the bf16
+    geometry's page bytes x num_pages); int8 pages are ~half the bytes
+    (+ scale rows), so the int8 allocator holds ~1.9x the pages and the
+    measured concurrent-slot count — churn-shaped requests admitted via
+    a bare Scheduler until allocation fails — shows the capacity
+    multiplier directly (no device work; the allocator IS the resource).
+
+    Churn phase: the PR-5 churn machinery shape (staggered decode
+    budgets, replacement arrivals, mixed scheduler) on a kv_quant="int8"
+    engine — CPU validation proves the plumbing; the TPU ladder item
+    (BENCH_SELF_r06_kvq) gives the hardware verdict.
+    """
+    import time as _time
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import (
+        EngineRequest, SamplingParams, Scheduler,
+    )
+    from dynamo_tpu.ops.kv_quant import page_bytes
+
+    logf = logf or log
+    kw = dict(base_kwargs or PAGE_KWARGS)
+    import jax.numpy as jnp
+    itemsize = jnp.dtype(model_cfg.dtype).itemsize
+    pb_ref = page_bytes(model_cfg.num_layers, model_cfg.num_kv_heads,
+                        kw["page_size"], model_cfg.head_dim, itemsize,
+                        False)
+    pb_q = page_bytes(model_cfg.num_layers, model_cfg.num_kv_heads,
+                      kw["page_size"], model_cfg.head_dim, itemsize, True)
+    budget = kw["num_pages"] * pb_ref
+
+    def max_slots_at(num_pages):
+        """Churn-shaped admissions (isl 4x128, decode budget 64) into a
+        bare scheduler until a request cannot get pages."""
+        # alternating scheduler with unbounded prefill priority: every
+        # plan is a pure PrefillPlan (decode never runs), so the commit
+        # loop below only needs commit_prefill_row and no request ever
+        # finishes and releases pages mid-measurement
+        from dynamo_tpu.engine.scheduler import PrefillPlan
+        c = EngineConfig(**{**kw, "num_pages": num_pages,
+                            "max_slots": 4096, "mixed_token_budget": 0,
+                            "max_prefill_streak": 0})
+        s = Scheduler(c)
+        isl, count = 512, 0
+        pmod = min(1000, model_cfg.vocab_size - 2)
+        while count < 4096:
+            rid = f"cap-{count}"
+            s.add_request(EngineRequest(
+                rid, [(7 * count + 3 * j) % pmod + 1 for j in range(isl)],
+                SamplingParams(max_tokens=64, ignore_eos=True)))
+            # drive this request's prefill to completion so its pages are
+            # truly held (admission-time allocation covers isl+64); any
+            # non-prefill plan (decode-only progress) or MemoryError means
+            # the waiting request is page-blocked — capacity reached
+            done = False
+            while not done:
+                try:
+                    plan = s.schedule()
+                except MemoryError:
+                    plan = None
+                if plan is None or not isinstance(plan, PrefillPlan):
+                    break
+                for i in reversed(range(len(plan.seqs))):
+                    if plan.seqs[i] is None:
+                        continue
+                    tok = s.commit_prefill_row(
+                        plan, i, 9 if plan.is_last_chunk[i] else None)
+                    done = done or tok is not None
+            if not done:
+                break
+            count += 1
+        return count
+
+    slots_ref = max_slots_at(budget // pb_ref)
+    slots_q = max_slots_at(budget // pb_q)
+    capacity = {
+        "hbm_page_budget_bytes": budget,
+        "page_bytes_bf16": pb_ref, "page_bytes_int8": pb_q,
+        "page_bytes_ratio": round(pb_ref / pb_q, 3),
+        "slots_bf16": slots_ref, "slots_int8": slots_q,
+        "slot_ratio": round(slots_q / max(1, slots_ref), 3),
+    }
+    logf(f"kv_quant capacity at {budget >> 20} MiB page budget: "
+         f"{slots_ref} bf16 slots vs {slots_q} int8 slots "
+         f"({capacity['slot_ratio']}x); bytes/page {pb_ref} -> {pb_q} "
+         f"({capacity['page_bytes_ratio']}x)")
+    touch()
+
+    # churn pass on the int8 engine (PR-5 machinery shape)
+    eng = NativeEngine(model_cfg, EngineConfig(kv_quant="int8", **kw),
+                       seed=0)
+    touch()
+    slots = kw["max_slots"]
+    pmod = min(1000, model_cfg.vocab_size - 2)
+    prompt_len = 128
+    # churn ISL targets the 4x long-ISL shape but clamps so all slots'
+    # admission-time allocations (isl + the largest staggered budget)
+    # fit in ~80% of the page budget (tiny CPU validation configs are
+    # much smaller than the TPU geometry)
+    ps = kw["page_size"]
+    fit = (int(0.8 * kw["num_pages"]) // slots) * ps - 88
+    churn_isl = max(ps, min(4 * prompt_len, fit))
+    next_id = [0]
+
+    def add_fresh():
+        salt = 977 * (next_id[0] + 1)
+        eng.add_request(EngineRequest(
+            f"kvq-churn-{next_id[0]}",
+            [(salt + 3 * j) % pmod + 1 for j in range(churn_isl)],
+            SamplingParams(max_tokens=48 + (next_id[0] % 5) * 8,
+                           temperature=0.0, ignore_eos=True)))
+        next_id[0] += 1
+
+    for _ in range(slots):
+        add_fresh()
+    warm_finishes = 0
+    for _ in range(600):
+        for ev in eng.step():
+            if ev.finished:
+                add_fresh()
+                warm_finishes += 1
+        touch()
+        if warm_finishes >= slots:
+            break
+    t0 = _time.perf_counter()
+    tokens = 0
+    while _time.perf_counter() < t0 + seconds:
+        for ev in eng.step():
+            if ev.token is not None:
+                tokens += 1
+            if ev.finished:
+                add_fresh()
+        touch()
+    tok_s = tokens / (_time.perf_counter() - t0) / max(1, n_chips)
+    logf(f"kv_quant churn (int8 pages, mixed scheduler): "
+         f"{tok_s:.1f} tok/s/chip")
+    del eng
+    return {"capacity": capacity,
+            "churn_int8_tok_s": round(tok_s, 1)}
+
 
 def run_parity(model_cfg, engine_box=None, touch=lambda: None, logf=None):
     """Window-vs-single-step greedy token parity on the current backend.
@@ -842,6 +1119,20 @@ def worker():
         f"{churn_alt['tok_s']:.1f}) vs pure decode {pure:.1f}; "
         f"decode-side disagg gain bound "
         f"{pure / max(agg_tok_s, 1e-9):.2f}x")
+
+    if os.environ.get("BENCH_KVQ", "1") != "0" \
+            and time.time() - T0 < BUDGET_S - 180:
+        st.set_phase("kv_quant_ab")
+        log("phase: kv_quant A/B — capacity at fixed HBM page budget + "
+            "int8-KV churn pass (ROADMAP item 5 evidence)")
+        try:
+            st.result["extras"]["kv_quant"] = run_kv_quant_ab(
+                model_cfg, PAGE_KWARGS, seconds=10.0, n_chips=n_chips,
+                touch=st.touch, logf=log)
+        except Exception as e:  # evidence phase must not kill the capture
+            log(f"kv_quant A/B failed ({type(e).__name__}: {e})")
+            st.result["extras"]["kv_quant"] = {"failure": str(e)}
+        st.touch()
 
     if os.environ.get("BENCH_SPEC") == "oracle":
         st.set_phase("spec_ceiling")

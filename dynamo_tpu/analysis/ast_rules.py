@@ -32,6 +32,13 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       distinct shape mints a new compiled XLA program, so an admission-
       dependent dim recompiles the serving loop per arrival — without a
       `# dynalint: bucketed` annotation
+- R11 raw KV-cache leaf access (`cache["k"]` / `cache["v"]` / the scale
+      leaves) in model/ops/engine-step code without a
+      `# dynalint: kv-codec` annotation — with kv_quant the leaves hold
+      int8 bytes + scales, and code that indexes them directly (or
+      `.astype`s them to a float) silently treats quantized bytes as
+      values; every access must go through (or knowingly feed) the
+      ops/kv_quant.py codec
 """
 from __future__ import annotations
 
@@ -677,6 +684,68 @@ def r10_unbucketed_plan_dims(tree: ast.AST, lines: List[str],
                 "like the plan builders do, or annotate with "
                 "`# dynalint: bucketed` and say why the shape is "
                 "admission-stable"))
+    return out
+
+
+# -- R11: raw KV-cache leaf access outside the quant codec helpers ------------
+
+# Scope: model forward code, the ops layer, and the engine's jitted step
+# path — everywhere a cache leaf can reach arithmetic. With
+# ModelConfig.kv_quant the "k"/"v" leaves hold int8 bytes whose VALUES
+# only exist after the ops/kv_quant.py codec applies the scale rows; a
+# raw `cache["k"]` index (or `.astype` to a float dtype) that bypasses
+# the codec reads garbage that is bitwise-plausible and numerically
+# wrong — the worst kind of quantization bug. Codec-aware sites (reads
+# that hand leaves to a dequantizing consumer, whole-page moves that
+# keep the representation) carry `# dynalint: kv-codec` on the access
+# or the preceding two lines; ops/kv_quant.py itself IS the codec.
+_R11_SCOPE = ("models/", "ops/", "engine/engine")
+_R11_EXEMPT = ("ops/kv_quant",)
+_R11_KEYS = {"k", "v", "k_scale", "v_scale"}
+_R11_ANNOT_RE = re.compile(r"#\s*dynalint:\s*kv-codec")
+
+
+@rule("R11")
+def r11_raw_kv_cache_access(tree: ast.AST, lines: List[str],
+                            path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R11_SCOPE) \
+            or any(part in norm for part in _R11_EXEMPT):
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R11_ANNOT_RE.search(_line(lines, x))
+                   for x in (ln, ln - 1, ln - 2))
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Subscript):
+            continue
+        # match <...cache>["k"] etc.: a name or attribute whose last
+        # component is `cache` (cache, self.cache, eng.cache), indexed
+        # by one of the KV leaf keys
+        base = node.value
+        base_name = (base.id if isinstance(base, ast.Name)
+                     else base.attr if isinstance(base, ast.Attribute)
+                     else None)
+        if base_name != "cache":
+            continue
+        sl = node.slice
+        if not (isinstance(sl, ast.Constant) and sl.value in _R11_KEYS):
+            continue
+        if annotated(node.lineno):
+            continue
+        out.append(_finding(
+            "R11", path, lines, node,
+            f"raw KV-cache leaf access `{_unparse(node)}` outside the "
+            "kv_quant codec helpers — with kv_quant='int8' this leaf "
+            "holds quantized bytes (+scale rows elsewhere); indexing or "
+            "casting it directly treats int8 bytes as values",
+            "route the read/write through ops/kv_quant.py (quantize_"
+            "rows / dequantize_rows / gather_dequant) or the codec-"
+            "aware attention/write helpers, or annotate with "
+            "`# dynalint: kv-codec` and say how the site preserves or "
+            "decodes the representation"))
     return out
 
 

@@ -26,10 +26,23 @@ import jax
 
 from dynamo_tpu.runtime import faults
 from dynamo_tpu.runtime.integrity import (
-    STATS as INTEGRITY, IntegrityError, page_checksum,
+    STATS as INTEGRITY, XFER_STATS, IntegrityError, page_checksum,
 )
 
 log = logging.getLogger("dynamo_tpu.disagg.transfer")
+
+
+def _page_sums(k_np, v_np, ks_np, vs_np, count: int):
+    """Capture-time checksums, one per page, over the arrays AS STORED —
+    for kv_quant pages that is the int8 bytes plus the f32 scale rows,
+    so verify-on-fetch needs no dequantization and corruption in either
+    component is caught."""
+    if ks_np is None:
+        return [page_checksum(k_np[:, :, i], v_np[:, :, i])
+                for i in range(count)]
+    return [page_checksum(k_np[:, :, i], v_np[:, :, i],
+                          ks_np[:, :, i], vs_np[:, :, i])
+            for i in range(count)]
 
 
 class TransferBackend(abc.ABC):
@@ -37,8 +50,10 @@ class TransferBackend(abc.ABC):
 
     @abc.abstractmethod
     async def send_pages(self, engine_id: str, request_id: str, dst_page_ids,
-                         k_pages, v_pages) -> None:
-        """Inject pages (k/v: [L, Hkv, Nb, ps, hd] on the sender's mesh)
+                         k_pages, v_pages, k_scale=None,
+                         v_scale=None) -> None:
+        """Inject pages (k/v: [L, Hkv, Nb, ps, hd] on the sender's mesh;
+        kv_quant senders also pass the [L, Hkv, Nb, ps] scale stacks)
         into the target engine's cache at dst_page_ids.
 
         Raises if request_id is no longer pending on the target (the decode
@@ -65,7 +80,8 @@ class LocalTransferBackend(TransferBackend):
         self._receivers.pop(engine_id, None)
 
     async def send_pages(self, engine_id: str, request_id: str, dst_page_ids,
-                         k_pages, v_pages) -> None:
+                         k_pages, v_pages, k_scale=None,
+                         v_scale=None) -> None:
         worker = self._receivers.get(engine_id)
         if worker is None:
             raise KeyError(f"unknown decode engine {engine_id!r}")
@@ -77,14 +93,23 @@ class LocalTransferBackend(TransferBackend):
             # same capture-checksum/verify/bounded-re-fetch contract as
             # the TCP backend (zero cost when the site is disarmed —
             # the fast path below never leaves the device)
-            k_pages, v_pages = await self._verified_stage(
-                request_id, ids, k_pages, v_pages)
+            k_pages, v_pages, k_scale, v_scale = await self._verified_stage(
+                request_id, ids, k_pages, v_pages, k_scale, v_scale)
         # The cross-mesh move + relayout: place the pages with the decode
         # engine's cache sharding (ICI/DCN transfer; resharding handles
         # prefill-TP != decode-TP, the kv_rearrange equivalent).
         shd = worker.engine.cache_sharding
         k = jax.device_put(k_pages, shd)
         v = jax.device_put(v_pages, shd)
+        ks = vs = None
+        if k_scale is not None:
+            sshd = worker.engine.cache_scale_sharding
+            ks = jax.device_put(k_scale, sshd)
+            vs = jax.device_put(v_scale, sshd)
+        nbytes = k.nbytes + v.nbytes + (
+            ks.nbytes + vs.nbytes if ks is not None else 0)
+        XFER_STATS.bytes_sent += nbytes
+        XFER_STATS.pages_sent += len(ids)
 
         def inject(eng):
             # guard against decode-side timeout/release: the pages may have
@@ -93,18 +118,22 @@ class LocalTransferBackend(TransferBackend):
                 raise KeyError(
                     f"request {request_id!r} no longer pending on "
                     f"{engine_id!r}")
-            eng.inject_pages(ids, k, v)
+            eng.inject_pages(ids, k, v, ks, vs)
+            XFER_STATS.fetches += 1
+            XFER_STATS.bytes_fetched += nbytes
 
         await worker.submit(inject)
 
     @staticmethod
     async def _verified_stage(request_id: str, ids, k_pages, v_pages,
+                              k_scale=None, v_scale=None,
                               max_refetch: int = 2):
         """Chaos-mode staging hop: device -> host (checksums at capture)
         -> transfer failpoint -> verify -> host arrays for device_put.
         A mismatch re-fetches from the still-authoritative device copy;
         past the budget the transfer is abandoned (IntegrityError) and
-        the decode side re-prefills."""
+        the decode side re-prefills. kv_quant pages checksum and verify
+        in their stored representation (int8 + scales, no dequant)."""
         import asyncio
 
         import numpy as np
@@ -112,18 +141,22 @@ class LocalTransferBackend(TransferBackend):
             k_np, v_np = await asyncio.to_thread(
                 lambda: (np.asarray(jax.device_get(k_pages)),
                          np.asarray(jax.device_get(v_pages))))
-            sums = [page_checksum(k_np[:, :, i], v_np[:, :, i])
-                    for i in range(len(ids))]
+            ks_np = vs_np = None
+            if k_scale is not None:
+                ks_np, vs_np = await asyncio.to_thread(
+                    lambda: (np.asarray(jax.device_get(k_scale)),
+                             np.asarray(jax.device_get(v_scale))))
+            sums = _page_sums(k_np, v_np, ks_np, vs_np, len(ids))
             INTEGRITY.pages_hashed += len(ids)
             k_bytes = faults.REGISTRY.corrupt_bytes(
                 "remote_transfer.fetch_page", k_np.tobytes())
             k_np = np.frombuffer(k_bytes, k_np.dtype).reshape(k_np.shape)
-            bad = [ids[i] for i in range(len(ids))
-                   if page_checksum(k_np[:, :, i], v_np[:, :, i])
-                   != sums[i]]
+            bad = [ids[i] for i, s in
+                   enumerate(_page_sums(k_np, v_np, ks_np, vs_np, len(ids)))
+                   if s != sums[i]]
             if not bad:
                 INTEGRITY.pages_verified += len(ids)
-                return k_np, v_np
+                return k_np, v_np, ks_np, vs_np
             INTEGRITY.mismatches += len(bad)
             if attempt < max_refetch:
                 INTEGRITY.refetches += 1

@@ -487,9 +487,14 @@ class PrefillWorker:
                 seq = eng.scheduler.parked[rid]
                 return eng.extract_pages(seq.pages[start_page:])
             pages = await self.worker.submit(extract)
+            # kv_quant engines extract int8 pages + scale stacks; the
+            # transfer ships that representation verbatim (half the wire
+            # bytes of bf16; checksums cover the quantized bytes)
             await self.transfer.send_pages(
                 req.engine_id, rid, req.page_ids[start_page:],
-                pages["k"], pages["v"])
+                pages["k"], pages["v"],
+                k_scale=pages.get("k_scale"),
+                v_scale=pages.get("v_scale"))
             await self.worker.submit(lambda eng: eng.release_parked(rid))
             self.completed += 1
             await self._notify(req, PrefillCompletion(

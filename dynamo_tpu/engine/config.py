@@ -50,6 +50,14 @@ class ModelConfig:
     # `dtype`; "int8" = dense projections + lm_head stored int8 with
     # per-output-channel scales (halves weight HBM + decode weight reads)
     quant: str = ""
+    # KV-cache page quantization (ops/kv_quant.py): "" = pages in `dtype`
+    # (bit-identical to pre-knob behavior); "int8" = pages stored int8
+    # with per-row f32 scales, quantized at capture inside the jitted
+    # step and dequantized inside the paged read — the same
+    # representation flows through offload tiers, disagg transfer, and
+    # integrity checksums. Deployments usually set this through
+    # EngineConfig.kv_quant (mirroring the weight knob's --quant flag).
+    kv_quant: str = ""
     # MoE (Mixtral-style); num_experts == 0 means dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -209,6 +217,15 @@ class EngineConfig:
     # first on every pass, so it runs as soon as its resources free).
     # 0 = strict head-only (the old head-of-line-blocking behavior).
     prefill_skip_ahead: int = 4
+    # KV-cache page quantization knob, mirroring the weight `quant` knob
+    # (ModelConfig.quant): "" = pages in the model dtype; "int8" = int8
+    # pages + per-row f32 scales end-to-end (capture -> paged read ->
+    # offload tiers -> disagg transfer; ops/kv_quant.py). Set here (the
+    # deployment surface) it overrides ModelConfig.kv_quant at engine
+    # construction. Composes with pipeline_depth=2, mixed steps, tp/dp
+    # meshes, and fault injection; pp meshes reject it (the GPipe stage
+    # scan does not thread scale shards yet).
+    kv_quant: str = ""
     # COMPAT ALIAS (legacy alternating scheduler only, i.e.
     # mixed_token_budget=0): longest run of consecutive prefill steps
     # while decodes are active; after the streak one decode step runs,

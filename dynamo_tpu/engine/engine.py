@@ -67,6 +67,16 @@ class NativeEngine:
         seed: int = 0,
     ):
         self.mesh = mesh if mesh is not None else single_device_mesh()
+        # KV-cache quantization knob: the EngineConfig surface mirrors the
+        # weight `quant` knob and overrides ModelConfig.kv_quant (the
+        # model code reads cfg.kv_quant at trace time; ops/kv_quant.py)
+        from dynamo_tpu.ops.kv_quant import validate_mode as _kvq_validate
+        if engine_cfg.kv_quant:
+            _kvq_validate(engine_cfg.kv_quant)
+            model_cfg = dataclasses.replace(model_cfg,
+                                            kv_quant=engine_cfg.kv_quant)
+        _kvq_validate(model_cfg.kv_quant)
+        self.kv_quant = model_cfg.kv_quant
         # pipeline parallelism (mesh axis "pp", models/pp.py): layer-sharded
         # params/cache, microbatched GPipe schedule. The pp path uses the
         # gather attention everywhere (the Pallas kernel doesn't run under
@@ -76,6 +86,11 @@ class NativeEngine:
         # per-token dispatch.
         self.pp = self.mesh.shape.get("pp", 1)
         if self.pp > 1:
+            if self.kv_quant:
+                raise ValueError(
+                    "kv_quant does not compose with pp meshes yet (the "
+                    "GPipe stage scan does not thread scale shards); use "
+                    "tp/dp meshes or disable kv_quant")
             if model_cfg.is_moe:
                 raise ValueError("pp requires a dense model; shard MoE "
                                  "configs over the ep axis instead")
@@ -134,11 +149,18 @@ class NativeEngine:
         if engine_cfg.host_pages > 0:
             page_shape = (model_cfg.num_layers, model_cfg.num_kv_heads,
                           engine_cfg.page_size, model_cfg.head_dim)
-            np_dtype = jnp.empty((), model_cfg.dtype).dtype
+            # tier slabs store the DEVICE representation verbatim: int8
+            # pages + f32 scale rows under kv_quant (spill/promote never
+            # dequantize; checksums cover the quantized bytes)
+            np_dtype = (np.dtype(np.int8) if self.kv_quant
+                        else jnp.empty((), model_cfg.dtype).dtype)
             self.host_pool = HostKvPool(engine_cfg.host_pages, page_shape,
                                         np_dtype,
                                         disk_pages=engine_cfg.disk_pages,
-                                        disk_dir=engine_cfg.disk_dir)
+                                        disk_dir=engine_cfg.disk_dir,
+                                        scale_shape=(page_shape[:-1]
+                                                     if self.kv_quant
+                                                     else None))
         self.scheduler = Scheduler(engine_cfg, host_pool=self.host_pool)
         self._pending_offloads: list = []
         self._copy_stream = None
@@ -221,16 +243,26 @@ class NativeEngine:
             is_leaf=lambda x: isinstance(x, P),
         )
         if params is None:
+            # random init runs UNSHARDED, then device_puts onto the mesh:
+            # with jax_threefry_partitionable=False (this jax build's
+            # default) the RNG bit stream depends on how jit shards the
+            # draw, so init-with-out_shardings produced DIFFERENT weights
+            # on a tp-sharded mesh than on one device — every mesh-vs-
+            # oracle parity test compares engines seeded identically, so
+            # init values must be mesh-invariant. device_put preserves
+            # values exactly; the transient single-device full tree is
+            # fine at random-init scale (checkpoint loads take the
+            # params=... path and never hit this).
             if model_cfg.quant == "int8":
                 def init_q(key):
                     return quantize_params(
                         llama.init_params(key, model_cfg), model_cfg)
-                init = jax.jit(init_q, out_shardings=shardings)
+                init = jax.jit(init_q)
             else:
                 init = jax.jit(
-                    functools.partial(llama.init_params, cfg=model_cfg),
-                    out_shardings=shardings)
-            params = init(jax.random.PRNGKey(seed))
+                    functools.partial(llama.init_params, cfg=model_cfg))
+            params = jax.device_put(init(jax.random.PRNGKey(seed)),
+                                    shardings)
         else:
             if model_cfg.quant == "int8":
                 from dynamo_tpu.ops.quant import is_quantized
@@ -244,12 +276,11 @@ class NativeEngine:
             params = jax.device_put(params, shardings)
         self.params = params
 
-        cache_shd = self.cache_sharding
         init_cache = jax.jit(
             functools.partial(
                 llama.init_cache, model_cfg,
                 num_pages=engine_cfg.num_pages, page_size=engine_cfg.page_size),
-            out_shardings={"k": cache_shd, "v": cache_shd})
+            out_shardings=self.cache_shardings)
         self.cache = init_cache()
 
         # sequence-parallel prefill (ring attention over the "sp" axis):
@@ -419,6 +450,22 @@ class NativeEngine:
             from dynamo_tpu.models.pp import pp_cache_sharding
             return NamedSharding(self.mesh, pp_cache_sharding())
         return NamedSharding(self.mesh, llama.cache_sharding(self.model_cfg))
+
+    @property
+    def cache_scale_sharding(self) -> NamedSharding:
+        """Sharding for KV scale page stacks (kv_quant engines only)."""
+        return NamedSharding(self.mesh,
+                             llama.cache_scale_sharding(self.model_cfg))
+
+    @property
+    def cache_shardings(self):
+        """Per-leaf NamedShardings matching the cache dict layout."""
+        if self.pp > 1:
+            from dynamo_tpu.models.pp import pp_cache_sharding
+            shd = NamedSharding(self.mesh, pp_cache_sharding())
+            return {"k": shd, "v": shd}
+        return {key: NamedSharding(self.mesh, spec) for key, spec in
+                llama.cache_shardings(self.model_cfg).items()}
 
     # -- public API ----------------------------------------------------------
 
@@ -1380,29 +1427,37 @@ class NativeEngine:
         for start in range(0, len(pending), max_b):
             chunk = pending[start:start + max_b]
             ids = [pid for pid, _ in chunk]
-            ks, vs = [], []
-            for _, h in chunk:
-                k, v = self.host_pool.get(h)
-                ks.append(k)
-                vs.append(v)
+            got = [self.host_pool.get(h) for _, h in chunk]
             nb = next_bucket(len(ids), self.scheduler.page_buckets)
-            # [L, Hkv, Nb, ps, hd]; unused tail pages stay zero + dropped
-            k_pages = np.zeros(
-                (ks[0].shape[0], ks[0].shape[1], nb) + ks[0].shape[2:],
-                ks[0].dtype)
-            v_pages = np.zeros_like(k_pages)
-            for i, (k, v) in enumerate(zip(ks, vs)):
-                k_pages[:, :, i] = k
-                v_pages[:, :, i] = v
+            # [L, Hkv, Nb, ps(, hd)] per leaf; unused tail pages stay
+            # zero + dropped. kv_quant tiers return 4 leaves (int8 pages
+            # + f32 scale rows) — stacked and injected as-is, never
+            # dequantized on the onboard path.
+            n_leaves = len(got[0])
+            stacks = []
+            for leaf in range(n_leaves):
+                first = got[0][leaf]
+                arr = np.zeros(first.shape[:2] + (nb,) + first.shape[2:],
+                               first.dtype)
+                for i, page in enumerate(got):
+                    arr[:, :, i] = page[leaf]
+                stacks.append(arr)
             # unpin only AFTER copying out of the slab views: put() (on the
             # CopyStream thread) never evicts pinned slots, so the views
             # above were stable until here
             for _, h in chunk:
                 self.host_pool.unpin(h)
             shd = self.cache_sharding
-            self.inject_pages(
-                ids, jax.device_put(jnp.asarray(k_pages), shd),
-                jax.device_put(jnp.asarray(v_pages), shd))
+            k_dev = jax.device_put(jnp.asarray(stacks[0]), shd)
+            v_dev = jax.device_put(jnp.asarray(stacks[1]), shd)
+            if n_leaves == 4:
+                sshd = self.cache_scale_sharding
+                self.inject_pages(
+                    ids, k_dev, v_dev,
+                    jax.device_put(jnp.asarray(stacks[2]), sshd),
+                    jax.device_put(jnp.asarray(stacks[3]), sshd))
+            else:
+                self.inject_pages(ids, k_dev, v_dev)
             self.host_pool.stats.onboarded += len(ids)
 
     # -- disaggregation ------------------------------------------------------
@@ -1438,22 +1493,40 @@ class NativeEngine:
         out[:len(page_ids)] = page_ids
         return out
 
-    def extract_pages(self, page_ids) -> tuple:
-        """Gather whole KV pages -> ({k,v} [L, Hkv, Nb, ps, hd], on-device)."""
+    def extract_pages(self, page_ids) -> dict:
+        """Gather whole KV pages -> ({k,v[,k_scale,v_scale]}, on-device):
+        values [L, Hkv, Nb, ps, hd] plus scale stacks [L, Hkv, Nb, ps] on
+        kv_quant engines — the stored representation, never dequantized."""
         ids = jnp.asarray(self._bucket_ids(page_ids))
         ids = jnp.minimum(ids, self.cfg.num_pages - 1)  # clamp padding reads
         return self._extract_fn(self.cache, ids)
 
-    def inject_pages(self, page_ids, k_pages, v_pages) -> None:
+    def inject_pages(self, page_ids, k_pages, v_pages,
+                     k_scale=None, v_scale=None) -> None:
         """Scatter whole KV pages into this engine's cache (donated update).
 
         The caller is responsible for placing k/v on this engine's mesh with
         cache sharding (transfer.py does the cross-mesh device_put — the
         ICI/DCN reshard that replaces the reference's kv_rearrange kernel).
 
+        kv_quant engines require the matching scale stacks: pages travel
+        in the quantized representation end-to-end, and a peer that sends
+        bf16 pages into an int8 cache (or vice versa) is a deployment
+        error, named rather than silently cast.
+
         The id padding follows the SENDER's bucket (k_pages.shape[2]), not
         ours — the two engines may have different max_model_len and hence
         different page-count buckets; padding ids drop on scatter."""
+        if self.kv_quant and k_scale is None:
+            raise ValueError(
+                "this engine stores int8 KV pages (kv_quant="
+                f"{self.kv_quant!r}) but the sender shipped no scales; "
+                "both sides of a transfer must run the same kv_quant mode")
+        if not self.kv_quant and k_scale is not None:
+            raise ValueError(
+                "sender shipped quantized KV pages but this engine's "
+                "cache is unquantized; both sides of a transfer must run "
+                "the same kv_quant mode")
         # evicted-but-unsaved pages must reach the host slab before this
         # write can overwrite them (disagg injects land on evicted pages)
         if self._pending_offloads:
@@ -1464,8 +1537,11 @@ class NativeEngine:
                 f"{len(page_ids)} dst pages but only {nb} pages sent")
         ids = np.full((nb,), self.cfg.num_pages, np.int32)
         ids[:len(page_ids)] = page_ids
-        self.cache = self._inject_fn(self.cache, jnp.asarray(ids),
-                                     k_pages, v_pages)
+        pages = {"k": k_pages, "v": v_pages}
+        if k_scale is not None:
+            pages["k_scale"] = k_scale
+            pages["v_scale"] = v_scale
+        self.cache = self._inject_fn(self.cache, jnp.asarray(ids), pages)
 
     # -- introspection -------------------------------------------------------
 
@@ -1483,6 +1559,21 @@ class NativeEngine:
         m.decode_plan_uploads = self.decode_plan_uploads
         m.mixed_steps = self.mixed_steps
         m.decode_stall_steps = self.decode_stall_steps
+        # KV representation gauges (ops/kv_quant.py): bytes one page
+        # occupies in HBM (k+v+scales) and the quant mode's bit width
+        # (0 = unquantized); transfer volume comes from the process-
+        # global counters so prefill-side sends surface on the sender's
+        # own metrics (refreshed per metrics() call, like the PR-4
+        # robustness gauges)
+        from dynamo_tpu.ops.kv_quant import page_bytes
+        from dynamo_tpu.runtime.integrity import XFER_STATS
+        mc, ec = self.model_cfg, self.cfg
+        m.kv_page_bytes = page_bytes(
+            mc.num_layers, mc.num_kv_heads, ec.page_size, mc.head_dim,
+            jnp.dtype(mc.dtype).itemsize, bool(self.kv_quant))
+        m.kv_quant_bits = 8 if self.kv_quant == "int8" else 0
+        m.kv_transfer_bytes = XFER_STATS.bytes_sent
+        m.kv_transfer_fetches = XFER_STATS.fetches
         return m
 
     def moe_drop_rate(self) -> float:
@@ -1497,28 +1588,64 @@ class NativeEngine:
 
 
 def _extract_pages(cache, ids):
-    """Gather pages [L, Hkv, P, ps, hd] by ids [Nb] -> [L, Hkv, Nb, ps, hd]."""
-    return {"k": jnp.take(cache["k"], ids, axis=2),
-            "v": jnp.take(cache["v"], ids, axis=2)}
+    """Gather pages by ids [Nb] along the page axis (2) of EVERY cache
+    leaf — values [L, Hkv, P, ps, hd] and, on kv_quant engines, the
+    scale stacks [L, Hkv, P, ps] move with the same ids."""
+    # dynalint: kv-codec — whole-page moves keep the stored (possibly
+    # quantized) representation; no value decode happens here
+    return {key: jnp.take(arr, ids, axis=2) for key, arr in cache.items()}
 
 
-def _inject_pages(cache, ids, k_pages, v_pages):
-    """Scatter pages into the cache at ids; out-of-range ids are dropped."""
-    return {"k": cache["k"].at[:, :, ids].set(k_pages, mode="drop"),
-            "v": cache["v"].at[:, :, ids].set(v_pages, mode="drop")}
+def _inject_pages(cache, ids, pages):
+    """Scatter pages into the cache at ids; out-of-range ids are dropped.
+    `pages` carries the same leaf set as the cache (values + scales on
+    kv_quant engines)."""
+    # dynalint: kv-codec — whole-page moves of the stored representation
+    return {key: cache[key].at[:, :, ids].set(pages[key], mode="drop")
+            for key in cache}
 
 
 def _scatter_new_kv(cache, k_news, v_news, write_idx):
     """One in-place scatter of all layers' new kv rows (deferred write).
 
-    cache {k,v}: [L, Hkv, P, ps, hd]; k_news/v_news [L, S, Hkv, hd];
+    cache {k,v[,k_scale,v_scale]}: [L, Hkv, P, ps, hd] (+ [L, Hkv, P,
+    ps] scales); k_news/v_news [L, S, Hkv, hd] full-precision rows;
     write_idx [S] flat token slots (<0 = padding, dropped). Padding rows
     get distinct out-of-range indices so unique_indices stays truthful.
+    On kv_quant caches the rows quantize HERE — capture time, inside the
+    jitted step — and the int8 values + f32 scales scatter together.
     """
-    l, hkv, p, ps, hd = cache["k"].shape
+    l, hkv, p, ps, hd = cache["k"].shape  # dynalint: kv-codec (shape only)
     s = write_idx.shape[0]
     safe = jnp.where(write_idx >= 0, write_idx,
                      p * ps + jnp.arange(s, dtype=write_idx.dtype))
+    if "k_scale" in cache:
+        from dynamo_tpu.ops.kv_quant import quantize_rows
+        kq, ks = quantize_rows(k_news)        # [L, S, Hkv, hd] / [L, S, Hkv]
+        vq, vs = quantize_rows(v_news)
+        # dynalint: kv-codec — quantized write path
+        flat_k = cache["k"].reshape(l, hkv, p * ps, hd)
+        flat_v = cache["v"].reshape(l, hkv, p * ps, hd)
+        # dynalint: kv-codec — quantized scatter keeps values+scales paired
+        flat_ks = cache["k_scale"].reshape(l, hkv, p * ps)
+        flat_vs = cache["v_scale"].reshape(l, hkv, p * ps)
+        kn = kq.transpose(0, 2, 1, 3)
+        vn = vq.transpose(0, 2, 1, 3)
+        ksn = ks.transpose(0, 2, 1)
+        vsn = vs.transpose(0, 2, 1)
+        flat_k = flat_k.at[:, :, safe].set(kn, mode="drop",
+                                           unique_indices=True)
+        flat_v = flat_v.at[:, :, safe].set(vn, mode="drop",
+                                           unique_indices=True)
+        flat_ks = flat_ks.at[:, :, safe].set(ksn, mode="drop",
+                                             unique_indices=True)
+        flat_vs = flat_vs.at[:, :, safe].set(vsn, mode="drop",
+                                             unique_indices=True)
+        return {"k": flat_k.reshape(l, hkv, p, ps, hd),
+                "v": flat_v.reshape(l, hkv, p, ps, hd),
+                "k_scale": flat_ks.reshape(l, hkv, p, ps),
+                "v_scale": flat_vs.reshape(l, hkv, p, ps)}
+    # dynalint: kv-codec — unquantized write path
     flat_k = cache["k"].reshape(l, hkv, p * ps, hd)
     flat_v = cache["v"].reshape(l, hkv, p * ps, hd)
     kn = k_news.transpose(0, 2, 1, 3).astype(flat_k.dtype)
@@ -1579,7 +1706,8 @@ def _engine_decode_window(cfg: ModelConfig, eos_ids: tuple, kernel_mesh,
     else:
         eos_vec = None
 
-    l, hkv_n, n_pages, ps, hd = cache["k"].shape
+    l, hkv_n, n_pages, ps, hd = cache["k"].shape  # dynalint: kv-codec
+    kvq = bool(cfg.kv_quant)
     # the Pallas-kernel decode path streams pages from the global cache
     # itself — it keeps the original carry-the-cache window (per-step
     # scatter); the split-KV fast path applies to the XLA gather mode
@@ -1594,12 +1722,32 @@ def _engine_decode_window(cfg: ModelConfig, eos_ids: tuple, kernel_mesh,
             return g.reshape(l, hkv_n, s, base_pb, page_size, hd).reshape(
                 l, hkv_n, s, lb, hd)
 
-        kb = gather_base(cache["k"])
-        vb = gather_base(cache["v"])
+        def gather_base_scale(sc):
+            g = jnp.take(sc, base_table.reshape(-1), axis=2)
+            return g.reshape(l, hkv_n, s, base_pb, page_size).reshape(
+                l, hkv_n, s, lb)
+
+        if kvq:
+            # int8 cache: dequantize the per-window read-only base ONCE
+            # at gather (ops/kv_quant.py codec read); the in-window
+            # buffers below hold full-precision rows and never round-
+            # trip through int8 until the end-of-window writeback
+            from dynamo_tpu.ops.kv_quant import dequantize_rows
+            dt = jnp.dtype(cfg.dtype)
+            # dynalint: kv-codec — codec read site
+            kb = dequantize_rows(gather_base(cache["k"]),
+                                 gather_base_scale(cache["k_scale"]), dt)
+            # dynalint: kv-codec — codec read site
+            vb = dequantize_rows(gather_base(cache["v"]),
+                                 gather_base_scale(cache["v_scale"]), dt)
+        else:
+            # dynalint: kv-codec — unquantized base gather
+            kb = gather_base(cache["k"])
+            vb = gather_base(cache["v"])
         # valid kv at window start; fixed across the window (the window
         # buffer covers everything generated after it)
         base_len = jnp.clip(positions, 0, max_pos + 1)
-        kw0 = jnp.zeros((l, hkv_n, s, n_steps, hd), cache["k"].dtype)
+        kw0 = jnp.zeros((l, hkv_n, s, n_steps, hd), kb.dtype)
         vw0 = jnp.zeros_like(kw0)
 
     def global_write_idx(pos, writable):
@@ -1754,6 +1902,14 @@ def _engine_step(cfg: ModelConfig, eos_ids: tuple, sp_mesh, kernel_mesh,
                 "configs to tp/dp meshes)")
         logits, cache = pp_forward(params, cfg, tokens, cache, meta,
                                    pp_mesh)
+        # replicate before the sampling tail: pp_forward returns logits
+        # vocab-sharded over "tp", and with jax_threefry_partitionable
+        # =False (this build's default) a categorical draw partitioned
+        # over the vocab produces DIFFERENT bits than the single-mesh
+        # oracle's replicated draw — sampled streams must be mesh-
+        # invariant at a fixed seed (tests/test_pp.py sampled oracle)
+        logits = jax.lax.with_sharding_constraint(
+            logits, NamedSharding(pp_mesh, P(None, None, None)))
         aux = {}
     else:
         logits, cache, aux = llama.forward(

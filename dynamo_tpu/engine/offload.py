@@ -57,7 +57,8 @@ class DiskKvPool:
     """
 
     def __init__(self, capacity: int, page_shape: Tuple[int, ...],
-                 dtype: np.dtype, directory: str):
+                 dtype: np.dtype, directory: str,
+                 scale_shape: Optional[Tuple[int, ...]] = None):
         import os
         os.makedirs(directory, exist_ok=True)
         self.capacity = capacity
@@ -66,6 +67,19 @@ class DiskKvPool:
                                 dtype, "w+", shape=shape)
         self.v_slab = np.memmap(os.path.join(directory, "kv_disk_v.bin"),
                                 dtype, "w+", shape=shape)
+        # kv_quant engines spill the QUANTIZED representation: int8 value
+        # slabs above plus f32 per-row scale slabs here — pages are never
+        # dequantized to cross a tier, and the traveling checksum covers
+        # values AND scales
+        self.ks_slab = self.vs_slab = None
+        if scale_shape is not None:
+            sshape = (capacity,) + tuple(scale_shape)
+            self.ks_slab = np.memmap(
+                os.path.join(directory, "kv_disk_ks.bin"), np.float32,
+                "w+", shape=sshape)
+            self.vs_slab = np.memmap(
+                os.path.join(directory, "kv_disk_vs.bin"), np.float32,
+                "w+", shape=sshape)
         self._by_hash: Dict[int, int] = {}
         self._hash_at: List[Optional[int]] = [None] * capacity
         self._free: List[int] = list(range(capacity - 1, -1, -1))
@@ -78,7 +92,7 @@ class DiskKvPool:
         return seq_hash in self._by_hash
 
     def put(self, seq_hash: int, k_page: np.ndarray, v_page: np.ndarray,
-            sum_: Optional[int] = None) -> bool:
+            sum_: Optional[int] = None, k_scale=None, v_scale=None) -> bool:
         """Store (LRU-evicting); returns True when an existing entry was
         evicted to make room. `sum_` is the page's capture-time checksum
         (computed fresh for direct callers without one)."""
@@ -88,7 +102,8 @@ class DiskKvPool:
             self._lru[slot] = None
             return False
         if sum_ is None:
-            sum_ = page_checksum(k_page, v_page)
+            sum_ = (page_checksum(k_page, v_page) if k_scale is None else
+                    page_checksum(k_page, v_page, k_scale, v_scale))
             INTEGRITY.pages_hashed += 1
         evicted = False
         if self._free:
@@ -100,6 +115,9 @@ class DiskKvPool:
             evicted = True
         self.k_slab[slot] = k_page
         self.v_slab[slot] = v_page
+        if self.ks_slab is not None:
+            self.ks_slab[slot] = k_scale
+            self.vs_slab[slot] = v_scale
         self._sum_at[slot] = sum_
         if faults.REGISTRY.enabled:   # at-rest rot in the disk tier
             faults.REGISTRY.corrupt_array("offload.write_tier",
@@ -109,10 +127,10 @@ class DiskKvPool:
         self._lru[slot] = None
         return evicted
 
-    def take(self, seq_hash: int
-             ) -> Optional[Tuple[np.ndarray, np.ndarray, int]]:
+    def take(self, seq_hash: int) -> Optional[Tuple]:
         """Read AND remove (promote-to-DRAM semantics): returns verified
-        copies plus the traveling checksum, or None on a miss OR an
+        copies plus the traveling checksum — (k, v, sum_) or, with scale
+        slabs, (k, v, k_scale, v_scale, sum_) — or None on a miss OR an
         integrity mismatch (the rotten entry is quarantined — already
         removed — and the page will be recomputed)."""
         slot = self._by_hash.pop(seq_hash, None)
@@ -123,18 +141,22 @@ class DiskKvPool:
         self._free.append(slot)
         k = np.array(self.k_slab[slot])
         v = np.array(self.v_slab[slot])
+        scales = ()
+        if self.ks_slab is not None:
+            scales = (np.array(self.ks_slab[slot]),
+                      np.array(self.vs_slab[slot]))
         if faults.REGISTRY.enabled:   # rot surfacing on the read path
             faults.REGISTRY.corrupt_array("offload.read_tier", k)
         sum_ = self._sum_at[slot]
         self._sum_at[slot] = None
-        if sum_ is not None and page_checksum(k, v) != sum_:
+        if sum_ is not None and page_checksum(k, v, *scales) != sum_:
             INTEGRITY.mismatches += 1
             INTEGRITY.quarantined += 1
             log.warning("disk kv page %x failed integrity check; "
                         "quarantined (will recompute)", seq_hash)
             return None
         INTEGRITY.pages_verified += 1
-        return k, v, sum_
+        return (k, v) + scales + (sum_,)
 
 
 class HostKvPool:
@@ -149,10 +171,21 @@ class HostKvPool:
 
     def __init__(self, capacity: int, page_shape: Tuple[int, ...],
                  dtype: np.dtype, disk_pages: int = 0,
-                 disk_dir: Optional[str] = None):
+                 disk_dir: Optional[str] = None,
+                 scale_shape: Optional[Tuple[int, ...]] = None):
         self.capacity = capacity
         self.k_slab = np.zeros((capacity,) + tuple(page_shape), dtype)
         self.v_slab = np.zeros((capacity,) + tuple(page_shape), dtype)
+        # kv_quant engines: the slabs above hold int8 values and these
+        # hold the f32 per-row scales — the tier stores the device
+        # representation verbatim (half the DRAM per page of bf16), and
+        # the capture checksum covers values AND scales
+        self.ks_slab = self.vs_slab = None
+        if scale_shape is not None:
+            self.ks_slab = np.zeros((capacity,) + tuple(scale_shape),
+                                    np.float32)
+            self.vs_slab = np.zeros((capacity,) + tuple(scale_shape),
+                                    np.float32)
         self._by_hash: Dict[int, int] = {}     # seq_hash -> slot
         self._hash_at: List[Optional[int]] = [None] * capacity
         self._free: List[int] = list(range(capacity - 1, -1, -1))
@@ -171,7 +204,8 @@ class HostKvPool:
             import tempfile
             self.disk = DiskKvPool(
                 disk_pages, page_shape, dtype,
-                disk_dir or tempfile.mkdtemp(prefix="dynamo_kv_disk_"))
+                disk_dir or tempfile.mkdtemp(prefix="dynamo_kv_disk_"),
+                scale_shape=scale_shape)
         # puts arrive from the CopyStream drain thread while the engine
         # thread matches prefixes / onboards — one lock guards the maps AND
         # slab writes (get() returns slab views: callers must hold a pin
@@ -206,6 +240,13 @@ class HostKvPool:
             self._pins[seq_hash] = self._pins.get(seq_hash, 0) + 1
             return True
 
+    def _slot_arrays(self, slot: int) -> Tuple:
+        """Lock held: the slot's stored arrays in checksum order."""
+        if self.ks_slab is None:
+            return self.k_slab[slot], self.v_slab[slot]
+        return (self.k_slab[slot], self.v_slab[slot],
+                self.ks_slab[slot], self.vs_slab[slot])
+
     def _verify(self, slot: int) -> bool:
         """Lock held: fire the read-tier failpoint and check the slot's
         bytes against its capture-time checksum."""
@@ -215,7 +256,7 @@ class HostKvPool:
         sum_ = self._sum_at[slot]
         if sum_ is None:
             return True
-        if page_checksum(self.k_slab[slot], self.v_slab[slot]) != sum_:
+        if page_checksum(*self._slot_arrays(slot)) != sum_:
             INTEGRITY.mismatches += 1
             return False
         INTEGRITY.pages_verified += 1
@@ -249,16 +290,17 @@ class HostKvPool:
         got = self.disk.take(seq_hash)
         if got is None:
             return False
-        k, v, sum_ = got
-        if not self._insert(seq_hash, k, v, sum_):
+        arrays, sum_ = got[:-1], got[-1]
+        if not self._insert(seq_hash, *arrays, sum_=sum_):
             # DRAM fully pinned: return the page to disk, don't lose it
-            self.disk.put(seq_hash, k, v, sum_)
+            self.disk.put(seq_hash, arrays[0], arrays[1], sum_,
+                          *arrays[2:])
             return False
         self.stats.disk_hits += 1
         return True
 
-    def _insert(self, seq_hash: int, k_page, v_page,
-                sum_: Optional[int]) -> bool:
+    def _insert(self, seq_hash: int, k_page, v_page, k_scale=None,
+                v_scale=None, *, sum_: Optional[int]) -> bool:
         """Lock held: place a page in the DRAM slab, spilling the LRU
         victim down to the disk tier when one exists. `sum_` is the
         capture-time checksum traveling with the page."""
@@ -284,15 +326,21 @@ class HostKvPool:
                     # spill down instead of dropping (multi-tier ladder,
                     # reference kv/storage.rs tier roles); the DRAM slot's
                     # checksum travels down with the page, so corruption
-                    # in this tier cannot be laundered by the spill
+                    # in this tier cannot be laundered by the spill —
+                    # scale rows spill alongside their int8 values
+                    old_scales = (() if self.ks_slab is None else
+                                  (self.ks_slab[slot], self.vs_slab[slot]))
                     if self.disk.put(old, self.k_slab[slot],
                                      self.v_slab[slot],
-                                     self._sum_at[slot]):
+                                     self._sum_at[slot], *old_scales):
                         self.stats.disk_evicted += 1
                     self.stats.disk_offloaded += 1
             self.stats.evicted += 1
         self.k_slab[slot] = k_page
         self.v_slab[slot] = v_page
+        if self.ks_slab is not None:
+            self.ks_slab[slot] = k_scale
+            self.vs_slab[slot] = v_scale
         self._sum_at[slot] = sum_
         if faults.REGISTRY.enabled:   # at-rest rot in the DRAM tier
             faults.REGISTRY.corrupt_array("offload.write_tier",
@@ -302,25 +350,29 @@ class HostKvPool:
         self._lru[slot] = None
         return True
 
-    def put(self, seq_hash: int, k_page: np.ndarray, v_page: np.ndarray
-            ) -> None:
-        # checksum at CAPTURE: k/v here are the authoritative copy just
-        # pulled off the device (CopyStream); everything downstream —
-        # slab residency, disk spills, promotions — verifies against it
+    def put(self, seq_hash: int, k_page: np.ndarray, v_page: np.ndarray,
+            k_scale: Optional[np.ndarray] = None,
+            v_scale: Optional[np.ndarray] = None) -> None:
+        # checksum at CAPTURE: k/v (+ scale rows on kv_quant engines)
+        # are the authoritative copy just pulled off the device
+        # (CopyStream); everything downstream — slab residency, disk
+        # spills, promotions — verifies against it
         with self._mu:
             if seq_hash in self._by_hash:   # duplicate: refresh LRU only,
                 self._touch(self._by_hash[seq_hash])  # don't count as a
                 return                                # new offload
-            sum_ = page_checksum(k_page, v_page)
+            scales = () if k_scale is None else (k_scale, v_scale)
+            sum_ = page_checksum(k_page, v_page, *scales)
             INTEGRITY.pages_hashed += 1
-            if self._insert(seq_hash, k_page, v_page, sum_):
+            if self._insert(seq_hash, k_page, v_page, *scales, sum_=sum_):
                 self.stats.offloaded += 1
 
-    def get(self, seq_hash: int
-            ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    def get(self, seq_hash: int) -> Optional[Tuple]:
         """Pinned entries were verified at pin() and their slots are
         stable (put never evicts pinned slots), so they return directly;
-        an unpinned get re-verifies and quarantines on mismatch."""
+        an unpinned get re-verifies and quarantines on mismatch. Returns
+        (k, v) slab views, or (k, v, k_scale, v_scale) on kv_quant
+        pools."""
         with self._mu:
             slot = self._by_hash.get(seq_hash)
             if slot is None:
@@ -331,7 +383,7 @@ class HostKvPool:
                 self._quarantine(seq_hash, slot)
                 return None
             self._touch(slot)
-            return self.k_slab[slot], self.v_slab[slot]
+            return self._slot_arrays(slot)
 
     def _touch(self, slot: int) -> None:
         self._lru.pop(slot, None)
@@ -369,7 +421,8 @@ class CopyStream:
         self._thread.start()
 
     def submit(self, device_pages, seq_hashes: List[int]) -> None:
-        """device_pages: {"k","v"} device arrays [L, Hkv, N, ps, hd] already
+        """device_pages: {"k","v"[,"k_scale","v_scale"]} device arrays
+        ([L, Hkv, N, ps, hd] values; [L, Hkv, N, ps] scales) already
         dispatched; seq_hashes: chained hash per page along dim 2."""
         hashes = list(seq_hashes)
         with self._cv:
@@ -414,8 +467,15 @@ class CopyStream:
             try:
                 k = np.asarray(jax.device_get(pages["k"]))
                 v = np.asarray(jax.device_get(pages["v"]))
-                for i, h in enumerate(hashes):
-                    self._pool.put(h, k[:, :, i], v[:, :, i])
+                if "k_scale" in pages:   # kv_quant: scales ride along
+                    ks = np.asarray(jax.device_get(pages["k_scale"]))
+                    vs = np.asarray(jax.device_get(pages["v_scale"]))
+                    for i, h in enumerate(hashes):
+                        self._pool.put(h, k[:, :, i], v[:, :, i],
+                                       ks[:, :, i], vs[:, :, i])
+                else:
+                    for i, h in enumerate(hashes):
+                        self._pool.put(h, k[:, :, i], v[:, :, i])
             except Exception:  # noqa: BLE001 — a failed offload only costs
                 pass           # a future recompute; never kill the drain
             finally:

@@ -211,6 +211,15 @@ class EngineMetrics:
     # otherwise)
     mixed_steps: int = 0
     decode_stall_steps: int = 0
+    # KV representation (ops/kv_quant.py): bytes one page occupies in
+    # HBM (k+v+scales), quant bit width (0 = unquantized pages), and
+    # cumulative transfer volume in the WIRE representation — quantized
+    # bytes on kv_quant engines, so bytes/fetch shows the ~2x disagg
+    # handoff saving directly
+    kv_page_bytes: int = 0
+    kv_quant_bits: int = 0
+    kv_transfer_bytes: int = 0
+    kv_transfer_fetches: int = 0
 
 
 def window_ladder(decode_steps: int) -> List[int]:

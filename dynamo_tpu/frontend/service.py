@@ -115,6 +115,15 @@ class HttpService:
                           f"graceful drain: {name}")
             for name in ("drains_started", "drains_completed",
                          "drained_streams", "cancelled_streams")}
+        # KV transfer volume in the wire representation (quantized bytes
+        # on kv_quant engines — runtime/integrity.py XFER_STATS), same
+        # render-time refresh as the robustness gauges above
+        self._kv_xfer = {
+            name: m.gauge(f"llm_kv_transfer_{name}",
+                          f"kv transfer: cumulative {name} "
+                          "(wire representation)")
+            for name in ("bytes_sent", "pages_sent", "fetches",
+                         "bytes_fetched")}
         s = self.server
         s.route("POST", "/v1/chat/completions", self._chat)
         s.route("POST", "/v1/completions", self._completions)
@@ -167,6 +176,10 @@ class HttpService:
         for name, value in DRAIN_STATS.snapshot().items():
             if name in self._drain:
                 self._drain[name].set(value=value)
+        from dynamo_tpu.runtime.integrity import XFER_STATS
+        for name, value in XFER_STATS.snapshot().items():
+            if name in self._kv_xfer:
+                self._kv_xfer[name].set(value=value)
 
     async def _chat(self, req: Request):
         try:

@@ -48,6 +48,13 @@ class WorkerMetrics:
     # because the step carried no decode rows — ~0 with mixed steps on)
     mixed_steps: int = 0
     decode_stall_steps: int = 0
+    # KV representation (ops/kv_quant.py): HBM bytes per page, quant bit
+    # width (0 = unquantized), cumulative wire-representation transfer
+    # volume (quantized bytes on kv_quant engines)
+    kv_page_bytes: int = 0
+    kv_quant_bits: int = 0
+    kv_transfer_bytes: int = 0
+    kv_transfer_fetches: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "WorkerMetrics":

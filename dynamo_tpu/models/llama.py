@@ -28,8 +28,9 @@ from jax.sharding import PartitionSpec as P
 from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.ops.attention import (
     _softcap, decode_attention_deferred, decode_attention_split,
-    paged_attention, write_kv_pages,
+    paged_attention, write_kv_pages, write_kv_pages_quant,
 )
+from dynamo_tpu.ops.kv_quant import validate_mode as _validate_kv_quant
 from dynamo_tpu.ops.moe import moe_dispatch_mlp, moe_dispatch_mlp_sharded
 from dynamo_tpu.ops.quant import wmat
 from dynamo_tpu.ops.paged_attention import (
@@ -216,9 +217,33 @@ def cache_sharding(cfg: ModelConfig) -> P:
     return P(None, "tp", None, None, None)
 
 
+def cache_scale_sharding(cfg: ModelConfig) -> P:
+    """KV scale arrays [L, Hkv, P, ps]: kv heads over tp, like the values."""
+    del cfg
+    return P(None, "tp", None, None)
+
+
+def cache_shardings(cfg: ModelConfig) -> Dict[str, P]:
+    """Per-leaf PartitionSpecs matching init_cache's dict layout."""
+    out = {"k": cache_sharding(cfg), "v": cache_sharding(cfg)}
+    if _validate_kv_quant(cfg.kv_quant):
+        out["k_scale"] = cache_scale_sharding(cfg)
+        out["v_scale"] = cache_scale_sharding(cfg)
+    return out
+
+
 def init_cache(cfg: ModelConfig, num_pages: int, page_size: int) -> Dict[str, jax.Array]:
     dt = _dtype(cfg)
     shape = (cfg.num_layers, cfg.num_kv_heads, num_pages, page_size, cfg.head_dim)
+    if _validate_kv_quant(cfg.kv_quant):
+        # int8 pages + per-row f32 scales (ops/kv_quant.py): the scale
+        # array shares the page axis (2) with the values, so every
+        # page-indexed move (extract/inject/offload/transfer) carries
+        # the scales with the same ids
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+                "v_scale": jnp.zeros(shape[:-1], jnp.float32)}
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
@@ -331,6 +356,7 @@ def decode_forward(
     b = tokens.shape[0]
     h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     kernel_mode = _decode_kernel_mode(cfg)
+    kvq = bool(_validate_kv_quant(cfg.kv_quant))
     lw = cfg.layer_windows()
     layer_wnd = None if lw is None else jnp.asarray(lw, jnp.int32)
     # ids validated at admission (_validate_prompt); decode feeds only
@@ -369,16 +395,38 @@ def decode_forward(
                 q_scale=cfg.query_scale)
         elif kernel_mode is not None:
             interp = kernel_mode == "interpret"
+            # int8 caches hand the kernels the raw pages plus the scale
+            # stacks; dequantization folds into the in-kernel score/prob
+            # rows (ops/paged_attention.py)  # dynalint: kv-codec
+            scales = ((cache["k_scale"], cache["v_scale"]) if kvq
+                      else (None, None))
             if mesh is not None and mesh.size > 1:
                 acc, m, l = decode_paged_attention_prefix_sharded(
+                    # dynalint: kv-codec — kernels dequantize in-read
                     q[:, 0], cache["k"], cache["v"], lid[None], page_table,
-                    prefix_lens, mesh, interpret=interp)
+                    prefix_lens, mesh, interpret=interp,
+                    k_scale=scales[0], v_scale=scales[1])
             else:
                 acc, m, l = decode_paged_attention_prefix(
+                    # dynalint: kv-codec — kernels dequantize in-read
                     q[:, 0], cache["k"], cache["v"], lid[None], page_table,
-                    prefix_lens, interpret=interp)
+                    prefix_lens, interpret=interp,
+                    k_scale=scales[0], v_scale=scales[1])
             attn = combine_self_attention(q[:, 0], k_new, v_new, acc, m, l)
+        elif kvq:
+            # gather fallback, int8 cache: per-layer slices + scales;
+            # dequantization happens right after the page gather
+            # (ops/attention.py)  # dynalint: kv-codec
+            attn = decode_attention_deferred(
+                # dynalint: kv-codec — consumer dequantizes at gather
+                q[:, 0], cache["k"][lid], cache["v"][lid], k_new, v_new,
+                page_table, prefix_lens, softcap=cfg.attn_softcap,
+                window=wnd, q_scale=cfg.query_scale,
+                # dynalint: kv-codec — scale rows feed the dequant
+                k_scale=cache["k_scale"][lid],
+                v_scale=cache["v_scale"][lid])
         else:
+            # dynalint: kv-codec — unquantized per-layer value slices
             attn = decode_attention_deferred(
                 q[:, 0], cache["k"][lid], cache["v"][lid], k_new, v_new,
                 page_table, prefix_lens, softcap=cfg.attn_softcap,
@@ -461,6 +509,7 @@ def forward(
     """
     b, tq = tokens.shape
     h, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    kvq = bool(_validate_kv_quant(cfg.kv_quant))
 
     if input_embeds is None:
         # admission validated the ids  # dynalint: disable-next-line=R1
@@ -506,10 +555,14 @@ def forward(
 
     def layer_step(x, layer):
         if layer_wnd is not None:
-            lp, kc, vc, wnd = layer
+            layer, wnd = layer[:-1], layer[-1]
+        else:
+            wnd = None
+        if kvq:
+            lp, kc, vc, ksc, vsc = layer
         else:
             lp, kc, vc = layer
-            wnd = None
+            ksc = vsc = None
         xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         q = jnp.einsum("btd,de->bte", xn, wmat(lp["wq"], xn.dtype))
         k = jnp.einsum("btd,de->bte", xn, wmat(lp["wk"], xn.dtype))
@@ -521,25 +574,33 @@ def forward(
         v = v.reshape(b, tq, hkv, hd)
         q = apply_rope(q, meta.positions, cfg.rope_theta)
         k = apply_rope(k, meta.positions, cfg.rope_theta)
-        kc, vc = write_kv_pages(kc, vc, k, v, meta.write_idx)
+        if kvq:
+            # capture-time quantization: rows quantize (per-row scale)
+            # inside this jitted step and scatter as int8+scale — no
+            # extra host sync, no dequantized shadow copy
+            kc, vc, ksc, vsc = write_kv_pages_quant(
+                kc, vc, ksc, vsc, k, v, meta.write_idx)
+        else:
+            kc, vc = write_kv_pages(kc, vc, k, v, meta.write_idx)
         if use_kernel:
             # decode hot path: stream pages HBM->VMEM, no materialized gather
             interp = _decode_kernel_mode(cfg) == "interpret"
             if mesh is not None and mesh.size > 1:
                 attn = decode_paged_attention_sharded(
                     q[:, 0], kc, vc, meta.page_table, meta.kv_lens, mesh,
-                    interpret=interp)[:, None]
+                    interpret=interp, k_scale=ksc, v_scale=vsc)[:, None]
             else:
                 attn = decode_paged_attention(
                     q[:, 0], kc, vc, meta.page_table, meta.kv_lens,
-                    interpret=interp)[:, None]
+                    interpret=interp, k_scale=ksc, v_scale=vsc)[:, None]
         elif use_ring:
             attn = ring_attention(q, k, v, meta.positions, kv_positions,
                                   sp_mesh)
         else:
             attn = paged_attention(q, kc, vc, meta.page_table, meta.kv_lens,
                                    meta.positions, softcap=cfg.attn_softcap,
-                                   window=wnd, q_scale=cfg.query_scale)
+                                   window=wnd, q_scale=cfg.query_scale,
+                                   k_scale=ksc, v_scale=vsc)
         attn_out = jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd),
                               wmat(lp["wo"], x.dtype))
         if cfg.post_norms:
@@ -566,23 +627,30 @@ def forward(
             mlp = rms_norm(mlp, lp["post_mlp_norm"], cfg.rms_norm_eps,
                            cfg.norm_plus_one)
         x = x + mlp
-        ys = (kc, vc, drop_stats) if moe_aux else (kc, vc)
+        out_c = (kc, vc, ksc, vsc) if kvq else (kc, vc)
+        ys = out_c + (drop_stats,) if moe_aux else out_c
         return x, ys
 
     moe_aux = cfg.is_moe and cfg.moe_impl == "dispatch"
     # real (non-padding) positions: padding slots carry write_idx < 0
     token_valid = meta.write_idx >= 0 if moe_aux else None
+    # dynalint: kv-codec — cache leaves enter the layer scan whole; all
+    # value decode/encode happens in the codec-aware paths above
     scan_xs = (params["layers"], cache["k"], cache["v"])
+    if kvq:
+        # dynalint: kv-codec — scale leaves ride the scan next to values
+        scan_xs = scan_xs + (cache["k_scale"], cache["v_scale"])
     if layer_wnd is not None:
         scan_xs = scan_xs + (layer_wnd,)
+    nc = 4 if kvq else 2
     if moe_aux:
         x, ys = jax.lax.scan(layer_step, x, scan_xs)
-        new_k, new_v, drops = ys[0], ys[1], ys[2]
+        new_cache, drops = ys[:nc], ys[nc]
         aux = {"moe_dropped": jnp.sum(drops[0]),
                "moe_routed": jnp.sum(drops[1])}
     else:
         x, ys = jax.lax.scan(layer_step, x, scan_xs)
-        new_k, new_v = ys[0], ys[1]
+        new_cache = ys[:nc]
         aux = {}
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
@@ -590,6 +658,8 @@ def forward(
             else wmat(params["lm_head"], x.dtype))
     logits = _softcap(jnp.einsum("btd,dv->btv", x,
                                  head).astype(jnp.float32), cfg.final_softcap)
+    keys = ("k", "v", "k_scale", "v_scale") if kvq else ("k", "v")
+    cache_out = dict(zip(keys, new_cache))
     if with_aux:
-        return logits, {"k": new_k, "v": new_v}, aux
-    return logits, {"k": new_k, "v": new_v}
+        return logits, cache_out, aux
+    return logits, cache_out

@@ -211,6 +211,8 @@ def pp_forward(
                 pp_cache_sharding(), pp_cache_sharding(),
                 P(), P(), P(), P(), P())
     args = (params["embed"], params["layers"], params["final_norm"], head,
+            # dynalint: kv-codec — pp caches are always unquantized
+            # (NativeEngine rejects kv_quant on pp meshes)
             cache["k"], cache["v"], tokens, meta.positions, meta.page_table,
             meta.kv_lens, meta.write_idx)
     if wnds is not None:
@@ -362,6 +364,8 @@ def pp_decode_window(
                 P(), P(), P(), P(), P(), P(), P(), P(),
                 P(), P(), P(), P())
     args = (params["embed"], params["layers"], params["final_norm"], head,
+            # dynalint: kv-codec — pp caches are always unquantized
+            # (NativeEngine rejects kv_quant on pp meshes)
             cache["k"], cache["v"], tokens, positions, page_table, max_pos,
             min_tokens, counters, ignore_eos, stop_ids,
             temperature, top_k, top_p, seeds)

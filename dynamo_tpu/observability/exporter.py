@@ -96,6 +96,23 @@ class MetricsExporter:
                  "Steps where running streams emitted nothing (decode "
                  "stalled by a prefill-only step)"),
             )}
+        # KV representation gauges (ops/kv_quant.py): page HBM footprint,
+        # quant mode bit width (0 = unquantized, 8 = int8 pages), and
+        # transfer volume in the wire representation — bytes_per_fetch is
+        # the disagg handoff cost the kv_quant capacity bench halves
+        self.g_kv_repr = {
+            name: r.gauge(f"{PREFIX}_kv_{name}", help_, labels)
+            for name, help_ in (
+                ("page_bytes", "HBM bytes per KV page (k+v+scales)"),
+                ("quant_mode",
+                 "KV page quant bit width (0 = unquantized, 8 = int8)"),
+                ("transfer_bytes",
+                 "Cumulative KV transfer payload bytes (wire "
+                 "representation: quantized on kv_quant engines)"),
+                ("transfer_fetches", "Cumulative KV transfer fetches"),
+                ("transfer_bytes_per_fetch",
+                 "Mean KV transfer payload bytes per fetch"),
+            )}
         self.g_load_avg = r.gauge(
             f"{PREFIX}_load_avg", "Mean active KV blocks across workers")
         self.g_load_std = r.gauge(
@@ -160,7 +177,8 @@ class MetricsExporter:
                       self.g_kv_active, self.g_kv_total, self.g_waiting,
                       self.g_usage, self.g_hit_rate, self.g_window_steps,
                       self.g_window_wasted, self.g_spec_proposed,
-                      self.g_spec_accepted, *self.g_pipe.values()):
+                      self.g_spec_accepted, *self.g_pipe.values(),
+                      *self.g_kv_repr.values()):
                 g.remove(worker_id)
         for worker_id, m in endpoints.workers.items():
             self.g_active_slots.set(worker_id, value=m.request_active_slots)
@@ -193,6 +211,18 @@ class MetricsExporter:
                 worker_id, value=m.mixed_steps)
             self.g_pipe["stall_steps"].set(
                 worker_id, value=m.decode_stall_steps)
+            self.g_kv_repr["page_bytes"].set(
+                worker_id, value=m.kv_page_bytes)
+            self.g_kv_repr["quant_mode"].set(
+                worker_id, value=m.kv_quant_bits)
+            self.g_kv_repr["transfer_bytes"].set(
+                worker_id, value=m.kv_transfer_bytes)
+            self.g_kv_repr["transfer_fetches"].set(
+                worker_id, value=m.kv_transfer_fetches)
+            self.g_kv_repr["transfer_bytes_per_fetch"].set(
+                worker_id,
+                value=(m.kv_transfer_bytes / m.kv_transfer_fetches
+                       if m.kv_transfer_fetches else 0.0))
         self.g_load_avg.set(value=endpoints.load_avg)
         self.g_load_std.set(value=endpoints.load_std)
         self.g_workers.set(value=len(endpoints.workers))

@@ -34,6 +34,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.ops.kv_quant import gather_dequant, quantize_rows
+
 NEG_INF = -1e30
 
 
@@ -66,14 +68,22 @@ def paged_attention(
     softcap: float = 0.0,
     window: Optional[jax.Array] = None,  # scalar int32 sliding width
     q_scale: float = 0.0,
+    k_scale: Optional[jax.Array] = None,  # [Hkv, P, ps] f32 — int8 cache
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Causal attention of q against the paged KV prefix. Returns [B, Tq, H, hd]."""
     b, tq, h, hd = q.shape
     hkv = k_cache.shape[0]
     g = h // hkv
 
-    k = gather_pages(k_cache, page_table)  # [Hkv, B, Lk, hd]
-    v = gather_pages(v_cache, page_table)
+    if k_scale is not None:
+        # int8 cache: dequantize at the gather boundary (the one codec
+        # read site for this path); downstream math is unchanged
+        k = gather_dequant(k_cache, k_scale, page_table, q.dtype)
+        v = gather_dequant(v_cache, v_scale, page_table, q.dtype)
+    else:
+        k = gather_pages(k_cache, page_table)  # [Hkv, B, Lk, hd]
+        v = gather_pages(v_cache, page_table)
     lk = k.shape[2]
 
     qg = q.reshape(b, tq, hkv, g, hd)
@@ -195,6 +205,8 @@ def decode_attention_deferred(
     softcap: float = 0.0,
     window: Optional[jax.Array] = None,  # scalar int32 sliding width
     q_scale: float = 0.0,
+    k_scale: Optional[jax.Array] = None,  # [Hkv, P, ps] f32 — int8 cache
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Decode attention with the current token's kv appended in registers.
 
@@ -209,8 +221,14 @@ def decode_attention_deferred(
     hkv = k_cache.shape[0]
     g = h // hkv
 
-    k = gather_pages(k_cache, page_table)  # [Hkv, B, Lk, hd]
-    v = gather_pages(v_cache, page_table)
+    if k_scale is not None:
+        # int8 cache: dequantize at the gather boundary to q.dtype —
+        # the dequantized operand is the same width the bf16 path reads
+        k = gather_dequant(k_cache, k_scale, page_table, q.dtype)
+        v = gather_dequant(v_cache, v_scale, page_table, q.dtype)
+    else:
+        k = gather_pages(k_cache, page_table)  # [Hkv, B, Lk, hd]
+        v = gather_pages(v_cache, page_table)
     lk = k.shape[2]
 
     sc = _scale(hd, q_scale)
@@ -264,6 +282,40 @@ def write_kv_pages(
     flat_k = flat_k.at[:, safe_idx].set(kn, mode="drop")
     flat_v = flat_v.at[:, safe_idx].set(vn, mode="drop")
     return (flat_k.reshape(hkv, p, ps, hd), flat_v.reshape(hkv, p, ps, hd))
+
+
+def write_kv_pages_quant(
+    k_cache: jax.Array,    # [Hkv, P, ps, hd] int8
+    v_cache: jax.Array,
+    k_scale: jax.Array,    # [Hkv, P, ps] f32 per-row scales
+    v_scale: jax.Array,
+    k_new: jax.Array,      # [B, Tq, Hkv, hd] full-precision new rows
+    v_new: jax.Array,
+    write_idx: jax.Array,  # [B, Tq] int32 flat indices into P*ps; <0 = skip
+) -> tuple:
+    """Capture-time KV quantization (ops/kv_quant.py codec): each new row
+    quantizes against its own max and scatters int8 values + f32 scale at
+    the same flat token slot — the quantized twin of write_kv_pages."""
+    hkv, p, ps, hd = k_cache.shape
+    kq, ks = quantize_rows(k_new)           # [B, Tq, Hkv, hd] / [B, Tq, Hkv]
+    vq, vs = quantize_rows(v_new)
+    flat_k = k_cache.reshape(hkv, p * ps, hd)
+    flat_v = v_cache.reshape(hkv, p * ps, hd)
+    flat_ks = k_scale.reshape(hkv, p * ps)
+    flat_vs = v_scale.reshape(hkv, p * ps)
+    idx = write_idx.reshape(-1)
+    keep = idx >= 0
+    safe_idx = jnp.where(keep, idx, p * ps)
+    kn = kq.reshape(-1, hkv, hd).swapaxes(0, 1)
+    vn = vq.reshape(-1, hkv, hd).swapaxes(0, 1)
+    ksn = ks.reshape(-1, hkv).swapaxes(0, 1)
+    vsn = vs.reshape(-1, hkv).swapaxes(0, 1)
+    flat_k = flat_k.at[:, safe_idx].set(kn, mode="drop")
+    flat_v = flat_v.at[:, safe_idx].set(vn, mode="drop")
+    flat_ks = flat_ks.at[:, safe_idx].set(ksn, mode="drop")
+    flat_vs = flat_vs.at[:, safe_idx].set(vsn, mode="drop")
+    return (flat_k.reshape(hkv, p, ps, hd), flat_v.reshape(hkv, p, ps, hd),
+            flat_ks.reshape(hkv, p, ps), flat_vs.reshape(hkv, p, ps))
 
 
 def dense_causal_attention(

@@ -33,6 +33,7 @@ folded to [G, hd] by a reshape+sum outside the kernel.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -55,8 +56,19 @@ def kernel_supported(head_dim: int, page_size: int) -> bool:
     return 128 % head_dim == 0 and page_size % (128 // head_dim) == 0
 
 
-def _decode_kernel(ps: int, g: int, pt_ref, lens_ref, q_ref, k_hbm, v_hbm,
-                   o_ref, k_buf, v_buf, sems):
+def _decode_kernel(ps: int, g: int, quant: bool, pt_ref, lens_ref, q_ref,
+                   k_hbm, v_hbm, *rest):
+    if quant:
+        # int8 pages: per-(page, token-row) scale blocks ride as regular
+        # VMEM inputs (gathered by page table outside the kernel); the
+        # dequant folds into the score/probability rows — a row's scale
+        # is constant over the hd contraction, so (q . k_int8) * s_k ==
+        # q . (k_int8 * s_k), and p * s_v moves V's scale into the
+        # probability operand of the accumulator dot
+        sk_ref, sv_ref, o_ref, k_buf, v_buf, sems = rest
+    else:
+        o_ref, k_buf, v_buf, sems = rest
+        sk_ref = sv_ref = None
     s = pl.program_id(0)
     j = pl.program_id(1)
     kv_len = lens_ref[s]
@@ -101,6 +113,8 @@ def _decode_kernel(ps: int, g: int, pt_ref, lens_ref, q_ref, k_hbm, v_hbm,
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [G, ps]
+        if quant:
+            scores = scores * sk_ref[0, 0, pl.ds(i, 1)]  # [1, ps] K dequant
         pos = i * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps), 1)
         scores = jnp.where(pos < kv_len, scores, NEG_INF)
 
@@ -108,8 +122,9 @@ def _decode_kernel(ps: int, g: int, pt_ref, lens_ref, q_ref, k_hbm, v_hbm,
         alpha = jnp.exp(m - m_new)                     # [G, 1]
         p = jnp.exp(scores - m_new)                    # [G, ps]
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = p * sv_ref[0, 0, pl.ds(i, 1)] if quant else p  # V dequant
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            pv, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [G, hd]
         return m_new, l_new, acc_new
 
@@ -120,16 +135,26 @@ def _decode_kernel(ps: int, g: int, pt_ref, lens_ref, q_ref, k_hbm, v_hbm,
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
 
 
-def _decode_kernel_packed(ps: int, g: int, hd: int, pack: int,
-                          pt_ref, lens_ref, q_ref, k_hbm, v_hbm,
-                          o_ref, k_buf, v_buf, sems):
+def _decode_kernel_packed(ps: int, g: int, hd: int, pack: int, quant: bool,
+                          pt_ref, lens_ref, q_ref, k_hbm, v_hbm, *rest):
     """hd < 128 variant: pages are packed [rows, 128] blocks (rows = ps/pack).
 
     Token (r*pack + pk) of a page lives in row r, lanes [pk*hd, (pk+1)*hd).
     The output o_ref is the PACKED accumulator [G, 128] (f32): lane segment
     pk holds the attention contribution of tokens == pk (mod pack); the
     caller folds segments with a reshape+sum.
+
+    quant (int8 pages): scale blocks arrive [1, 1, Pb*pack, rows] (page-
+    table-gathered outside, token (r*pack+pk) of page i at [i*pack+pk, r])
+    and fold into the per-segment score/probability rows — segment pk's
+    [G, rows] score covers exactly the tokens whose scale row is
+    [i*pack+pk], so the fold is a [1, rows] broadcast multiply.
     """
+    if quant:
+        sk_ref, sv_ref, o_ref, k_buf, v_buf, sems = rest
+    else:
+        o_ref, k_buf, v_buf, sems = rest
+        sk_ref = sv_ref = None
     s = pl.program_id(0)
     j = pl.program_id(1)
     kv_len = lens_ref[s]
@@ -187,6 +212,8 @@ def _decode_kernel_packed(ps: int, g: int, hd: int, pack: int,
             sc = jax.lax.dot_general(
                 q_shifts[pk], k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)    # [G, rows]
+            if quant:
+                sc = sc * sk_ref[0, 0, pl.ds(i * pack + pk, 1)]  # [1, rows]
             pos = i * ps + row * pack + pk
             scores.append(jnp.where(pos < kv_len, sc, NEG_INF))
 
@@ -199,8 +226,10 @@ def _decode_kernel_packed(ps: int, g: int, hd: int, pack: int,
         for pk in range(pack):
             p = jnp.exp(scores[pk] - m_new)            # [G, rows]
             l_new = l_new + jnp.sum(p, axis=-1, keepdims=True)
+            pv = (p * sv_ref[0, 0, pl.ds(i * pack + pk, 1)] if quant
+                  else p)                              # V dequant fold
             contrib = jax.lax.dot_general(
-                p, v, (((1,), (0,)), ((), ())),
+                pv, v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)    # [G, 128]
             # lanes outside segment pk are cross-residue junk — mask them
             acc_new = acc_new + jnp.where(lane_masks[pk], contrib, 0.0)
@@ -214,9 +243,8 @@ def _decode_kernel_packed(ps: int, g: int, hd: int, pack: int,
 
 
 def _decode_kernel_prefix(ps: int, hkv: int, g: int, hd: int, pack: int,
-                          pt_ref, lens_ref, layer_ref,
-                          q_ref, k_hbm, v_hbm, o_ref, m_ref, l_ref,
-                          k_buf, v_buf, sems):
+                          quant: bool, pt_ref, lens_ref, layer_ref,
+                          q_ref, k_hbm, v_hbm, *rest):
     """Prefix-only decode attention, one program per SEQUENCE (grid (s,)).
 
     Three design deltas vs _decode_kernel_packed, all for the serving hot
@@ -232,7 +260,16 @@ def _decode_kernel_prefix(ps: int, hkv: int, g: int, hd: int, pack: int,
       (acc, m, l): the current token's kv is combined outside
       (combine_self_attention), which lets the engine defer all cache
       writes to one in-place scatter per step.
+
+    quant (int8 pages): per-head scale blocks [1, Hkv, Pb*pack, rows]
+    (this layer's scales, page-table-gathered outside) fold into the
+    score/probability rows exactly as in _decode_kernel_packed.
     """
+    if quant:
+        sk_ref, sv_ref, o_ref, m_ref, l_ref, k_buf, v_buf, sems = rest
+    else:
+        o_ref, m_ref, l_ref, k_buf, v_buf, sems = rest
+        sk_ref = sv_ref = None
     s = pl.program_id(0)
     w = pack * hd
     rows = ps // pack
@@ -304,6 +341,8 @@ def _decode_kernel_prefix(ps: int, hkv: int, g: int, hd: int, pack: int,
                 sc = jax.lax.dot_general(
                     q_shifts[j][pk], k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32)  # [G, rows]
+                if quant:
+                    sc = sc * sk_ref[0, j, pl.ds(i * pack + pk, 1)]
                 pos = i * ps + row * pack + pk
                 scores.append(jnp.where(pos < prefix, sc, NEG_INF))
             m_new = ms[j]
@@ -316,8 +355,10 @@ def _decode_kernel_prefix(ps: int, hkv: int, g: int, hd: int, pack: int,
             for pk in range(pack):
                 p = jnp.exp(scores[pk] - m_new)          # [G, rows]
                 l_new = l_new + jnp.sum(p, axis=-1, keepdims=True)
+                pv = (p * sv_ref[0, j, pl.ds(i * pack + pk, 1)] if quant
+                      else p)                            # V dequant fold
                 contrib = jax.lax.dot_general(
-                    p, v, (((1,), (0,)), ((), ())),
+                    pv, v, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)  # [G, W]
                 acc_new = acc_new + jnp.where(lane_masks[pk], contrib, 0.0)
             ms_n.append(m_new)
@@ -344,27 +385,59 @@ def decode_paged_attention_prefix(
     prefix_lens: jax.Array,  # [S] int32 — valid kv BEFORE this token
     *,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [L, Hkv, P, ps] f32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ):
     """Unnormalized prefix attention state: (acc [S,H,hd] f32, m [S,H,1],
-    l [S,H,1]). Fold with the current token via combine_self_attention."""
+    l [S,H,1]). Fold with the current token via combine_self_attention.
+
+    With k_scale/v_scale (int8 cache), this layer's scales are gathered by
+    the page table OUTSIDE the kernel (an [S, Hkv, Pb, ps] f32 gather —
+    1/hd of the KV bytes) and folded into the in-kernel score/prob rows;
+    the page DMA itself stays int8, which is the point: half the HBM
+    traffic of the bf16 read."""
     s, h, hd = q.shape
     nl, hkv, p, ps, _ = k_cache.shape
     g = h // hkv
     pack = max(1, 128 // hd)
     w = pack * hd
     rows = ps // pack
+    quant = k_scale is not None
     k_pk = k_cache.reshape(nl, hkv, p, rows, w)     # free row-major bitcast
     v_pk = v_cache.reshape(nl, hkv, p, rows, w)
     qg = q.reshape(s, hkv, g, hd)
+    pb = page_table.shape[1]
+
+    in_specs = [
+        pl.BlockSpec((1, hkv, g, hd), lambda i, *_: (i, 0, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    args = (page_table, prefix_lens, layer, qg, k_pk, v_pk)
+    if quant:
+        def scale_blocks(scale):
+            # this layer's scales, gathered to [S, Hkv, Pb*pack, rows]:
+            # token (r*pack + pk) of page i lands at [i*pack + pk, r],
+            # matching the packed value layout's lane segments
+            sl = jnp.take(scale, layer[0], axis=0)          # [Hkv, P, ps]
+            sg = jnp.take(sl, page_table.reshape(-1),
+                          axis=1).reshape(hkv, s, pb, ps)
+            return (sg.transpose(1, 0, 2, 3)
+                    .reshape(s, hkv, pb, rows, pack)
+                    .transpose(0, 1, 2, 4, 3)
+                    .reshape(s, hkv, pb * pack, rows))
+        in_specs += [
+            pl.BlockSpec((1, hkv, pb * pack, rows),
+                         lambda i, *_: (i, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, pb * pack, rows),
+                         lambda i, *_: (i, 0, 0, 0)),
+        ]
+        args = args + (scale_blocks(k_scale), scale_blocks(v_scale))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(s,),
-        in_specs=[
-            pl.BlockSpec((1, hkv, g, hd), lambda i, *_: (i, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, hkv, g, w), lambda i, *_: (i, 0, 0, 0)),
             pl.BlockSpec((1, hkv, g, w), lambda i, *_: (i, 0, 0, 0)),
@@ -378,11 +451,12 @@ def decode_paged_attention_prefix(
     )
     shape = jax.ShapeDtypeStruct((s, hkv, g, w), jnp.float32)
     acc, m, l = pl.pallas_call(
-        functools.partial(_decode_kernel_prefix, ps, hkv, g, hd, pack),
+        functools.partial(_decode_kernel_prefix, ps, hkv, g, hd, pack,
+                          quant),
         out_shape=[shape, shape, shape],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(page_table, prefix_lens, layer, qg, k_pk, v_pk)
+    )(*args)
     acc = acc.reshape(s, hkv, g, pack, hd).sum(axis=3).reshape(s, h, hd)
     return acc, m[..., :1].reshape(s, h, 1), l[..., :1].reshape(s, h, 1)
 
@@ -413,21 +487,33 @@ def combine_self_attention(q, k_new, v_new, acc, m, l):
 
 def decode_paged_attention_prefix_sharded(
     q, k_cache, v_cache, layer, page_table, prefix_lens, mesh,
-    *, interpret: bool = False,
+    *, interpret: bool = False, k_scale=None, v_scale=None,
 ):
-    """shard_map the prefix kernel over the "tp" axis (heads sharded)."""
-    specs = dict(
-        mesh=mesh,
-        in_specs=(P(None, "tp", None), P(None, "tp", None, None, None),
-                  P(None, "tp", None, None, None), P(None),
-                  P(None, None), P(None)),
-        out_specs=(P(None, "tp", None), P(None, "tp", None),
-                   P(None, "tp", None)),
-    )
+    """shard_map the prefix kernel over the "tp" axis (heads sharded);
+    int8 caches shard the scale stacks' kv-head axis the same way."""
+    in_specs = (P(None, "tp", None), P(None, "tp", None, None, None),
+                P(None, "tp", None, None, None), P(None),
+                P(None, None), P(None))
+    out_specs = (P(None, "tp", None), P(None, "tp", None),
+                 P(None, "tp", None))
+    if k_scale is not None:
+        in_specs = in_specs + (P(None, "tp", None, None),
+                               P(None, "tp", None, None))
+
+        def body(q, kc, vc, lyr, pt, lens, ks, vs):
+            return decode_paged_attention_prefix(
+                q, kc, vc, lyr, pt, lens, interpret=interpret,
+                k_scale=ks, v_scale=vs)
+        f = shard_map_compat(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+        return f(q, k_cache, v_cache, layer, page_table, prefix_lens,
+                 k_scale, v_scale)
+
     def body(q, kc, vc, lyr, pt, lens):
         return decode_paged_attention_prefix(q, kc, vc, lyr, pt, lens,
                                              interpret=interpret)
-    f = shard_map_compat(body, **specs)
+    f = shard_map_compat(body, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs)
     return f(q, k_cache, v_cache, layer, page_table, prefix_lens)
 
 
@@ -440,11 +526,19 @@ def decode_paged_attention(
     kv_lens: jax.Array,      # [S] int32 (>= 1 per active slot)
     *,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [Hkv, P, ps] f32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Returns [S, H, hd] attention of each decode token over its pages."""
+    """Returns [S, H, hd] attention of each decode token over its pages.
+
+    With k_scale/v_scale (int8 cache) the scales are gathered by the page
+    table outside the kernel and folded into the in-kernel score/prob
+    rows; the page DMA stays int8."""
     s, h, hd = q.shape
     hkv, p, ps, _ = k_cache.shape
     g = h // hkv
+    pb = page_table.shape[1]
+    quant = k_scale is not None
     # padded decode slots carry kv_len 0; clamp so the page-0 warm-up DMA
     # and the 1/l normalization stay well-defined (their output is ignored)
     kv_lens = jnp.maximum(kv_lens, 1)
@@ -454,6 +548,11 @@ def decode_paged_attention(
     # full array extent — valid Mosaic layout for any G (see kernel docs).
     qg = q.reshape(s, hkv, g, hd)
 
+    def gather_scale(scale):                     # -> [S, Hkv, Pb, ps]
+        sg = jnp.take(scale, page_table.reshape(-1),
+                      axis=1).reshape(hkv, s, pb, ps)
+        return sg.transpose(1, 0, 2, 3)
+
     if hd < 128 and kernel_supported(hd, ps):
         # lane-aligned packed path (see module docstring): view pages as
         # [rows, 128] and fold the packed accumulator outside the kernel
@@ -461,14 +560,29 @@ def decode_paged_attention(
         rows = ps // pack
         k_pk = k_cache.reshape(hkv, p, rows, 128)   # free row-major bitcast
         v_pk = v_cache.reshape(hkv, p, rows, 128)
+        in_specs = [
+            pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        args = (page_table, kv_lens, qg, k_pk, v_pk)
+        if quant:
+            def packed_scale(scale):             # -> [S, Hkv, Pb*pack, rows]
+                sg = gather_scale(scale)
+                return (sg.reshape(s, hkv, pb, rows, pack)
+                        .transpose(0, 1, 2, 4, 3)
+                        .reshape(s, hkv, pb * pack, rows))
+            in_specs += [
+                pl.BlockSpec((1, 1, pb * pack, rows),
+                             lambda i, j, *_: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, pb * pack, rows),
+                             lambda i, j, *_: (i, j, 0, 0)),
+            ]
+            args = args + (packed_scale(k_scale), packed_scale(v_scale))
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(s, hkv),
-            in_specs=[
-                pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, g, 128),
                                    lambda i, j, *_: (i, j, 0, 0)),
             scratch_shapes=[
@@ -478,21 +592,30 @@ def decode_paged_attention(
             ],
         )
         packed = pl.pallas_call(
-            functools.partial(_decode_kernel_packed, ps, g, hd, pack),
+            functools.partial(_decode_kernel_packed, ps, g, hd, pack,
+                              quant),
             out_shape=jax.ShapeDtypeStruct((s, hkv, g, 128), jnp.float32),
             grid_spec=grid_spec,
             interpret=interpret,
-        )(page_table, kv_lens, qg, k_pk, v_pk)
+        )(*args)
         return (packed.reshape(s, h, pack, hd).sum(axis=2).astype(q.dtype))
 
+    in_specs = [
+        pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    args = (page_table, kv_lens, qg, k_cache, v_cache)
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, pb, ps), lambda i, j, *_: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, pb, ps), lambda i, j, *_: (i, j, 0, 0)),
+        ]
+        args = args + (gather_scale(k_scale), gather_scale(v_scale))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s, hkv),
-        in_specs=[
-            pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, ps, hd), k_cache.dtype),
@@ -501,12 +624,13 @@ def decode_paged_attention(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, ps, g),
-        out_shape=jax.ShapeDtypeStruct((s, hkv, g, hd), q.dtype),
+        functools.partial(_decode_kernel, ps, g, quant),
+        out_shape=jax.ShapeDtypeStruct((s, hkv, g, hd),
+                                       jnp.float32 if quant else q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(page_table, kv_lens, qg, k_cache, v_cache)
-    return out.reshape(s, h, hd)
+    )(*args)
+    return out.reshape(s, h, hd).astype(q.dtype)
 
 
 def decode_paged_attention_sharded(
@@ -518,6 +642,8 @@ def decode_paged_attention_sharded(
     mesh: Mesh,
     *,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [Hkv, P, ps] f32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Multi-chip decode kernel: shard_map over the "tp" mesh axis.
 
@@ -531,17 +657,28 @@ def decode_paged_attention_sharded(
     """
     head_spec = P(None, "tp", None)
     cache_spec = P("tp", None, None, None)
-    specs = dict(
-        mesh=mesh,
-        in_specs=(head_spec, cache_spec, cache_spec, P(None, None), P(None)),
-        out_specs=head_spec,
-    )
+    in_specs = (head_spec, cache_spec, cache_spec, P(None, None), P(None))
+    if k_scale is not None:
+        scale_spec = P("tp", None, None)
+        f = shard_map_compat(
+            functools.partial(_decode_local_quant, interpret), mesh=mesh,
+            in_specs=in_specs + (scale_spec, scale_spec),
+            out_specs=head_spec)
+        return f(q, k_cache, v_cache, page_table, kv_lens, k_scale, v_scale)
     # pallas_call output has no varying-mesh-axis annotation; the compat
     # shim disables the VMA/rep check
-    f = shard_map_compat(functools.partial(_decode_local, interpret), **specs)
+    f = shard_map_compat(functools.partial(_decode_local, interpret),
+                         mesh=mesh, in_specs=in_specs, out_specs=head_spec)
     return f(q, k_cache, v_cache, page_table, kv_lens)
 
 
 def _decode_local(interpret, q, k_cache, v_cache, page_table, kv_lens):
     return decode_paged_attention(q, k_cache, v_cache, page_table, kv_lens,
                                   interpret=interpret)
+
+
+def _decode_local_quant(interpret, q, k_cache, v_cache, page_table, kv_lens,
+                        k_scale, v_scale):
+    return decode_paged_attention(q, k_cache, v_cache, page_table, kv_lens,
+                                  interpret=interpret, k_scale=k_scale,
+                                  v_scale=v_scale)

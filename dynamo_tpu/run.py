@@ -91,7 +91,7 @@ async def build_engine(out_spec: str, card: ModelDeploymentCard, args):
         max_model_len=min(card.context_length, model_cfg.max_model_len),
         tp=args.tp, sp=args.sp, host_pages=args.host_pages,
         spec_decode=args.spec_decode, spec_k=args.spec_k,
-        spec_draft_model=args.spec_draft)
+        spec_draft_model=args.spec_draft, kv_quant=args.kv_quant)
     n_mesh = args.tp * args.pp * args.ep * args.sp
     mesh = (make_mesh(tp=args.tp, pp=args.pp, ep=args.ep, sp=args.sp)
             if n_mesh > 1 else None)
@@ -201,6 +201,12 @@ async def amain() -> None:
     p.add_argument("--quant", default="", choices=("", "int8"),
                    help="weight-only quantization: int8 halves weight HBM "
                         "and decode weight reads (ops/quant.py)")
+    p.add_argument("--kv-quant", default="", choices=("", "int8"),
+                   help="KV-cache page quantization: int8 pages + per-row "
+                        "scales end-to-end (capture -> paged read -> "
+                        "offload tiers -> disagg transfer), ~1.9x HBM "
+                        "page capacity and ~2x fewer transfer bytes "
+                        "(ops/kv_quant.py; parity-gated)")
     p.add_argument("--host-pages", type=int, default=0)
     p.add_argument("--spec-decode", default="",
                    choices=("", "ngram", "draft"),

@@ -75,3 +75,28 @@ class IntegrityStats:
 
 
 STATS = IntegrityStats()
+
+
+@dataclasses.dataclass
+class KvTransferStats:
+    """Process-global KV-transfer volume counters (/metrics:
+    llm_kv_transfer_*). Bytes count the WIRE representation — for
+    kv_quant="int8" engines that is the quantized int8 pages plus their
+    f32 scale rows, so bytes_sent / fetches is the honest
+    bytes-per-fetch figure the capacity math relies on (~2x below a
+    bf16 engine's at the same page count)."""
+
+    bytes_sent: int = 0       # payload bytes shipped by transfer senders
+    pages_sent: int = 0       # pages those bytes carried
+    fetches: int = 0          # transfer frames fetched/injected
+    bytes_fetched: int = 0    # payload bytes arriving at inject
+
+    def snapshot(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+    def reset(self) -> None:
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, 0)
+
+
+XFER_STATS = KvTransferStats()

@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R10), the
+- one positive AND one negative fixture per AST rule (R1-R11), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -593,6 +593,64 @@ def test_r10_live_on_current_planning_layer():
         with open(os.path.join(REPO, rel)) as f:
             found = lint_source(f.read(), rel)
         assert not [x for x in found if x.rule == "R10"], rel
+
+
+# -- R11: raw KV-cache leaf access outside the quant codec helpers ------------
+
+R11_SRC = """
+    import jax.numpy as jnp
+
+    def leaky_read(cache, page_table):
+        k = cache["k"].astype(jnp.float32)     # bytes-as-values
+        return jnp.take(k, page_table, axis=1)
+"""
+
+
+def test_r11_flags_raw_cache_leaf_access_in_model_code():
+    found = lint_source(textwrap.dedent(R11_SRC),
+                        "dynamo_tpu/models/fixture.py")
+    assert "R11" in rules(found)
+
+
+def test_r11_quiet_outside_scope_and_in_codec_module():
+    # frontend code never touches cache leaves' numerics: out of scope
+    found = lint_source(textwrap.dedent(R11_SRC),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert "R11" not in rules(found)
+    # the codec module itself is exempt — it IS the decode/encode site
+    found = lint_source(textwrap.dedent(R11_SRC),
+                        "dynamo_tpu/ops/kv_quant.py")
+    assert "R11" not in rules(found)
+
+
+def test_r11_quiet_on_annotated_codec_sites():
+    neg = """
+        import jax.numpy as jnp
+        from dynamo_tpu.ops.kv_quant import dequantize_rows
+
+        def codec_read(cache, page_table):
+            # dynalint: kv-codec — codec read site
+            g = jnp.take(cache["k"], page_table, axis=1)
+            # dynalint: kv-codec — scale rows feed the dequant
+            s = jnp.take(cache["k_scale"], page_table, axis=1)
+            return dequantize_rows(g, s, jnp.bfloat16)
+    """
+    found = lint_source(textwrap.dedent(neg),
+                        "dynamo_tpu/models/fixture.py")
+    assert "R11" not in rules(found)
+
+
+def test_r11_live_on_current_model_and_ops_tree():
+    """Every cache-leaf access in the model/ops/engine-step code is
+    codec-annotated (the kv_quant PR's boundary stays mechanically
+    enforced)."""
+    for rel in ("dynamo_tpu/models/llama.py", "dynamo_tpu/models/pp.py",
+                "dynamo_tpu/engine/engine.py",
+                "dynamo_tpu/ops/attention.py",
+                "dynamo_tpu/ops/paged_attention.py"):
+        with open(os.path.join(REPO, rel)) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R11"], rel
 
 
 # -- jaxpr invariants ----------------------------------------------------------

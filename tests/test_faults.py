@@ -242,10 +242,12 @@ def test_site_remote_transfer_corruption_refetches_then_succeeds():
     arm("remote_transfer.fetch_page", FaultSpec("corrupt", p=1.0, n=1))
     k = jnp.arange(2 * 2 * 2 * 4, dtype=jnp.float32).reshape(2, 2, 2, 4)
     v = k + 100.0
-    k_np, v_np = asyncio.run(LocalTransferBackend._verified_stage(
-        "r1", [0, 1], k, v))
+    k_np, v_np, ks_np, vs_np = asyncio.run(
+        LocalTransferBackend._verified_stage("r1", [0, 1], k, v))
     # the single bounded corruption was absorbed by one re-fetch and the
-    # verified bytes match the authoritative device copy
+    # verified bytes match the authoritative device copy (unquantized
+    # pages carry no scale stacks)
+    assert ks_np is None and vs_np is None
     np.testing.assert_array_equal(k_np, np.asarray(k))
     np.testing.assert_array_equal(v_np, np.asarray(v))
     assert INTEGRITY.refetches == 1 and INTEGRITY.mismatches >= 1

@@ -58,6 +58,34 @@ def make_engine(num_pages=64, **kw):
         max_model_len=512, **kw), seed=0)
 
 
+def _disagg_remote_stack_kvq(plane, integrity_retries=2):
+    """Same stack as _disagg_remote_stack but with int8-KV engines on
+    BOTH sides (the transfer contract requires matching kv_quant)."""
+    from dynamo_tpu.disagg import (
+        DisaggDecodeWorker, DisaggregatedRouter, KvTransferServer,
+        PrefillQueue, PrefillWorker, RemoteTransferBackend,
+    )
+    from dynamo_tpu.llm.worker import NativeEngineWorker
+
+    async def build():
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        router = DisaggregatedRouter(max_local_prefill_length=4,
+                                     max_prefill_queue_size=8, model="tiny")
+        decode = DisaggDecodeWorker(
+            make_engine(kv_quant="int8"), plane.messaging, router, queue,
+            worker_id="dec-0", prefill_timeout_s=30.0)
+        server = await KvTransferServer(decode, "dec-0").start()
+        await server.register(plane.kv)
+        transfer = RemoteTransferBackend(
+            plane.kv, integrity_retries=integrity_retries)
+        prefill = PrefillWorker(
+            NativeEngineWorker(make_engine(kv_quant="int8")), queue,
+            transfer, plane.messaging)
+        return decode, prefill, server, transfer
+
+    return build()
+
+
 _ORACLE = []
 
 
@@ -210,6 +238,46 @@ def test_persistent_wire_corruption_falls_back_to_local_prefill():
     assert INTEGRITY.refetches >= 1       # the budget was actually spent
     assert INTEGRITY.quarantined >= 1     # then the source pages quarantined
     assert INTEGRITY.reprefills >= 1      # and the remote path abandoned
+
+
+def test_kv_quant_wire_corruption_absorbed_by_refetch():
+    """int8 KV pages over the disagg wire under a seeded corruption
+    burst: checksums computed over the QUANTIZED bytes (values + scale
+    rows, no dequant) catch the flip, one re-fetch re-stages clean
+    bytes, and the stream is token-identical to the int8 local oracle —
+    the acceptance bar's corrupt->refetch leg for quantized pages."""
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine(kv_quant="int8").generate(prompt, params, "kvq-o")
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, server, transfer = await _disagg_remote_stack_kvq(
+            plane)
+        await decode.start()
+        await prefill.start()
+        arm("remote_transfer.fetch_page",
+            FaultSpec("corrupt", p=1.0, n=1, nbytes=16))
+        try:
+            toks, reasons = await asyncio.wait_for(_drive(
+                decode.generate(_pre("rq1", prompt), Context("rq1"))), 120)
+        finally:
+            await prefill.stop()
+            await decode.stop()
+            await transfer.close()
+            await server.stop()
+        return toks, reasons
+
+    toks, reasons = asyncio.run(main())
+    assert toks == expect, (toks, expect)
+    assert reasons == ["length"]
+    assert INTEGRITY.mismatches >= 1
+    assert INTEGRITY.refetches >= 1
+    assert INTEGRITY.quarantined == 0
+    assert INTEGRITY.reprefills == 0
 
 
 # -- corrupt at rest: offload tiers --------------------------------------------

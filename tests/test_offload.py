@@ -16,12 +16,13 @@ CFG = ModelConfig(dtype="float32", max_model_len=256)
 PAGE = 8
 
 
-def make_engine(num_pages, host_pages=0, disk_pages=0, disk_dir=None):
+def make_engine(num_pages, host_pages=0, disk_pages=0, disk_dir=None,
+                kv_quant=""):
     return NativeEngine(CFG, EngineConfig(
         page_size=PAGE, num_pages=num_pages, max_slots=2,
         max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
         max_model_len=256, host_pages=host_pages, disk_pages=disk_pages,
-        disk_dir=disk_dir), seed=0)
+        disk_dir=disk_dir, kv_quant=kv_quant), seed=0)
 
 
 def test_host_pool_lru():
@@ -98,6 +99,51 @@ def test_disk_tier_spill_and_promote(tmp_path):
     got_a2 = eng.generate(prompt_a, params, "a2")
     assert got_a2 == expect_a
     assert st.disk_hits > 0, "re-prefill must promote from the disk tier"
+
+
+def test_kv_quant_pages_survive_host_and_disk_tiers(tmp_path):
+    """int8 pages spill and promote through the full tier ladder in
+    their QUANTIZED representation (int8 slabs + f32 scale slabs,
+    checksums over both) and decode tokens stay identical to the
+    int8 no-tier oracle — the acceptance bar's offload leg."""
+    params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    prompt_a = list(range(10, 34))    # 3 pages
+    prompt_b = list(range(100, 140))  # 5 pages
+    expect_a = make_engine(num_pages=64,
+                           kv_quant="int8").generate(prompt_a, params, "a")
+
+    eng = make_engine(num_pages=6, host_pages=2, disk_pages=16,
+                      disk_dir=str(tmp_path), kv_quant="int8")
+    # tier slabs store the device representation: int8 values, f32 scales
+    assert eng.host_pool.k_slab.dtype == np.int8
+    assert eng.host_pool.ks_slab is not None
+    assert eng.host_pool.ks_slab.dtype == np.float32
+    assert eng.generate(prompt_a, params, "a1") == expect_a
+    eng.generate(prompt_b, params, "b")   # evicts A: DRAM -> disk cascade
+    eng._copy_stream.drain()
+    st = eng.host_pool.stats
+    assert st.offloaded > 0 and st.disk_offloaded > 0
+    got_a2 = eng.generate(prompt_a, params, "a2")
+    assert got_a2 == expect_a
+    assert st.disk_hits > 0 and st.onboarded > 0
+
+
+def test_host_pool_scale_rot_is_caught():
+    """The capture checksum covers the SCALE rows too: flipping a scale
+    byte (values intact) must still quarantine on read — a corrupted
+    scale silently rescales every token in the page."""
+    from dynamo_tpu.runtime.integrity import STATS as INTEGRITY
+    INTEGRITY.reset()
+    pool = HostKvPool(2, (1, 1, 2, 2), np.int8, scale_shape=(1, 1, 2))
+    k = np.ones((1, 1, 2, 2), np.int8)
+    s = np.full((1, 1, 2), 0.5, np.float32)
+    pool.put(7, k, k, s, s)
+    got = pool.get(7)
+    assert got is not None and len(got) == 4
+    pool.ks_slab[0].view(np.uint8)[0] ^= 0xFF   # rot the scale at rest
+    assert pool.get(7) is None                  # quarantined, never served
+    assert INTEGRITY.quarantined == 1
+    INTEGRITY.reset()
 
 
 def test_offload_disabled_by_default():

@@ -74,7 +74,20 @@ def main() -> int:
             return 3  # config error: permanent, never retried
         import dataclasses
         model_cfg = dataclasses.replace(model_cfg, quant=quant)
-    verdict = bench.run_parity(model_cfg, logf=log)
+    # PARITY_KV_QUANT=int8: run the kv-cache quantization gate instead of
+    # the window-vs-single-step check — greedy-match rate + bounded logit
+    # drift between the int8-KV engine and its unquantized twin, the SAME
+    # bench.run_kv_quant_parity implementation (and thresholds) the tier-1
+    # gate runs on CPU (tests/test_kv_quant.py), now on real hardware
+    # (PARITY_TPU_r06_kvq ladder item).
+    kvq = os.environ.get("PARITY_KV_QUANT", "")
+    if kvq:
+        if kvq != "int8":
+            log(f"PARITY_KV_QUANT={kvq!r} unsupported (supported: int8)")
+            return 3
+        verdict = bench.run_kv_quant_parity(model_cfg, logf=log)
+    else:
+        verdict = bench.run_parity(model_cfg, logf=log)
     record = {
         "t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "backend": backend, "devices": [str(d) for d in devices],
@@ -83,12 +96,16 @@ def main() -> int:
     }
     if quant:
         record["quant"] = quant
+    if kvq:
+        record["kv_quant"] = kvq
     # evidence-artifact policy (tools/artifacts.py, VERDICT r5 weak #7):
     # final name, written once; a re-run of the same capture overwrites
     # deliberately rather than renaming the old file aside
     from tools.artifacts import write_json
     write_json(OUT, record, overwrite=True)
     log(f"wrote {OUT}")
+    if kvq:
+        return 0 if verdict.get("pass") else 1
     return 0 if verdict.startswith("exact") else 1
 
 

@@ -25,7 +25,9 @@ while true; do
       && [ -e BENCH_SELF_r05_w128.json ] \
       && [ -e BENCH_SELF_r05_spec.json ] \
       && [ -e PARITY_TPU_r06_int8.json ] \
-      && [ -e BENCH_SELF_r06_int8_churn.json ]; then
+      && [ -e BENCH_SELF_r06_int8_churn.json ] \
+      && [ -e PARITY_TPU_r06_kvq.json ] \
+      && [ -e BENCH_SELF_r06_kvq.json ]; then
     echo "[watch] all TPU evidence captured; exiting" >&2
     exit 0
   fi
@@ -149,6 +151,43 @@ json.dump(r, open("BENCH_SELF_r06_int8_churn.json", "w"), indent=1)
 EOF
             cp "$cl" BENCH_SELF_r06_int8_churn.log 2>/dev/null
             echo "[watch] int8 churn captured: $cvalue" >&2 ;;
+        esac
+      fi
+      if [ ! -e PARITY_TPU_r06_kvq.json ]; then
+        # kv-cache int8 parity gate (ROADMAP item 5): the SAME
+        # bench.run_kv_quant_parity thresholds the tier-1 CPU gate
+        # enforces (greedy-match >= 0.99 + bounded logit drift), on
+        # hardware — the one check Mosaic/bf16 numerics could move
+        echo "[watch] -> kv_quant parity" >&2
+        PARITY_KV_QUANT=int8 PARITY_OUT=PARITY_TPU_r06_kvq.json \
+          timeout 900 python tools/tpu_parity_quick.py \
+          >> tpu_parity_r6_kvq.log 2>&1 \
+          && echo "[watch] kv_quant parity captured" >&2
+      fi
+      if [ ! -e BENCH_SELF_r06_kvq.json ] \
+          && [ -e BENCH_SELF_r05_int8.json ]; then
+        # kv_quant A/B capture: extras.kv_quant (capacity at fixed HBM
+        # page budget + int8-KV churn) from the bench's kv_quant_ab
+        # phase, on an int8-WEIGHT engine so both HBM levers compose
+        echo "[watch] -> kv_quant bench" >&2
+        rm -f .bench_state.json
+        kj=/tmp/bench_k_$$.json kl=/tmp/bench_k_$$.log
+        BENCH_QUANT=int8 BENCH_BUDGET_S=1200 timeout 1500 python bench.py \
+            >"$kj" 2>"$kl"
+        kvalue=$(python -c "import json,sys;print(json.load(open(sys.argv[1]))['extras'].get('kv_quant',{}).get('churn_int8_tok_s',0))" \
+            "$kj" 2>/dev/null || echo 0)
+        case "$kvalue" in
+          0|0.0|"") echo "[watch] kv_quant bench got no number" >&2 ;;
+          *)
+            python - "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$kj" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[2]))
+r["timestamp"] = sys.argv[1]
+r["self_measured"] = True
+json.dump(r, open("BENCH_SELF_r06_kvq.json", "w"), indent=1)
+EOF
+            cp "$kl" BENCH_SELF_r06_kvq.log 2>/dev/null
+            echo "[watch] kv_quant bench captured: $kvalue" >&2 ;;
         esac
       fi
       if [ ! -e BENCH_SELF_r05_spec.json ] \
