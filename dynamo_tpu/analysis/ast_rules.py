@@ -39,6 +39,14 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       `.astype`s them to a float) silently treats quantized bytes as
       values; every access must go through (or knowingly feed) the
       ops/kv_quant.py codec
+- R12 control-plane retry loops (watch pumps, heartbeat/keepalive
+      loops, lease renewal, scrape loops) that survive failures —
+      a `while` loop with a non-reraising exception handler around a
+      control-plane call — without backoff+jitter (no name containing
+      "backoff" in the loop) and without a
+      `# dynalint: backoff-ok=<reason>` annotation; at fleet scale an
+      un-jittered retry loop re-synchronizes hundreds of workers into
+      thundering-herd waves against the discovery store
 """
 from __future__ import annotations
 
@@ -746,6 +754,92 @@ def r11_raw_kv_cache_access(tree: ast.AST, lines: List[str],
             "aware attention/write helpers, or annotate with "
             "`# dynalint: kv-codec` and say how the site preserves or "
             "decodes the representation"))
+    return out
+
+
+# -- R12: control-plane retry loops without backoff+jitter --------------------
+
+# Scope: the layers whose retry loops hit the discovery store / event
+# plane — the watch pumps, heartbeat/keepalive loops, lease renewal and
+# scrape loops. The churn-storm failure mode is collective: one loop
+# retrying hot is a nuisance, a THOUSAND of them synchronized by the
+# same outage is a thundering herd that keeps the store down. A loop is
+# a *retry loop* when (a) it is a `while` loop that (b) contains an
+# exception handler that does not re-raise (the loop survives failures
+# and goes around again) and (c) touches a control-plane reconnect /
+# renewal target. The sanctioned fix is runtime/backoff.py (any name
+# containing "backoff" in the loop body counts); a deliberately
+# fixed-cadence loop (TTL-paced heartbeat, fixed-interval scrape)
+# carries `# dynalint: backoff-ok=<reason>` on the `while` line or the
+# line above.
+_R12_SCOPE = ("runtime/", "frontend/", "kv_router/")
+_R12_TARGETS = {
+    "watch_prefix", "subscribe", "grant_lease", "keep_alive",
+    "scrape_once", "scrape_stats", "_rpc", "lease_keepalive", "register",
+}
+_R12_ANNOT_RE = re.compile(r"#\s*dynalint:\s*backoff-ok=\S+")
+_R12_BACKOFF_RE = re.compile(r"backoff", re.I)
+
+
+def _loop_own_nodes(loop: ast.While):
+    """Nodes in the loop's own body, not descending into nested
+    function/class definitions (their loops are their own problem)."""
+    stack = list(loop.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@rule("R12")
+def r12_retry_loop_without_backoff(tree: ast.AST, lines: List[str],
+                                   path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R12_SCOPE):
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R12_ANNOT_RE.search(_line(lines, x))
+                   for x in (ln, ln - 1))
+
+    out: List[Finding] = []
+    for loop in ast.walk(tree):
+        if not isinstance(loop, ast.While):
+            continue
+        survives = False
+        target = None
+        has_backoff = False
+        for node in _loop_own_nodes(loop):
+            if isinstance(node, ast.ExceptHandler) \
+                    and not _handler_reraises(node):
+                survives = True
+            if isinstance(node, ast.Call):
+                terminal = _call_name(node).rsplit(".", 1)[-1]
+                if terminal in _R12_TARGETS:
+                    target = target or terminal
+            if isinstance(node, ast.Name) \
+                    and _R12_BACKOFF_RE.search(node.id):
+                has_backoff = True
+            if isinstance(node, ast.Attribute) \
+                    and _R12_BACKOFF_RE.search(node.attr):
+                has_backoff = True
+        if not (survives and target) or has_backoff:
+            continue
+        if annotated(loop.lineno):
+            continue
+        out.append(_finding(
+            "R12", path, lines, loop,
+            f"control-plane retry loop around `{target}` survives "
+            "failures with no backoff+jitter — under a storm, every "
+            "worker running this loop retries in the SAME synchronized "
+            "wave, hammering the store that is trying to recover",
+            "drive the retry delay through runtime/backoff.py (bounded "
+            "exponential + seeded jitter + flap hysteresis), or "
+            "annotate the loop with `# dynalint: backoff-ok=<why a "
+            "fixed cadence is correct here>`"))
     return out
 
 

@@ -45,9 +45,14 @@ class DisaggregatedRouter:
         snapshot, events = await kv.watch_prefix(key)
         for entry in snapshot:
             self._apply(entry.value)
-        async for ev in events:
-            if ev.kind == "put" and ev.value is not None:
-                self._apply(ev.value)
+        try:
+            async for ev in events:
+                if ev.kind == "put" and ev.value is not None:
+                    self._apply(ev.value)
+        finally:
+            # deterministic watcher teardown (WatchStream no longer
+            # relies on generator GC finalization)
+            await events.aclose()
 
     def start_watching(self, kv) -> asyncio.Task:
         return asyncio.create_task(self.watch_config(kv))

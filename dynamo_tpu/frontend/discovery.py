@@ -83,6 +83,7 @@ class ModelWatcher:
         self.reliability_policy = reliability_policy
         self._task: Optional[asyncio.Task] = None
         self._owned: Dict[str, tuple] = {}  # key -> (client, router)
+        self._values: Dict[str, bytes] = {}  # key -> last applied payload
         # one reliability-snapshot publisher per namespace served: the
         # standalone exporter (observability/exporter.py) subscribes
         # "{ns}.>" and folds "{ns}.frontend.reliability" snapshots into
@@ -90,22 +91,77 @@ class ModelWatcher:
         self._rel_publishers: Dict[str, asyncio.Task] = {}
 
     async def start(self) -> "ModelWatcher":
-        snapshot, events = await self.runtime.kv.watch_prefix(MODELS_PREFIX)
+        snapshot, stream = await self.runtime.kv.watch_prefix(MODELS_PREFIX)
         for e in snapshot:
             await self._on_put(e.key, e.value)
-
-        async def pump():
-            async for ev in events:
-                try:
-                    if ev.kind == "put":
-                        await self._on_put(ev.key, ev.value)
-                    else:
-                        await self._on_delete(ev.key)
-                except Exception:  # dynalint: swallow-ok=watch-pump-must-outlive-bad-event
-                    log.exception("model watch event failed: %s", ev.key)
-
-        self._task = asyncio.create_task(pump())
+        self._task = asyncio.create_task(self._pump(stream))
         return self
+
+    async def _pump(self, stream) -> None:
+        """Model-registry watch pump: per-tick batched application (a
+        re-registration storm coalesces to one rebuild per key), and on
+        watch-stream failure resumes with bounded backoff + jitter and a
+        full snapshot resync instead of dying silently."""
+        from dynamo_tpu.runtime.backoff import Backoff
+        backoff = Backoff(base_s=0.05, max_s=2.0, stable_reset_s=10.0)
+        try:
+            while True:
+                try:
+                    batch = await stream.next_batch()
+                    # coalesce per key: only the FINAL state of a key
+                    # this tick is applied (N flaps -> one rebuild)
+                    final = {}
+                    for ev in batch:
+                        final[ev.key] = ev
+                    for ev in final.values():
+                        await self._dispatch(ev.kind, ev.key, ev.value)
+                    backoff.reset()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.warning("model watch stream failed; resuming with "
+                                "resync", exc_info=True)
+                    try:
+                        await stream.aclose()
+                    except Exception:  # dynalint: swallow-ok=old-stream-best-effort-close
+                        pass
+                    await backoff.sleep()
+                    try:
+                        snapshot, stream = await self.runtime.kv.watch_prefix(
+                            MODELS_PREFIX)
+                    except Exception:  # dynalint: swallow-ok=store-unavailable-window-retried-next-backoff-round
+                        log.warning("model watch re-establish failed",
+                                    exc_info=True)
+                        continue
+                    await self._resync(snapshot)
+        finally:
+            try:
+                await stream.aclose()
+            except Exception:  # dynalint: swallow-ok=teardown-best-effort-close
+                pass
+
+    async def _dispatch(self, kind: str, key: str,
+                        value: Optional[bytes]) -> None:
+        try:
+            if kind == "put":
+                await self._on_put(key, value)
+            else:
+                await self._on_delete(key)
+        except Exception:  # dynalint: swallow-ok=watch-pump-must-outlive-bad-event
+            log.exception("model watch event failed: %s", key)
+
+    async def _resync(self, snapshot) -> None:
+        """Reconcile the model registry after a watch gap. Unchanged keys
+        (same payload bytes) are skipped — a resync storm must not tear
+        down and rebuild every live pipeline."""
+        seen = set()
+        for e in snapshot:
+            seen.add(e.key)
+            if self._values.get(e.key) == e.value:
+                continue
+            await self._dispatch("put", e.key, e.value)
+        for key in [k for k in self._owned if k not in seen]:
+            await self._dispatch("delete", key, None)
 
     async def stop(self) -> None:
         if self._task:
@@ -153,6 +209,7 @@ class ModelWatcher:
                                   reliability=reliable)
         self.models.add(info["name"], pipeline, info.get("model_type", "chat"))
         self._owned[key] = (client, router)
+        self._values[key] = value
         log.info("model registered: %s -> %s/%s/%s%s", info["name"],
                  info["namespace"], info["component"], info["endpoint"],
                  " [kv-routed]" if router else "")
@@ -164,6 +221,7 @@ class ModelWatcher:
             # still be registered under the other type (separate KV key)
             self.models.remove(parts[1], model_type=parts[0])
         owned = self._owned.pop(key, None)
+        self._values.pop(key, None)
         if owned:
             client, router = owned
             if router is not None:

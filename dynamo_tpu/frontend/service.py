@@ -124,6 +124,14 @@ class HttpService:
                           "(wire representation)")
             for name in ("bytes_sent", "pages_sent", "fetches",
                          "bytes_fetched")}
+        # control-plane health (runtime/cpstats.py CP_STATS): watch
+        # queue depth + coalescing, indexer size + eviction backlog,
+        # event-plane lag, and the router's stale-snapshot degraded flag
+        from dynamo_tpu.runtime.cpstats import ControlPlaneStats
+        self._cp = {
+            name: m.gauge(f"llm_cp_{name}",
+                          f"control plane: {name.replace('_', ' ')}")
+            for name in ControlPlaneStats.FIELDS}
         s = self.server
         s.route("POST", "/v1/chat/completions", self._chat)
         s.route("POST", "/v1/completions", self._completions)
@@ -180,6 +188,9 @@ class HttpService:
         for name, value in XFER_STATS.snapshot().items():
             if name in self._kv_xfer:
                 self._kv_xfer[name].set(value=value)
+        from dynamo_tpu.runtime.cpstats import CP_STATS
+        for name, value in CP_STATS.snapshot().items():
+            self._cp[name].set(value=float(value))
 
     async def _chat(self, req: Request):
         try:

@@ -20,13 +20,23 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional, Sequence
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import xxhash
 
 from dynamo_tpu.kv_router.protocols import (
     KvCacheRemoveData, KvCacheStoreData, RouterEvent, compute_page_hashes,
 )
+from dynamo_tpu.runtime.cpstats import CP_STATS
+
+# incremental-eviction budgets: a dead 100k-node worker must not stall
+# find_matches for the whole purge. remove_worker() processes one
+# EVICT_CHUNK synchronously (small workers behave exactly as before);
+# the rest drains EVICT_AMORTIZE nodes per subsequent apply_event /
+# find_matches call, so eviction cost is amortized across the very
+# traffic that needs the tree responsive.
+EVICT_CHUNK = 512
+EVICT_AMORTIZE = 64
 
 
 @dataclasses.dataclass
@@ -61,6 +71,12 @@ class RadixTree:
         # worker_id -> {block_hash -> node}
         self.lookup: Dict[str, Dict[int, _Node]] = {}
         self.expiration_s = expiration_duration_s
+        self.node_count = 0
+        # incremental eviction state: worker -> pending (block_hash, node)
+        # pairs still holding that worker's entries. While a worker is
+        # here, find_matches filters it from scores — the tree answers as
+        # if the purge already finished, the WORK is what's amortized.
+        self._evicting: Dict[str, Deque[Tuple[int, "_Node"]]] = {}
 
     # -- matching ------------------------------------------------------------
 
@@ -73,6 +89,8 @@ class RadixTree:
         (reference indexer.rs:239-275 walks exactly this way: the walk stops
         at the first page no worker holds).
         """
+        if self._evicting:
+            self.process_evictions(EVICT_AMORTIZE)
         result = MatchResult()
         node = self.root
         for h in page_hashes:
@@ -89,6 +107,13 @@ class RadixTree:
                 result.frequencies.append(len(node.recent_uses))
             if early_exit and len(node.workers) == 1:
                 break
+        if self._evicting:
+            # a mid-eviction worker's leftover entries must not score:
+            # the router would route onto the corpse the purge exists
+            # to remove (this filter is what makes chunked eviction
+            # OBSERVABLY identical to the old synchronous purge)
+            for worker in self._evicting:
+                result.scores.pop(worker, None)
         return result
 
     def _expire(self, node: _Node, now: float) -> None:
@@ -99,6 +124,8 @@ class RadixTree:
     # -- event application ---------------------------------------------------
 
     def apply_event(self, event: RouterEvent) -> None:
+        if self._evicting:
+            self.process_evictions(EVICT_AMORTIZE)
         worker = event.worker_id
         data = event.event.data
         table = self.lookup.setdefault(worker, {})
@@ -117,6 +144,7 @@ class RadixTree:
                 if child is None:
                     child = _Node(blk.tokens_hash, node)
                     node.children[blk.tokens_hash] = child
+                    self.node_count += 1
                 # re-store under a new block_hash: drop the stale mapping
                 # (invariant: table entries are {bh: node.workers[w]==bh})
                 old = child.workers.get(worker)
@@ -140,31 +168,73 @@ class RadixTree:
             parent = node.parent
             if parent.children.get(node.tokens_hash) is node:
                 del parent.children[node.tokens_hash]
+                self.node_count -= 1
             node = parent
 
     def remove_worker(self, worker: str) -> None:
+        """Queue the worker's entries for incremental eviction and
+        process one bounded chunk now. Small workers finish here (the
+        pre-storm behavior); a 100k-node worker leaves a backlog that
+        drains EVICT_AMORTIZE nodes per apply_event/find_matches (or via
+        process_evictions) — meanwhile find_matches already answers as
+        if the purge completed."""
         table = self.lookup.pop(worker, None)
         if not table:
             return
-        for node in set(table.values()):
-            node.workers.pop(worker, None)
-            self._maybe_prune(node)
+        items = deque(table.items())
+        dq = self._evicting.get(worker)
+        if dq is None:
+            self._evicting[worker] = items
+        else:
+            dq.extend(items)
+        self.process_evictions(EVICT_CHUNK)
+
+    def process_evictions(self, budget: int = EVICT_CHUNK) -> int:
+        """Drain up to `budget` pending eviction entries; returns the
+        number processed. The block-hash guard makes a pending entry a
+        no-op when the node's entry no longer belongs to the evicted
+        generation (the worker re-stored through clear_all_blocks)."""
+        done = 0
+        while budget > 0 and self._evicting:
+            worker, dq = next(iter(self._evicting.items()))
+            while dq and budget > 0:
+                bh, node = dq.popleft()
+                if node.workers.get(worker) == bh:
+                    del node.workers[worker]
+                    self._maybe_prune(node)
+                done += 1
+                budget -= 1
+            if not dq:
+                del self._evicting[worker]
+        return done
+
+    def finish_eviction(self, worker: str) -> None:
+        """Synchronously drain this worker's pending eviction (the
+        revive path: a worker coming BACK must not stay hidden behind
+        the find_matches eviction filter)."""
+        dq = self._evicting.pop(worker, None)
+        if not dq:
+            return
+        for bh, node in dq:
+            if node.workers.get(worker) == bh:
+                del node.workers[worker]
+                self._maybe_prune(node)
+
+    def eviction_backlog(self) -> int:
+        return sum(len(dq) for dq in self._evicting.values())
 
     def clear_all_blocks(self, worker: str) -> None:
         """Worker restarted with an empty cache: drop its pages, keep it known."""
         self.remove_worker(worker)
+        self.finish_eviction(worker)
         self.lookup[worker] = {}
 
     # -- introspection -------------------------------------------------------
 
     def num_nodes(self) -> int:
-        count = 0
-        stack = [self.root]
-        while stack:
-            n = stack.pop()
-            count += 1
-            stack.extend(n.children.values())
-        return count - 1  # exclude root
+        # O(1): maintained at node create/prune (a periodic /metrics
+        # refresh over a 100k-node tree cannot afford the full walk)
+        return self.node_count
 
     def worker_block_count(self, worker: str) -> int:
         return len(self.lookup.get(worker, {}))
@@ -205,10 +275,18 @@ class KvIndexer:
             return
         self.tree.apply_event(event)
         self.events_applied += 1
+        if self.events_applied % 256 == 0:
+            self._refresh_cp_stats()
 
     def revive_worker(self, worker: str) -> None:
-        """A worker id re-appeared live (restart): accept its events again."""
+        """A worker id re-appeared live (restart): accept its events
+        again — and drain any eviction still pending against its old
+        generation, so the find_matches eviction filter cannot hide the
+        revived worker's fresh pages."""
         self._removed.discard(worker)
+        finish = getattr(self.tree, "finish_eviction", None)
+        if finish is not None:
+            finish(worker)
 
     def apply_raw(self, msg: dict) -> None:
         self.apply_event(RouterEvent.unpack(msg))
@@ -223,6 +301,27 @@ class KvIndexer:
     def remove_worker(self, worker: str) -> None:
         self._removed.add(worker)
         self.tree.remove_worker(worker)
+        self._refresh_cp_stats()
+
+    def process_evictions(self, budget: int = EVICT_CHUNK) -> int:
+        """Drain pending incremental evictions (no-op on the native
+        tree, whose remove_worker is synchronous C)."""
+        proc = getattr(self.tree, "process_evictions", None)
+        done = proc(budget) if proc is not None else 0
+        if done:
+            self._refresh_cp_stats()
+        return done
+
+    def eviction_backlog(self) -> int:
+        backlog = getattr(self.tree, "eviction_backlog", None)
+        return backlog() if backlog is not None else 0
+
+    def num_nodes(self) -> int:
+        return self.tree.num_nodes()
+
+    def _refresh_cp_stats(self) -> None:
+        CP_STATS.indexer_nodes = self.tree.num_nodes()
+        CP_STATS.indexer_eviction_backlog = self.eviction_backlog()
 
 
 class KvIndexerSharded:
@@ -270,3 +369,15 @@ class KvIndexerSharded:
 
     def revive_worker(self, worker: str) -> None:
         self._shard_for(worker).revive_worker(worker)
+
+    def process_evictions(self, budget: int = EVICT_CHUNK) -> int:
+        done = 0
+        for shard in self.shards:
+            done += shard.process_evictions(budget)
+        return done
+
+    def eviction_backlog(self) -> int:
+        return sum(s.eviction_backlog() for s in self.shards)
+
+    def num_nodes(self) -> int:
+        return sum(s.num_nodes() for s in self.shards)

@@ -62,6 +62,11 @@ class KvCacheEvent:
 class RouterEvent:
     worker_id: str
     event: KvCacheEvent
+    # publish-time unix timestamp (time.time()). Optional for wire
+    # compat; when present the router derives its event-plane LAG
+    # (now - ts at apply time), which drives the stale-snapshot
+    # degraded mode and the llm_cp_event_lag_seconds gauge.
+    ts: Optional[float] = None
 
     def pack(self) -> dict:
         d = self.event.data
@@ -70,8 +75,11 @@ class RouterEvent:
                     "blocks": [[b.block_hash, b.tokens_hash] for b in d.blocks]}
         else:
             data = {"kind": "removed", "block_hashes": list(d.block_hashes)}
-        return {"worker_id": self.worker_id,
-                "event_id": self.event.event_id, "data": data}
+        out = {"worker_id": self.worker_id,
+               "event_id": self.event.event_id, "data": data}
+        if self.ts is not None:
+            out["ts"] = self.ts
+        return out
 
     @classmethod
     def unpack(cls, msg: dict) -> "RouterEvent":
@@ -83,4 +91,5 @@ class RouterEvent:
         else:
             data = KvCacheRemoveData(block_hashes=list(d["block_hashes"]))
         return cls(worker_id=msg["worker_id"],
-                   event=KvCacheEvent(event_id=msg["event_id"], data=data))
+                   event=KvCacheEvent(event_id=msg["event_id"], data=data),
+                   ts=msg.get("ts"))

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import time
 from typing import Dict, Optional
 
 from dynamo_tpu.kv_router.protocols import (
@@ -61,7 +62,8 @@ class KvEventPublisher:
                     batches.append(KvCacheRemoveData(block_hashes=[seq_hash]))
         for data in batches:
             ev = RouterEvent(self.worker_id,
-                             KvCacheEvent(self._event_id, data))
+                             KvCacheEvent(self._event_id, data),
+                             ts=time.time())
             self._event_id += 1
             await self.component.publish(KV_EVENTS_SUBJECT, ev.pack())
         return len(batches)
@@ -70,13 +72,15 @@ class KvEventPublisher:
         data = KvCacheStoreData(
             parent_hash=parent_hash,
             blocks=[KvCacheStoredBlockData(bh, th) for bh, th in blocks])
-        ev = RouterEvent(self.worker_id, KvCacheEvent(self._event_id, data))
+        ev = RouterEvent(self.worker_id, KvCacheEvent(self._event_id, data),
+                         ts=time.time())
         self._event_id += 1
         await self.component.publish(KV_EVENTS_SUBJECT, ev.pack())
 
     async def publish_removed(self, block_hashes) -> None:
         ev = RouterEvent(self.worker_id, KvCacheEvent(
-            self._event_id, KvCacheRemoveData(list(block_hashes))))
+            self._event_id, KvCacheRemoveData(list(block_hashes))),
+            ts=time.time())
         self._event_id += 1
         await self.component.publish(KV_EVENTS_SUBJECT, ev.pack())
 
@@ -155,6 +159,7 @@ class KvMetricsAggregator:
 
     async def start(self) -> None:
         async def loop():
+            # dynalint: backoff-ok=fixed-interval scrape; a failed cycle is logged and the next tick retries at the same cadence (no reconnect amplification: scrape fan-out is bounded by the fleet)
             while True:
                 try:
                     await self.scrape_once()

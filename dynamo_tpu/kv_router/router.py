@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional, Sequence
 
 from dynamo_tpu.kv_router.indexer import KvIndexer, MatchResult
@@ -18,6 +19,8 @@ from dynamo_tpu.kv_router.publisher import (
     KV_EVENTS_SUBJECT, KV_HIT_RATE_SUBJECT, KvMetricsAggregator,
 )
 from dynamo_tpu.kv_router.scheduler import KvScheduler, WorkerSelector
+from dynamo_tpu.runtime.backoff import Backoff
+from dynamo_tpu.runtime.cpstats import CP_STATS
 
 log = logging.getLogger("dynamo_tpu.kv_router")
 
@@ -26,7 +29,21 @@ class KvRouter:
     def __init__(self, component, worker_client, block_size: int,
                  selector: Optional[WorkerSelector] = None,
                  scrape_interval_s: float = 0.5,
-                 publish_hit_events: bool = False):
+                 publish_hit_events: bool = False,
+                 degraded_lag_s: float = 2.0,
+                 degraded_backlog: int = 10_000,
+                 degraded_min_s: float = 1.0,
+                 event_batch: int = 2048):
+        """degraded_lag_s / degraded_backlog: thresholds for the
+        STALE-SNAPSHOT DEGRADED MODE. Prefix scores are advisory — when
+        the event plane lags (publish ts → apply time) past
+        degraded_lag_s, or the event backlog passes degraded_backlog,
+        the router keeps scheduling on its last-good prefix scores +
+        load metrics and REPORTS the staleness (self.degraded,
+        llm_cp_router_degraded) instead of blocking requests behind
+        event application. Exit uses half-threshold hysteresis plus a
+        degraded_min_s dwell so the gaps BETWEEN a lag storm's delayed
+        bursts can't flap the flag."""
         self.component = component
         self.client = worker_client
         self.block_size = block_size
@@ -34,21 +51,32 @@ class KvRouter:
         self.scheduler = KvScheduler(block_size, selector)
         self.aggregator = KvMetricsAggregator(worker_client, scrape_interval_s)
         self.publish_hit_events = publish_hit_events
+        self.degraded_lag_s = degraded_lag_s
+        self.degraded_backlog = degraded_backlog
+        self.degraded_min_s = degraded_min_s
+        self.event_batch = event_batch
+        self.degraded = False
+        self.degraded_entries = 0
+        self._degraded_since = 0.0
+        self.event_lag_s = 0.0
+        self.events_applied = 0
         self._event_task: Optional[asyncio.Task] = None
 
     async def start(self) -> "KvRouter":
-        sub = await self.component.subscribe(KV_EVENTS_SUBJECT)
-
-        async def pump():
-            async for _subj, msg in sub:
-                try:
-                    self.indexer.apply_event(RouterEvent.unpack(msg))
-                except Exception:
-                    log.exception("bad kv event: %r", msg)
-
-        self._event_task = asyncio.create_task(pump())
+        stream = await self.component.subscribe(KV_EVENTS_SUBJECT)
+        self._event_task = asyncio.create_task(self._event_pump(stream))
 
         def on_metrics(endpoints, removed):
+            # fence re-check: a scrape that RACED a death can still carry
+            # the dead worker (it answered $STATS just before its key
+            # vanished), and update_endpoints swaps the whole snapshot —
+            # without this filter the corpse re-enters scheduling until
+            # the next scrape. The client's watch state is authoritative.
+            instances = getattr(self.client, "instances", None)
+            if instances is not None:
+                for worker_id in [w for w in endpoints.workers
+                                  if w not in instances]:
+                    del endpoints.workers[worker_id]
             self.scheduler.update_endpoints(endpoints)
             for worker_id in removed:
                 self.indexer.remove_worker(worker_id)
@@ -82,6 +110,80 @@ class KvRouter:
             self.client.add_listener(on_instance)
         await self.aggregator.start()
         return self
+
+    async def _event_pump(self, stream) -> None:
+        """Event-plane consumer with backpressure accounting.
+
+        Events apply in per-tick batches bounded by event_batch, with a
+        yield between batches so schedule() calls interleave instead of
+        starving behind a storm. Lag = now - newest applied event's
+        publish ts; an idle tick (no events, empty backlog) means the
+        pump is caught up, so lag resets. The pump survives stream death
+        the same way the watch pumps do: bounded backoff + resubscribe
+        (prefix state needs no resync — the instance watch evicts dead
+        workers, and missed Stored events only cost routing optimality)."""
+        backoff = Backoff(base_s=0.05, max_s=2.0, stable_reset_s=10.0)
+        idle_s = 0.25
+        while True:
+            try:
+                batch = await stream.next_batch(self.event_batch,
+                                                timeout=idle_s)
+                now = time.time()
+                for _subj, msg in batch:
+                    try:
+                        ev = RouterEvent.unpack(msg)
+                        self.indexer.apply_event(ev)
+                        self.events_applied += 1
+                        if ev.ts is not None:
+                            self.event_lag_s = max(0.0, now - ev.ts)
+                    except Exception:
+                        log.exception("bad kv event: %r", msg)
+                backlog = stream.depth()
+                if not batch and backlog == 0:
+                    self.event_lag_s = 0.0   # caught up and idle
+                self._update_degraded(backlog)
+                backoff.reset()
+                if batch:
+                    await asyncio.sleep(0)   # let schedule() interleave
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.warning("kv event stream failed; resubscribing",
+                            exc_info=True)
+                try:
+                    await stream.aclose()
+                except Exception:  # dynalint: swallow-ok=old-stream-best-effort-close
+                    pass
+                await backoff.sleep()
+                try:
+                    stream = await self.component.subscribe(
+                        KV_EVENTS_SUBJECT)
+                except Exception:
+                    log.warning("kv event resubscribe failed",
+                                exc_info=True)
+
+    def _update_degraded(self, backlog: int) -> None:
+        lag = self.event_lag_s
+        if not self.degraded:
+            if lag > self.degraded_lag_s or backlog > self.degraded_backlog:
+                self.degraded = True
+                self.degraded_entries += 1
+                self._degraded_since = time.monotonic()
+                log.warning(
+                    "kv_router entering stale-snapshot degraded mode "
+                    "(event lag %.2fs, backlog %d): scheduling continues "
+                    "on last-good prefix scores + load", lag, backlog)
+        elif lag < self.degraded_lag_s / 2 \
+                and backlog < self.degraded_backlog / 2 \
+                and time.monotonic() - self._degraded_since \
+                >= self.degraded_min_s:
+            self.degraded = False
+            log.info("kv_router exited degraded mode (event lag %.2fs, "
+                     "backlog %d)", lag, backlog)
+        CP_STATS.event_lag_seconds = lag
+        CP_STATS.event_backlog = backlog
+        CP_STATS.router_degraded = int(self.degraded)
+        CP_STATS.router_degraded_entries = self.degraded_entries
 
     async def stop(self) -> None:
         if self._event_task:

@@ -131,6 +131,15 @@ class MetricsExporter:
                           f"reliability layer: cumulative {name} "
                           "at the publishing frontend", ("source",))
             for name in ReliabilityMetrics.FIELDS}
+        # control-plane health of THIS exporter process (its own Client
+        # watch + aggregator — the same watch fan-out every frontend
+        # runs, so its lag/resync counters are a representative canary);
+        # refreshed from runtime/cpstats.py CP_STATS at render time
+        from dynamo_tpu.runtime.cpstats import ControlPlaneStats
+        self.g_cp = {
+            name: r.gauge(f"{PREFIX}_cp_{name}",
+                          f"control plane: {name.replace('_', ' ')}")
+            for name in ControlPlaneStats.FIELDS}
         self._client = None
         self._aggregator: Optional[KvMetricsAggregator] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -255,6 +264,15 @@ class MetricsExporter:
                         value=self._hit_overlap / self._hit_isl)
         except asyncio.CancelledError:
             pass
+        finally:
+            aclose = getattr(sub, "aclose", None)
+            if aclose is not None:
+                await aclose()
+
+    def _refresh_cp_gauges(self) -> None:
+        from dynamo_tpu.runtime.cpstats import CP_STATS
+        for name, value in CP_STATS.snapshot().items():
+            self.g_cp[name].set(value=float(value))
 
     # -- http -----------------------------------------------------------------
 
@@ -269,6 +287,7 @@ class MetricsExporter:
                     not in (b"\r\n", b"\n", b""):
                 pass  # drain headers
             if b"/metrics" in line:
+                self._refresh_cp_gauges()
                 body = self.registry.render().encode()
                 writer.write(
                     b"HTTP/1.1 200 OK\r\ncontent-type: text/plain; "

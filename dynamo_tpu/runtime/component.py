@@ -19,6 +19,8 @@ from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 import msgpack
 
 from dynamo_tpu.runtime import dataplane
+from dynamo_tpu.runtime.backoff import Backoff
+from dynamo_tpu.runtime.cpstats import CP_STATS
 from dynamo_tpu.runtime.deadline import with_deadline
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, FnEngine
 
@@ -69,6 +71,42 @@ def instance_subject(ns: str, comp: str, endpoint: str, worker_id: str) -> str:
     return f"{ns}|{comp}.{endpoint}-{worker_id}"
 
 
+class DecodedSubscription:
+    """msgpack-decoding view over a transport subscription stream that
+    PRESERVES the batching surface (next_batch/depth/aclose) — the
+    kv_router's event pump needs per-tick batches and the live backlog
+    for its lag/backpressure accounting, which a plain decoding
+    generator would hide."""
+
+    def __init__(self, raw):
+        self._raw = raw
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        subj, payload = await self._raw.__anext__()
+        return subj, msgpack.unpackb(payload, raw=False)
+
+    async def next_batch(self, max_items: int = 4096,
+                         timeout: Optional[float] = None) -> list:
+        nb = getattr(self._raw, "next_batch", None)
+        if nb is None:   # plain async-gen transport: batches of one
+            batch = [await self._raw.__anext__()]
+        else:
+            batch = await nb(max_items, timeout)
+        return [(s, msgpack.unpackb(p, raw=False)) for s, p in batch]
+
+    def depth(self) -> int:
+        d = getattr(self._raw, "depth", None)
+        return d() if d is not None else 0
+
+    async def aclose(self) -> None:
+        a = getattr(self._raw, "aclose", None)
+        if a is not None:
+            await a()
+
+
 class Namespace:
     def __init__(self, runtime, name: str):
         self._rt = runtime
@@ -86,13 +124,8 @@ class Namespace:
             self.event_subject(subject), msgpack.packb(payload))
 
     async def subscribe(self, subject: str):
-        gen = await self._rt.messaging.subscribe(self.event_subject(subject))
-
-        async def decoded():
-            async for subj, payload in gen:
-                yield subj, msgpack.unpackb(payload, raw=False)
-
-        return decoded()
+        return DecodedSubscription(await self._rt.messaging.subscribe(
+            self.event_subject(subject)))
 
 
 class Component:
@@ -117,14 +150,8 @@ class Component:
             f"{self.namespace.name}.{self.name}.{subject}", msgpack.packb(payload))
 
     async def subscribe(self, subject: str):
-        gen = await self._rt.messaging.subscribe(
-            f"{self.namespace.name}.{self.name}.{subject}")
-
-        async def decoded():
-            async for subj, payload in gen:
-                yield subj, msgpack.unpackb(payload, raw=False)
-
-        return decoded()
+        return DecodedSubscription(await self._rt.messaging.subscribe(
+            f"{self.namespace.name}.{self.name}.{subject}"))
 
     async def list_instances(self) -> List[Dict[str, Any]]:
         entries = await self._rt.kv.get_prefix(self.etcd_root + "/")
@@ -346,6 +373,13 @@ class Client:
         # circuit breaker trips)
         self._listeners: List[Callable[[str, str, Optional[dict]], None]] \
             = []
+        # cached ready/draining id lists: at 1000 instances a sorted
+        # full-fleet scan per schedule() call was a superlinear hot path
+        # (the router consults draining_ids on EVERY request); the cache
+        # invalidates on watch events, which is the only way state moves
+        self._ids_dirty = True
+        self._ready_cache: List[str] = []
+        self._draining_cache: List[str] = []
 
     def add_listener(self,
                      cb: Callable[[str, str, Optional[dict]], None]) -> None:
@@ -354,19 +388,85 @@ class Client:
         self._listeners.append(cb)
 
     async def start(self) -> "Client":
-        prefix = instance_key(self.endpoint.ns, self.endpoint.component.name,
-                              self.endpoint.name, "")
-        snapshot, events = await self._rt.kv.watch_prefix(prefix)
+        self._prefix = instance_key(self.endpoint.ns,
+                                    self.endpoint.component.name,
+                                    self.endpoint.name, "")
+        snapshot, stream = await self._rt.kv.watch_prefix(self._prefix)
         for e in snapshot:
             self._apply("put", e.key, e.value)
         self._ready.set()
-
-        async def pump():
-            async for ev in events:
-                self._apply(ev.kind, ev.key, ev.value)
-
-        self._watch_task = asyncio.create_task(pump())
+        self._watch_task = asyncio.create_task(self._watch_loop(stream))
         return self
+
+    async def _watch_loop(self, stream) -> None:
+        """Watch pump: applies events in per-tick BATCHES (a churn storm
+        of N events on one key costs one listener pass, not N), and on
+        watch-stream failure resumes with bounded backoff + jitter and a
+        full snapshot resync — a watcher may die, it must never die
+        SILENTLY (the pre-storm pump did exactly that: one exception and
+        the client served stale instances forever)."""
+        backoff = Backoff(base_s=0.05, max_s=2.0, stable_reset_s=10.0)
+        try:
+            while True:
+                try:
+                    batch = await stream.next_batch()
+                    CP_STATS.watch_queue_depth = stream.depth()
+                    self._apply_batch(batch)
+                    backoff.reset()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.warning("instance watch for %s failed; resuming "
+                                "with resync", self._prefix, exc_info=True)
+                    try:
+                        await stream.aclose()
+                    except Exception:  # dynalint: swallow-ok=old-stream-best-effort-close
+                        pass
+                    await backoff.sleep()
+                    try:
+                        snapshot, stream = await self._rt.kv.watch_prefix(
+                            self._prefix)
+                    except Exception:  # dynalint: swallow-ok=store-unavailable-window-retried-next-backoff-round
+                        log.warning("watch re-establish failed for %s",
+                                    self._prefix, exc_info=True)
+                        continue
+                    CP_STATS.watch_resyncs += 1
+                    self._resync(snapshot)
+        finally:
+            try:
+                await stream.aclose()
+            except Exception:  # dynalint: swallow-ok=teardown-best-effort-close
+                pass
+
+    def _apply_batch(self, events) -> None:
+        """Coalesce a tick's events per key (last state wins — put→delete
+        applies only the delete, flap→final applies only the final) and
+        apply once per key. Different keys are independent instance
+        states, so cross-key order is immaterial."""
+        if not events:
+            return
+        final: Dict[str, Any] = {}
+        for ev in events:
+            final[ev.key] = ev
+        CP_STATS.watch_events_applied += len(final)
+        CP_STATS.watch_events_coalesced += len(events) - len(final)
+        for ev in final.values():
+            self._apply(ev.kind, ev.key, ev.value)
+
+    def _resync(self, snapshot) -> None:
+        """Reconcile full state after a watch gap: deletes missed while
+        the stream was down MUST still fire listeners — the kv_router's
+        dead-worker eviction fence hangs off them."""
+        seen = set()
+        for e in snapshot:
+            seen.add(e.key.rsplit(":", 1)[-1])
+            self._apply("put", e.key, e.value)
+        gone = [w for w in self.instances if w not in seen]
+        for worker_id in gone:
+            self._apply("delete", self.endpoint.key_for(worker_id), None)
+        # resync-recovered state counts as applied events (they replace
+        # the deliveries lost with the dead stream)
+        CP_STATS.watch_events_applied += len(snapshot) + len(gone)
 
     def _apply(self, kind: str, key: str, value: Optional[bytes]):
         worker_id = key.rsplit(":", 1)[-1]
@@ -379,6 +479,7 @@ class Client:
             self.instances[worker_id] = info
         elif kind == "delete":
             self.instances.pop(worker_id, None)
+        self._ids_dirty = True
         for cb in self._listeners:
             try:
                 cb(kind, worker_id, info)
@@ -393,21 +494,35 @@ class Client:
                     f"no instances of {self.endpoint.subject_for('*')}")
             await asyncio.sleep(0.02)
 
+    def _recompute_ids(self) -> None:
+        ready: List[str] = []
+        draining: List[str] = []
+        for w in sorted(self.instances):
+            if instance_status(self.instances[w]) == STATUS_DRAINING:
+                draining.append(w)
+            else:
+                ready.append(w)
+        self._ready_cache, self._draining_cache = ready, draining
+        self._ids_dirty = False
+
     def instance_ids(self, include_draining: bool = False) -> List[str]:
         """Dispatchable instance ids. DRAINING instances are excluded —
         planned maintenance must attract no new assignments — UNLESS
         every live instance is draining (a probe on a draining-but-alive
         worker beats failing the request outright, the same fallback
-        shape as the circuit breaker's all-ejected case)."""
+        shape as the circuit breaker's all-ejected case). Returns the
+        watch-maintained cache: callers must not mutate it."""
         if include_draining:
             return sorted(self.instances)
-        ready = sorted(w for w, info in self.instances.items()
-                       if instance_status(info) != STATUS_DRAINING)
-        return ready if ready else sorted(self.instances)
+        if self._ids_dirty:
+            self._recompute_ids()
+        return self._ready_cache if self._ready_cache \
+            else sorted(self.instances)
 
     def draining_ids(self) -> List[str]:
-        return sorted(w for w, info in self.instances.items()
-                      if instance_status(info) == STATUS_DRAINING)
+        if self._ids_dirty:
+            self._recompute_ids()
+        return self._draining_cache
 
     # -- routing -------------------------------------------------------------
 

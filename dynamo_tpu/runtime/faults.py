@@ -18,6 +18,27 @@ Sites (the catalog lives in docs/RESILIENCE.md):
     queue.dequeue               durable work-queue consumption
     discovery.heartbeat         lease keep-alive ticks
 
+Control-plane sites (the 1000-worker sim harness, runtime/simcluster.py,
+drives churn storms through these; PR 4 added the 7 data-plane sites
+above):
+
+    watch.stream                watch-event delivery into a watcher; a
+                                drop raises into the consumer's pump —
+                                the watch-stream-disconnect model (the
+                                pump must resume + resync, not die)
+    discovery.store             discovery-store op (get/put/delete/
+                                get_prefix) during an unavailable window
+                                — the etcd-quorum-loss model
+    lease.expiry                lease watchdog tick; a drop force-expires
+                                the lease NOW (seeded p over a fleet =
+                                a lease-expiry burst)
+    event.plane                 per-subscriber event delivery; delay is
+                                applied ASYNCHRONOUSLY (call_later), so
+                                delayed events arrive late AND out of
+                                order — the event-plane lag/reorder
+                                model; drop loses the event, duplicate
+                                doubles it
+
 Fault kinds: ``drop`` (the op raises FaultInjected, a ConnectionError —
 the recovery layers treat it as any transport death), ``delay`` (seeded
 jitter up to delay_s), ``corrupt`` (flip nbytes seeded byte positions in
@@ -46,6 +67,11 @@ SITES = (
     "offload.read_tier",
     "queue.dequeue",
     "discovery.heartbeat",
+    # control-plane sites (this PR's scale harness)
+    "watch.stream",
+    "discovery.store",
+    "lease.expiry",
+    "event.plane",
 )
 
 KINDS = ("drop", "delay", "corrupt", "duplicate", "fail_n")
@@ -225,6 +251,13 @@ class FaultRegistry:
         return out
 
     # -- site hooks -----------------------------------------------------------
+
+    def decide(self, site: str) -> Optional[Outcome]:
+        """Call-site-managed outcome: no sleep, no raise — the site
+        applies drop/delay/duplicate itself (the event-plane delivery
+        path uses this to schedule DELAYED puts instead of blocking the
+        publisher, which is what makes injected lag also reorder)."""
+        return self._decide(site)
 
     async def fire(self, site: str) -> Outcome:
         """Async sites: apply delay, raise on drop, return the outcome

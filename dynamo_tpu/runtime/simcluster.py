@@ -1,0 +1,598 @@
+"""Simulated O(1000)-worker cluster on the in-process control plane.
+
+ROADMAP item 4: nothing validated that discovery, the radix prefix
+indexer, watch fan-out, event-plane metrics, and scheduling hold up past
+~4 workers. This module stands up a fleet of MOCK workers — no model, no
+data plane: each is an instance key under its own lease, a live $STATS
+responder, and a synthetic KV-event stream — plus one real `KvRouter` +
+`Client` on the other side, then drives seeded CHAOS STORMS through the
+control-plane failpoint sites (`runtime/faults.py`: watch.stream,
+discovery.store, lease.expiry, event.plane) and through direct fleet
+churn (rolling restarts, lease-expiry bursts) while a schedule-load
+generator measures latency and enforces the routing contracts:
+
+- **zero scheduling errors**: `KvRouter.schedule` never raises while
+  capacity exists;
+- **no corpse routing**: once a worker's delete/draining watch event has
+  been APPLIED (the client listener fired), schedule() never returns it;
+- **degraded-mode round trip**: an event-plane lag storm drives the
+  router into — and back out of — the stale-snapshot degraded mode with
+  no request errors.
+
+Everything is seeded: storm target selection is a pure function of the
+seed (`pick_storm_targets`), failpoint schedules are `FaultSchedule`s,
+and re-registration jitter draws from per-worker seeded rngs — the same
+plan replays the same storm. `tools/cluster_sim.py` is the CLI that runs
+the capacity ladder and commits `SCALE_r07.json`.
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import random
+import time
+from typing import Dict, List, Optional
+
+import msgpack
+
+from dynamo_tpu.kv_router.protocols import (
+    KvCacheEvent, KvCacheStoreData, KvCacheStoredBlockData, RouterEvent,
+    compute_page_hashes,
+)
+from dynamo_tpu.kv_router.publisher import KV_EVENTS_SUBJECT
+from dynamo_tpu.kv_router.router import KvRouter
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.backoff import Backoff
+from dynamo_tpu.runtime.component import STATUS_DRAINING
+from dynamo_tpu.runtime.cpstats import CP_STATS
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+log = logging.getLogger("dynamo_tpu.simcluster")
+
+
+@dataclasses.dataclass
+class SimConfig:
+    workers: int = 64
+    streams: int = 1024          # logical streams cycling through the load gen
+    prefix_families: int = 32    # distinct shared-prefix families (system prompts)
+    family_pages: int = 8        # full KV pages per family prefix
+    stores_per_worker: int = 4   # families each worker claims pages for
+    block_size: int = 16
+    lease_ttl_s: float = 3.0
+    scrape_interval_s: float = 0.5
+    degraded_lag_s: float = 0.75
+    seed: int = 0
+    namespace: str = "sim"
+    component: str = "worker"
+    endpoint: str = "generate"
+
+
+def pick_storm_targets(seed: int, worker_ids: List[str],
+                       fraction: float) -> List[str]:
+    """Deterministic storm membership + order: a pure function of
+    (seed, fleet, fraction) so a storm is replayable from its seed."""
+    rng = random.Random(seed)
+    ids = sorted(worker_ids)
+    rng.shuffle(ids)
+    count = max(1, int(len(ids) * fraction))
+    return ids[:count]
+
+
+def family_tokens(family: int, block_size: int, pages: int) -> List[int]:
+    """Deterministic token prefix for one shared-prefix family."""
+    return [(family * 977 + 31 * i) % 50000 for i in range(block_size * pages)]
+
+
+def percentile(sorted_vals: List[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1, int(p * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[k]
+
+
+class SimWorker:
+    """One mock worker: lease + instance key + $STATS responder +
+    synthetic KV events. Deliberately NOT a DistributedRuntime — a
+    thousand of those would each spawn lease-watch machinery the sim
+    drives centrally instead."""
+
+    def __init__(self, plane: MemoryPlane, cfg: SimConfig, worker_id: str,
+                 rng: random.Random):
+        self.plane = plane
+        self.cfg = cfg
+        self.worker_id = worker_id
+        self.rng = rng
+        self.lease = None
+        self._unserve_stats = None
+        self.alive = False          # heartbeat driver skips dead workers
+        self.generation = 0
+        self.backoff = Backoff(base_s=0.02, max_s=1.0, jitter=1.0,
+                               stable_reset_s=5.0,
+                               rng=random.Random(rng.randrange(1 << 30)))
+        self._event_id = 0
+
+    # -- discovery ------------------------------------------------------------
+
+    @property
+    def key(self) -> str:
+        c = self.cfg
+        return (f"{c.namespace}/components/{c.component}/"
+                f"{c.endpoint}:{self.worker_id}")
+
+    @property
+    def _subject(self) -> str:
+        c = self.cfg
+        return f"{c.namespace}|{c.component}.{c.endpoint}-{self.worker_id}"
+
+    def _info(self, status: Optional[str] = None) -> bytes:
+        c = self.cfg
+        info = {"namespace": c.namespace, "component": c.component,
+                "endpoint": c.endpoint, "worker_id": self.worker_id,
+                "subject": self._subject}
+        if status:
+            info["status"] = status
+        return json.dumps(info).encode()
+
+    async def _kv_retry(self, op, attempts: int = 8):
+        """Discovery ops ride out store-unavailable windows (the
+        discovery.store failpoint) with the worker's jittered backoff —
+        what a real worker's registration loop does."""
+        for i in range(attempts):
+            try:
+                return await op()
+            except ConnectionError:
+                if i == attempts - 1:
+                    raise
+                await self.backoff.sleep()
+
+    async def register(self) -> None:
+        self.lease = await self._kv_retry(
+            lambda: self.plane.kv.grant_lease(self.cfg.lease_ttl_s))
+        await self._kv_retry(
+            lambda: self.plane.kv.put(self.key, self._info(),
+                                      self.lease.id))
+
+        async def stats(_payload: bytes) -> bytes:
+            return msgpack.packb(self._stats())
+
+        self._unserve_stats = await self.plane.messaging.serve(
+            f"$STATS.{self._subject}", stats)
+        self.alive = True
+        self.generation += 1
+
+    def _stats(self) -> dict:
+        pages = self.cfg.family_pages * self.cfg.stores_per_worker
+        return {
+            "request_active_slots": self.rng.randrange(0, 8),
+            "request_total_slots": 8,
+            "kv_active_blocks": self.rng.randrange(0, pages + 1),
+            "kv_total_blocks": max(pages, 1) * 4,
+            "num_requests_waiting": 0,
+            "gpu_cache_usage_perc": self.rng.random() * 0.5,
+            "gpu_prefix_cache_hit_rate": self.rng.random(),
+        }
+
+    async def mark_draining(self) -> None:
+        await self._kv_retry(
+            lambda: self.plane.kv.put(self.key, self._info(STATUS_DRAINING),
+                                      self.lease.id if self.lease else 0))
+
+    async def deregister(self) -> None:
+        self.alive = False
+        await self._kv_retry(lambda: self.plane.kv.delete(self.key))
+        if self.lease is not None:
+            try:
+                await self.lease.revoke()
+            except ConnectionError:
+                pass   # store window: lease expiry covers the revoke
+            self.lease = None
+        if self._unserve_stats is not None:
+            await self._unserve_stats()
+            self._unserve_stats = None
+
+    def kill(self) -> None:
+        """Process death: heartbeats stop, the lease expires on its own
+        and the instance key vanishes through the lease-expiry path."""
+        self.alive = False
+
+    async def restart_with_jitter(self) -> float:
+        """Re-registration with seeded jitter + flap hysteresis: the
+        whole point is that a storm of restarts does NOT stampede
+        discovery in one synchronized wave."""
+        delay = self.backoff.next_delay()
+        await asyncio.sleep(delay)
+        await self.register()
+        return delay
+
+    # -- synthetic KV-event stream -------------------------------------------
+
+    async def publish_family_pages(self, families: List[int],
+                                   pages: Optional[int] = None) -> int:
+        """Publish Stored chains claiming the first `pages` pages of each
+        family prefix — the shape a real allocator emits after a prefill
+        of a shared system prompt."""
+        c = self.cfg
+        n_events = 0
+        for fam in families:
+            toks = family_tokens(fam, c.block_size, c.family_pages)
+            th = compute_page_hashes(toks, c.block_size)
+            depth = pages if pages is not None else c.family_pages
+            parent = None
+            blocks = []
+            for i in range(min(depth, len(th))):
+                # block hashes are worker-unique chained ids; generation
+                # salt keeps a restarted worker's chains distinct
+                bh = hash((self.worker_id, self.generation, fam, i)) \
+                    & 0x7FFFFFFFFFFFFFFF
+                blocks.append(KvCacheStoredBlockData(bh, th[i]))
+            ev = RouterEvent(
+                self.worker_id,
+                KvCacheEvent(self._event_id,
+                             KvCacheStoreData(parent_hash=parent,
+                                              blocks=blocks)),
+                ts=time.time())
+            self._event_id += 1
+            await self.plane.messaging.publish(
+                f"{c.namespace}.{c.component}.{KV_EVENTS_SUBJECT}",
+                msgpack.packb(ev.pack()))
+            n_events += 1
+        return n_events
+
+
+class SimCluster:
+    """The harness: fleet + router + load generator + storm drivers."""
+
+    def __init__(self, cfg: SimConfig):
+        self.cfg = cfg
+        self.rng = random.Random(cfg.seed)
+        self.plane = MemoryPlane()
+        self.workers: Dict[str, SimWorker] = {}
+        self.rt = None
+        self.client = None
+        self.router: Optional[KvRouter] = None
+        self._hb_task: Optional[asyncio.Task] = None
+        # contract accounting
+        self.schedule_errors = 0
+        self.dead_picks = 0           # schedule returned a fenced worker
+        self.schedule_calls = 0
+        self.latencies_us: List[float] = []
+        self._fenced: set = set()     # applied delete/draining fence
+        # logical streams: (family, distinct suffix salt)
+        self._streams = [(i % cfg.prefix_families, i)
+                         for i in range(cfg.streams)]
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def start(self) -> "SimCluster":
+        cfg = self.cfg
+        self.rt = await DistributedRuntime.create_local(self.plane,
+                                                        "sim-router")
+        comp = self.rt.namespace(cfg.namespace).component(cfg.component)
+        self.client = comp.endpoint(cfg.endpoint).client()
+        await self.client.start()
+
+        def on_instance(kind, worker_id, info):
+            # the dead/draining fence the routing contract is checked
+            # against: "after its watch event is applied" == after this
+            # listener ran
+            if kind == "delete":
+                self._fenced.add(worker_id)
+            elif info is not None and info.get("status") == STATUS_DRAINING:
+                self._fenced.add(worker_id)
+            else:
+                self._fenced.discard(worker_id)
+
+        self.client.add_listener(on_instance)
+        self.router = await KvRouter(
+            comp, self.client, cfg.block_size,
+            scrape_interval_s=cfg.scrape_interval_s,
+            degraded_lag_s=cfg.degraded_lag_s).start()
+
+        t0 = time.perf_counter()
+        ids = [f"w{i:04d}" for i in range(cfg.workers)]
+        for i in range(0, len(ids), 64):      # registration waves
+            wave = []
+            for wid in ids[i:i + 64]:
+                w = SimWorker(self.plane, cfg, wid,
+                              random.Random(self.rng.randrange(1 << 30)))
+                self.workers[wid] = w
+                wave.append(w.register())
+            await asyncio.gather(*wave)
+        self.register_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for i in range(0, len(ids), 64):
+            await asyncio.gather(*(
+                self._seed_events(self.workers[wid]) for wid in ids[i:i + 64]))
+        self.seed_events_s = time.perf_counter() - t0
+
+        self._hb_task = asyncio.create_task(self._heartbeat_driver())
+        await self.router.aggregator.scrape_once()
+        await self._drain_event_queue()
+        return self
+
+    async def _seed_events(self, w: SimWorker) -> None:
+        fams = [w.rng.randrange(self.cfg.prefix_families)
+                for _ in range(self.cfg.stores_per_worker)]
+        await w.publish_family_pages(fams)
+
+    async def _heartbeat_driver(self) -> None:
+        """One task heartbeats the whole fleet (a real fleet has one loop
+        per process; the sim centralizes them to stay at one task)."""
+        interval = self.cfg.lease_ttl_s / 3
+        while True:  # dynalint: backoff-ok=fixed-cadence heartbeat driver, paced by lease TTL
+            await asyncio.sleep(interval)
+            for w in list(self.workers.values()):
+                if w.alive and w.lease is not None:
+                    keep = getattr(w.lease, "keep_alive", None)
+                    if keep is not None:
+                        try:
+                            keep()
+                        except faults.FaultInjected:
+                            pass   # lost heartbeat: deadline not refreshed
+
+    async def _drain_event_queue(self, timeout_s: float = 5.0) -> None:
+        """Wait until the router has caught up with published events."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if CP_STATS.event_backlog == 0 and not self.router.degraded:
+                return
+            await asyncio.sleep(0.02)
+
+    async def stop(self) -> None:
+        if self._hb_task:
+            self._hb_task.cancel()
+        if self.router is not None:
+            await self.router.stop()
+        if self.client is not None:
+            await self.client.stop()
+        if self.rt is not None:
+            await self.rt.shutdown()
+
+    # -- load generation ------------------------------------------------------
+
+    def _stream_tokens(self, stream_idx: int) -> List[int]:
+        fam, salt = self._streams[stream_idx % len(self._streams)]
+        cfg = self.cfg
+        toks = family_tokens(fam, cfg.block_size, cfg.family_pages)
+        # per-stream divergent suffix (under one page: doesn't index)
+        return toks + [salt % 50000, (salt * 7) % 50000]
+
+    async def schedule_once(self, stream_idx: int) -> Optional[str]:
+        toks = self._stream_tokens(stream_idx)
+        t0 = time.perf_counter()
+        try:
+            pick = await self.router.schedule(toks)
+        except Exception:
+            self.schedule_errors += 1
+            log.exception("schedule failed for stream %d", stream_idx)
+            return None
+        finally:
+            self.schedule_calls += 1
+        self.latencies_us.append((time.perf_counter() - t0) * 1e6)
+        # contract: the fence reflects APPLIED watch events; a pick
+        # inside it means the router routed onto a known corpse
+        if pick in self._fenced:
+            self.dead_picks += 1
+            log.error("dead/draining worker %s picked post-fence", pick)
+        return pick
+
+    async def run_load(self, calls: int, concurrency: int = 32) -> dict:
+        """Run `calls` schedule decisions at bounded concurrency; the
+        per-call latency lands in self.latencies_us."""
+        rng = random.Random(self.rng.randrange(1 << 30))
+        sem = asyncio.Semaphore(concurrency)
+        before = len(self.latencies_us)
+
+        async def one(i: int):
+            async with sem:
+                await self.schedule_once(rng.randrange(len(self._streams)))
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one(i) for i in range(calls)))
+        wall = time.perf_counter() - t0
+        lat = sorted(self.latencies_us[before:])
+        return {"calls": calls, "wall_s": round(wall, 3),
+                "calls_per_s": round(calls / wall, 1) if wall else 0.0,
+                "p50_us": round(percentile(lat, 0.50), 1),
+                "p99_us": round(percentile(lat, 0.99), 1)}
+
+    # -- storms ---------------------------------------------------------------
+
+    async def storm_rolling_restart(self, fraction: float = 0.3,
+                                    batch: int = 8,
+                                    load_calls: int = 0) -> dict:
+        """Drain + deregister + jittered re-register a seeded fraction of
+        the fleet, `batch` workers at a time, optionally under schedule
+        load. Replacement workers re-register under the same id (a k8s
+        rolling update), exercising fence-then-revive end to end."""
+        targets = pick_storm_targets(self.rng.randrange(1 << 30),
+                                     list(self.workers), fraction)
+        load_task = (asyncio.create_task(self.run_load(load_calls))
+                     if load_calls else None)
+        t0 = time.perf_counter()
+        jitters: List[float] = []
+        for i in range(0, len(targets), batch):
+            group = [self.workers[w] for w in targets[i:i + batch]]
+            await asyncio.gather(*(w.mark_draining() for w in group))
+            await asyncio.sleep(0)           # let the watch tick land
+            await asyncio.gather(*(w.deregister() for w in group))
+
+            async def revive(w: SimWorker):
+                jitters.append(await w.restart_with_jitter())
+                await self._seed_events(w)
+
+            await asyncio.gather(*(revive(w) for w in group))
+        storm_s = time.perf_counter() - t0
+        if load_task is not None:
+            load = await load_task
+        else:
+            load = None
+        await self._drain_event_queue()
+        return {"targets": len(targets), "storm_s": round(storm_s, 3),
+                "mean_jitter_s": round(sum(jitters) / len(jitters), 4)
+                if jitters else 0.0,
+                "load": load,
+                "errors": self.schedule_errors,
+                "dead_picks": self.dead_picks}
+
+    async def storm_lease_expiry(self, fraction: float = 0.2,
+                                 load_calls: int = 0) -> dict:
+        """Kill heartbeats for a seeded fraction; their leases expire in
+        one burst (a mass watch-delete flood), then everyone restarts
+        with jittered, hysteresis-grown delays."""
+        targets = pick_storm_targets(self.rng.randrange(1 << 30),
+                                     list(self.workers), fraction)
+        load_task = (asyncio.create_task(self.run_load(load_calls))
+                     if load_calls else None)
+        for wid in targets:
+            self.workers[wid].kill()
+        # wait for the burst: every killed worker's key must vanish
+        deadline = time.monotonic() + self.cfg.lease_ttl_s * 4
+        while time.monotonic() < deadline:
+            if all(w not in self.client.instances for w in targets):
+                break
+            await asyncio.sleep(0.05)
+        expired = [w for w in targets if w not in self.client.instances]
+        await asyncio.gather(*(self.workers[w].restart_with_jitter()
+                               for w in targets))
+        for wid in targets:
+            await self._seed_events(self.workers[wid])
+        if load_task is not None:
+            await load_task
+        await self._drain_event_queue()
+        return {"targets": len(targets), "expired": len(expired),
+                "errors": self.schedule_errors,
+                "dead_picks": self.dead_picks}
+
+    async def storm_watch_disconnect(self, kills: int = 3,
+                                     load_calls: int = 0) -> dict:
+        """Arm the watch.stream failpoint to kill the next `kills` watch
+        deliveries; every watcher must resume with backoff + resync. The
+        convergence check registers fresh workers DURING the storm and
+        asserts the client sees the exact live fleet afterwards."""
+        resyncs_before = CP_STATS.watch_resyncs
+        faults.REGISTRY.arm("watch.stream", faults.FaultSchedule(
+            self.rng.randrange(1 << 30),
+            [faults.FaultSpec("fail_n", n=kills)]))
+        extra = []
+        for i in range(2):
+            wid = f"storm-extra-{len(self.workers) + i}"
+            w = SimWorker(self.plane, self.cfg, wid,
+                          random.Random(self.rng.randrange(1 << 30)))
+            self.workers[wid] = w
+            extra.append(w)
+        await asyncio.gather(*(w.register() for w in extra))
+        if load_calls:
+            await self.run_load(load_calls)
+        # convergence: the resumed watcher's resync must surface the
+        # extras even though their put events died with the stream
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if all(w.worker_id in self.client.instances for w in extra):
+                break
+            await asyncio.sleep(0.05)
+        faults.REGISTRY.disarm("watch.stream")
+        converged = all(w.worker_id in self.client.instances for w in extra)
+        return {"kills": kills,
+                "resyncs": CP_STATS.watch_resyncs - resyncs_before,
+                "converged": converged,
+                "errors": self.schedule_errors,
+                "dead_picks": self.dead_picks}
+
+    async def storm_event_lag(self, delay_s: float = 1.5,
+                              bursts: int = 4,
+                              load_calls: int = 0) -> dict:
+        """Arm event.plane delay so KV events arrive late (and out of
+        order); the router must enter the stale-snapshot degraded mode,
+        keep scheduling without errors, and exit once caught up."""
+        entries_before = self.router.degraded_entries
+        faults.REGISTRY.arm("event.plane", faults.FaultSchedule(
+            self.rng.randrange(1 << 30),
+            [faults.FaultSpec("delay", p=1.0, delay_s=delay_s)]))
+        ids = list(self.workers)
+        for _ in range(bursts):
+            wids = [ids[self.rng.randrange(len(ids))] for _ in range(8)]
+            await asyncio.gather(*(self._seed_events(self.workers[w])
+                                   for w in wids))
+            await asyncio.sleep(delay_s / bursts)
+        if load_calls:
+            await self.run_load(load_calls)
+        # wait for the delayed deliveries to land and the lag to surface
+        deadline = time.monotonic() + delay_s * 4 + 5.0
+        entered = False
+        while time.monotonic() < deadline:
+            if self.router.degraded:
+                entered = True
+                break
+            await asyncio.sleep(0.02)
+        faults.REGISTRY.disarm("event.plane")
+        # fresh (undelayed) events + idle ticks pull the lag back down
+        await self._seed_events(self.workers[ids[0]])
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if not self.router.degraded:
+                break
+            await asyncio.sleep(0.05)
+        return {"delay_s": delay_s,
+                "entered": entered,
+                "exited": not self.router.degraded,
+                "degraded_entries":
+                    self.router.degraded_entries - entries_before,
+                "errors": self.schedule_errors,
+                "dead_picks": self.dead_picks}
+
+    # -- profiling ------------------------------------------------------------
+
+    async def measure_scrape(self) -> float:
+        t0 = time.perf_counter()
+        await self.router.aggregator.scrape_once()
+        return time.perf_counter() - t0
+
+    async def event_rate_probe(self, events: int,
+                               publishers: int = 32) -> dict:
+        """Publish `events` Stored events as fast as the loop allows and
+        measure how far the router's application lags behind arrival."""
+        ids = list(self.workers)[:publishers]
+        applied_before = self.router.events_applied
+        t0 = time.perf_counter()
+        per_pub = max(1, events // max(1, len(ids)))
+        for start in range(0, per_pub):
+            await asyncio.gather(*(
+                self.workers[w].publish_family_pages(
+                    [self.workers[w].rng.randrange(
+                        self.cfg.prefix_families)], pages=1)
+                for w in ids))
+        publish_s = time.perf_counter() - t0
+        peak_backlog = CP_STATS.event_backlog
+        await self._drain_event_queue(timeout_s=30.0)
+        total_s = time.perf_counter() - t0
+        applied = self.router.events_applied - applied_before
+        return {"published": per_pub * len(ids),
+                "publish_s": round(publish_s, 3),
+                "applied": applied,
+                "applied_per_s": round(applied / total_s, 1)
+                if total_s else 0.0,
+                "peak_backlog": peak_backlog,
+                "peak_lag_s": round(self.router.event_lag_s, 4),
+                "drain_s": round(total_s - publish_s, 3)}
+
+    def summary(self) -> dict:
+        lat = sorted(self.latencies_us)
+        return {
+            "workers": len(self.workers),
+            "streams": self.cfg.streams,
+            "schedule_calls": self.schedule_calls,
+            "schedule_errors": self.schedule_errors,
+            "dead_picks": self.dead_picks,
+            "p50_us": round(percentile(lat, 0.50), 1),
+            "p99_us": round(percentile(lat, 0.99), 1),
+            "register_s": round(self.register_s, 3),
+            "indexer_nodes": self.router.indexer.num_nodes(),
+            "eviction_backlog": self.router.indexer.eviction_backlog(),
+            "watch_resyncs": CP_STATS.watch_resyncs,
+            "degraded_entries": self.router.degraded_entries,
+        }

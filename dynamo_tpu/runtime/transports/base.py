@@ -12,7 +12,9 @@ control-plane server (dynamo_tpu.runtime.transports.server).
 from __future__ import annotations
 
 import abc
+import asyncio
 import dataclasses
+import inspect
 from typing import AsyncIterator, Awaitable, Callable, Dict, List, Optional, Tuple
 
 
@@ -45,6 +47,106 @@ class Lease:
 
     async def revoke(self):
         await self._revoke_cb(self.id)
+
+
+class QueueStream:
+    """Async-iterable delivery stream over a transport queue.
+
+    Both transports used to hand consumers a bare async generator over
+    an asyncio.Queue; at cluster scale that shape has three gaps this
+    class closes:
+
+    - ``next_batch()``: await the first item, then drain everything
+      already queued — a churn storm costs ONE consumer wakeup and one
+      application pass per tick instead of one per event (the watch /
+      event-plane coalescing the 1000-worker sim demands);
+    - ``depth()``: the live backlog, for the ``llm_cp_*`` queue-depth
+      gauges and the router's backpressure signal;
+    - ``aclose()``: deterministic teardown (the generators relied on GC
+      finalization to run their ``finally`` blocks).
+
+    ``failpoint``: an optional faults.py site evaluated once per
+    ``__anext__``/``next_batch`` delivery; an injected drop raises
+    ``FaultInjected`` into the consumer — the stream-disconnect model.
+    Consumers that must survive it (Client/ModelWatcher watch pumps)
+    resume with backoff + snapshot resync; items lost with the
+    disconnect are recovered by that resync.
+    """
+
+    def __init__(self, queue: asyncio.Queue,
+                 on_close: Optional[Callable] = None,
+                 failpoint: Optional[str] = None):
+        self._q = queue
+        self._on_close = on_close
+        self._failpoint = failpoint
+        self._closed = False
+
+    def _fire(self) -> None:
+        if self._failpoint is None:
+            return
+        from dynamo_tpu.runtime import faults
+        if not faults.REGISTRY.enabled:
+            return
+        out = faults.REGISTRY.decide(self._failpoint)
+        if out is not None and out.drop:
+            raise faults.FaultInjected(self._failpoint)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        item = await self._q.get()
+        self._fire()
+        return item
+
+    async def next_batch(self, max_items: int = 4096,
+                         timeout: Optional[float] = None) -> list:
+        """Await the first item, then drain whatever is already queued
+        (up to ``max_items``). Returns ``[]`` on timeout when one is
+        given — consumers use that to run idle-time checks (degraded-
+        mode exit, lag decay) without a second timer task."""
+        try:
+            if timeout is None:
+                first = await self._q.get()
+            else:
+                first = await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
+            return []
+        batch = [first]
+        while len(batch) < max_items:
+            try:
+                batch.append(self._q.get_nowait())
+            except asyncio.QueueEmpty:
+                break
+        self._fire()
+        return batch
+
+    def depth(self) -> int:
+        return self._q.qsize()
+
+    async def aclose(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._on_close is not None:
+            res = self._on_close()
+            if inspect.isawaitable(res):
+                await res
+
+
+class WatchStream(QueueStream):
+    """KV watch-event delivery; carries the ``watch.stream`` failpoint
+    (an injected drop == the watch stream disconnecting mid-flight)."""
+
+    def __init__(self, queue: asyncio.Queue,
+                 on_close: Optional[Callable] = None):
+        super().__init__(queue, on_close, failpoint="watch.stream")
+
+
+class SubscriptionStream(QueueStream):
+    """Event-plane delivery of (subject, payload) pairs. Lag/reorder/
+    drop faults are injected on the PUBLISH side (the event.plane site),
+    where a delayed delivery can actually arrive out of order."""
 
 
 class KVStore(abc.ABC):

@@ -13,11 +13,12 @@ import logging
 import time
 import uuid
 from collections import defaultdict
-from typing import AsyncIterator, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from dynamo_tpu.runtime import faults
 from dynamo_tpu.runtime.transports.base import (
-    KVEntry, KVStore, Lease, Messaging, WatchEvent, subject_matches,
+    KVEntry, KVStore, Lease, Messaging, SubscriptionStream, WatchEvent,
+    WatchStream, subject_matches,
 )
 
 log = logging.getLogger("dynamo_tpu.memory_plane")
@@ -71,6 +72,7 @@ class MemoryKVStore(KVStore):
         await self._latency.apply()
         if faults.REGISTRY.enabled:   # drop => ConnectionError to caller
             await faults.REGISTRY.fire("transport.send")
+            await faults.REGISTRY.fire("discovery.store")
         self._data[key] = KVEntry(key, value, lease_id)
         if lease_id:
             self._lease_keys[lease_id].add(key)
@@ -87,6 +89,7 @@ class MemoryKVStore(KVStore):
         await self._latency.apply()
         if faults.REGISTRY.enabled:
             await faults.REGISTRY.fire("transport.send")
+            await faults.REGISTRY.fire("discovery.store")
         e = self._data.get(key)
         return e.value if e else None
 
@@ -94,12 +97,14 @@ class MemoryKVStore(KVStore):
         await self._latency.apply()
         if faults.REGISTRY.enabled:
             await faults.REGISTRY.fire("transport.send")
+            await faults.REGISTRY.fire("discovery.store")
         return [e for k, e in sorted(self._data.items()) if k.startswith(prefix)]
 
     async def delete(self, key: str) -> None:
         await self._latency.apply()
         if faults.REGISTRY.enabled:
             await faults.REGISTRY.fire("transport.send")
+            await faults.REGISTRY.fire("discovery.store")
         e = self._data.pop(key, None)
         if e is not None:
             if e.lease_id:
@@ -133,7 +138,15 @@ class MemoryKVStore(KVStore):
             if deadline is None:
                 return
             now = time.monotonic()
-            if now >= deadline:
+            forced = False
+            if faults.REGISTRY.enabled \
+                    and faults.REGISTRY.armed("lease.expiry"):
+                # lease-expiry burst site: a drop outcome force-expires
+                # THIS lease now; armed with p over a fleet, each
+                # watchdog tick expires ~p of the leases it visits
+                out = faults.REGISTRY.decide("lease.expiry")
+                forced = out is not None and out.drop
+            if now >= deadline or forced:
                 await self._expire(lease_id)
                 lease.lost.set()
                 return
@@ -158,15 +171,11 @@ class MemoryKVStore(KVStore):
         entry = (prefix, q)
         self._watchers.append(entry)
 
-        async def gen() -> AsyncIterator[WatchEvent]:
-            try:
-                while True:
-                    yield await q.get()
-            finally:
-                if entry in self._watchers:
-                    self._watchers.remove(entry)
+        def on_close():
+            if entry in self._watchers:
+                self._watchers.remove(entry)
 
-        return snapshot, gen()
+        return snapshot, WatchStream(q, on_close=on_close)
 
 
 class MemoryMessaging(Messaging):
@@ -217,26 +226,45 @@ class MemoryMessaging(Messaging):
                     out = await _lossy_fire("transport.recv")
                     if out is None:
                         continue  # lost for THIS subscriber only
-                    q.put_nowait((subject, payload))
-                    if out.duplicate or send_dup:
-                        q.put_nowait((subject, payload))
+                    dup = out.duplicate or send_dup
+                    if not self._deliver_event_plane(q, subject, payload,
+                                                     dup):
+                        continue
                 else:
                     q.put_nowait((subject, payload))
+
+    @staticmethod
+    def _deliver_event_plane(q, subject, payload, dup: bool) -> bool:
+        """Per-subscriber delivery through the event.plane failpoint.
+        Delay is applied via call_later — the delayed event arrives late
+        AND after later undelayed events (lag ⇒ reorder, like a slow
+        NATS consumer); drop loses it; duplicate doubles it. Returns
+        False when the event was dropped."""
+        out = (faults.REGISTRY.decide("event.plane")
+               if faults.REGISTRY.armed("event.plane") else None)
+        if out is not None and out.drop:
+            return False
+        copies = 2 if (dup or (out is not None and out.duplicate)) else 1
+        if out is not None and out.delay_s > 0:
+            loop = asyncio.get_running_loop()
+            for _ in range(copies):
+                loop.call_later(out.delay_s, q.put_nowait,
+                                (subject, payload))
+        else:
+            for _ in range(copies):
+                q.put_nowait((subject, payload))
+        return True
 
     async def subscribe(self, subject):
         q: asyncio.Queue = asyncio.Queue()
         entry = (subject, q)
         self._subs.append(entry)
 
-        async def gen():
-            try:
-                while True:
-                    yield await q.get()
-            finally:
-                if entry in self._subs:
-                    self._subs.remove(entry)
+        def on_close():
+            if entry in self._subs:
+                self._subs.remove(entry)
 
-        return gen()
+        return SubscriptionStream(q, on_close=on_close)
 
     async def queue_push(self, queue, payload):
         await self._latency.apply()

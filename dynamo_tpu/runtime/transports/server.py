@@ -153,10 +153,15 @@ class _Conn:
         snapshot, events = await self.server.plane.kv.watch_prefix(m["prefix"])
 
         async def pump():
-            async for ev in events:
-                await self.send({"op": "watch_event", "watch_id": wid,
-                                 "kind": ev.kind, "key": ev.key,
-                                 "value": ev.value})
+            try:
+                async for ev in events:
+                    await self.send({"op": "watch_event", "watch_id": wid,
+                                     "kind": ev.kind, "key": ev.key,
+                                     "value": ev.value})
+            finally:
+                # deterministic stream teardown (WatchStream no longer
+                # relies on generator GC finalization)
+                await events.aclose()
 
         self.watch_tasks[wid] = asyncio.create_task(pump())
         return {"watch_id": wid,

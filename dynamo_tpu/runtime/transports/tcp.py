@@ -10,10 +10,11 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
-from typing import AsyncIterator, Dict, Optional
+from typing import Dict, Optional
 
 from dynamo_tpu.runtime.transports.base import (
-    KVEntry, KVStore, Lease, Messaging, WatchEvent,
+    KVEntry, KVStore, Lease, Messaging, SubscriptionStream, WatchEvent,
+    WatchStream,
 )
 from dynamo_tpu.runtime.transports.wire import (
     oneshot_request, read_frame, write_frame,
@@ -210,6 +211,7 @@ class ControlPlaneClient(KVStore, Messaging):
         lease to the cancellation token)."""
         from dynamo_tpu.runtime import faults
         try:
+            # dynalint: backoff-ok=TTL-paced lease renewal; cadence is ttl/3 by protocol, and a failed keepalive ends the loop (lease lost) instead of retrying hot
             while True:
                 await asyncio.sleep(ttl / 3)
                 if faults.REGISTRY.enabled:
@@ -235,18 +237,14 @@ class ControlPlaneClient(KVStore, Messaging):
         self._watch_queues[wid] = q
         snapshot = [KVEntry(k, v, l) for k, v, l in reply["entries"]]
 
-        async def gen() -> AsyncIterator[WatchEvent]:
+        async def on_close():
+            self._watch_queues.pop(wid, None)
             try:
-                while True:
-                    yield await q.get()
-            finally:
-                self._watch_queues.pop(wid, None)
-                try:
-                    await self._rpc({"op": "unwatch", "watch_id": wid})
-                except Exception:  # dynalint: swallow-ok=best-effort-unwatch-on-close
-                    pass
+                await self._rpc({"op": "unwatch", "watch_id": wid})
+            except Exception:  # dynalint: swallow-ok=best-effort-unwatch-on-close
+                pass
 
-        return snapshot, gen()
+        return snapshot, WatchStream(q, on_close=on_close)
 
     # -- Messaging -----------------------------------------------------------
 
@@ -276,18 +274,14 @@ class ControlPlaneClient(KVStore, Messaging):
         q: asyncio.Queue = asyncio.Queue()
         self._sub_queues[sid] = q
 
-        async def gen():
+        async def on_close():
+            self._sub_queues.pop(sid, None)
             try:
-                while True:
-                    yield await q.get()
-            finally:
-                self._sub_queues.pop(sid, None)
-                try:
-                    await self._rpc({"op": "unsubscribe", "sub_id": sid})
-                except Exception:  # dynalint: swallow-ok=best-effort-unsubscribe-on-close
-                    pass
+                await self._rpc({"op": "unsubscribe", "sub_id": sid})
+            except Exception:  # dynalint: swallow-ok=best-effort-unsubscribe-on-close
+                pass
 
-        return gen()
+        return SubscriptionStream(q, on_close=on_close)
 
     async def queue_push(self, queue, payload):
         await self._rpc({"op": "queue_push", "queue": queue,

@@ -82,6 +82,22 @@ ROLLING_PLAN = {
         {"kind": "delay", "p": 1.0, "delay_s": 0.003}]},
 }
 
+# control-plane storm (the scale-harness scenario): watch-stream
+# disconnects, a discovery-store brown-out, event-plane lag/reorder, and
+# seeded heartbeat loss — all at once, over a simulated fleet
+CONTROL_PLANE_PLAN = {
+    "watch.stream": {"seed": 41, "specs": [
+        {"kind": "fail_n", "n": 2}]},
+    "discovery.store": {"seed": 241, "specs": [
+        {"kind": "fail_n", "n": 3},
+        {"kind": "delay", "p": 0.05, "delay_s": 0.01}]},
+    "event.plane": {"seed": 341, "specs": [
+        {"kind": "delay", "p": 0.3, "delay_s": 0.8},
+        {"kind": "drop", "p": 0.02}]},
+    "discovery.heartbeat": {"seed": 441, "specs": [
+        {"kind": "drop", "p": 0.05}]},
+}
+
 
 @pytest.fixture(autouse=True)
 def disarm_after():
@@ -466,9 +482,65 @@ def test_chaos_rolling_restart_zero_drop_token_identical():
     run_scenario("rolling_restart")
 
 
+# -- scenario: control-plane storm over the simulated fleet --------------------
+
+def run_control_plane_storm(plan):
+    """The scale-harness scenario (runtime/simcluster.py) as a chaos
+    run: a simulated fleet under watch disconnects, a discovery-store
+    brown-out, event-plane lag/reorder/drop and heartbeat loss, while a
+    rolling restart cycles a fleet fraction under schedule load.
+
+    Contract: zero scheduling errors, zero post-fence picks (the router
+    never selects a dead/draining worker after its watch event is
+    applied), the fleet converges, and the event-lag leg must round-trip
+    the router's stale-snapshot degraded mode without request errors."""
+    from dynamo_tpu.runtime.cpstats import CP_STATS
+    from dynamo_tpu.runtime.simcluster import SimCluster, SimConfig
+    CP_STATS.reset()
+
+    async def main():
+        sim = await SimCluster(SimConfig(
+            workers=48, streams=384, seed=23, lease_ttl_s=2.0,
+            scrape_interval_s=0.1, degraded_lag_s=0.5)).start()
+        try:
+            faults.REGISTRY.arm_from_dict(plan)
+            await sim.run_load(300)
+            rr = await sim.storm_rolling_restart(fraction=0.25,
+                                                 load_calls=300)
+            assert rr["errors"] == 0 and rr["dead_picks"] == 0, rr
+            # event-plane lag (plan's delayed deliveries) must surface
+            # as the degraded round trip once the armed window passes
+            lag = await sim.storm_event_lag(delay_s=1.0, load_calls=150)
+            faults.REGISTRY.disarm()
+            assert lag["entered"] and lag["exited"], lag
+            # convergence: every live worker visible, none fenced
+            deadline = asyncio.get_running_loop().time() + 15
+            while len(sim.client.instances) < len(sim.workers):
+                assert asyncio.get_running_loop().time() < deadline, \
+                    (len(sim.client.instances), len(sim.workers))
+                await asyncio.sleep(0.1)
+            summary = sim.summary()
+            assert summary["schedule_errors"] == 0, summary
+            assert summary["dead_picks"] == 0, summary
+            return {"summary": summary,
+                    "rolling_restart": rr, "event_lag": lag,
+                    "faults": faults.REGISTRY.snapshot()}
+        finally:
+            faults.REGISTRY.disarm()
+            await sim.stop()
+
+    return asyncio.run(asyncio.wait_for(main(), 180))
+
+
+@pytest.mark.slow
+def test_chaos_control_plane_storm():
+    run_scenario("control_plane_storm")
+
+
 # name -> (runner, committed default plan); tools/chaos_replay.py's menu
 SCENARIOS = {
     "aggregated_zero_drop": (run_aggregated_zero_drop, AGGREGATED_PLAN),
     "disagg_prefill_death": (run_disagg_prefill_death, DISAGG_PLAN),
     "rolling_restart": (run_rolling_restart, ROLLING_PLAN),
+    "control_plane_storm": (run_control_plane_storm, CONTROL_PLANE_PLAN),
 }

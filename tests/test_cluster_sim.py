@@ -1,0 +1,140 @@
+"""Control-plane scale harness (runtime/simcluster.py): the tier-1 smoke
+plus the slow full-scale run.
+
+The smoke is the CI shape of the 1000-worker sim: 64 mock workers, one
+seeded rolling-restart storm under schedule load, a watch-disconnect
+burst, and an event-plane lag storm that must round-trip the router's
+stale-snapshot degraded mode. Contracts: zero scheduling errors, zero
+post-fence picks (the router never selects a dead/draining worker after
+its watch event is applied), watcher convergence, degraded in AND out.
+The full `--workers 1000` run stays behind `-m slow` and the TPU watch
+ladder (`tools/cluster_sim.py` commits SCALE_r07.json).
+"""
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.cpstats import CP_STATS
+from dynamo_tpu.runtime.simcluster import (
+    SimCluster, SimConfig, family_tokens, percentile, pick_storm_targets,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_cp_state():
+    faults.REGISTRY.disarm()
+    faults.REGISTRY.reset_counters()
+    CP_STATS.reset()
+    yield
+    faults.REGISTRY.disarm()
+    faults.REGISTRY.reset_counters()
+    CP_STATS.reset()
+
+
+def test_storm_targets_are_a_pure_function_of_seed():
+    ids = [f"w{i:04d}" for i in range(100)]
+    a = pick_storm_targets(42, ids, 0.3)
+    b = pick_storm_targets(42, list(reversed(ids)), 0.3)
+    assert a == b and len(a) == 30
+    assert pick_storm_targets(43, ids, 0.3) != a
+
+
+def test_family_tokens_deterministic_and_distinct():
+    assert family_tokens(3, 16, 4) == family_tokens(3, 16, 4)
+    assert family_tokens(3, 16, 4) != family_tokens(4, 16, 4)
+
+
+def test_percentile_edges():
+    assert percentile([], 0.99) == 0.0
+    assert percentile([1.0], 0.5) == 1.0
+    # nearest-rank: 0.99 * (n-1) rounds to index 98 of 0..99
+    assert percentile(list(map(float, range(100))), 0.99) == 98.0
+
+
+def test_cluster_sim_smoke_64_workers_storms_hold_contracts():
+    """The tier-1 sim smoke: seeded, deterministic storm membership,
+    every routing contract enforced end to end."""
+    async def main():
+        sim = await SimCluster(SimConfig(
+            workers=64, streams=512, seed=11, lease_ttl_s=2.0,
+            scrape_interval_s=0.1, degraded_lag_s=0.5)).start()
+        try:
+            # steady-state load over shared-prefix streams
+            load = await sim.run_load(400)
+            assert load["calls"] == 400
+            assert sim.schedule_errors == 0 and sim.dead_picks == 0
+            # prefix overlap actually drives routing (radix index live)
+            assert sim.router.indexer.num_nodes() > 0
+
+            # storm 1: seeded rolling restart under load — zero errors,
+            # and never a post-fence pick
+            rr = await sim.storm_rolling_restart(fraction=0.25,
+                                                 load_calls=300)
+            assert rr["errors"] == 0 and rr["dead_picks"] == 0
+            assert rr["targets"] == 16
+            assert len(sim.client.instances) == 64   # fleet recovered
+
+            # storm 2: watch-stream disconnect burst — the client pump
+            # resumes with backoff and RESYNCS (no silent dead watcher)
+            wd = await sim.storm_watch_disconnect(kills=2, load_calls=100)
+            assert wd["converged"], wd
+            assert wd["resyncs"] >= 1
+            assert wd["errors"] == 0 and wd["dead_picks"] == 0
+
+            # storm 3: event-plane lag — degraded mode in AND out, with
+            # scheduling uninterrupted and the flag on CP_STATS
+            lag = await sim.storm_event_lag(delay_s=1.0, load_calls=100)
+            assert lag["entered"] and lag["exited"], lag
+            assert lag["errors"] == 0 and lag["dead_picks"] == 0
+            assert CP_STATS.router_degraded == 0
+            assert CP_STATS.router_degraded_entries >= 1
+
+            summary = sim.summary()
+            assert summary["schedule_errors"] == 0
+            assert summary["dead_picks"] == 0
+            assert summary["p99_us"] > 0
+        finally:
+            await sim.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_lease_expiry_burst_prunes_then_recovers():
+    """A heartbeat blackout for a seeded fraction expires their leases in
+    one burst (mass watch-delete flood, coalesced by the batched pump);
+    jittered re-registration restores the fleet without a stampede."""
+    async def main():
+        sim = await SimCluster(SimConfig(
+            workers=32, streams=128, seed=3, lease_ttl_s=1.0,
+            scrape_interval_s=0.1)).start()
+        try:
+            le = await sim.storm_lease_expiry(fraction=0.25, load_calls=100)
+            assert le["expired"] == le["targets"] == 8
+            assert le["errors"] == 0 and le["dead_picks"] == 0
+            assert len(sim.client.instances) == 32
+        finally:
+            await sim.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+@pytest.mark.slow
+def test_cluster_sim_full_scale_1000_workers():
+    """The full-scale run (the committed SCALE_r07.json shape): behind
+    -m slow; tools/cluster_sim.py is the artifact-committing driver."""
+    async def main():
+        sim = await SimCluster(SimConfig(
+            workers=1000, streams=20_000, seed=7)).start()
+        try:
+            await sim.run_load(2000)
+            rr = await sim.storm_rolling_restart(fraction=0.3,
+                                                 load_calls=2000)
+            assert rr["errors"] == 0 and rr["dead_picks"] == 0
+            lag = await sim.storm_event_lag(delay_s=1.5, load_calls=500)
+            assert lag["entered"] and lag["exited"]
+            assert sim.summary()["schedule_errors"] == 0
+        finally:
+            await sim.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 600))

@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R11), the
+- one positive AND one negative fixture per AST rule (R1-R12), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -651,6 +651,99 @@ def test_r11_live_on_current_model_and_ops_tree():
         with open(os.path.join(REPO, rel)) as f:
             found = lint_source(f.read(), rel)
         assert not [x for x in found if x.rule == "R11"], rel
+
+
+# -- R12: control-plane retry loops without backoff+jitter --------------------
+
+R12_SRC = """
+    import asyncio
+
+    async def watch_loop(kv, prefix, apply):
+        while True:
+            try:
+                snapshot, events = await kv.watch_prefix(prefix)
+                async for ev in events:
+                    apply(ev)
+            except Exception:
+                await asyncio.sleep(0.1)   # hot, synchronized retry
+"""
+
+
+def test_r12_flags_retry_loop_without_backoff():
+    found = lint_source(textwrap.dedent(R12_SRC),
+                        "dynamo_tpu/runtime/watch_fixture.py")
+    assert "R12" in rules(found)
+
+
+def test_r12_quiet_outside_scope_and_without_retry_shape():
+    # engine code is out of scope (no control-plane reconnects there)
+    found = lint_source(textwrap.dedent(R12_SRC),
+                        "dynamo_tpu/engine/fixture.py")
+    assert "R12" not in rules(found)
+    # a loop that does NOT survive failures (no handler) is not a retry
+    # loop — death is handled a layer up
+    no_handler = """
+        async def watch_once(kv, prefix, apply):
+            while True:
+                snapshot, events = await kv.watch_prefix(prefix)
+                async for ev in events:
+                    apply(ev)
+    """
+    found = lint_source(textwrap.dedent(no_handler),
+                        "dynamo_tpu/runtime/watch_fixture.py")
+    assert "R12" not in rules(found)
+
+
+def test_r12_quiet_with_backoff_or_annotation():
+    with_backoff = """
+        from dynamo_tpu.runtime.backoff import Backoff
+
+        async def watch_loop(kv, prefix, apply):
+            backoff = Backoff()
+            while True:
+                try:
+                    snapshot, events = await kv.watch_prefix(prefix)
+                    async for ev in events:
+                        apply(ev)
+                    backoff.reset()
+                except Exception:
+                    await backoff.sleep()
+    """
+    found = lint_source(textwrap.dedent(with_backoff),
+                        "dynamo_tpu/runtime/watch_fixture.py")
+    assert "R12" not in rules(found)
+    annotated = """
+        import asyncio
+
+        async def heartbeat(lease, ttl):
+            # dynalint: backoff-ok=TTL-paced renewal cadence
+            while True:
+                try:
+                    lease.keep_alive()
+                except Exception:
+                    pass
+                await asyncio.sleep(ttl / 3)
+    """
+    found = lint_source(textwrap.dedent(annotated),
+                        "dynamo_tpu/runtime/hb_fixture.py")
+    assert "R12" not in rules(found)
+
+
+def test_r12_live_on_current_control_plane_tree():
+    """Every surviving control-plane retry loop in runtime/, frontend/,
+    kv_router/ either drives its delay through runtime/backoff.py or
+    carries a justified fixed-cadence annotation."""
+    import glob
+    scoped = []
+    for pat in ("dynamo_tpu/runtime/**/*.py", "dynamo_tpu/frontend/*.py",
+                "dynamo_tpu/kv_router/*.py"):
+        scoped.extend(glob.glob(os.path.join(REPO, pat), recursive=True))
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R12"], rel
 
 
 # -- jaxpr invariants ----------------------------------------------------------

@@ -273,6 +273,80 @@ def test_site_discovery_heartbeat_drop_skips_lease_refresh():
     assert snap["injected"]["discovery.heartbeat"] == 1
 
 
+def test_site_discovery_store_window_fails_then_recovers():
+    from dynamo_tpu.runtime.transports.memory import MemoryKVStore
+
+    async def main():
+        kv = MemoryKVStore()
+        await kv.put("k", b"v")
+        arm("discovery.store", FaultSpec("fail_n", n=1))
+        with pytest.raises(ConnectionError):   # unavailable window
+            await kv.get("k")
+        assert await kv.get("k") == b"v"       # window over
+
+    asyncio.run(main())
+    assert REGISTRY.snapshot()["injected"]["discovery.store"] == 1
+
+
+def test_site_lease_expiry_force_expires_lease():
+    from dynamo_tpu.runtime.transports.memory import MemoryKVStore
+
+    async def main():
+        kv = MemoryKVStore()
+        lease = await kv.grant_lease(ttl=0.9)
+        await kv.put("k", b"v", lease.id)
+        # the first watchdog tick (~ttl/3) force-expires, well before
+        # the 0.9s natural deadline
+        arm("lease.expiry", FaultSpec("drop", p=1.0, n=1))
+        await asyncio.wait_for(lease.lost.wait(), 10)
+        assert await kv.get("k") is None       # leased key swept
+
+    asyncio.run(main())
+    assert REGISTRY.snapshot()["injected"]["lease.expiry"] >= 1
+
+
+def test_site_event_plane_delay_reorders_delivery():
+    from dynamo_tpu.runtime.transports.memory import MemoryMessaging
+
+    async def main():
+        msg = MemoryMessaging()
+        sub = await msg.subscribe("ev.>")
+        # hit 1 delayed via call_later; hit 2 (budget spent) immediate —
+        # the delayed event arrives LATE and OUT OF ORDER, the lag model
+        # the router's degraded mode is built against
+        arm("event.plane", FaultSpec("delay", p=1.0, n=1, delay_s=0.2))
+        await msg.publish("ev.a", b"delayed")
+        await msg.publish("ev.a", b"prompt")
+        got = [await asyncio.wait_for(sub.__anext__(), 5)
+               for _ in range(2)]
+        assert [p for _, p in got] == [b"prompt", b"delayed"]
+
+    asyncio.run(main())
+
+
+def test_site_watch_stream_drop_raises_into_consumer():
+    from dynamo_tpu.runtime.transports.memory import MemoryKVStore
+
+    async def main():
+        kv = MemoryKVStore()
+        snapshot, stream = await kv.watch_prefix("p/")
+        arm("watch.stream", FaultSpec("fail_n", n=1))
+        await kv.put("p/a", b"1")
+        with pytest.raises(FaultInjected):     # the disconnect model
+            await asyncio.wait_for(stream.__anext__(), 5)
+        # a RE-ESTABLISHED stream works; the event lost with the old one
+        # is recovered by the snapshot (what Client._watch_loop does)
+        snapshot2, stream2 = await kv.watch_prefix("p/")
+        assert [e.key for e in snapshot2] == ["p/a"]
+        await kv.put("p/b", b"2")
+        ev = await asyncio.wait_for(stream2.__anext__(), 5)
+        assert ev.key == "p/b"
+        await stream.aclose()
+        await stream2.aclose()
+
+    asyncio.run(main())
+
+
 def test_every_catalogued_site_is_armable():
     for site in SITES:
         arm(site, FaultSpec("drop", p=0.0))

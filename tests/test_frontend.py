@@ -547,6 +547,12 @@ class TestHttpService:
                     f"{integrity.quarantined}" in text
                 assert f"llm_drain_drains_completed " \
                     f"{DRAIN_STATS.drains_completed}" in text
+                # control-plane gauges ride the same render-time fold
+                from dynamo_tpu.runtime.cpstats import CP_STATS
+                assert "llm_cp_router_degraded " \
+                    f"{int(CP_STATS.router_degraded)}" in text
+                assert "llm_cp_watch_resyncs " \
+                    f"{int(CP_STATS.watch_resyncs)}" in text
             finally:
                 faults.REGISTRY.disarm()
                 faults.REGISTRY.reset_counters()

@@ -112,6 +112,25 @@ def test_exporter_two_worker_graph():
             assert 'llm_reliability_stall_fires{source="front0"} 1' in body2
             assert 'llm_reliability_deadline_exceeded{source="front0"} 0' \
                 in body2
+            # control-plane gauges (runtime/cpstats.py CP_STATS), folded
+            # at render: the exporter's own Client watch feeds them, and
+            # a synthetic bump must be visible on the next scrape
+            from dynamo_tpu.runtime.cpstats import CP_STATS
+            assert "llm_cp_watch_queue_depth" in body2
+            assert "llm_cp_router_degraded" in body2
+            CP_STATS.indexer_nodes = 12345
+            CP_STATS.router_degraded = 1
+            CP_STATS.event_lag_seconds = 2.5
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", exporter.port)
+            writer.write(b"GET /metrics HTTP/1.1\r\n\r\n")
+            await writer.drain()
+            body3 = (await reader.read(65536)).decode()
+            writer.close()
+            assert "llm_cp_indexer_nodes 12345" in body3
+            assert "llm_cp_router_degraded 1" in body3
+            assert "llm_cp_event_lag_seconds 2.5" in body3
+            CP_STATS.reset()
         finally:
             await exporter.stop()
             for rt in rts:

@@ -104,6 +104,91 @@ def test_instance_removed_on_shutdown():
     run(main())
 
 
+def test_client_watch_stream_death_recovers_and_converges():
+    """Satellite regression: a killed watch stream must not leave a
+    SILENT dead watcher. The pump resumes with backoff + jitter and
+    resyncs from a full snapshot — registrations AND deregistrations
+    that happened during the gap converge."""
+    from dynamo_tpu.runtime import faults
+    from dynamo_tpu.runtime.cpstats import CP_STATS
+
+    async def main():
+        plane = MemoryPlane()
+        rt1 = await DistributedRuntime.create_local(plane, "w1")
+        await rt1.namespace("ns").component("c").endpoint("gen").serve(
+            echo_engine)
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("c").endpoint("gen").client()
+        await client.start()
+        await client.wait_for_instances()
+        resyncs_before = CP_STATS.watch_resyncs
+
+        # kill the next watch delivery: the stream raises into the pump
+        faults.REGISTRY.arm("watch.stream", faults.FaultSchedule(
+            0, [faults.FaultSpec("fail_n", n=1)]))
+        # both events die WITH the stream; only the resync can recover them
+        rt2 = await DistributedRuntime.create_local(plane, "w2")
+        await rt2.namespace("ns").component("c").endpoint("gen").serve(
+            echo_engine)
+        await rt1.shutdown()   # w1 deregisters during the gap
+
+        deadline = asyncio.get_running_loop().time() + 10
+        while client.instance_ids() != ["w2"]:
+            assert asyncio.get_running_loop().time() < deadline, \
+                client.instances
+            await asyncio.sleep(0.05)
+        assert CP_STATS.watch_resyncs > resyncs_before
+        faults.REGISTRY.disarm()
+
+        # the resumed watcher is LIVE, not just resynced: later events
+        # flow again without further faults
+        await rt2.shutdown()
+        deadline = asyncio.get_running_loop().time() + 5
+        while client.instance_ids():
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        await crt.shutdown()
+
+    try:
+        run(asyncio.wait_for(main(), 60))
+    finally:
+        from dynamo_tpu.runtime import faults
+        faults.REGISTRY.disarm()
+        faults.REGISTRY.reset_counters()
+
+
+def test_client_watch_batch_coalesces_flaps():
+    """A churn tick's events coalesce per key: N put/delete flaps on one
+    key apply as ONE final state (and the coalesce counter advances)."""
+    from dynamo_tpu.runtime.cpstats import CP_STATS
+
+    async def main():
+        plane = MemoryPlane()
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("c").endpoint("gen").client()
+        await client.start()
+        seen = []
+        client.add_listener(lambda kind, wid, info: seen.append((kind, wid)))
+        CP_STATS.reset()
+        # burst of flaps on one key, queued BEFORE the pump can tick:
+        # the batch must fold to the final put
+        key = "ns/components/c/gen:wf"
+        import json as _json
+        for i in range(9):
+            await plane.kv.put(key, _json.dumps({"i": i}).encode())
+        deadline = asyncio.get_running_loop().time() + 5
+        while "wf" not in client.instances:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        assert client.instances["wf"]["i"] == 8   # final state won
+        # fewer listener fires than raw events — the batching coalesced
+        assert len([s for s in seen if s[1] == "wf"]) < 9
+        assert CP_STATS.watch_events_coalesced > 0
+        await crt.shutdown()
+
+    run(asyncio.wait_for(main(), 30))
+
+
 def test_lease_expiry_prunes_instances():
     """Killing keep-alive (by revoking through expiry path) removes keys —
     the reference's lease-TTL failure-detection behavior."""

@@ -39,6 +39,7 @@ SCENARIO_NAMES = (
     "aggregated_zero_drop",
     "disagg_prefill_death",
     "rolling_restart",
+    "control_plane_storm",
 )
 
 DEFAULT_LOG = os.path.join(REPO_ROOT, "CHAOS_REPLAY.jsonl")

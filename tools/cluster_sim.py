@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""cluster_sim: the control-plane scale harness (ROADMAP item 4).
+
+Stands up a simulated cluster (dynamo_tpu/runtime/simcluster.py) of mock
+workers — instance keys + leases + $STATS responders + synthetic
+KV-event streams, no model — against a REAL Client + KvRouter, then:
+
+1. runs a capacity ladder (workers vs. schedule p50/p99, per-scrape
+   aggregation cost, registration time);
+2. probes the event plane (publish rate vs. applied rate, peak backlog
+   and lag);
+3. drives seeded chaos storms at full scale: a rolling restart of a
+   fleet fraction under load, a lease-expiry burst, a watch-disconnect
+   storm (watch.stream failpoint), and an event-plane lag storm that
+   must round-trip the router's stale-snapshot degraded mode;
+4. commits the capacity curves + storm contracts as a single evidence
+   artifact via tools/artifacts.py (append-forbidden single JSON,
+   final name — default SCALE_r07.json).
+
+Contracts enforced (exit 1 on violation):
+- zero scheduling errors across every phase;
+- zero post-fence picks (the router never selects a dead/draining
+  worker after its watch event is applied);
+- the watch-disconnect storm converges (resumed watcher resyncs);
+- the lag storm enters AND exits degraded mode.
+
+Usage:
+    python tools/cluster_sim.py --workers 1000 --streams 20000 --seed 7
+    python tools/cluster_sim.py --workers 64 --quick      # smoke shape
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from dynamo_tpu.runtime.simcluster import SimCluster, SimConfig  # noqa: E402
+
+
+async def run_point(workers: int, streams: int, seed: int,
+                    load_calls: int) -> dict:
+    """One capacity-ladder point: fleet up, load, scrape cost, down."""
+    sim = await SimCluster(SimConfig(
+        workers=workers, streams=streams, seed=seed)).start()
+    try:
+        load = await sim.run_load(load_calls)
+        scrape_s = await sim.measure_scrape()
+        return {"workers": workers, "register_s": round(sim.register_s, 3),
+                "scrape_ms": round(scrape_s * 1e3, 2), **load,
+                "indexer_nodes": sim.router.indexer.num_nodes(),
+                "errors": sim.schedule_errors,
+                "dead_picks": sim.dead_picks}
+    finally:
+        await sim.stop()
+
+
+async def run_full(args) -> dict:
+    t_start = time.time()
+    ladder = sorted({min(64, args.workers), min(256, args.workers),
+                     args.workers})
+    report = {"seed": args.seed, "workers": args.workers,
+              "streams": args.streams, "started_unix": round(t_start, 3)}
+
+    # 1. capacity ladder
+    curve = []
+    for n in ladder:
+        point = await run_point(n, min(args.streams, n * 32), args.seed,
+                                args.load_calls)
+        print(f"ladder {n:>5} workers: {json.dumps(point)}", flush=True)
+        curve.append(point)
+    report["workers_vs_latency"] = curve
+
+    # 2..4 run on one full-scale cluster
+    sim = await SimCluster(SimConfig(
+        workers=args.workers, streams=args.streams, seed=args.seed)).start()
+    try:
+        probe = await sim.event_rate_probe(events=args.probe_events)
+        print(f"event probe: {json.dumps(probe)}", flush=True)
+        report["events_vs_lag"] = probe
+
+        storms = {}
+        storms["rolling_restart"] = await sim.storm_rolling_restart(
+            fraction=args.restart_fraction, load_calls=args.load_calls)
+        print(f"rolling restart: {json.dumps(storms['rolling_restart'])}",
+              flush=True)
+        storms["lease_expiry"] = await sim.storm_lease_expiry(
+            fraction=0.1, load_calls=args.load_calls // 2)
+        print(f"lease expiry: {json.dumps(storms['lease_expiry'])}",
+              flush=True)
+        storms["watch_disconnect"] = await sim.storm_watch_disconnect(
+            kills=3, load_calls=args.load_calls // 4)
+        print(f"watch disconnect: {json.dumps(storms['watch_disconnect'])}",
+              flush=True)
+        storms["event_lag"] = await sim.storm_event_lag(
+            delay_s=1.5, load_calls=args.load_calls // 4)
+        print(f"event lag: {json.dumps(storms['event_lag'])}", flush=True)
+        report["storms"] = storms
+        report["summary"] = sim.summary()
+    finally:
+        await sim.stop()
+
+    report["elapsed_s"] = round(time.time() - t_start, 1)
+    report["contracts"] = {
+        "zero_schedule_errors": report["summary"]["schedule_errors"] == 0
+        and all(p["errors"] == 0 for p in curve),
+        "zero_dead_picks": report["summary"]["dead_picks"] == 0
+        and all(p["dead_picks"] == 0 for p in curve),
+        "watch_converged": storms["watch_disconnect"]["converged"],
+        "degraded_round_trip": storms["event_lag"]["entered"]
+        and storms["event_lag"]["exited"],
+    }
+    report["ok"] = all(report["contracts"].values())
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cluster_sim", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--workers", type=int, default=1000)
+    ap.add_argument("--streams", type=int, default=20_000)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--load-calls", type=int, default=4000,
+                    help="schedule decisions per load phase")
+    ap.add_argument("--probe-events", type=int, default=8000)
+    ap.add_argument("--restart-fraction", type=float, default=0.3,
+                    help="fleet fraction cycled by the rolling restart")
+    ap.add_argument("--quick", action="store_true",
+                    help="shrink loads for a fast shape check")
+    ap.add_argument("--out", default=os.path.join(REPO_ROOT,
+                                                  "SCALE_r07.json"),
+                    help="evidence artifact path (tools/artifacts.py "
+                         "policy: final name, no clobber)")
+    ap.add_argument("--no-artifact", action="store_true")
+    args = ap.parse_args(argv)
+    if args.quick:
+        args.load_calls = min(args.load_calls, 500)
+        args.probe_events = min(args.probe_events, 1000)
+
+    report = asyncio.run(run_full(args))
+    print(json.dumps(report, indent=1))
+    if not args.no_artifact:
+        from tools.artifacts import write_json
+        write_json(args.out, report)
+        print(f"committed {args.out}", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
