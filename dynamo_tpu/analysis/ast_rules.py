@@ -47,6 +47,14 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       `# dynalint: backoff-ok=<reason>` annotation; at fleet scale an
       un-jittered retry loop re-synchronizes hundreds of workers into
       thundering-herd waves against the discovery store
+- R13 tracing span lifecycle (runtime/tracing.py): (a) a manually-begun
+      span (`begin_span`) must be ended on every path — `with` form or a
+      try/finally containing `end_span`/`.finish()` — else early exits
+      leak the span; (b) span-RECORDING calls inside
+      `# dynalint: hot-path-begin/end` regions must use the deferred
+      recorder (`defer_phase`, what PhaseTimer routes through) instead
+      of allocating span objects between device dispatches; escape
+      hatch `# dynalint: span-ok=<reason>`
 """
 from __future__ import annotations
 
@@ -840,6 +848,127 @@ def r12_retry_loop_without_backoff(tree: ast.AST, lines: List[str],
             "exponential + seeded jitter + flap hysteresis), or "
             "annotate the loop with `# dynalint: backoff-ok=<why a "
             "fixed cadence is correct here>`"))
+    return out
+
+
+# -- R13: span lifecycle + hot-path span deferral -----------------------------
+
+# Two halves of one tracing contract (runtime/tracing.py):
+# (a) a manually-begun span (`begin_span`) MUST be ended on every path —
+#     either the call is a `with` context expression, or an enclosing
+#     try's finally contains an `end_span`/`.finish()` — otherwise an
+#     early return/exception leaks the span and the trace tree shows a
+#     request that "never finished" (the exact artifact trace_explain
+#     exists to rule out);
+# (b) inside `# dynalint: hot-path-begin/end` regions, span-RECORDING
+#     calls (TRACER.span/begin_span/event/record_span/scope_span) are
+#     forbidden — they allocate and walk attrs between two device
+#     dispatches; the deferred recorder (`defer_phase`, what PhaseTimer
+#     routes through) is the only allowed form there.
+# Escape hatch: `# dynalint: span-ok=<reason>` on the line or the line
+# above (e.g. the frontend root span that ends in an idempotent
+# finish() callback every exit funnels through).
+
+_R13_BEGIN = "begin_span"
+_R13_END = {"end_span", "finish"}
+_R13_RECORDING = {"span", "begin_span", "start_span", "event",
+                  "record_span", "scope_span"}
+_R13_ANNOT_RE = re.compile(r"#\s*dynalint:\s*span-ok=\S+")
+
+
+def _calls_named(node: ast.AST, names) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            term = _call_name(n).rsplit(".", 1)[-1]
+            if term in names:
+                return True
+    return False
+
+
+@rule("R13")
+def r13_span_lifecycle(tree: ast.AST, lines: List[str],
+                       path: str) -> List[Finding]:
+    def annotated(ln: int) -> bool:
+        return any(_R13_ANNOT_RE.search(_line(lines, x))
+                   for x in (ln, ln - 1))
+
+    out: List[Finding] = []
+
+    # (a) begin_span without a guaranteed end ---------------------------------
+    safe: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                for n in ast.walk(item.context_expr):
+                    if isinstance(n, ast.Call) and \
+                            _call_name(n).rsplit(".", 1)[-1] == _R13_BEGIN:
+                        safe.add(id(n))
+        elif isinstance(node, ast.Try) and node.finalbody:
+            ends = any(_calls_named(fin, _R13_END)
+                       for fin in node.finalbody)
+            if not ends:
+                continue
+            for stmt in node.body:
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Call) and \
+                            _call_name(n).rsplit(".", 1)[-1] == _R13_BEGIN:
+                        safe.add(id(n))
+    # a begin_span ASSIGNED right before a try/finally-with-end is the
+    # idiomatic pattern: treat `x = begin_span(...)` as safe when the
+    # same FUNCTION holds a try whose finally ends a span
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        has_ending_finally = any(
+            isinstance(t, ast.Try) and t.finalbody
+            and any(_calls_named(f, _R13_END) for f in t.finalbody)
+            for t in ast.walk(fn))
+        if not has_ending_finally:
+            continue
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) and \
+                    _call_name(n).rsplit(".", 1)[-1] == _R13_BEGIN:
+                safe.add(id(n))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) \
+                or _call_name(node).rsplit(".", 1)[-1] != _R13_BEGIN:
+            continue
+        if id(node) in safe or annotated(node.lineno):
+            continue
+        out.append(_finding(
+            "R13", path, lines, node,
+            "`begin_span(...)` with no guaranteed end — an early "
+            "return or exception leaks the span and the trace shows a "
+            "request that never finished",
+            "use `with TRACER.span(...)`, or end the span in a "
+            "try/finally (`TRACER.end_span(span)`), or annotate with "
+            "`# dynalint: span-ok=<why every path still ends it>`"))
+
+    # (b) recording calls inside hot-path regions -----------------------------
+    regions = _hot_path_regions(lines)
+    if regions:
+        def in_region(ln: int) -> bool:
+            return any(a <= ln <= b for a, b in regions)
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) \
+                    or not in_region(node.lineno):
+                continue
+            name = _call_name(node)
+            term = name.rsplit(".", 1)[-1]
+            if term not in _R13_RECORDING or "tracer" not in name.lower():
+                continue
+            if annotated(node.lineno):
+                continue
+            out.append(_finding(
+                "R13", path, lines, node,
+                f"span-recording call `{name}(...)` inside a hot-path "
+                "region — span objects and attr dicts between two "
+                "decode-window dispatches are host time the device "
+                "cannot hide",
+                "record through the deferred recorder instead "
+                "(`TRACER.defer_phase(scope, name, dt)` — what "
+                "PhaseTimer.phase routes through), or annotate with "
+                "`# dynalint: span-ok=<reason>`"))
     return out
 
 

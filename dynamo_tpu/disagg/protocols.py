@@ -44,6 +44,14 @@ class RemotePrefillRequest(pydantic.BaseModel):
     # multimodal: the prefill worker re-encodes these through its own vision
     # tower (pixels travel, embeds don't — they're mesh-layout-dependent)
     mm_parts: Optional[List[ImagePart]] = None
+    # tracing (runtime/tracing.py): the decode worker's span context in
+    # wire form, so the prefill side's queue-wait/prefill/transfer spans
+    # land in the SAME trace as the request that queued the item
+    trace: Optional[dict] = None
+    # enqueue instant (time.time(), same wall-clock convention as
+    # deadline_unix): the dequeuing worker derives the leased-queue wait
+    # span from it without the processes sharing a monotonic clock
+    enqueued_unix: Optional[float] = None
 
 
 class PrefillCompletion(pydantic.BaseModel):

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -36,10 +37,12 @@ import numpy as np
 import msgpack
 
 from dynamo_tpu.disagg.transfer import TransferBackend, _page_sums
+from dynamo_tpu.observability.serving import SERVING
 from dynamo_tpu.runtime import faults
 from dynamo_tpu.runtime.integrity import (
     STATS as INTEGRITY, XFER_STATS, IntegrityError,
 )
+from dynamo_tpu.runtime.tracing import TRACE_KEY, TRACER, TraceContext
 from dynamo_tpu.runtime.transports.base import KVStore
 from dynamo_tpu.runtime.transports.wire import read_frame, write_frame
 
@@ -175,6 +178,16 @@ class KvTransferServer:
     async def _inject_frame(self, frame: Dict) -> None:
         rid = frame["request_id"]
         page_ids = list(frame["page_ids"])
+        # per-fetch inject span (bytes + duration), riding the same
+        # frames as the integrity checksums — the sender shipped its
+        # trace context alongside the page bytes
+        trace = TraceContext.from_wire(frame.get(TRACE_KEY))
+        with TRACER.span("kv.inject", trace, request_id=rid,
+                         pages=len(page_ids)) as isp:
+            await self._inject_frame_inner(frame, rid, page_ids, isp)
+
+    async def _inject_frame_inner(self, frame: Dict, rid: str,
+                                  page_ids: list, isp) -> None:
         shape = tuple(frame["shape"])
         dtype = _np_dtype(frame["dtype"])
         k = np.frombuffer(frame["k"], dtype=dtype).reshape(shape)
@@ -232,6 +245,7 @@ class KvTransferServer:
         self.received_pages += len(page_ids)
         XFER_STATS.fetches += 1
         XFER_STATS.bytes_fetched += payload
+        isp.set(bytes=payload)
 
 
 class RemoteTransferBackend(TransferBackend):
@@ -296,20 +310,43 @@ class RemoteTransferBackend(TransferBackend):
 
     async def send_pages(self, engine_id: str, request_id: str, dst_page_ids,
                          k_pages, v_pages, k_scale=None,
-                         v_scale=None) -> None:
+                         v_scale=None, trace=None) -> None:
         ids = list(dst_page_ids)
         n = len(ids)
         if n == 0:
             return
+        # one span per transfer (staging -> last ack, incl. integrity
+        # re-fetches); bytes/refetches land as attrs on completion, and
+        # every chunk frame carries the trace so the DECODE side records
+        # its per-fetch inject spans in the same trace
+        t0 = time.monotonic()
+        span = TRACER.begin_span("kv.transfer", trace,
+                                 request_id=request_id, pages=n,
+                                 backend="remote", engine_id=engine_id)
+        failed = True
+        try:
+            await self._send_pages_locked(engine_id, request_id, ids,
+                                          k_pages, v_pages, k_scale,
+                                          v_scale, trace, span)
+            failed = False
+        finally:
+            TRACER.end_span(span, error=failed)
+            SERVING.kv_transfer.observe(value=time.monotonic() - t0)
+
+    async def _send_pages_locked(self, engine_id: str, request_id: str, ids,
+                                 k_pages, v_pages, k_scale, v_scale,
+                                 trace, span) -> None:
         lock = self._locks.setdefault(engine_id, asyncio.Lock())
         async with lock:
             conn_retried = False
             refetches = 0
             while True:
                 try:
-                    await self._send_chunks(engine_id, request_id, ids,
-                                            k_pages, v_pages,
-                                            k_scale, v_scale)
+                    sent = await self._send_chunks(engine_id, request_id,
+                                                   ids, k_pages, v_pages,
+                                                   k_scale, v_scale, trace)
+                    if span is not None:
+                        span.set(bytes=sent, refetches=refetches)
                     return
                 except IntegrityRejected:
                     # decode-side verify failed (bytes rotted in staging
@@ -387,15 +424,17 @@ class RemoteTransferBackend(TransferBackend):
 
     async def _send_chunks(self, engine_id: str, request_id: str, ids,
                            k_pages, v_pages, k_scale=None,
-                           v_scale=None) -> None:
+                           v_scale=None, trace=None) -> int:
         """Windowed pipelining: up to window_chunks frames are in flight
         before the oldest ack is awaited, so device→host staging, the wire,
         and the decode-side inject all overlap (the reference gets the same
         overlap from NIXL's async one-sided writes + layer-wise CopyStream,
-        SURVEY.md §2.7 / kv/layer.rs:619-1140)."""
+        SURVEY.md §2.7 / kv/layer.rs:619-1140). Returns payload bytes."""
         reader, writer = await self._connect(engine_id)
         n = len(ids)
         dtype_name = str(np.dtype(k_pages.dtype))
+        trace_wire = trace.to_wire() if trace is not None else None
+        total_bytes = 0
         in_flight: list = []  # chunk sizes awaiting ack, oldest first
 
         async def retire_oldest():
@@ -436,12 +475,16 @@ class RemoteTransferBackend(TransferBackend):
                 frame["k_scale"] = ks_np.tobytes()
                 frame["v_scale"] = vs_np.tobytes()
                 payload += len(frame["k_scale"]) + len(frame["v_scale"])
+            if trace_wire is not None:
+                frame[TRACE_KEY] = trace_wire
             write_frame(writer, frame)
             await writer.drain()
             XFER_STATS.bytes_sent += payload
             XFER_STATS.pages_sent += count
+            total_bytes += payload
             in_flight.append(count)
             if len(in_flight) >= self.window_chunks:
                 await retire_oldest()
         while in_flight:
             await retire_oldest()
+        return total_bytes

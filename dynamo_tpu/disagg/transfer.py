@@ -20,14 +20,17 @@ from __future__ import annotations
 
 import abc
 import logging
+import time
 from typing import Dict
 
 import jax
 
+from dynamo_tpu.observability.serving import SERVING
 from dynamo_tpu.runtime import faults
 from dynamo_tpu.runtime.integrity import (
     STATS as INTEGRITY, XFER_STATS, IntegrityError, page_checksum,
 )
+from dynamo_tpu.runtime.tracing import TRACER
 
 log = logging.getLogger("dynamo_tpu.disagg.transfer")
 
@@ -51,10 +54,14 @@ class TransferBackend(abc.ABC):
     @abc.abstractmethod
     async def send_pages(self, engine_id: str, request_id: str, dst_page_ids,
                          k_pages, v_pages, k_scale=None,
-                         v_scale=None) -> None:
+                         v_scale=None, trace=None) -> None:
         """Inject pages (k/v: [L, Hkv, Nb, ps, hd] on the sender's mesh;
         kv_quant senders also pass the [L, Hkv, Nb, ps] scale stacks)
         into the target engine's cache at dst_page_ids.
+
+        `trace`: optional TraceContext — implementations record a
+        "kv.transfer" span (bytes + pages + duration) under it and
+        observe llm_kv_transfer_seconds either way.
 
         Raises if request_id is no longer pending on the target (the decode
         side timed out and released the pages — injecting would corrupt
@@ -81,11 +88,29 @@ class LocalTransferBackend(TransferBackend):
 
     async def send_pages(self, engine_id: str, request_id: str, dst_page_ids,
                          k_pages, v_pages, k_scale=None,
-                         v_scale=None) -> None:
+                         v_scale=None, trace=None) -> None:
         worker = self._receivers.get(engine_id)
         if worker is None:
             raise KeyError(f"unknown decode engine {engine_id!r}")
         ids = list(dst_page_ids)
+        t0 = time.monotonic()
+        span = TRACER.begin_span("kv.transfer", trace,
+                                 request_id=request_id, pages=len(ids),
+                                 backend="local")
+        failed = True
+        try:
+            await self._send_pages_inner(engine_id, request_id, ids,
+                                         k_pages, v_pages, k_scale,
+                                         v_scale, span)
+            failed = False
+        finally:
+            TRACER.end_span(span, error=failed)
+            SERVING.kv_transfer.observe(value=time.monotonic() - t0)
+
+    async def _send_pages_inner(self, engine_id: str, request_id: str, ids,
+                                k_pages, v_pages, k_scale, v_scale,
+                                span) -> None:
+        worker = self._receivers[engine_id]
         if faults.REGISTRY.enabled \
                 and faults.REGISTRY.armed("remote_transfer.fetch_page"):
             # chaos mode: route through a host staging hop so the
@@ -110,6 +135,8 @@ class LocalTransferBackend(TransferBackend):
             ks.nbytes + vs.nbytes if ks is not None else 0)
         XFER_STATS.bytes_sent += nbytes
         XFER_STATS.pages_sent += len(ids)
+        if span is not None:
+            span.set(bytes=nbytes)
 
         def inject(eng):
             # guard against decode-side timeout/release: the pages may have

@@ -31,6 +31,7 @@ from dynamo_tpu.protocols.common import (
     EngineOutput, FinishReason, PreprocessedRequest,
 )
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.tracing import TRACER, TraceContext
 
 log = logging.getLogger("dynamo_tpu.disagg")
 
@@ -172,29 +173,41 @@ class DisaggDecodeWorker(NativeEngineWorker):
             # absolute wall-clock instant, so a prefill worker dequeuing
             # it after expiry drops it instead of burning compute
             remaining = context.time_remaining()
-            await self.prefill_queue.enqueue(RemotePrefillRequest(
-                engine_id=self.engine_id,
-                request_id=rid,
-                token_ids=list(pre.token_ids),
-                sampling=pre.sampling,
-                stop=pre.stop,
-                page_ids=alloc.page_ids,
-                num_cached_tokens=alloc.num_cached_tokens,
-                page_size=self.engine.cfg.page_size,
-                notify_subject=self.notify_subject,
-                mm_parts=mm_parts,
-                deadline_unix=(time.time() + remaining
-                               if remaining is not None else None),
-            ))
-            stop_task = asyncio.create_task(context.wait_stopped())
-            try:
-                await asyncio.wait(
-                    {asyncio.ensure_future(fut), stop_task},
-                    timeout=self.prefill_timeout_s,
-                    return_when=asyncio.FIRST_COMPLETED)
-            finally:
-                stop_task.cancel()
-            self._completions.pop(rid, None)
+            # "prefill.remote" covers enqueue -> completion/timeout; the
+            # queued item carries this span's context so the prefill
+            # side's queue-wait/run/transfer spans nest under it in the
+            # request's ONE trace
+            with TRACER.span("prefill.remote", context.trace,
+                             request_id=rid, pages=len(alloc.page_ids),
+                             cached_tokens=alloc.num_cached_tokens) as rsp:
+                rtc = rsp.context()
+                await self.prefill_queue.enqueue(RemotePrefillRequest(
+                    engine_id=self.engine_id,
+                    request_id=rid,
+                    token_ids=list(pre.token_ids),
+                    sampling=pre.sampling,
+                    stop=pre.stop,
+                    page_ids=alloc.page_ids,
+                    num_cached_tokens=alloc.num_cached_tokens,
+                    page_size=self.engine.cfg.page_size,
+                    notify_subject=self.notify_subject,
+                    mm_parts=mm_parts,
+                    deadline_unix=(time.time() + remaining
+                                   if remaining is not None else None),
+                    trace=rtc.to_wire() if rtc is not None else None,
+                    enqueued_unix=time.time(),
+                ))
+                stop_task = asyncio.create_task(context.wait_stopped())
+                try:
+                    await asyncio.wait(
+                        {asyncio.ensure_future(fut), stop_task},
+                        timeout=self.prefill_timeout_s,
+                        return_when=asyncio.FIRST_COMPLETED)
+                finally:
+                    stop_task.cancel()
+                self._completions.pop(rid, None)
+                rsp.set(completed=fut.done(),
+                        stopped=context.is_stopped)
             if context.is_stopped:
                 # client went away while the prefill was queued/running:
                 # tell the prefill fleet to drop/abort it (a late transfer
@@ -249,11 +262,14 @@ class DisaggDecodeWorker(NativeEngineWorker):
                 await self.submit(lambda eng: eng.release_remote(rid))
                 holding = False
                 if not (hidden_stop or eos):
+                    TRACER.event("decode.emit", context.trace, n=1,
+                                 first=True)
                     yield EngineOutput(token_ids=[first]).model_dump(
                         exclude_none=True)
                 yield EngineOutput(finish_reason=reason).model_dump(
                     exclude_none=True)
                 return
+            TRACER.event("decode.emit", context.trace, n=1, first=True)
             yield EngineOutput(token_ids=[first]).model_dump(
                 exclude_none=True)
             q = self._register(rid)
@@ -458,25 +474,38 @@ class PrefillWorker:
 
     async def _handle(self, req: RemotePrefillRequest, token: str) -> None:
         rid = req.request_id
+        # the decode side's prefill.remote span context travels in the
+        # queued item: queue-wait + prefill-run + transfer spans land in
+        # the same trace across the queue hop
+        trace = TraceContext.from_wire(req.trace)
+        if req.enqueued_unix is not None:
+            # leased-queue wait, derived from the wall-clock enqueue
+            # instant (processes share no monotonic clock)
+            TRACER.record_span(
+                "queue.wait", trace,
+                max(0.0, time.time() - req.enqueued_unix),
+                request_id=rid)
         try:
             eng_ps = self.worker.engine.cfg.page_size
             if req.page_size != eng_ps:
                 raise ValueError(
                     f"page size mismatch: decode {req.page_size} != "
                     f"prefill {eng_ps}")
-            q = self.worker._register(rid)
-            try:
-                pre = PreprocessedRequest(
-                    request_id=rid, token_ids=req.token_ids,
-                    sampling=req.sampling, stop=req.stop,
-                    mm_parts=req.mm_parts)
-                er = _to_engine_request(pre)
-                er.prefill_only = True
-                self.worker._pending_adds.append(er)
-                self.worker._wake.set()
-                frame: EngineOutput = await q.get()
-            finally:
-                self.worker._queues.pop(rid, None)
+            with TRACER.span("prefill.run", trace, request_id=rid,
+                             tokens=len(req.token_ids)):
+                q = self.worker._register(rid)
+                try:
+                    pre = PreprocessedRequest(
+                        request_id=rid, token_ids=req.token_ids,
+                        sampling=req.sampling, stop=req.stop,
+                        mm_parts=req.mm_parts)
+                    er = _to_engine_request(pre)
+                    er.prefill_only = True
+                    self.worker._pending_adds.append(er)
+                    self.worker._wake.set()
+                    frame: EngineOutput = await q.get()
+                finally:
+                    self.worker._queues.pop(rid, None)
             if frame.finish_reason != FinishReason.PREFILL_DONE:
                 raise RuntimeError(
                     f"prefill ended with {frame.finish_reason}: {frame.text}")
@@ -494,7 +523,8 @@ class PrefillWorker:
                 req.engine_id, rid, req.page_ids[start_page:],
                 pages["k"], pages["v"],
                 k_scale=pages.get("k_scale"),
-                v_scale=pages.get("v_scale"))
+                v_scale=pages.get("v_scale"),
+                trace=trace)
             await self.worker.submit(lambda eng: eng.release_parked(rid))
             self.completed += 1
             await self._notify(req, PrefillCompletion(

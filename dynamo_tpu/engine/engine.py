@@ -203,6 +203,10 @@ class NativeEngine:
         # harness mode only, it defeats the pipeline's overlap
         from dynamo_tpu.observability.metrics import PhaseTimer
         self.phases = PhaseTimer()
+        # decode pipeline legs double as trace spans under the "engine"
+        # scope (runtime/tracing.py defer_phase — the hot-path deferred
+        # recorder; branch-only when tracing is disabled)
+        self.phases.trace_scope = "engine"
         self.profile_sync = False
         # pipeline occupancy counters (EngineMetrics / /metrics gauges)
         self.decode_windows = 0       # windows dispatched via the window path

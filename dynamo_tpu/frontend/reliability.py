@@ -46,11 +46,13 @@ import time
 from typing import Dict, Optional, Set
 
 from dynamo_tpu.observability.metrics import MetricsRegistry
+from dynamo_tpu.observability.serving import SERVING
 from dynamo_tpu.protocols.common import (
     EngineOutput, FinishReason, PreprocessedRequest,
 )
 from dynamo_tpu.runtime.deadline import DeadlineExceeded, with_deadline
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.tracing import TRACE_KEY, TRACER
 
 log = logging.getLogger("dynamo_tpu.reliability")
 
@@ -345,6 +347,25 @@ class ReliableClient:
 
     async def _pick_instance(self, pre: PreprocessedRequest,
                              ctx: Context) -> str:
+        # one "schedule" span per pick, covering router scoring AND the
+        # load-balancing fallback. The llm_schedule_seconds histogram is
+        # observed by KvRouter.schedule itself when a router is wired
+        # (cluster_sim drives the router directly); only the fallback
+        # path observes here, so a pick is never double-counted.
+        t0 = time.monotonic()
+        picked = None
+        span = TRACER.begin_span("schedule", ctx.trace)
+        try:
+            picked = await self._pick_instance_inner(pre, ctx)
+            return picked
+        finally:
+            if self.router is None:
+                SERVING.schedule.observe(value=time.monotonic() - t0)
+            TRACER.end_span(span, instance=picked,
+                            error=picked is None)
+
+    async def _pick_instance_inner(self, pre: PreprocessedRequest,
+                                   ctx: Context) -> str:
         blocked = self.breaker.blocked()
         if self.router is not None:
             try:
@@ -409,6 +430,11 @@ class ReliableClient:
         pre = (request if isinstance(request, PreprocessedRequest)
                else PreprocessedRequest.model_validate(request))
         ctx = context or Context()
+        if ctx.trace is None:
+            # direct API callers (no HTTP frontend — chaos scenarios,
+            # embedders) still get a per-request trace root when tracing
+            # is enabled; None (one branch) otherwise
+            ctx.trace = TRACER.start_trace()
         if ctx.time_remaining() is None \
                 and self.policy.request_deadline_s is not None:
             ctx.set_deadline(self.policy.request_deadline_s)
@@ -439,6 +465,21 @@ class ReliableClient:
             req = self._attempt_request(pre, committed, attempt_no)
             sub_ctx = ctx.child()
             instance = None
+            # attempt span: retry/migration clones ({id}~a{n}) carry the
+            # PARENT request's trace — the attempt span nests under the
+            # request root, and everything the worker records for this
+            # dispatch nests under the attempt (sub_ctx's baggage ships
+            # the attempt's span id). `outcome` must agree with the
+            # counters below (migrated<->migrations, retried<->retries;
+            # audited by tests/test_tracing.py).
+            aspan = TRACER.begin_span(
+                "attempt", ctx.trace, attempt=attempt_no,
+                engine_request_id=req.request_id,
+                resumed_tokens=len(committed))
+            if aspan is not None:
+                sub_ctx.trace = aspan.context()
+                sub_ctx.baggage[TRACE_KEY] = sub_ctx.trace.to_wire()
+            outcome = "abandoned"
             # breaker bookkeeping: every attempt must end in exactly one of
             # record_success / record_failure / release_probe — an attempt
             # abandoned for reasons unrelated to the instance (caller
@@ -456,6 +497,7 @@ class ReliableClient:
                 except asyncio.CancelledError:
                     raise
                 except DeadlineExceeded:
+                    outcome = "deadline"
                     continue      # loop head reports deadline_exceeded
                 except Exception as e:
                     last_error = f"dispatch to {instance}: {e}"
@@ -464,11 +506,13 @@ class ReliableClient:
                         outcome_recorded = True
                     failures += 1
                     if failures >= self.policy.max_attempts:
+                        outcome = "gave_up"
                         yield _frame(
                             FinishReason.ERROR,
                             text=f"gave up after {failures} attempts: "
                                  f"{last_error}")
                         return
+                    outcome = "retried"
                     self.metrics.retries.inc()
                     await self._backoff(failures, ctx)
                     continue
@@ -502,6 +546,7 @@ class ReliableClient:
                                 # instance's fault — forward it
                                 self.breaker.record_success(instance)
                                 outcome_recorded = True
+                                outcome = "rejected_final"
                                 yield frame
                                 return
                             error = frame.get("text") or "worker error frame"
@@ -520,6 +565,7 @@ class ReliableClient:
                         if fr is not None:
                             self.breaker.record_success(instance)
                             outcome_recorded = True
+                            outcome = "success"
                             return
                 except asyncio.CancelledError:
                     raise
@@ -537,8 +583,10 @@ class ReliableClient:
                             pass
 
                 if deadline_hit:
+                    outcome = "deadline"
                     continue      # loop head reports deadline_exceeded
                 if ctx.is_stopped:
+                    outcome = "cancelled"
                     yield _frame(FinishReason.CANCELLED)
                     return
                 last_error = f"{instance}: {error}"
@@ -546,22 +594,28 @@ class ReliableClient:
                 outcome_recorded = True
                 failures += 1
                 if failures >= self.policy.max_attempts:
+                    outcome = "gave_up"
                     yield _frame(
                         FinishReason.ERROR,
                         text=f"gave up after {failures} attempts "
                              f"without progress: {last_error}")
                     return
                 if committed:
+                    outcome = "migrated"
                     self.metrics.migrations.inc()
                     log.warning("migrating %s (%d tokens committed): %s",
                                 ctx.id, len(committed), last_error)
                 else:
+                    outcome = "retried"
                     self.metrics.retries.inc()
                     log.warning("retrying %s: %s", ctx.id, last_error)
                 await self._backoff(failures, ctx)
             finally:
                 if instance is not None and not outcome_recorded:
                     self.breaker.release_probe(instance)
+                TRACER.end_span(
+                    aspan, outcome=outcome, instance=instance,
+                    error=outcome in ("gave_up", "deadline"))
 
 
 def _frame(reason: FinishReason, text: Optional[str] = None) -> dict:

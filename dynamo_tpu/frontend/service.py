@@ -20,6 +20,7 @@ from dynamo_tpu.frontend.http import (
     HttpError, HttpServer, Request, Response, StreamingResponse,
 )
 from dynamo_tpu.observability.metrics import MetricsRegistry
+from dynamo_tpu.observability.serving import SERVING
 from dynamo_tpu.protocols import sse
 from dynamo_tpu.protocols.delta import (
     aggregate_chat_chunks, aggregate_completion_chunks,
@@ -28,6 +29,7 @@ from dynamo_tpu.protocols.openai import (
     ChatCompletionRequest, CompletionRequest, ModelInfo, ModelList,
 )
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.tracing import TRACE_KEY, TRACER
 
 log = logging.getLogger("dynamo_tpu.frontend")
 
@@ -164,7 +166,10 @@ class HttpService:
 
     async def _metrics(self, req: Request) -> Response:
         self._refresh_robustness_gauges()
-        return Response.text(self.registry.render(),
+        # serving-path latency histograms (TTFT/ITL/queue/schedule/
+        # transfer) live on the process-global SERVING registry —
+        # observed at the serving layers, appended at render
+        return Response.text(self.registry.render() + SERVING.render(),
                              content_type="text/plain; version=0.0.4")
 
     def _refresh_robustness_gauges(self) -> None:
@@ -221,18 +226,36 @@ class HttpService:
                    model: str, start_stream):
         request_type = "stream" if oai_req.stream else "unary"
         t0 = time.perf_counter()
+        # trace root: one trace per HTTP request, created at ingest so
+        # the admission wait is already inside it. The context rides
+        # ctx.baggage and crosses every wire hop from here on. The root
+        # span ends in finish() below (every exit funnels there) —
+        # dynalint: span-ok=root-span-ends-in-the-idempotent-finish-callback
+        trace = TRACER.start_trace()
+        root = TRACER.begin_span("http.request", trace, model=model,
+                                 endpoint=endpoint,
+                                 request_type=request_type)
         admitted = False
         if self.admission is not None:
             from dynamo_tpu.frontend.reliability import AdmissionShed
             try:
+                t_adm = time.monotonic()
                 await self.admission.acquire()
                 admitted = True
+                wait = time.monotonic() - t_adm
+                SERVING.queue_wait.observe(value=wait)
+                TRACER.record_span("admission.wait",
+                                   root.context() if root else None, wait)
             except AdmissionShed as e:
                 self._requests.inc(model, endpoint, request_type, "shed")
+                TRACER.end_span(root, status="shed", error=True)
                 raise HttpError(
                     429, "server overloaded, retry later",
                     headers={"retry-after": str(e.retry_after_s)})
         ctx = Context()
+        if root is not None:
+            ctx.trace = root.context()
+            ctx.baggage[TRACE_KEY] = ctx.trace.to_wire()
         if self.default_deadline_s is not None:
             ctx.set_deadline(self.default_deadline_s)
         self._inflight.inc(model)
@@ -251,6 +274,7 @@ class HttpService:
             self._inflight.dec(model)
             self._requests.inc(model, endpoint, request_type, status)
             self._duration.observe(model, value=time.perf_counter() - t0)
+            TRACER.end_span(root, status=status, error=status == "error")
 
         try:
             chunk_gen = await _ensure_aiter(start_stream(ctx))

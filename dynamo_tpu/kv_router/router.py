@@ -19,6 +19,7 @@ from dynamo_tpu.kv_router.publisher import (
     KV_EVENTS_SUBJECT, KV_HIT_RATE_SUBJECT, KvMetricsAggregator,
 )
 from dynamo_tpu.kv_router.scheduler import KvScheduler, WorkerSelector
+from dynamo_tpu.observability.serving import SERVING
 from dynamo_tpu.runtime.backoff import Backoff
 from dynamo_tpu.runtime.cpstats import CP_STATS
 
@@ -204,6 +205,7 @@ class KvRouter:
         dropped from scoring unless that would leave no candidates.
         DRAINING instances join the exclusion the same way (planned
         maintenance takes no new assignments)."""
+        t0 = time.monotonic()
         draining = getattr(self.client, "draining_ids", None)
         if draining is not None:
             drains = draining()
@@ -212,6 +214,12 @@ class KvRouter:
         overlap = self.find_matches_for_tokens(tokens)
         worker_id = self.scheduler.schedule(len(tokens), overlap,
                                             exclude=exclude)
+        # serving-path histogram (llm_schedule_seconds): observed HERE,
+        # at the real scheduling decision, so the frontend's kv-routed
+        # path and a bare router (cluster_sim) account identically; the
+        # reliability layer's fallback pick observes only when no
+        # router is wired
+        SERVING.schedule.observe(value=time.monotonic() - t0)
         if self.publish_hit_events:
             for ev in self.scheduler.drain_hit_events():
                 await self.component.publish(KV_HIT_RATE_SUBJECT, {

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import AsyncIterator, Optional
 
 from dynamo_tpu.llm.backend import BackendPostprocessor
@@ -23,6 +24,7 @@ from dynamo_tpu.protocols.common import (
 from dynamo_tpu.protocols.delta import (
     ChatDeltaGenerator, CompletionDeltaGenerator,
 )
+from dynamo_tpu.observability.serving import SERVING
 from dynamo_tpu.protocols.openai import (
     ChatCompletionRequest, CompletionRequest, Usage,
 )
@@ -184,6 +186,13 @@ class Pipeline:
                 await q.put((i, None, None))
 
         pumps = [asyncio.create_task(pump(i)) for i in range(n)]
+        # serving-path latency histograms (observability/serving.py):
+        # TTFT = request start -> first token-carrying frame, ITL = gap
+        # between successive token frames, both per choice stream at the
+        # frame (commit) boundary — the same boundary bench.py measures
+        model_label = pre.model or self.card.name
+        t_start = time.monotonic()
+        last_emit: dict = {}
         posts = [BackendPostprocessor(tokenizer, pre.stop.stop or ())
                  for _ in range(n)]
         shapers = [_LogprobShaper(kind, self._token_str,
@@ -214,6 +223,15 @@ class Pipeline:
                     continue
                 frame = EngineOutput.model_validate(raw)
                 n_out += len(frame.token_ids)
+                if frame.token_ids:
+                    now = time.monotonic()
+                    prev = last_emit.get(i)
+                    if prev is None:
+                        SERVING.ttft.observe(model_label,
+                                             value=now - t_start)
+                    else:
+                        SERVING.itl.observe(model_label, value=now - prev)
+                    last_emit[i] = now
                 res = posts[i].process(frame)
                 lp_obj = shapers[i].push(frame, posts[i].last_pieces,
                                          res.text)

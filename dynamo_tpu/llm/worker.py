@@ -24,6 +24,7 @@ from dynamo_tpu.protocols.common import (
     EngineOutput, FinishReason, PreprocessedRequest,
 )
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.tracing import TRACER
 
 log = logging.getLogger("dynamo_tpu.worker")
 
@@ -268,6 +269,7 @@ class NativeEngineWorker(AsyncEngine):
         """Drain a request's frame queue, honoring client-side stop."""
         stop = asyncio.create_task(context.wait_stopped())
         get = None
+        trace = context.trace
         try:
             while True:
                 get = asyncio.create_task(q.get())
@@ -286,6 +288,12 @@ class NativeEngineWorker(AsyncEngine):
                     return
                 frame: EngineOutput = get.result()
                 get = None
+                if frame.token_ids:
+                    # per-emit instant: trace_explain derives per-window
+                    # decode ITL from the gaps between these (one branch
+                    # when tracing is off)
+                    TRACER.event("decode.emit", trace,
+                                 n=len(frame.token_ids))
                 yield frame.model_dump(exclude_none=True)
                 if frame.finish_reason is not None:
                     return

@@ -288,7 +288,11 @@ class MetricsExporter:
                 pass  # drain headers
             if b"/metrics" in line:
                 self._refresh_cp_gauges()
-                body = self.registry.render().encode()
+                # serving-path histograms (TTFT/ITL/queue/schedule/
+                # transfer) observed in-process fold in at render, the
+                # same way the frontend's /metrics appends them
+                from dynamo_tpu.observability.serving import SERVING
+                body = (self.registry.render() + SERVING.render()).encode()
                 writer.write(
                     b"HTTP/1.1 200 OK\r\ncontent-type: text/plain; "
                     b"version=0.0.4\r\ncontent-length: %d\r\n\r\n%s"

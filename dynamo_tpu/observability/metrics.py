@@ -25,11 +25,19 @@ class PhaseTimer:
     each phase in `with timer.phase(name):`; tools/decode_profile.py reads
     the accumulated split and emits the committed attribution artifact.
     Overhead is two perf_counter() calls per phase — always on.
+
+    When `trace_scope` is set (the engine sets "engine"), each phase is
+    ALSO recorded as a span through the tracer's deferred recorder
+    (runtime/tracing.py `defer_phase`): branch-only when tracing is
+    disabled, one tuple append when enabled — the only recording form
+    allowed inside `# dynalint: hot-path-begin/end` regions (R13),
+    which is exactly where the engine's phase() calls live.
     """
 
     def __init__(self):
         self.seconds: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
+        self.trace_scope: Optional[str] = None
 
     def add(self, name: str, dt: float) -> None:
         self.seconds[name] = self.seconds.get(name, 0.0) + dt
@@ -41,7 +49,11 @@ class PhaseTimer:
         try:
             yield
         finally:
-            self.add(name, time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.add(name, dt)
+            if self.trace_scope is not None:
+                from dynamo_tpu.runtime.tracing import TRACER
+                TRACER.defer_phase(self.trace_scope, name, dt)
 
     def reset(self) -> None:
         self.seconds.clear()

@@ -23,6 +23,7 @@ from dynamo_tpu.runtime.backoff import Backoff
 from dynamo_tpu.runtime.cpstats import CP_STATS
 from dynamo_tpu.runtime.deadline import with_deadline
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, FnEngine
+from dynamo_tpu.runtime.tracing import TRACER
 
 log = logging.getLogger("dynamo_tpu.component")
 
@@ -217,15 +218,30 @@ class Endpoint:
             req = msgpack.unpackb(env["payload"], raw=False)
 
             async def run():
+                # worker-side stream span: one per served dispatch, any
+                # engine type. The wire-carried trace parents it under
+                # the dispatching attempt span; re-parenting ctx nests
+                # everything the engine records (disagg child spans,
+                # decode.emit instants) under this worker span.
+                span = TRACER.begin_span("worker.generate", ctx.trace,
+                                         request_id=ctx.id,
+                                         subject=subject)
+                if span is not None:
+                    ctx.trace = span.context()
+                failed = True
                 try:
-                    gen = engine.generate(req, ctx)
-                except Exception as e:  # engine rejected the request outright
-                    log.exception("engine failure on %s", subject)
-                    await dataplane.close_with_error(
-                        writer, f"{type(e).__name__}: {e}")
-                    return
-                # generator-time failures are forwarded by pump_stream
-                await dataplane.pump_stream(writer, _packed(gen), ctx)
+                    try:
+                        gen = engine.generate(req, ctx)
+                    except Exception as e:  # engine rejected outright
+                        log.exception("engine failure on %s", subject)
+                        await dataplane.close_with_error(
+                            writer, f"{type(e).__name__}: {e}")
+                        return
+                    # generator-time failures forwarded by pump_stream
+                    await dataplane.pump_stream(writer, _packed(gen), ctx)
+                    failed = False
+                finally:
+                    TRACER.end_span(span, error=failed)
 
             task = asyncio.create_task(run())
             inflight.add(task)

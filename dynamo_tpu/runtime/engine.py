@@ -14,6 +14,8 @@ import uuid
 import weakref
 from typing import Any, AsyncIterator, Dict, Optional
 
+from dynamo_tpu.runtime.tracing import TRACE_KEY, TraceContext
+
 
 class Context:
     """Request envelope: id, typed baggage, cooperative stop/kill signals,
@@ -35,6 +37,13 @@ class Context:
                  deadline_s: Optional[float] = None):
         self.id = request_id or uuid.uuid4().hex
         self.baggage: Dict[str, Any] = dict(baggage or {})
+        # trace context (runtime/tracing.py): rides baggage under
+        # TRACE_KEY, so it crosses the wire with the dispatch envelope
+        # and re-hydrates here on the serving side. None when the
+        # request is untraced (tracing disabled, or a bare Context).
+        self.trace: Optional[TraceContext] = (
+            TraceContext.from_wire(self.baggage.get(TRACE_KEY))
+            if self.baggage else None)
         self._stopped = asyncio.Event()
         self._killed = asyncio.Event()
         self._deadline: Optional[float] = None
@@ -94,6 +103,8 @@ class Context:
         weakly, so an abandoned child never leaks)."""
         c = Context(self.id, self.baggage)
         c._deadline = self._deadline
+        if c.trace is None:
+            c.trace = self.trace  # programmatic trace not yet in baggage
         if self.is_stopped:
             c._stopped.set()
         if self.is_killed:

@@ -364,14 +364,22 @@ class SimCluster:
     async def schedule_once(self, stream_idx: int) -> Optional[str]:
         toks = self._stream_tokens(stream_idx)
         t0 = time.perf_counter()
-        try:
-            pick = await self.router.schedule(toks)
-        except Exception:
-            self.schedule_errors += 1
-            log.exception("schedule failed for stream %d", stream_idx)
-            return None
-        finally:
-            self.schedule_calls += 1
+        # storm trace capture (tools/cluster_sim.py --trace): one span
+        # per schedule decision under the "router" scope; NOOP_SPAN
+        # when tracing is off, so the capacity numbers are unaffected
+        from dynamo_tpu.runtime.tracing import TRACER
+        with TRACER.scope_span("router.schedule", "router",
+                               stream=stream_idx) as sp:
+            try:
+                pick = await self.router.schedule(toks)
+            except Exception:
+                self.schedule_errors += 1
+                log.exception("schedule failed for stream %d", stream_idx)
+                sp.set(error_pick=True)
+                return None
+            finally:
+                self.schedule_calls += 1
+            sp.set(instance=pick)
         self.latencies_us.append((time.perf_counter() - t0) * 1e6)
         # contract: the fence reflects APPLIED watch events; a pick
         # inside it means the router routed onto a known corpse

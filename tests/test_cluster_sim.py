@@ -52,9 +52,16 @@ def test_percentile_edges():
     assert percentile(list(map(float, range(100))), 0.99) == 98.0
 
 
-def test_cluster_sim_smoke_64_workers_storms_hold_contracts():
+def test_cluster_sim_smoke_64_workers_storms_hold_contracts(tmp_path):
     """The tier-1 sim smoke: seeded, deterministic storm membership,
-    every routing contract enforced end to end."""
+    every routing contract enforced end to end — with trace capture on
+    (tools/cluster_sim.py --trace path): every schedule decision during
+    the storms lands as a router-scope span, exported via
+    tools/artifacts.py into a chrome-loadable artifact."""
+    from dynamo_tpu.runtime.tracing import TRACER, chrome_trace
+    TRACER.configure(enabled=True, sample_rate=1.0)
+    TRACER.drain()
+
     async def main():
         sim = await SimCluster(SimConfig(
             workers=64, streams=512, seed=11, lease_ttl_s=2.0,
@@ -94,10 +101,32 @@ def test_cluster_sim_smoke_64_workers_storms_hold_contracts():
             assert summary["schedule_errors"] == 0
             assert summary["dead_picks"] == 0
             assert summary["p99_us"] > 0
+            return summary
         finally:
             await sim.stop()
 
-    asyncio.run(asyncio.wait_for(main(), 120))
+    try:
+        summary = asyncio.run(asyncio.wait_for(main(), 120))
+        # storm trace capture: one span per schedule decision, written
+        # through the evidence policy, chrome twin loadable
+        import json
+
+        from tools.artifacts import append_jsonl, write_json
+        spans = TRACER.drain()
+        sched = [s for s in spans if s["name"] == "router.schedule"]
+        assert len(sched) == summary["schedule_calls"]
+        assert all(s["trace_id"] == "scope:router" for s in sched)
+        assert any("instance" in (s["attrs"] or {}) for s in sched)
+        out = str(tmp_path / "scale_trace.jsonl")
+        for s in spans:
+            append_jsonl(out, s)
+        write_json(out + ".chrome.json", chrome_trace(spans),
+                   overwrite=True)
+        with open(out + ".chrome.json") as f:
+            assert json.load(f)["traceEvents"]
+    finally:
+        TRACER.configure(enabled=False)
+        TRACER.drain()
 
 
 def test_lease_expiry_burst_prunes_then_recovers():

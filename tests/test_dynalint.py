@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R12), the
+- one positive AND one negative fixture per AST rule (R1-R13), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -744,6 +744,109 @@ def test_r12_live_on_current_control_plane_tree():
         with open(path) as f:
             found = lint_source(f.read(), rel)
         assert not [x for x in found if x.rule == "R12"], rel
+
+
+# -- R13: span lifecycle + hot-path span deferral ------------------------------
+
+def test_r13_flags_begin_span_without_guaranteed_end():
+    leaky = """
+        from dynamo_tpu.runtime.tracing import TRACER
+
+        async def serve_one(trace, req):
+            span = TRACER.begin_span("serve", trace)
+            if req.bad:
+                return None          # span leaks on this path
+            result = await req.run()
+            TRACER.end_span(span)
+            return result
+    """
+    assert "R13" in rules(lint(leaky))
+
+
+def test_r13_quiet_on_with_form_and_try_finally():
+    with_form = """
+        from dynamo_tpu.runtime.tracing import TRACER
+
+        async def serve_one(trace, req):
+            with TRACER.span("serve", trace) as sp:
+                sp.set(n=1)
+                return await req.run()
+    """
+    assert "R13" not in rules(lint(with_form))
+    finally_form = """
+        from dynamo_tpu.runtime.tracing import TRACER
+
+        async def serve_one(trace, req):
+            span = TRACER.begin_span("serve", trace)
+            try:
+                return await req.run()
+            finally:
+                TRACER.end_span(span)
+    """
+    assert "R13" not in rules(lint(finally_form))
+    annotated = """
+        from dynamo_tpu.runtime.tracing import TRACER
+
+        async def serve_one(trace, req, finish_cb):
+            # dynalint: span-ok=ends-in-the-idempotent-finish-callback
+            span = TRACER.begin_span("serve", trace)
+            finish_cb.register(span)
+            return await req.run()
+    """
+    assert "R13" not in rules(lint(annotated))
+
+
+def test_r13_flags_span_recording_in_hot_path_region():
+    hot = """
+        from dynamo_tpu.runtime.tracing import TRACER
+
+        def _pipeline_step(self, plan, trace):
+            # dynalint: hot-path-begin
+            with TRACER.span("window", trace):
+                outs = self._dispatch_staged(plan)
+            TRACER.event("emit", trace, n=len(outs))
+            # dynalint: hot-path-end
+            return outs
+    """
+    found = [x for x in lint(hot) if x.rule == "R13"]
+    assert len(found) == 2          # the span AND the event
+
+
+def test_r13_quiet_on_deferred_recorder_in_region():
+    deferred = """
+        from dynamo_tpu.runtime.tracing import TRACER
+
+        def _pipeline_step(self, plan, t0, dt):
+            # dynalint: hot-path-begin
+            outs = self._dispatch_staged(plan)
+            TRACER.defer_phase("engine", "dispatch", dt)
+            # dynalint: hot-path-end
+            return outs
+    """
+    assert "R13" not in rules(lint(deferred))
+    # outside a region the same recording calls are fine
+    cold = """
+        from dynamo_tpu.runtime.tracing import TRACER
+
+        def commit(self, plan, trace):
+            TRACER.event("emit", trace, n=1)
+    """
+    assert "R13" not in rules(lint(cold))
+
+
+def test_r13_live_on_current_tree():
+    """Every begin_span in the live tree ends on all paths (or carries a
+    justified span-ok), and no hot-path region records spans directly —
+    the engine's regions route through PhaseTimer -> defer_phase."""
+    import glob
+    scoped = sorted(glob.glob(os.path.join(REPO, "dynamo_tpu/**/*.py"),
+                              recursive=True))
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R13"], rel
 
 
 # -- jaxpr invariants ----------------------------------------------------------

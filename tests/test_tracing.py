@@ -1,0 +1,494 @@
+"""End-to-end per-request tracing (runtime/tracing.py) + serving-path
+latency histograms (observability/serving.py).
+
+Covers the ISSUE-8 acceptance contracts:
+- one trace_id spans frontend -> schedule -> queue -> remote prefill ->
+  KV transfer (byte counts) -> decode emits, through the REAL stack
+  (HttpService + ModelWatcher + ReliableClient over the in-memory
+  control plane + DisaggDecodeWorker/PrefillWorker on tiny engines);
+- disabled tracing is a branch-only no-op (singleton span, empty rings);
+- seeded sampling is deterministic and errors survive sampling;
+- attempt spans agree with the reliability counters (migration audit);
+- llm_ttft_seconds / llm_itl_seconds / llm_queue_wait_seconds render on
+  the frontend /metrics with correct counts for a served request;
+- tools/trace_explain.py renders a timeline from the COMMITTED disagg
+  trace artifact (TRACE_DISAGG_r08.jsonl), and the chrome export loads.
+"""
+import asyncio
+import json
+import os
+
+import pytest
+
+from dynamo_tpu.observability.serving import SERVING
+from dynamo_tpu.runtime.tracing import (
+    NOOP_SPAN, TRACE_KEY, TRACER, TraceContext, chrome_trace,
+)
+from dynamo_tpu.runtime.engine import Context
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMMITTED_TRACE = os.path.join(REPO_ROOT, "TRACE_DISAGG_r08.jsonl")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _tracer_off_between_tests():
+    """Every test starts from the production default (disabled) and
+    leaves no spans behind for the next one."""
+    TRACER.configure(enabled=False, sample_rate=1.0, seed=0)
+    TRACER.drain()
+    yield
+    TRACER.configure(enabled=False, sample_rate=1.0, seed=0)
+    TRACER.drain()
+
+
+# -- core machinery -----------------------------------------------------------
+
+
+def test_disabled_tracing_is_branch_only_noop():
+    """Off (the default): no trace objects, the SAME pre-allocated span
+    singleton for every call, nothing recorded anywhere."""
+    assert TRACER.start_trace() is None
+    t = TraceContext("tid")
+    assert TRACER.span("a", t) is NOOP_SPAN
+    assert TRACER.span("b", t, x=1) is NOOP_SPAN          # no allocation
+    assert TRACER.begin_span("c", t) is None
+    TRACER.end_span(None)                                  # no-op
+    TRACER.event("d", t, n=1)
+    TRACER.record_span("e", t, 0.5)
+    TRACER.defer_phase("engine", "plan", 0.001)
+    with TRACER.span("f", t) as sp:
+        sp.set(anything=1)
+        assert sp.context() is None
+    assert TRACER.drain() == []
+
+
+def test_span_tree_parenting_and_wire_roundtrip():
+    TRACER.configure(enabled=True)
+    tr = TRACER.start_trace("t-1")
+    with TRACER.span("root", tr, model="m") as root:
+        child_ctx = root.context()
+        assert child_ctx.trace_id == "t-1"
+        assert child_ctx.span_id == root.span_id
+        # the wire form survives a Context hop (baggage -> rebuild)
+        ctx = Context("rid", baggage={TRACE_KEY: child_ctx.to_wire()})
+        assert ctx.trace is not None
+        assert ctx.trace.trace_id == "t-1"
+        assert ctx.trace.span_id == root.span_id
+        assert ctx.child().trace.trace_id == "t-1"
+        TRACER.event("leaf", ctx.trace, n=2)
+    spans = {s["name"]: s for s in TRACER.drain()}
+    assert spans["leaf"]["parent_id"] == spans["root"]["span_id"]
+    assert spans["leaf"]["dur"] == 0.0
+    assert spans["leaf"]["attrs"] == {"n": 2}
+    assert spans["root"]["dur"] > 0.0
+
+
+def test_seeded_sampling_deterministic_and_errors_always_captured():
+    TRACER.configure(enabled=True, sample_rate=0.5, seed=11)
+    first = [TRACER.sampled(f"t{i}") for i in range(200)]
+    again = [TRACER.sampled(f"t{i}") for i in range(200)]
+    assert first == again                       # pure fn of (seed, id)
+    assert 40 < sum(first) < 160                # actually samples
+    TRACER.configure(seed=12)
+    assert [TRACER.sampled(f"t{i}") for i in range(200)] != first
+    # errors always captured: a sampled-OUT trace records only the
+    # failing span
+    TRACER.configure(sample_rate=0.0, seed=11)
+    tr = TRACER.start_trace("whatever")
+    assert tr is not None and not tr.sampled
+    with TRACER.span("quiet", tr):
+        pass
+    with pytest.raises(ValueError):
+        with TRACER.span("boom", tr):
+            raise ValueError("x")
+    spans = TRACER.drain()
+    assert [s["name"] for s in spans] == ["boom"]
+    assert spans[0]["error"] is True
+
+
+def test_ring_buffer_bounded_and_drop_counted():
+    TRACER.configure(enabled=True, sample_rate=1.0)
+    # a fresh tracer so the capacity applies to a new ring
+    from dynamo_tpu.runtime.tracing import Tracer
+    t = Tracer().configure(enabled=True, sample_rate=1.0, ring_capacity=8)
+    tr = t.start_trace("ring")
+    for i in range(20):
+        t.event(f"e{i}", tr)
+    spans = t.drain()
+    assert len(spans) == 8
+    assert [s["name"] for s in spans] == [f"e{i}" for i in range(12, 20)]
+    assert t.dropped() == 12
+
+
+def test_span_ids_carry_process_prefix_and_merged_files_explain():
+    """Span ids embed a per-process prefix (merging span files from the
+    frontend/decode/prefill processes must not collide ids), and
+    trace_explain survives a malformed file where ids DO collide (the
+    pre-fix shape: counter-only ids from two processes forming a parent
+    cycle) instead of recursing forever."""
+    TRACER.configure(enabled=True)
+    tr = TRACER.start_trace("pfx")
+    with TRACER.span("a", tr):
+        pass
+    span, = TRACER.drain()
+    from dynamo_tpu.runtime.tracing import _ID_PREFIX
+    assert span["span_id"].startswith(_ID_PREFIX + "-")
+
+    from tools.trace_explain import explain
+    base = {"ts": 0.0, "dur": 0.001, "attrs": None, "error": False,
+            "thread": "t"}
+    cyclic = [  # two processes both minted "s1"/"s2"; links form a loop
+        {**base, "trace_id": "t", "span_id": "s1", "parent_id": "s2",
+         "name": "worker.generate"},
+        {**base, "trace_id": "t", "span_id": "s2", "parent_id": "s1",
+         "name": "attempt"},
+        {**base, "trace_id": "t", "span_id": "s1", "parent_id": "",
+         "name": "http.request"},
+    ]
+    text = explain(cyclic, "t")          # must terminate
+    assert "worker.generate" in text and "attempt" in text
+
+
+def test_chrome_trace_loadable_shape():
+    TRACER.configure(enabled=True)
+    tr = TRACER.start_trace("ct")
+    with TRACER.span("outer", tr, k="v"):
+        TRACER.event("instant", tr)
+    ct = chrome_trace(TRACER.drain())
+    blob = json.loads(json.dumps(ct))           # JSON-serializable
+    evs = blob["traceEvents"]
+    assert len(evs) == 2
+    by_name = {e["name"]: e for e in evs}
+    assert by_name["outer"]["ph"] == "X" and by_name["outer"]["dur"] > 0
+    assert by_name["instant"]["ph"] == "i"
+    assert all(e["ts"] >= 0 for e in evs)
+    assert by_name["outer"]["args"]["trace_id"] == "ct"
+
+
+# -- the full-stack disagg trace (the acceptance span tree) -------------------
+
+# every leg the ISSUE-8 criterion names, in ONE trace
+REQUIRED_LEGS = {"http.request", "schedule", "attempt", "prefill.remote",
+                 "queue.wait", "prefill.run", "kv.transfer", "decode.emit"}
+
+
+async def _serve_disagg_request():
+    """HTTP frontend -> ReliableClient over the wire -> DisaggDecodeWorker
+    (remote prefill via the leased queue + LocalTransferBackend) -> SSE
+    stream back. Returns (status, drained spans)."""
+    from dynamo_tpu.disagg import (
+        DisaggDecodeWorker, DisaggregatedRouter, LocalTransferBackend,
+        PrefillQueue, PrefillWorker,
+    )
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.frontend.discovery import ModelWatcher, register_model
+    from dynamo_tpu.frontend.service import HttpService
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.worker import NativeEngineWorker, serve_llm_worker
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    from tests.http_client import request
+
+    cfg = ModelConfig(dtype="float32", max_model_len=512)
+
+    def make_engine():
+        return NativeEngine(cfg, EngineConfig(
+            page_size=8, num_pages=64, max_slots=4, max_prefill_chunk=32,
+            prefill_buckets=(8, 16, 32), max_model_len=512), seed=0)
+
+    card = ModelDeploymentCard(name="tiny", arch="tiny",
+                               tokenizer_kind="byte", context_length=512,
+                               eos_token_ids=[2])
+    plane = MemoryPlane()
+    wrt = await DistributedRuntime.create_local(plane, "dec-0")
+    queue = PrefillQueue(plane.messaging, "ns", "tiny")
+    router = DisaggregatedRouter(max_local_prefill_length=4,
+                                 max_prefill_queue_size=4, model="tiny")
+    decode = DisaggDecodeWorker(make_engine(), plane.messaging, router,
+                                queue, worker_id="dec-0",
+                                prefill_timeout_s=30.0)
+    transfer = LocalTransferBackend()
+    transfer.register("dec-0", decode)
+    prefill = PrefillWorker(NativeEngineWorker(make_engine()), queue,
+                            transfer, plane.messaging)
+    await decode.start()
+    await prefill.start()
+    await serve_llm_worker(wrt, "ns", "backend", decode, card=card)
+
+    frt = await DistributedRuntime.create_local(plane, "front")
+    svc = await HttpService("127.0.0.1", 0).start()
+    watcher = await ModelWatcher(frt, svc.models).start()
+    await register_model(frt.kv, "tiny", "ns", "backend", card,
+                         model_type="chat")
+    for _ in range(100):
+        if "tiny" in svc.models.chat:
+            break
+        await asyncio.sleep(0.02)
+    try:
+        status, body = await request(
+            "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+            {"model": "tiny", "max_tokens": 6, "messages": [
+                {"role": "user", "content": "trace this slow request"}]})
+        assert decode.remote_prefills == 1, "remote prefill path not taken"
+    finally:
+        await watcher.stop()
+        await svc.stop()
+        await prefill.stop()
+        await decode.stop()
+        await frt.shutdown()
+        await wrt.shutdown()
+    return status, TRACER.drain()
+
+
+def test_disagg_request_yields_single_trace_span_tree(tmp_path):
+    """One trace_id covers frontend ingest, schedule, leased-queue wait,
+    remote prefill, KV transfer (with byte counts) and decode emits; the
+    exported JSONL + chrome trace round-trip through trace_explain."""
+    TRACER.configure(enabled=True, sample_rate=1.0)
+    TRACER.drain()
+    status, spans = run(_serve_disagg_request())
+    assert status == 200
+
+    request_traces = {}
+    for s in spans:
+        if not s["trace_id"].startswith("scope:"):
+            request_traces.setdefault(s["trace_id"], []).append(s)
+    # exactly one request flowed -> exactly one request trace
+    assert len(request_traces) == 1, sorted(request_traces)
+    (tid, mine), = request_traces.items()
+    names = {s["name"] for s in mine}
+    assert REQUIRED_LEGS <= names, REQUIRED_LEGS - names
+
+    # the transfer leg carries byte counts
+    xfer = [s for s in mine if s["name"] == "kv.transfer"]
+    assert xfer and all(s["attrs"]["bytes"] > 0 for s in xfer)
+    assert all(s["attrs"]["pages"] > 0 for s in xfer)
+    # decode emits: first token + streamed windows, all under this trace
+    emits = [s for s in mine if s["name"] == "decode.emit"]
+    assert len(emits) >= 2
+    # parenting: the attempt hangs off the http root, the remote prefill
+    # under the worker side of that attempt
+    by_id = {s["span_id"]: s for s in mine}
+    root = next(s for s in mine if s["name"] == "http.request")
+    attempt = next(s for s in mine if s["name"] == "attempt")
+    assert attempt["parent_id"] == root["span_id"]
+    remote = next(s for s in mine if s["name"] == "prefill.remote")
+    assert remote["parent_id"] in by_id
+    # engine phase spans rode the deferred recorder under scope:engine
+    assert any(s["trace_id"] == "scope:engine" for s in spans)
+
+    # export: JSONL via tools/artifacts + chrome trace, then explain
+    from tools.artifacts import append_jsonl, write_json
+    out = os.environ.get("DYN_TRACE_ARTIFACT",
+                         str(tmp_path / "trace_disagg.jsonl"))
+    for s in spans:
+        append_jsonl(out, s)
+    write_json(out + ".chrome.json", chrome_trace(spans), overwrite=True)
+    assert json.load(open(out + ".chrome.json"))["traceEvents"]
+
+    from tools.trace_explain import explain, load_spans, pick_trace
+    loaded = load_spans(out)
+    assert pick_trace(loaded) == tid
+    text = explain(loaded, tid)
+    for needle in ("http.request", "kv transfer", "queue wait",
+                   "decode:", "attempts:"):
+        assert needle in text, (needle, text)
+
+
+def test_trace_explain_renders_committed_artifact():
+    """The committed disagg capture stays explainable: timeline + every
+    latency-attribution leg from TRACE_DISAGG_r08.jsonl (generated by
+    the e2e test above with DYN_TRACE_ARTIFACT, committed per the
+    tools/artifacts.py evidence policy)."""
+    from tools.trace_explain import explain, load_spans, pick_trace
+    spans = load_spans(COMMITTED_TRACE)
+    assert spans, f"missing committed artifact {COMMITTED_TRACE}"
+    tid = pick_trace(spans)
+    names = {s["name"] for s in spans if s["trace_id"] == tid}
+    assert REQUIRED_LEGS <= names, REQUIRED_LEGS - names
+    text = explain(spans, tid)
+    assert "kv transfer" in text and "bytes" in text
+    assert "queue wait" in text
+    assert "decode:" in text
+    assert "attempts: 1 (success×1)" in text
+
+
+# -- attempt linking audit (reliability counters vs the trace) ----------------
+
+
+def test_attempt_spans_agree_with_reliability_counters():
+    """Migration clones ({id}~a{n}) carry the parent trace, and the
+    per-terminal-status attempt spans agree with the counters."""
+    from dynamo_tpu.frontend.reliability import (
+        CircuitBreaker, ReliabilityMetrics, ReliabilityPolicy,
+        ReliableClient,
+    )
+    from tests.test_reliability import _serving_pair, pre_request
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    TRACER.configure(enabled=True, sample_rate=1.0)
+    TRACER.drain()
+
+    async def main():
+        rts, client = await _serving_pair(MemoryPlane())
+        metrics = ReliabilityMetrics()
+        rel = ReliableClient(
+            client,
+            ReliabilityPolicy(stall_timeout_s=0.2, max_attempts=6,
+                              backoff_base_s=0.01),
+            breaker=CircuitBreaker(failure_threshold=1, cooldown_s=30.0,
+                                   metrics=metrics),
+            metrics=metrics)
+        prompt = list(range(10, 22))
+        try:
+            for i in range(4):
+                tr = TRACER.start_trace(f"audit-{i}")
+                ctx = Context(f"m{i}", baggage={TRACE_KEY: tr.to_wire()})
+                toks = []
+                async for frame in rel.generate(
+                        pre_request(f"m{i}", prompt, 12), ctx):
+                    toks.extend(frame.get("token_ids", ()))
+                assert toks == prompt
+        finally:
+            for rt in rts:
+                await rt.shutdown()
+        return metrics.snapshot()
+
+    snap = run(main())
+    spans = TRACER.drain()
+    attempts = [s for s in spans if s["name"] == "attempt"]
+    outcomes = {}
+    for s in attempts:
+        outcomes.setdefault(s["attrs"]["outcome"], []).append(s)
+    # audit: what the counters claim is what the trace shows
+    assert len(outcomes.get("migrated", ())) == snap["migrations"] >= 1
+    assert len(outcomes.get("retried", ())) == snap["retries"]
+    assert len(outcomes.get("success", ())) == 4       # one per request
+    # migration attempts carry the PARENT trace and the clone id
+    migrated = outcomes["migrated"][0]
+    follow_up = [s for s in attempts
+                 if s["trace_id"] == migrated["trace_id"]
+                 and s["attrs"]["attempt"] > migrated["attrs"]["attempt"]]
+    assert follow_up, "migrated attempt has no successor in its trace"
+    assert any("~a" in s["attrs"]["engine_request_id"] for s in follow_up)
+    assert all(s["attrs"]["resumed_tokens"] > 0 for s in follow_up)
+    # worker-side spans landed under the same traces (cross-wire link)
+    worker_spans = [s for s in spans if s["name"] == "worker.generate"]
+    assert worker_spans
+    assert {s["trace_id"] for s in worker_spans} <= \
+        {s["trace_id"] for s in attempts}
+
+
+# -- serving histograms on /metrics -------------------------------------------
+
+
+def test_frontend_metrics_serve_ttft_and_itl_histograms():
+    """llm_ttft_seconds / llm_itl_seconds / llm_queue_wait_seconds appear
+    on the frontend /metrics with correct counts for a served request
+    (echo engine: one frame per token, single choice)."""
+    from dynamo_tpu.frontend.reliability import AdmissionControl
+    from dynamo_tpu.frontend.service import HttpService
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.pipeline import LocalPipeline
+    from dynamo_tpu.llm.worker import EchoTokenEngine
+
+    from tests.http_client import request
+
+    SERVING.reset()
+
+    async def main():
+        card = ModelDeploymentCard(name="echo-model", arch="tiny",
+                                   tokenizer_kind="byte",
+                                   context_length=512, eos_token_ids=[2])
+        pipe = LocalPipeline(card, EchoTokenEngine())
+        svc = await HttpService(
+            "127.0.0.1", 0,
+            admission=AdmissionControl(max_inflight=8)).start()
+        svc.models.add("echo-model", pipe, "chat")
+        status, body = await request(
+            "127.0.0.1", svc.port, "POST", "/v1/chat/completions",
+            {"model": "echo-model", "max_tokens": 500,
+             "messages": [{"role": "user", "content": "hello tpu"}]})
+        assert status == 200
+        usage = json.loads(body)["usage"]
+        mstatus, mbody = await request("127.0.0.1", svc.port, "GET",
+                                       "/metrics")
+        await svc.stop()
+        return usage, mstatus, mbody.decode()
+
+    usage, mstatus, text = run(main())
+    assert mstatus == 200
+    n_tokens = usage["completion_tokens"]
+    assert n_tokens > 1
+    # exactly one first-token observation, one ITL per later frame
+    assert 'llm_ttft_seconds_count{model="echo-model"} 1' in text
+    assert ('llm_itl_seconds_count{model="echo-model"} '
+            f"{n_tokens - 1}") in text
+    assert 'llm_ttft_seconds_bucket{model="echo-model",le="+Inf"} 1' in text
+    assert "llm_queue_wait_seconds_count 1" in text
+    assert "# TYPE llm_ttft_seconds histogram" in text
+    assert "# TYPE llm_schedule_seconds histogram" in text
+
+
+def test_exporter_folds_serving_histograms():
+    """The standalone exporter's /metrics appends the same serving
+    histograms (render-time fold)."""
+    SERVING.reset()
+    SERVING.ttft.observe("m", value=0.02)
+    SERVING.kv_transfer.observe(value=0.003)
+    from dynamo_tpu.observability.exporter import MetricsExporter
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    from tests.http_client import request
+
+    async def main():
+        plane = MemoryPlane()
+        rt = await DistributedRuntime.create_local(plane, "exp")
+        exp = await MetricsExporter(rt, "ns", "backend").start()
+        status, body = await request("127.0.0.1", exp.port, "GET",
+                                     "/metrics")
+        await exp.stop()
+        await rt.shutdown()
+        return status, body.decode()
+
+    status, text = run(main())
+    assert status == 200
+    assert 'llm_ttft_seconds_count{model="m"} 1' in text
+    assert "llm_kv_transfer_seconds_count 1" in text
+
+
+# -- tool plumbing ------------------------------------------------------------
+
+
+def test_chaos_replay_trace_flag_writes_artifacts(tmp_path, monkeypatch):
+    """--trace captures spans around a scenario run and writes the JSONL
+    + chrome twin through tools/artifacts.py."""
+    import tools.chaos_replay as cr
+
+    class _StubChaos:
+        SCENARIOS = {name: (None, {"site": {"seed": 1, "specs": []}})
+                     for name in cr.SCENARIO_NAMES}
+
+        @staticmethod
+        def run_scenario(name, plan):
+            tr = TRACER.start_trace("chaos-span")
+            with TRACER.span("storm", tr, scenario=name):
+                pass
+            return {"ok": 1}
+
+    monkeypatch.setattr(cr, "_load_scenarios", lambda: _StubChaos)
+    out = str(tmp_path / "chaos_trace.jsonl")
+    rc = cr.main(["rolling_restart", "--trace", out])
+    assert rc == 0
+    lines = [json.loads(x) for x in open(out) if x.strip()]
+    assert any(s["name"] == "storm" for s in lines)
+    chrome = json.load(open(out + ".chrome.json"))
+    assert chrome["traceEvents"]
+    assert TRACER.enabled  # --trace armed the tracer for the run

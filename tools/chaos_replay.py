@@ -73,6 +73,14 @@ def main(argv=None) -> int:
     ap.add_argument("--record-to", default=DEFAULT_LOG,
                     help="append-only JSONL evidence log (default: "
                          "CHAOS_REPLAY.jsonl)")
+    ap.add_argument("--trace", metavar="TRACE_JSONL", nargs="?",
+                    const=os.path.join(REPO_ROOT, "CHAOS_TRACE.jsonl"),
+                    help="capture spans during the storm (sample=1.0) and "
+                         "append them to this JSONL (default: "
+                         "CHAOS_TRACE.jsonl) plus a chrome://tracing twin "
+                         "at <path>.chrome.json — both via "
+                         "tools/artifacts.py; replay with "
+                         "tools/trace_explain.py")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -96,6 +104,13 @@ def main(argv=None) -> int:
         with open(args.plan) as f:
             plan = json.load(f)
 
+    if args.trace:
+        # a replayed storm should leave a DIAGNOSABLE artifact, not just
+        # a pass/fail: sample everything, drain after the run
+        from dynamo_tpu.runtime.tracing import TRACER
+        TRACER.configure(enabled=True, sample_rate=1.0)
+        TRACER.drain()  # start the capture clean
+
     started = time.time()
     try:
         summary = test_chaos.run_scenario(args.scenario, plan)
@@ -108,6 +123,19 @@ def main(argv=None) -> int:
               "error": error, "summary": summary,
               "started_unix": round(started, 3),
               "elapsed_s": round(elapsed, 3)}
+    if args.trace:
+        from dynamo_tpu.runtime.tracing import TRACER, chrome_trace
+
+        from tools.artifacts import append_jsonl, write_json
+        spans = TRACER.drain()
+        for span in spans:
+            append_jsonl(args.trace, span)
+        write_json(args.trace + ".chrome.json", chrome_trace(spans),
+                   overwrite=True)
+        record["trace_spans"] = len(spans)
+        record["trace_file"] = args.trace
+        print(f"captured {len(spans)} span(s) -> {args.trace} "
+              f"(+ .chrome.json)", file=sys.stderr)
     print(json.dumps(record, indent=1))
     if args.record:
         from tools.artifacts import append_jsonl

@@ -138,12 +138,35 @@ def main(argv=None) -> int:
                     help="evidence artifact path (tools/artifacts.py "
                          "policy: final name, no clobber)")
     ap.add_argument("--no-artifact", action="store_true")
+    ap.add_argument("--trace", metavar="TRACE_JSONL", nargs="?",
+                    const=os.path.join(REPO_ROOT, "SCALE_TRACE.jsonl"),
+                    help="capture router.schedule spans during the storms "
+                         "(sample=1.0) and append them to this JSONL plus "
+                         "a chrome://tracing twin at <path>.chrome.json, "
+                         "via tools/artifacts.py")
     args = ap.parse_args(argv)
     if args.quick:
         args.load_calls = min(args.load_calls, 500)
         args.probe_events = min(args.probe_events, 1000)
+    if args.trace:
+        from dynamo_tpu.runtime.tracing import TRACER
+        TRACER.configure(enabled=True, sample_rate=1.0)
+        TRACER.drain()  # start the capture clean
 
     report = asyncio.run(run_full(args))
+    if args.trace:
+        from dynamo_tpu.runtime.tracing import TRACER, chrome_trace
+
+        from tools.artifacts import append_jsonl, write_json
+        spans = TRACER.drain()
+        for span in spans:
+            append_jsonl(args.trace, span)
+        write_json(args.trace + ".chrome.json", chrome_trace(spans),
+                   overwrite=True)
+        report["trace_spans"] = len(spans)
+        report["trace_file"] = args.trace
+        print(f"captured {len(spans)} span(s) -> {args.trace} "
+              f"(+ .chrome.json)", file=sys.stderr)
     print(json.dumps(report, indent=1))
     if not args.no_artifact:
         from tools.artifacts import write_json

@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""trace_explain: reconstruct one request's timeline from a trace file.
+
+The answer to "explain this slow request": given a span JSONL file
+(written by `TRACER.drain()` — one span per line, the schema in
+dynamo_tpu/runtime/tracing.py), pick a trace and render
+
+- the span TREE (parent links), offset + duration per span, attrs
+  inline — frontend root, schedule, attempts, worker stream, remote
+  prefill, queue wait, KV transfer;
+- a summary: queue/admission wait, prefill legs, transfer bytes and
+  per-fetch cost, per-window decode ITL (gaps between decode.emit
+  instants), and the retry/migration story (attempt outcomes).
+
+Usage:
+    python tools/trace_explain.py TRACE.jsonl [--trace-id ID]
+    python tools/trace_explain.py TRACE.jsonl --list
+    python tools/trace_explain.py TRACE.jsonl --chrome OUT.json
+
+With no --trace-id the busiest non-scope trace is explained (scope:*
+pseudo-traces — engine phases, router storms — are aggregate context,
+not a request). --chrome re-exports the WHOLE file as a
+chrome://tracing-loadable JSON via tools/artifacts.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def load_spans(path: str) -> List[dict]:
+    spans = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "trace_id" in rec and "ts" in rec:
+                spans.append(rec)
+    return spans
+
+
+def pick_trace(spans: List[dict]) -> Optional[str]:
+    counts: Dict[str, int] = {}
+    for s in spans:
+        tid = s["trace_id"]
+        if not tid.startswith("scope:"):
+            counts[tid] = counts.get(tid, 0) + 1
+    if not counts:
+        return None
+    return max(counts, key=lambda t: counts[t])
+
+
+def _fmt_attrs(attrs: Optional[dict]) -> str:
+    if not attrs:
+        return ""
+    return " " + " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+
+
+def _percentile(vals: List[float], p: float) -> float:
+    if not vals:
+        return 0.0
+    vals = sorted(vals)
+    i = min(len(vals) - 1, int(round(p * (len(vals) - 1))))
+    return vals[i]
+
+
+def explain(spans: List[dict], trace_id: str) -> str:
+    """Render one trace's timeline + summary as text (pure function —
+    the tier-1 golden test drives it on the committed artifact)."""
+    mine = [s for s in spans if s["trace_id"] == trace_id]
+    if not mine:
+        return f"trace {trace_id}: no spans"
+    mine.sort(key=lambda s: (s["ts"], s["span_id"]))
+    t_base = min(s["ts"] for s in mine)
+    by_id = {s["span_id"]: s for s in mine}
+    children: Dict[str, List[dict]] = {}
+    roots: List[dict] = []
+    for s in mine:
+        parent = s.get("parent_id") or ""
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+
+    out: List[str] = [f"trace {trace_id}: {len(mine)} span(s), "
+                      f"{(max(x['ts'] + x['dur'] for x in mine) - t_base) * 1e3:.1f} ms end to end"]
+
+    # defensive: a malformed file (e.g. span-id collisions from a
+    # pre-fix process mix) could make the parent graph cyclic — render
+    # each span at most once rather than recursing forever
+    seen_ids: set = set()
+
+    def render(s: dict, depth: int) -> None:
+        if id(s) in seen_ids:
+            return
+        seen_ids.add(id(s))
+        off = (s["ts"] - t_base) * 1e3
+        dur = s["dur"] * 1e3
+        mark = "!" if s.get("error") else ("·" if s["dur"] <= 0 else "—")
+        out.append(f"  {off:9.2f}ms {'  ' * depth}{mark} {s['name']}"
+                   + (f" [{dur:.2f}ms]" if s["dur"] > 0 else "")
+                   + _fmt_attrs(s.get("attrs")))
+        if depth < 64:
+            for c in children.get(s["span_id"], ()):
+                render(c, depth + 1)
+
+    for r in roots:
+        render(r, 0)
+    for s in mine:              # orphans of a cyclic/malformed graph
+        render(s, 0)
+
+    # -- summary --------------------------------------------------------------
+    def named(*names):
+        return [s for s in mine if s["name"] in names]
+
+    out.append("")
+    out.append("summary:")
+    waits = named("admission.wait", "queue.wait")
+    if waits:
+        total = sum(s["dur"] for s in waits) * 1e3
+        out.append(f"  queue wait: {total:.2f} ms across {len(waits)} "
+                   f"leg(s) ({', '.join(s['name'] for s in waits)})")
+    sched = named("schedule", "router.schedule")
+    if sched:
+        out.append(f"  schedule: {sum(s['dur'] for s in sched) * 1e3:.2f} ms "
+                   f"over {len(sched)} decision(s)")
+    prefills = named("prefill.remote", "prefill.run")
+    for s in prefills:
+        out.append(f"  {s['name']}: {s['dur'] * 1e3:.2f} ms"
+                   + _fmt_attrs(s.get("attrs")))
+    xfers = named("kv.transfer", "kv.inject")
+    if xfers:
+        total_bytes = sum((s.get("attrs") or {}).get("bytes", 0)
+                          for s in xfers)
+        total_pages = sum((s.get("attrs") or {}).get("pages", 0)
+                          for s in xfers)
+        out.append(f"  kv transfer: {total_bytes} bytes / {total_pages} "
+                   f"page(s) in {len(xfers)} leg(s), "
+                   f"{sum(s['dur'] for s in xfers) * 1e3:.2f} ms")
+    emits = sorted(named("decode.emit"), key=lambda s: s["ts"])
+    if len(emits) >= 2:
+        gaps = [(b["ts"] - a["ts"]) * 1e3
+                for a, b in zip(emits, emits[1:])]
+        out.append(f"  decode: {len(emits)} emit(s); itl p50 "
+                   f"{_percentile(gaps, 0.5):.2f} ms, p95 "
+                   f"{_percentile(gaps, 0.95):.2f} ms, max "
+                   f"{max(gaps):.2f} ms")
+    elif emits:
+        out.append(f"  decode: {len(emits)} emit(s)")
+    attempts = named("attempt")
+    if attempts:
+        outcomes: Dict[str, int] = {}
+        for s in attempts:
+            o = (s.get("attrs") or {}).get("outcome", "?")
+            outcomes[o] = outcomes.get(o, 0) + 1
+        story = ", ".join(f"{k}×{v}" for k, v in sorted(outcomes.items()))
+        out.append(f"  attempts: {len(attempts)} ({story})")
+    errs = [s for s in mine if s.get("error")]
+    if errs:
+        out.append(f"  errors: {len(errs)} span(s): "
+                   + ", ".join(sorted({s['name'] for s in errs})))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_explain", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("trace_file", help="span JSONL (TRACER.drain records)")
+    ap.add_argument("--trace-id", help="trace to explain "
+                                       "(default: busiest request trace)")
+    ap.add_argument("--list", action="store_true",
+                    help="list trace ids with span counts and exit")
+    ap.add_argument("--chrome", metavar="OUT_JSON",
+                    help="also write the whole file as a chrome://tracing "
+                         "JSON (tools/artifacts.py policy)")
+    args = ap.parse_args(argv)
+
+    spans = load_spans(args.trace_file)
+    if not spans:
+        print(f"no spans in {args.trace_file}", file=sys.stderr)
+        return 1
+    if args.list:
+        counts: Dict[str, int] = {}
+        for s in spans:
+            counts[s["trace_id"]] = counts.get(s["trace_id"], 0) + 1
+        for tid, n in sorted(counts.items(), key=lambda kv: -kv[1]):
+            print(f"{n:6d}  {tid}")
+        return 0
+    if args.chrome:
+        from dynamo_tpu.runtime.tracing import chrome_trace
+
+        from tools.artifacts import write_json
+        write_json(args.chrome, chrome_trace(spans), overwrite=True)
+        print(f"chrome trace -> {args.chrome}", file=sys.stderr)
+    tid = args.trace_id or pick_trace(spans)
+    if tid is None:
+        print("no request traces in file (only scope:* spans); pass "
+              "--trace-id to explain one of those", file=sys.stderr)
+        return 1
+    print(explain(spans, tid))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
