@@ -55,6 +55,16 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       recorder (`defer_phase`, what PhaseTimer routes through) instead
       of allocating span objects between device dispatches; escape
       hatch `# dynalint: span-ok=<reason>`
+- R14 unbounded raw stream IO on the data/control wire (disagg/,
+      runtime/transports/): an awaited `read_frame` / `readexactly` /
+      `readuntil` / `readline` / `drain` with no `timeout=` kwarg, no
+      enclosing `asyncio.wait_for` in the same await expression, and no
+      `# dynalint: unbounded-io-ok=<reason>` annotation within three
+      lines above. R7 bounds the higher-level round trips; R14 pins the
+      raw socket ops under them — a half-open peer or a receiver that
+      stops reading wedges exactly these awaits (the pre-fix
+      RemoteTransferBackend ack read is the type specimen: a decode
+      worker restart left the sender blocked forever on a dead socket)
 """
 from __future__ import annotations
 
@@ -969,6 +979,67 @@ def r13_span_lifecycle(tree: ast.AST, lines: List[str],
                 "(`TRACER.defer_phase(scope, name, dt)` — what "
                 "PhaseTimer.phase routes through), or annotate with "
                 "`# dynalint: span-ok=<reason>`"))
+    return out
+
+
+# -- R14: unbounded raw stream IO on the wire ---------------------------------
+
+# Scope: the layers that own raw sockets — the disagg data plane and the
+# transport implementations. R7 already bounds the named higher-level
+# round trips (request, queue_pop, open_connection, ...); R14 covers the
+# primitive stream ops UNDER them, which is where a half-open peer or a
+# receiver that stops reading actually wedges a coroutine: a frame read
+# against a dead decode worker, a `drain()` against a peer whose recv
+# window is full. Every such await must be bounded — a `timeout=` kwarg
+# (read_frame grew one), an `asyncio.wait_for` in the same await
+# expression — or carry `# dynalint: unbounded-io-ok=<reason>` within
+# three lines above (the sanctioned cases: server-side pumps reading
+# from legitimately-idle client connections, where death surfaces as
+# EOF, and bodies that run entirely under one enclosing wait_for).
+_R14_SCOPE = ("disagg/", "runtime/transports/")
+_R14_TARGETS = {"read_frame", "readexactly", "readuntil", "readline",
+                "drain"}
+_R14_ANNOT_RE = re.compile(r"#\s*dynalint:\s*unbounded-io-ok=\S+")
+
+
+@rule("R14")
+def r14_unbounded_stream_io(tree: ast.AST, lines: List[str],
+                            path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R14_SCOPE):
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R14_ANNOT_RE.search(_line(lines, x))
+                   for x in range(ln - 3, ln + 1))
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Await) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        name = _call_name(call)
+        terminal = name.rsplit(".", 1)[-1]
+        if terminal not in _R14_TARGETS:
+            # a wait_for(...) wrapper makes the terminal "wait_for";
+            # the raw op inside it is bounded by construction
+            continue
+        if any(kw.arg == "timeout" for kw in call.keywords):
+            continue
+        if annotated(node.lineno):
+            continue
+        out.append(_finding(
+            "R14", path, lines, node,
+            f"`await {name}(...)` is a raw stream read/write with no "
+            "deadline — a half-open peer (or one that stops reading) "
+            "wedges this coroutine, and with it the transfer/queue slot "
+            "it serves, until process restart",
+            "bound it: pass timeout= (read_frame supports it), wrap in "
+            "asyncio.wait_for, or annotate with "
+            "`# dynalint: unbounded-io-ok=<why an unbounded wait is "
+            "correct here>` (e.g. an idle server-side pump whose peer "
+            "death surfaces as EOF)"))
     return out
 
 

@@ -32,6 +32,12 @@ class RemotePrefillRequest(pydantic.BaseModel):
     # computed_block_ids semantics)
     num_cached_tokens: int = 0
     page_size: int = 0        # decode engine page size (must match prefill)
+    # admission epoch of the decode-side allocation: every transfer
+    # chunk carries it and the decode side fences mismatches, so a
+    # STALE sender (expired lease, replacement already streaming; or a
+    # reused request id after release+realloc) can never write into
+    # pages that now belong to a different sequence
+    alloc_epoch: int = 0
     # fully-qualified messaging subject for the PrefillCompletion notify
     notify_subject: str = ""
     # client deadline as an absolute unix timestamp (time.time()); the

@@ -73,5 +73,19 @@ class PrefillQueue:
         must not be redelivered)."""
         await self.messaging.queue_ack(self.name, token)
 
+    async def touch(self, token: str, lease_s: float = 30.0) -> bool:
+        """Re-arm a leased item's redelivery deadline (JetStream
+        in-progress ack). A prefill worker entering the transfer leg —
+        which may legitimately outlast the dequeue lease when the link
+        flaps and the sender resumes — touches the lease instead of the
+        fleet sizing lease_s for the worst-case resume ladder. Returns
+        False when the lease already expired (the item was redelivered;
+        the caller's copy is now the duplicate and the decode-side
+        commit protocol absorbs it)."""
+        touch = getattr(self.messaging, "queue_touch", None)
+        if touch is None:
+            return True
+        return await touch(self.name, token, lease_s=lease_s)
+
     async def depth(self) -> int:
         return await self.messaging.queue_depth(self.name)

@@ -20,6 +20,27 @@ data plane:
   fetched and cached), keeps one pooled connection per decode engine, and
   streams msgpack frames with raw page bytes.
 
+**Chunk-committed streaming** (docs/RESILIENCE.md "Data-plane transfer
+failure model"): the transfer is no longer all-or-nothing. The sender
+streams bounded-window chunks, each carrying its capture-time checksums
+plus `(request_id, alloc_epoch, chunk_idx)`; the decode side verifies,
+injects, and ACKS each chunk durably — a `TransferSession` tracks the
+committed frontier (leading pages verified AND injected), re-delivered
+chunks below it ack as duplicates without touching the cache, and a
+nonzero `alloc_epoch` fences out stale senders (same request id,
+reallocated pages). Every stream opens with a resume handshake that
+returns the frontier, so a sender recovering from a mid-transfer link
+cut — or a *replacement* sender running a re-leased queue item after the
+original prefill worker died — resumes from the last acked chunk instead
+of restarting. Every socket read/write is bounded (`io_timeout_s`, and a
+transfer-level `budget_s` derived from the request deadline), the
+in-flight window is bounded (the sender stalls on the oldest ack, never
+buffers unboundedly), and a send failure invalidates BOTH the pooled
+connection and the cached endpoint so a decode worker restarting on a
+new port is re-resolved from discovery. If the sender is unrecoverable,
+the decode worker salvages the committed prefix (engine.salvage_remote)
+rather than re-prefilling from token zero.
+
 Chunk sizes are bucketed to powers of two so the decode engine compiles a
 bounded set of inject programs (same static-shape discipline as the
 scheduler's page buckets).
@@ -27,9 +48,11 @@ scheduler's page buckets).
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 import time
-from typing import Dict, Optional, Tuple
+from collections import OrderedDict
+from typing import Dict, Optional, Set, Tuple
 
 import jax
 import numpy as np
@@ -54,8 +77,21 @@ KV_TRANSFER_PREFIX = "kv_transfer/"
 class IntegrityRejected(RuntimeError):
     """The decode side refused a chunk whose bytes failed their
     capture-time checksums. Retryable: the sender still holds the
-    authoritative pages, so a bounded re-fetch (re-stage + re-send)
-    recovers — unlike other semantic rejections, which are final."""
+    authoritative pages, so a bounded re-fetch (re-stage + re-send of
+    the UNCOMMITTED tail — committed chunks stay committed) recovers —
+    unlike other semantic rejections, which are final."""
+
+
+class StaleEpochError(RuntimeError):
+    """A chunk's alloc_epoch does not match the pending allocation's:
+    the sender is stale (zombie after lease expiry, or a reused request
+    id after release+realloc). Final — the bytes must never land."""
+
+
+class TransferBudgetExceeded(RuntimeError):
+    """The transfer's wall-clock sub-budget (derived from the request
+    deadline) is spent. Final — the decode side falls back (salvaging
+    whatever prefix committed) rather than ride a dead stream."""
 
 
 def transfer_key(engine_id: str) -> str:
@@ -77,17 +113,51 @@ def _pow2_pad(n: int) -> int:
     return p
 
 
+@dataclasses.dataclass
+class TransferSession:
+    """Decode-side commit state for one streamed transfer, keyed by
+    (request_id, alloc_epoch).
+
+    `committed_pages` is the FRONTIER: the count of leading pages of the
+    transfer's page list that have been verified and injected (acked
+    chunks). Chunks commit strictly in frame order (one consumer per
+    connection), so the committed region is always a prefix — which is
+    what lets a resuming/replacement sender skip by page count alone,
+    even with a different chunk size, and what makes the decode-side
+    salvage ("re-prefill only past the committed boundary") sound.
+    """
+
+    request_id: str
+    alloc_epoch: int
+    total_pages: int = 0
+    committed_pages: int = 0
+    committed_chunks: Set[int] = dataclasses.field(default_factory=set)
+
+
 class KvTransferServer:
     """Decode-side page-injection listener for one engine worker."""
 
+    MAX_SESSIONS = 1024  # LRU backstop; sessions are also dropped explicitly
+
     def __init__(self, worker, engine_id: str, host: str = "127.0.0.1",
-                 port: int = 0, advertise_host: Optional[str] = None):
+                 port: int = 0, advertise_host: Optional[str] = None,
+                 ack_timeout_s: float = 30.0):
         self.worker = worker
         self.engine_id = engine_id
         self.host, self.port = host, port
         self.advertise_host = advertise_host or host
+        self.ack_timeout_s = ack_timeout_s
         self._server: Optional[asyncio.AbstractServer] = None
+        self._client_writers: Set[asyncio.StreamWriter] = set()
         self.received_pages = 0
+        # (request_id, alloc_epoch) -> TransferSession, insertion-ordered
+        # for LRU eviction
+        self._sessions: "OrderedDict[Tuple[str, int], TransferSession]" = \
+            OrderedDict()
+        # the decode worker salvages through this handle on fallback
+        # (disagg/worker.py reads committed_frontier); a worker without a
+        # transfer server simply has no frontier to salvage
+        setattr(worker, "kv_transfer_server", self)
 
     async def start(self) -> "KvTransferServer":
         if self._server is None:
@@ -99,6 +169,12 @@ class KvTransferServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            # also cut established connections: a real restart resets
+            # them, senders see the reset and re-resolve; and on 3.12
+            # wait_closed() blocks until every handler exits, so an idle
+            # pooled sender connection would otherwise wedge shutdown
+            for w in list(self._client_writers):
+                w.close()
             await self._server.wait_closed()
             self._server = None
 
@@ -112,6 +188,44 @@ class KvTransferServer:
         await kv.put(transfer_key(self.engine_id),
                      msgpack.packb(self.connection_info, use_bin_type=True),
                      lease_id=lease_id)
+
+    # -- commit/session bookkeeping -------------------------------------------
+
+    def _session(self, request_id: str, alloc_epoch: int,
+                 total_pages: int = 0) -> TransferSession:
+        key = (request_id, alloc_epoch)
+        sess = self._sessions.get(key)
+        if sess is None:
+            # a new epoch supersedes any older session for the same id
+            # (release + realloc): the old frontier describes pages that
+            # no longer belong to this request
+            for old in [k for k in self._sessions if k[0] == request_id
+                        and k[1] != alloc_epoch]:
+                del self._sessions[old]
+            sess = TransferSession(request_id, alloc_epoch,
+                                   total_pages=total_pages)
+            self._sessions[key] = sess
+            while len(self._sessions) > self.MAX_SESSIONS:
+                self._sessions.popitem(last=False)
+        else:
+            self._sessions.move_to_end(key)
+            if total_pages:
+                sess.total_pages = total_pages
+        return sess
+
+    def committed_frontier(self, request_id: str, alloc_epoch: int) -> int:
+        """Pages of the transfer list durably committed (verified +
+        injected + acked) for this exact allocation; 0 when unknown."""
+        sess = self._sessions.get((request_id, alloc_epoch))
+        return sess.committed_pages if sess is not None else 0
+
+    def forget(self, request_id: str) -> None:
+        """Drop commit state once the request's fate is settled
+        (activated, salvaged, or released)."""
+        for key in [k for k in self._sessions if k[0] == request_id]:
+            del self._sessions[key]
+
+    # -- wire -----------------------------------------------------------------
 
     async def _on_connect(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
@@ -132,29 +246,48 @@ class KvTransferServer:
                     return
                 if not peer_alive:
                     continue
-                try:
-                    await self._inject_frame(frame)
-                    write_frame(writer, {"ok": True})
-                except Exception as e:  # noqa: BLE001 — sent to the peer
-                    log.warning("kv inject rejected: %s", e)
+                if frame.get("op") == "resume":
+                    # committed-frontier handshake: a (re)connecting or
+                    # replacement sender learns where to resume
                     write_frame(writer, {
-                        "ok": False,
-                        "error": f"{type(e).__name__}: {e}",
-                        # integrity rejections are retryable sender-side
-                        # (re-fetch); other rejections are final
-                        "integrity": isinstance(e, IntegrityError)})
+                        "ok": True,
+                        "committed": self.committed_frontier(
+                            str(frame.get("request_id", "")),
+                            int(frame.get("alloc_epoch", 0)))})
+                else:
+                    try:
+                        ack = await self._inject_frame(frame)
+                        write_frame(writer, ack)
+                    except Exception as e:  # noqa: BLE001 — sent to the peer
+                        log.warning("kv inject rejected: %s", e)
+                        write_frame(writer, {
+                            "ok": False,
+                            "error": f"{type(e).__name__}: {e}",
+                            # integrity rejections are retryable
+                            # sender-side (re-fetch); stale-epoch and
+                            # other rejections are final
+                            "integrity": isinstance(e, IntegrityError),
+                            "stale": isinstance(e, StaleEpochError)})
                 try:
-                    await writer.drain()
-                except (ConnectionError, OSError, RuntimeError):
+                    # bounded: a peer that stops reading acks must flip
+                    # this consumer to drain-only, not wedge it
+                    await asyncio.wait_for(writer.drain(),
+                                           self.ack_timeout_s)
+                except (ConnectionError, OSError, RuntimeError,
+                        asyncio.TimeoutError):
                     # any transport death (reset, abort, closed-transport
-                    # RuntimeError) flips to drain-only mode rather than
-                    # killing the consumer — a dead consumer would wedge
-                    # the producer's bounded put below (ADVICE r3)
+                    # RuntimeError, ack-drain timeout) flips to drain-only
+                    # mode rather than killing the consumer — a dead
+                    # consumer would wedge the producer's bounded put
+                    # below (ADVICE r3)
                     peer_alive = False
 
         consumer = asyncio.create_task(inject_loop())
+        self._client_writers.add(writer)
         try:
             while True:
+                # dynalint: unbounded-io-ok=idle-pooled-sender-connections-
+                # are-legal; the SENDER bounds its own IO, death is EOF
                 frame = await read_frame(reader)
                 await frames.put(frame)
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -173,21 +306,41 @@ class KvTransferServer:
                 except asyncio.QueueFull:
                     await asyncio.sleep(0.01)
             await consumer
+            self._client_writers.discard(writer)
             writer.close()
 
-    async def _inject_frame(self, frame: Dict) -> None:
+    async def _inject_frame(self, frame: Dict) -> Dict:
         rid = frame["request_id"]
         page_ids = list(frame["page_ids"])
+        epoch = int(frame.get("alloc_epoch", 0))
+        chunk_idx = int(frame.get("chunk_idx", 0))
+        base = int(frame.get("base", 0))
+        sess = self._session(rid, epoch, int(frame.get("total", 0)))
+        if base + len(page_ids) <= sess.committed_pages:
+            # idempotent re-delivery: this chunk is already below the
+            # committed frontier (the original ack was lost, or a
+            # replacement sender re-sent from an older view) — ack
+            # without touching the cache
+            sess.committed_chunks.add(chunk_idx)
+            return {"ok": True, "chunk_idx": chunk_idx, "dup": True,
+                    "committed": sess.committed_pages}
         # per-fetch inject span (bytes + duration), riding the same
         # frames as the integrity checksums — the sender shipped its
         # trace context alongside the page bytes
         trace = TraceContext.from_wire(frame.get(TRACE_KEY))
         with TRACER.span("kv.inject", trace, request_id=rid,
-                         pages=len(page_ids)) as isp:
-            await self._inject_frame_inner(frame, rid, page_ids, isp)
+                         pages=len(page_ids), chunk=chunk_idx) as isp:
+            await self._inject_frame_inner(frame, rid, page_ids, epoch, isp)
+        # the chunk is durably committed only now: verified, on device,
+        # past the pending+epoch guards
+        sess.committed_pages = max(sess.committed_pages,
+                                   base + len(page_ids))
+        sess.committed_chunks.add(chunk_idx)
+        return {"ok": True, "chunk_idx": chunk_idx, "dup": False,
+                "committed": sess.committed_pages}
 
     async def _inject_frame_inner(self, frame: Dict, rid: str,
-                                  page_ids: list, isp) -> None:
+                                  page_ids: list, epoch: int, isp) -> None:
         shape = tuple(frame["shape"])
         dtype = _np_dtype(frame["dtype"])
         k = np.frombuffer(frame["k"], dtype=dtype).reshape(shape)
@@ -235,10 +388,21 @@ class KvTransferServer:
                 lambda: (jax.device_put(k, shd), jax.device_put(v, shd)))
 
         def inject(eng):
-            if rid not in eng.scheduler.remote:
+            seq = eng.scheduler.remote.get(rid)
+            if seq is None:
                 raise KeyError(
                     f"request {rid!r} no longer pending on "
                     f"{self.engine_id!r}")
+            if epoch and seq.epoch != epoch:
+                # epoch fence: same request id, DIFFERENT allocation —
+                # a stale sender's bytes must never land in pages that
+                # now belong to another sequence. Checked HERE, on the
+                # engine thread, where scheduler state is authoritative.
+                XFER_STATS.stale_chunks += 1
+                raise StaleEpochError(
+                    f"request {rid!r} alloc epoch {seq.epoch} != sender "
+                    f"epoch {epoch} on {self.engine_id!r} (stale sender "
+                    "fenced)")
             eng.inject_pages(page_ids, k_dev, v_dev, ks_dev, vs_dev)
 
         await self.worker.submit(inject)
@@ -253,14 +417,27 @@ class RemoteTransferBackend(TransferBackend):
 
     def __init__(self, kv: KVStore, chunk_pages: int = 16,
                  connect_timeout_s: float = 10.0, window_chunks: int = 4,
-                 integrity_retries: int = 2):
+                 integrity_retries: int = 2, io_timeout_s: float = 30.0,
+                 link_retries: int = 3):
         self._kv = kv
         self.chunk_pages = chunk_pages
         # max chunks in flight before awaiting the oldest ack: overlaps
         # staging + network with the decode side's inject instead of
-        # stop-and-wait per chunk (VERDICT r2 weak #4)
+        # stop-and-wait per chunk (VERDICT r2 weak #4). This is also the
+        # backpressure bound — the sender STALLS here, it never buffers
+        # more than window_chunks staged chunks
         self.window_chunks = max(1, window_chunks)
         self.connect_timeout_s = connect_timeout_s
+        # per-read/write socket deadline: a stalled socket (half-open
+        # peer, decode restart) surfaces as a timeout within io_timeout_s
+        # and rides the link-failure resume path instead of wedging the
+        # prefill worker slot forever
+        self.io_timeout_s = io_timeout_s
+        # mid-transfer link failures (cut, reset, stall) the sender
+        # absorbs by reconnecting and RESUMING from the committed
+        # frontier; past the budget the transfer is abandoned and the
+        # decode side salvages the committed prefix
+        self.link_retries = max(0, link_retries)
         # bounded re-fetch budget after a decode-side integrity
         # rejection; past it the transfer is abandoned (quarantine) and
         # the decode side re-prefills locally — latency, never tokens
@@ -285,18 +462,25 @@ class RemoteTransferBackend(TransferBackend):
             self._meta[engine_id] = meta
         return meta
 
-    async def _connect(self, engine_id: str):
+    async def _connect(self, engine_id: str, deadline=None):
         conn = self._conns.get(engine_id)
         if conn is not None and not conn[1].is_closing():
             return conn
         meta = await self._resolve(engine_id)
+        # budget check BEFORE creating the dial coroutine: _io_timeout
+        # raising with an already-created coroutine would leak it unawaited
+        timeout = min(self.connect_timeout_s, self._io_timeout(deadline))
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(meta["host"], int(meta["port"])),
-            self.connect_timeout_s)
+            timeout)
         self._conns[engine_id] = (reader, writer)
         return reader, writer
 
     def _drop(self, engine_id: str) -> None:
+        """Invalidate BOTH the pooled connection and the cached endpoint:
+        the next attempt re-resolves `kv_transfer/{engine_id}` from the
+        discovery KV, so a decode worker restarting on a new port is
+        picked up instead of wedging the pool until process restart."""
         conn = self._conns.pop(engine_id, None)
         if conn is not None:
             conn[1].close()
@@ -306,20 +490,44 @@ class RemoteTransferBackend(TransferBackend):
         for engine_id in list(self._conns):
             self._drop(engine_id)
 
+    # -- bounded IO -----------------------------------------------------------
+
+    def _io_timeout(self, deadline) -> float:
+        """Per-op timeout: io_timeout_s clipped to the transfer budget's
+        remaining wall clock. Raises once the budget is spent — the
+        transfer must FAIL at its sub-budget, never block past it."""
+        if deadline is None:
+            return self.io_timeout_s
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TransferBudgetExceeded(
+                "kv transfer budget exhausted (request deadline)")
+        return min(self.io_timeout_s, remaining)
+
+    async def _read(self, reader, deadline):
+        return await read_frame(reader, timeout=self._io_timeout(deadline))
+
+    async def _write(self, writer, frame, deadline) -> None:
+        write_frame(writer, frame)
+        await asyncio.wait_for(writer.drain(), self._io_timeout(deadline))
+
     # -- transfer -------------------------------------------------------------
 
     async def send_pages(self, engine_id: str, request_id: str, dst_page_ids,
                          k_pages, v_pages, k_scale=None,
-                         v_scale=None, trace=None) -> None:
+                         v_scale=None, trace=None, alloc_epoch: int = 0,
+                         budget_s=None) -> None:
         ids = list(dst_page_ids)
         n = len(ids)
         if n == 0:
             return
         # one span per transfer (staging -> last ack, incl. integrity
-        # re-fetches); bytes/refetches land as attrs on completion, and
-        # every chunk frame carries the trace so the DECODE side records
-        # its per-fetch inject spans in the same trace
+        # re-fetches and link-failure resumes); bytes/refetches/resumes
+        # land as attrs on completion, and every chunk frame carries the
+        # trace so the DECODE side records its per-fetch inject spans in
+        # the same trace
         t0 = time.monotonic()
+        deadline = t0 + budget_s if budget_s is not None else None
         span = TRACER.begin_span("kv.transfer", trace,
                                  request_id=request_id, pages=n,
                                  backend="remote", engine_id=engine_id)
@@ -327,7 +535,8 @@ class RemoteTransferBackend(TransferBackend):
         try:
             await self._send_pages_locked(engine_id, request_id, ids,
                                           k_pages, v_pages, k_scale,
-                                          v_scale, trace, span)
+                                          v_scale, trace, span,
+                                          alloc_epoch, deadline)
             failed = False
         finally:
             TRACER.end_span(span, error=failed)
@@ -335,30 +544,35 @@ class RemoteTransferBackend(TransferBackend):
 
     async def _send_pages_locked(self, engine_id: str, request_id: str, ids,
                                  k_pages, v_pages, k_scale, v_scale,
-                                 trace, span) -> None:
+                                 trace, span, alloc_epoch,
+                                 deadline) -> None:
         lock = self._locks.setdefault(engine_id, asyncio.Lock())
         async with lock:
-            conn_retried = False
             refetches = 0
+            resumes = 0
             while True:
                 try:
-                    sent = await self._send_chunks(engine_id, request_id,
-                                                   ids, k_pages, v_pages,
-                                                   k_scale, v_scale, trace)
+                    sent = await self._send_chunks(
+                        engine_id, request_id, ids, k_pages, v_pages,
+                        k_scale, v_scale, trace, alloc_epoch, deadline)
                     if span is not None:
-                        span.set(bytes=sent, refetches=refetches)
+                        span.set(bytes=sent, refetches=refetches,
+                                 resumes=resumes)
                     return
                 except IntegrityRejected:
                     # decode-side verify failed (bytes rotted in staging
                     # or on the wire): the device pages here are still
                     # authoritative, so a bounded re-fetch re-stages and
-                    # re-sends from scratch. The connection may hold
-                    # unread acks for the rest of the window — drop it.
+                    # re-sends — only the UNCOMMITTED tail, the committed
+                    # frontier survives the retry. The connection may
+                    # hold unread acks for the rest of the window — drop
+                    # it (and the cached endpoint with it).
                     self._drop(engine_id)
                     if refetches >= self.integrity_retries:
                         # persistent corruption: quarantine the staged
                         # source pages and abandon the remote path — the
-                        # decode side falls back to a local re-prefill
+                        # decode side salvages the committed prefix and
+                        # re-prefills only the rest
                         INTEGRITY.quarantined += len(ids)
                         INTEGRITY.reprefills += 1
                         log.error(
@@ -372,23 +586,52 @@ class RemoteTransferBackend(TransferBackend):
                     log.warning("kv transfer integrity mismatch for %s; "
                                 "re-fetch %d/%d", request_id, refetches,
                                 self.integrity_retries)
-                except (ConnectionError, asyncio.IncompleteReadError,
-                        OSError):
-                    # stale pooled connection or decode restart:
-                    # re-resolve the metadata and retry once from the top
-                    # (injects of the same pages are idempotent)
-                    self._drop(engine_id)
-                    if conn_retried:
-                        raise
-                    conn_retried = True
-                except RuntimeError:
-                    # semantic rejection (e.g. request released
-                    # decode-side): no retry, but the connection may
-                    # still hold unread acks for the rest of the window
-                    # — reusing it would desync every later transfer's
-                    # ack accounting. Drop it.
+                except TransferBudgetExceeded:
+                    # the request deadline's transfer sub-budget is
+                    # spent: final — never block a prefill slot for a
+                    # stream whose client has already given up
                     self._drop(engine_id)
                     raise
+                except (ConnectionError, asyncio.IncompleteReadError,
+                        asyncio.TimeoutError, OSError) as e:
+                    # mid-transfer link death: cut, reset, stalled socket
+                    # (per-IO timeout), or a decode worker restart. Drop
+                    # the pooled connection AND cached endpoint, then
+                    # RESUME — the reconnected stream's frontier
+                    # handshake skips every committed chunk, so a retry
+                    # costs only the unacked window, not the transfer.
+                    if isinstance(e, (TimeoutError, asyncio.TimeoutError)):
+                        XFER_STATS.link_timeouts += 1
+                    self._drop(engine_id)
+                    if resumes >= self.link_retries:
+                        log.error(
+                            "kv transfer for %s lost its link %d time(s); "
+                            "abandoning remote path (decode side salvages "
+                            "the committed prefix)", request_id,
+                            resumes + 1)
+                        raise
+                    resumes += 1
+                    log.warning("kv transfer link failure for %s (%s); "
+                                "resume %d/%d", request_id,
+                                type(e).__name__, resumes,
+                                self.link_retries)
+                except RuntimeError:
+                    # semantic rejection (request released decode-side,
+                    # stale alloc epoch): no retry, but the connection
+                    # may still hold unread acks for the rest of the
+                    # window — reusing it would desync every later
+                    # transfer's ack accounting. Drop it.
+                    self._drop(engine_id)
+                    raise
+
+    async def _chunk_gate(self, chunk_idx: int) -> None:
+        """Per-chunk seam, fired before each chunk is staged: the
+        `transfer.link` failpoint models a link cut (drop — raises a
+        ConnectionError into the resume path) or a stalled socket
+        (delay) at seeded chunk indices; tests also override this to
+        stage deterministic mid-stream sender deaths."""
+        if faults.REGISTRY.enabled:
+            await faults.REGISTRY.fire("transfer.link")
 
     @staticmethod
     def _stage_chunk(k_pages, v_pages, k_scale, v_scale, start: int,
@@ -424,21 +667,45 @@ class RemoteTransferBackend(TransferBackend):
 
     async def _send_chunks(self, engine_id: str, request_id: str, ids,
                            k_pages, v_pages, k_scale=None,
-                           v_scale=None, trace=None) -> int:
-        """Windowed pipelining: up to window_chunks frames are in flight
-        before the oldest ack is awaited, so device→host staging, the wire,
-        and the decode-side inject all overlap (the reference gets the same
-        overlap from NIXL's async one-sided writes + layer-wise CopyStream,
-        SURVEY.md §2.7 / kv/layer.rs:619-1140). Returns payload bytes."""
-        reader, writer = await self._connect(engine_id)
+                           v_scale=None, trace=None, alloc_epoch: int = 0,
+                           deadline=None) -> int:
+        """Windowed chunk-committed pipelining: up to window_chunks frames
+        are in flight before the oldest ack is awaited, so device→host
+        staging, the wire, and the decode-side inject all overlap (the
+        reference gets the same overlap from NIXL's async one-sided
+        writes + layer-wise CopyStream, SURVEY.md §2.7 /
+        kv/layer.rs:619-1140). Opens with the committed-frontier
+        handshake and skips every chunk already below it — the resume
+        path after a link failure AND the replacement-sender path after
+        a queue re-lease are the same code. Returns payload bytes sent
+        this attempt."""
+        reader, writer = await self._connect(engine_id, deadline)
         n = len(ids)
         dtype_name = str(np.dtype(k_pages.dtype))
         trace_wire = trace.to_wire() if trace is not None else None
+        # frontier handshake: one tiny frame, bounded reply
+        await self._write(writer, {"op": "resume",
+                                   "request_id": request_id,
+                                   "alloc_epoch": alloc_epoch}, deadline)
+        reply = await self._read(reader, deadline)
+        if not reply.get("ok"):
+            raise RuntimeError(
+                f"kv transfer handshake rejected by {engine_id!r}: "
+                f"{reply.get('error', 'unknown error')}")
+        committed = int(reply.get("committed", 0))
+        if committed > 0:
+            # a chunk-level resume: this stream continues a transfer a
+            # previous attempt (or a dead sender) already part-committed
+            XFER_STATS.resumes += 1
+            TRACER.event("kv.transfer.resume", trace,
+                         request_id=request_id, committed_pages=committed)
+            log.info("kv transfer for %s resumes from page %d/%d",
+                     request_id, committed, n)
         total_bytes = 0
         in_flight: list = []  # chunk sizes awaiting ack, oldest first
 
         async def retire_oldest():
-            ack = await read_frame(reader)
+            ack = await self._read(reader, deadline)
             if not ack.get("ok"):
                 if ack.get("integrity"):
                     raise IntegrityRejected(
@@ -449,36 +716,46 @@ class RemoteTransferBackend(TransferBackend):
                     f"{ack.get('error', 'unknown error')}")
             self.sent_pages += in_flight.pop(0)
 
-        for start in range(0, n, self.chunk_pages):
+        for chunk_idx, start in enumerate(range(0, n, self.chunk_pages)):
             count = min(self.chunk_pages, n - start)
+            if start + count <= committed:
+                continue  # durably committed decode-side: skip, don't resend
+            await self._chunk_gate(chunk_idx)
             chunk_ids = ids[start:start + count]
-            k_np, v_np, ks_np, vs_np, sums = await asyncio.to_thread(
-                self._stage_chunk, k_pages, v_pages, k_scale, v_scale,
-                start, count)
-            k_bytes = k_np.tobytes()
-            if faults.REGISTRY.enabled:
-                # the wire-corruption failpoint: flips bytes AFTER the
-                # capture checksum, exactly what a bad transport does
-                k_bytes = faults.REGISTRY.corrupt_bytes(
-                    "remote_transfer.fetch_page", k_bytes)
-            frame = {
-                "request_id": request_id,
-                "page_ids": chunk_ids,
-                "shape": list(k_np.shape),
-                "dtype": dtype_name,
-                "k": k_bytes,
-                "v": v_np.tobytes(),
-                "sums": sums,
-            }
-            payload = len(frame["k"]) + len(frame["v"])
-            if ks_np is not None:
-                frame["k_scale"] = ks_np.tobytes()
-                frame["v_scale"] = vs_np.tobytes()
-                payload += len(frame["k_scale"]) + len(frame["v_scale"])
-            if trace_wire is not None:
-                frame[TRACE_KEY] = trace_wire
-            write_frame(writer, frame)
-            await writer.drain()
+            with TRACER.span("kv.transfer.chunk", trace,
+                             request_id=request_id, chunk=chunk_idx,
+                             pages=count) as csp:
+                k_np, v_np, ks_np, vs_np, sums = await asyncio.to_thread(
+                    self._stage_chunk, k_pages, v_pages, k_scale, v_scale,
+                    start, count)
+                k_bytes = k_np.tobytes()
+                if faults.REGISTRY.enabled:
+                    # the wire-corruption failpoint: flips bytes AFTER the
+                    # capture checksum, exactly what a bad transport does
+                    k_bytes = faults.REGISTRY.corrupt_bytes(
+                        "remote_transfer.fetch_page", k_bytes)
+                frame = {
+                    "request_id": request_id,
+                    "alloc_epoch": alloc_epoch,
+                    "chunk_idx": chunk_idx,
+                    "base": start,
+                    "total": n,
+                    "page_ids": chunk_ids,
+                    "shape": list(k_np.shape),
+                    "dtype": dtype_name,
+                    "k": k_bytes,
+                    "v": v_np.tobytes(),
+                    "sums": sums,
+                }
+                payload = len(frame["k"]) + len(frame["v"])
+                if ks_np is not None:
+                    frame["k_scale"] = ks_np.tobytes()
+                    frame["v_scale"] = vs_np.tobytes()
+                    payload += len(frame["k_scale"]) + len(frame["v_scale"])
+                if trace_wire is not None:
+                    frame[TRACE_KEY] = trace_wire
+                await self._write(writer, frame, deadline)
+                csp.set(bytes=payload)
             XFER_STATS.bytes_sent += payload
             XFER_STATS.pages_sent += count
             total_bytes += payload

@@ -54,7 +54,8 @@ class TransferBackend(abc.ABC):
     @abc.abstractmethod
     async def send_pages(self, engine_id: str, request_id: str, dst_page_ids,
                          k_pages, v_pages, k_scale=None,
-                         v_scale=None, trace=None) -> None:
+                         v_scale=None, trace=None, alloc_epoch: int = 0,
+                         budget_s=None) -> None:
         """Inject pages (k/v: [L, Hkv, Nb, ps, hd] on the sender's mesh;
         kv_quant senders also pass the [L, Hkv, Nb, ps] scale stacks)
         into the target engine's cache at dst_page_ids.
@@ -62,6 +63,18 @@ class TransferBackend(abc.ABC):
         `trace`: optional TraceContext — implementations record a
         "kv.transfer" span (bytes + pages + duration) under it and
         observe llm_kv_transfer_seconds either way.
+
+        `alloc_epoch`: the decode-side allocation's admission epoch
+        (RemoteAllocation.alloc_epoch). Nonzero epochs FENCE the write:
+        the receiver rejects the transfer when the pending allocation's
+        epoch differs — a stale sender (zombie after lease expiry, or a
+        reused request id after release+realloc) can never write into
+        reallocated pages. 0 = unfenced (the scheduler.remote pending
+        guard still applies).
+
+        `budget_s`: optional wall-clock budget for the whole transfer,
+        derived from the request deadline — implementations must fail
+        (never block past it) once spent.
 
         Raises if request_id is no longer pending on the target (the decode
         side timed out and released the pages — injecting would corrupt
@@ -88,7 +101,8 @@ class LocalTransferBackend(TransferBackend):
 
     async def send_pages(self, engine_id: str, request_id: str, dst_page_ids,
                          k_pages, v_pages, k_scale=None,
-                         v_scale=None, trace=None) -> None:
+                         v_scale=None, trace=None, alloc_epoch: int = 0,
+                         budget_s=None) -> None:
         worker = self._receivers.get(engine_id)
         if worker is None:
             raise KeyError(f"unknown decode engine {engine_id!r}")
@@ -101,7 +115,7 @@ class LocalTransferBackend(TransferBackend):
         try:
             await self._send_pages_inner(engine_id, request_id, ids,
                                          k_pages, v_pages, k_scale,
-                                         v_scale, span)
+                                         v_scale, span, alloc_epoch)
             failed = False
         finally:
             TRACER.end_span(span, error=failed)
@@ -109,7 +123,7 @@ class LocalTransferBackend(TransferBackend):
 
     async def _send_pages_inner(self, engine_id: str, request_id: str, ids,
                                 k_pages, v_pages, k_scale, v_scale,
-                                span) -> None:
+                                span, alloc_epoch: int = 0) -> None:
         worker = self._receivers[engine_id]
         if faults.REGISTRY.enabled \
                 and faults.REGISTRY.armed("remote_transfer.fetch_page"):
@@ -141,10 +155,18 @@ class LocalTransferBackend(TransferBackend):
         def inject(eng):
             # guard against decode-side timeout/release: the pages may have
             # been reallocated to another request
-            if request_id not in eng.scheduler.remote:
+            seq = eng.scheduler.remote.get(request_id)
+            if seq is None:
                 raise KeyError(
                     f"request {request_id!r} no longer pending on "
                     f"{engine_id!r}")
+            if alloc_epoch and seq.epoch != alloc_epoch:
+                # epoch fence: same id, DIFFERENT allocation — a stale
+                # sender must never write into reallocated pages
+                XFER_STATS.stale_chunks += 1
+                raise KeyError(
+                    f"request {request_id!r} epoch {seq.epoch} != sender "
+                    f"alloc_epoch {alloc_epoch} (stale transfer)")
             eng.inject_pages(ids, k, v, ks, vs)
             XFER_STATS.fetches += 1
             XFER_STATS.bytes_fetched += nbytes

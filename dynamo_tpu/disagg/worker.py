@@ -31,6 +31,7 @@ from dynamo_tpu.protocols.common import (
     EngineOutput, FinishReason, PreprocessedRequest,
 )
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.integrity import XFER_STATS
 from dynamo_tpu.runtime.tracing import TRACER, TraceContext
 
 log = logging.getLogger("dynamo_tpu.disagg")
@@ -65,6 +66,19 @@ class DisaggDecodeWorker(NativeEngineWorker):
         # counters surfaced through worker stats
         self.remote_prefills = 0
         self.local_prefills = 0
+        # fallback disposition (chunk-committed transfer, docs/RESILIENCE
+        # .md): salvages re-used a committed prefix and re-prefilled only
+        # the tail; full_reprefills recomputed from token zero (nothing
+        # had committed). majority_committed_full_reprefills counts full
+        # recomputes that threw away a >=50%-committed transfer — the
+        # waste salvage exists to make structurally impossible (the
+        # chaos storm asserts it stays 0).
+        self.salvaged_prefills = 0
+        self.full_reprefills = 0
+        self.majority_committed_full_reprefills = 0
+        # set by KvTransferServer when one is attached to this worker;
+        # the salvage path reads the committed frontier through it
+        self.kv_transfer_server = getattr(self, "kv_transfer_server", None)
 
     async def start(self):
         await super().start()
@@ -122,6 +136,30 @@ class DisaggDecodeWorker(NativeEngineWorker):
             return
         async for frame in self._generate_remote(pre, req, context):
             yield frame
+
+    async def _broadcast_cancel(self, rid: str) -> None:
+        """Tell the prefill fleet this request's remote prefill is moot —
+        drop it if queued, abort it mid-run, and settle the lease. Fired
+        on EVERY abandoning exit (client stop, prefill timeout, deadline
+        expiry), not just client stops: a timed-out remote prefill the
+        decode side has already given up on would otherwise keep burning
+        a prefill-engine slot to completion (the late transfer fails
+        safely on the scheduler.remote guard, but the compute is gone)."""
+        try:
+            await self.messaging.publish(
+                cancel_subject(self.prefill_queue.name),
+                PrefillCancel(request_id=rid).model_dump_json().encode())
+        except Exception:  # dynalint: swallow-ok=best-effort-cancel-broadcast
+            log.exception("prefill cancel publish failed for %s", rid)
+
+    def _committed_frontier(self, rid: str, alloc_epoch: int) -> int:
+        """Transfer-list pages the attached KvTransferServer has durably
+        committed for this exact allocation (0 without a server — the
+        local backend's one-shot device_put is all-or-nothing)."""
+        srv = self.kv_transfer_server
+        if srv is None:
+            return 0
+        return srv.committed_frontier(rid, alloc_epoch)
 
     async def _generate_remote(self, pre: PreprocessedRequest,
                                req: EngineRequest, context: Context):
@@ -190,6 +228,7 @@ class DisaggDecodeWorker(NativeEngineWorker):
                     page_ids=alloc.page_ids,
                     num_cached_tokens=alloc.num_cached_tokens,
                     page_size=self.engine.cfg.page_size,
+                    alloc_epoch=alloc.alloc_epoch,
                     notify_subject=self.notify_subject,
                     mm_parts=mm_parts,
                     deadline_unix=(time.time() + remaining
@@ -214,19 +253,19 @@ class DisaggDecodeWorker(NativeEngineWorker):
                 # would fail safely on the scheduler.remote guard anyway,
                 # but without the broadcast the dead prefill still burns a
                 # whole engine slot)
-                try:
-                    await self.messaging.publish(
-                        cancel_subject(self.prefill_queue.name),
-                        PrefillCancel(
-                            request_id=rid).model_dump_json().encode())
-                except Exception:  # dynalint: swallow-ok=best-effort-cancel-broadcast
-                    log.exception("prefill cancel publish failed for %s", rid)
+                await self._broadcast_cancel(rid)
                 yield EngineOutput(
                     finish_reason=FinishReason.CANCELLED).model_dump(
                         exclude_none=True)
                 return
             completion = fut.result() if fut.done() else None
             if completion is None or completion.error:
+                if completion is None:
+                    # the prefill is still queued or running somewhere we
+                    # no longer care about: cancel it on every abandoning
+                    # exit (timeout AND deadline expiry), not just client
+                    # stops — a dead prefill must not finish its slot
+                    await self._broadcast_cancel(rid)
                 if context.deadline_expired:
                     # the client budget is spent (the queue-side expiry
                     # drop lands here too): a local re-prefill would burn
@@ -238,10 +277,54 @@ class DisaggDecodeWorker(NativeEngineWorker):
                         text="deadline exceeded during remote prefill",
                     ).model_dump(exclude_none=True)
                     return
-                # remote prefill failed or timed out: recompute locally
-                log.warning("remote prefill failed for %s (%s); local "
-                            "fallback", rid,
+                # remote prefill failed or timed out. If the streamed
+                # transfer COMMITTED a prefix (verified+injected+acked
+                # chunks), salvage it: re-prefill locally only from the
+                # committed page boundary — the disagg twin of the
+                # migration path's committed-prefix re-dispatch. Only a
+                # transfer with NOTHING committed recomputes from token
+                # zero.
+                frontier = self._committed_frontier(rid, alloc.alloc_epoch)
+                if frontier > 0:
+                    ps = self.engine.cfg.page_size
+                    start_page = alloc.num_cached_tokens // ps
+                    valid_pages = start_page + frontier
+                    log.warning(
+                        "remote prefill failed for %s (%s); salvaging %d "
+                        "committed page(s), re-prefilling the tail "
+                        "locally", rid,
+                        completion.error if completion else "timeout",
+                        frontier)
+                    self.salvaged_prefills += 1
+                    XFER_STATS.salvaged_pages += frontier
+                    q = self._register(rid)
+                    try:
+                        salvaged = await self.submit(
+                            lambda eng: eng.salvage_remote(rid,
+                                                           valid_pages))
+                        TRACER.event("kv.salvage", context.trace,
+                                     request_id=rid, pages=frontier,
+                                     tokens=salvaged)
+                        async for frame in self._stream(rid, context, q):
+                            yield frame
+                        holding = False
+                    finally:
+                        self._queues.pop(rid, None)
+                    return
+                log.warning("remote prefill failed for %s (%s); full "
+                            "local fallback (nothing committed)", rid,
                             completion.error if completion else "timeout")
+                self.full_reprefills += 1
+                shipped = (len(alloc.page_ids)
+                           - alloc.num_cached_tokens
+                           // self.engine.cfg.page_size)
+                if shipped > 0 and frontier >= 0.5 * shipped:
+                    # structural tripwire (asserted 0 by the transfer
+                    # chaos storm): a majority-committed transfer must
+                    # never be recomputed from token zero — salvage above
+                    # takes any frontier > 0, so this only fires if the
+                    # frontier accounting ever breaks
+                    self.majority_committed_full_reprefills += 1
                 await self.submit(lambda eng: eng.release_remote(rid))
                 holding = False
                 self.local_prefills += 1
@@ -283,14 +366,24 @@ class DisaggDecodeWorker(NativeEngineWorker):
                 self._queues.pop(rid, None)
         finally:
             self._completions.pop(rid, None)
+            if self.kv_transfer_server is not None:
+                # the request's fate is settled (activated, salvaged, or
+                # released): drop the transfer's commit bookkeeping
+                self.kv_transfer_server.forget(rid)
             if holding:
                 self._pending_aborts.append(rid)
                 self._wake.set()
 
     def stats_handler(self) -> dict:
         stats = super().stats_handler()
-        stats["disagg"] = {"remote_prefills": self.remote_prefills,
-                           "local_prefills": self.local_prefills}
+        stats["disagg"] = {
+            "remote_prefills": self.remote_prefills,
+            "local_prefills": self.local_prefills,
+            "salvaged_prefills": self.salvaged_prefills,
+            "full_reprefills": self.full_reprefills,
+            "majority_committed_full_reprefills":
+                self.majority_committed_full_reprefills,
+        }
         return stats
 
 
@@ -516,6 +609,24 @@ class PrefillWorker:
                 seq = eng.scheduler.parked[rid]
                 return eng.extract_pages(seq.pages[start_page:])
             pages = await self.worker.submit(extract)
+            # the transfer leg may legitimately outlast the dequeue lease
+            # when the link flaps and the sender resumes: re-arm the
+            # lease now instead of sizing lease_s for the worst-case
+            # resume ladder. An already-expired lease means the item was
+            # redelivered — keep going anyway: the decode side's chunk
+            # commits are idempotent, and whichever sender finishes
+            # first wins (the other's chunks ack as duplicates).
+            await self.queue.touch(token, self.lease_s)
+            # transfer sub-budget derived from the client deadline: the
+            # transfer must fail (and let the decode side salvage the
+            # committed prefix) rather than stream past the moment the
+            # client gave up
+            budget_s = None
+            if req.deadline_unix is not None:
+                budget_s = req.deadline_unix - time.time()
+                if budget_s <= 0:
+                    raise RuntimeError(
+                        "deadline exceeded before transfer started")
             # kv_quant engines extract int8 pages + scale stacks; the
             # transfer ships that representation verbatim (half the wire
             # bytes of bf16; checksums cover the quantized bytes)
@@ -524,7 +635,9 @@ class PrefillWorker:
                 pages["k"], pages["v"],
                 k_scale=pages.get("k_scale"),
                 v_scale=pages.get("v_scale"),
-                trace=trace)
+                trace=trace,
+                alloc_epoch=req.alloc_epoch,
+                budget_s=budget_s)
             await self.worker.submit(lambda eng: eng.release_parked(rid))
             self.completed += 1
             await self._notify(req, PrefillCompletion(

@@ -1485,6 +1485,15 @@ class NativeEngine:
     def release_remote(self, request_id: str) -> None:
         self.scheduler.release_remote(request_id)
 
+    def salvage_remote(self, request_id: str, valid_pages: int) -> int:
+        """Decode side: the remote prefill is unrecoverable but the
+        streamed transfer COMMITTED a prefix (verified + injected +
+        acked chunks). Keep those pages and re-prefill locally only
+        from the committed page boundary — the disagg twin of the
+        migration path's committed-prefix re-dispatch. Returns the
+        salvaged token count."""
+        return self.scheduler.salvage_remote(request_id, valid_pages)
+
     def release_parked(self, request_id: str) -> None:
         self.scheduler.release_parked(request_id)
 
@@ -1578,6 +1587,10 @@ class NativeEngine:
         m.kv_quant_bits = 8 if self.kv_quant == "int8" else 0
         m.kv_transfer_bytes = XFER_STATS.bytes_sent
         m.kv_transfer_fetches = XFER_STATS.fetches
+        m.kv_transfer_resumes = XFER_STATS.resumes
+        m.kv_transfer_salvaged_pages = XFER_STATS.salvaged_pages
+        m.kv_transfer_stale_chunks = XFER_STATS.stale_chunks
+        m.kv_transfer_link_timeouts = XFER_STATS.link_timeouts
         return m
 
     def moe_drop_rate(self) -> float:

@@ -91,6 +91,12 @@ class RemoteAllocation:
     request_id: str
     page_ids: List[int]
     num_cached_tokens: int   # prefix-hit tokens already valid decode-side
+    # admission epoch of the allocated sequence: rides every transfer
+    # chunk so the decode side can fence out a STALE sender — a zombie
+    # prefill worker (expired lease, replacement already streaming)
+    # whose chunks would otherwise land in pages that may have been
+    # released and reallocated to a different request reusing the id
+    alloc_epoch: int = 0
 
 
 @dataclasses.dataclass
@@ -220,6 +226,13 @@ class EngineMetrics:
     kv_quant_bits: int = 0
     kv_transfer_bytes: int = 0
     kv_transfer_fetches: int = 0
+    # chunk-committed streaming (disagg/remote_transfer.py): resumed
+    # transfers, salvaged committed-prefix pages, epoch-fenced stale
+    # chunks, and per-IO timeouts treated as link death
+    kv_transfer_resumes: int = 0
+    kv_transfer_salvaged_pages: int = 0
+    kv_transfer_stale_chunks: int = 0
+    kv_transfer_link_timeouts: int = 0
 
 
 def window_ladder(decode_steps: int) -> List[int]:
@@ -394,7 +407,8 @@ class Scheduler:
         return RemoteAllocation(
             request_id=req.request_id,
             page_ids=list(seq.pages),
-            num_cached_tokens=seq.num_cached)
+            num_cached_tokens=seq.num_cached,
+            alloc_epoch=seq.epoch)
 
     def activate_remote(self, request_id: str, first_token: int
                         ) -> SequenceState:
@@ -416,6 +430,34 @@ class Scheduler:
         seq = self.remote.pop(request_id, None)
         if seq is not None:
             self.finish(seq)
+
+    def salvage_remote(self, request_id: str, valid_pages: int) -> int:
+        """Unrecoverable remote prefill after a PARTIAL transfer: re-enter
+        the normal prefill flow keeping the committed prefix (the disagg
+        twin of the migration path's committed-prefix re-dispatch).
+
+        The first `valid_pages` of the up-front allocation hold KV the
+        decode-side KvTransferServer verified and injected (chunk acks
+        only advance the frontier AFTER a successful inject, so every
+        page below it is real), and both engines share weights — the
+        bytes are exactly what a local prefill would have produced. Only
+        the uncommitted tail is recomputed, with at least one token left
+        so the local prefill samples the first output itself (there is
+        no PrefillCompletion.first_token on this path).
+
+        Returns the number of prompt tokens salvaged (charged as cached,
+        not recomputed)."""
+        seq = self.remote.pop(request_id)
+        ps = self.cfg.page_size
+        n = len(seq.prompt)
+        valid = max(0, min(valid_pages * ps, n - 1))
+        # never below the prefix-cache hit the allocation already had
+        valid = max(valid, seq.num_cached)
+        seq.num_cached = valid
+        seq.num_computed = valid
+        self._seal_full_pages(seq)  # publish stored events: injected pages
+        self.waiting.appendleft(seq)
+        return valid
 
     # -- disaggregation: prefill side ----------------------------------------
 

@@ -125,7 +125,12 @@ class HttpService:
                           f"kv transfer: cumulative {name} "
                           "(wire representation)")
             for name in ("bytes_sent", "pages_sent", "fetches",
-                         "bytes_fetched")}
+                         "bytes_fetched",
+                         # chunk-committed streaming: resumed transfers,
+                         # salvaged committed-prefix pages, epoch-fenced
+                         # stale chunks, per-IO link timeouts
+                         "resumes", "salvaged_pages", "stale_chunks",
+                         "link_timeouts")}
         # control-plane health (runtime/cpstats.py CP_STATS): watch
         # queue depth + coalescing, indexer size + eviction backlog,
         # event-plane lag, and the router's stale-snapshot degraded flag

@@ -55,6 +55,13 @@ class WorkerMetrics:
     kv_quant_bits: int = 0
     kv_transfer_bytes: int = 0
     kv_transfer_fetches: int = 0
+    # chunk-committed streaming (disagg/remote_transfer.py): resumed
+    # transfers, salvaged committed-prefix pages, epoch-fenced stale
+    # chunks, per-IO timeouts treated as link death
+    kv_transfer_resumes: int = 0
+    kv_transfer_salvaged_pages: int = 0
+    kv_transfer_stale_chunks: int = 0
+    kv_transfer_link_timeouts: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "WorkerMetrics":

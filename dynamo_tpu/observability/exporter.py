@@ -112,6 +112,18 @@ class MetricsExporter:
                 ("transfer_fetches", "Cumulative KV transfer fetches"),
                 ("transfer_bytes_per_fetch",
                  "Mean KV transfer payload bytes per fetch"),
+                # chunk-committed streaming (disagg/remote_transfer.py)
+                ("transfer_resumes",
+                 "KV transfers resumed from a committed frontier "
+                 "(link failure or replacement sender)"),
+                ("transfer_salvaged_pages",
+                 "Committed-prefix pages re-used by decode-side salvage "
+                 "instead of local re-prefill"),
+                ("transfer_stale_chunks",
+                 "Transfer chunks rejected by the alloc-epoch fence "
+                 "(stale sender after realloc)"),
+                ("transfer_link_timeouts",
+                 "Per-IO socket timeouts treated as transfer link death"),
             )}
         self.g_load_avg = r.gauge(
             f"{PREFIX}_load_avg", "Mean active KV blocks across workers")
@@ -232,6 +244,14 @@ class MetricsExporter:
                 worker_id,
                 value=(m.kv_transfer_bytes / m.kv_transfer_fetches
                        if m.kv_transfer_fetches else 0.0))
+            self.g_kv_repr["transfer_resumes"].set(
+                worker_id, value=m.kv_transfer_resumes)
+            self.g_kv_repr["transfer_salvaged_pages"].set(
+                worker_id, value=m.kv_transfer_salvaged_pages)
+            self.g_kv_repr["transfer_stale_chunks"].set(
+                worker_id, value=m.kv_transfer_stale_chunks)
+            self.g_kv_repr["transfer_link_timeouts"].set(
+                worker_id, value=m.kv_transfer_link_timeouts)
         self.g_load_avg.set(value=endpoints.load_avg)
         self.g_load_std.set(value=endpoints.load_std)
         self.g_workers.set(value=len(endpoints.workers))

@@ -13,6 +13,15 @@ Sites (the catalog lives in docs/RESILIENCE.md):
     transport.send              control-plane op leaving this process
     transport.recv              event/frame delivery into a subscriber
     remote_transfer.fetch_page  KV page bytes crossing the transfer plane
+    transfer.link               the data-plane link itself, fired once
+                                per streamed KV chunk on the sender: a
+                                drop is a link cut / connection reset
+                                mid-transfer (the sender must RESUME
+                                from the committed frontier, not
+                                restart), a delay is a stalled socket
+                                (the per-IO timeouts must bound it);
+                                `skip` pins the fault to a seeded chunk
+                                index
     offload.write_tier          KV page landing in a host/disk tier slab
     offload.read_tier           KV page read back out of a tier slab
     queue.dequeue               durable work-queue consumption
@@ -63,6 +72,7 @@ SITES = (
     "transport.send",
     "transport.recv",
     "remote_transfer.fetch_page",
+    "transfer.link",
     "offload.write_tier",
     "offload.read_tier",
     "queue.dequeue",
@@ -94,13 +104,21 @@ class FaultSpec:
     (seeded); ``n`` bounds how many hits the rule may fire on in total
     (0 = unbounded) — `fail_n` uses it as the fail-then-ok budget, and
     a `corrupt` with n=1 models a transient single corruption that a
-    bounded re-fetch must absorb."""
+    bounded re-fetch must absorb. ``skip`` makes the rule dormant for
+    the first `skip` hits, so a fault can be pinned to a deterministic
+    hit index (a `fail_n` with skip=k, n=1 cuts exactly the k-th
+    chunk/op — the transfer.link resume matrix rides this).
+    ``delay_min_s`` floors the seeded delay draw (delay in
+    [delay_min_s, delay_s]); delay_min_s == delay_s is a deterministic
+    stall of exactly that length."""
 
     kind: str
     p: float = 1.0
     n: int = 0
     delay_s: float = 0.0
     nbytes: int = 1
+    skip: int = 0
+    delay_min_s: float = 0.0
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -150,8 +168,12 @@ class FaultSchedule:
             roll = self._rng.random()
             if spec.n and self._fired[i] >= spec.n:
                 continue
+            if spec.skip and self.hits <= spec.skip:
+                # dormant for the first `skip` hits: the roll above was
+                # still consumed, so skipping never shifts the stream
+                continue
             if spec.kind == "fail_n":
-                # deterministic: fails exactly the first n hits
+                # deterministic: fails exactly the first n (post-skip) hits
                 self._fired[i] += 1
                 out.drop = True
                 continue
@@ -161,8 +183,10 @@ class FaultSchedule:
             if spec.kind == "drop":
                 out.drop = True
             elif spec.kind == "delay":
-                out.delay_s = max(out.delay_s,
-                                  self._rng.random() * spec.delay_s)
+                lo = min(spec.delay_min_s, spec.delay_s)
+                out.delay_s = max(
+                    out.delay_s,
+                    lo + self._rng.random() * (spec.delay_s - lo))
             elif spec.kind == "corrupt":
                 out.corrupt = True
                 out.nbytes = max(out.nbytes, spec.nbytes)

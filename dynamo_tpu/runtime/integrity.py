@@ -90,6 +90,18 @@ class KvTransferStats:
     pages_sent: int = 0       # pages those bytes carried
     fetches: int = 0          # transfer frames fetched/injected
     bytes_fetched: int = 0    # payload bytes arriving at inject
+    # chunk-committed streaming (disagg/remote_transfer.py): transfers
+    # that resumed from a non-zero committed frontier instead of
+    # restarting (mid-stream link failure OR a replacement sender after
+    # queue re-lease), pages a decode-side salvage re-used from the
+    # committed prefix instead of re-prefilling, chunks rejected by the
+    # (request_id, alloc_epoch) fence (a stale sender writing after the
+    # pages were reallocated), and per-IO socket timeouts treated as
+    # link death
+    resumes: int = 0
+    salvaged_pages: int = 0
+    stale_chunks: int = 0
+    link_timeouts: int = 0
 
     def snapshot(self) -> Dict[str, int]:
         return dataclasses.asdict(self)

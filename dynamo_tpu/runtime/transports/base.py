@@ -234,6 +234,17 @@ class Messaging(abc.ABC):
         """Settle a leased item: it is done (or terminally failed) and must
         not be redelivered."""
 
+    async def queue_touch(self, queue: str, token: str,
+                          lease_s: float = 30.0) -> bool:
+        """Extend a leased item's redelivery deadline to now + lease_s
+        (JetStream in-progress ack): a consumer entering a long leg it
+        is still actively driving (a resumable KV transfer) re-arms the
+        lease instead of sizing lease_s for the worst case up front.
+        Returns False when the lease is unknown — already expired and
+        redelivered, so the caller's work is now a duplicate. Default:
+        no-op success (lease-less backends)."""
+        return True
+
 
 def subject_matches(pattern: str, subject: str) -> bool:
     """NATS-style: '>' matches any suffix."""

@@ -332,6 +332,16 @@ class MemoryMessaging(Messaging):
         if item is not None:
             self._delivery_counts.pop((item[0], item[1]), None)
 
+    async def queue_touch(self, queue, token, lease_s: float = 30.0):
+        item = self._leased.get(token)
+        if item is None:
+            # expired (and possibly already redelivered): the toucher's
+            # copy of the work is now a duplicate
+            return False
+        q, payload, _deadline, n = item
+        self._leased[token] = (q, payload, time.monotonic() + lease_s, n)
+        return True
+
 
 class MemoryPlane:
     """Bundle of both planes, shared by components within one process."""

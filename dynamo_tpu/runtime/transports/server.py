@@ -44,11 +44,15 @@ class _Conn:
     async def send(self, msg):
         async with self._write_lock:
             write_frame(self.writer, msg)
-            await self.writer.drain()
+            # bounded: one client that stops reading must not wedge every
+            # send to its connection behind the write lock (TimeoutError
+            # is an OSError — handled like any dead connection)
+            await asyncio.wait_for(self.writer.drain(), 30.0)
 
     async def run(self):
         try:
             while True:
+                # dynalint: unbounded-io-ok=idle-client-connections-are-legal
                 msg = await read_frame(self.reader)
                 asyncio.create_task(self._dispatch(msg))
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -258,6 +262,11 @@ class _Conn:
     async def _op_queue_ack(self, m):
         await self.server.plane.messaging.queue_ack(m["queue"], m["token"])
         return {}
+
+    async def _op_queue_touch(self, m):
+        alive = await self.server.plane.messaging.queue_touch(
+            m["queue"], m["token"], lease_s=m.get("lease_s") or 30.0)
+        return {"alive": bool(alive)}
 
     async def _op_queue_depth(self, m):
         return {"depth": await self.server.plane.messaging.queue_depth(m["queue"])}
@@ -502,8 +511,13 @@ class ControlPlaneServer:
                 continue
             try:
                 write_frame(writer, {"op": "repl_subscribe", "id": 1})
-                await writer.drain()
+                # one tiny frame: cannot fill the peer's recv window, but
+                # bound it anyway so a wedged primary can't pin the standby
+                await asyncio.wait_for(writer.drain(), 30.0)
                 while True:
+                    # dynalint: unbounded-io-ok=replication-stream-is-push —
+                    # the primary sends journal records as writes happen;
+                    # link death surfaces as EOF and the loop re-dials
                     m = await read_frame(reader)
                     if m.get("id") == 1:
                         if m.get("error"):

@@ -110,7 +110,11 @@ class ControlPlaneClient(KVStore, Messaging):
             await faults.REGISTRY.fire("transport.send")
         async with self._write_lock:
             write_frame(self._writer, msg)
-            await self._writer.drain()
+            # bounded: a control-plane peer that stops reading must not
+            # wedge every sender behind the write lock. TimeoutError is
+            # an OSError (3.11+), so existing transport-death handlers
+            # treat it as a lost connection.
+            await asyncio.wait_for(self._writer.drain(), 30.0)
 
     async def _rpc(self, msg, timeout: float = 60.0):
         rid = next(self._ids)
@@ -132,6 +136,9 @@ class ControlPlaneClient(KVStore, Messaging):
     async def _read_loop(self):
         try:
             while True:
+                # dynalint: unbounded-io-ok=idle-is-legal-here — the server
+                # pushes watch/sub events at arbitrary times; liveness is
+                # the keepalive loop's job, death surfaces as EOF
                 msg = await read_frame(self._reader)
                 op = msg.get("op")
                 if op is None:
@@ -304,6 +311,11 @@ class ControlPlaneClient(KVStore, Messaging):
 
     async def queue_ack(self, queue, token):
         await self._rpc({"op": "queue_ack", "queue": queue, "token": token})
+
+    async def queue_touch(self, queue, token, lease_s: float = 30.0):
+        reply = await self._rpc({"op": "queue_touch", "queue": queue,
+                                 "token": token, "lease_s": lease_s})
+        return bool(reply.get("alive", True))
 
     async def queue_depth(self, queue):
         return (await self._rpc({"op": "queue_depth", "queue": queue}))["depth"]

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import struct
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import msgpack
 
@@ -22,13 +22,26 @@ def pack(msg: Dict[str, Any]) -> bytes:
     return struct.pack(">I", len(body)) + body
 
 
-async def read_frame(reader: asyncio.StreamReader) -> Dict[str, Any]:
+async def _read_frame_inner(reader: asyncio.StreamReader) -> Dict[str, Any]:
+    # dynalint: unbounded-io-ok=bounded by read_frame(timeout=) or the caller's wrapper
     header = await reader.readexactly(4)
     (length,) = struct.unpack(">I", header)
     if length > MAX_FRAME:
         raise ValueError(f"frame of {length} bytes exceeds max {MAX_FRAME}")
+    # dynalint: unbounded-io-ok=bounded by read_frame(timeout=) or the caller's wrapper
     body = await reader.readexactly(length)
     return msgpack.unpackb(body, raw=False)
+
+
+async def read_frame(reader: asyncio.StreamReader,
+                     timeout: Optional[float] = None) -> Dict[str, Any]:
+    """Read one frame. `timeout` (seconds) bounds the WHOLE frame —
+    header and body together, so a peer that trickles bytes cannot
+    stretch one read past the deadline. None = caller owns the bound
+    (an idle server-side pump, or an enclosing wait_for)."""
+    if timeout is None:
+        return await _read_frame_inner(reader)
+    return await asyncio.wait_for(_read_frame_inner(reader), timeout)
 
 
 def write_frame(writer: asyncio.StreamWriter, msg: Dict[str, Any]) -> None:
@@ -51,8 +64,10 @@ async def oneshot_request(host: str, port: int, msg: Dict[str, Any],
         reader, writer = await asyncio.open_connection(host, port)
         try:
             write_frame(writer, {"id": 1, **msg})
+            # dynalint: unbounded-io-ok=whole-_go-body-under-one-wait_for
             await writer.drain()
             while True:
+                # dynalint: unbounded-io-ok=whole-_go-body-under-one-wait_for
                 m = await read_frame(reader)
                 if m.get("id") == 1:
                     return m, reader, writer

@@ -82,6 +82,25 @@ ROLLING_PLAN = {
         {"kind": "delay", "p": 1.0, "delay_s": 0.003}]},
 }
 
+# disagg transfer storm (chunk-committed data plane): seeded link cuts
+# mid-stream, a deterministic 30s stall that the doomed prefill worker
+# dies inside (its re-leased item must RESUME from the acked frontier),
+# and queue jitter — the decode-side transfer server is also restarted
+# on a new port mid-run (endpoint re-resolution), and a final leg kills
+# the link for good after a majority of chunks committed (salvage).
+TRANSFER_STORM_PLAN = {
+    "transfer.link": {"seed": 53, "specs": [
+        # the stall: hit 3 (the doomed sender's third chunk) wedges for
+        # exactly 30s — the worker is killed inside it, holding a
+        # part-committed transfer
+        {"kind": "delay", "p": 1.0, "n": 1, "skip": 2,
+         "delay_s": 30.0, "delay_min_s": 30.0},
+        # seeded link cuts across the rest of the run
+        {"kind": "drop", "p": 0.12}]},
+    "queue.dequeue": {"seed": 353, "specs": [
+        {"kind": "delay", "p": 0.5, "delay_s": 0.01}]},
+}
+
 # control-plane storm (the scale-harness scenario): watch-stream
 # disconnects, a discovery-store brown-out, event-plane lag/reorder, and
 # seeded heartbeat loss — all at once, over a simulated fleet
@@ -482,6 +501,162 @@ def test_chaos_rolling_restart_zero_drop_token_identical():
     run_scenario("rolling_restart")
 
 
+# -- scenario: disagg transfer storm (chunk-committed data plane) --------------
+
+def run_disagg_transfer_storm(plan):
+    """Mid-transfer failure storm over the REAL TCP transfer plane
+    (chunk_pages=1 so every transfer is a multi-chunk stream):
+
+      phase A — a prefill worker is killed INSIDE a transfer (the plan's
+        deterministic stall) after chunks have durably committed; the
+        re-leased item's replacement sender must resume from the acked
+        frontier, not re-ship committed pages;
+      phase B — seeded link cuts land mid-stream on the survivor; every
+        cut is absorbed by reconnect+resume;
+      phase C — the decode-side transfer server restarts on a NEW port
+        (established connections reset, like a process restart); the
+        sender must invalidate its cached endpoint and re-resolve from
+        discovery;
+      phase D — the link dies for good after 3 of 4 chunks committed;
+        the decode worker must SALVAGE the committed prefix (local
+        re-prefill only past the committed page boundary).
+
+    Contract: ZERO dropped streams — every request completes
+    token-identical to the aggregated oracle; >= 1 chunk-level resume is
+    recorded; and no request whose transfer was majority-committed is
+    ever re-prefilled from token zero (salvage counters prove the
+    committed prefix was reused)."""
+    from dynamo_tpu.disagg import (
+        DisaggDecodeWorker, DisaggregatedRouter, KvTransferServer,
+        PrefillQueue, PrefillWorker, RemoteTransferBackend,
+    )
+    from dynamo_tpu.llm.worker import NativeEngineWorker
+    from dynamo_tpu.runtime.integrity import XFER_STATS
+
+    # 30-token prompts -> 4 pages -> 4 one-page chunks per transfer
+    prompts = {i: [(11 * i + j) % 200 + 3 for j in range(30)]
+               for i in range(8)}
+    params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    oracle_engine = make_engine()
+    oracle = {i: oracle_engine.generate(p, params, f"o{i}")
+              for i, p in prompts.items()}
+    r0, s0 = XFER_STATS.resumes, XFER_STATS.salvaged_pages
+
+    async def main():
+        faults.REGISTRY.arm_from_dict(plan)
+        plane = MemoryPlane()
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        router = DisaggregatedRouter(max_local_prefill_length=4,
+                                     max_prefill_queue_size=32)
+        decode = DisaggDecodeWorker(
+            make_engine(), plane.messaging, router, queue,
+            worker_id="dec-0", prefill_timeout_s=90.0)
+        server = await KvTransferServer(decode, "dec-0").start()
+        await server.register(plane.kv)
+        # window_chunks=1 keeps commits stop-and-wait: at any cut the
+        # frontier equals the chunks already acked — deterministic
+        doomed = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue,
+            RemoteTransferBackend(plane.kv, chunk_pages=1,
+                                  window_chunks=1),
+            plane.messaging, dequeue_timeout_s=0.1, max_inflight=1,
+            lease_s=0.5)
+        surv_tx = RemoteTransferBackend(plane.kv, chunk_pages=1,
+                                        window_chunks=1)
+        survivor = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue, surv_tx,
+            plane.messaging, dequeue_timeout_s=0.1, lease_s=10.0)
+        await decode.start()
+        await doomed.start()
+
+        async def run_request(i):
+            from dynamo_tpu.runtime.tracing import TRACER
+            ctx = Context(f"r{i}")
+            # root the request's trace here (no frontend in this stack)
+            # so a --trace replay captures the kv.transfer.chunk /
+            # kv.transfer.resume / kv.salvage tree; None when disabled
+            ctx.trace = TRACER.start_trace(f"storm-r{i}")
+            toks = []
+            async for frame in decode.generate(
+                    pre_request(f"r{i}", prompts[i], 4), ctx):
+                assert frame.get("finish_reason") not in ("error",), frame
+                toks.extend(frame.get("token_ids", ()))
+            return i, toks
+
+        # phase A: kill the doomed worker inside its stalled transfer,
+        # AFTER chunks have durably committed
+        tasks = [asyncio.create_task(run_request(i)) for i in range(3)]
+        deadline = asyncio.get_event_loop().time() + 60
+        while not any(s.committed_pages >= 2
+                      for s in server._sessions.values()):
+            assert asyncio.get_event_loop().time() < deadline, \
+                "no chunk ever committed before the kill"
+            await asyncio.sleep(0.02)
+        await doomed.stop()
+        await survivor.start()
+        results = await asyncio.wait_for(asyncio.gather(*tasks), 180)
+        for i, toks in results:
+            assert toks == oracle[i], (i, toks, oracle[i])
+        assert plane.messaging.redeliveries >= 1, \
+            "the dead sender's lease never redelivered"
+
+        # phase B: seeded link cuts under load on the survivor
+        results = await asyncio.wait_for(
+            asyncio.gather(*(run_request(3 + i) for i in range(3))), 180)
+        for i, toks in results:
+            assert toks == oracle[i], (i, toks, oracle[i])
+
+        # phase C: decode-side transfer server restart on a new port
+        await server.stop()
+        server2 = await KvTransferServer(decode, "dec-0").start()
+        await server2.register(plane.kv)
+        assert server2.port != server.port
+        i, toks = await asyncio.wait_for(run_request(6), 180)
+        assert toks == oracle[i], (i, toks, oracle[i])
+        assert server2.received_pages >= 1   # re-resolved, not wedged
+
+        # phase D: unrecoverable link after 3 of 4 chunks committed —
+        # the decode side must salvage, never recompute from token zero
+        faults.REGISTRY.disarm("transfer.link")
+        faults.REGISTRY.arm("transfer.link", faults.FaultSchedule(
+            plan["transfer.link"]["seed"],
+            [faults.FaultSpec("fail_n", n=1000, skip=3)]))
+        surv_tx.link_retries = 1
+        i, toks = await asyncio.wait_for(run_request(7), 180)
+        assert toks == oracle[i], (i, toks, oracle[i])
+        faults.REGISTRY.disarm("transfer.link")
+        assert decode.salvaged_prefills >= 1, "phase D never salvaged"
+
+        # the storm-wide contracts
+        assert decode.majority_committed_full_reprefills == 0, \
+            "a majority-committed transfer was re-prefilled from zero"
+        summary = {
+            "remote_prefills": decode.remote_prefills,
+            "salvaged_prefills": decode.salvaged_prefills,
+            "full_reprefills": decode.full_reprefills,
+            "redeliveries": plane.messaging.redeliveries,
+            "resumes": XFER_STATS.resumes - r0,
+            "salvaged_pages": XFER_STATS.salvaged_pages - s0,
+        }
+        await survivor.stop()
+        await decode.stop()
+        await server2.stop()
+        return summary
+
+    try:
+        summary = asyncio.run(asyncio.wait_for(main(), 300))
+    finally:
+        faults.REGISTRY.disarm()
+    assert summary["resumes"] >= 1, summary
+    assert summary["salvaged_pages"] >= 1, summary
+    summary["faults"] = faults.REGISTRY.snapshot()
+    return summary
+
+
+def test_chaos_disagg_transfer_storm():
+    run_scenario("disagg_transfer_storm")
+
+
 # -- scenario: control-plane storm over the simulated fleet --------------------
 
 def run_control_plane_storm(plan):
@@ -541,6 +716,8 @@ def test_chaos_control_plane_storm():
 SCENARIOS = {
     "aggregated_zero_drop": (run_aggregated_zero_drop, AGGREGATED_PLAN),
     "disagg_prefill_death": (run_disagg_prefill_death, DISAGG_PLAN),
+    "disagg_transfer_storm": (run_disagg_transfer_storm,
+                              TRANSFER_STORM_PLAN),
     "rolling_restart": (run_rolling_restart, ROLLING_PLAN),
     "control_plane_storm": (run_control_plane_storm, CONTROL_PLANE_PLAN),
 }

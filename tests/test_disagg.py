@@ -390,6 +390,91 @@ def test_disagg_client_abort_cancels_remote_prefill():
     asyncio.run(asyncio.wait_for(main(), 120))
 
 
+def test_disagg_prefill_timeout_broadcasts_cancel():
+    """A remote prefill the decode side TIMES OUT on (not a client
+    disconnect) must also broadcast PrefillCancel: without it, the
+    abandoned prefill keeps burning an engine slot to completion even
+    though its transfer can only be rejected. The decode stream itself
+    falls back to a local prefill and still completes."""
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "oracle")
+
+    class HoldTransfer(LocalTransferBackend):
+        """Never completes: the decode-side prefill_timeout_s fires."""
+
+        async def send_pages(self, *a, **k):
+            await asyncio.Event().wait()
+
+    async def main():
+        plane = MemoryPlane()
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        router = DisaggregatedRouter(max_local_prefill_length=4,
+                                     max_prefill_queue_size=16)
+        decode = DisaggDecodeWorker(
+            make_engine(), plane.messaging, router, queue,
+            worker_id="dec-0", prefill_timeout_s=1.0)
+        prefill = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue, HoldTransfer(),
+            plane.messaging, dequeue_timeout_s=0.1, lease_s=30.0)
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, reason = [], None
+            async for frame in decode.generate(
+                    pre_request("rt", prompt).model_dump(exclude_none=True),
+                    Context("rt")):
+                toks.extend(frame.get("token_ids", ()))
+                if frame.get("finish_reason") not in (None, "prefill_done"):
+                    reason = frame["finish_reason"]
+            # timeout -> cancel broadcast -> local fallback, same tokens
+            assert reason == "length" and toks == expect
+            deadline = asyncio.get_event_loop().time() + 20
+            while prefill.cancelled < 1:
+                assert asyncio.get_event_loop().time() < deadline, \
+                    "timed-out prefill was never cancelled fleet-side"
+                await asyncio.sleep(0.02)
+            assert prefill.cancelled == 1
+            assert prefill.completed == 0
+            # the cancel settled the lease: nothing redelivers later
+            await asyncio.sleep(0.2)
+            assert await queue.depth() == 0
+        finally:
+            await prefill.stop()
+            await decode.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_prefill_queue_touch_extends_lease():
+    """queue.touch re-arms a leased item's redelivery deadline (the
+    transfer leg's in-progress ack); an expired token reports False."""
+    async def main():
+        plane = MemoryPlane()
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        req = RemotePrefillRequest(
+            engine_id="dec-0", request_id="q1", token_ids=[1, 2, 3],
+            page_ids=[0], page_size=8)
+        await queue.enqueue(req)
+        got = await queue.dequeue_leased(timeout=1.0, lease_s=0.3)
+        assert got is not None
+        _item, token = got
+        await asyncio.sleep(0.2)
+        assert await queue.touch(token, lease_s=0.6)  # re-armed
+        await asyncio.sleep(0.3)          # past the ORIGINAL deadline
+        assert await queue.depth() == 0   # not redelivered: touch held it
+        assert plane.messaging.redeliveries == 0
+        await asyncio.sleep(0.5)          # past the touched deadline too
+        assert await queue.depth() == 1   # un-acked: redelivered now
+        got2 = await queue.dequeue_leased(timeout=1.0, lease_s=5.0)
+        assert got2 is not None and got2[0].request_id == "q1"
+        # the first token is dead after redelivery: touch says so
+        assert not await queue.touch(token, lease_s=1.0)
+        await queue.ack(got2[1])
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
 def test_disagg_prefill_worker_death_mid_item_redelivers():
     """Satellite: a prefill worker that dies after dequeue but before
     completion must NOT lose the item — the lease expires and a surviving

@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R13), the
+- one positive AND one negative fixture per AST rule (R1-R14), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -847,6 +847,82 @@ def test_r13_live_on_current_tree():
         with open(path) as f:
             found = lint_source(f.read(), rel)
         assert not [x for x in found if x.rule == "R13"], rel
+
+
+# -- R14: unbounded raw stream IO on the wire ----------------------------------
+
+R14_SRC = """
+    import asyncio
+    from dynamo_tpu.runtime.transports.wire import read_frame, write_frame
+
+    async def retire_ack(reader, writer, frame):
+        write_frame(writer, frame)
+        await writer.drain()               # unbounded flush
+        return await read_frame(reader)    # unbounded ack read
+"""
+
+
+def test_r14_flags_unbounded_stream_io_in_scope():
+    found = lint_source(textwrap.dedent(R14_SRC),
+                        "dynamo_tpu/disagg/xfer_fixture.py")
+    assert len([x for x in found if x.rule == "R14"]) == 2  # drain + read
+    found = lint_source(textwrap.dedent(R14_SRC),
+                        "dynamo_tpu/runtime/transports/tcp_fixture.py")
+    assert "R14" in rules(found)
+
+
+def test_r14_quiet_outside_scope():
+    # the frontend's awaits are R7's territory; raw-IO scope is the
+    # disagg data plane and the transport implementations
+    found = lint_source(textwrap.dedent(R14_SRC),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert "R14" not in rules(found)
+
+
+def test_r14_quiet_on_bounded_and_annotated_io():
+    bounded = """
+        import asyncio
+        from dynamo_tpu.runtime.transports.wire import read_frame, write_frame
+
+        async def retire_ack(self, reader, writer, frame, deadline):
+            write_frame(writer, frame)
+            await asyncio.wait_for(writer.drain(), self._io_timeout(deadline))
+            return await read_frame(reader, timeout=self._io_timeout(deadline))
+    """
+    found = lint_source(textwrap.dedent(bounded),
+                        "dynamo_tpu/disagg/xfer_fixture.py")
+    assert "R14" not in rules(found)
+    annotated = """
+        from dynamo_tpu.runtime.transports.wire import read_frame
+
+        async def pump(self, reader):
+            while True:
+                # dynalint: unbounded-io-ok=idle-client-connections-are-
+                # legal; peer death surfaces as EOF
+                frame = await read_frame(reader)
+                self.dispatch(frame)
+    """
+    found = lint_source(textwrap.dedent(annotated),
+                        "dynamo_tpu/runtime/transports/srv_fixture.py")
+    assert "R14" not in rules(found)
+
+
+def test_r14_live_on_data_and_control_wire():
+    """Every raw stream read/write in disagg/ and runtime/transports/
+    is bounded (timeout kwarg, wait_for) or carries a justified
+    unbounded-io-ok annotation — the tentpole's per-IO timeout
+    discipline, held by machine."""
+    import glob
+    scoped = []
+    for pat in ("dynamo_tpu/disagg/*.py",
+                "dynamo_tpu/runtime/transports/*.py"):
+        scoped.extend(glob.glob(os.path.join(REPO, pat)))
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R14"], rel
 
 
 # -- jaxpr invariants ----------------------------------------------------------

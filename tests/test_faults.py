@@ -80,6 +80,35 @@ def test_fail_n_fails_exactly_first_n():
     assert [o.drop for o in outs] == [True] * 3 + [False] * 7
 
 
+def test_skip_pins_fault_to_a_hit_index():
+    """skip=k leaves the rule dormant for the first k hits: a fail_n
+    with skip=2, n=1 cuts EXACTLY the third hit — how the transfer
+    resume matrix seeds a link cut at a chosen chunk index."""
+    sched = FaultSchedule(0, [FaultSpec("fail_n", n=1, skip=2)])
+    outs = drain(sched, 8)
+    assert [o.drop for o in outs] == [False, False, True] + [False] * 5
+    # skip still consumes the per-hit draw: a trailing spec's decisions
+    # are unchanged by the leading spec's dormancy
+    paired = FaultSchedule(3, [FaultSpec("fail_n", n=1, skip=2),
+                               FaultSpec("drop", p=0.5)])
+    inert = FaultSchedule(3, [FaultSpec("drop", p=0.0),
+                              FaultSpec("drop", p=0.5)])
+    a, b = drain(paired, 16), drain(inert, 16)
+    assert [x.drop for x in a[3:]] == [x.drop for x in b[3:]]
+
+
+def test_delay_min_floors_the_seeded_draw():
+    """delay_min_s == delay_s is a DETERMINISTIC stall of exactly that
+    length (how a chaos plan wedges a sender so a worker kill lands
+    mid-transfer); a plain delay stays in [0, delay_s]."""
+    sched = FaultSchedule(1, [FaultSpec("delay", p=1.0, delay_s=2.5,
+                                        delay_min_s=2.5)])
+    assert [o.delay_s for o in drain(sched, 4)] == [2.5] * 4
+    lo = FaultSchedule(1, [FaultSpec("delay", p=1.0, delay_s=2.0,
+                                     delay_min_s=1.0)])
+    assert all(1.0 <= o.delay_s <= 2.0 for o in drain(lo, 16))
+
+
 def test_bounded_corrupt_fires_at_most_n_times():
     sched = FaultSchedule(5, [FaultSpec("corrupt", p=1.0, n=2)])
     outs = drain(sched, 20)
@@ -252,6 +281,26 @@ def test_site_remote_transfer_corruption_refetches_then_succeeds():
     np.testing.assert_array_equal(v_np, np.asarray(v))
     assert INTEGRITY.refetches == 1 and INTEGRITY.mismatches >= 1
     assert INTEGRITY.quarantined == 0
+
+
+def test_site_transfer_link_cut_reaches_sender_gate():
+    """Wiring smoke: the transfer.link site fires on the sender's
+    per-chunk gate as a ConnectionError (FaultInjected), which is what
+    routes it into the resume path rather than a crash."""
+    from dynamo_tpu.disagg.remote_transfer import RemoteTransferBackend
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    backend = RemoteTransferBackend(MemoryPlane().kv)
+    arm("transfer.link", FaultSpec("fail_n", n=1, skip=1))
+
+    async def main():
+        await backend._chunk_gate(0)          # hit 1: dormant (skip)
+        with pytest.raises(ConnectionError):  # hit 2: the seeded cut
+            await backend._chunk_gate(1)
+        await backend._chunk_gate(2)          # budget spent: link healthy
+
+    asyncio.run(main())
+    assert REGISTRY.snapshot()["injected"]["transfer.link"] == 1
 
 
 def test_site_discovery_heartbeat_drop_skips_lease_refresh():

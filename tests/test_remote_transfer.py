@@ -266,6 +266,12 @@ def test_transfer_pipelining_overlaps_chunks():
                     except (asyncio.IncompleteReadError,
                             ConnectionResetError):
                         return
+                    if frame.get("op") == "resume":
+                        # the committed-frontier handshake every stream
+                        # opens with; a fresh transfer resumes from 0
+                        write_frame(writer, {"ok": True, "committed": 0})
+                        await writer.drain()
+                        continue
                     received.append(len(frame["page_ids"]))
                     pending += 1
                     if len(received) >= 2:
@@ -307,6 +313,320 @@ def test_remote_transfer_metadata_missing():
         z = np.zeros((2, 2, 1, 8, 32), np.float32)
         with pytest.raises(KeyError, match="no kv-transfer metadata"):
             await transfer.send_pages("ghost", "r", [0], z, z)
+
+    asyncio.run(main())
+
+
+# -- chunk-committed streaming: the resume matrix ------------------------------
+# (docs/RESILIENCE.md "Data-plane transfer failure model")
+
+from dynamo_tpu.disagg.remote_transfer import (  # noqa: E402
+    TransferBudgetExceeded,
+)
+from dynamo_tpu.runtime import faults  # noqa: E402
+from dynamo_tpu.runtime.faults import FaultSchedule, FaultSpec  # noqa: E402
+from dynamo_tpu.runtime.integrity import XFER_STATS  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.REGISTRY.disarm()
+    faults.REGISTRY.reset_counters()
+
+
+@pytest.mark.parametrize("cut_chunk", [0, 1, 2])
+def test_transfer_link_cut_resumes_token_identical(cut_chunk):
+    """Seeded link cut at the first/middle/last chunk: the sender
+    reconnects, learns the committed frontier, and resumes — the decode
+    side injects every page exactly once and the stream is
+    token-identical to the aggregated oracle."""
+    prompt = list(range(100, 120))  # 3 pages @ page_size 8 -> 3 chunks
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+    # stop-and-wait window: every chunk before the cut is fully acked,
+    # so the frontier at the cut is exactly cut_chunk — deterministic
+    faults.REGISTRY.arm("transfer.link", FaultSchedule(
+        0, [FaultSpec("fail_n", n=1, skip=cut_chunk)]))
+    r0 = XFER_STATS.resumes
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, server, transfer = await _build_remote_stack(
+            plane, chunk_pages=1)
+        transfer.window_chunks = 1
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, reason = await asyncio.wait_for(_drive(
+                decode.generate(pre_request("rl", prompt).model_dump(
+                    exclude_none=True), Context("rl"))), 60)
+        finally:
+            await prefill.stop()
+            await decode.stop()
+            await transfer.close()
+            await server.stop()
+        return toks, reason, server.received_pages, transfer.sent_pages
+
+    toks, reason, rx, tx = asyncio.run(main())
+    assert reason == "length" and toks == expect
+    assert rx == tx == 3   # every page injected exactly once, all acked
+    if cut_chunk > 0:
+        # the retry continued a part-committed transfer (a chunk-level
+        # resume); a cut before anything committed restarts from zero
+        # and is not a resume
+        assert XFER_STATS.resumes - r0 == 1
+    assert faults.REGISTRY.snapshot()["injected"]["transfer.link"] == 1
+
+
+def test_sender_death_mid_stream_resumes_from_acked_frontier():
+    """The prefill worker dies mid-transfer with chunks already acked:
+    the re-leased queue item's REPLACEMENT sender opens with the
+    frontier handshake and ships only the unacked tail — no page
+    crosses the wire twice, and the stream never notices."""
+    prompt = list(range(50, 90))   # 40 tokens -> 5 pages
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+    r0 = XFER_STATS.resumes
+
+    class StallAfter(RemoteTransferBackend):
+        """Wedges forever at chunk `stall_after`: the worker driving it
+        dies holding a part-committed transfer."""
+
+        async def _chunk_gate(self, chunk_idx):
+            if chunk_idx >= 2:
+                await asyncio.Event().wait()
+            await super()._chunk_gate(chunk_idx)
+
+    async def main():
+        plane = MemoryPlane()
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        router = DisaggregatedRouter(max_local_prefill_length=4,
+                                     max_prefill_queue_size=8, model="tiny")
+        decode = DisaggDecodeWorker(
+            make_engine(), plane.messaging, router, queue,
+            worker_id="dec-0", prefill_timeout_s=60.0)
+        server = await KvTransferServer(decode, "dec-0").start()
+        await server.register(plane.kv)
+        doomed = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue,
+            StallAfter(plane.kv, chunk_pages=1, window_chunks=1),
+            plane.messaging, dequeue_timeout_s=0.1, lease_s=0.5)
+        surv_tx = RemoteTransferBackend(plane.kv, chunk_pages=1,
+                                        window_chunks=1)
+        survivor = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue, surv_tx,
+            plane.messaging, dequeue_timeout_s=0.1, lease_s=10.0)
+        await decode.start()
+        await doomed.start()
+        task = asyncio.create_task(_drive(
+            decode.generate(pre_request("rd", prompt).model_dump(
+                exclude_none=True), Context("rd"))))
+        # wait for two durably committed chunks, then kill the sender
+        deadline = asyncio.get_event_loop().time() + 30
+        while not any(s.committed_pages >= 2
+                      for s in server._sessions.values()):
+            assert asyncio.get_event_loop().time() < deadline, \
+                "no chunk ever committed"
+            await asyncio.sleep(0.02)
+        await doomed.stop()
+        await survivor.start()
+        toks, reason = await asyncio.wait_for(task, 120)
+        redelivered = plane.messaging.redeliveries
+        sent_by_survivor = surv_tx.sent_pages
+        await survivor.stop()
+        await decode.stop()
+        await server.stop()
+        return toks, reason, redelivered, sent_by_survivor
+
+    toks, reason, redelivered, sent_by_survivor = asyncio.run(main())
+    assert reason == "length" and toks == expect
+    assert redelivered >= 1, "the dead sender's lease never redelivered"
+    # the replacement resumed from the acked frontier: only the tail
+    # crossed the wire again (5 pages total, 2 committed by the corpse)
+    assert sent_by_survivor == 3
+    assert XFER_STATS.resumes - r0 >= 1
+
+
+def test_unrecoverable_sender_salvages_committed_prefix():
+    """Link permanently dead after 3 of 5 chunks committed, resume
+    budget exhausted: the decode worker SALVAGES — it keeps the
+    committed pages and re-prefills locally only past the committed
+    page boundary — and the stream is still token-identical."""
+    prompt = list(range(50, 90))   # 5 pages; chunks 0-2 will commit
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+    faults.REGISTRY.arm("transfer.link", FaultSchedule(
+        0, [FaultSpec("fail_n", n=1000, skip=3)]))
+    s0, r0 = XFER_STATS.salvaged_pages, XFER_STATS.resumes
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, server, transfer = await _build_remote_stack(
+            plane, chunk_pages=1)
+        transfer.window_chunks = 1
+        transfer.link_retries = 1
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, reason = await asyncio.wait_for(_drive(
+                decode.generate(pre_request("rs", prompt).model_dump(
+                    exclude_none=True), Context("rs"))), 120)
+        finally:
+            await prefill.stop()
+            await decode.stop()
+            await transfer.close()
+            await server.stop()
+        return (toks, reason, decode.salvaged_prefills,
+                decode.full_reprefills,
+                decode.majority_committed_full_reprefills)
+
+    toks, reason, salvaged, full, majority_full = asyncio.run(main())
+    assert reason == "length" and toks == expect
+    assert salvaged == 1 and full == 0
+    assert majority_full == 0
+    # salvage charged exactly the committed pages — the local re-prefill
+    # paid only for the 2 uncommitted ones
+    assert XFER_STATS.salvaged_pages - s0 == 3
+    assert XFER_STATS.resumes - r0 >= 1  # it did try to resume first
+
+
+def test_stale_epoch_chunk_rejected_after_realloc():
+    """Same request id, released and re-allocated (new epoch): a sender
+    still holding the OLD allocation's epoch is fenced — its chunks
+    never reach the cache — while the current-epoch sender streams
+    normally."""
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+
+    async def main():
+        plane = MemoryPlane()
+        decode = NativeEngineWorker(make_engine())
+        await decode.start()
+        server = await KvTransferServer(decode, "dec-0").start()
+        await server.register(plane.kv)
+        transfer = RemoteTransferBackend(plane.kv, chunk_pages=1)
+        prefill_eng = make_engine()
+        s0 = XFER_STATS.stale_chunks
+        try:
+            alloc1 = await decode.submit(
+                lambda eng: eng.allocate_remote(
+                    EngineRequest("race", prompt, params)))
+            prefill_eng.add_request(
+                EngineRequest("race", prompt, params, prefill_only=True))
+            while prefill_eng.has_work():
+                prefill_eng.step()
+            pages = prefill_eng.extract_pages(
+                prefill_eng.scheduler.parked["race"].pages)
+            # release + re-allocate the SAME id: new epoch, new pages
+            await decode.submit(lambda eng: eng.release_remote("race"))
+            alloc2 = await decode.submit(
+                lambda eng: eng.allocate_remote(
+                    EngineRequest("race", prompt, params)))
+            assert alloc2.alloc_epoch > alloc1.alloc_epoch > 0
+            with pytest.raises(RuntimeError, match="[Ss]tale"):
+                await transfer.send_pages(
+                    "dec-0", "race", alloc1.page_ids,
+                    pages["k"], pages["v"],
+                    alloc_epoch=alloc1.alloc_epoch)
+            assert XFER_STATS.stale_chunks - s0 >= 1
+            assert server.received_pages == 0   # nothing landed
+            # the live allocation's sender is untouched by the fence
+            await transfer.send_pages(
+                "dec-0", "race", alloc2.page_ids,
+                pages["k"], pages["v"], alloc_epoch=alloc2.alloc_epoch)
+            assert server.received_pages == len(alloc2.page_ids)
+        finally:
+            await transfer.close()
+            await server.stop()
+            await decode.stop()
+
+    asyncio.run(main())
+
+
+def test_decode_restart_on_new_port_reresolves_endpoint():
+    """The decode worker's transfer server restarts on a NEW port: the
+    sender's pooled connection and cached endpoint are invalidated on
+    the send failure and re-resolved from discovery — the next transfer
+    lands on the new listener without a process restart."""
+    prompt = list(range(100, 120))
+    # a disjoint second prompt: a shared prefix would hit the decode
+    # engine's cache after r1 and keep r2 local (no transfer to observe)
+    prompt2 = list(range(130, 150))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    oracle = make_engine()
+    expect = oracle.generate(prompt, params, "direct")
+    expect2 = oracle.generate(prompt2, params, "direct2")
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, server, transfer = await _build_remote_stack(plane)
+        await decode.start()
+        await prefill.start()
+        server2 = None
+        try:
+            toks1, _ = await asyncio.wait_for(_drive(
+                decode.generate(pre_request("r1", prompt).model_dump(
+                    exclude_none=True), Context("r1"))), 60)
+            old_port = server.port
+            # the restart: the old listener AND its established
+            # connections die (a process restart resets both), the new
+            # one registers under the same engine_id on a fresh port
+            await server.stop()
+            server2 = await KvTransferServer(decode, "dec-0").start()
+            await server2.register(plane.kv)
+            assert server2.port != old_port
+            toks2, _ = await asyncio.wait_for(_drive(
+                decode.generate(pre_request("r2", prompt2).model_dump(
+                    exclude_none=True), Context("r2"))), 60)
+            return (toks1, toks2, server2.received_pages,
+                    transfer._meta["dec-0"]["port"], server2.port)
+        finally:
+            await prefill.stop()
+            await decode.stop()
+            await transfer.close()
+            if server2 is not None:
+                await server2.stop()
+
+    toks1, toks2, rx2, cached_port, new_port = asyncio.run(main())
+    assert toks1 == expect and toks2 == expect2
+    assert rx2 == 3                 # the new listener took the transfer
+    assert cached_port == new_port  # endpoint re-resolved, not stale
+
+
+def test_transfer_budget_exhausted_fails_fast():
+    """A transfer whose request-deadline sub-budget is already spent
+    must fail immediately — never block a prefill slot streaming to a
+    client that has given up."""
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+
+    async def main():
+        plane = MemoryPlane()
+        decode = NativeEngineWorker(make_engine())
+        await decode.start()
+        server = await KvTransferServer(decode, "dec-0").start()
+        await server.register(plane.kv)
+        transfer = RemoteTransferBackend(plane.kv, chunk_pages=1)
+        prefill_eng = make_engine()
+        try:
+            alloc = await decode.submit(
+                lambda eng: eng.allocate_remote(
+                    EngineRequest("rb", prompt, params)))
+            prefill_eng.add_request(
+                EngineRequest("rb", prompt, params, prefill_only=True))
+            while prefill_eng.has_work():
+                prefill_eng.step()
+            pages = prefill_eng.extract_pages(
+                prefill_eng.scheduler.parked["rb"].pages)
+            with pytest.raises(TransferBudgetExceeded):
+                await asyncio.wait_for(transfer.send_pages(
+                    "dec-0", "rb", alloc.page_ids, pages["k"], pages["v"],
+                    budget_s=0.0), 10)
+        finally:
+            await transfer.close()
+            await server.stop()
+            await decode.stop()
 
     asyncio.run(main())
 

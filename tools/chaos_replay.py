@@ -38,6 +38,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCENARIO_NAMES = (
     "aggregated_zero_drop",
     "disagg_prefill_death",
+    "disagg_transfer_storm",
     "rolling_restart",
     "control_plane_storm",
 )

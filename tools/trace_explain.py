@@ -148,6 +148,18 @@ def explain(spans: List[dict], trace_id: str) -> str:
         out.append(f"  kv transfer: {total_bytes} bytes / {total_pages} "
                    f"page(s) in {len(xfers)} leg(s), "
                    f"{sum(s['dur'] for s in xfers) * 1e3:.2f} ms")
+    resumes = named("kv.transfer.resume")
+    if resumes:
+        pages = sum((s.get("attrs") or {}).get("committed_pages", 0)
+                    for s in resumes)
+        out.append(f"  kv transfer resumes: {len(resumes)} (continued "
+                   f"past {pages} already-committed page(s))")
+    salvages = named("kv.salvage")
+    for s in salvages:
+        a = s.get("attrs") or {}
+        out.append(f"  kv salvage: kept {a.get('pages', '?')} committed "
+                   f"page(s) ({a.get('tokens', '?')} tokens charged as "
+                   "cached); only the tail re-prefilled locally")
     emits = sorted(named("decode.emit"), key=lambda s: s["ts"])
     if len(emits) >= 2:
         gaps = [(b["ts"] - a["ts"]) * 1e3
