@@ -65,6 +65,13 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       stops reading wedges exactly these awaits (the pre-fix
       RemoteTransferBackend ack read is the type specimen: a decode
       worker restart left the sender blocked forever on a dead socket)
+- R15 metric registration contract (dynamo_tpu/ package): every
+      `registry.counter/gauge/histogram(name, help, ...)` must carry
+      non-empty help text AND its family must appear in the
+      docs/OBSERVABILITY.md metric catalog (f-string names resolve by
+      literal fragments); an undocumented family is invisible to the
+      runbooks and exempt from the catalog completeness test — escape
+      hatch `# dynalint: metric-doc-ok=<reason>`
 """
 from __future__ import annotations
 
@@ -1040,6 +1047,132 @@ def r14_unbounded_stream_io(tree: ast.AST, lines: List[str],
             "`# dynalint: unbounded-io-ok=<why an unbounded wait is "
             "correct here>` (e.g. an idle server-side pump whose peer "
             "death surfaces as EOF)"))
+    return out
+
+
+# -- R15: metric registrations need help text + a docs-catalog entry ----------
+
+# Scope: the dynamo_tpu package (not tools/tests — ad-hoc analysis
+# histograms there aren't operator-facing). A `registry.counter/gauge/
+# histogram(name, help, ...)` registration is the operator contract for
+# a metric family: HELP renders on every /metrics scrape, and
+# docs/OBSERVABILITY.md's metric catalog is what the completeness test
+# (tests/test_metrics_catalog.py) checks rendered output against — an
+# undocumented family is invisible to the runbooks, a doc-only family
+# is a silent plumbing regression waiting to happen. The rule resolves
+# f-string names by their literal fragments (a dict-comprehension over
+# `f"llm_cp_{name}"` passes if ANY catalog family matches the
+# fragments in order); a name with no literal fragments is statically
+# unresolvable and skipped. Escape: `# dynalint: metric-doc-ok=<reason>`
+# within two lines above.
+_R15_METHODS = {"counter", "gauge", "histogram"}
+_R15_ANNOT_RE = re.compile(r"#\s*dynalint:\s*metric-doc-ok=\S+")
+_R15_FAMILY_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
+_R15_CATALOG: Optional[frozenset] = None
+
+
+def _metric_catalog() -> Optional[frozenset]:
+    """Backticked llm_* family names in docs/OBSERVABILITY.md's metric
+    catalog section; None when the doc is unreadable (rule degrades to
+    help-text-only rather than flagging everything)."""
+    global _R15_CATALOG
+    if _R15_CATALOG is not None:
+        return _R15_CATALOG
+    import os
+    doc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))),
+        "docs", "OBSERVABILITY.md")
+    try:
+        with open(doc) as f:
+            text = f.read()
+    except OSError:
+        return None
+    # the catalog section only: from its header to the next "## "
+    m = re.search(r"^##[^\n]*metric catalog.*?$", text,
+                  re.I | re.M)
+    if m is None:
+        return None
+    tail = text[m.end():]
+    nxt = re.search(r"^## ", tail, re.M)
+    section = tail[:nxt.start()] if nxt else tail
+    _R15_CATALOG = frozenset(
+        name for name in _R15_FAMILY_RE.findall(section)
+        if name.startswith("llm_"))
+    return _R15_CATALOG
+
+
+def _r15_name_fragments(node: ast.expr) -> Optional[List[str]]:
+    """Literal fragments of a metric-name expression, in order; None
+    when the expression carries no resolvable literal text."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value] if node.value else None
+    if isinstance(node, ast.JoinedStr):
+        frags = [v.value for v in node.values
+                 if isinstance(v, ast.Constant)
+                 and isinstance(v.value, str) and v.value]
+        return frags or None
+    return None
+
+
+@rule("R15")
+def r15_metric_registration_contract(tree: ast.AST, lines: List[str],
+                                     path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if "dynamo_tpu/" not in norm:
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R15_ANNOT_RE.search(_line(lines, x))
+                   for x in range(ln - 2, ln + 1))
+
+    catalog = _metric_catalog()
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute) or \
+                node.func.attr not in _R15_METHODS:
+            continue
+        if not node.args:
+            continue
+        frags = _r15_name_fragments(node.args[0])
+        if frags is None and not isinstance(
+                node.args[0], (ast.Constant, ast.JoinedStr)):
+            continue    # non-literal name: not a registration we can see
+        if annotated(node.lineno):
+            continue
+        label = "".join(frags) if frags else "<dynamic>"
+        # (a) non-empty help text
+        help_arg = node.args[1] if len(node.args) > 1 else next(
+            (kw.value for kw in node.keywords if kw.arg == "help_"), None)
+        helpless = help_arg is None or (
+            isinstance(help_arg, ast.Constant)
+            and isinstance(help_arg.value, str)
+            and not help_arg.value.strip())
+        if helpless:
+            out.append(_finding(
+                "R15", path, lines, node,
+                f"metric registration {label!r} has no help text — "
+                "HELP renders empty on every /metrics scrape and the "
+                "operator reading a storm has nothing to go on",
+                "pass a non-empty help string (second argument)"))
+        # (b) family documented in the docs/OBSERVABILITY.md catalog
+        if catalog is None or frags is None:
+            continue
+        pattern = ".*".join(re.escape(f) for f in frags)
+        if not (frags[0].startswith("llm_") or pattern.startswith("llm")):
+            pattern = ".*" + pattern
+        rx = re.compile(pattern + ".*")
+        if not any(rx.fullmatch(fam) for fam in catalog):
+            out.append(_finding(
+                "R15", path, lines, node,
+                f"metric family {label!r} is not in the "
+                "docs/OBSERVABILITY.md metric catalog — undocumented "
+                "families are invisible to the runbooks and exempt from "
+                "the catalog completeness test (silent plumbing "
+                "regressions)",
+                "add the family to the catalog table in "
+                "docs/OBSERVABILITY.md (with its surface), or annotate "
+                "with `# dynalint: metric-doc-ok=<reason>`"))
     return out
 
 

@@ -532,6 +532,7 @@ class RemoteTransferBackend(TransferBackend):
                                  request_id=request_id, pages=n,
                                  backend="remote", engine_id=engine_id)
         failed = True
+        bytes_before = XFER_STATS.bytes_sent
         try:
             await self._send_pages_locked(engine_id, request_id, ids,
                                           k_pages, v_pages, k_scale,
@@ -540,7 +541,16 @@ class RemoteTransferBackend(TransferBackend):
             failed = False
         finally:
             TRACER.end_span(span, error=failed)
-            SERVING.kv_transfer.observe(value=time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            SERVING.kv_transfer.observe(value=dt)
+            if not failed:
+                # per-link delivered-goodput sample (bytes actually
+                # shipped this send, incl. resume/refetch overhead in
+                # the denominator) — the TransferCostModel bandwidth
+                # EWMA the transfer-aware router scoring consumes
+                from dynamo_tpu.observability.fleet import TRANSFER_MODEL
+                TRANSFER_MODEL.observe(
+                    engine_id, XFER_STATS.bytes_sent - bytes_before, dt)
 
     async def _send_pages_locked(self, engine_id: str, request_id: str, ids,
                                  k_pages, v_pages, k_scale, v_scale,

@@ -112,6 +112,7 @@ class LocalTransferBackend(TransferBackend):
                                  request_id=request_id, pages=len(ids),
                                  backend="local")
         failed = True
+        bytes_before = XFER_STATS.bytes_sent
         try:
             await self._send_pages_inner(engine_id, request_id, ids,
                                          k_pages, v_pages, k_scale,
@@ -119,7 +120,15 @@ class LocalTransferBackend(TransferBackend):
             failed = False
         finally:
             TRACER.end_span(span, error=failed)
-            SERVING.kv_transfer.observe(value=time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            SERVING.kv_transfer.observe(value=dt)
+            if not failed:
+                # per-link bandwidth sample for the TransferCostModel
+                # (observability/fleet.py) — same feed as the remote
+                # backend, so router scoring sees local moves too
+                from dynamo_tpu.observability.fleet import TRANSFER_MODEL
+                TRANSFER_MODEL.observe(
+                    engine_id, XFER_STATS.bytes_sent - bytes_before, dt)
 
     async def _send_pages_inner(self, engine_id: str, request_id: str, ids,
                                 k_pages, v_pages, k_scale, v_scale,

@@ -203,6 +203,23 @@ class NativeEngine:
         # harness mode only, it defeats the pipeline's overlap
         from dynamo_tpu.observability.metrics import PhaseTimer
         self.phases = PhaseTimer()
+        # per-step resource ledger (observability/ledger.py): bounded
+        # ring of step samples recorded at the commit sites below — the
+        # deferred-recorder discipline (host ints only, never a jax
+        # array), branch-only when DYN_LEDGER=0; drains as JSONL, folds
+        # into the llm_engine_* gauges
+        from dynamo_tpu.observability.ledger import (
+            StepLedger, model_flops_per_token,
+        )
+        self.ledger = StepLedger(
+            flops_per_token=model_flops_per_token(model_cfg))
+        # (program, bucket) keys already dispatched: a key's first
+        # dispatch is an XLA compile that stalls the serving loop —
+        # counted as a recompile event on the ledger sample that commits
+        # after it (observability: steady-state serving should hold this
+        # flat once the bucket ladder is warm)
+        self._seen_programs: set = set()
+        self._pending_recompiles = 0
         # decode pipeline legs double as trace spans under the "engine"
         # scope (runtime/tracing.py defer_phase — the hot-path deferred
         # recorder; branch-only when tracing is disabled)
@@ -639,6 +656,36 @@ class NativeEngine:
                    self.scheduler.params[seq.request_id].logprobs is not None
                    for seq in reqs)
 
+    def _note_program(self, key: tuple) -> None:
+        """Recompile detection at the _step_fns/_decode_fns dispatch
+        sites: the first dispatch of a (program, bucket-shape) key is an
+        XLA compile. Pending events attach to the next ledger sample."""
+        if key not in self._seen_programs:
+            self._seen_programs.add(key)
+            self._pending_recompiles += 1
+
+    def _ledger_record(self, kind: str, rows: int, rows_live: int,
+                       useful: int, padded: int) -> None:
+        """One ledger sample at a commit site. Host-state reads only
+        (allocator counters, pool free lists, deque length) — the
+        deferred-recorder discipline the ledger's overhead contract and
+        the decode hot-path region both require."""
+        if not self.ledger.enabled:
+            return
+        alloc = self.scheduler.allocator
+        hp = self.host_pool
+        host_used = hp.used if hp is not None else 0
+        host_total = hp.capacity if hp is not None else 0
+        disk = hp.disk if hp is not None else None
+        disk_used = disk.used if disk is not None else 0
+        disk_total = disk.capacity if disk is not None else 0
+        rc, self._pending_recompiles = self._pending_recompiles, 0
+        self.ledger.record_step(
+            kind, rows, rows_live, useful, padded,
+            alloc.num_pages - alloc.num_free, alloc.num_pages,
+            host_used, host_total, disk_used, disk_total,
+            len(self.scheduler.waiting), rc)
+
     def _run_device_step(self, plan, reqs, mixed: bool = False):
         temp, top_k, top_p, seeds, counters, min_toks = \
             self._sampling_arrays(reqs, mixed=mixed)
@@ -659,6 +706,9 @@ class NativeEngine:
         if mm:
             kwargs.update(mm_embeds=jnp.asarray(plan.mm_embeds),
                           mm_mask=jnp.asarray(plan.mm_mask))
+        self._note_program(("step", rp is not None, with_lp, mm,
+                            plan.tokens.shape, plan.page_table.shape[1],
+                            None if rp is None else rp[0].shape[1]))
         out = self._step_fns[(rp is not None, with_lp, mm)](*args, **kwargs)
         tokens, lp, top_ids, top_lps, self.cache, aux = out
         tokens, lp, top_ids, top_lps, aux = jax.device_get(
@@ -693,6 +743,10 @@ class NativeEngine:
                     seq, tok, float(lps[0][i]), lps[1][i], lps[2][i]))
             else:
                 events.append(self._postprocess(seq, tok))
+        self._ledger_record(
+            "prefill", len(plan.seqs),
+            sum(1 for s in plan.seqs if s is not None),
+            sum(plan.n_valid), int(plan.tokens.size))
         return events
 
     def _run_mixed(self, plan: MixedPlan) -> List[StepOutput]:
@@ -747,6 +801,10 @@ class NativeEngine:
         # device-resident window carry (token/position/counter) is stale
         self._dec_state = None
         self.mixed_steps += 1
+        self._ledger_record(
+            "mixed", len(plan.seqs),
+            sum(1 for s in plan.seqs if s is not None),
+            sum(plan.n_valid), int(plan.tokens.size))
         return events
 
     def _run_decode(self, plan: DecodePlan) -> List[StepOutput]:
@@ -851,6 +909,11 @@ class NativeEngine:
                          jnp.asarray(counters))
             self.decode_plan_uploads += 1
         nw = self._window_rung(plan)
+        # recompile detection (ledger): the decode-window program is
+        # keyed by its variant grid entry plus every bucketed dim
+        self._note_program(("window", rp is not None, with_lp, greedy, nw,
+                            len(plan.seqs), plan.page_table.shape[1],
+                            base_pb, plan.stop_ids.shape[1]))
         pregather = llama._decode_kernel_mode(self.model_cfg) is None
         return {"sig": sig, "dev": dev, "first": first, "nw": nw,
                 "key": (rp is not None, with_lp, greedy, nw),
@@ -891,6 +954,9 @@ class NativeEngine:
                          jnp.asarray(counters))
             self.decode_plan_uploads += 1
         nw = self._window_rung(plan)
+        self._note_program(("ppwindow", greedy, nw, len(plan.seqs),
+                            plan.page_table.shape[1],
+                            plan.stop_ids.shape[1]))
         return {"sig": sig, "dev": dev, "first": first, "nw": nw,
                 "key": (nw, greedy), "base_cap": None, "pp": True}
 
@@ -1245,6 +1311,8 @@ class NativeEngine:
             for j in range(n):
                 write_idx[i, j] = seq.flat_index(pos0 + j, ps)
             kv_lens[i] = pos0 + n
+        self._note_program(("verify", tokens.shape,
+                            plan.page_table.shape[1]))
         pred, self.cache, aux = self._verify_fn(
             self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(positions), jnp.asarray(plan.page_table),
@@ -1288,6 +1356,12 @@ class NativeEngine:
                 # request id's coverage (code-review r5)
                 self._draft.committed(seq, m, emitted)
         self.spec_steps += 1
+        # ledger: the verify block charges [S, k+1] bucket tokens; the
+        # accepted drafts + the model's own token are the useful part
+        self._ledger_record(
+            "spec", s_count,
+            sum(1 for s in plan.seqs if s is not None),
+            len(events), s_count * kp1)
         return events
 
     def _commit_window(self, plan: DecodePlan, toks: np.ndarray, lps=None,
@@ -1334,6 +1408,11 @@ class NativeEngine:
         self.window_slot_steps += n_steps * n_live
         self.window_wasted_steps += sum(n_steps - 1 - s
                                         for s in finish_step.values())
+        # ledger sample for the committed window: the bucket charge is
+        # every (step, slot) pair of the window; useful = tokens that
+        # actually committed (post-finish tail + padding rows = waste)
+        self._ledger_record("decode", len(plan.seqs), n_live,
+                            len(events), n_steps * len(plan.seqs))
         return events
 
     def _run_decode_pp(self, plan: DecodePlan) -> List[StepOutput]:
@@ -1369,6 +1448,8 @@ class NativeEngine:
                     lps[2][i]))
             else:
                 events.append(self._postprocess(seq, seq.output[-1]))
+        self._ledger_record("decode", len(plan.seqs), len(events),
+                            len(events), len(plan.seqs))
         return events
 
     def _postprocess(self, seq: SequenceState, tok: int,
@@ -1591,6 +1672,21 @@ class NativeEngine:
         m.kv_transfer_salvaged_pages = XFER_STATS.salvaged_pages
         m.kv_transfer_stale_chunks = XFER_STATS.stale_chunks
         m.kv_transfer_link_timeouts = XFER_STATS.link_timeouts
+        # per-step ledger figures (observability/ledger.py), per-engine:
+        # steps/recompiles/padding waste are this instance's cumulative
+        # counters; tok_s is the EWMA instantaneous committed rate; the
+        # offload tier occupancy mirrors the ledger's per-tier sample
+        m.engine_steps = self.ledger.steps
+        m.engine_recompiles = self.ledger.recompiles_total
+        m.engine_tok_s = round(self.ledger.tok_s, 3)
+        m.engine_mfu = round(self.ledger.mfu, 6)
+        m.engine_pad_frac = round(self.ledger.pad_fraction(), 4)
+        if self.host_pool is not None:
+            m.kv_host_pages_used = self.host_pool.used
+            m.kv_host_pages_total = self.host_pool.capacity
+            if self.host_pool.disk is not None:
+                m.kv_disk_pages_used = self.host_pool.disk.used
+                m.kv_disk_pages_total = self.host_pool.disk.capacity
         return m
 
     def moe_drop_rate(self) -> float:

@@ -91,6 +91,11 @@ class DiskKvPool:
     def __contains__(self, seq_hash: int) -> bool:
         return seq_hash in self._by_hash
 
+    @property
+    def used(self) -> int:
+        """Occupied disk-tier pages (ledger/fleet tier occupancy)."""
+        return self.capacity - len(self._free)
+
     def put(self, seq_hash: int, k_page: np.ndarray, v_page: np.ndarray,
             sum_: Optional[int] = None, k_scale=None, v_scale=None) -> bool:
         """Store (LRU-evicting); returns True when an existing entry was

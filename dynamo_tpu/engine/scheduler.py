@@ -233,6 +233,21 @@ class EngineMetrics:
     kv_transfer_salvaged_pages: int = 0
     kv_transfer_stale_chunks: int = 0
     kv_transfer_link_timeouts: int = 0
+    # per-step resource ledger (observability/ledger.py): committed
+    # device steps, recompile events (first dispatch of a new
+    # (program, bucket) key), EWMA instantaneous useful tok/s, MFU
+    # estimate (0 without a configured peak), cumulative bucket-ladder
+    # padding-waste fraction, and offload tier occupancy — the
+    # per-worker signals observability/fleet.py's rollup consumes
+    engine_steps: int = 0
+    engine_recompiles: int = 0
+    engine_tok_s: float = 0.0
+    engine_mfu: float = 0.0
+    engine_pad_frac: float = 0.0
+    kv_host_pages_used: int = 0
+    kv_host_pages_total: int = 0
+    kv_disk_pages_used: int = 0
+    kv_disk_pages_total: int = 0
 
 
 def window_ladder(decode_steps: int) -> List[int]:

@@ -139,6 +139,15 @@ class HttpService:
             name: m.gauge(f"llm_cp_{name}",
                           f"control plane: {name.replace('_', ' ')}")
             for name in ControlPlaneStats.FIELDS}
+        # per-step engine ledger (observability/ledger.py LEDGER_STATS):
+        # step counts per kind, recompiles, bucket-ladder padding waste,
+        # KV tier occupancy, batch occupancy, queue depth, EWMA tok/s
+        # and the MFU estimate — same render-time fold as the rest
+        from dynamo_tpu.observability.ledger import LedgerStats
+        self._engine = {
+            name: m.gauge(f"llm_engine_{name}",
+                          f"engine step ledger: {name.replace('_', ' ')}")
+            for name in LedgerStats.FIELDS}
         s = self.server
         s.route("POST", "/v1/chat/completions", self._chat)
         s.route("POST", "/v1/completions", self._completions)
@@ -201,6 +210,9 @@ class HttpService:
         from dynamo_tpu.runtime.cpstats import CP_STATS
         for name, value in CP_STATS.snapshot().items():
             self._cp[name].set(value=float(value))
+        from dynamo_tpu.observability.ledger import LEDGER_STATS
+        for name, value in LEDGER_STATS.snapshot().items():
+            self._engine[name].set(value=float(value))
 
     async def _chat(self, req: Request):
         try:

@@ -125,6 +125,25 @@ class MetricsExporter:
                 ("transfer_link_timeouts",
                  "Per-IO socket timeouts treated as transfer link death"),
             )}
+        # per-step ledger figures (observability/ledger.py via
+        # EngineMetrics): committed steps, recompile events, EWMA tok/s,
+        # MFU estimate, padding-waste fraction, offload tier occupancy
+        self.g_engine = {
+            name: r.gauge(f"{PREFIX}_engine_{name}", help_, labels)
+            for name, help_ in (
+                ("steps", "Device steps committed (ledger samples)"),
+                ("recompiles",
+                 "New (program, bucket) keys dispatched (XLA compiles)"),
+                ("tok_s", "EWMA instantaneous useful tokens/s"),
+                ("mfu", "Model FLOPs utilization estimate (0 = no peak "
+                        "configured)"),
+                ("pad_frac",
+                 "Cumulative bucket-ladder padding-waste fraction"),
+                ("host_pages_used", "Host-DRAM KV tier pages in use"),
+                ("host_pages_total", "Host-DRAM KV tier page capacity"),
+                ("disk_pages_used", "Disk KV tier pages in use"),
+                ("disk_pages_total", "Disk KV tier page capacity"),
+            )}
         self.g_load_avg = r.gauge(
             f"{PREFIX}_load_avg", "Mean active KV blocks across workers")
         self.g_load_std = r.gauge(
@@ -165,6 +184,10 @@ class MetricsExporter:
             self.component_name).endpoint(self.endpoint_name)
         self._client = ep.client()
         await self._client.start()
+        # watch-event series eviction: delete/draining events drop the
+        # instance's label series immediately (the scrape-driven
+        # `removed` pass below stays as the backstop)
+        self._client.add_listener(self._on_instance)
         self._aggregator = KvMetricsAggregator(
             self._client, interval_s=self._interval_s)
         self._aggregator.on_update(self._on_update)
@@ -192,15 +215,36 @@ class MetricsExporter:
 
     # -- aggregation ----------------------------------------------------------
 
+    def _worker_gauges(self):
+        """Every per-instance gauge family (the ('worker',) label set)."""
+        return (self.g_active_slots, self.g_total_slots,
+                self.g_kv_active, self.g_kv_total, self.g_waiting,
+                self.g_usage, self.g_hit_rate, self.g_window_steps,
+                self.g_window_wasted, self.g_spec_proposed,
+                self.g_spec_accepted, *self.g_pipe.values(),
+                *self.g_kv_repr.values(), *self.g_engine.values())
+
+    def _evict_worker_series(self, worker_id: str) -> None:
+        for g in self._worker_gauges():
+            g.remove(worker_id)
+
+    def _on_instance(self, kind: str, worker_id: str, info) -> None:
+        """Watch-event label-series eviction (the kv_router's
+        `on_instance` pattern): a departed or draining worker's
+        per-instance series drop the moment its delete/draining event
+        is APPLIED — not a scrape interval later. Without this, a
+        scrape loop that stalls (or a fleet that churns faster than it
+        scrapes) leaks one series set per dead instance and the
+        exporter's /metrics grows without bound (rolling-restart churn
+        test in tests/test_metrics_exporter.py)."""
+        from dynamo_tpu.runtime.component import STATUS_DRAINING
+        if kind == "delete" or (
+                info is not None and info.get("status") == STATUS_DRAINING):
+            self._evict_worker_series(worker_id)
+
     def _on_update(self, endpoints, removed) -> None:
         for worker_id in removed:
-            for g in (self.g_active_slots, self.g_total_slots,
-                      self.g_kv_active, self.g_kv_total, self.g_waiting,
-                      self.g_usage, self.g_hit_rate, self.g_window_steps,
-                      self.g_window_wasted, self.g_spec_proposed,
-                      self.g_spec_accepted, *self.g_pipe.values(),
-                      *self.g_kv_repr.values()):
-                g.remove(worker_id)
+            self._evict_worker_series(worker_id)
         for worker_id, m in endpoints.workers.items():
             self.g_active_slots.set(worker_id, value=m.request_active_slots)
             self.g_total_slots.set(worker_id, value=m.request_total_slots)
@@ -252,6 +296,21 @@ class MetricsExporter:
                 worker_id, value=m.kv_transfer_stale_chunks)
             self.g_kv_repr["transfer_link_timeouts"].set(
                 worker_id, value=m.kv_transfer_link_timeouts)
+            self.g_engine["steps"].set(worker_id, value=m.engine_steps)
+            self.g_engine["recompiles"].set(
+                worker_id, value=m.engine_recompiles)
+            self.g_engine["tok_s"].set(worker_id, value=m.engine_tok_s)
+            self.g_engine["mfu"].set(worker_id, value=m.engine_mfu)
+            self.g_engine["pad_frac"].set(
+                worker_id, value=m.engine_pad_frac)
+            self.g_engine["host_pages_used"].set(
+                worker_id, value=m.kv_host_pages_used)
+            self.g_engine["host_pages_total"].set(
+                worker_id, value=m.kv_host_pages_total)
+            self.g_engine["disk_pages_used"].set(
+                worker_id, value=m.kv_disk_pages_used)
+            self.g_engine["disk_pages_total"].set(
+                worker_id, value=m.kv_disk_pages_total)
         self.g_load_avg.set(value=endpoints.load_avg)
         self.g_load_std.set(value=endpoints.load_std)
         self.g_workers.set(value=len(endpoints.workers))

@@ -1,0 +1,279 @@
+"""Fleet time-series rollup + per-link KV-transfer cost model.
+
+Layer 2 of the resource-telemetry plane (docs/OBSERVABILITY.md §6):
+where the per-step ledger (observability/ledger.py) answers "what is
+THIS engine doing", the rollup answers "what is the FLEET doing, over
+time" — a scrape loop over the `$STATS` plane (the same WorkerMetrics
+every router aggregator reads) feeding fixed-interval ring series
+(observability/timeseries.py) per worker and per fleet aggregate, plus
+a `TransferCostModel` of per-link KV-transfer bandwidth EWMAs fed from
+the transfer backends' bytes/duration samples (the signal ROADMAP
+item 3's transfer-aware router scoring consumes). The SLO watchdog
+(observability/slo.py) evaluates over the same store;
+`tools/fleet_top.py` renders it.
+
+The cost model is process-global (`TRANSFER_MODEL`, the XFER_STATS
+pattern): both disagg transfer backends call `observe(link, bytes,
+seconds)` per completed send, so any process that ships KV pages grows
+a measured bandwidth table keyed by destination engine id for free.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+from dynamo_tpu.observability.timeseries import Ewma, SeriesStore
+
+log = logging.getLogger("dynamo_tpu.fleet")
+
+# WorkerMetrics fields the rollup keeps per-worker history for (a
+# deliberate subset: per-worker series cost capacity x fields buckets)
+WORKER_FIELDS = (
+    "kv_active_blocks", "kv_total_blocks", "request_active_slots",
+    "num_requests_waiting", "gpu_cache_usage_perc", "engine_tok_s",
+    "engine_mfu", "engine_pad_frac", "engine_recompiles",
+    "kv_host_pages_used", "kv_transfer_bytes",
+)
+
+
+class TransferCostModel:
+    """Per-link KV-transfer bandwidth EWMAs, queryable by the router.
+
+    A "link" is the destination engine/worker id of a KV page transfer
+    (what `send_pages(engine_id, ...)` targets); the sample is the
+    payload bytes and wall seconds of one completed send, so the EWMA
+    tracks delivered goodput including integrity re-fetches and resume
+    overhead. `estimate_s` is the router-facing query: what would
+    shipping N bytes to this worker cost right now?"""
+
+    def __init__(self, alpha: float = 0.3,
+                 default_bytes_per_s: float = 1e9,
+                 min_sample_s: float = 1e-6):
+        self.alpha = alpha
+        self.default_bytes_per_s = default_bytes_per_s
+        self.min_sample_s = min_sample_s
+        self._links: Dict[str, Ewma] = {}
+
+    def observe(self, link: str, nbytes: int, seconds: float) -> None:
+        if nbytes <= 0 or seconds < self.min_sample_s:
+            return
+        ew = self._links.get(link)
+        if ew is None:
+            ew = self._links[link] = Ewma(self.alpha)
+        ew.update(nbytes / seconds)
+
+    def bandwidth_bytes_per_s(self, link: str) -> float:
+        ew = self._links.get(link)
+        if ew is None or ew.value is None:
+            return self.default_bytes_per_s
+        return ew.value
+
+    def measured(self, link: str) -> bool:
+        ew = self._links.get(link)
+        return ew is not None and ew.samples > 0
+
+    def estimate_s(self, link: str, nbytes: int) -> float:
+        return nbytes / max(1.0, self.bandwidth_bytes_per_s(link))
+
+    def links(self) -> List[str]:
+        return sorted(self._links)
+
+    def snapshot(self) -> Dict[str, dict]:
+        return {
+            link: {"bytes_per_s": round(ew.value, 1),
+                   "samples": ew.samples}
+            for link, ew in sorted(self._links.items())
+            if ew.value is not None}
+
+    def reset(self) -> None:
+        self._links.clear()
+
+
+TRANSFER_MODEL = TransferCostModel()
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Dict[str, float]]:
+    """Minimal Prometheus text-exposition parser: family name ->
+    {label-string -> value}. HELP/TYPE lines are recorded as presence
+    (empty dict) so a family with no series still shows up — what the
+    docs-catalog completeness test keys on. Histogram _bucket/_sum/
+    _count sample names roll up under their family name."""
+    out: Dict[str, Dict[str, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                out.setdefault(parts[2], {})
+            continue
+        name_labels, _, value = line.rpartition(" ")
+        name, labels = name_labels, ""
+        if "{" in name_labels:
+            name, _, rest = name_labels.partition("{")
+            labels = "{" + rest
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in out:
+                name = name[:-len(suffix)]
+                break
+        try:
+            out.setdefault(name, {})[labels] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+async def scrape_http_metrics(host: str, port: int,
+                              timeout_s: float = 5.0
+                              ) -> Dict[str, Dict[str, float]]:
+    """One GET /metrics against a frontend or exporter, parsed."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout_s)
+    try:
+        writer.write(b"GET /metrics HTTP/1.1\r\nhost: fleet\r\n"
+                     b"connection: close\r\n\r\n")
+        await asyncio.wait_for(writer.drain(), timeout_s)
+        raw = await asyncio.wait_for(reader.read(), timeout_s)
+    finally:
+        writer.close()
+    body = raw.split(b"\r\n\r\n", 1)[-1].decode(errors="replace")
+    return parse_prometheus_text(body)
+
+
+class FleetRollup:
+    """The scrape loop: `$STATS` plane -> SeriesStore history.
+
+    One `scrape_once(ts)` polls every live worker's WorkerMetrics
+    through the runtime Client (the same fan-out KvMetricsAggregator
+    does), records per-worker series for WORKER_FIELDS, fleet
+    aggregates, the serving-path histogram quantiles (TTFT/ITL p95/p99
+    via Histogram.quantile — the series the SLO specs evaluate), the
+    control-plane health fields, and the TransferCostModel's per-link
+    bandwidth EWMAs. Explicit `ts` keeps it virtual-clock testable."""
+
+    def __init__(self, client, store: Optional[SeriesStore] = None,
+                 interval_s: float = 1.0,
+                 model: Optional[TransferCostModel] = None,
+                 expected_workers: Optional[int] = None,
+                 clock: Callable[[], float] = time.time):
+        self.client = client
+        self.store = store if store is not None else SeriesStore(
+            interval_s=interval_s)
+        self.interval_s = interval_s
+        self.model = model if model is not None else TRANSFER_MODEL
+        self.expected_workers = expected_workers
+        self.clock = clock
+        self.scrapes = 0
+        self._task: Optional[asyncio.Task] = None
+
+    async def scrape_once(self, ts: Optional[float] = None) -> dict:
+        from dynamo_tpu.kv_router.scoring import WorkerMetrics
+        from dynamo_tpu.runtime.cpstats import CP_STATS
+        if ts is None:
+            ts = self.clock()
+        stats = await self.client.scrape_stats()
+        rec = self.store.record
+        workers: Dict[str, WorkerMetrics] = {}
+        for worker_id, payload in stats.items():
+            try:
+                m = WorkerMetrics.from_dict(payload)
+            except (TypeError, KeyError):
+                continue
+            workers[worker_id] = m
+            for field in WORKER_FIELDS:
+                rec(f"worker/{worker_id}/{field}",
+                    float(getattr(m, field)), ts)
+        live = len(workers)
+        rec("fleet/workers_live", live, ts)
+        if self.expected_workers:
+            rec("fleet/availability", live / self.expected_workers, ts)
+        if workers:
+            rec("fleet/kv_usage_avg",
+                sum(m.gpu_cache_usage_perc for m in workers.values())
+                / live, ts)
+            rec("fleet/waiting_total",
+                sum(m.num_requests_waiting for m in workers.values()), ts)
+            rec("fleet/tok_s_total",
+                sum(m.engine_tok_s for m in workers.values()), ts)
+            rec("fleet/recompiles_total",
+                sum(m.engine_recompiles for m in workers.values()), ts)
+        # serving-path latency quantiles (the SLO evaluator's TTFT/ITL
+        # sources; Histogram.quantile — observability/metrics.py)
+        from dynamo_tpu.observability.serving import SERVING
+        for name, hist, q in (("serving/ttft_p95", SERVING.ttft, 0.95),
+                              ("serving/itl_p99", SERVING.itl, 0.99)):
+            qv = hist.quantile_all(q)
+            if qv == qv:  # not NaN: at least one observation exists
+                rec(name, qv, ts)
+        # control-plane health + event-plane lag (degraded-mode context
+        # the SLO watchdog reads)
+        rec("cp/event_lag_seconds", float(CP_STATS.event_lag_seconds), ts)
+        rec("cp/router_degraded", float(CP_STATS.router_degraded), ts)
+        # per-link measured transfer bandwidth (the router-scoring feed)
+        for link, snap in self.model.snapshot().items():
+            rec(f"link/{link}/bytes_per_s", snap["bytes_per_s"], ts)
+        self.scrapes += 1
+        return {"ts": ts, "workers": live,
+                "links": len(self.model.links())}
+
+    async def start(self) -> "FleetRollup":
+        async def loop():
+            # dynalint: backoff-ok=fixed-cadence rollup scrape; a failed
+            # cycle logs and the next tick retries at the same cadence
+            while True:
+                try:
+                    await self.scrape_once()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("fleet rollup scrape failed")
+                await asyncio.sleep(self.interval_s)
+        self._task = asyncio.create_task(loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    # -- rendering / evidence -------------------------------------------------
+
+    def summary(self, window_s: float = 60.0,
+                ts: Optional[float] = None) -> dict:
+        """One rollup snapshot: fleet aggregates over the window plus
+        the link table (fleet_top's data source and the FLEET_r10
+        evidence rows)."""
+        if ts is None:
+            ts = self.clock()
+        st = self.store
+
+        def agg(name):
+            s = st.get(name)
+            if s is None:
+                return None
+            return {"last": s.latest(),
+                    "avg": round(a, 4) if (a := s.avg(window_s, ts))
+                    is not None else None,
+                    "max": s.max(window_s, ts)}
+
+        workers = sorted({n.split("/")[1]
+                          for n in st.names("worker/")})
+        return {
+            "ts": round(ts, 3),
+            "scrapes": self.scrapes,
+            "workers_seen": len(workers),
+            "fleet": {name.split("/", 1)[1]: agg(name)
+                      for name in st.names("fleet/")},
+            "serving": {name.split("/", 1)[1]: agg(name)
+                        for name in st.names("serving/")},
+            "cp": {name.split("/", 1)[1]: agg(name)
+                   for name in st.names("cp/")},
+            "links": self.model.snapshot(),
+        }

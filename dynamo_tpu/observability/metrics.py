@@ -159,6 +159,30 @@ DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                    10.0, 30.0, 60.0, float("inf"))
 
 
+def _bucket_quantile(buckets, counts, total: int, q: float) -> float:
+    """Shared estimator under Histogram.quantile/quantile_all; see
+    quantile() for semantics. `counts` are per-bucket (not cumulative)."""
+    if total <= 0 or not counts:
+        return float("nan")
+    if not 0.0 < q <= 1.0:
+        raise ValueError(f"quantile q must be in (0, 1], got {q}")
+    target = q * total
+    cum = 0.0
+    for i, hi in enumerate(buckets):
+        prev = cum
+        cum += counts[i]
+        if cum >= target:
+            if hi == float("inf"):
+                # cannot extrapolate: largest finite bound (or NaN when
+                # the ladder somehow has no finite rung)
+                return buckets[i - 1] if i else float("nan")
+            lo = buckets[i - 1] if i else 0.0
+            if counts[i] <= 0:
+                return hi
+            return lo + (hi - lo) * (target - prev) / counts[i]
+    return float("nan")   # unreachable: last bucket is +Inf
+
+
 class Histogram(_Metric):
     kind = "histogram"
 
@@ -186,6 +210,33 @@ class Histogram(_Metric):
 
     def count(self, *labels: str) -> int:
         return self._totals.get(labels, 0)
+
+    def quantile(self, q: float, *labels: str) -> float:
+        """Estimate the q-quantile (0 < q <= 1) from the bucket counts —
+        the promql `histogram_quantile` estimator: find the bucket the
+        rank lands in, interpolate linearly inside it. Exact at bucket
+        boundaries (a rank landing exactly on a bucket's cumulative
+        count returns that bucket's upper bound); a rank inside the
+        +Inf bucket returns the largest finite bound (the estimator
+        cannot extrapolate past the ladder). NaN with no observations.
+        Used by the SLO evaluator (observability/slo.py), the fleet
+        rollup's serving/* series, and trace_explain --summary."""
+        self._check(labels)
+        with self._lock:
+            counts = list(self._counts.get(labels, ()))
+            total = self._totals.get(labels, 0)
+        return _bucket_quantile(self.buckets, counts, total, q)
+
+    def quantile_all(self, q: float) -> float:
+        """quantile() over the SUM of every label series' buckets (the
+        per-model TTFT histogram viewed fleet-wide)."""
+        with self._lock:
+            agg = [0] * len(self.buckets)
+            for counts in self._counts.values():
+                for i, c in enumerate(counts):
+                    agg[i] += c
+            total = sum(self._totals.values())
+        return _bucket_quantile(self.buckets, agg, total, q)
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",

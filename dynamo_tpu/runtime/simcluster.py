@@ -173,6 +173,13 @@ class SimWorker:
             "num_requests_waiting": 0,
             "gpu_cache_usage_perc": self.rng.random() * 0.5,
             "gpu_prefix_cache_hit_rate": self.rng.random(),
+            # synthetic ledger figures (observability/ledger.py fields a
+            # real engine publishes): the fleet rollup scrapes these, so
+            # the 64-worker FLEET_r10 evidence exercises the same
+            # WorkerMetrics plumbing a live fleet feeds it with
+            "engine_steps": self._event_id * 7,
+            "engine_tok_s": round(800.0 + self.rng.random() * 400.0, 1),
+            "engine_pad_frac": round(self.rng.random() * 0.3, 3),
         }
 
     async def mark_draining(self) -> None:
@@ -475,6 +482,34 @@ class SimCluster:
         return {"targets": len(targets), "expired": len(expired),
                 "errors": self.schedule_errors,
                 "dead_picks": self.dead_picks}
+
+    async def kill_fraction(self, fraction: float = 0.3,
+                            wait_expiry: bool = True) -> List[str]:
+        """Kill a seeded fraction of the fleet (heartbeats stop; leases
+        expire) WITHOUT restarting — the two-phase primitive the fleet
+        SLO storm (tools/fleet_storm.py) scrapes through: kill, watch
+        the availability series burn, then `revive()` and watch the
+        alert clear."""
+        targets = pick_storm_targets(self.rng.randrange(1 << 30),
+                                     list(self.workers), fraction)
+        for wid in targets:
+            self.workers[wid].kill()
+        if wait_expiry:
+            deadline = time.monotonic() + self.cfg.lease_ttl_s * 4
+            while time.monotonic() < deadline:
+                if all(w not in self.client.instances for w in targets):
+                    break
+                await asyncio.sleep(0.05)
+        return targets
+
+    async def revive(self, targets: List[str]) -> None:
+        """Restart previously-killed workers (jittered) and re-seed
+        their KV events — the recovery leg of the SLO storm."""
+        await asyncio.gather(*(self.workers[w].restart_with_jitter()
+                               for w in targets))
+        for wid in targets:
+            await self._seed_events(self.workers[wid])
+        await self._drain_event_queue()
 
     async def storm_watch_disconnect(self, kills: int = 3,
                                      load_calls: int = 0) -> dict:

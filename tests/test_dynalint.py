@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R14), the
+- one positive AND one negative fixture per AST rule (R1-R15), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -923,6 +923,72 @@ def test_r14_live_on_data_and_control_wire():
         with open(path) as f:
             found = lint_source(f.read(), rel)
         assert not [x for x in found if x.rule == "R14"], rel
+
+
+# -- R15: metric registration contract ----------------------------------------
+
+R15_BAD = """
+    from dynamo_tpu.observability.metrics import MetricsRegistry
+    r = MetricsRegistry()
+    undocumented = r.gauge("llm_mystery_gauge_nobody_wrote_down",
+                           "has help but no catalog entry")
+    helpless = r.gauge("llm_workers", "")
+    missing_help = r.counter("llm_workers")
+"""
+
+
+def test_r15_flags_undocumented_family_and_empty_help():
+    found = lint_source(textwrap.dedent(R15_BAD),
+                        "dynamo_tpu/observability/fixture.py")
+    r15 = [x for x in found if x.rule == "R15"]
+    assert len(r15) == 3
+    msgs = " ".join(x.message for x in r15)
+    assert "not in the" in msgs and "no help text" in msgs
+
+
+def test_r15_quiet_on_documented_families_and_fstring_fragments():
+    good = """
+        def build(r, name):
+            # exact literal: catalog member
+            g = r.gauge("llm_workers", "Live worker instances")
+            # f-string fragments resolve against the catalog
+            # (llm_cp_* families)
+            cp = {n: r.gauge(f"llm_cp_{n}", f"control plane: {n}")
+                  for n in ("watch_resyncs",)}
+            # histogram with keyword help
+            h = r.histogram("llm_ttft_seconds",
+                            help_="time to first token")
+            # dynalint: metric-doc-ok=fixture-internal scratch gauge
+            s = r.gauge("llm_scratch_not_documented", "x")
+            return g, cp, h, s
+    """
+    found = lint_source(textwrap.dedent(good),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert "R15" not in rules(found)
+
+
+def test_r15_quiet_outside_package_scope():
+    found = lint_source(textwrap.dedent(R15_BAD).replace(
+        "dynamo_tpu.observability.metrics", "metrics"),
+        "tools/fixture.py")
+    assert "R15" not in rules(found)
+
+
+def test_r15_live_every_registration_documented_with_help():
+    """The live gate: every metric registration in the dynamo_tpu
+    package carries help text and a docs/OBSERVABILITY.md §9 catalog
+    entry (the static half; test_metrics_catalog.py holds the
+    rendered half)."""
+    import glob
+    scoped = glob.glob(os.path.join(REPO, "dynamo_tpu", "**", "*.py"),
+                       recursive=True)
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R15"], \
+            (rel, [x.message for x in found if x.rule == "R15"])
 
 
 # -- jaxpr invariants ----------------------------------------------------------

@@ -1,0 +1,103 @@
+"""Fleet rollup over a live (small) simcluster — the scrape-loop leg
+of the telemetry plane. The full 64-worker storm with SLO fire->clear
+is the committed FLEET_r10.json evidence (tools/fleet_storm.py,
+golden-checked in test_telemetry.py); this smoke keeps the $STATS
+scrape -> series -> summary -> watchdog wiring honest at tier-1 cost
+(8 workers, a handful of scrapes, no sleeps beyond sim startup).
+"""
+import asyncio
+
+import pytest
+
+from dynamo_tpu.observability.fleet import FleetRollup, TransferCostModel
+from dynamo_tpu.observability.slo import SloSpec, SloWatchdog
+from dynamo_tpu.observability.timeseries import SeriesStore
+from dynamo_tpu.runtime.cpstats import CP_STATS
+from dynamo_tpu.runtime.simcluster import SimCluster, SimConfig
+
+
+@pytest.fixture(autouse=True)
+def clean_cp_state():
+    CP_STATS.reset()
+    yield
+    CP_STATS.reset()
+
+
+def test_rollup_scrapes_sim_fleet_into_series_and_summary():
+    async def main():
+        sim = await SimCluster(SimConfig(workers=8, streams=64,
+                                         seed=3)).start()
+        model = TransferCostModel()
+        store = SeriesStore(interval_s=1.0, capacity=64)
+        rollup = FleetRollup(sim.client, store=store, interval_s=1.0,
+                             model=model, expected_workers=8)
+        try:
+            # seeded per-link bandwidth samples (a live fleet feeds
+            # these from the transfer backends)
+            model.observe("w0000", 10_000_000, 0.01)
+            model.observe("w0001", 2_000_000, 0.01)
+            for t in (100.0, 101.0, 102.0):
+                snap = await rollup.scrape_once(ts=t)
+            return snap, store, rollup.summary(window_s=5.0, ts=102.0), sim
+        finally:
+            await sim.stop()
+
+    snap, store, summary, sim = asyncio.run(main())
+    assert snap["workers"] == 8
+    assert snap["links"] == 2
+    # per-worker history for every rollup field, incl. the synthetic
+    # ledger figures the sim workers publish
+    assert store.get("worker/w0003/kv_active_blocks") is not None
+    assert store.get("worker/w0003/engine_tok_s").latest() > 0
+    # fleet aggregates
+    assert store.get("fleet/workers_live").window(5.0, 102.0) == [8.0] * 3
+    assert store.get("fleet/availability").latest() == 1.0
+    assert store.get("fleet/tok_s_total").latest() > 0
+    # link EWMAs surfaced as series
+    assert store.get("link/w0000/bytes_per_s").latest() == \
+        pytest.approx(1e9)
+    # summary is the fleet_top/evidence shape
+    assert summary["workers_seen"] == 8
+    assert summary["fleet"]["availability"]["last"] == 1.0
+    assert set(summary["links"]) == {"w0000", "w0001"}
+
+
+def test_rollup_feeds_watchdog_availability_drop():
+    """Kill half the sim fleet; the availability series the rollup
+    records must take a bandwidth-floor-style SLO over threshold —
+    the live half of what the seeded-plan test proves virtually."""
+    async def main():
+        sim = await SimCluster(SimConfig(workers=8, streams=64, seed=5,
+                                         lease_ttl_s=0.5)).start()
+        store = SeriesStore(interval_s=1.0, capacity=256)
+        rollup = FleetRollup(sim.client, store=store, interval_s=1.0,
+                             model=TransferCostModel(),
+                             expected_workers=8)
+        wd = SloWatchdog(store, [SloSpec(
+            name="avail", series="fleet/availability", objective=0.7,
+            mode="below", target=0.9, short_window_s=3.0,
+            long_window_s=6.0, burn_threshold=2.0, min_samples=2)],
+            degraded_fn=lambda: False)
+        try:
+            t = 100.0
+            for _ in range(6):
+                await rollup.scrape_once(ts=t)
+                wd.evaluate(t)
+                t += 1.0
+            assert not wd.firing()
+            targets = await sim.kill_fraction(fraction=0.5)
+            fired_at = None
+            for _ in range(8):
+                await rollup.scrape_once(ts=t)
+                if wd.evaluate(t) and wd.firing():
+                    fired_at = t
+                t += 1.0
+            return targets, fired_at, wd.firing(), store
+        finally:
+            await sim.stop()
+
+    targets, fired_at, firing, store = asyncio.run(main())
+    assert len(targets) == 4
+    assert store.get("fleet/availability").latest() == pytest.approx(0.5)
+    assert firing == ["avail"]
+    assert fired_at is not None

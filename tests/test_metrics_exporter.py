@@ -138,3 +138,63 @@ def test_exporter_two_worker_graph():
             await ert.shutdown()
 
     asyncio.run(main())
+
+
+def _series_count(exporter) -> int:
+    """Total live label series across every per-worker gauge family."""
+    return sum(len(g._values) for g in exporter._worker_gauges())
+
+
+def test_exporter_series_lifecycle_under_rolling_restart_churn():
+    """Satellite (ISSUE 10): departed workers' per-instance series are
+    remove()d at WATCH-EVENT time (the kv_router on_instance eviction,
+    mirrored), so a rolling restart of uniquely-named workers cannot
+    grow the exporter's series set without bound — and the eviction
+    does NOT wait for the next scrape cycle."""
+    async def main():
+        plane = MemoryPlane()
+        ert = await DistributedRuntime.create_local(plane, "exporter")
+        # slow scrape interval: eviction must come from the watch path,
+        # not from a lucky scrape landing in the sleep below
+        exporter = MetricsExporter(ert, "ns", "worker", port=0,
+                                   scrape_interval_s=30.0)
+        await exporter.start()
+        counts = []
+        try:
+            for gen in range(3):       # 3 generations of 2 workers each
+                rts = []
+                for i in range(2):
+                    rt = await DistributedRuntime.create_local(
+                        plane, f"gen{gen}-w{i}")
+                    ep = rt.namespace("ns").component(
+                        "worker").endpoint("generate")
+                    await ep.serve(
+                        fake_engine,
+                        stats_handler=lambda: {
+                            "request_active_slots": 1,
+                            "request_total_slots": 4,
+                            "kv_active_blocks": 2, "kv_total_blocks": 16,
+                            "num_requests_waiting": 0,
+                            "gpu_cache_usage_perc": 0.1,
+                            "gpu_prefix_cache_hit_rate": 0.5})
+                    rts.append(rt)
+                await asyncio.sleep(0.05)      # watch puts land
+                await exporter._aggregator.scrape_once()
+                counts.append(_series_count(exporter))
+                for rt in rts:                 # the whole generation dies
+                    await rt.shutdown()
+                await asyncio.sleep(0.05)      # watch DELETES land
+                # no scrape between death and this check: the watch
+                # listener alone must have evicted the series
+                counts.append(_series_count(exporter))
+            return counts
+        finally:
+            await exporter.stop()
+            await ert.shutdown()
+
+    counts = asyncio.run(main())
+    alive, dead = counts[0::2], counts[1::2]
+    # every generation renders the same bounded series count while
+    # alive, and zero per-worker series after its delete events apply
+    assert all(c == alive[0] > 0 for c in alive), counts
+    assert all(c == 0 for c in dead), counts

@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""fleet_storm: the resource-telemetry evidence run (FLEET_r10.json).
+
+Produces the full telemetry-plane evidence chain in one run
+(docs/OBSERVABILITY.md §7, ISSUE 10 acceptance):
+
+1. **Per-step engine ledger from a live engine**: a tiny CPU engine
+   serves a churn of concurrent requests with the ledger on; the
+   drained per-step samples are committed as LEDGER_r10.jsonl
+   (tools/artifacts.py append-only policy) and summarized in the
+   report.
+2. **64-worker fleet rollup**: a PR 7 simcluster fleet under a
+   FleetRollup scrape loop, per-link KV-transfer bandwidth EWMAs fed
+   with seeded samples (the sim has no data plane; a live fleet feeds
+   the same TransferCostModel from its transfer backends).
+3. **SLO burn-rate fire -> clear**: a seeded storm (lease-expiry kill
+   of a fleet fraction + bandwidth collapse on a victim link) drives
+   the availability and bandwidth-floor SLOs over their burn
+   thresholds; recovery (revive + healthy bandwidth) clears them.
+   Alerts ride the event plane (`<ns>.slo.alerts`) and a subscriber
+   round-trips them into the artifact.
+
+Contracts (exit 1 on violation): the storm fires at least one alert,
+every alert clears after recovery, the event-plane round trip delivers
+every alert, and the sim scheduled with zero errors throughout.
+
+Usage:
+    python tools/fleet_storm.py                  # full evidence run
+    python tools/fleet_storm.py --quick --no-artifact   # shape check
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def run_engine_ledger(jsonl_path: str, quick: bool = False) -> dict:
+    """Leg 1: a live engine under churn, ledger drained to JSONL."""
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+    cfg = ModelConfig(dtype="float32", max_model_len=512)
+    eng = NativeEngine(cfg, EngineConfig(
+        page_size=64, num_pages=32, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512, decode_steps=4,
+        pipeline_depth=2), seed=0)
+    eng.ledger.configure(enabled=True)
+    n_reqs = 3 if quick else 8
+    rng = random.Random(10)
+    # staggered admissions while decode runs -> the ledger sees all
+    # three step kinds (prefill, mixed, decode windows)
+    pending = [(f"led{i}",
+                [rng.randrange(3, 250) for _ in range(rng.randrange(8, 24))],
+                SamplingParams(max_tokens=6 + 2 * (i % 4), temperature=0.0,
+                               ignore_eos=True))
+               for i in range(n_reqs)]
+    eng.add_request(EngineRequest(*pending.pop(0)))
+    done = set()
+    while eng.has_work() or pending:
+        if pending and eng.step_count % 3 == 1:
+            eng.add_request(EngineRequest(*pending.pop(0)))
+        for ev in eng.step():
+            if ev.finished:
+                done.add(ev.request_id)
+    summary = eng.ledger.summary()
+    n = eng.ledger.write_jsonl(jsonl_path)
+    eng.close()
+    summary.update(jsonl=os.path.basename(jsonl_path), written=n,
+                   requests=len(done))
+    return summary
+
+
+async def run_fleet_storm(args) -> dict:
+    """Legs 2+3: rollup + SLO fire->clear over a seeded sim storm."""
+    import msgpack
+
+    from dynamo_tpu.observability.fleet import FleetRollup, TransferCostModel
+    from dynamo_tpu.observability.slo import (
+        SloSpec, SloWatchdog, wire_event_plane,
+    )
+    from dynamo_tpu.observability.timeseries import SeriesStore
+    from dynamo_tpu.runtime.simcluster import SimCluster, SimConfig
+    interval = 0.1
+    sim = await SimCluster(SimConfig(
+        workers=args.workers, streams=args.workers * 8,
+        lease_ttl_s=0.6, seed=args.seed)).start()
+    model = TransferCostModel()
+    store = SeriesStore(interval_s=interval, capacity=4096)
+    rollup = FleetRollup(sim.client, store=store, interval_s=interval,
+                         model=model, expected_workers=args.workers)
+    rng = random.Random(args.seed)
+    links = sorted(sim.workers)[:8]
+    victim = links[0]
+
+    def feed_links(degraded: bool) -> None:
+        # seeded per-link samples: ~1 GB/s healthy; the victim link
+        # collapses to ~20 MB/s during the storm
+        for link in links:
+            bw = 2e7 if (degraded and link == victim) \
+                else 1e9 * (0.8 + 0.4 * rng.random())
+            model.observe(link, int(bw * 0.01), 0.01)
+
+    specs = [
+        SloSpec(name="fleet_availability", series="fleet/availability",
+                objective=0.85, mode="below", target=0.9,
+                short_window_s=1.0, long_window_s=3.0,
+                burn_threshold=2.0, min_samples=3),
+        SloSpec(name=f"kv_bw_floor/{victim}",
+                series=f"link/{victim}/bytes_per_s",
+                objective=1e8, mode="below", target=0.9,
+                short_window_s=1.0, long_window_s=3.0,
+                burn_threshold=2.0, min_samples=3),
+        # degraded-exempt: event-plane lag wobbles are sanctioned while
+        # the router rides its stale snapshot — this spec must stay
+        # quiet even though the storm perturbs the event plane
+        SloSpec(name="event_lag", series="cp/event_lag_seconds",
+                objective=5.0, mode="above", target=0.9,
+                short_window_s=1.0, long_window_s=3.0,
+                burn_threshold=2.0, degraded_exempt=True),
+    ]
+    delivered = []
+
+    async def consume(sub):
+        async for _subject, payload in sub:
+            delivered.append(msgpack.unpackb(payload, raw=False))
+
+    subject = f"{sim.cfg.namespace}.slo.alerts"
+    sub = await sim.plane.messaging.subscribe(subject)
+    consumer = asyncio.create_task(consume(sub))
+    wd = SloWatchdog(store, specs, degraded_fn=lambda: False)
+    wire_event_plane(wd, sim.plane.messaging, subject)
+
+    async def tick(n: int, degraded: bool) -> None:
+        for _ in range(n):
+            feed_links(degraded)
+            await rollup.scrape_once()
+            wd.evaluate(time.time())
+            await sim.run_load(8)
+            await asyncio.sleep(interval)
+
+    report: dict = {"rollup": {}, "slo_states": {}}
+    try:
+        await tick(args.phase_ticks, degraded=False)
+        report["rollup"]["healthy"] = rollup.summary(window_s=5.0)
+        report["slo_states"]["healthy"] = wd.summary()
+        fired_before = list(wd.firing())
+
+        targets = await sim.kill_fraction(fraction=0.4)
+        await tick(args.phase_ticks * 2, degraded=True)
+        report["rollup"]["storm"] = rollup.summary(window_s=5.0)
+        report["slo_states"]["storm"] = wd.summary()
+        firing_in_storm = list(wd.firing())
+
+        await sim.revive(targets)
+        # recovery: healthy links + full fleet until every alert clears
+        for _ in range(args.phase_ticks * 6):
+            await tick(1, degraded=False)
+            if not wd.firing():
+                break
+        report["rollup"]["recovered"] = rollup.summary(window_s=5.0)
+        report["slo_states"]["recovered"] = wd.summary()
+        await asyncio.sleep(0.2)      # let the last publishes land
+    finally:
+        consumer.cancel()
+        aclose = getattr(sub, "aclose", None)
+        if aclose is not None:
+            await aclose()
+        await sim.stop()
+
+    report["alerts"] = wd.alerts
+    report["alerts_delivered"] = delivered
+    report["storm"] = {"killed": len(targets), "victim_link": victim,
+                       "firing_in_storm": firing_in_storm,
+                       "fired_before_storm": fired_before}
+    fired = [ev for ev in wd.alerts if ev["event"] == "fire"]
+    cleared = [ev for ev in wd.alerts if ev["event"] == "clear"]
+    report["contracts"] = {
+        "alert_fired_in_storm": bool(firing_in_storm)
+        and not fired_before,
+        "all_alerts_cleared": not wd.firing()
+        and len(cleared) == len(fired) and bool(fired),
+        "event_plane_roundtrip": len(delivered) == len(wd.alerts),
+        "degraded_exempt_quiet": not any(
+            ev["slo"] == "event_lag" for ev in wd.alerts),
+        "zero_schedule_errors": sim.schedule_errors == 0,
+    }
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleet_storm", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--workers", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=10)
+    ap.add_argument("--phase-ticks", type=int, default=15,
+                    help="scrape/evaluate ticks per storm phase")
+    ap.add_argument("--out", default=os.path.join(REPO_ROOT,
+                                                  "FLEET_r10.json"))
+    ap.add_argument("--ledger-out",
+                    default=os.path.join(REPO_ROOT, "LEDGER_r10.jsonl"))
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--no-artifact", action="store_true")
+    args = ap.parse_args(argv)
+    if args.quick:
+        args.workers = min(args.workers, 16)
+        args.phase_ticks = min(args.phase_ticks, 8)
+
+    t0 = time.time()
+    ledger_path = args.ledger_out if not args.no_artifact \
+        else os.path.join("/tmp", "LEDGER_quick.jsonl")
+    if os.path.exists(ledger_path) and args.no_artifact:
+        os.unlink(ledger_path)
+    ledger = run_engine_ledger(ledger_path, quick=args.quick)
+    print(f"engine ledger: {json.dumps(ledger)}", flush=True)
+
+    report = asyncio.run(run_fleet_storm(args))
+    report["seed"] = args.seed
+    report["workers"] = args.workers
+    report["ledger"] = ledger
+    report["elapsed_s"] = round(time.time() - t0, 1)
+    report["ok"] = all(report["contracts"].values())
+    print(json.dumps({"contracts": report["contracts"],
+                      "alerts": report["alerts"],
+                      "elapsed_s": report["elapsed_s"]}, indent=1))
+    if not args.no_artifact:
+        from tools.artifacts import write_json
+        write_json(args.out, report)
+        print(f"committed {args.out} (+ {args.ledger_out})",
+              file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""fleet_top: render the fleet time-series rollup, one-shot or --watch.
+
+The `top(1)` of the telemetry plane (docs/OBSERVABILITY.md §6): given a
+live coordinator it stands up a FleetRollup (observability/fleet.py)
+over the worker `$STATS` plane, scrapes, and renders the fleet
+aggregates, per-worker table, per-link KV-transfer bandwidth EWMAs and
+(optionally) SLO burn state. Given a committed evidence artifact
+(--from-artifact FLEET_r10.json) it renders the same view offline from
+the recorded summaries — the review path for a storm that already
+happened.
+
+Usage:
+    python tools/fleet_top.py --coordinator 127.0.0.1:6230 \
+        --namespace ns --component worker [--watch] [--interval 2]
+    python tools/fleet_top.py --from-artifact FLEET_r10.json
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+from typing import Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _fmt(v, nd=2) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render_summary(summary: dict, slo: Optional[dict] = None,
+                   workers: Optional[dict] = None) -> str:
+    """Pure renderer over FleetRollup.summary() output (+ optional
+    SloWatchdog.summary() and a per-worker last-value table) — the same
+    function drives --watch, one-shot, and --from-artifact, and the
+    tier-1 smoke golden-checks it."""
+    out = [f"fleet @ ts={_fmt(summary.get('ts'))}  "
+           f"scrapes={summary.get('scrapes')}  "
+           f"workers_seen={summary.get('workers_seen')}"]
+    fleet = summary.get("fleet") or {}
+    if fleet:
+        out.append("  fleet (last / avg / max over window):")
+        for name, agg in sorted(fleet.items()):
+            if agg is None:
+                continue
+            out.append(f"    {name:<20} {_fmt(agg.get('last')):>10} "
+                       f"{_fmt(agg.get('avg')):>10} "
+                       f"{_fmt(agg.get('max')):>10}")
+    serving = summary.get("serving") or {}
+    for name, agg in sorted(serving.items()):
+        if agg:
+            out.append(f"  serving/{name}: last={_fmt(agg.get('last'), 4)} "
+                       f"avg={_fmt(agg.get('avg'), 4)}")
+    cp = summary.get("cp") or {}
+    if cp:
+        vals = {k: (a or {}).get("last") for k, a in cp.items()}
+        out.append(f"  control plane: degraded="
+                   f"{_fmt(vals.get('router_degraded'), 0)} "
+                   f"event_lag={_fmt(vals.get('event_lag_seconds'), 3)}s")
+    links = summary.get("links") or {}
+    if links:
+        out.append(f"  kv-transfer links ({len(links)} measured):")
+        for link, snap in sorted(links.items()):
+            mbs = snap["bytes_per_s"] / 1e6
+            out.append(f"    {link:<24} {mbs:10.1f} MB/s "
+                       f"({snap['samples']} samples)")
+    if slo:
+        out.append("  slo burn:")
+        for name, st in sorted(slo.items()):
+            mark = "FIRING" if st.get("firing") else "ok"
+            out.append(
+                f"    {name:<24} {mark:<7} "
+                f"short={_fmt(st.get('burn_short'))} "
+                f"long={_fmt(st.get('burn_long'))} "
+                f"transitions={st.get('transitions', 0)}")
+    if workers:
+        out.append(f"  workers ({len(workers)}):")
+        for wid, row in sorted(workers.items())[:32]:
+            out.append(f"    {wid:<12} " + " ".join(
+                f"{k}={_fmt(v)}" for k, v in sorted(row.items())))
+        if len(workers) > 32:
+            out.append(f"    ... {len(workers) - 32} more")
+    return "\n".join(out)
+
+
+def render_artifact(report: dict) -> str:
+    """Offline view of a committed FLEET_r10-style artifact."""
+    out = [f"artifact: seed={report.get('seed')} "
+           f"workers={report.get('workers')} "
+           f"ok={report.get('ok')}"]
+    for phase in ("healthy", "storm", "recovered"):
+        snap = (report.get("rollup") or {}).get(phase)
+        if snap:
+            out.append(f"--- {phase} ---")
+            out.append(render_summary(snap, slo=(report.get("slo_states")
+                                                 or {}).get(phase)))
+    alerts = report.get("alerts") or []
+    if alerts:
+        out.append("alert timeline:")
+        for ev in alerts:
+            out.append(f"  t={_fmt(ev.get('ts'))} {ev.get('event'):>5} "
+                       f"{ev.get('slo')} burn_short="
+                       f"{_fmt(ev.get('burn_short'))} "
+                       f"burn_long={_fmt(ev.get('burn_long'))}")
+    ledger = report.get("ledger")
+    if ledger:
+        out.append(f"engine ledger: {ledger.get('samples')} samples "
+                   f"({ledger.get('jsonl')}), "
+                   f"pad_waste={_fmt(ledger.get('pad_waste_frac'), 3)}, "
+                   f"recompiles={ledger.get('recompiles')}")
+    contracts = report.get("contracts")
+    if contracts:
+        out.append("contracts: " + " ".join(
+            f"{k}={'PASS' if v else 'FAIL'}"
+            for k, v in sorted(contracts.items())))
+    return "\n".join(out)
+
+
+async def _live(args) -> int:
+    from dynamo_tpu.observability.fleet import FleetRollup
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    host, port = args.coordinator.rsplit(":", 1)
+    runtime = await DistributedRuntime.connect(host, int(port), "fleet-top")
+    ep = runtime.namespace(args.namespace).component(
+        args.component).endpoint(args.endpoint)
+    client = ep.client()
+    await client.start()
+    # the watch needs a beat to deliver the instance set — scraping
+    # before it lands renders an empty fleet and reads as an outage
+    try:
+        await client.wait_for_instances(timeout=5.0)
+    except Exception:
+        pass    # an actually-empty fleet still renders (as empty)
+    rollup = FleetRollup(client, interval_s=args.interval)
+    try:
+        while True:
+            await rollup.scrape_once()
+            workers = {}
+            for name in rollup.store.names("worker/"):
+                _, wid, field = name.split("/", 2)
+                if field in ("kv_active_blocks", "engine_tok_s",
+                             "num_requests_waiting"):
+                    workers.setdefault(wid, {})[field] = \
+                        rollup.store.get(name).latest()
+            print(render_summary(rollup.summary(), workers=workers),
+                  flush=True)
+            if not args.watch:
+                return 0
+            print("", flush=True)
+            await asyncio.sleep(args.interval)
+    finally:
+        await client.stop()
+        await runtime.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleet_top", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--coordinator", default="127.0.0.1:6230")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", default="worker")
+    ap.add_argument("--endpoint", default="generate")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--watch", action="store_true",
+                    help="keep rendering every --interval seconds")
+    ap.add_argument("--from-artifact", metavar="FLEET_JSON",
+                    help="render a committed fleet evidence artifact "
+                         "offline instead of scraping a live fleet")
+    args = ap.parse_args(argv)
+    if args.from_artifact:
+        with open(args.from_artifact) as f:
+            print(render_artifact(json.load(f)))
+        return 0
+    return asyncio.run(_live(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,7 +15,14 @@ dynamo_tpu/runtime/tracing.py), pick a trace and render
 Usage:
     python tools/trace_explain.py TRACE.jsonl [--trace-id ID]
     python tools/trace_explain.py TRACE.jsonl --list
+    python tools/trace_explain.py TRACE.jsonl --summary
     python tools/trace_explain.py TRACE.jsonl --chrome OUT.json
+
+--summary aggregates the WHOLE file per span name — count, total time,
+and p50/p95/p99 duration estimated through the bucketed Histogram
+quantile estimator (observability/metrics.py Histogram.quantile, the
+same estimator the SLO watchdog reads) — the cross-request view the
+per-trace tree cannot give.
 
 With no --trace-id the busiest non-scope trace is explained (scope:*
 pseudo-traces — engine phases, router storms — are aggregate context,
@@ -185,6 +192,46 @@ def explain(spans: List[dict], trace_id: str) -> str:
     return "\n".join(out)
 
 
+def summarize(spans: List[dict]) -> str:
+    """Whole-file per-span-name latency table: count, total ms, and
+    p50/p95/p99 from bucket counts (Histogram.quantile — the estimator
+    is exact at bucket boundaries; +Inf-bucket ranks report the largest
+    finite bound). Instants (dur <= 0) are counted but not timed."""
+    from dynamo_tpu.observability.metrics import Histogram
+
+    # span durations range from µs schedule decisions to multi-second
+    # storms: a wide log-ish ladder keeps the estimator honest
+    buckets = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+               0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+               float("inf"))
+    hist = Histogram("trace_span_seconds", "span durations", ("name",),
+                     buckets=buckets)
+    totals: Dict[str, float] = {}
+    instants: Dict[str, int] = {}
+    for s in spans:
+        name = s["name"]
+        if s.get("dur", 0.0) > 0.0:
+            hist.observe(name, value=s["dur"])
+            totals[name] = totals.get(name, 0.0) + s["dur"]
+        else:
+            instants[name] = instants.get(name, 0) + 1
+    out = [f"{len(spans)} span(s), "
+           f"{len(set(s['trace_id'] for s in spans))} trace(s)"]
+    out.append(f"  {'span':<28}{'count':>7}{'total ms':>11}"
+               f"{'p50 ms':>9}{'p95 ms':>9}{'p99 ms':>9}")
+    for name in sorted(totals, key=lambda n: -totals[n]):
+        n = hist.count(name)
+        p50, p95, p99 = (hist.quantile(q, name) * 1e3
+                         for q in (0.50, 0.95, 0.99))
+        out.append(f"  {name:<28}{n:>7}{totals[name] * 1e3:>11.2f}"
+                   f"{p50:>9.3f}{p95:>9.3f}{p99:>9.3f}")
+    for name in sorted(instants):
+        if name not in totals:
+            out.append(f"  {name:<28}{instants[name]:>7}"
+                       f"{'instant':>11}")
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="trace_explain", description=__doc__,
@@ -194,6 +241,9 @@ def main(argv=None) -> int:
                                        "(default: busiest request trace)")
     ap.add_argument("--list", action="store_true",
                     help="list trace ids with span counts and exit")
+    ap.add_argument("--summary", action="store_true",
+                    help="whole-file per-span-name latency table "
+                         "(p50/p95/p99 via Histogram.quantile) and exit")
     ap.add_argument("--chrome", metavar="OUT_JSON",
                     help="also write the whole file as a chrome://tracing "
                          "JSON (tools/artifacts.py policy)")
@@ -209,6 +259,9 @@ def main(argv=None) -> int:
             counts[s["trace_id"]] = counts.get(s["trace_id"], 0) + 1
         for tid, n in sorted(counts.items(), key=lambda kv: -kv[1]):
             print(f"{n:6d}  {tid}")
+        return 0
+    if args.summary:
+        print(summarize(spans))
         return 0
     if args.chrome:
         from dynamo_tpu.runtime.tracing import chrome_trace
