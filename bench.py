@@ -81,6 +81,7 @@ PHASE_STALL_S = {
     "decode_chunks": 120.0,  # refreshed per chunk
     "ttft": 150.0,
     "churn": 150.0,
+    "transfer_overlap": 300.0,   # two extra engine builds (disagg pair)
     "parity": 300.0,         # second engine build + single-step compiles
     "spec_ceiling": 600.0,   # spec-twin engine build + verify compile
 }
@@ -384,6 +385,33 @@ def supervise() -> int:
                 from tools.artifacts import append_jsonl
                 append_jsonl(traj, trajectory_row(best))
                 log(f"trajectory row -> {traj}")
+                # derived ratio rows (ISSUE 11 bench satellite): the
+                # disagg/aggregated TTFT ratio under early decode and
+                # the disagg decode gain, as their own gateable metrics
+                # — suffixed by model+platform so a tiny CPU validation
+                # row can never be scored against a TPU gate
+                run_id = os.environ.get(
+                    "BENCH_RUN_ID",
+                    time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+                suffix = "{}_{}".format(
+                    os.environ.get("BENCH_MODEL",
+                                   "llama3-1b").replace("-", "_"),
+                    "tpu" if probing else "cpu")
+                to = best["extras"].get("transfer_overlap") or {}
+                ratios = {
+                    f"disagg_agg_ttft_ratio_early_{suffix}":
+                        to.get("disagg_agg_ttft_ratio_early")
+                        if "failure" not in to else None,
+                    f"disagg_decode_gain_{suffix}":
+                        best["extras"].get("disagg_decode_gain"),
+                }
+                for metric, value in ratios.items():
+                    if value and value > 0:
+                        append_jsonl(traj, {
+                            "run_id": run_id, "metric": metric,
+                            "value": float(value), "unit": "ratio",
+                            "vs_baseline": None, "extras": {}})
+                        log(f"trajectory row [{metric}={value}] -> {traj}")
             except Exception as e:   # the one-JSON-line contract wins
                 log(f"trajectory append failed: {e}")
         if "BENCH_STATE" not in os.environ:
@@ -718,6 +746,174 @@ def run_kv_quant_ab(model_cfg, base_kwargs=None, *, seconds=10.0,
     del eng
     return {"capacity": capacity,
             "churn_int8_tok_s": round(tok_s, 1)}
+
+
+def run_transfer_overlap_ab(model_cfg, base_kwargs=None, *, requests=6,
+                            warm=2, n_chips=1, touch=lambda: None,
+                            logf=None):
+    """Disagg TTFT A/B for extras["transfer_overlap"] (ISSUE 11):
+
+    1. aggregated TTFT — the same decode worker prefills locally
+       (disagg router threshold lifted), the matched-load denominator;
+    2. disagg wait-for-final-chunk — early_decode off: TTFT pays
+       prefill + FULL transfer + completion notify;
+    3. disagg early-decode — the first token goes out the moment the
+       prefill samples it, decode gates on the committed frontier.
+
+    All three run on the SAME in-process stack (MemoryPlane control
+    plane, real KvTransferServer/RemoteTransferBackend over TCP
+    loopback, two engines sharing the backend) with distinct prompts
+    per request so the prefix cache can't fake a TTFT. Also folds in a
+    small seeded routing A/B (runtime/simcluster.py routing_ab —
+    prefix-only vs transfer-aware p99 over heterogeneous links; the
+    committed full-scale run is ROUTING_AB_r11.json). CPU validation
+    proves the plumbing and ratio direction; the TPU ladder item
+    (BENCH_SELF_r11_overlap) gives the hardware verdict."""
+    import asyncio
+
+    from dynamo_tpu.disagg import (
+        DisaggDecodeWorker, DisaggregatedRouter, KvTransferServer,
+        PrefillQueue, PrefillWorker, RemoteTransferBackend,
+    )
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.llm.worker import NativeEngineWorker
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    logf = logf or log
+    kw = dict(base_kwargs or PAGE_KWARGS)
+    pmod = min(1000, model_cfg.vocab_size - 2)
+    ps = kw["page_size"]
+    # several transfer chunks per request, bounded so two requests'
+    # admission-time allocations fit the page budget comfortably
+    prompt_len = max(2 * ps, min(4 * 128, (kw["num_pages"] // 4) * ps - ps))
+    max_tokens = 4
+
+    async def main():
+        plane = MemoryPlane()
+        queue = PrefillQueue(plane.messaging, "bench", "overlap")
+        drouter = DisaggregatedRouter(max_local_prefill_length=ps,
+                                      max_prefill_queue_size=64,
+                                      model="bench")
+        decode = DisaggDecodeWorker(
+            NativeEngine(model_cfg, EngineConfig(**kw), seed=0),
+            plane.messaging, drouter, queue, worker_id="bench-dec",
+            prefill_timeout_s=300.0)
+        touch()
+        server = await KvTransferServer(decode, "bench-dec").start()
+        await server.register(plane.kv)
+        transfer = RemoteTransferBackend(plane.kv, chunk_pages=2,
+                                         window_chunks=2)
+        prefill = PrefillWorker(
+            NativeEngineWorker(NativeEngine(model_cfg, EngineConfig(**kw),
+                                            seed=0)),
+            queue, transfer, plane.messaging)
+        touch()
+        await decode.start()
+        await prefill.start()
+        rid_n = [0]
+
+        async def one_ttft(tag):
+            rid_n[0] += 1
+            rid = f"ov-{tag}-{rid_n[0]}"
+            salt = 131 * rid_n[0] + sum(tag.encode())
+            pre = PreprocessedRequest(
+                request_id=rid,
+                token_ids=[(salt + 3 * j) % pmod + 1
+                           for j in range(prompt_len)],
+                stop=StopConditions(max_tokens=max_tokens,
+                                    ignore_eos=True))
+            t0 = time.perf_counter()
+            ttft = None
+            async for frame in decode.generate(
+                    pre.model_dump(exclude_none=True), Context(rid)):
+                if ttft is None and frame.get("token_ids"):
+                    ttft = time.perf_counter() - t0
+            touch()
+            return ttft
+
+        async def mode(tag):
+            for _ in range(warm):
+                await one_ttft(tag + "w")   # compiles out of the timing
+            vals = sorted([await one_ttft(tag) for _ in range(requests)])
+            return {"p50_ms": round(vals[len(vals) // 2] * 1e3, 2),
+                    "max_ms": round(vals[-1] * 1e3, 2),
+                    "mean_ms": round(sum(vals) / len(vals) * 1e3, 2)}
+
+        try:
+            saved = drouter.max_local_prefill_length
+            drouter.max_local_prefill_length = 1 << 30
+            agg = await mode("agg")        # local prefill: the denominator
+            drouter.max_local_prefill_length = saved
+            decode.early_decode = False
+            wait = await mode("wait")
+            decode.early_decode = True
+            early = await mode("early")
+            counters = {
+                "remote_prefills": decode.remote_prefills,
+                "early_first_emits": decode.early_first_emits,
+                "overlap_activations":
+                    decode.engine.scheduler.overlap_activations,
+                "overlap_fallbacks": decode.overlap_fallbacks,
+            }
+        finally:
+            await prefill.stop()
+            await decode.stop()
+            await transfer.close()
+            await server.stop()
+        return agg, wait, early, counters
+
+    agg, wait, early, counters = asyncio.run(main())
+    result = {
+        "prompt_len": prompt_len, "requests": requests,
+        "agg_ttft": agg,
+        "disagg_wait_ttft": wait,
+        "disagg_early_ttft": early,
+        "disagg_agg_ttft_ratio_wait":
+            round(wait["p50_ms"] / max(agg["p50_ms"], 1e-9), 3),
+        "disagg_agg_ttft_ratio_early":
+            round(early["p50_ms"] / max(agg["p50_ms"], 1e-9), 3),
+        "early_vs_wait_ttft_gain":
+            round(1.0 - early["p50_ms"] / max(wait["p50_ms"], 1e-9), 3),
+        **counters,
+    }
+    logf(f"transfer overlap TTFT p50: agg {agg['p50_ms']}ms, disagg-wait "
+         f"{wait['p50_ms']}ms ({result['disagg_agg_ttft_ratio_wait']}x), "
+         f"disagg-early {early['p50_ms']}ms "
+         f"({result['disagg_agg_ttft_ratio_early']}x agg; "
+         f"{result['early_vs_wait_ttft_gain'] * 100:.0f}% vs wait)")
+    touch()
+    # seeded routing A/B at smoke scale (the full-scale committed run
+    # is ROUTING_AB_r11.json via tools/routing_ab.py)
+    try:
+        from dynamo_tpu.runtime.simcluster import SimCluster, SimConfig
+
+        async def ab():
+            sim = await SimCluster(SimConfig(workers=48, streams=256,
+                                             seed=11)).start()
+            try:
+                return await sim.routing_ab(requests=800)
+            finally:
+                await sim.stop()
+
+        rab = asyncio.run(ab())
+        result["routing_ab"] = {
+            "prefix_only_p99_ms": rab["prefix_only"]["ttft_p99_ms"],
+            "transfer_aware_p99_ms": rab["transfer_aware"]["ttft_p99_ms"],
+            "p99_improvement": rab["p99_improvement"],
+        }
+        logf(f"routing A/B (48 workers, seeded): p99 "
+             f"{rab['prefix_only']['ttft_p99_ms']}ms -> "
+             f"{rab['transfer_aware']['ttft_p99_ms']}ms "
+             f"({rab['p99_improvement'] * 100:.1f}% better)")
+    except Exception as e:   # the TTFT A/B evidence stands on its own
+        result["routing_ab"] = {"failure": f"{type(e).__name__}: {e}"}
+    touch()
+    return result
 
 
 def run_parity(model_cfg, engine_box=None, touch=lambda: None, logf=None):
@@ -1157,6 +1353,21 @@ def worker():
         f"{churn_alt['tok_s']:.1f}) vs pure decode {pure:.1f}; "
         f"decode-side disagg gain bound "
         f"{pure / max(agg_tok_s, 1e-9):.2f}x")
+
+    if os.environ.get("BENCH_OVERLAP", "1") != "0" \
+            and time.time() - T0 < BUDGET_S - 180:
+        st.set_phase("transfer_overlap")
+        log("phase: disagg TTFT A/B — wait-for-final-chunk vs early-decode"
+            " overlap, + router prefix-only vs transfer-aware (ISSUE 11)")
+        try:
+            st.result["extras"]["transfer_overlap"] = \
+                run_transfer_overlap_ab(model_cfg, PAGE_KWARGS,
+                                        n_chips=n_chips, touch=st.touch,
+                                        logf=log)
+        except Exception as e:  # evidence phase must not kill the capture
+            log(f"transfer overlap A/B failed ({type(e).__name__}: {e})")
+            st.result["extras"]["transfer_overlap"] = {"failure": str(e)}
+        st.touch()
 
     if os.environ.get("BENCH_KVQ", "1") != "0" \
             and time.time() - T0 < BUDGET_S - 180:

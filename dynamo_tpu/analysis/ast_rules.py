@@ -72,6 +72,15 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       literal fragments); an undocumented family is invisible to the
       runbooks and exempt from the catalog completeness test — escape
       hatch `# dynalint: metric-doc-ok=<reason>`
+- R16 transfer-cost fallback contract (dynamo_tpu/ + tools/): any
+      consumer of the TransferCostModel's scalar queries
+      (`estimate_s(...)`, `bandwidth_bytes_per_s(...)`, or a
+      `.estimate(...)` on a cost-model receiver) must visibly handle
+      the no-data branch — the enclosing function references the
+      cold/measured/frozen/degraded/default/median vocabulary — or
+      carry `# dynalint: cost-fallback-ok=<reason>`. A cold or
+      degraded-stale estimate silently treated as a measurement is
+      exactly how a router over-commits to an unmeasured link
 """
 from __future__ import annotations
 
@@ -1173,6 +1182,88 @@ def r15_metric_registration_contract(tree: ast.AST, lines: List[str],
                 "add the family to the catalog table in "
                 "docs/OBSERVABILITY.md (with its surface), or annotate "
                 "with `# dynalint: metric-doc-ok=<reason>`"))
+    return out
+
+
+# -- R16: transfer-cost estimates must handle the no-data branch --------------
+
+# Scope: the dynamo_tpu package and tools/ (the serving path and the
+# diagnosis tooling both consume TransferCostModel). The model's scalar
+# queries (`estimate_s`, `bandwidth_bytes_per_s`) and its structured
+# `estimate()` (matched only on cost/model receivers, to avoid generic
+# `estimate` methods elsewhere) silently answer from a PRIOR when the
+# link has no measured EWMA — the fleet-median fallback — and from a
+# FROZEN value under the router's stale-snapshot degraded mode. A
+# consumer that can't tell prior from measurement over-commits to
+# unmeasured links, so the rule demands the enclosing function visibly
+# engage the fallback vocabulary (cold/measured/frozen/degraded/
+# default/median/fallback — a `.cold` branch, a `measured()` check, a
+# freeze flag, a documented default) or carry
+# `# dynalint: cost-fallback-ok=<reason>` within three lines above.
+_R16_SCOPE = ("dynamo_tpu/", "tools/")
+_R16_SCALARS = {"estimate_s", "bandwidth_bytes_per_s"}
+_R16_ANNOT_RE = re.compile(r"#\s*dynalint:\s*cost-fallback-ok=\S+")
+_R16_HANDLED_RE = re.compile(
+    r"cold|measured|frozen|degraded|default|median|fallback", re.I)
+
+
+@rule("R16")
+def r16_cost_fallback_contract(tree: ast.AST, lines: List[str],
+                               path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R16_SCOPE) \
+            or "tests/" in norm:
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R16_ANNOT_RE.search(_line(lines, x))
+                   for x in range(ln - 3, ln + 1))
+
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def enclosing_handles(ln: int) -> bool:
+        inner = None
+        for fn in funcs:
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= ln <= end and (
+                    inner is None or fn.lineno >= inner.lineno):
+                inner = fn
+        if inner is None:
+            # module-level consumer: scan a window around the call
+            lo, hi = max(1, ln - 10), min(len(lines), ln + 10)
+        else:
+            lo, hi = inner.lineno, getattr(inner, "end_lineno",
+                                           inner.lineno)
+        return any(_R16_HANDLED_RE.search(_line(lines, x))
+                   for x in range(lo, hi + 1))
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        terminal = name.rsplit(".", 1)[-1]
+        if terminal in _R16_SCALARS:
+            pass
+        elif terminal == "estimate" and (
+                "model" in name.lower() or "cost" in name.lower()):
+            pass
+        else:
+            continue
+        if annotated(node.lineno) or enclosing_handles(node.lineno):
+            continue
+        out.append(_finding(
+            "R16", path, lines, node,
+            f"`{name}(...)` consumes a transfer-cost estimate without "
+            "handling the no-data branch — a cold link answers from the "
+            "fleet-median PRIOR and a degraded router answers from a "
+            "FROZEN value; treating either as a measurement over-commits "
+            "traffic onto links nobody has measured",
+            "branch on the estimate's `cold` flag (or `.measured()`/"
+            "the selector's freeze state), document the default, or "
+            "annotate with `# dynalint: cost-fallback-ok=<why the "
+            "fallback is safe here>`"))
     return out
 
 

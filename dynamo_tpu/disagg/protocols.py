@@ -61,12 +61,27 @@ class RemotePrefillRequest(pydantic.BaseModel):
 
 
 class PrefillCompletion(pydantic.BaseModel):
-    """Published on `completion_subject(engine_id)` after the KV pages have
-    been injected into the decode engine."""
+    """Published on `completion_subject(engine_id)` — after the KV pages
+    have been injected into the decode engine, OR (early-decode overlap,
+    docs/PERF.md) as soon as the prefill sampled its first token, with
+    `transfer_pending=True` while the chunk-committed transfer is still
+    streaming. The decode side emits the first token immediately (TTFT
+    no longer pays the transfer) and gates decode activation on its own
+    committed-frontier watermark; a second, final completion follows on
+    success, and the usual `error` completion on failure."""
 
     request_id: str
     first_token: Optional[int] = None   # sampled by the prefill engine
     error: Optional[str] = None
+    # early notify: the KV transfer has not finished yet — the decode
+    # worker must gate decode on its local committed frontier, not on
+    # this message. A completion without the flag means the transfer
+    # (and inject) fully landed, exactly the pre-overlap contract.
+    transfer_pending: bool = False
+    # transfer-list length (pages actually shipped, prefix-cache hits
+    # excluded): the decode side's gate target, cross-checked against
+    # its own allocation
+    total_pages: int = 0
 
 
 class PrefillCancel(pydantic.BaseModel):

@@ -336,6 +336,14 @@ class KvTransferServer:
         sess.committed_pages = max(sess.committed_pages,
                                    base + len(page_ids))
         sess.committed_chunks.add(chunk_idx)
+        # early-decode overlap: the step loop's committed-frontier gate
+        # (scheduler.poll_overlap_gates) must see this advance NOW — the
+        # final chunk's commit is the gate-opening event, and without a
+        # wake the loop could idle up to its poll timeout before planning
+        # the first decode window
+        wake = getattr(self.worker, "_wake", None)
+        if wake is not None:
+            wake.set()
         return {"ok": True, "chunk_idx": chunk_idx, "dup": False,
                 "committed": sess.committed_pages}
 
@@ -528,34 +536,60 @@ class RemoteTransferBackend(TransferBackend):
         # the same trace
         t0 = time.monotonic()
         deadline = t0 + budget_s if budget_s is not None else None
+        from dynamo_tpu.observability.fleet import TRANSFER_MODEL
+        # pre-send estimate (the router's view of this transfer) rides
+        # the span so committed trace artifacts carry estimated-vs-
+        # actual per link (tools/trace_explain.py --summary); `cold`
+        # marks the no-EWMA fleet-median fallback branch
+        est_bytes = self._payload_bytes(k_pages, v_pages, k_scale, n)
+        est = TRANSFER_MODEL.estimate(engine_id, est_bytes)
         span = TRACER.begin_span("kv.transfer", trace,
                                  request_id=request_id, pages=n,
-                                 backend="remote", engine_id=engine_id)
+                                 backend="remote", engine_id=engine_id,
+                                 est_s=round(est.seconds, 6),
+                                 est_cold=est.cold)
         failed = True
-        bytes_before = XFER_STATS.bytes_sent
+        # per-transfer UNIQUE payload accounting (chunk_idx -> bytes):
+        # resumes re-send unacked chunks, but a chunk counts ONCE toward
+        # delivered goodput — re-sent bytes fold into the EWMA through
+        # the elapsed time only, so a lossy link estimates at its real
+        # delivery rate, not its raw wire speed
+        unique_bytes: Dict[int, int] = {}
+        TRANSFER_MODEL.note_inflight(engine_id, est_bytes)
         try:
             await self._send_pages_locked(engine_id, request_id, ids,
                                           k_pages, v_pages, k_scale,
                                           v_scale, trace, span,
-                                          alloc_epoch, deadline)
+                                          alloc_epoch, deadline,
+                                          unique_bytes)
             failed = False
         finally:
+            TRANSFER_MODEL.note_done(engine_id, est_bytes)
             TRACER.end_span(span, error=failed)
             dt = time.monotonic() - t0
             SERVING.kv_transfer.observe(value=dt)
             if not failed:
-                # per-link delivered-goodput sample (bytes actually
-                # shipped this send, incl. resume/refetch overhead in
-                # the denominator) — the TransferCostModel bandwidth
-                # EWMA the transfer-aware router scoring consumes
-                from dynamo_tpu.observability.fleet import TRANSFER_MODEL
+                # per-link delivered-goodput sample — the
+                # TransferCostModel bandwidth EWMA the transfer-aware
+                # router scoring consumes
                 TRANSFER_MODEL.observe(
-                    engine_id, XFER_STATS.bytes_sent - bytes_before, dt)
+                    engine_id, sum(unique_bytes.values()), dt)
+
+    @staticmethod
+    def _payload_bytes(k_pages, v_pages, k_scale, n: int) -> int:
+        """Approximate unique payload bytes of shipping `n` pages of
+        this stack (k+v+scales), for the pre-send estimate and the
+        in-flight backlog term; the exact figure lands per chunk."""
+        nb = max(1, k_pages.shape[2])
+        per_page = (k_pages.nbytes + v_pages.nbytes) / nb
+        if k_scale is not None:
+            per_page += 2 * k_scale.nbytes / nb
+        return int(per_page * n)
 
     async def _send_pages_locked(self, engine_id: str, request_id: str, ids,
                                  k_pages, v_pages, k_scale, v_scale,
                                  trace, span, alloc_epoch,
-                                 deadline) -> None:
+                                 deadline, unique_bytes=None) -> None:
         lock = self._locks.setdefault(engine_id, asyncio.Lock())
         async with lock:
             refetches = 0
@@ -564,7 +598,8 @@ class RemoteTransferBackend(TransferBackend):
                 try:
                     sent = await self._send_chunks(
                         engine_id, request_id, ids, k_pages, v_pages,
-                        k_scale, v_scale, trace, alloc_epoch, deadline)
+                        k_scale, v_scale, trace, alloc_epoch, deadline,
+                        unique_bytes)
                     if span is not None:
                         span.set(bytes=sent, refetches=refetches,
                                  resumes=resumes)
@@ -678,7 +713,7 @@ class RemoteTransferBackend(TransferBackend):
     async def _send_chunks(self, engine_id: str, request_id: str, ids,
                            k_pages, v_pages, k_scale=None,
                            v_scale=None, trace=None, alloc_epoch: int = 0,
-                           deadline=None) -> int:
+                           deadline=None, unique_bytes=None) -> int:
         """Windowed chunk-committed pipelining: up to window_chunks frames
         are in flight before the oldest ack is awaited, so device→host
         staging, the wire, and the decode-side inject all overlap (the
@@ -768,6 +803,11 @@ class RemoteTransferBackend(TransferBackend):
                 csp.set(bytes=payload)
             XFER_STATS.bytes_sent += payload
             XFER_STATS.pages_sent += count
+            if unique_bytes is not None:
+                # idempotent per chunk index: a re-sent chunk (resume
+                # after a link cut) never double-counts toward the
+                # delivered-goodput sample
+                unique_bytes[chunk_idx] = payload
             total_bytes += payload
             in_flight.append(count)
             if len(in_flight) >= self.window_chunks:

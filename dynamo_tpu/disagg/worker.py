@@ -43,7 +43,8 @@ class DisaggDecodeWorker(NativeEngineWorker):
     def __init__(self, engine, messaging, disagg_router: DisaggregatedRouter,
                  prefill_queue: PrefillQueue, component=None,
                  worker_id: str = "", prefill_timeout_s: float = 120.0,
-                 mm_transfer: str = "pixels", **kwargs):
+                 mm_transfer: str = "pixels", early_decode: bool = True,
+                 **kwargs):
         super().__init__(engine, component=component, worker_id=worker_id,
                          **kwargs)
         self.messaging = messaging
@@ -51,6 +52,15 @@ class DisaggDecodeWorker(NativeEngineWorker):
         self.prefill_queue = prefill_queue
         self.engine_id = worker_id or f"decode-{id(self):x}"
         self.prefill_timeout_s = prefill_timeout_s
+        # early-decode overlap (FlowKV-style, docs/PERF.md): consume the
+        # prefill side's transfer_pending notify — emit the first token
+        # the moment the prefill sampled it (TTFT stops paying the KV
+        # transfer) and gate decode activation on this worker's OWN
+        # committed-frontier watermark instead of stream completion.
+        # Requires an attached KvTransferServer (the chunk-committed
+        # streaming path); with the one-shot local backend the early
+        # notify is ignored and the final completion drives activation.
+        self.early_decode = early_decode
         # multimodal payload on the prefill queue: "pixels" re-encodes on
         # the prefill side (no decode-side state shipped); "embeds"
         # forwards this worker's vision-tower output + content salts, so
@@ -76,6 +86,11 @@ class DisaggDecodeWorker(NativeEngineWorker):
         self.salvaged_prefills = 0
         self.full_reprefills = 0
         self.majority_committed_full_reprefills = 0
+        # early-decode overlap disposition: first tokens emitted while
+        # the transfer was still streaming, and overlap attempts that
+        # fell back (gate failed before activation)
+        self.early_first_emits = 0
+        self.overlap_fallbacks = 0
         # set by KvTransferServer when one is attached to this worker;
         # the salvage path reads the committed frontier through it
         self.kv_transfer_server = getattr(self, "kv_transfer_server", None)
@@ -105,6 +120,15 @@ class DisaggDecodeWorker(NativeEngineWorker):
             except Exception:  # dynalint: swallow-ok=malformed-peer-frame-logged
                 log.exception("malformed prefill completion: %r",
                               payload[:200])
+                continue
+            if done.transfer_pending and not (
+                    self.early_decode
+                    and self.kv_transfer_server is not None):
+                # wait-for-completion mode (overlap off, or no chunk-
+                # committed transfer server to gate on): only the final
+                # or error completion may resolve the wait — activating
+                # on an early notify would decode against pages that
+                # haven't landed
                 continue
             fut = self._completions.pop(done.request_id, None)
             if fut is not None and not fut.done():
@@ -259,6 +283,24 @@ class DisaggDecodeWorker(NativeEngineWorker):
                         exclude_none=True)
                 return
             completion = fut.result() if fut.done() else None
+            if completion is not None and completion.error is None \
+                    and completion.transfer_pending \
+                    and completion.first_token is not None:
+                # early-decode overlap (docs/PERF.md): the prefill side
+                # sampled the first token and the KV transfer is still
+                # streaming — the notify loop only lets this through
+                # when overlap is on AND a chunk-committed transfer
+                # server is attached. `hold` carries the allocation-
+                # ownership flag back out (a generator can't assign the
+                # caller's local).
+                hold = [True]
+                try:
+                    async for frame in self._generate_overlapped(
+                            pre, req, context, alloc, completion, hold):
+                        yield frame
+                finally:
+                    holding = hold[0]
+                return
             if completion is None or completion.error:
                 if completion is None:
                     # the prefill is still queued or running somewhere we
@@ -374,6 +416,187 @@ class DisaggDecodeWorker(NativeEngineWorker):
                 self._pending_aborts.append(rid)
                 self._wake.set()
 
+    async def _overlap_wait(self, rid: str, context: Context,
+                            q: asyncio.Queue):
+        """Wait for the first decode frame of an overlap-activated
+        request, a failure notify, a client stop, or the prefill
+        timeout — whichever lands first. Returns ("frame", EngineOutput)
+        | ("stopped", None) | ("error", PrefillCompletion-or-None).
+        Duplicate success notifies (a replacement sender re-running the
+        prefill after a re-lease, or the final completion of a transfer
+        whose gate is about to open) are absorbed, not failures."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.prefill_timeout_s
+        stop_task = asyncio.create_task(context.wait_stopped())
+        get = asyncio.create_task(q.get())
+        try:
+            while True:
+                err_fut: asyncio.Future = loop.create_future()
+                self._completions[rid] = err_fut
+                timeout = deadline - loop.time()
+                if timeout <= 0:
+                    return ("error", None)
+                done, _ = await asyncio.wait(
+                    {get, err_fut, stop_task}, timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if get in done:
+                    return ("frame", get.result())
+                if stop_task in done:
+                    return ("stopped", None)
+                if err_fut in done:
+                    comp = err_fut.result()
+                    if comp.error:
+                        return ("error", comp)
+                    continue    # duplicate/final success notify: keep waiting
+                return ("error", None)   # timeout
+        finally:
+            self._completions.pop(rid, None)
+            if not get.done():
+                get.cancel()
+            stop_task.cancel()
+
+    async def _generate_overlapped(self, pre: PreprocessedRequest,
+                                   req: EngineRequest, context: Context,
+                                   alloc, completion, hold):
+        """Early-decode overlap: emit the already-sampled first token
+        NOW (TTFT stops paying the transfer), arm the scheduler's
+        committed-frontier gate, and hand off to the normal decode
+        stream once it opens. Failure before the gate opens falls into
+        the same salvage-vs-re-prefill decision table as the
+        non-overlapped path (docs/RESILIENCE.md) — with the emitted
+        first token charged through the committed-prefix resume
+        machinery, never re-emitted."""
+        rid = req.request_id
+        first = int(completion.first_token)
+        p = req.params
+        ps = self.engine.cfg.page_size
+        hidden_stop = first in p.stop_token_ids
+        eos = (not p.ignore_eos) and first in self.engine.eos_token_ids
+        if hidden_stop or eos or p.max_tokens <= 1:
+            # no decode will ever run: settle now — the still-streaming
+            # sender's remaining chunks fail safely on the
+            # scheduler.remote guard (same as a decode-side timeout)
+            reason = (FinishReason.STOP if (hidden_stop or eos)
+                      else FinishReason.LENGTH)
+            await self.submit(lambda eng: eng.release_remote(rid))
+            hold[0] = False
+            if not (hidden_stop or eos):
+                TRACER.event("decode.emit", context.trace, n=1,
+                             first=True, early=True)
+                yield EngineOutput(token_ids=[first]).model_dump(
+                    exclude_none=True)
+            yield EngineOutput(finish_reason=reason).model_dump(
+                exclude_none=True)
+            return
+        # TTFT stops HERE, while the KV tail is still in flight (the
+        # span-ordering test pins this decode.emit before the
+        # kv.transfer span's end)
+        self.early_first_emits += 1
+        TRACER.event("decode.emit", context.trace, n=1, first=True,
+                     early=True)
+        yield EngineOutput(token_ids=[first]).model_dump(exclude_none=True)
+        srv = self.kv_transfer_server
+        epoch = alloc.alloc_epoch
+        start_page = alloc.num_cached_tokens // ps
+        needed = len(alloc.page_ids) - start_page
+        q = self._register(rid)
+        try:
+            await self.submit(lambda eng: eng.preactivate_remote(
+                rid, first, needed,
+                lambda: srv.committed_frontier(rid, epoch)))
+            kind, val = await self._overlap_wait(rid, context, q)
+            if kind == "frame":
+                frame: EngineOutput = val
+                if frame.token_ids:
+                    TRACER.event("decode.emit", context.trace,
+                                 n=len(frame.token_ids))
+                yield frame.model_dump(exclude_none=True)
+                if frame.finish_reason is not None:
+                    hold[0] = False   # the engine already finished it
+                    return
+                async for f2 in self._stream(rid, context, q):
+                    yield f2
+                hold[0] = False
+                return
+            if kind == "stopped":
+                # client went away mid-overlap: tell the prefill fleet;
+                # the caller's finally stages the abort, which drops
+                # the gate + allocation through release_remote
+                await self._broadcast_cancel(rid)
+                yield EngineOutput(
+                    finish_reason=FinishReason.CANCELLED).model_dump(
+                        exclude_none=True)
+                return
+            # transfer failed or timed out before any decode frame:
+            # disarm the gate — unless activation raced the failure, in
+            # which case decode owns the request and the notify was
+            # stale (a superseded sender's error after the replacement
+            # already finished the stream)
+            still_gated = await self.submit(
+                lambda eng: eng.cancel_overlap(rid))
+            if not still_gated:
+                async for f2 in self._stream(rid, context, q):
+                    yield f2
+                hold[0] = False
+                return
+            failure = val   # PrefillCompletion with error, or None (timeout)
+            self.overlap_fallbacks += 1
+            if failure is None:
+                # still queued or running somewhere we no longer care
+                # about: cancel on every abandoning exit
+                await self._broadcast_cancel(rid)
+            if context.deadline_expired:
+                await self.submit(lambda eng: eng.release_remote(rid))
+                hold[0] = False
+                yield EngineOutput(
+                    finish_reason=FinishReason.ERROR,
+                    text="deadline exceeded during remote prefill",
+                ).model_dump(exclude_none=True)
+                return
+            frontier = self._committed_frontier(rid, epoch)
+            if frontier > 0:
+                log.warning(
+                    "remote prefill failed for %s mid-overlap (%s); "
+                    "salvaging %d committed page(s), re-prefilling the "
+                    "tail locally (first token already emitted)", rid,
+                    failure.error if failure else "timeout", frontier)
+                self.salvaged_prefills += 1
+                XFER_STATS.salvaged_pages += frontier
+                salvaged = await self.submit(
+                    lambda eng: eng.salvage_remote(
+                        rid, start_page + frontier, first_token=first))
+                TRACER.event("kv.salvage", context.trace, request_id=rid,
+                             pages=frontier, tokens=salvaged)
+                async for f2 in self._stream(rid, context, q):
+                    yield f2
+                hold[0] = False
+                return
+            # nothing committed: full local re-prefill through the
+            # committed-prefix resume machinery — token_ids carries the
+            # emitted first token, resume_committed charges it against
+            # the original budgets, and the stream continues from
+            # token 2 (exactly the mid-stream migration contract)
+            log.warning(
+                "remote prefill failed for %s mid-overlap (%s); full "
+                "local fallback (nothing committed)", rid,
+                failure.error if failure else "timeout")
+            self.full_reprefills += 1
+            if needed > 0 and frontier >= 0.5 * needed:
+                # structural tripwire (see the non-overlap twin above)
+                self.majority_committed_full_reprefills += 1
+            await self.submit(lambda eng: eng.release_remote(rid))
+            hold[0] = False
+            self.local_prefills += 1
+            fb = pre.model_copy(update={
+                "token_ids": list(pre.token_ids) + [first],
+                "resume_committed": 1 + (pre.resume_committed or 0)})
+            self._queues.pop(rid, None)   # super().generate re-registers
+            async for f2 in super().generate(
+                    fb.model_dump(exclude_none=True), context):
+                yield f2
+        finally:
+            self._queues.pop(rid, None)
+
     def stats_handler(self) -> dict:
         stats = super().stats_handler()
         stats["disagg"] = {
@@ -383,6 +606,10 @@ class DisaggDecodeWorker(NativeEngineWorker):
             "full_reprefills": self.full_reprefills,
             "majority_committed_full_reprefills":
                 self.majority_committed_full_reprefills,
+            "early_first_emits": self.early_first_emits,
+            "overlap_fallbacks": self.overlap_fallbacks,
+            "overlap_activations":
+                self.engine.scheduler.overlap_activations,
         }
         return stats
 
@@ -408,13 +635,20 @@ class PrefillWorker:
     def __init__(self, worker: NativeEngineWorker, queue: PrefillQueue,
                  transfer: TransferBackend, messaging,
                  dequeue_timeout_s: float = 1.0, max_inflight: int = 4,
-                 lease_s: float = 60.0):
+                 lease_s: float = 60.0, early_notify: bool = True):
         self.worker = worker
         self.queue = queue
         self.transfer = transfer
         self.messaging = messaging
         self.dequeue_timeout_s = dequeue_timeout_s
         self.lease_s = lease_s
+        # early-decode overlap: publish a transfer_pending completion
+        # the moment the prefill samples its first token — BEFORE the
+        # KV transfer — so the decode side can emit it immediately and
+        # gate decode on its own committed frontier. Decode workers in
+        # wait-for-completion mode ignore the early notify, so this is
+        # always safe to leave on.
+        self.early_notify = early_notify
         # cap concurrent handlers so excess work stays in the durable queue,
         # where queue_depth() feeds the disagg routers' backpressure signal
         self._slots = asyncio.Semaphore(max_inflight)
@@ -605,6 +839,17 @@ class PrefillWorker:
             first_token = frame.token_ids[0]
             # ship only the pages the decode side doesn't already have
             start_page = req.num_cached_tokens // eng_ps
+            if self.early_notify:
+                # early-decode overlap: the first token exists NOW — the
+                # entire transfer below no longer sits on the client's
+                # TTFT. The final completion (or the error notify in the
+                # except arm) still follows; the decode side gates
+                # decode activation on its own committed frontier either
+                # way, so a lost early notify costs nothing.
+                await self._notify(req, PrefillCompletion(
+                    request_id=rid, first_token=first_token,
+                    transfer_pending=True,
+                    total_pages=len(req.page_ids) - start_page))
             def extract(eng):
                 seq = eng.scheduler.parked[rid]
                 return eng.extract_pages(seq.pages[start_page:])

@@ -552,6 +552,12 @@ class NativeEngine:
 
     def has_work(self) -> bool:
         s = self.scheduler
+        if s.overlap_gates:
+            # early-decode overlap (docs/PERF.md): promote any gated
+            # remote sequence whose committed frontier now covers its
+            # transfer list — the watermark check runs HERE, before
+            # planning, on the same thread that applies injects
+            s.poll_overlap_gates()
         return (self._pipeline is not None or bool(s.waiting)
                 or any(x is not None for x in s.running))
 
@@ -1563,17 +1569,32 @@ class NativeEngine:
     def activate_remote(self, request_id: str, first_token: int) -> None:
         self.scheduler.activate_remote(request_id, first_token)
 
+    def preactivate_remote(self, request_id: str, first_token: int,
+                           needed_pages: int, frontier_fn) -> None:
+        """Decode side, early-decode overlap: arm a committed-frontier
+        gate so the sequence activates the moment every transferred
+        page is verified + injected, instead of waiting for stream
+        completion + the notify round trip (docs/PERF.md)."""
+        self.scheduler.preactivate_remote(request_id, first_token,
+                                          needed_pages, frontier_fn)
+
+    def cancel_overlap(self, request_id: str) -> bool:
+        return self.scheduler.cancel_overlap(request_id)
+
     def release_remote(self, request_id: str) -> None:
         self.scheduler.release_remote(request_id)
 
-    def salvage_remote(self, request_id: str, valid_pages: int) -> int:
+    def salvage_remote(self, request_id: str, valid_pages: int,
+                       first_token=None) -> int:
         """Decode side: the remote prefill is unrecoverable but the
         streamed transfer COMMITTED a prefix (verified + injected +
         acked chunks). Keep those pages and re-prefill locally only
         from the committed page boundary — the disagg twin of the
-        migration path's committed-prefix re-dispatch. Returns the
-        salvaged token count."""
-        return self.scheduler.salvage_remote(request_id, valid_pages)
+        migration path's committed-prefix re-dispatch. `first_token`
+        seeds the already-emitted first output token on the early-
+        decode overlap path. Returns the salvaged token count."""
+        return self.scheduler.salvage_remote(request_id, valid_pages,
+                                             first_token=first_token)
 
     def release_parked(self, request_id: str) -> None:
         self.scheduler.release_parked(request_id)

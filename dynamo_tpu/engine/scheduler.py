@@ -317,6 +317,13 @@ class Scheduler:
         # their KV is pulled by the transfer engine
         self.remote: Dict[str, SequenceState] = {}
         self.parked: Dict[str, SequenceState] = {}
+        # early-decode overlap gates (FlowKV-style, docs/PERF.md): rid ->
+        # (first_token, needed_pages, frontier_fn). The sequence STAYS in
+        # self.remote (chunk injects + alloc-epoch fencing still see it);
+        # poll_overlap_gates() promotes it into the normal waiting flow
+        # the moment every page its first window reads is committed.
+        self.overlap_gates: Dict[str, tuple] = {}
+        self.overlap_activations = 0
         ps = cfg.page_size
         self.prefill_buckets = list(cfg.prefill_buckets)
         max_pages_per_seq = -(-cfg.max_model_len // ps)
@@ -431,6 +438,7 @@ class Scheduler:
         generated token and enter the normal scheduling flow (a 1-token
         prefill chunk writes that token's KV, then the seq takes a decode
         slot)."""
+        self.overlap_gates.pop(request_id, None)
         seq = self.remote.pop(request_id)
         n = len(seq.prompt)
         seq.num_cached = n
@@ -442,11 +450,63 @@ class Scheduler:
 
     def release_remote(self, request_id: str) -> None:
         """Abort a pending remote allocation (prefill failed / client gone)."""
+        self.overlap_gates.pop(request_id, None)
         seq = self.remote.pop(request_id, None)
         if seq is not None:
             self.finish(seq)
 
-    def salvage_remote(self, request_id: str, valid_pages: int) -> int:
+    # -- early decode over the committed frontier (FlowKV overlap) ----------
+
+    def preactivate_remote(self, request_id: str, first_token: int,
+                           needed_pages: int, frontier_fn) -> None:
+        """Arm an early-decode gate: the remote prefill's first token is
+        already known (the prefill side samples it BEFORE the KV
+        transfer starts), so the sequence can enter decode as soon as
+        the pages its first window reads — every transferred page, since
+        decode attention spans the whole prompt — are committed
+        (verified + injected) by the transfer server, instead of waiting
+        for stream completion + the completion notify round trip.
+
+        `frontier_fn()` returns the transfer's committed-page frontier
+        (KvTransferServer.committed_frontier for this exact alloc
+        epoch); `needed_pages` is the transfer-list length. The seq
+        stays in self.remote until the gate opens, so in-flight chunks
+        keep injecting, stale-epoch fencing is unchanged, and a
+        transfer failure before the gate opens falls into exactly the
+        salvage/fallback paths a non-overlapped request has."""
+        if request_id not in self.remote:
+            raise KeyError(f"request {request_id!r} not pending remote")
+        self.overlap_gates[request_id] = (int(first_token),
+                                          max(0, needed_pages), frontier_fn)
+
+    def cancel_overlap(self, request_id: str) -> bool:
+        """Disarm a pending gate. True when the gate was still pending
+        (the seq never activated — the caller owns salvage/fallback);
+        False when the gate already opened (decode is rolling and the
+        normal streaming path owns the request)."""
+        return self.overlap_gates.pop(request_id, None) is not None
+
+    def poll_overlap_gates(self) -> int:
+        """Promote every gated sequence whose committed frontier covers
+        its transfer list; returns how many activated. Called before
+        planning (engine.has_work) — the per-request committed-frontier
+        watermark check that lets decode start while the final chunk's
+        ack/notify round trip is still in flight."""
+        activated = 0
+        for rid in list(self.overlap_gates):
+            first_token, needed, frontier_fn = self.overlap_gates[rid]
+            if rid not in self.remote:
+                del self.overlap_gates[rid]
+                continue
+            if frontier_fn() >= needed:
+                del self.overlap_gates[rid]
+                self.activate_remote(rid, first_token)
+                self.overlap_activations += 1
+                activated += 1
+        return activated
+
+    def salvage_remote(self, request_id: str, valid_pages: int,
+                       first_token: Optional[int] = None) -> int:
         """Unrecoverable remote prefill after a PARTIAL transfer: re-enter
         the normal prefill flow keeping the committed prefix (the disagg
         twin of the migration path's committed-prefix re-dispatch).
@@ -460,8 +520,16 @@ class Scheduler:
         so the local prefill samples the first output itself (there is
         no PrefillCompletion.first_token on this path).
 
+        `first_token` is the early-decode overlap variant (the prefill
+        side's first token was ALREADY emitted to the client before the
+        transfer died): it is seeded as output[0], the re-prefill covers
+        the uncommitted prompt tail plus that token's position, and the
+        sampler's next draw is token 2 — the stream continues exactly
+        where the emitted prefix left off, never re-emitting.
+
         Returns the number of prompt tokens salvaged (charged as cached,
         not recomputed)."""
+        self.overlap_gates.pop(request_id, None)
         seq = self.remote.pop(request_id)
         ps = self.cfg.page_size
         n = len(seq.prompt)
@@ -470,6 +538,8 @@ class Scheduler:
         valid = max(valid, seq.num_cached)
         seq.num_cached = valid
         seq.num_computed = valid
+        if first_token is not None:
+            seq.output.append(int(first_token))
         self._seal_full_pages(seq)  # publish stored events: injected pages
         self.waiting.appendleft(seq)
         return valid

@@ -139,6 +139,15 @@ class HttpService:
             name: m.gauge(f"llm_cp_{name}",
                           f"control plane: {name.replace('_', ' ')}")
             for name in ControlPlaneStats.FIELDS}
+        # transfer-aware router scoring (kv_router/stats.py
+        # ROUTER_STATS): cold-fallback / degraded-freeze decision
+        # counts, the winner's transfer-cost estimate, and the fleet
+        # estimator-error EWMA — same render-time fold
+        from dynamo_tpu.kv_router.stats import RouterScoringStats
+        self._router = {
+            name: m.gauge(f"llm_router_{name}",
+                          f"router scoring: {name.replace('_', ' ')}")
+            for name in RouterScoringStats.FIELDS}
         # per-step engine ledger (observability/ledger.py LEDGER_STATS):
         # step counts per kind, recompiles, bucket-ladder padding waste,
         # KV tier occupancy, batch occupancy, queue depth, EWMA tok/s
@@ -210,6 +219,9 @@ class HttpService:
         from dynamo_tpu.runtime.cpstats import CP_STATS
         for name, value in CP_STATS.snapshot().items():
             self._cp[name].set(value=float(value))
+        from dynamo_tpu.kv_router.stats import ROUTER_STATS
+        for name, value in ROUTER_STATS.snapshot().items():
+            self._router[name].set(value=float(value))
         from dynamo_tpu.observability.ledger import LEDGER_STATS
         for name, value in LEDGER_STATS.snapshot().items():
             self._engine[name].set(value=float(value))

@@ -4,12 +4,15 @@ from dynamo_tpu.kv_router.protocols import (
     RouterEvent, tokens_hash,
 )
 from dynamo_tpu.kv_router.router import KvRouter
-from dynamo_tpu.kv_router.scheduler import DefaultWorkerSelector, KvScheduler
+from dynamo_tpu.kv_router.scheduler import (
+    DefaultWorkerSelector, KvScheduler, TransferAwareSelector,
+)
 from dynamo_tpu.kv_router.scoring import ProcessedEndpoints, WorkerMetrics
 
 __all__ = [
     "KvIndexer", "KvIndexerSharded", "RadixTree", "KvCacheEvent",
     "KvCacheRemoveData", "KvCacheStoreData", "KvCacheStoredBlockData",
     "RouterEvent", "tokens_hash", "KvRouter", "DefaultWorkerSelector",
-    "KvScheduler", "ProcessedEndpoints", "WorkerMetrics",
+    "TransferAwareSelector", "KvScheduler", "ProcessedEndpoints",
+    "WorkerMetrics",
 ]

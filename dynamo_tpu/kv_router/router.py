@@ -49,6 +49,14 @@ class KvRouter:
         self.client = worker_client
         self.block_size = block_size
         self.indexer = KvIndexer(block_size)
+        if selector is None:
+            # serving default: transfer-aware scoring over the process-
+            # global TransferCostModel (observability/fleet.py). With no
+            # measured links every candidate prices at the same default
+            # prior, so a fresh router ranks exactly like the prefix-
+            # only selector until transfer samples arrive.
+            from dynamo_tpu.kv_router.scheduler import TransferAwareSelector
+            selector = TransferAwareSelector()
         self.scheduler = KvScheduler(block_size, selector)
         self.aggregator = KvMetricsAggregator(worker_client, scrape_interval_s)
         self.publish_hit_events = publish_hit_events
@@ -181,6 +189,13 @@ class KvRouter:
             self.degraded = False
             log.info("kv_router exited degraded mode (event lag %.2fs, "
                      "backlog %d)", lag, backlog)
+        # degraded interaction with transfer-aware scoring: while the
+        # snapshot is stale, the cost term FREEZES at its last-good
+        # per-worker values rather than recomputing from stale load/
+        # backlog signals — degradation must not amplify staleness
+        freeze = getattr(self.scheduler.selector, "freeze_cost", None)
+        if freeze is not None:
+            freeze(self.degraded)
         CP_STATS.event_lag_seconds = lag
         CP_STATS.event_backlog = backlog
         CP_STATS.router_degraded = int(self.degraded)
@@ -193,6 +208,13 @@ class KvRouter:
         await self.aggregator.stop()
 
     # -- scheduling ----------------------------------------------------------
+
+    @property
+    def last_score_components(self) -> dict:
+        """Per-worker score components of the LAST schedule decision
+        (transfer-aware selectors only; {} otherwise) — the diagnosis
+        surface for "why did it route there"."""
+        return getattr(self.scheduler.selector, "last_components", {})
 
     def find_matches_for_tokens(self, tokens: Sequence[int]) -> MatchResult:
         return self.indexer.find_matches(

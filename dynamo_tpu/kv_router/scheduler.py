@@ -15,6 +15,29 @@ overlap_weight=2). Ties break randomly; the chosen worker's active slots and
 blocks are optimistically bumped so back-to-back schedules don't pile onto
 one worker before the next metrics scrape lands
 (process_worker_selection, scheduler.rs:208-232).
+
+**Transfer-aware scoring** (`TransferAwareSelector`, the serving
+default; NetKV in PAPERS.md, ROADMAP item 3): disaggregated TTFT is
+dominated by moving the non-overlapped KV pages to the chosen worker,
+so the logit grows a fourth term —
+
+    logit -= transfer_weight * min(max_penalty, cost_s / horizon_s)
+    cost_s = estimate(link, bytes_to_move).seconds + queue_s(link)
+
+with `bytes_to_move = (required - matched) blocks * page bytes` (the
+worker's reported `kv_page_bytes`, falling back to
+`default_block_bytes`), `estimate` the per-link measured-bandwidth
+EWMA (observability/fleet.py TransferCostModel — delivered goodput,
+resume overhead included) and `queue_s` the drain time of bytes
+already in flight toward that destination. Cold links (no EWMA yet)
+price at the fleet-median bandwidth with `cold=True` — never free,
+never infinitely penalized. Under the router's stale-snapshot degraded
+mode the cost term FREEZES at its last-good per-worker values
+(`freeze_cost`) instead of recomputing from a snapshot known to be
+stale — degradation must not amplify staleness into routing error.
+Per-decision score components land in `last_components` /
+`last_pick` for diagnosis and feed the llm_router_* gauges
+(kv_router/stats.py).
 """
 from __future__ import annotations
 
@@ -24,6 +47,7 @@ from typing import Dict, List, Optional, Protocol
 
 from dynamo_tpu.kv_router.indexer import MatchResult
 from dynamo_tpu.kv_router.scoring import ProcessedEndpoints, WorkerMetrics
+from dynamo_tpu.kv_router.stats import ROUTER_STATS
 
 
 class AllWorkersBusy(Exception):
@@ -78,6 +102,136 @@ class DefaultWorkerSelector:
                 best.append(worker_id)
         worker_id = self.rng.choice(best)
         required = -(-isl // block_size)
+        return WorkerSelection(
+            worker_id=worker_id, required_blocks=required,
+            overlap_blocks=request.overlap.scores.get(worker_id, 0))
+
+
+class TransferAwareSelector(DefaultWorkerSelector):
+    """DefaultWorkerSelector + a measured KV-transfer-cost penalty.
+
+    The cost term is normalized against `horizon_s` (how many seconds
+    of transfer outweigh one whole unit of load score) and capped at
+    `max_penalty` so a single pathological link is strongly avoided
+    without drowning every other signal. See the module docstring for
+    the formula and the degraded-freeze semantics."""
+
+    def __init__(self, overlap_weight: float = 2.0,
+                 transfer_weight: float = 1.0,
+                 horizon_s: float = 0.25,
+                 max_penalty: float = 4.0,
+                 default_block_bytes: int = 64 * 1024,
+                 cost_model=None,
+                 rng: Optional[random.Random] = None):
+        super().__init__(overlap_weight, rng)
+        self.transfer_weight = transfer_weight
+        self.horizon_s = horizon_s
+        self.max_penalty = max_penalty
+        self.default_block_bytes = default_block_bytes
+        if cost_model is None:
+            from dynamo_tpu.observability.fleet import TRANSFER_MODEL
+            cost_model = TRANSFER_MODEL
+        self.cost_model = cost_model
+        # degraded-mode interaction: while frozen, per-worker cost
+        # terms pin to their last live values (KvRouter flips this with
+        # its stale-snapshot degraded flag)
+        self.frozen = False
+        self._frozen_cost: Dict[str, float] = {}
+        # per-decision diagnosis: worker -> score components of the
+        # LAST select_worker call, and the winner's row
+        self.last_components: Dict[str, dict] = {}
+        self.last_pick: Optional[dict] = None
+
+    def freeze_cost(self, frozen: bool) -> None:
+        """Enter/exit the degraded cost freeze. Entering keeps the
+        last live per-worker costs; exiting clears them so the next
+        decision recomputes from fresh signals."""
+        if self.frozen and not frozen:
+            self._frozen_cost.clear()
+        self.frozen = frozen
+
+    def _bytes_to_move(self, m: WorkerMetrics, required: int,
+                       matched: int) -> int:
+        block_bytes = m.kv_page_bytes or self.default_block_bytes
+        return max(0, required - matched) * block_bytes
+
+    def _cost_s(self, worker_id: str, nbytes: int) -> tuple:
+        """(cost_s, cold) — live, or pinned under the degraded freeze.
+        A frozen worker never seen live prices at the median of the
+        pinned costs (not zero: unknown is not free)."""
+        if self.frozen:
+            known = self._frozen_cost
+            if worker_id in known:
+                return known[worker_id], False
+            if known:
+                vals = sorted(known.values())
+                return vals[len(vals) // 2], True
+            # frozen before any live decision: fall through to a live
+            # estimate once — better than scoring everyone at zero
+        est = self.cost_model.estimate(worker_id, nbytes)
+        cost = est.seconds + self.cost_model.queue_s(worker_id)
+        if not self.frozen:
+            self._frozen_cost[worker_id] = cost
+        return cost, est.cold
+
+    def select_worker(self, endpoints: ProcessedEndpoints,
+                      request: SchedulingRequest,
+                      block_size: int) -> WorkerSelection:
+        if not endpoints.workers:
+            raise AllWorkersBusy("no live workers")
+        isl = max(request.isl_tokens, 1)
+        required = -(-isl // block_size)
+        best_logit = float("-inf")
+        best: List[str] = []
+        components: Dict[str, dict] = {}
+        any_cold = False
+        if not self.frozen:
+            # the pinned-cost table is "the last live decision's view":
+            # rebuilt per decision (bounded by the candidate set) so a
+            # freeze pins fresh values and dead workers can't linger
+            self._frozen_cost.clear()
+        for worker_id, m in endpoints.workers.items():
+            matched = request.overlap.scores.get(worker_id, 0)
+            overlap_score = matched * block_size / isl
+            kv_usage = (m.kv_active_blocks / m.kv_total_blocks
+                        if m.kv_total_blocks else 0.0)
+            norm_active = (m.request_active_slots / m.request_total_slots
+                           if m.request_total_slots else 0.0)
+            nbytes = self._bytes_to_move(m, required, matched)
+            cost_s, cold = self._cost_s(worker_id, nbytes)
+            any_cold |= cold
+            norm_cost = min(self.max_penalty, cost_s / self.horizon_s)
+            logit = (self.overlap_weight * overlap_score
+                     - kv_usage - norm_active
+                     - self.transfer_weight * norm_cost)
+            components[worker_id] = {
+                "overlap": round(overlap_score, 4),
+                "kv_usage": round(kv_usage, 4),
+                "active": round(norm_active, 4),
+                "transfer_bytes": nbytes,
+                "transfer_s": round(cost_s, 6),
+                "transfer_norm": round(norm_cost, 4),
+                "cold": cold,
+                "frozen": self.frozen,
+                "logit": round(logit, 4),
+            }
+            if logit > best_logit:
+                best_logit, best = logit, [worker_id]
+            elif logit == best_logit:
+                best.append(worker_id)
+        worker_id = self.rng.choice(best)
+        self.last_components = components
+        pick = dict(components[worker_id], worker_id=worker_id)
+        self.last_pick = pick
+        ROUTER_STATS.transfer_scored += 1
+        if any_cold:
+            ROUTER_STATS.cold_scored += 1
+        if self.frozen:
+            ROUTER_STATS.frozen_scored += 1
+        ROUTER_STATS.last_transfer_est_s = pick["transfer_s"]
+        ROUTER_STATS.last_transfer_bytes = pick["transfer_bytes"]
+        ROUTER_STATS.est_err_abs_frac = round(
+            self.cost_model.mean_abs_est_err(), 4)
         return WorkerSelection(
             worker_id=worker_id, required_blocks=required,
             overlap_blocks=request.overlap.scores.get(worker_id, 0))

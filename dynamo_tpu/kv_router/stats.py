@@ -1,0 +1,39 @@
+"""Process-local router scoring counters (/metrics: llm_router_*).
+
+Same pattern as runtime/cpstats.py CP_STATS: plain numbers bumped on the
+scoring path, folded into Prometheus gauges at /metrics render time by
+frontend/service.py and observability/exporter.py. The source is the
+transfer-aware worker selector (kv_router/scheduler.py
+TransferAwareSelector): every schedule decision records whether the
+transfer-cost term was live, cold-fallback (a candidate link had no
+bandwidth EWMA yet), or frozen (stale-snapshot degraded mode pinned the
+last-good costs), plus the winner's estimated transfer seconds and the
+fleet's estimator-error EWMA — the signals that make a routing
+regression caused by a stale or missing bandwidth EWMA diagnosable from
+a scrape (docs/OBSERVABILITY.md §9, docs/PERF.md routing section).
+"""
+from __future__ import annotations
+
+
+class RouterScoringStats:
+    FIELDS = (
+        "transfer_scored",       # decisions scored with the transfer term
+        "cold_scored",           # decisions where >=1 candidate was cold
+        "frozen_scored",         # decisions under the degraded cost freeze
+        "last_transfer_est_s",   # winner's estimated transfer seconds
+        "last_transfer_bytes",   # winner's bytes-to-move estimate
+        "est_err_abs_frac",      # fleet mean |estimator error| (EWMA-fed)
+    )
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        for name in self.FIELDS:
+            setattr(self, name, 0)
+
+    def snapshot(self) -> dict:
+        return {name: getattr(self, name) for name in self.FIELDS}
+
+
+ROUTER_STATS = RouterScoringStats()

@@ -171,6 +171,14 @@ class MetricsExporter:
             name: r.gauge(f"{PREFIX}_cp_{name}",
                           f"control plane: {name.replace('_', ' ')}")
             for name in ControlPlaneStats.FIELDS}
+        # transfer-aware router scoring counters (kv_router/stats.py),
+        # same render-time refresh — when the exporter process hosts a
+        # router these are its scoring health, otherwise they render 0
+        from dynamo_tpu.kv_router.stats import RouterScoringStats
+        self.g_router = {
+            name: r.gauge(f"{PREFIX}_router_{name}",
+                          f"router scoring: {name.replace('_', ' ')}")
+            for name in RouterScoringStats.FIELDS}
         self._client = None
         self._aggregator: Optional[KvMetricsAggregator] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -352,6 +360,9 @@ class MetricsExporter:
         from dynamo_tpu.runtime.cpstats import CP_STATS
         for name, value in CP_STATS.snapshot().items():
             self.g_cp[name].set(value=float(value))
+        from dynamo_tpu.kv_router.stats import ROUTER_STATS
+        for name, value in ROUTER_STATS.snapshot().items():
+            self.g_router[name].set(value=float(value))
 
     # -- http -----------------------------------------------------------------
 

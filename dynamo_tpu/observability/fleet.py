@@ -20,6 +20,7 @@ a measured bandwidth table keyed by destination engine id for free.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 import time
 from typing import Callable, Dict, List, Optional
@@ -38,15 +39,42 @@ WORKER_FIELDS = (
 )
 
 
+@dataclasses.dataclass
+class TransferEstimate:
+    """One router-facing cost answer. `cold` marks the no-data branch:
+    the link has no measured EWMA yet and `bytes_per_s` fell back to the
+    fleet median (or the configured default when NOTHING is measured) —
+    never free, never infinite. Consumers must branch on it (dynalint
+    R16): a cold estimate is a prior, not a measurement."""
+
+    link: str
+    seconds: float
+    bytes_per_s: float
+    cold: bool
+
+
 class TransferCostModel:
     """Per-link KV-transfer bandwidth EWMAs, queryable by the router.
 
     A "link" is the destination engine/worker id of a KV page transfer
     (what `send_pages(engine_id, ...)` targets); the sample is the
-    payload bytes and wall seconds of one completed send, so the EWMA
-    tracks delivered goodput including integrity re-fetches and resume
-    overhead. `estimate_s` is the router-facing query: what would
-    shipping N bytes to this worker cost right now?"""
+    UNIQUE payload bytes of one completed send over its total wall
+    seconds, so the EWMA tracks delivered goodput — integrity
+    re-fetches and resume re-sends inflate the denominator without
+    inflating the numerator, and a lossy link correctly estimates
+    slower than its raw wire speed. `estimate(link, bytes)` is the
+    router-facing query: what would shipping N bytes to this worker
+    cost right now? Cold links (no EWMA yet) answer with the fleet
+    median bandwidth and `cold=True` — a principled prior, neither a
+    free pass nor an infinite penalty.
+
+    The model also tracks per-destination transfer BACKLOG (bytes
+    staged/in flight on sends not yet completed — `note_inflight` /
+    `note_done` from the send path) and a per-link ESTIMATOR-ERROR
+    EWMA (signed relative error of the pre-send estimate vs the
+    actual transfer time), the diagnosis signal for routing
+    regressions caused by a stale EWMA (tools/fleet_top.py,
+    tools/trace_explain.py --summary)."""
 
     def __init__(self, alpha: float = 0.3,
                  default_bytes_per_s: float = 1e9,
@@ -55,6 +83,8 @@ class TransferCostModel:
         self.default_bytes_per_s = default_bytes_per_s
         self.min_sample_s = min_sample_s
         self._links: Dict[str, Ewma] = {}
+        self._err: Dict[str, Ewma] = {}
+        self._inflight: Dict[str, int] = {}
 
     def observe(self, link: str, nbytes: int, seconds: float) -> None:
         if nbytes <= 0 or seconds < self.min_sample_s:
@@ -62,33 +92,115 @@ class TransferCostModel:
         ew = self._links.get(link)
         if ew is None:
             ew = self._links[link] = Ewma(self.alpha)
+        else:
+            # estimator error BEFORE folding the sample in: how wrong
+            # would the router's estimate have been for this transfer?
+            # Signed relative error: >0 = over-estimated (link faster
+            # than believed), <0 = under-estimated (stale-fast EWMA —
+            # the dangerous direction for routing).
+            est = nbytes / max(1.0, ew.value)
+            err = self._err.get(link)
+            if err is None:
+                err = self._err[link] = Ewma(self.alpha)
+            err.update((est - seconds) / max(seconds, self.min_sample_s))
         ew.update(nbytes / seconds)
+
+    # -- in-flight backlog (per-destination queue depth in bytes) -------------
+
+    def note_inflight(self, link: str, nbytes: int) -> None:
+        """A send of `nbytes` toward `link` started; pair with
+        note_done — the delta is the router's transfer-backlog term."""
+        self._inflight[link] = self._inflight.get(link, 0) + max(0, nbytes)
+
+    def note_done(self, link: str, nbytes: int) -> None:
+        left = self._inflight.get(link, 0) - max(0, nbytes)
+        if left > 0:
+            self._inflight[link] = left
+        else:
+            self._inflight.pop(link, None)
+
+    def backlog_bytes(self, link: str) -> int:
+        return self._inflight.get(link, 0)
+
+    # -- queries --------------------------------------------------------------
 
     def bandwidth_bytes_per_s(self, link: str) -> float:
         ew = self._links.get(link)
         if ew is None or ew.value is None:
-            return self.default_bytes_per_s
+            # no-data branch: fleet-median prior (default when nothing
+            # anywhere is measured)
+            return self.fleet_median_bytes_per_s()
         return ew.value
+
+    def fleet_median_bytes_per_s(self) -> float:
+        """Median measured bandwidth across links; the cold-link prior.
+        Falls back to default_bytes_per_s when no link is measured."""
+        vals = sorted(ew.value for ew in self._links.values()
+                      if ew.value is not None)
+        if not vals:
+            return self.default_bytes_per_s
+        return vals[len(vals) // 2]
 
     def measured(self, link: str) -> bool:
         ew = self._links.get(link)
         return ew is not None and ew.samples > 0
 
+    def estimate(self, link: str, nbytes: int) -> TransferEstimate:
+        """Cost of shipping `nbytes` to `link` now, cold-aware: a
+        never-measured link answers at the fleet-median bandwidth with
+        cold=True — it can never score as free (bytes always cost
+        time) nor as infinitely penalized (the prior is finite)."""
+        cold = not self.measured(link)
+        bw = max(1.0, self.bandwidth_bytes_per_s(link))
+        return TransferEstimate(link=link, seconds=max(0, nbytes) / bw,
+                                bytes_per_s=bw, cold=cold)
+
     def estimate_s(self, link: str, nbytes: int) -> float:
-        return nbytes / max(1.0, self.bandwidth_bytes_per_s(link))
+        # cold fallback handled inside estimate() (fleet-median prior)
+        return self.estimate(link, nbytes).seconds
+
+    def queue_s(self, link: str) -> float:
+        """Drain time of the bytes already in flight toward `link` —
+        the per-destination transfer-backlog term of the router score.
+        Cold-safe: rides the same fleet-median prior as estimate()."""
+        backlog = self.backlog_bytes(link)
+        if backlog <= 0:
+            return 0.0
+        return self.estimate(link, backlog).seconds
+
+    def est_err_frac(self, link: str) -> Optional[float]:
+        """Signed relative estimator error EWMA for one link (None
+        until a second sample exists)."""
+        err = self._err.get(link)
+        return err.value if err is not None else None
+
+    def mean_abs_est_err(self) -> float:
+        vals = [abs(e.value) for e in self._err.values()
+                if e.value is not None]
+        return sum(vals) / len(vals) if vals else 0.0
 
     def links(self) -> List[str]:
         return sorted(self._links)
 
     def snapshot(self) -> Dict[str, dict]:
-        return {
-            link: {"bytes_per_s": round(ew.value, 1),
+        out = {}
+        for link, ew in sorted(self._links.items()):
+            if ew.value is None:
+                continue
+            row = {"bytes_per_s": round(ew.value, 1),
                    "samples": ew.samples}
-            for link, ew in sorted(self._links.items())
-            if ew.value is not None}
+            err = self._err.get(link)
+            if err is not None and err.value is not None:
+                row["est_err_frac"] = round(err.value, 4)
+            if self._inflight.get(link):
+                row["backlog_bytes"] = self._inflight[link]
+            out[link] = row
+        return out
 
     def reset(self) -> None:
         self._links.clear()
+        self._err.clear()
+        self._inflight.clear()
 
 
 TRANSFER_MODEL = TransferCostModel()

@@ -623,6 +623,188 @@ class SimCluster:
                 "peak_lag_s": round(self.router.event_lag_s, 4),
                 "drain_s": round(total_s - publish_s, 3)}
 
+    # -- transfer-aware routing A/B (ISSUE 11 / ROADMAP item 3) ---------------
+
+    async def routing_ab(self, requests: int = 2000,
+                         block_bytes: int = 256 * 1024,
+                         prefill_s: float = 0.04,
+                         arrival_spacing_s: Optional[float] = None,
+                         flaky_p: float = 0.25,
+                         flaky_delay_s: float = 0.35,
+                         cold_fraction: float = 0.15,
+                         warm_samples: int = 3) -> dict:
+        """Prefix-overlap-only vs transfer-aware scheduling over a fleet
+        with HETEROGENEOUS link speeds, measured on simulated TTFT.
+
+        Every per-link property is a pure function of the cluster seed:
+        wire bandwidth draws from a two-decade tier ladder, and each
+        link owns a seeded `transfer.link`-style delay FaultSchedule
+        (the same FaultSpec machinery the chaos harness arms globally —
+        here instantiated per link so flaky links stall deterministic
+        transfers). A request's simulated TTFT = queue wait at its
+        chosen worker + prefill + bytes_to_move/bandwidth + the seeded
+        stall; bytes_to_move follows the radix index's REAL overlap for
+        the chosen worker, so warm prefixes genuinely ship less. Both
+        modes run the identical seeded request stream against
+        identically seeded load snapshots; the transfer-aware mode's
+        cost model learns only from the transfers the simulation
+        completes (delivered goodput incl. stalls — lossy-link reality),
+        with a seeded fraction of links left COLD to exercise the
+        fleet-median fallback in anger.
+
+        Returns a seeded-replayable report: per-mode TTFT percentiles
+        and the p99/p50 improvement of transfer-aware over prefix-only
+        (tools/routing_ab.py commits it as ROUTING_AB_r11.json)."""
+        import heapq
+        import zlib
+
+        from dynamo_tpu.kv_router.scheduler import (
+            DefaultWorkerSelector, TransferAwareSelector,
+        )
+        from dynamo_tpu.kv_router.scoring import (
+            ProcessedEndpoints, WorkerMetrics,
+        )
+        from dynamo_tpu.observability.fleet import TransferCostModel
+
+        seed = self.cfg.seed
+        ids = sorted(self.workers)
+        if arrival_spacing_s is None:
+            # constant per-worker offered load regardless of fleet size
+            # (~5 arrivals/s/worker): queueing pressure — the thing
+            # transfer-aware backlog scoring manages — survives scaling
+            # the A/B from the tier-1 smoke to the 1000-worker artifact
+            arrival_spacing_s = 0.192 / max(1, len(ids))
+
+        def link_seed(wid: str, salt: int) -> int:
+            return (seed * 1000003 + salt) ^ zlib.crc32(wid.encode())
+
+        # two-decade bandwidth ladder, seeded per link: most links are
+        # datacenter-fast, a tail is congested/oversubscribed — the
+        # heterogeneity transfer-aware routing exists to see
+        tiers = (2e9, 8e8, 2e8, 1e7)
+        weights = (0.4, 0.3, 0.2, 0.1)
+        bw: Dict[str, float] = {}
+        flaky: Dict[str, faults.FaultSchedule] = {}
+        cold: set = set()
+        for wid in ids:
+            r = random.Random(link_seed(wid, 1))
+            bw[wid] = r.choices(tiers, weights)[0]
+            # per-link seeded delay faults (the transfer.link site's
+            # delay kind, one schedule per link): slow links are also
+            # likelier to stall
+            p = flaky_p if bw[wid] <= 2e8 else flaky_p / 5
+            flaky[wid] = faults.FaultSchedule(
+                link_seed(wid, 2),
+                [faults.FaultSpec("delay", p=p, delay_s=flaky_delay_s,
+                                  delay_min_s=flaky_delay_s / 2)])
+            if r.random() < cold_fraction:
+                cold.add(wid)
+
+        def seeded_endpoints() -> ProcessedEndpoints:
+            # identical load snapshot for both modes (fresh objects:
+            # optimistic bumps mutate them during a mode)
+            pages = self.cfg.family_pages * self.cfg.stores_per_worker
+            eps = ProcessedEndpoints()
+            for wid in ids:
+                r = random.Random(link_seed(wid, 3))
+                eps.workers[wid] = WorkerMetrics(
+                    request_active_slots=r.randrange(0, 8),
+                    request_total_slots=8,
+                    kv_active_blocks=r.randrange(0, pages + 1),
+                    kv_total_blocks=max(pages, 1) * 4)
+            return eps
+
+        block_size = self.cfg.block_size
+
+        def run_mode(selector, model) -> dict:
+            self.router.scheduler.selector = selector
+            self.router.scheduler.update_endpoints(seeded_endpoints())
+            for sched in flaky.values():
+                sched.reset()    # same seeded stall stream per mode
+            if model is not None:
+                # warm the measured-bandwidth table the way a live
+                # fleet would (a few completed sends per link), minus
+                # the seeded cold set — those exercise the fleet-median
+                # fallback during the measured run
+                for wid in ids:
+                    if wid in cold:
+                        continue
+                    for k in range(warm_samples):
+                        nb = block_bytes * (4 + k)
+                        model.observe(wid, nb, nb / bw[wid])
+            rng = random.Random(seed + 17)
+            busy_until: Dict[str, float] = {}
+            inflight: list = []    # (finish_t, wid, nbytes) heap
+            ttfts: List[float] = []
+            slow_picks = 0
+            for i in range(requests):
+                now = i * arrival_spacing_s
+                while inflight and inflight[0][0] <= now:
+                    _, fwid, fbytes = heapq.heappop(inflight)
+                    if model is not None:
+                        model.note_done(fwid, fbytes)
+                toks = self._stream_tokens(
+                    rng.randrange(len(self._streams)))
+                overlap = self.router.find_matches_for_tokens(toks)
+                pick = self.router.scheduler.schedule(len(toks), overlap)
+                required = -(-len(toks) // block_size)
+                matched = overlap.scores.get(pick, 0)
+                nbytes = max(0, required - matched) * block_bytes
+                stall = flaky[pick].decide().delay_s
+                xfer_s = nbytes / bw[pick] + stall
+                start = max(now, busy_until.get(pick, 0.0))
+                finish = start + prefill_s + xfer_s
+                busy_until[pick] = finish
+                ttfts.append(finish - now)
+                if bw[pick] <= 2e8:
+                    slow_picks += 1
+                if model is not None and nbytes > 0:
+                    # the model learns delivered goodput incl. the
+                    # seeded stall — lossy links estimate slower than
+                    # their wire speed
+                    model.observe(pick, nbytes, max(xfer_s, 1e-6))
+                    model.note_inflight(pick, nbytes)
+                    heapq.heappush(inflight, (finish, pick, nbytes))
+            lat = sorted(ttfts)
+            return {
+                "requests": requests,
+                "ttft_p50_ms": round(percentile(lat, 0.50) * 1e3, 2),
+                "ttft_p95_ms": round(percentile(lat, 0.95) * 1e3, 2),
+                "ttft_p99_ms": round(percentile(lat, 0.99) * 1e3, 2),
+                "ttft_mean_ms": round(sum(lat) / len(lat) * 1e3, 2),
+                "slow_link_picks": slow_picks,
+            }
+
+        saved = self.router.scheduler.selector
+        try:
+            prefix_only = run_mode(
+                DefaultWorkerSelector(rng=random.Random(seed + 5)), None)
+            model = TransferCostModel()
+            aware_sel = TransferAwareSelector(
+                cost_model=model, rng=random.Random(seed + 5),
+                default_block_bytes=block_bytes)
+            aware = run_mode(aware_sel, model)
+        finally:
+            self.router.scheduler.selector = saved
+        return {
+            "seed": seed,
+            "workers": len(ids),
+            "block_bytes": block_bytes,
+            "bandwidth_tiers": list(tiers),
+            "cold_links": len(cold),
+            "flaky_delay_s": flaky_delay_s,
+            "prefix_only": prefix_only,
+            "transfer_aware": aware,
+            "p99_improvement": round(
+                1.0 - aware["ttft_p99_ms"]
+                / max(prefix_only["ttft_p99_ms"], 1e-9), 4),
+            "p50_improvement": round(
+                1.0 - aware["ttft_p50_ms"]
+                / max(prefix_only["ttft_p50_ms"], 1e-9), 4),
+            "measured_links": len(model.links()),
+            "mean_abs_est_err": round(model.mean_abs_est_err(), 4),
+        }
+
     def summary(self) -> dict:
         lat = sorted(self.latencies_us)
         return {

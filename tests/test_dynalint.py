@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R15), the
+- one positive AND one negative fixture per AST rule (R1-R16), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -989,6 +989,84 @@ def test_r15_live_every_registration_documented_with_help():
             found = lint_source(f.read(), rel)
         assert not [x for x in found if x.rule == "R15"], \
             (rel, [x.message for x in found if x.rule == "R15"])
+
+
+# -- R16: transfer-cost fallback contract --------------------------------------
+
+R16_BAD = """
+    def pick_worker(model, workers, nbytes):
+        # ranks purely on the scalar estimate: a never-sampled link's
+        # prior is indistinguishable from a measurement here
+        return min(workers, key=lambda w: model.estimate_s(w, nbytes))
+"""
+
+
+def test_r16_flags_unhandled_scalar_estimate():
+    found = lint_source(textwrap.dedent(R16_BAD),
+                        "dynamo_tpu/kv_router/fixture.py")
+    assert "R16" in rules(found)
+    found = lint_source(textwrap.dedent(R16_BAD), "tools/fixture.py")
+    assert "R16" in rules(found)
+
+
+def test_r16_quiet_outside_scope():
+    found = lint_source(textwrap.dedent(R16_BAD), "examples/fixture.py")
+    assert "R16" not in rules(found)
+    # generic `.estimate` on a non-cost receiver is not a target
+    other = """
+        def eta(tracker, job):
+            return tracker.estimate(job)
+    """
+    found = lint_source(textwrap.dedent(other),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert "R16" not in rules(found)
+
+
+def test_r16_quiet_on_handled_and_annotated_consumers():
+    handled = """
+        def pick_worker(model, workers, nbytes):
+            best, best_cost = None, float("inf")
+            for w in workers:
+                est = model.estimate(w, nbytes)
+                cost = est.seconds * (2.0 if est.cold else 1.0)
+                if cost < best_cost:
+                    best, best_cost = w, cost
+            return best
+
+        def drain_time(model, link):
+            if not model.measured(link):
+                return None
+            return model.estimate_s(link, model.backlog_bytes(link))
+    """
+    found = lint_source(textwrap.dedent(handled),
+                        "dynamo_tpu/kv_router/fixture.py")
+    assert "R16" not in rules(found)
+    annotated = """
+        def rough_eta(model, link, nbytes):
+            # dynalint: cost-fallback-ok=display-only ETA, the prior is
+            # exactly what we want to show for unmeasured links
+            return model.estimate_s(link, nbytes)
+    """
+    found = lint_source(textwrap.dedent(annotated),
+                        "dynamo_tpu/observability/fixture.py")
+    assert "R16" not in rules(found)
+
+
+def test_r16_live_on_cost_model_consumers():
+    """Every live consumer of the cost model's queries (the selector,
+    the send path, the model's own delegating methods) handles the
+    cold/frozen/default branch or carries a justified annotation."""
+    import glob
+    scoped = glob.glob(os.path.join(REPO, "dynamo_tpu", "**", "*.py"),
+                       recursive=True)
+    scoped += glob.glob(os.path.join(REPO, "tools", "*.py"))
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R16"], \
+            (rel, [x.message for x in found if x.rule == "R16"])
 
 
 # -- jaxpr invariants ----------------------------------------------------------

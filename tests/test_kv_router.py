@@ -185,6 +185,125 @@ class TestScheduler:
             sched.schedule(10, MatchResult())
 
 
+class TestTransferAwareSelector:
+    """Transfer-aware scoring (ROADMAP item 3 / ISSUE 11): estimated
+    KV-transfer cost folds into the logit next to overlap and load."""
+
+    def _endpoints(self, **workers):
+        return ProcessedEndpoints({
+            wid: WorkerMetrics(**kw) for wid, kw in workers.items()})
+
+    def _model(self, **bw):
+        from dynamo_tpu.observability.fleet import TransferCostModel
+        m = TransferCostModel()
+        for link, bytes_per_s in bw.items():
+            m.observe(link, int(bytes_per_s), 1.0)
+        return m
+
+    def _selector(self, model, **kw):
+        from dynamo_tpu.kv_router.scheduler import TransferAwareSelector
+        kw.setdefault("rng", random.Random(0))
+        kw.setdefault("default_block_bytes", 1 << 20)   # 1 MiB/block
+        return TransferAwareSelector(cost_model=model, **kw)
+
+    def test_slow_link_loses_at_equal_overlap_and_load(self):
+        # identical load, no overlap anywhere: the only signal is the
+        # measured link bandwidth — the fast link must win
+        model = self._model(fast=1 << 30, slow=1 << 22)   # 1 GiB/s vs 4 MiB/s
+        sched = KvScheduler(block_size=16,
+                            selector=self._selector(model))
+        sched.update_endpoints(self._endpoints(
+            fast=dict(request_total_slots=8, kv_total_blocks=100),
+            slow=dict(request_total_slots=8, kv_total_blocks=100)))
+        from dynamo_tpu.kv_router.indexer import MatchResult
+        assert sched.schedule(160, MatchResult()) == "fast"
+        comps = sched.selector.last_components
+        assert comps["slow"]["transfer_s"] > comps["fast"]["transfer_s"]
+        assert not comps["fast"]["cold"] and not comps["slow"]["cold"]
+
+    def test_overlap_shrinks_bytes_to_move(self):
+        # a warm worker ships fewer bytes: overlap reduces the cost term
+        # (and wins) even on an equal-speed link
+        model = self._model(warm=1 << 28, cold_w=1 << 28)
+        sched = KvScheduler(block_size=16,
+                            selector=self._selector(model))
+        sched.update_endpoints(self._endpoints(
+            warm=dict(request_total_slots=8, kv_total_blocks=100),
+            cold_w=dict(request_total_slots=8, kv_total_blocks=100)))
+        from dynamo_tpu.kv_router.indexer import MatchResult
+        assert sched.schedule(160, MatchResult(scores={"warm": 8})) == "warm"
+        comps = sched.selector.last_components
+        assert comps["warm"]["transfer_bytes"] \
+            < comps["cold_w"]["transfer_bytes"]
+
+    def test_cold_link_neither_free_nor_infinite(self):
+        # satellite pin: a never-measured link prices at the fleet
+        # median — its cost term is strictly positive AND finite, and
+        # the decision is flagged cold
+        from dynamo_tpu.kv_router.stats import ROUTER_STATS
+        ROUTER_STATS.reset()
+        model = self._model(measured=1 << 24)    # 16 MiB/s fleet median
+        sel = self._selector(model)
+        sched = KvScheduler(block_size=16, selector=sel)
+        sched.update_endpoints(self._endpoints(
+            measured=dict(request_total_slots=8, kv_total_blocks=100),
+            never_seen=dict(request_total_slots=8, kv_total_blocks=100)))
+        from dynamo_tpu.kv_router.indexer import MatchResult
+        sched.schedule(160, MatchResult())
+        c = sel.last_components["never_seen"]
+        assert c["cold"] is True
+        assert 0.0 < c["transfer_s"] < float("inf")
+        # the cold prior equals the fleet median, so equal-load equal-
+        # overlap candidates tie instead of the cold one being shut out
+        assert c["transfer_s"] == pytest.approx(
+            sel.last_components["measured"]["transfer_s"])
+        assert c["transfer_norm"] <= sel.max_penalty
+        assert ROUTER_STATS.cold_scored >= 1
+
+    def test_degraded_freeze_pins_cost_term(self):
+        # stale-snapshot degraded mode: the cost term freezes at its
+        # last live values — new (possibly stale-amplified) signals
+        # don't move the ranking until the freeze lifts
+        model = self._model(a=1 << 30, b=1 << 30)
+        sel = self._selector(model)
+        sched = KvScheduler(block_size=16, selector=sel)
+        sched.update_endpoints(self._endpoints(
+            a=dict(request_total_slots=8, kv_total_blocks=100),
+            b=dict(request_total_slots=8, kv_total_blocks=100)))
+        from dynamo_tpu.kv_router.indexer import MatchResult
+        sched.schedule(160, MatchResult())
+        live_a = sel.last_components["a"]["transfer_s"]
+        sel.freeze_cost(True)
+        # the link "collapses" while degraded — frozen scoring must NOT see it
+        for _ in range(8):
+            model.observe("a", 1 << 10, 1.0)
+        sched.schedule(160, MatchResult())
+        frozen = sel.last_components["a"]
+        assert frozen["frozen"] is True
+        assert frozen["transfer_s"] == pytest.approx(live_a)
+        sel.freeze_cost(False)
+        sched.schedule(160, MatchResult())
+        thawed = sel.last_components["a"]
+        assert thawed["frozen"] is False
+        assert thawed["transfer_s"] > live_a   # the collapse is visible again
+
+    def test_router_stats_and_components_exposed(self):
+        from dynamo_tpu.kv_router.stats import ROUTER_STATS
+        ROUTER_STATS.reset()
+        model = self._model(w1=1 << 28)
+        sel = self._selector(model)
+        sched = KvScheduler(block_size=16, selector=sel)
+        sched.update_endpoints(self._endpoints(
+            w1=dict(request_total_slots=8, kv_total_blocks=100)))
+        from dynamo_tpu.kv_router.indexer import MatchResult
+        sched.schedule(64, MatchResult())
+        assert ROUTER_STATS.transfer_scored == 1
+        assert sel.last_pick["worker_id"] == "w1"
+        for key in ("overlap", "kv_usage", "active", "transfer_s",
+                    "transfer_norm", "cold", "frozen", "logit"):
+            assert key in sel.last_pick
+
+
 class TestOrphanEvents:
     def test_unknown_parent_store_is_dropped(self):
         """A mid-sequence page whose parent is unknown (router restarted)

@@ -1,0 +1,275 @@
+"""Early decode over the committed frontier (FlowKV-style overlap).
+
+The disagg decode worker no longer waits for KV-stream completion: the
+prefill side publishes a `transfer_pending` completion the moment it
+samples the first token, the decode worker emits that token immediately
+(TTFT stops paying the transfer), and decode activation gates on the
+scheduler's per-request committed-frontier watermark
+(engine/scheduler.py overlap gates) — checked before planning, opened
+by the KvTransferServer's chunk commits.
+
+Pinned here:
+- token identity: overlap on == overlap off == aggregated oracle, for
+  greedy AND seeded-sampled streams (reading only committed pages is
+  exact — docs/PERF.md);
+- span ordering: the first decode window runs before the final chunk's
+  ack lands sender-side (`decode.emit` precedes the `kv.transfer`
+  span's end);
+- failure semantics unchanged: sender death mid-overlap still salvages
+  the committed prefix with `majority_committed_full_reprefills == 0`,
+  and the already-emitted first token is charged, never re-emitted;
+- the wait-for-completion mode still works (early notifies ignored).
+"""
+import asyncio
+
+import pytest
+
+from dynamo_tpu.disagg import (
+    DisaggDecodeWorker, DisaggregatedRouter, KvTransferServer, PrefillQueue,
+    PrefillWorker, RemoteTransferBackend,
+)
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+from dynamo_tpu.llm.worker import NativeEngineWorker
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest, SamplingOptions, StopConditions,
+)
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.faults import FaultSchedule, FaultSpec
+from dynamo_tpu.runtime.integrity import XFER_STATS
+from dynamo_tpu.runtime.tracing import TRACE_KEY, TRACER, TraceContext
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+PAGE = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faults.REGISTRY.disarm()
+    faults.REGISTRY.reset_counters()
+    TRACER.configure(enabled=False, sample_rate=1.0, seed=0)
+    TRACER.reset()
+
+
+def make_engine():
+    return NativeEngine(CFG, EngineConfig(
+        page_size=PAGE, num_pages=64, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512), seed=0)
+
+
+def pre_request(rid, prompt, max_tokens=6, temperature=0.0, seed=0):
+    return PreprocessedRequest(
+        request_id=rid, token_ids=prompt,
+        sampling=SamplingOptions(temperature=temperature, seed=seed),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True))
+
+
+async def _drive(gen):
+    toks, reason = [], None
+    async for frame in gen:
+        toks.extend(frame.get("token_ids", ()))
+        if frame.get("finish_reason") not in (None, "prefill_done"):
+            reason = frame["finish_reason"]
+    return toks, reason
+
+
+async def _build_stack(plane, early_decode=True, chunk_pages=1,
+                       window_chunks=1, prefill_timeout_s=30.0):
+    queue = PrefillQueue(plane.messaging, "ns", "tiny")
+    router = DisaggregatedRouter(max_local_prefill_length=4,
+                                 max_prefill_queue_size=8, model="tiny")
+    decode = DisaggDecodeWorker(
+        make_engine(), plane.messaging, router, queue,
+        worker_id="dec-0", prefill_timeout_s=prefill_timeout_s,
+        early_decode=early_decode)
+    server = await KvTransferServer(decode, "dec-0").start()
+    await server.register(plane.kv)
+    transfer = RemoteTransferBackend(plane.kv, chunk_pages=chunk_pages,
+                                     window_chunks=window_chunks)
+    prefill = PrefillWorker(
+        NativeEngineWorker(make_engine()), queue, transfer, plane.messaging)
+    return decode, prefill, server, transfer
+
+
+def _run_disagg(pre, early_decode=True, arm=None, trace=None,
+                link_retries=3):
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, server, transfer = await _build_stack(
+            plane, early_decode=early_decode)
+        transfer.link_retries = link_retries
+        if arm is not None:
+            faults.REGISTRY.arm("transfer.link", arm)
+        await decode.start()
+        await prefill.start()
+        ctx = (Context(pre.request_id,
+                       baggage={TRACE_KEY: trace.to_wire()})
+               if trace is not None else Context(pre.request_id))
+        try:
+            toks, reason = await asyncio.wait_for(_drive(
+                decode.generate(pre.model_dump(exclude_none=True), ctx)),
+                120)
+        finally:
+            await prefill.stop()
+            await decode.stop()
+            await transfer.close()
+            await server.stop()
+        return toks, reason, decode
+
+    return asyncio.run(main())
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_overlap_token_identity_greedy_and_sampled(temperature):
+    """Overlap on == overlap off == aggregated oracle: activation waits
+    for exactly the pages the first window reads, so the engine state at
+    activation is bit-identical to wait-for-completion — only the wall
+    clock differs."""
+    prompt = list(range(100, 140))   # 5 pages -> 5 chunks
+    params = SamplingParams(max_tokens=6, temperature=temperature,
+                            seed=7, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+
+    toks_on, reason_on, dec_on = _run_disagg(
+        pre_request("ov1", prompt, temperature=temperature, seed=7))
+    toks_off, reason_off, dec_off = _run_disagg(
+        pre_request("ov2", prompt, temperature=temperature, seed=7),
+        early_decode=False)
+    assert reason_on == reason_off == "length"
+    assert toks_on == toks_off == expect
+    # the overlap run really overlapped; the disabled run never did
+    assert dec_on.early_first_emits == 1
+    assert dec_on.engine.scheduler.overlap_activations == 1
+    assert dec_on.overlap_fallbacks == 0
+    assert dec_off.early_first_emits == 0
+    assert dec_off.engine.scheduler.overlap_activations == 0
+
+
+def test_first_decode_window_precedes_final_chunk_ack():
+    """The acceptance ordering: with a per-chunk stalled link the first
+    decode emit lands BEFORE the sender's kv.transfer span ends (= the
+    final chunk's ack) — decode genuinely runs under the in-flight
+    tail."""
+    TRACER.configure(enabled=True, sample_rate=1.0)
+    TRACER.reset()
+    prompt = list(range(100, 140))   # 5 chunks at chunk_pages=1
+    # deterministic 60ms stall per chunk: the transfer tail is wide
+    # enough that span ordering cannot be won by scheduling luck
+    arm = FaultSchedule(0, [FaultSpec("delay", p=1.0, delay_s=0.06,
+                                      delay_min_s=0.06)])
+    trace = TraceContext("ov-trace")
+    toks, reason, dec = _run_disagg(
+        pre_request("ov3", prompt, max_tokens=4), arm=arm, trace=trace)
+    assert reason == "length" and len(toks) == 4
+    assert dec.early_first_emits == 1
+    spans = TRACER.drain()
+    emits = [s for s in spans if s["name"] == "decode.emit"
+             and (s.get("attrs") or {}).get("first")]
+    xfers = [s for s in spans if s["name"] == "kv.transfer"]
+    assert emits and xfers
+    first_emit = min(s["ts"] for s in emits)
+    xfer_end = max(s["ts"] + s["dur"] for s in xfers)
+    assert first_emit < xfer_end, \
+        "first token emit did not precede the transfer's last ack"
+    # the first decode WINDOW also starts before the final chunk acks:
+    # at least one non-first decode.emit (the engine's own output) lands
+    # before the transfer span ends only when the gate+decode genuinely
+    # ran under the tail — with a 60ms/chunk stall and 5 chunks the
+    # final chunks are still streaming when decode begins. The chunk
+    # spans prove the interleave: the LAST chunk span starts after the
+    # first emit.
+    chunks = [s for s in spans if s["name"] == "kv.transfer.chunk"]
+    assert chunks
+    last_chunk_start = max(s["ts"] for s in chunks)
+    assert first_emit < last_chunk_start, \
+        "first emit should precede the final chunk's send"
+
+
+def test_sender_death_mid_overlap_salvages_committed_prefix():
+    """Link permanently dead after 3 of 5 chunks committed, resume
+    budget exhausted, first token ALREADY emitted: the decode worker
+    salvages the committed pages, seeds the emitted token, re-prefills
+    only the tail — token-identical, no re-emit, tripwire clean."""
+    prompt = list(range(50, 90))   # 5 pages; chunks 0-2 commit
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+    s0 = XFER_STATS.salvaged_pages
+    arm = FaultSchedule(0, [FaultSpec("fail_n", n=1000, skip=3)])
+    toks, reason, dec = _run_disagg(
+        pre_request("ovs", prompt), arm=arm, link_retries=1)
+    assert reason == "length" and toks == expect
+    assert dec.early_first_emits == 1
+    assert dec.overlap_fallbacks == 1
+    assert dec.salvaged_prefills == 1 and dec.full_reprefills == 0
+    assert dec.majority_committed_full_reprefills == 0
+    assert XFER_STATS.salvaged_pages - s0 == 3
+    # the emitted first token was charged, not recomputed differently:
+    # exactly max_tokens tokens reached the client (no duplicate first)
+    assert len(toks) == 6
+
+
+def test_overlap_full_fallback_when_nothing_committed():
+    """Link dead from chunk 0 with the first token already emitted:
+    nothing committed -> full local re-prefill through the committed-
+    prefix resume machinery; the stream still matches the oracle and
+    the first token is never re-emitted."""
+    prompt = list(range(60, 100))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = make_engine().generate(prompt, params, "direct")
+    arm = FaultSchedule(0, [FaultSpec("fail_n", n=1000)])
+    toks, reason, dec = _run_disagg(
+        pre_request("ovf", prompt), arm=arm, link_retries=0)
+    assert reason == "length" and toks == expect
+    assert dec.early_first_emits == 1
+    assert dec.overlap_fallbacks == 1
+    assert dec.full_reprefills == 1 and dec.salvaged_prefills == 0
+    assert dec.majority_committed_full_reprefills == 0
+    assert len(toks) == 6
+
+
+# -- scheduler-level gate unit coverage ---------------------------------------
+
+
+def test_overlap_gate_promotes_exactly_at_watermark():
+    eng = make_engine()
+    prompt = list(range(100, 140))   # 5 pages
+    params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    alloc = eng.allocate_remote(EngineRequest("g1", prompt, params))
+    assert alloc is not None
+    frontier = {"v": 0}
+    eng.preactivate_remote("g1", 321, len(alloc.page_ids),
+                           lambda: frontier["v"])
+    # below the watermark: no activation, seq stays remote
+    assert not eng.has_work()
+    assert "g1" in eng.scheduler.remote
+    frontier["v"] = len(alloc.page_ids) - 1
+    assert not eng.has_work()
+    # at the watermark: promoted into the normal waiting flow
+    frontier["v"] = len(alloc.page_ids)
+    assert eng.has_work()
+    assert "g1" not in eng.scheduler.remote
+    assert eng.scheduler.overlap_activations == 1
+    seq = eng.scheduler.waiting[0]
+    assert seq.output == [321]
+
+
+def test_overlap_gate_cancel_and_release_semantics():
+    eng = make_engine()
+    prompt = list(range(100, 132))
+    params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    alloc = eng.allocate_remote(EngineRequest("g2", prompt, params))
+    eng.preactivate_remote("g2", 5, len(alloc.page_ids), lambda: 0)
+    # pending gate: cancel reports True and decode never activates
+    assert eng.cancel_overlap("g2") is True
+    assert eng.cancel_overlap("g2") is False   # already disarmed
+    assert "g2" in eng.scheduler.remote        # allocation untouched
+    # release drops a still-armed gate with the allocation
+    alloc2 = eng.allocate_remote(EngineRequest("g3", prompt, params))
+    eng.preactivate_remote("g3", 5, len(alloc2.page_ids), lambda: 0)
+    eng.release_remote("g3")
+    assert not eng.scheduler.overlap_gates
+    assert not eng.has_work()

@@ -631,6 +631,94 @@ def test_transfer_budget_exhausted_fails_fast():
     asyncio.run(main())
 
 
+def test_resume_overhead_folds_into_goodput_ewma():
+    """ISSUE 11 satellite: a link cut mid-stream makes the sender
+    re-send its unacked chunk(s), but the TransferCostModel sample must
+    count each chunk's payload ONCE over the transfer's total wall
+    time — the bandwidth EWMA reflects lossy-link delivered goodput,
+    never raw wire speed inflated by re-sent bytes. A scripted endpoint
+    makes the re-send deterministic (receive chunk 1, cut WITHOUT
+    committing it — with the real server, whether the in-flight window
+    committed before the resume handshake is a race); the live-stack
+    lossy path is covered by the seeded resume matrix above."""
+    import numpy as np
+
+    import msgpack
+
+    from dynamo_tpu.disagg.remote_transfer import transfer_key
+    from dynamo_tpu.observability.fleet import TRANSFER_MODEL
+    from dynamo_tpu.runtime.transports.wire import read_frame, write_frame
+
+    observed = []
+    real_observe = TRANSFER_MODEL.observe
+    TRANSFER_MODEL.observe = lambda link, nbytes, seconds: observed.append(
+        (link, nbytes, seconds))
+    wire_chunks = []   # every chunk frame that crossed, incl. re-sends
+
+    async def main():
+        plane = MemoryPlane()
+        conn_n = [0]
+
+        async def on_connect(reader, writer):
+            conn_n[0] += 1
+            first = conn_n[0] == 1
+            try:
+                while True:
+                    try:
+                        frame = await read_frame(reader)
+                    except (asyncio.IncompleteReadError,
+                            ConnectionResetError):
+                        return
+                    if frame.get("op") == "resume":
+                        # first stream starts fresh; the reconnect
+                        # learns chunk 0 committed (chunk 1 did NOT)
+                        write_frame(writer, {
+                            "ok": True, "committed": 0 if first else 1})
+                        await writer.drain()
+                        continue
+                    wire_chunks.append(frame["chunk_idx"])
+                    if first and frame["chunk_idx"] >= 1:
+                        # chunk 1 received but never committed/acked:
+                        # cut the link — a deterministic re-send
+                        writer.close()
+                        return
+                    write_frame(writer, {"ok": True,
+                                         "chunk_idx": frame["chunk_idx"]})
+                    await writer.drain()
+            finally:
+                writer.close()
+
+        server = await asyncio.start_server(on_connect, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        await plane.kv.put(
+            transfer_key("fake"),
+            msgpack.packb({"host": "127.0.0.1", "port": port},
+                          use_bin_type=True))
+        transfer = RemoteTransferBackend(plane.kv, chunk_pages=1,
+                                         window_chunks=1)
+        z = np.zeros((2, 2, 5, 8, 4), np.float32)   # 5 pages -> 5 chunks
+        await asyncio.wait_for(
+            transfer.send_pages("fake", "rg", [0, 1, 2, 3, 4], z, z), 30)
+        await transfer.close()
+        server.close()
+        await server.wait_closed()
+
+    try:
+        asyncio.run(main())
+    finally:
+        TRANSFER_MODEL.observe = real_observe
+    # chunk 1 crossed the wire twice (cut + resume), everything else once
+    assert wire_chunks == [0, 1, 1, 2, 3, 4]
+    assert len(observed) == 1
+    link, goodput_bytes, seconds = observed[0]
+    assert link == "fake" and seconds > 0
+    # the goodput sample is the UNIQUE payload: 5 equal chunks counted
+    # exactly once despite 6 chunk frames on the wire
+    per_chunk = goodput_bytes // 5
+    assert goodput_bytes == per_chunk * 5
+    assert per_chunk > 0
+
+
 # -- TRUE two-process disaggregation ------------------------------------------
 
 def _free_port():

@@ -103,6 +103,51 @@ def test_transfer_cost_model_ewma_and_estimate():
     assert m.links() == ["w0"]
 
 
+def test_transfer_cost_model_cold_start_fleet_median():
+    """ISSUE 11 satellite pin: a never-measured link estimates at the
+    fleet-median bandwidth with cold=True — neither free (zero cost)
+    nor infinitely penalized."""
+    from dynamo_tpu.observability.fleet import TransferCostModel
+    m = TransferCostModel(default_bytes_per_s=1e9)
+    # nothing measured anywhere: the default prior, still cold
+    est = m.estimate("ghost", 1_000_000)
+    assert est.cold and est.seconds == pytest.approx(1e-3)
+    m.observe("slow", 1_000_000, 1.0)     # 1 MB/s
+    m.observe("mid", 10_000_000, 1.0)     # 10 MB/s
+    m.observe("fast", 100_000_000, 1.0)   # 100 MB/s
+    assert m.fleet_median_bytes_per_s() == pytest.approx(1e7)
+    est = m.estimate("ghost", 10_000_000)
+    assert est.cold
+    assert est.seconds == pytest.approx(1.0)      # finite, median-priced
+    assert est.seconds > 0.0                      # never free
+    assert not m.estimate("fast", 1).cold
+    # estimate_s stays the scalar view of the same cold-aware answer
+    assert m.estimate_s("ghost", 10_000_000) == pytest.approx(1.0)
+
+
+def test_transfer_cost_model_backlog_and_estimator_error():
+    from dynamo_tpu.observability.fleet import TransferCostModel
+    m = TransferCostModel(alpha=0.5)
+    m.observe("w0", 10_000_000, 1.0)      # believes 10 MB/s
+    # estimator error records BEFORE each subsequent sample folds in:
+    # a transfer at the believed speed -> ~0 error; a 2x-slower one ->
+    # under-estimate (negative signed error)
+    m.observe("w0", 10_000_000, 1.0)
+    assert m.est_err_frac("w0") == pytest.approx(0.0, abs=1e-6)
+    m.observe("w0", 10_000_000, 2.0)
+    assert m.est_err_frac("w0") < 0.0
+    assert m.mean_abs_est_err() > 0.0
+    assert "est_err_frac" in m.snapshot()["w0"]
+    # in-flight backlog: queue_s prices the unfinished bytes at the
+    # link's bandwidth and drains back to zero on completion
+    m.note_inflight("w0", 5_000_000)
+    assert m.backlog_bytes("w0") == 5_000_000
+    assert m.queue_s("w0") > 0.0
+    m.note_done("w0", 5_000_000)
+    assert m.backlog_bytes("w0") == 0
+    assert m.queue_s("w0") == 0.0
+
+
 # -- Histogram.quantile --------------------------------------------------------
 
 
@@ -414,6 +459,38 @@ def test_trace_explain_summary_uses_bucket_quantiles():
     lines = [ln for ln in out.splitlines() if "http.request" in ln
              or "kv.transfer " in ln]
     assert lines[0].strip().startswith("http.request")
+    # the pre-ISSUE-11 artifact carries no est_s attrs: the estimator
+    # table must NOT appear (old goldens render unchanged)
+    assert "estimator" not in out
+
+
+def test_trace_explain_link_estimator_table():
+    """ISSUE 11 satellite: kv.transfer spans carrying the sender's
+    pre-send est_s attr render a per-link estimated-vs-actual column —
+    a stale-fast EWMA (under-estimate) shows as negative err%."""
+    import os
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(root, "tools"))
+    from trace_explain import link_estimator_table, summarize
+
+    def span(link, est, dur, cold=False):
+        return {"trace_id": "t", "span_id": link + str(est), "ts": 0.0,
+                "dur": dur, "name": "kv.transfer",
+                "attrs": {"engine_id": link, "est_s": est,
+                          "bytes": 1000, "est_cold": cold}}
+
+    spans = [span("fast", 0.010, 0.010),
+             span("stale", 0.010, 0.100),     # 10x under-estimated
+             span("coldlink", 0.020, 0.030, cold=True)]
+    table = "\n".join(link_estimator_table(spans))
+    assert "stale" in table and "fast" in table
+    stale_row = next(ln for ln in table.splitlines() if "stale" in ln)
+    assert "-90.0" in stale_row          # (est - act)/act = -90%
+    cold_row = next(ln for ln in table.splitlines() if "coldlink" in ln)
+    assert cold_row.rstrip().endswith("1")   # cold estimate counted
+    # the table folds into --summary output
+    assert "estimator" in summarize(spans)
 
 
 def test_fleet_r10_artifact_contracts():

@@ -70,8 +70,16 @@ def render_summary(summary: dict, slo: Optional[dict] = None,
         out.append(f"  kv-transfer links ({len(links)} measured):")
         for link, snap in sorted(links.items()):
             mbs = snap["bytes_per_s"] / 1e6
+            # estimator error (signed EWMA of est-vs-actual transfer
+            # time; TransferCostModel): negative = the bandwidth EWMA
+            # is stale-fast and the router under-prices this link.
+            # Older artifacts carry no err field -> "-" (unchanged).
+            err = snap.get("est_err_frac")
+            err_txt = f" err {err * 100:+.1f}%" if err is not None else ""
+            backlog = snap.get("backlog_bytes")
+            bl_txt = f" backlog {backlog >> 20}MiB" if backlog else ""
             out.append(f"    {link:<24} {mbs:10.1f} MB/s "
-                       f"({snap['samples']} samples)")
+                       f"({snap['samples']} samples){err_txt}{bl_txt}")
     if slo:
         out.append("  slo burn:")
         for name, st in sorted(slo.items()):

@@ -27,7 +27,8 @@ while true; do
       && [ -e PARITY_TPU_r06_int8.json ] \
       && [ -e BENCH_SELF_r06_int8_churn.json ] \
       && [ -e PARITY_TPU_r06_kvq.json ] \
-      && [ -e BENCH_SELF_r06_kvq.json ]; then
+      && [ -e BENCH_SELF_r06_kvq.json ] \
+      && [ -e BENCH_SELF_r11_overlap_tpu.json ]; then
     echo "[watch] all TPU evidence captured; exiting" >&2
     exit 0
   fi
@@ -188,6 +189,35 @@ json.dump(r, open("BENCH_SELF_r06_kvq.json", "w"), indent=1)
 EOF
             cp "$kl" BENCH_SELF_r06_kvq.log 2>/dev/null
             echo "[watch] kv_quant bench captured: $kvalue" >&2 ;;
+        esac
+      fi
+      if [ ! -e BENCH_SELF_r11_overlap_tpu.json ]; then
+        # disagg TTFT overlap A/B on hardware (ISSUE 11): the bench's
+        # transfer_overlap phase (agg vs disagg-wait vs disagg-early
+        # TTFT + routing A/B) on the flagship, and — via the supervisor's
+        # ratio trajectory rows — a real row for the
+        # disagg_decode_gain_llama3_1b_tpu / disagg_agg_ttft_ratio
+        # gates in BASELINE.json (tools/bench_compare.py scores it)
+        echo "[watch] -> transfer-overlap bench" >&2
+        rm -f .bench_state.json
+        oj=/tmp/bench_o_$$.json ol=/tmp/bench_o_$$.log
+        BENCH_RUN_ID=BENCH_SELF_r11_overlap_tpu BENCH_KVQ=0 \
+          BENCH_BUDGET_S=1200 timeout 1500 python bench.py \
+            >"$oj" 2>"$ol"
+        ovalue=$(python -c "import json,sys;print(json.load(open(sys.argv[1]))['extras'].get('transfer_overlap',{}).get('disagg_agg_ttft_ratio_early',0))" \
+            "$oj" 2>/dev/null || echo 0)
+        case "$ovalue" in
+          0|0.0|"") echo "[watch] transfer-overlap bench got no ratio" >&2 ;;
+          *)
+            python - "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$oj" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[2]))
+r["timestamp"] = sys.argv[1]
+r["self_measured"] = True
+json.dump(r, open("BENCH_SELF_r11_overlap_tpu.json", "w"), indent=1)
+EOF
+            cp "$ol" BENCH_SELF_r11_overlap_tpu.log 2>/dev/null
+            echo "[watch] transfer-overlap captured: ratio $ovalue" >&2 ;;
         esac
       fi
       if [ ! -e BENCH_SELF_r05_spec.json ] \

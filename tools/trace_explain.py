@@ -229,7 +229,44 @@ def summarize(spans: List[dict]) -> str:
         if name not in totals:
             out.append(f"  {name:<28}{instants[name]:>7}"
                        f"{'instant':>11}")
+    table = link_estimator_table(spans)
+    if table:
+        out.append("")
+        out.extend(table)
     return "\n".join(out)
+
+
+def link_estimator_table(spans: List[dict]) -> List[str]:
+    """Per-link estimated-vs-actual transfer-time table from kv.transfer
+    spans carrying the sender's pre-send `est_s` attr (the
+    TransferCostModel's answer at dispatch time). The diagnosis surface
+    for routing regressions caused by a stale bandwidth EWMA: a link
+    whose err% goes strongly negative is being under-estimated (the
+    EWMA believes it faster than it is) and the transfer-aware router
+    is over-routing onto it. Empty when no span carries an estimate
+    (pre-ISSUE-11 artifacts render unchanged)."""
+    links: Dict[str, List[dict]] = {}
+    for s in spans:
+        attrs = s.get("attrs") or {}
+        if s["name"] == "kv.transfer" and "est_s" in attrs \
+                and s.get("dur", 0.0) > 0.0:
+            links.setdefault(attrs.get("engine_id", "?"), []).append(s)
+    if not links:
+        return []
+    out = ["kv transfer estimator (per link, est vs actual):",
+           f"  {'link':<24}{'sends':>6}{'bytes':>12}{'est ms':>9}"
+           f"{'act ms':>9}{'err %':>8}{'cold':>6}"]
+    for link in sorted(links):
+        rows = links[link]
+        est = sum((r["attrs"].get("est_s") or 0.0) for r in rows)
+        act = sum(r["dur"] for r in rows)
+        nbytes = sum((r["attrs"].get("bytes") or 0) for r in rows)
+        cold = sum(1 for r in rows if r["attrs"].get("est_cold"))
+        err = (est - act) / act * 100 if act else 0.0
+        out.append(f"  {link:<24}{len(rows):>6}{nbytes:>12}"
+                   f"{est * 1e3:>9.2f}{act * 1e3:>9.2f}{err:>8.1f}"
+                   f"{cold:>6}")
+    return out
 
 
 def main(argv=None) -> int:
