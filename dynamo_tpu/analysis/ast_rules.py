@@ -81,6 +81,18 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       carry `# dynalint: cost-fallback-ok=<reason>`. A cold or
       degraded-stale estimate silently treated as a measurement is
       exactly how a router over-commits to an unmeasured link
+- R17 actuation pacing contract (dynamo_tpu/ + tools/): a call to the
+      fleet actuators — `mark_draining(...)`, `set_role(...)`,
+      `re_role(...)`, `re_register(...)`, or `.drain(...)` on a
+      worker/endpoint/served/instance receiver — placed inside a loop
+      or a controller tick (a function named *tick*/*actuate*/
+      *controller*/*rebalance*) must visibly engage pacing — the
+      enclosing function references a cooldown/hysteresis/backoff/
+      jitter object — or carry `# dynalint: actuation-ok=<reason>`.
+      An unpaced actuation loop is a fleet-drainer: a controller that
+      re-roles on every tick of a bad sensor mass-drains the fleet
+      faster than any storm (runtime/autoscaler.py owns the sanctioned
+      Cooldown/Hysteresis objects)
 """
 from __future__ import annotations
 
@@ -1264,6 +1276,106 @@ def r16_cost_fallback_contract(tree: ast.AST, lines: List[str],
             "the selector's freeze state), document the default, or "
             "annotate with `# dynalint: cost-fallback-ok=<why the "
             "fallback is safe here>`"))
+    return out
+
+
+# -- R17: fleet actuations in loops/controller ticks must be paced ------------
+
+# Scope: the dynamo_tpu package and tools/ (controllers and storm
+# drivers both actuate). The actuators this repo ships — graceful drain
+# (`mark_draining`/`.drain()` on a worker-shaped receiver) and role
+# re-registration (`set_role`/`re_role`/`re_register`) — are safe as
+# one-shot operator actions; the failure mode is the LOOP: a controller
+# tick or retry loop that actuates on every pass turns one bad sensor
+# reading into a fleet-wide drain. The rule demands the enclosing
+# function visibly engage pacing (cooldown/hysteresis/backoff/jitter —
+# the runtime/autoscaler.py Cooldown+Hysteresis objects, a Backoff, a
+# seeded jittered restart) or carry `# dynalint: actuation-ok=<reason>`
+# within three lines above. Lexical like R16: the pacing argument
+# should be written down where the actuation happens.
+_R17_SCOPE = ("dynamo_tpu/", "tools/")
+_R17_ALWAYS = {"mark_draining", "set_role", "re_role", "re_register"}
+_R17_DRAIN_RECV_RE = re.compile(
+    r"worker|endpoint|served|instance|engine_proc", re.I)
+_R17_ANNOT_RE = re.compile(r"#\s*dynalint:\s*actuation-ok=\S+")
+_R17_PACED_RE = re.compile(r"cooldown|hysteresis|backoff|jitter", re.I)
+_R17_TICK_FN_RE = re.compile(r"tick|actuate|controller|rebalance", re.I)
+
+
+def _r17_is_actuation(node: ast.Call) -> bool:
+    name = _call_name(node)
+    terminal = name.rsplit(".", 1)[-1]
+    if terminal in _R17_ALWAYS:
+        return True
+    if terminal == "drain":
+        recv = name.rsplit(".", 1)[0] if "." in name else ""
+        return bool(_R17_DRAIN_RECV_RE.search(recv))
+    return False
+
+
+@rule("R17")
+def r17_actuation_pacing_contract(tree: ast.AST, lines: List[str],
+                                  path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R17_SCOPE) or "tests/" in norm:
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R17_ANNOT_RE.search(_line(lines, x))
+                   for x in range(ln - 3, ln + 1))
+
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def enclosing(ln: int):
+        inner = None
+        for fn in funcs:
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= ln <= end and (
+                    inner is None or fn.lineno >= inner.lineno):
+                inner = fn
+        return inner
+
+    def paced(ln: int) -> bool:
+        fn = enclosing(ln)
+        if fn is None:
+            lo, hi = max(1, ln - 10), min(len(lines), ln + 10)
+        else:
+            lo, hi = fn.lineno, getattr(fn, "end_lineno", fn.lineno)
+        return any(_R17_PACED_RE.search(_line(lines, x))
+                   for x in range(lo, hi + 1))
+
+    # actuations inside a loop, plus every actuation in a function
+    # whose name says it IS the repeated context (a controller tick)
+    suspects: Dict[int, ast.Call] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and _r17_is_actuation(sub):
+                    suspects[sub.lineno] = sub
+    for fn in funcs:
+        if not _R17_TICK_FN_RE.search(fn.name):
+            continue
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) and _r17_is_actuation(sub):
+                suspects[sub.lineno] = sub
+
+    out: List[Finding] = []
+    for ln in sorted(suspects):
+        node = suspects[ln]
+        if annotated(ln) or paced(ln):
+            continue
+        out.append(_finding(
+            "R17", path, lines, node,
+            f"`{_call_name(node)}(...)` actuates a drain/re-role inside "
+            "a loop or controller tick without visible pacing — an "
+            "unpaced actuation loop lets one wedged sensor mass-drain "
+            "the fleet (every tick moves more workers)",
+            "pace the loop with a cooldown/hysteresis object "
+            "(runtime/autoscaler.py Cooldown/Hysteresis), a Backoff, or "
+            "seeded jitter, or annotate with "
+            "`# dynalint: actuation-ok=<why unpaced actuation is safe "
+            "here>`"))
     return out
 
 

@@ -40,6 +40,13 @@ log = logging.getLogger("dynamo_tpu.disagg")
 class DisaggDecodeWorker(NativeEngineWorker):
     """Decode worker with conditional remote prefill."""
 
+    # instance-key role (llm/worker.serve_llm_worker metadata): a real
+    # disagg fleet's decode workers carry role=decode on discovery, so
+    # `Client.ids_for_role`, the fleet rollup's per-role aggregates,
+    # and the autoscaler's re-role path see the split without any
+    # per-deployment config (runtime/autoscaler.py)
+    serving_role = "decode"
+
     def __init__(self, engine, messaging, disagg_router: DisaggregatedRouter,
                  prefill_queue: PrefillQueue, component=None,
                  worker_id: str = "", prefill_timeout_s: float = 120.0,
@@ -631,6 +638,13 @@ class PrefillWorker:
     queued, or aborts it mid-run — either way the lease is settled so the
     dead item is never redelivered.
     """
+
+    # discovery role for embedders that register the inner engine
+    # (serve_llm_worker(..., role=PrefillWorker.serving_role)); the
+    # queue consumer itself is not a routed endpoint, but a fleet that
+    # wants its prefill capacity visible to the rollup's per-role
+    # aggregates and the autoscaler registers it under this role
+    serving_role = "prefill"
 
     def __init__(self, worker: NativeEngineWorker, queue: PrefillQueue,
                  transfer: TransferBackend, messaging,

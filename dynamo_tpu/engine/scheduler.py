@@ -337,6 +337,10 @@ class Scheduler:
         # share a step with paged decode rows, so sp engines stay legacy.
         self.mixed_token_budget = (cfg.mixed_token_budget
                                    if cfg.sp == 1 else 0)
+        # floor for runtime budget actuation (set_mixed_token_budget):
+        # the smallest prefill bucket must still fit one chunk row next
+        # to a decode row, or the budget silently starves prefill
+        self._mixed_budget_floor = 2 * min(cfg.prefill_buckets)
         # monotonic epoch source shared by admission AND preemption: the
         # engine's device-resident decode carry and the sampler's host
         # array caches key slots by (request_id, epoch), so every
@@ -692,6 +696,23 @@ class Scheduler:
             parent = seq.page_hashes[-1] if seq.page_hashes else 0
             h = self.allocator.seal(seq.pages[i], parent, all_tokens[i * ps:(i + 1) * ps])
             seq.page_hashes.append(h)
+
+    def set_mixed_token_budget(self, budget: int) -> int:
+        """Runtime actuation point for the mixed-step token budget —
+        what the autoscaler's ledger-driven self-tuning leg
+        (runtime/autoscaler.py MixedBudgetTuner) adjusts as padding
+        waste shifts with the traffic shape. Clamped, never a silent
+        MODE flip: sp engines stay legacy-alternating (0) and a
+        positive request never lands below the floor where the
+        smallest prefill chunk row no longer fits next to a decode
+        row. Returns the applied value."""
+        budget = int(budget)
+        if self.cfg.sp != 1 or budget <= 0:
+            applied = 0 if self.cfg.sp != 1 else max(0, budget)
+        else:
+            applied = max(self._mixed_budget_floor, budget)
+        self.mixed_token_budget = applied
+        return applied
 
     def schedule(self):
         """Return a MixedPlan, PrefillPlan, DecodePlan, or None (idle).

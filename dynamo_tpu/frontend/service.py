@@ -157,6 +157,16 @@ class HttpService:
             name: m.gauge(f"llm_engine_{name}",
                           f"engine step ledger: {name.replace('_', ' ')}")
             for name in LedgerStats.FIELDS}
+        # closed-loop autoscaler (runtime/autoscaler.py
+        # AUTOSCALER_STATS): decisions by kind, cooldown/hysteresis
+        # suppressions, do-no-harm refusals, degraded-freeze ticks,
+        # last-decision age, and the budget-tuner leg — same
+        # render-time fold
+        from dynamo_tpu.runtime.autoscaler import AutoscalerStats
+        self._autoscaler = {
+            name: m.gauge(f"llm_autoscaler_{name}",
+                          f"fleet autoscaler: {name.replace('_', ' ')}")
+            for name in AutoscalerStats.FIELDS}
         s = self.server
         s.route("POST", "/v1/chat/completions", self._chat)
         s.route("POST", "/v1/completions", self._completions)
@@ -225,6 +235,9 @@ class HttpService:
         from dynamo_tpu.observability.ledger import LEDGER_STATS
         for name, value in LEDGER_STATS.snapshot().items():
             self._engine[name].set(value=float(value))
+        from dynamo_tpu.runtime.autoscaler import AUTOSCALER_STATS
+        for name, value in AUTOSCALER_STATS.snapshot().items():
+            self._autoscaler[name].set(value=float(value))
 
     async def _chat(self, req: Request):
         try:

@@ -334,7 +334,7 @@ class NativeEngineWorker(AsyncEngine):
 
 async def serve_llm_worker(runtime, namespace: str, component: str,
                            engine: AsyncEngine, endpoint: str = "generate",
-                           card=None):
+                           card=None, role: str = None):
     """Register + serve an LLM engine endpoint with stats wired up.
 
     Also wires the KV event publisher for engines that support one but
@@ -353,8 +353,18 @@ async def serve_llm_worker(runtime, namespace: str, component: str,
     if getattr(engine, "event_publisher", "absent") is None:
         engine.event_publisher = KvEventPublisher(comp, runtime.worker_id)
     stats = getattr(engine, "stats_handler", None)
-    metadata = {"model_card": card.to_dict()} if card is not None else None
-    served = await ep.serve(engine, metadata=metadata, stats_handler=stats)
+    metadata = {"model_card": card.to_dict()} if card is not None else {}
+    # serving role on the instance key (runtime/component.instance_role):
+    # what `Client.ids_for_role`, the fleet rollup's per-role aggregates,
+    # and the autoscaler's re-role actuation key on. Disagg engines
+    # self-describe (DisaggDecodeWorker.serving_role); aggregated
+    # engines stay role-less wildcards.
+    role = role if role is not None else getattr(engine, "serving_role",
+                                                 None)
+    if role is not None:
+        metadata["role"] = role
+    served = await ep.serve(engine, metadata=metadata or None,
+                            stats_handler=stats)
     return served
 
 

@@ -179,6 +179,14 @@ class MetricsExporter:
             name: r.gauge(f"{PREFIX}_router_{name}",
                           f"router scoring: {name.replace('_', ' ')}")
             for name in RouterScoringStats.FIELDS}
+        # closed-loop autoscaler counters (runtime/autoscaler.py), same
+        # render-time refresh — when this process hosts the controller
+        # these are its decision health, otherwise they render 0
+        from dynamo_tpu.runtime.autoscaler import AutoscalerStats
+        self.g_autoscaler = {
+            name: r.gauge(f"{PREFIX}_autoscaler_{name}",
+                          f"fleet autoscaler: {name.replace('_', ' ')}")
+            for name in AutoscalerStats.FIELDS}
         self._client = None
         self._aggregator: Optional[KvMetricsAggregator] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -363,6 +371,9 @@ class MetricsExporter:
         from dynamo_tpu.kv_router.stats import ROUTER_STATS
         for name, value in ROUTER_STATS.snapshot().items():
             self.g_router[name].set(value=float(value))
+        from dynamo_tpu.runtime.autoscaler import AUTOSCALER_STATS
+        for name, value in AUTOSCALER_STATS.snapshot().items():
+            self.g_autoscaler[name].set(value=float(value))
 
     # -- http -----------------------------------------------------------------
 

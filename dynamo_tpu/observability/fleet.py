@@ -312,6 +312,43 @@ class FleetRollup:
                 sum(m.engine_tok_s for m in workers.values()), ts)
             rec("fleet/recompiles_total",
                 sum(m.engine_recompiles for m in workers.values()), ts)
+        # per-role aggregates (ISSUE 12 satellite): the prefill/decode
+        # split read once here, from the instance-key role field, so
+        # the autoscaler and fleet_top consume one schema instead of
+        # re-deriving it per consumer. Draining counts come from the
+        # watch-maintained instance info (a draining worker still
+        # answers $STATS, so it appears in `workers` too).
+        from dynamo_tpu.runtime.component import (
+            STATUS_DRAINING, instance_role, instance_status,
+        )
+        instances = getattr(self.client, "instances", None) or {}
+        role_members: Dict[str, list] = {}
+        role_draining: Dict[str, int] = {}
+        for worker_id, info in instances.items():
+            role = instance_role(info)
+            if role is None:
+                continue
+            if instance_status(info) == STATUS_DRAINING:
+                role_draining[role] = role_draining.get(role, 0) + 1
+                role_members.setdefault(role, [])
+            elif worker_id in workers:
+                role_members.setdefault(role, []).append(workers[worker_id])
+            else:
+                role_members.setdefault(role, [])
+        for role, members in role_members.items():
+            ready = len(members)
+            drn = role_draining.get(role, 0)
+            rec(f"role/{role}/workers", float(ready), ts)
+            rec(f"role/{role}/draining", float(drn), ts)
+            rec(f"role/{role}/availability",
+                ready / max(1, ready + drn), ts)
+            if members:
+                rec(f"role/{role}/queue_depth",
+                    float(sum(m.num_requests_waiting for m in members)), ts)
+                total_slots = sum(m.request_total_slots for m in members)
+                rec(f"role/{role}/occupancy",
+                    sum(m.request_active_slots for m in members)
+                    / max(1, total_slots), ts)
         # serving-path latency quantiles (the SLO evaluator's TTFT/ITL
         # sources; Histogram.quantile — observability/metrics.py)
         from dynamo_tpu.observability.serving import SERVING
@@ -377,6 +414,10 @@ class FleetRollup:
 
         workers = sorted({n.split("/")[1]
                           for n in st.names("worker/")})
+        roles: Dict[str, dict] = {}
+        for name in st.names("role/"):
+            _, role, field = name.split("/", 2)
+            roles.setdefault(role, {})[field] = agg(name)
         return {
             "ts": round(ts, 3),
             "scrapes": self.scrapes,
@@ -387,5 +428,19 @@ class FleetRollup:
                         for name in st.names("serving/")},
             "cp": {name.split("/", 1)[1]: agg(name)
                    for name in st.names("cp/")},
+            "roles": roles,
             "links": self.model.snapshot(),
         }
+
+    def per_role(self) -> Dict[str, dict]:
+        """Latest per-role aggregates (the controller's sensor view;
+        `signals_from_rollup` folds these series plus the watchdog's
+        burn state into one FleetSignals)."""
+        out: Dict[str, dict] = {}
+        for name in self.store.names("role/"):
+            _, role, field = name.split("/", 2)
+            series = self.store.get(name)
+            latest = series.latest() if series is not None else None
+            if latest is not None:
+                out.setdefault(role, {})[field] = latest
+        return out

@@ -64,6 +64,16 @@ def instance_status(info: Optional[Dict[str, Any]]) -> str:
     return info.get("status", STATUS_READY)
 
 
+def instance_role(info: Optional[Dict[str, Any]]) -> Optional[str]:
+    """Serving role carried in the instance-key JSON ("role":
+    "prefill"/"decode" on disaggregated fleets). Absent => None: a
+    role-less instance serves everything (aggregated fleets need no
+    migration), so role-filtered callers treat None as wildcard."""
+    if not info:
+        return None
+    return info.get("role")
+
+
 def instance_key(ns: str, comp: str, endpoint: str, worker_id: str) -> str:
     return f"{ns}/components/{comp}/{endpoint}:{worker_id}"
 
@@ -350,6 +360,50 @@ class ServedEndpoint:
         return {"worker_id": self.worker_id, "inflight_at_start":
                 started_with, "cancelled": cancelled}
 
+    async def re_role(self, role: str, drain_timeout_s: float = 30.0,
+                      poll_s: float = 0.05) -> dict:
+        """Re-register this LIVE instance under a new serving role —
+        the real-worker leg of the autoscaler's "this decode worker
+        becomes a prefill worker" actuation (runtime/autoscaler.py).
+
+        Fence ordering (the drain-vs-schedule race guard): the
+        instance key is re-put with status=DRAINING first, so every
+        watching client/router drops it from `ids_for_role(old_role)`
+        the moment that event is applied; then in-flight response
+        streams get up to `drain_timeout_s` to finish (they are NOT
+        cancelled — a role change is planned maintenance of the
+        routing table, not of the streams); only then does the ready
+        re-put with the NEW role land. Between the two puts the
+        instance is schedulable for NEITHER role, so old-role work can
+        never race onto a worker that has already re-roled.
+        """
+        rt = self.endpoint._rt
+        from_role = self.info.get("role")
+        started_with = len(self.inflight)
+        try:
+            await self.mark_draining()
+        except Exception:  # dynalint: swallow-ok=re-role-proceeds-without-fence
+            log.exception("re_role: marking %s draining failed; "
+                          "re-roling anyway", self.worker_id)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + drain_timeout_s
+        while self.inflight and loop.time() < deadline:
+            await asyncio.sleep(poll_s)
+        lingering = len(self.inflight)
+        if lingering:
+            log.warning("re_role %s: %d stream(s) still in flight at "
+                        "the drain deadline; re-roling around them",
+                        self.worker_id, lingering)
+        self.info["role"] = role
+        self.info.pop("status", None)
+        self.draining = False
+        await rt.kv.put(self.endpoint.key_for(self.worker_id),
+                        json.dumps(self.info).encode(),
+                        rt.lease.id if rt.lease else 0)
+        return {"worker_id": self.worker_id, "from_role": from_role,
+                "to_role": role, "inflight_at_start": started_with,
+                "lingering": lingering}
+
     async def shutdown(self):
         # idempotent (drain calls it, then runtime.shutdown sweeps all
         # served endpoints again) and ordered: the instance KEY goes
@@ -396,6 +450,11 @@ class Client:
         self._ids_dirty = True
         self._ready_cache: List[str] = []
         self._draining_cache: List[str] = []
+        # per-role dispatchable ids (re-role fence reads; role-less
+        # instances are wildcards kept separately so aggregated fleets
+        # answer every role without per-call scans)
+        self._role_cache: Dict[str, List[str]] = {}
+        self._roleless_cache: List[str] = []
 
     def add_listener(self,
                      cb: Callable[[str, str, Optional[dict]], None]) -> None:
@@ -513,12 +572,22 @@ class Client:
     def _recompute_ids(self) -> None:
         ready: List[str] = []
         draining: List[str] = []
+        roleless: List[str] = []
+        by_role: Dict[str, List[str]] = {}
         for w in sorted(self.instances):
-            if instance_status(self.instances[w]) == STATUS_DRAINING:
+            info = self.instances[w]
+            if instance_status(info) == STATUS_DRAINING:
                 draining.append(w)
+                continue
+            ready.append(w)
+            role = instance_role(info)
+            if role is not None:
+                by_role.setdefault(role, []).append(w)
             else:
-                ready.append(w)
+                roleless.append(w)
         self._ready_cache, self._draining_cache = ready, draining
+        self._role_cache = by_role
+        self._roleless_cache = roleless
         self._ids_dirty = False
 
     def instance_ids(self, include_draining: bool = False) -> List[str]:
@@ -539,6 +608,24 @@ class Client:
         if self._ids_dirty:
             self._recompute_ids()
         return self._draining_cache
+
+    def ids_for_role(self, role: str) -> List[str]:
+        """Dispatchable instance ids serving `role` — the re-role fence:
+        a worker flipped to DRAINING (the first leg of
+        `ServedEndpoint.re_role` / `SimWorker.set_role`) leaves this
+        list the moment its watch event is APPLIED, and only re-enters
+        under its NEW role's list with the ready re-put, so there is no
+        window where old-role work can schedule onto it. Role-less
+        instances (aggregated fleets) count for every role. Returns
+        the watch-maintained cache: callers must not mutate it."""
+        if self._ids_dirty:
+            self._recompute_ids()
+        declared = self._role_cache.get(role, [])
+        if not self._roleless_cache:
+            return declared
+        if not declared:
+            return self._roleless_cache
+        return sorted(declared + self._roleless_cache)
 
     # -- routing -------------------------------------------------------------
 

@@ -113,6 +113,10 @@ class SimWorker:
                                stable_reset_s=5.0,
                                rng=random.Random(rng.randrange(1 << 30)))
         self._event_id = 0
+        # serving role carried in the instance key ("prefill"/"decode";
+        # None = aggregated wildcard) — what the autoscaler re-roles
+        self.role: Optional[str] = None
+        self.re_roles = 0
 
     # -- discovery ------------------------------------------------------------
 
@@ -132,6 +136,8 @@ class SimWorker:
         info = {"namespace": c.namespace, "component": c.component,
                 "endpoint": c.endpoint, "worker_id": self.worker_id,
                 "subject": self._subject}
+        if self.role is not None:
+            info["role"] = self.role
         if status:
             info["status"] = status
         return json.dumps(info).encode()
@@ -186,6 +192,33 @@ class SimWorker:
         await self._kv_retry(
             lambda: self.plane.kv.put(self.key, self._info(STATUS_DRAINING),
                                       self.lease.id if self.lease else 0))
+
+    async def assign_role(self, role: Optional[str]) -> None:
+        """Declare/replace this worker's serving role in place (initial
+        fleet split; NOT the re-role path — no drain fence)."""
+        self.role = role
+        if self.alive:
+            await self._kv_retry(
+                lambda: self.plane.kv.put(self.key, self._info(),
+                                          self.lease.id if self.lease
+                                          else 0))
+
+    async def set_role(self, role: str) -> None:
+        """Graceful re-role: the autoscaler's "this decode worker
+        becomes a prefill worker" actuation, sim leg (the real-worker
+        twin is `ServedEndpoint.re_role`). Fence ordering: DRAINING
+        re-put under the OLD role first (watching routers drop it from
+        `ids_for_role(old)` at event-apply time), then deregister +
+        re-register under the new role — there is no window where the
+        worker is schedulable for its old role."""
+        if role == self.role:
+            return
+        await self.mark_draining()
+        await asyncio.sleep(0)       # let the draining watch tick land
+        await self.deregister()
+        self.role = role
+        await self.register()
+        self.re_roles += 1
 
     async def deregister(self) -> None:
         self.alive = False
@@ -804,6 +837,362 @@ class SimCluster:
             "measured_links": len(model.links()),
             "mean_abs_est_err": round(model.mean_abs_est_err(), 4),
         }
+
+    # -- closed-loop autoscale storm (ISSUE 12 / ROADMAP item 4) --------------
+
+    async def _await_fence(self, wid: str, timeout_s: float = 2.0) -> bool:
+        """Wait until the client APPLIED the worker's draining/delete
+        watch event (status draining or key gone) — the point after
+        which the re-role fence contract is checkable."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            info = self.client.instances.get(wid)
+            if info is None or info.get("status") == STATUS_DRAINING:
+                return True
+            await asyncio.sleep(0.005)
+        return False
+
+    async def _await_role_visible(self, wid: str, role: str,
+                                  timeout_s: float = 2.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            info = self.client.instances.get(wid)
+            if info is not None and info.get("role") == role \
+                    and info.get("status") != STATUS_DRAINING:
+                return True
+            await asyncio.sleep(0.005)
+        return False
+
+    async def re_role_worker(self, wid: str, role: str,
+                             old_role: Optional[str] = None) -> int:
+        """Drive one worker's graceful re-role through the REAL control
+        plane and enforce the drain-vs-schedule fence: after the
+        draining event is applied, the worker must never appear in
+        `ids_for_role(old_role)` again until its ready re-put under the
+        NEW role. Returns the number of fence violations observed (0 =
+        contract held). Storm-driver pacing (cooldown/hysteresis) is
+        owned by the calling controller."""
+        w = self.workers[wid]
+        old_role = old_role if old_role is not None else w.role
+        violations = 0
+        await w.mark_draining()
+        if await self._await_fence(wid) and old_role is not None \
+                and wid in self.client.ids_for_role(old_role):
+            violations += 1
+            log.error("re-role fence violation: %s still schedulable "
+                      "for %s after draining applied", wid, old_role)
+        await w.set_role(role)
+        await self._await_role_visible(wid, role)
+        if old_role is not None and wid in self.client.ids_for_role(old_role):
+            violations += 1
+            log.error("re-role fence violation: %s schedulable for OLD "
+                      "role %s after re-registering as %s",
+                      wid, old_role, role)
+        await self._seed_events(w)
+        return violations
+
+    async def autoscale_storm(self, traffic, ticks: int = 360,
+                              n_prefill: Optional[int] = None,
+                              controller: bool = True,
+                              asc_cfg=None,
+                              degraded_window: tuple = (0, 0),
+                              prompt_tokens: tuple = (200, 600),
+                              decode_tokens: tuple = (24, 96),
+                              prefill_tok_s: float = 400.0,
+                              decode_slots: int = 12,
+                              base_itl_s: float = 0.05,
+                              ttft_objective_s: float = 3.0,
+                              itl_objective_s: float = 0.25,
+                              drain_ticks: int = 2,
+                              warmup_ticks: int = 10) -> dict:
+        """Seeded diurnal + flash-crowd storm over a virtual clock: the
+        closed-loop autoscaler evidence run (AUTOSCALE_r12.json).
+
+        The TRAFFIC/SERVICE model is virtual and pure — arrivals,
+        prefill-queue drain, decode-stream progress, TTFT/ITL samples
+        are all functions of (seed, traffic shape, role layout) at
+        integer virtual seconds — so the controller's decision timeline
+        replays bit-identically from the same plan. The CONTROL PLANE
+        is real: every re-role decision actuates through
+        `SimWorker.set_role` (draining fence -> deregister ->
+        re-register) against the live Client/watch machinery, with the
+        drain-vs-schedule fence contract checked per actuation
+        (`fence_violations` must stay 0).
+
+        Per tick: arrivals join the prefill queue; active prefill
+        workers drain it FIFO at `prefill_tok_s` (completions sample
+        TTFT = completion - arrival and spawn a decode stream on the
+        least-loaded non-draining decode worker); decode workers serve
+        streams at `base_itl_s`, stretched by the over-subscription
+        ratio when streams exceed `decode_slots`; the rollup-schema
+        series (`serving/ttft_p95`, `serving/itl_p99`, `role/*/...`)
+        are recorded at the virtual timestamp; the SLO watchdog
+        evaluates; and — controller mode — the autoscaler ticks on
+        `signals_from_store` over those same series. Re-role drains
+        MIGRATE in-flight decode streams to surviving decode workers
+        (`migrated` counted, `dropped` must stay 0); ticks inside
+        `degraded_window` freeze the controller (zero decisions, the
+        `frozen_degraded` counter advances instead).
+        """
+        from dynamo_tpu.observability.slo import SloSpec, SloWatchdog
+        from dynamo_tpu.observability.timeseries import SeriesStore
+        from dynamo_tpu.runtime.autoscaler import (
+            ROLE_DECODE, ROLE_PREFILL, AutoscalerConfig, AutoscalerStats,
+            FleetAutoscaler, signals_from_store,
+        )
+        cfg = self.cfg
+        ids = sorted(self.workers)
+        if n_prefill is None:
+            n_prefill = len(ids) // 2
+        # deterministic initial split, declared on the real instance keys
+        role_of: Dict[str, str] = {}
+        for i, wid in enumerate(ids):
+            role_of[wid] = ROLE_PREFILL if i < n_prefill else ROLE_DECODE
+        await asyncio.gather(*(self.workers[wid].assign_role(role_of[wid])
+                               for wid in ids))
+
+        store = SeriesStore(interval_s=1.0, capacity=max(600, ticks + 8))
+        wd = SloWatchdog(store, [
+            SloSpec(name="ttft_p95", series="serving/ttft_p95",
+                    objective=ttft_objective_s, mode="above", target=0.9,
+                    short_window_s=8.0, long_window_s=24.0,
+                    burn_threshold=2.0, min_samples=3),
+            SloSpec(name="itl_p99", series="serving/itl_p99",
+                    objective=itl_objective_s, mode="above", target=0.9,
+                    short_window_s=8.0, long_window_s=24.0,
+                    burn_threshold=2.0, min_samples=3),
+        ], degraded_fn=lambda: False)
+        stats = AutoscalerStats()
+        asc = FleetAutoscaler(
+            asc_cfg or AutoscalerConfig(
+                # role minimums at HALF the steady split: the do-no-harm
+                # floor that keeps a lagging occupancy signal from
+                # draining decode below its sustainable capacity
+                min_prefill=max(1, n_prefill // 2),
+                min_decode=max(1, (len(ids) - n_prefill) // 2),
+                # actuation bounds scale with fleet size (2 moves per
+                # decision is controller-speed at 16 workers and
+                # wedged-slow at 64)
+                cooldown_s=8.0, hysteresis_ticks=3,
+                max_moves=max(2, len(ids) // 8),
+                max_moves_per_window=max(10, len(ids) // 2),
+                window_s=60.0,
+                queue_hi=2.0, queue_lo=0.25, occ_hi=0.9, occ_lo=0.3,
+                burn_hi=2.0,
+                target_prefill_frac=n_prefill / max(1, len(ids))),
+            stats=stats)
+
+        # virtual fleet state
+        draining: Dict[str, list] = {}       # wid -> [ticks_left, to_role]
+        spares: List[str] = []               # shed workers (add pool)
+        queue: List[list] = []               # [rid, arrival_ts, remaining]
+        streams: Dict[str, List[list]] = {   # wid -> [[rid, remaining], ..]
+            wid: [] for wid in ids if role_of[wid] == ROLE_DECODE}
+        ttft_window: List[float] = []
+        ttfts: List[float] = []
+        completed = migrated = dropped = 0
+        fence_violations = 0
+        decisions_in_degraded = 0
+        ttft_bad_ticks = itl_bad_ticks = 0
+        peak_queue = 0.0
+        rid_seq = 0
+        req_rng_base = cfg.seed * 7919
+
+        def active(role: str) -> List[str]:
+            return [w for w, r in role_of.items()
+                    if r == role and w not in draining]
+
+        for t in range(ticks):
+            ts = float(t)
+            deg = degraded_window[0] <= t < degraded_window[1]
+            # 1. arrivals
+            for _ in range(traffic.arrivals(t)):
+                rid_seq += 1
+                r = random.Random(req_rng_base + rid_seq)
+                queue.append([rid_seq, ts,
+                              r.randint(*prompt_tokens),
+                              r.randint(*decode_tokens)])
+            peak_queue = max(peak_queue, float(len(queue)))
+            # 2. prefill service (pooled FIFO drain)
+            p_active = active(ROLE_PREFILL)
+            capacity = len(p_active) * prefill_tok_s
+            used = 0.0
+            while queue and capacity > 0:
+                item = queue[0]
+                take = min(item[2], capacity)
+                item[2] -= take
+                capacity -= take
+                used += take
+                if item[2] <= 0:
+                    queue.pop(0)
+                    completed += 1
+                    ttft = (ts + 1.0) - item[1]
+                    ttfts.append(ttft)
+                    ttft_window.append(ttft)
+                    del ttft_window[:-50]
+                    d_active = sorted(active(ROLE_DECODE),
+                                      key=lambda w: (len(streams.get(w, ())),
+                                                     w))
+                    if d_active:
+                        streams.setdefault(d_active[0], []).append(
+                            [item[0], item[3]])
+                    else:
+                        dropped += 1     # no decode target: contract break
+            p_occ = used / max(1.0, len(p_active) * prefill_tok_s)
+            # 3. decode service
+            itl_samples: List[float] = []
+            d_active = active(ROLE_DECODE)
+            total_streams = 0
+            for wid in sorted(streams):
+                ss = streams[wid]
+                if not ss:
+                    continue
+                total_streams += len(ss)
+                itl = base_itl_s * max(1.0, len(ss) / decode_slots)
+                itl_samples.extend([itl] * len(ss))
+                per_stream = 1.0 / itl
+                for s in ss:
+                    s[1] -= per_stream
+                streams[wid] = [s for s in ss if s[1] > 0]
+            total_slots = max(1, len(d_active) * decode_slots)
+            d_occ = total_streams / total_slots
+            # 4. drain progress: completions flip the role on the REAL
+            # control plane and migrate leftover decode streams
+            for wid in list(draining):
+                draining[wid][0] -= 1
+                if draining[wid][0] > 0:
+                    continue
+                to_role = draining.pop(wid)[1]
+                leftover = streams.pop(wid, [])
+                if leftover:
+                    targets = sorted(active(ROLE_DECODE),
+                                     key=lambda w: (len(streams.get(w, ())),
+                                                    w))
+                    if targets:
+                        for i, s in enumerate(leftover):
+                            streams.setdefault(
+                                targets[i % len(targets)], []).append(s)
+                        migrated += len(leftover)
+                    else:
+                        dropped += len(leftover)
+                old = role_of.pop(wid)
+                if to_role is None:       # shed: park the worker
+                    spares.append(wid)
+                    await self.workers[wid].mark_draining()
+                    await self.workers[wid].deregister()
+                else:
+                    role_of[wid] = to_role
+                    if to_role == ROLE_DECODE:
+                        streams.setdefault(wid, [])
+                    fence_violations += await self.re_role_worker(
+                        wid, to_role, old_role=old)
+            # 5. record the rollup-schema series at the virtual ts
+            rec = store.record
+            if ttft_window:
+                rec("serving/ttft_p95",
+                    percentile(sorted(ttft_window), 0.95), ts)
+            rec("serving/itl_p99",
+                percentile(sorted(itl_samples), 0.99)
+                if itl_samples else base_itl_s, ts)
+            for role, occ, qd in ((ROLE_PREFILL, p_occ, float(len(queue))),
+                                  (ROLE_DECODE, d_occ,
+                                   float(max(0, total_streams
+                                             - total_slots)))):
+                ready = len(active(role))
+                drn = sum(1 for w in draining if role_of.get(w) == role)
+                rec(f"role/{role}/workers", float(ready), ts)
+                rec(f"role/{role}/draining", float(drn), ts)
+                rec(f"role/{role}/queue_depth", qd, ts)
+                rec(f"role/{role}/occupancy", occ, ts)
+                rec(f"role/{role}/availability",
+                    ready / max(1, ready + drn), ts)
+            sv = store.get("serving/ttft_p95")
+            if sv is not None and sv.latest() is not None \
+                    and sv.latest() > ttft_objective_s:
+                ttft_bad_ticks += 1
+            if (store.get("serving/itl_p99").latest() or 0.0) \
+                    > itl_objective_s:
+                itl_bad_ticks += 1
+            # 6. watchdog + controller (warmup ticks give the series
+            # their first samples before the controller may act)
+            wd.evaluate(ts)
+            if not controller or t < warmup_ticks:
+                continue
+            sig = signals_from_store(store, wd, ts, degraded=deg,
+                                     drains_active=len(draining))
+            candidates = {
+                ROLE_DECODE: sorted(active(ROLE_DECODE),
+                                    key=lambda w: (len(streams.get(w, ())),
+                                                   w)),
+                ROLE_PREFILL: sorted(active(ROLE_PREFILL)),
+            }
+            decisions = asc.decide(sig, candidates)
+            if deg and decisions:
+                decisions_in_degraded += len(decisions)
+            for d in decisions:
+                if d.kind in ("re_role_to_prefill", "re_role_to_decode"):
+                    for wid in d.workers:
+                        draining[wid] = [drain_ticks, d.to_role]
+                        await self.workers[wid].mark_draining()
+                        if await self._await_fence(wid) and \
+                                wid in self.client.ids_for_role(
+                                    role_of[wid]):
+                            fence_violations += 1
+                elif d.kind == "shed":
+                    for wid in d.workers:
+                        draining[wid] = [drain_ticks, None]
+                        await self.workers[wid].mark_draining()
+                elif d.kind == "add":
+                    for _ in range(d.count):
+                        if not spares:
+                            break
+                        wid = spares.pop()
+                        role_of[wid] = d.to_role
+                        if d.to_role == ROLE_DECODE:
+                            streams.setdefault(wid, [])
+                        w = self.workers[wid]
+                        w.role = d.to_role
+                        await w.register()
+                        await self._seed_events(w)
+
+        lat = sorted(ttfts)
+        report = {
+            "mode": "controller" if controller else "static",
+            "workers": len(ids),
+            "n_prefill_initial": n_prefill,
+            "ticks": ticks,
+            "requests": rid_seq,
+            "completed": completed,
+            "ttft_p50_s": round(percentile(lat, 0.50), 3),
+            "ttft_p95_s": round(percentile(lat, 0.95), 3),
+            "ttft_p99_s": round(percentile(lat, 0.99), 3),
+            "peak_queue": peak_queue,
+            "slo": {
+                "ttft_bad_ticks": ttft_bad_ticks,
+                "itl_bad_ticks": itl_bad_ticks,
+                "alerts": list(wd.alerts),
+                "firing_at_end": wd.firing(),
+            },
+            "streams": {"completed": completed, "migrated": migrated,
+                        "dropped": dropped},
+            "roles_final": {
+                "prefill": len(active(ROLE_PREFILL)),
+                "decode": len(active(ROLE_DECODE)),
+                "spares": len(spares),
+            },
+            "fence_violations": fence_violations,
+            "degraded_window": list(degraded_window),
+        }
+        if controller:
+            report["controller"] = asc.summary()
+            report["controller"]["frozen_degraded"] = stats.frozen_degraded
+            report["controller"]["cooldown_suppressed"] = \
+                stats.cooldown_suppressed
+            report["controller"]["hysteresis_suppressed"] = \
+                stats.hysteresis_suppressed
+            report["controller"]["guard_blocked"] = stats.guard_blocked
+            report["decisions_in_degraded"] = decisions_in_degraded
+        return report
 
     def summary(self) -> dict:
         lat = sorted(self.latencies_us)

@@ -1,0 +1,395 @@
+"""Closed-loop fleet autoscaler (runtime/autoscaler.py, ISSUE 12).
+
+Three layers, cheapest first:
+
+- **decision units**: the do-no-harm machinery — cooldown, hysteresis,
+  role-minimum and concurrent-drain guards, the degraded freeze, and
+  the bounded-actuation window that keeps a wedged sensor from
+  mass-draining the fleet — each driven with synthetic FleetSignals;
+- **determinism**: the decision timeline is a pure function of the
+  seeded signal sequence (two controllers, identical timelines), and
+  the committed AUTOSCALE_r12.json storm replays bit-identically
+  through the live simcluster path;
+- **the tier-1 smoke**: a 64-worker simcluster diurnal + flash-crowd
+  storm where the controller holds the TTFT SLO the static split
+  burns through, with zero dropped streams and zero fence violations.
+
+The `MixedBudgetTuner` (item-4 local self-tuning leg) is unit-tested
+against a real bare Scheduler + StepLedger; the live-engine leg is the
+AUTOSCALE_r12.json `budget_tuning` evidence (tools/fleet_storm.py).
+"""
+import asyncio
+import json
+import os
+
+import pytest
+
+from dynamo_tpu.observability.slo import SloSpec, SloWatchdog
+from dynamo_tpu.observability.timeseries import SeriesStore
+from dynamo_tpu.runtime.autoscaler import (
+    ROLE_DECODE, ROLE_PREFILL, AutoscalerConfig, AutoscalerStats,
+    FleetAutoscaler, FleetSignals, MixedBudgetTuner, RoleState,
+    signals_from_store,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def sig(ts, p_workers=8, d_workers=8, queue=0.0, p_occ=0.5, d_occ=0.5,
+        ttft_burn=0.0, itl_burn=0.0, ttft_firing=False, itl_firing=False,
+        degraded=False, drains=0, p_draining=0, d_draining=0):
+    return FleetSignals(
+        ts=ts,
+        roles={ROLE_PREFILL: RoleState(workers=p_workers,
+                                       draining=p_draining,
+                                       queue_depth=queue,
+                                       occupancy=p_occ),
+               ROLE_DECODE: RoleState(workers=d_workers,
+                                      draining=d_draining,
+                                      occupancy=d_occ)},
+        ttft_burn=ttft_burn, itl_burn=itl_burn,
+        ttft_firing=ttft_firing, itl_firing=itl_firing,
+        degraded=degraded, drains_active=drains)
+
+
+def mk(**over):
+    defaults = dict(min_prefill=2, min_decode=2, cooldown_s=10.0,
+                    hysteresis_ticks=3, max_moves=2,
+                    max_moves_per_window=8, window_s=60.0,
+                    queue_hi=3.0, queue_lo=0.25, occ_hi=0.85,
+                    occ_lo=0.30, burn_hi=1.0)
+    defaults.update(over)
+    stats = AutoscalerStats()
+    return FleetAutoscaler(AutoscalerConfig(**defaults),
+                           stats=stats), stats
+
+
+CANDS = {ROLE_DECODE: [f"d{i}" for i in range(8)],
+         ROLE_PREFILL: [f"p{i}" for i in range(8)]}
+
+
+# -- decision units ------------------------------------------------------------
+
+def test_hysteresis_then_decision_then_cooldown():
+    asc, stats = mk()
+    hot = dict(queue=40.0)      # 5 waiting per prefill worker: hot
+    assert asc.decide(sig(0.0, **hot), CANDS) == []
+    assert asc.decide(sig(1.0, **hot), CANDS) == []
+    assert stats.hysteresis_suppressed == 2
+    out = asc.decide(sig(2.0, **hot), CANDS)
+    assert len(out) == 1
+    d = out[0]
+    assert d.kind == "re_role_to_prefill"
+    assert d.from_role == ROLE_DECODE and d.to_role == ROLE_PREFILL
+    # candidate order is preference order: least-loaded first
+    assert d.workers == ("d0", "d1")
+    assert stats.decisions_total == 1
+    assert stats.decisions_re_role_to_prefill == 1
+    # inside the cooldown the same sustained pressure is suppressed
+    assert asc.decide(sig(3.0, **hot), CANDS) == []
+    assert stats.cooldown_suppressed == 1
+    # ... and fires again once the cooldown elapses
+    assert asc.decide(sig(12.5, **hot), CANDS)[0].kind == \
+        "re_role_to_prefill"
+
+
+def test_one_tick_blip_never_actuates():
+    asc, stats = mk()
+    for t in range(10):
+        blip = (t % 2 == 0)     # alternating pressure: direction resets
+        out = asc.decide(sig(float(t), queue=40.0 if blip else 0.0),
+                         CANDS)
+        assert out == []
+    assert stats.decisions_total == 0
+
+
+def test_role_minimum_guard_refuses_to_drain_below_floor():
+    asc, stats = mk(min_decode=8)    # decode already at its minimum
+    for t in range(6):
+        out = asc.decide(sig(float(t), queue=40.0), CANDS)
+        assert out == []
+    assert stats.guard_blocked > 0
+    assert stats.decisions_total == 0
+
+
+def test_concurrent_drain_guard():
+    asc, stats = mk()
+    for t in range(4):
+        out = asc.decide(sig(float(t), queue=40.0, drains=1), CANDS)
+        assert out == []
+    assert stats.guard_blocked >= 1
+    # the moment the drain finishes, the sustained pressure actuates
+    assert asc.decide(sig(5.0, queue=40.0), CANDS)
+
+
+def test_degraded_freeze_makes_zero_decisions():
+    asc, stats = mk()
+    # build a full streak, then degrade right at the firing tick
+    asc.decide(sig(0.0, queue=40.0), CANDS)
+    asc.decide(sig(1.0, queue=40.0), CANDS)
+    for t in range(2, 8):
+        assert asc.decide(sig(float(t), queue=40.0, degraded=True),
+                          CANDS) == []
+    assert stats.frozen_degraded == 6
+    assert stats.decisions_total == 0
+    # freeze HOLDS the streak (it neither grows nor resets): the first
+    # healthy tick may act on the already-sustained pressure
+    out = asc.decide(sig(8.0, queue=40.0), CANDS)
+    assert len(out) == 1 and stats.frozen_degraded == 6
+
+
+def test_bounded_actuation_caps_a_wedged_sensor():
+    """A sensor pinned at 'bad' forever: total moved workers over any
+    window stays at max_moves_per_window — the fleet is never
+    mass-drained no matter how long the sensor lies."""
+    asc, stats = mk(cooldown_s=1.0, hysteresis_ticks=1,
+                    max_moves_per_window=4, window_s=1000.0,
+                    min_decode=0)
+    cands = {ROLE_DECODE: [f"d{i}" for i in range(50)],
+             ROLE_PREFILL: []}
+    moved = []
+    for t in range(60):
+        for d in asc.decide(sig(float(t), d_workers=50, queue=500.0),
+                            cands):
+            moved.extend(d.workers)
+    assert len(moved) == 4            # the window bound, not 60 ticks' worth
+    assert stats.guard_blocked > 0
+
+
+def test_add_when_both_roles_hot_and_shed_when_idle():
+    asc, _ = mk()
+    for t in range(3):
+        out = asc.decide(sig(float(t), queue=40.0, d_occ=0.95), CANDS)
+    assert out[0].kind == "add" and out[0].count == 2
+    assert out[0].to_role in (ROLE_PREFILL, ROLE_DECODE)
+    asc2, _ = mk()
+    for t in range(3):
+        out = asc2.decide(sig(float(t), queue=0.0, p_occ=0.05,
+                              d_occ=0.05), CANDS)
+    assert out[0].kind == "shed" and out[0].count == 1
+
+
+def test_empty_queue_with_busy_workers_is_not_idle():
+    """Capacity exactly matching demand (empty queue, high occupancy)
+    must not read as excess: no shed."""
+    asc, stats = mk()
+    for t in range(8):
+        assert asc.decide(sig(float(t), queue=0.0, p_occ=0.7,
+                              d_occ=0.6), CANDS) == []
+    assert stats.decisions_total == 0
+
+
+def test_homing_returns_the_split_to_target():
+    asc, _ = mk(target_prefill_frac=0.5)
+    for t in range(3):
+        out = asc.decide(sig(float(t), p_workers=12, d_workers=4,
+                             queue=0.0, p_occ=0.3, d_occ=0.5), CANDS)
+    assert out[0].kind == "re_role_to_decode"
+    assert "homing" in out[0].reason
+    asc2, _ = mk(target_prefill_frac=0.5)
+    for t in range(3):
+        out = asc2.decide(sig(float(t), p_workers=4, d_workers=12,
+                              queue=0.0, p_occ=0.5, d_occ=0.1), CANDS)
+    assert out[0].kind == "re_role_to_prefill"
+    assert "homing" in out[0].reason
+
+
+def test_decision_timeline_is_deterministic():
+    import random
+
+    def timeline(seed):
+        rng = random.Random(seed)
+        asc, _ = mk(cooldown_s=3.0)
+        for t in range(120):
+            hot = 30.0 * (1 + rng.random()) if 40 <= t < 80 else 0.0
+            occ = 0.4 + 0.2 * rng.random()
+            asc.decide(sig(float(t), queue=hot, d_occ=occ), CANDS)
+        return asc.timeline
+
+    assert timeline(7) == timeline(7)
+    assert len(timeline(7)) >= 1
+
+
+def test_signals_from_store_reads_rollup_schema():
+    store = SeriesStore(interval_s=1.0, capacity=64)
+    ts = 100.0
+    for field, v in (("workers", 6.0), ("draining", 1.0),
+                     ("queue_depth", 12.0), ("occupancy", 0.8),
+                     ("availability", 6 / 7)):
+        store.record(f"role/prefill/{field}", v, ts)
+    store.record("role/decode/workers", 10.0, ts)
+    store.record("serving/ttft_p95", 5.0, ts)
+    wd = SloWatchdog(store, [SloSpec(
+        name="ttft_p95", series="serving/ttft_p95", objective=3.0,
+        target=0.9, short_window_s=2.0, long_window_s=4.0,
+        min_samples=1)], degraded_fn=lambda: False)
+    wd.evaluate(ts)
+    s = signals_from_store(store, wd, ts, drains_active=2)
+    p = s.roles[ROLE_PREFILL]
+    assert p.workers == 6 and p.draining == 1
+    assert p.queue_depth == 12.0 and p.occupancy == 0.8
+    assert s.roles[ROLE_DECODE].workers == 10
+    assert s.ttft_burn == wd.states["ttft_p95"].burn_short
+    assert s.drains_active == 2
+
+
+# -- MixedBudgetTuner (ledger -> mixed_token_budget self-tuning) ---------------
+
+def _bare_scheduler(sp=1):
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.scheduler import Scheduler
+    return Scheduler(EngineConfig(
+        page_size=64, num_pages=32, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512, sp=sp))
+
+
+def _ledger():
+    from dynamo_tpu.observability.ledger import LedgerStats, StepLedger
+    return StepLedger(enabled=True, stats=LedgerStats())
+
+
+def _feed(led, useful, padded):
+    led.record_step("mixed", 4, 2, useful, padded, 0, 32, 0, 0, 0, 0,
+                    0, 0)
+
+
+def test_budget_tuner_shrinks_on_padding_waste_bounded():
+    sched = _bare_scheduler()
+    led = _ledger()
+    stats = AutoscalerStats()
+    tuner = MixedBudgetTuner(sched, led, min_tokens=100, cooldown_s=2.0,
+                             hysteresis_ticks=2, min_budget=128,
+                             stats=stats)
+    assert sched.mixed_token_budget == 512
+    budgets = []
+    ts = 0.0
+    for _ in range(30):
+        _feed(led, 100, 512)       # ~80% padding waste
+        ts += 5.0
+        out = tuner.tick(ts)
+        if out is not None:
+            budgets.append(out)
+    # walked down in bounded multiplicative steps, clamped at the floor
+    assert budgets and budgets[-1] == 128
+    assert all(b >= 128 for b in budgets)
+    assert sched.mixed_token_budget == 128
+    assert stats.budget_adjustments == len(budgets)
+    assert stats.budget_current == 128
+    # floor reached: further waste makes no further adjustment
+    before = stats.budget_adjustments
+    _feed(led, 100, 512)
+    assert tuner.tick(ts + 50.0) is None
+    assert stats.budget_adjustments == before
+
+
+def test_budget_tuner_grows_on_low_waste_and_needs_evidence():
+    sched = _bare_scheduler()
+    led = _ledger()
+    tuner = MixedBudgetTuner(sched, led, min_tokens=100, cooldown_s=2.0,
+                             hysteresis_ticks=2, max_budget=1024,
+                             stats=AutoscalerStats())
+    # below the evidence floor: no verdict at all
+    _feed(led, 10, 20)
+    assert tuner.tick(5.0) is None
+    for i in range(6):
+        _feed(led, 500, 512)       # ~2% waste: headroom
+        tuner.tick(10.0 + 5 * i)
+    assert sched.mixed_token_budget > 512
+    assert sched.mixed_token_budget <= 1024
+
+
+def test_budget_tuner_cooldown_and_hysteresis():
+    sched = _bare_scheduler()
+    led = _ledger()
+    tuner = MixedBudgetTuner(sched, led, min_tokens=100,
+                             cooldown_s=100.0, hysteresis_ticks=2,
+                             stats=AutoscalerStats())
+    _feed(led, 100, 512)
+    assert tuner.tick(1.0) is None     # hysteresis: first waste window
+    _feed(led, 100, 512)
+    first = tuner.tick(2.0)            # second window: actuates
+    assert first is not None
+    _feed(led, 100, 512)
+    _feed(led, 100, 512)
+    assert tuner.tick(3.0) is None     # inside the cooldown
+    assert sched.mixed_token_budget == first
+
+
+def test_set_mixed_token_budget_clamps():
+    sched = _bare_scheduler()
+    floor = 2 * 8                      # smallest prefill bucket x 2
+    assert sched.set_mixed_token_budget(4) == floor
+    assert sched.set_mixed_token_budget(999) == 999
+    assert sched.set_mixed_token_budget(0) == 0   # explicit mode flip
+    sp = _bare_scheduler(sp=2)
+    assert sp.set_mixed_token_budget(512) == 0    # sp stays alternating
+
+
+# -- simcluster: the tier-1 smoke + committed-plan replay ----------------------
+
+def _storm(workers, traffic, controller, ticks, degraded_window,
+           seed=10):
+    from dynamo_tpu.runtime.simcluster import SimCluster, SimConfig
+
+    async def main():
+        sim = await SimCluster(SimConfig(
+            workers=workers, streams=workers * 8, lease_ttl_s=30.0,
+            seed=seed)).start()
+        try:
+            return await sim.autoscale_storm(
+                traffic, ticks=ticks, controller=controller,
+                degraded_window=tuple(degraded_window))
+        finally:
+            await sim.stop()
+
+    return asyncio.run(main())
+
+
+def test_autoscale_storm_controller_beats_static_64_workers():
+    """The tier-1 smoke of the AUTOSCALE_r12 contract at 64 workers:
+    the controller holds the TTFT SLO the static 32+32 split burns
+    through, trades away no ITL, drops no streams across its re-role
+    drains, freezes under the degraded window, and never violates the
+    re-role fence."""
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from fleet_storm import TrafficShape
+    traffic = TrafficShape(seed=21, base_rate=20.0)
+    static = _storm(64, traffic, False, 300, (200, 220))
+    ctrl = _storm(64, traffic, True, 300, (200, 220))
+    assert static["slo"]["ttft_bad_ticks"] >= 10
+    assert ctrl["slo"]["ttft_bad_ticks"] <= \
+        static["slo"]["ttft_bad_ticks"] // 2
+    assert ctrl["slo"]["itl_bad_ticks"] <= \
+        static["slo"]["itl_bad_ticks"] + 2
+    assert len(ctrl["controller"]["timeline"]) >= 2
+    assert ctrl["streams"]["dropped"] == 0
+    assert static["streams"]["dropped"] == 0
+    assert ctrl["fence_violations"] == 0
+    assert ctrl["decisions_in_degraded"] == 0
+    assert ctrl["controller"]["frozen_degraded"] == 20
+
+
+def test_autoscale_replay_matches_committed_artifact():
+    """The committed AUTOSCALE_r12.json plan replays bit-identically:
+    same traffic shape + seed through the live simcluster path yields
+    the exact decision timeline (and the same SLO verdicts)."""
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from fleet_storm import TrafficShape
+    path = os.path.join(REPO, "AUTOSCALE_r12.json")
+    if not os.path.exists(path):
+        pytest.skip("AUTOSCALE_r12.json not committed")
+    with open(path) as f:
+        plan = json.load(f)
+    assert plan["ok"] is True
+    traffic = TrafficShape.from_dict(plan["traffic"])
+    replay = _storm(plan["workers"], traffic, True, plan["ticks"],
+                    plan["degraded_window"], seed=plan["seed"])
+    committed = plan["controller"]
+    assert replay["controller"]["timeline"] == \
+        committed["controller"]["timeline"]
+    assert replay["slo"]["ttft_bad_ticks"] == \
+        committed["slo"]["ttft_bad_ticks"]
+    assert replay["streams"] == committed["streams"]
+    assert replay["fence_violations"] == 0

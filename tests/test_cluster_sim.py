@@ -196,3 +196,68 @@ def test_cluster_sim_full_scale_1000_workers():
             await sim.stop()
 
     asyncio.run(asyncio.wait_for(main(), 600))
+
+
+def test_re_role_fence_under_churning_load():
+    """ISSUE 12 satellite: a worker re-registering under a new role is
+    never schedulable for its OLD role between the draining fence and
+    the new-role re-put. Roles churn continuously under role-filtered
+    scheduling load; `re_role_worker` asserts the fence at both edges
+    (after the draining event applies, and after the new-role
+    registration) and the load task cross-checks every pick's live
+    role against the watch-applied instance info."""
+    from dynamo_tpu.runtime.autoscaler import ROLE_DECODE, ROLE_PREFILL
+
+    async def main():
+        sim = await SimCluster(SimConfig(workers=16, streams=64,
+                                         lease_ttl_s=30.0,
+                                         seed=9)).start()
+        try:
+            ids = sorted(sim.workers)
+            for i, wid in enumerate(ids):
+                await sim.workers[wid].assign_role(
+                    ROLE_PREFILL if i < 8 else ROLE_DECODE)
+            # wait for the roles to land on the watch
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while len(sim.client.ids_for_role(ROLE_PREFILL)) != 8:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+
+            stop = asyncio.Event()
+            mismatches = 0
+
+            async def load():
+                nonlocal mismatches
+                while not stop.is_set():
+                    for role in (ROLE_PREFILL, ROLE_DECODE):
+                        for pick in sim.client.ids_for_role(role):
+                            info = sim.client.instances.get(pick)
+                            # the fence contract: a listed pick's
+                            # APPLIED info serves that role (or is a
+                            # role-less wildcard) and is not draining
+                            if info is None or (
+                                    info.get("role") not in (role, None)
+                                    or info.get("status") == "draining"):
+                                mismatches += 1
+                    await asyncio.sleep(0)
+
+            load_task = asyncio.create_task(load())
+            violations = 0
+            # churn: flip 6 workers decode->prefill->decode twice over
+            for _round in range(2):
+                for wid in ids[8:14]:
+                    violations += await sim.re_role_worker(
+                        wid, ROLE_PREFILL, old_role=ROLE_DECODE)
+                for wid in ids[8:14]:
+                    violations += await sim.re_role_worker(
+                        wid, ROLE_DECODE, old_role=ROLE_PREFILL)
+            stop.set()
+            await load_task
+            return violations, mismatches, sim
+        finally:
+            await sim.stop()
+
+    violations, mismatches, sim = asyncio.run(
+        asyncio.wait_for(main(), 60))
+    assert violations == 0
+    assert mismatches == 0

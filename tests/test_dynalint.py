@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R16), the
+- one positive AND one negative fixture per AST rule (R1-R17), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -1067,6 +1067,99 @@ def test_r16_live_on_cost_model_consumers():
             found = lint_source(f.read(), rel)
         assert not [x for x in found if x.rule == "R16"], \
             (rel, [x.message for x in found if x.rule == "R16"])
+
+
+# -- R17: actuation pacing contract --------------------------------------------
+
+R17_BAD = """
+    async def rebalance_loop(workers):
+        while True:
+            for w in workers:
+                await w.mark_draining()
+"""
+
+
+def test_r17_flags_unpaced_actuation_loop():
+    found = lint_source(textwrap.dedent(R17_BAD),
+                        "dynamo_tpu/runtime/fixture.py")
+    assert "R17" in rules(found)
+    found = lint_source(textwrap.dedent(R17_BAD), "tools/fixture.py")
+    assert "R17" in rules(found)
+
+
+def test_r17_flags_controller_tick_without_pacing():
+    tick = """
+        async def tick(self, served_endpoint, role):
+            await served_endpoint.re_role(role)
+    """
+    found = lint_source(textwrap.dedent(tick),
+                        "dynamo_tpu/runtime/fixture.py")
+    assert "R17" in rules(found)
+
+
+def test_r17_quiet_outside_scope_and_on_non_actuators():
+    found = lint_source(textwrap.dedent(R17_BAD), "examples/fixture.py")
+    assert "R17" not in rules(found)
+    # `.drain()` on a non-worker receiver (stream writers, ledgers,
+    # tracers) is not an actuation
+    other = """
+        async def pump(writer, ledger):
+            while True:
+                await writer.drain()
+                ledger.drain(clear=True)
+    """
+    found = lint_source(textwrap.dedent(other),
+                        "dynamo_tpu/runtime/fixture.py")
+    assert "R17" not in rules(found)
+    # a one-shot actuation outside any loop/tick is an operator action
+    oneshot = """
+        async def maintenance(served_endpoint):
+            await served_endpoint.drain(timeout_s=30.0)
+    """
+    found = lint_source(textwrap.dedent(oneshot),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert "R17" not in rules(found)
+
+
+def test_r17_quiet_on_paced_and_annotated_actuators():
+    paced = """
+        async def actuate(self, decisions, workers):
+            # the controller's cooldown+hysteresis pace these drains
+            if not self.cooldown.ready(self.now()):
+                return
+            for d in decisions:
+                await workers[d.worker].set_role(d.to_role)
+    """
+    found = lint_source(textwrap.dedent(paced),
+                        "dynamo_tpu/runtime/fixture.py")
+    assert "R17" not in rules(found)
+    annotated = """
+        async def storm(workers):
+            for w in workers:
+                # dynalint: actuation-ok=seeded chaos storm driver, not
+                # a controller; the whole point is unpaced churn
+                await w.mark_draining()
+    """
+    found = lint_source(textwrap.dedent(annotated),
+                        "tools/fixture.py")
+    assert "R17" not in rules(found)
+
+
+def test_r17_live_on_actuation_call_sites():
+    """Every live drain/re-role call site in a loop or controller tick
+    engages pacing (the autoscaler's Cooldown/Hysteresis, a Backoff, a
+    seeded jitter) or carries a justified annotation."""
+    import glob
+    scoped = glob.glob(os.path.join(REPO, "dynamo_tpu", "**", "*.py"),
+                       recursive=True)
+    scoped += glob.glob(os.path.join(REPO, "tools", "*.py"))
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R17"], \
+            (rel, [x.message for x in found if x.rule == "R17"])
 
 
 # -- jaxpr invariants ----------------------------------------------------------

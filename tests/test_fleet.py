@@ -101,3 +101,60 @@ def test_rollup_feeds_watchdog_availability_drop():
     assert store.get("fleet/availability").latest() == pytest.approx(0.5)
     assert firing == ["avail"]
     assert fired_at is not None
+
+
+def test_rollup_per_role_aggregates_and_signals():
+    """ISSUE 12 satellite: the rollup exposes the prefill/decode split
+    directly (role/* series + per_role + summary.roles) so the
+    autoscaler and fleet_top read one schema instead of re-deriving
+    it; signals_from_rollup folds the same series into FleetSignals."""
+    from dynamo_tpu.runtime.autoscaler import (
+        ROLE_DECODE, ROLE_PREFILL, signals_from_rollup,
+    )
+
+    async def main():
+        sim = await SimCluster(SimConfig(workers=8, streams=64,
+                                         seed=4)).start()
+        store = SeriesStore(interval_s=1.0, capacity=64)
+        rollup = FleetRollup(sim.client, store=store, interval_s=1.0,
+                             model=TransferCostModel(),
+                             expected_workers=8)
+        try:
+            ids = sorted(sim.workers)
+            for i, wid in enumerate(ids):
+                await sim.workers[wid].assign_role(
+                    ROLE_PREFILL if i < 5 else ROLE_DECODE)
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while len(sim.client.ids_for_role(ROLE_PREFILL)) != 5:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            await rollup.scrape_once(ts=100.0)
+            healthy = rollup.per_role()
+            # one prefill worker starts draining: the role aggregates
+            # see it at the next scrape (ready drops, draining counts)
+            await sim.workers[ids[0]].mark_draining()
+            while ids[0] in sim.client.ids_for_role(ROLE_PREFILL):
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            await rollup.scrape_once(ts=101.0)
+            sig = signals_from_rollup(rollup, None, ts=101.0)
+            return healthy, rollup.per_role(), rollup.summary(
+                window_s=5.0, ts=101.0), sig
+        finally:
+            await sim.stop()
+
+    healthy, drained, summary, sig = asyncio.run(main())
+    assert healthy[ROLE_PREFILL]["workers"] == 5
+    assert healthy[ROLE_DECODE]["workers"] == 3
+    assert healthy[ROLE_PREFILL]["availability"] == 1.0
+    assert "queue_depth" in healthy[ROLE_PREFILL]
+    assert "occupancy" in healthy[ROLE_DECODE]
+    assert drained[ROLE_PREFILL]["workers"] == 4
+    assert drained[ROLE_PREFILL]["draining"] == 1
+    assert drained[ROLE_PREFILL]["availability"] == pytest.approx(0.8)
+    # the summary carries the role block (fleet_top renders it)
+    assert summary["roles"][ROLE_PREFILL]["workers"]["last"] == 4.0
+    # and the controller-facing fold reads the same schema
+    assert sig.roles[ROLE_PREFILL].workers == 4
+    assert sig.roles[ROLE_PREFILL].draining == 1
+    assert sig.roles[ROLE_DECODE].workers == 3

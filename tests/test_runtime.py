@@ -575,3 +575,59 @@ def test_dataplane_uses_uds_same_host_and_tcp_when_disabled(monkeypatch):
     monkeypatch.setenv("DYN_DATAPLANE", "tcp")
     accepts, path = run(roundtrip())
     assert path is None and accepts == 0
+
+
+def test_served_endpoint_re_role_fence_and_role_routing():
+    """ISSUE 12: the real-worker re-registration path. A live served
+    instance re-roles decode->prefill through the DRAINING fence; the
+    watching client's `ids_for_role` never lists it for the old role
+    after the fence event applies, and lists it for the new role only
+    after the ready re-put. Role-less instances stay wildcards."""
+    async def main():
+        plane = MemoryPlane()
+        wrt = await DistributedRuntime.create_local(plane, "w-roled")
+        art = await DistributedRuntime.create_local(plane, "w-any")
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        ep = wrt.namespace("ns").component("gen").endpoint("generate")
+        served = await ep.serve(echo_engine, metadata={"role": "decode"})
+        await art.namespace("ns").component("gen").endpoint(
+            "generate").serve(echo_engine)     # role-less wildcard
+        client = crt.namespace("ns").component("gen").endpoint(
+            "generate").client()
+        await client.start()
+        await client.wait_for_instances()
+
+        async def wait_for(pred, timeout=5.0):
+            deadline = asyncio.get_running_loop().time() + timeout
+            while not pred():
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "condition never held"
+                await asyncio.sleep(0.01)
+
+        await wait_for(lambda: "w-roled" in client.ids_for_role("decode"))
+        # the role-less instance serves every role
+        assert "w-any" in client.ids_for_role("decode")
+        assert "w-any" in client.ids_for_role("prefill")
+        assert "w-roled" not in client.ids_for_role("prefill")
+
+        res = await served.re_role("prefill", drain_timeout_s=1.0)
+        assert res["from_role"] == "decode" and res["to_role"] == "prefill"
+        await wait_for(lambda: "w-roled" in client.ids_for_role("prefill"))
+        assert "w-roled" not in client.ids_for_role("decode")
+        assert "w-roled" not in client.draining_ids()
+        # requests still route to the re-roled instance
+        frames = [f async for f in await client.direct(
+            {"n": 2, "text": "post-re-role"}, "w-roled")]
+        assert [f["i"] for f in frames] == [0, 1]
+
+        # mid-fence: a draining re-put removes it from BOTH role lists
+        await served.mark_draining()
+        await wait_for(
+            lambda: "w-roled" not in client.ids_for_role("prefill"))
+        assert "w-roled" not in client.ids_for_role("decode")
+        assert "w-roled" in client.draining_ids()
+        await crt.shutdown()
+        await art.shutdown()
+        await wrt.shutdown()
+
+    run(main())

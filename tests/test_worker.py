@@ -279,3 +279,43 @@ def test_serve_llm_worker_attaches_event_publisher():
         await wrt.shutdown()
 
     asyncio.run(main())
+
+
+def test_serve_llm_worker_publishes_serving_role():
+    """ISSUE 12: an engine that self-describes a serving role
+    (DisaggDecodeWorker.serving_role = "decode") lands it on the
+    instance key, so role-filtered routing and the rollup's per-role
+    aggregates see a real disagg fleet's split; explicit role= wins,
+    and plain engines stay role-less wildcards."""
+    async def main():
+        plane = MemoryPlane()
+        wrt = await DistributedRuntime.create_local(plane, "w-auto")
+        worker = await NativeEngineWorker(make_engine()).start()
+        worker.serving_role = "decode"      # what DisaggDecodeWorker sets
+        await serve_llm_worker(wrt, "ns", "backend", worker)
+        wrt2 = await DistributedRuntime.create_local(plane, "w-explicit")
+        worker2 = await NativeEngineWorker(make_engine()).start()
+        await serve_llm_worker(wrt2, "ns", "backend", worker2,
+                               role="prefill")
+        wrt3 = await DistributedRuntime.create_local(plane, "w-plain")
+        worker3 = await NativeEngineWorker(make_engine()).start()
+        await serve_llm_worker(wrt3, "ns", "backend", worker3)
+
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("backend").endpoint(
+            "generate").client()
+        await client.start()
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while len(client.instances) < 3:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        decode = client.ids_for_role("decode")
+        prefill = client.ids_for_role("prefill")
+        assert "w-auto" in decode and "w-auto" not in prefill
+        assert "w-explicit" in prefill and "w-explicit" not in decode
+        # the role-less worker serves every role
+        assert "w-plain" in decode and "w-plain" in prefill
+        for rt in (crt, wrt, wrt2, wrt3):
+            await rt.shutdown()
+
+    asyncio.run(main())

@@ -1,8 +1,26 @@
 #!/usr/bin/env python
-"""fleet_storm: the resource-telemetry evidence run (FLEET_r10.json).
+"""fleet_storm: telemetry + autoscaler evidence runs.
 
-Produces the full telemetry-plane evidence chain in one run
-(docs/OBSERVABILITY.md §7, ISSUE 10 acceptance):
+Two evidence modes:
+
+`--mode slo` — the resource-telemetry chain (FLEET_r10.json, ISSUE 10):
+engine ledger + 64-worker rollup + SLO fire->clear storm.
+
+`--mode autoscale` (default) — the closed-loop autoscaler chain
+(AUTOSCALE_r12.json, ISSUE 12 / ROADMAP item 4): a seeded diurnal +
+flash-crowd traffic shape (`TrafficShape`) driven through the
+simcluster's virtual-clock `autoscale_storm` twice — once with the
+static prefill/decode split, once with the `FleetAutoscaler` closing
+the loop — plus a controller REPLAY run asserting the decision
+timeline is bit-identical, and a live-engine `MixedBudgetTuner` leg
+showing ledger padding-waste adapting `mixed_token_budget`. Contracts
+(exit 1 on violation): the controller holds the TTFT/ITL SLOs the
+static split burns through (bad-tick count under half of static's),
+zero dropped streams across every re-role drain, zero decisions while
+degraded-frozen, zero re-role fence violations, and the replay
+timeline matches exactly.
+
+Original telemetry-chain description (ISSUE 10):
 
 1. **Per-step engine ledger from a live engine**: a tiny CPU engine
    serves a churn of concurrent requests with the ledger on; the
@@ -32,7 +50,9 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import json
+import math
 import os
 import random
 import sys
@@ -40,6 +60,46 @@ import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficShape:
+    """Seeded diurnal + flash-crowd traffic driver (requests/second at
+    integer virtual-clock ticks). `arrivals(tick)` is a pure function
+    of (shape, tick) — the fractional part of the rate resolves through
+    a per-tick seeded draw, NOT a stateful rng — so any replay of the
+    same shape produces the identical arrival stream regardless of
+    what else consumed randomness (the AUTOSCALE_r12 bit-identical
+    contract rides on this)."""
+
+    seed: int = 12
+    base_rate: float = 5.0        # requests/s at the diurnal midline
+    diurnal_amp: float = 0.4      # peak/trough swing fraction
+    diurnal_period_s: float = 240.0
+    flash_start: int = 100        # flash-crowd window [start, start+len)
+    flash_len: int = 60
+    flash_mult: float = 2.2
+
+    def rate(self, tick: int) -> float:
+        r = self.base_rate * (1.0 + self.diurnal_amp * math.sin(
+            2.0 * math.pi * tick / self.diurnal_period_s))
+        if self.flash_start <= tick < self.flash_start + self.flash_len:
+            r *= self.flash_mult
+        return max(0.0, r)
+
+    def arrivals(self, tick: int) -> int:
+        r = self.rate(tick)
+        n = int(r)
+        frac_rng = random.Random(self.seed * 1000003 + tick)
+        return n + (1 if frac_rng.random() < r - n else 0)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrafficShape":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
 
 
 def run_engine_ledger(jsonl_path: str, quick: bool = False) -> dict:
@@ -195,16 +255,143 @@ async def run_fleet_storm(args) -> dict:
     return report
 
 
+def run_budget_tuner(quick: bool = False) -> dict:
+    """The item-4 local self-tuning leg: a live tiny engine whose
+    ledger padding-waste drives `MixedBudgetTuner` adjustments of
+    `mixed_token_budget` (virtual-clock ticks between step batches).
+    Same engine geometry as `run_engine_ledger` so the jitted programs
+    hit the persistent XLA cache."""
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+    from dynamo_tpu.runtime.autoscaler import AutoscalerStats, MixedBudgetTuner
+    cfg = ModelConfig(dtype="float32", max_model_len=512)
+    eng = NativeEngine(cfg, EngineConfig(
+        page_size=64, num_pages=32, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512, decode_steps=4,
+        pipeline_depth=2), seed=0)
+    eng.ledger.configure(enabled=True)
+    stats = AutoscalerStats()
+    tuner = MixedBudgetTuner(eng.scheduler, eng.ledger,
+                             min_tokens=64, cooldown_s=4.0,
+                             hysteresis_ticks=2, stats=stats)
+    budget0 = eng.scheduler.mixed_token_budget
+    rng = random.Random(13)
+    # tiny staggered prompts under the default (oversized) budget: the
+    # [Bb, Tb] buckets charge far more padding than useful tokens, so
+    # the windowed waste fraction sits over pad_hi and the tuner walks
+    # the budget down
+    n_reqs = 4 if quick else 10
+    pending = [(f"tune{i}",
+                [rng.randrange(3, 250) for _ in range(rng.randrange(6, 18))],
+                SamplingParams(max_tokens=5 + (i % 4), temperature=0.0,
+                               ignore_eos=True))
+               for i in range(n_reqs)]
+    eng.add_request(EngineRequest(*pending.pop(0)))
+    vts = 0.0
+    while eng.has_work() or pending:
+        if pending and eng.step_count % 3 == 1:
+            eng.add_request(EngineRequest(*pending.pop(0)))
+        for _ in eng.step():
+            pass
+        vts += 2.5                 # virtual seconds per engine step
+        tuner.tick(vts)
+    final = eng.scheduler.mixed_token_budget
+    pad = eng.ledger.pad_fraction()
+    eng.close()
+    return {"budget_initial": budget0, "budget_final": final,
+            "adjustments": tuner.adjustments,
+            "n_adjustments": stats.budget_adjustments,
+            "pad_waste_frac": round(pad, 4)}
+
+
+async def run_autoscale_storm(args) -> dict:
+    """The AUTOSCALE_r12 evidence chain: static vs controller vs
+    controller-replay over the identical seeded plan."""
+    from dynamo_tpu.runtime.simcluster import SimCluster, SimConfig
+    traffic = TrafficShape(seed=args.seed + 1)
+    degraded_window = (args.degraded_start,
+                       args.degraded_start + args.degraded_len)
+
+    async def one_run(controller: bool) -> dict:
+        sim = await SimCluster(SimConfig(
+            workers=args.autoscale_workers,
+            streams=args.autoscale_workers * 8,
+            lease_ttl_s=30.0,       # virtual storm: no expiry churn leg
+            seed=args.seed)).start()
+        try:
+            return await sim.autoscale_storm(
+                traffic, ticks=args.ticks, controller=controller,
+                degraded_window=degraded_window)
+        finally:
+            await sim.stop()
+
+    static = await one_run(False)
+    ctrl = await one_run(True)
+    replay = await one_run(True)
+
+    deg_len = degraded_window[1] - degraded_window[0]
+    contracts = {
+        # the static 8+8 split genuinely burns through the TTFT SLO...
+        "static_split_burns":
+            static["slo"]["ttft_bad_ticks"] >= 10,
+        # ...and the controller holds it (less than half the bad ticks)
+        # without trading it for ITL burn
+        "controller_holds_ttft":
+            ctrl["slo"]["ttft_bad_ticks"]
+            <= max(2, static["slo"]["ttft_bad_ticks"] // 2),
+        "controller_holds_itl":
+            ctrl["slo"]["itl_bad_ticks"]
+            <= static["slo"]["itl_bad_ticks"] + 2,
+        "controller_acted": len(ctrl["controller"]["timeline"]) >= 2,
+        "zero_dropped_streams":
+            static["streams"]["dropped"] == 0
+            and ctrl["streams"]["dropped"] == 0
+            and replay["streams"]["dropped"] == 0,
+        "zero_decisions_while_degraded":
+            ctrl["decisions_in_degraded"] == 0
+            and ctrl["controller"]["frozen_degraded"] == deg_len,
+        "zero_fence_violations":
+            ctrl["fence_violations"] == 0
+            and replay["fence_violations"] == 0,
+        # bit-identical replay: the whole decision timeline, not a hash
+        "replay_bit_identical":
+            replay["controller"]["timeline"]
+            == ctrl["controller"]["timeline"],
+    }
+    return {
+        "traffic": traffic.to_dict(),
+        "ticks": args.ticks,
+        "workers": args.autoscale_workers,
+        "seed": args.seed,
+        "degraded_window": list(degraded_window),
+        "static": static,
+        "controller": ctrl,
+        "replay_timeline_len": len(replay["controller"]["timeline"]),
+        "contracts": contracts,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="fleet_storm", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--workers", type=int, default=64)
+    ap.add_argument("--mode", choices=("autoscale", "slo"),
+                    default="autoscale")
+    ap.add_argument("--workers", type=int, default=64,
+                    help="fleet size for the slo-mode storm")
     ap.add_argument("--seed", type=int, default=10)
     ap.add_argument("--phase-ticks", type=int, default=15,
-                    help="scrape/evaluate ticks per storm phase")
-    ap.add_argument("--out", default=os.path.join(REPO_ROOT,
-                                                  "FLEET_r10.json"))
+                    help="scrape/evaluate ticks per slo storm phase")
+    ap.add_argument("--ticks", type=int, default=360,
+                    help="virtual seconds of the autoscale storm")
+    ap.add_argument("--autoscale-workers", type=int, default=16,
+                    help="fleet size of the autoscale storm (8+8 split)")
+    ap.add_argument("--degraded-start", type=int, default=210)
+    ap.add_argument("--degraded-len", type=int, default=30)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default FLEET_r10.json / "
+                         "AUTOSCALE_r12.json by mode)")
     ap.add_argument("--ledger-out",
                     default=os.path.join(REPO_ROOT, "LEDGER_r10.jsonl"))
     ap.add_argument("--quick", action="store_true")
@@ -213,8 +400,33 @@ def main(argv=None) -> int:
     if args.quick:
         args.workers = min(args.workers, 16)
         args.phase_ticks = min(args.phase_ticks, 8)
+        args.ticks = min(args.ticks, 240)
 
     t0 = time.time()
+    if args.mode == "autoscale":
+        out = args.out or os.path.join(REPO_ROOT, "AUTOSCALE_r12.json")
+        report = asyncio.run(run_autoscale_storm(args))
+        report["budget_tuning"] = run_budget_tuner(quick=args.quick)
+        report["contracts"]["budget_tuner_adjusted"] = \
+            report["budget_tuning"]["n_adjustments"] >= 1
+        report["elapsed_s"] = round(time.time() - t0, 1)
+        report["ok"] = all(report["contracts"].values())
+        print(json.dumps({
+            "contracts": report["contracts"],
+            "static_ttft_bad_ticks":
+                report["static"]["slo"]["ttft_bad_ticks"],
+            "controller_ttft_bad_ticks":
+                report["controller"]["slo"]["ttft_bad_ticks"],
+            "decisions": report["controller"]["controller"]["timeline"],
+            "budget_tuning": report["budget_tuning"],
+            "elapsed_s": report["elapsed_s"]}, indent=1))
+        if not args.no_artifact:
+            from tools.artifacts import write_json
+            write_json(out, report)
+            print(f"committed {out}", file=sys.stderr)
+        return 0 if report["ok"] else 1
+
+    out = args.out or os.path.join(REPO_ROOT, "FLEET_r10.json")
     ledger_path = args.ledger_out if not args.no_artifact \
         else os.path.join("/tmp", "LEDGER_quick.jsonl")
     if os.path.exists(ledger_path) and args.no_artifact:
@@ -233,8 +445,8 @@ def main(argv=None) -> int:
                       "elapsed_s": report["elapsed_s"]}, indent=1))
     if not args.no_artifact:
         from tools.artifacts import write_json
-        write_json(args.out, report)
-        print(f"committed {args.out} (+ {args.ledger_out})",
+        write_json(out, report)
+        print(f"committed {out} (+ {args.ledger_out})",
               file=sys.stderr)
     return 0 if report["ok"] else 1
 

@@ -54,6 +54,19 @@ def render_summary(summary: dict, slo: Optional[dict] = None,
             out.append(f"    {name:<20} {_fmt(agg.get('last')):>10} "
                        f"{_fmt(agg.get('avg')):>10} "
                        f"{_fmt(agg.get('max')):>10}")
+    roles = summary.get("roles") or {}
+    if roles:
+        # the prefill/decode split the autoscaler steers (rollup
+        # `role/*` series; older artifacts carry no roles -> omitted)
+        out.append("  roles:")
+        for role, fields in sorted(roles.items()):
+            vals = {k: (a or {}).get("last") for k, a in fields.items()}
+            out.append(
+                f"    {role:<10} workers={_fmt(vals.get('workers'), 0)} "
+                f"draining={_fmt(vals.get('draining'), 0)} "
+                f"queue={_fmt(vals.get('queue_depth'), 1)} "
+                f"occ={_fmt(vals.get('occupancy'))} "
+                f"avail={_fmt(vals.get('availability'))}")
     serving = summary.get("serving") or {}
     for name, agg in sorted(serving.items()):
         if agg:
