@@ -82,6 +82,7 @@ PHASE_STALL_S = {
     "ttft": 150.0,
     "churn": 150.0,
     "transfer_overlap": 300.0,   # two extra engine builds (disagg pair)
+    "warm_prefix": 300.0,        # four engine builds sharing one program set
     "parity": 300.0,         # second engine build + single-step compiles
     "spec_ceiling": 600.0,   # spec-twin engine build + verify compile
 }
@@ -398,12 +399,22 @@ def supervise() -> int:
                                    "llama3-1b").replace("-", "_"),
                     "tpu" if probing else "cpu")
                 to = best["extras"].get("transfer_overlap") or {}
+                wp = best["extras"].get("warm_prefix") or {}
+                if "failure" in wp:
+                    wp = {}
                 ratios = {
                     f"disagg_agg_ttft_ratio_early_{suffix}":
                         to.get("disagg_agg_ttft_ratio_early")
                         if "failure" not in to else None,
                     f"disagg_decode_gain_{suffix}":
                         best["extras"].get("disagg_decode_gain"),
+                    # warm-prefix ladder (ISSUE 13): cross-worker
+                    # pool-fetch TTFT over cold, and prefetch over fetch
+                    # — both gated "lower" in BASELINE.json
+                    f"warm_prefix_pool_fetch_ttft_ratio_{suffix}":
+                        wp.get("pool_fetch_cold_ttft_ratio"),
+                    f"warm_prefix_prefetch_fetch_ttft_ratio_{suffix}":
+                        wp.get("prefetch_fetch_ttft_ratio"),
                 }
                 for metric, value in ratios.items():
                     if value and value > 0:
@@ -916,6 +927,156 @@ def run_transfer_overlap_ab(model_cfg, base_kwargs=None, *, requests=6,
     return result
 
 
+def run_warm_prefix(model_cfg, base_kwargs=None, *, requests=4,
+                    shared_pages=6, n_chips=1, touch=lambda: None,
+                    logf=None):
+    """Cluster-pool warm-prefix TTFT ladder for extras["warm_prefix"]
+    (ISSUE 13, ROADMAP item 2 — the millions-of-users shared-system-
+    prompt scenario):
+
+    1. cold        — a never-seen prefix prefills from scratch (the
+                     denominator);
+    2. local_hit   — the SAME engine re-serves the prefix (HBM prefix
+                     cache, the pre-pool best case);
+    3. pool_fetch  — the prefix was prefilled on engine A and published
+                     into the SharedKvPool; engine B serves it by
+                     fetching the pages at admission (cross-worker
+                     reuse, no recompute);
+    4. pool_prefetch — engine B additionally warmed the pages into HBM
+                     during a simulated admission wait
+                     (engine.prefetch_pool_pages, the PRESERVE window),
+                     so the walk hits device memory.
+
+    Distinct shared prefixes per measured request keep each fetch
+    genuinely cold on the serving engine; every TTFT sample is also
+    observed into the llm_ttft_seconds histogram (SERVING.ttft).
+    Greedy token identity pool-vs-cold is asserted inline — a pool
+    serve that changed tokens would poison the measurement. CPU
+    validation proves plumbing + ratio direction; the TPU ladder item
+    (BENCH_SELF_r13_warm_prefix_tpu) gives the hardware verdict."""
+    import time as _time
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.kv_pool import POOL_STATS, SharedKvPool
+    from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+    from dynamo_tpu.observability.serving import SERVING
+
+    logf = logf or log
+    kw = dict(base_kwargs or PAGE_KWARGS)
+    ps = kw["page_size"]
+    pmod = min(1000, model_cfg.vocab_size - 2)
+    # bound the prefix so (requests+1) distinct prefixes fit engine A's
+    # page budget alongside a decode allocation
+    shared_pages = max(2, min(shared_pages,
+                              kw["num_pages"] // (2 * (requests + 1))))
+    shared_len = shared_pages * ps
+    params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+
+    def prefix(i):
+        return [(7 * i + 3 * j) % pmod + 1 for j in range(shared_len)]
+
+    def tail(i):
+        return [(311 + 13 * i + 5 * j) % pmod + 1 for j in range(ps)]
+
+    def ttft(eng, rid, prompt):
+        t0 = _time.perf_counter()
+        eng.add_request(EngineRequest(rid, prompt, params))
+        toks = []
+        while True:
+            for ev in eng.step():
+                if ev.request_id == rid and ev.token is not None:
+                    if not toks:
+                        dt = _time.perf_counter() - t0
+                    toks.append(ev.token)
+                if ev.request_id == rid and ev.finished:
+                    SERVING.ttft.observe("bench-warm-prefix", value=dt)
+                    return dt, toks
+        # unreachable: max_tokens bounds the loop
+
+    def build(pool=None, wid=""):
+        eng = NativeEngine(model_cfg, EngineConfig(**kw), seed=0)
+        if pool is not None:
+            eng.attach_kv_pool(pool, wid)
+        touch()
+        return eng
+
+    def p50(vals):
+        return round(sorted(vals)[len(vals) // 2] * 1e3, 2)
+
+    pool = SharedKvPool(capacity_pages=kw["num_pages"] * 2)
+    # engine A prefills every shared prefix and publishes it: the drain
+    # tees sealed pages to the publish stream, which checksums at
+    # capture; fetches below re-verify (engine/kv_pool.py)
+    a = build(pool, "warm-a")
+    for i in range(requests + 1):
+        a.generate(prefix(i), params, f"seed-{i}")
+        a.drain_kv_events()
+        touch()
+    a._pool_stream.drain()
+    seeded_entries = len(pool)
+
+    cold = build()          # no pool: the from-scratch denominator
+    b = build(pool, "warm-b")
+    c = build(pool, "warm-c")
+    # compile warmup on every engine (prefix 0 is the warm spare —
+    # never measured), so XLA compiles sit outside every timing
+    for eng, tag in ((cold, "w0"), (b, "w1"), (c, "w2")):
+        ttft(eng, f"warm-{tag}", prefix(0) + tail(0))
+        touch()
+
+    cold_v, local_v, fetch_v, pre_v = [], [], [], []
+    identical = True
+    for i in range(1, requests + 1):
+        prompt = prefix(i) + tail(i)
+        dt, cold_toks = ttft(cold, f"cold-{i}", prompt)
+        cold_v.append(dt)
+        dt, _ = ttft(cold, f"local-{i}", prompt)   # same engine: HBM hit
+        local_v.append(dt)
+        fetched_before = b.scheduler.pool_fetched_pages
+        dt, pool_toks = ttft(b, f"fetch-{i}", prompt)
+        fetch_v.append(dt)
+        identical &= pool_toks == cold_toks
+        assert b.scheduler.pool_fetched_pages > fetched_before, \
+            "pool-fetch mode served without fetching (measurement void)"
+        # PRESERVE window: warm BEFORE admission, then measure
+        warmed = c.prefetch_pool_pages(prompt)
+        assert warmed >= shared_pages - 1, \
+            f"prefetch warmed {warmed} < {shared_pages - 1} pages"
+        dt, _ = ttft(c, f"pre-{i}", prompt)
+        pre_v.append(dt)
+        touch()
+    for eng in (a, cold, b, c):
+        eng.close()
+    del a, cold, b, c
+
+    result = {
+        "shared_len": shared_len, "requests": requests,
+        "pool_entries_seeded": seeded_entries,
+        "cold_ttft_p50_ms": p50(cold_v),
+        "local_hit_ttft_p50_ms": p50(local_v),
+        "pool_fetch_ttft_p50_ms": p50(fetch_v),
+        "pool_prefetch_ttft_p50_ms": p50(pre_v),
+        "pool_fetch_cold_ttft_ratio":
+            round(p50(fetch_v) / max(p50(cold_v), 1e-9), 3),
+        "prefetch_fetch_ttft_ratio":
+            round(p50(pre_v) / max(p50(fetch_v), 1e-9), 3),
+        "token_identity_greedy": identical,
+        "pool_counters": {k: POOL_STATS.snapshot()[k] for k in (
+            "publishes", "dedup_hits", "fetch_hits", "fetch_misses",
+            "prefetch_pages", "quarantined")},
+    }
+    logf(f"warm-prefix TTFT p50: cold {result['cold_ttft_p50_ms']}ms, "
+         f"local-hit {result['local_hit_ttft_p50_ms']}ms, pool-fetch "
+         f"{result['pool_fetch_ttft_p50_ms']}ms "
+         f"({result['pool_fetch_cold_ttft_ratio']}x cold), pool-prefetch "
+         f"{result['pool_prefetch_ttft_p50_ms']}ms "
+         f"({result['prefetch_fetch_ttft_ratio']}x fetch); greedy "
+         f"identity {'OK' if identical else 'BROKEN'}")
+    touch()
+    return result
+
+
 def run_parity(model_cfg, engine_box=None, touch=lambda: None, logf=None):
     """Window-vs-single-step greedy token parity on the current backend.
 
@@ -1367,6 +1528,21 @@ def worker():
         except Exception as e:  # evidence phase must not kill the capture
             log(f"transfer overlap A/B failed ({type(e).__name__}: {e})")
             st.result["extras"]["transfer_overlap"] = {"failure": str(e)}
+        st.touch()
+
+    if os.environ.get("BENCH_WARM_PREFIX", "1") != "0" \
+            and time.time() - T0 < BUDGET_S - 120:
+        st.set_phase("warm_prefix")
+        log("phase: warm-prefix TTFT ladder — cold vs local-hit vs "
+            "pool-fetch vs pool-prefetch over the shared KV pool "
+            "(ISSUE 13)")
+        try:
+            st.result["extras"]["warm_prefix"] = run_warm_prefix(
+                model_cfg, PAGE_KWARGS, n_chips=n_chips, touch=st.touch,
+                logf=log)
+        except Exception as e:  # evidence phase must not kill the capture
+            log(f"warm-prefix ladder failed ({type(e).__name__}: {e})")
+            st.result["extras"]["warm_prefix"] = {"failure": str(e)}
         st.touch()
 
     if os.environ.get("BENCH_KVQ", "1") != "0" \

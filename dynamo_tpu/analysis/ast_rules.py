@@ -93,6 +93,17 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       re-roles on every tick of a bad sensor mass-drains the fleet
       faster than any storm (runtime/autoscaler.py owns the sanctioned
       Cooldown/Hysteresis objects)
+- R18 shared-pool verification contract (dynamo_tpu/ + tools/): any
+      shared-KV-pool data-path call — `publish`/`fetch`/`note_source`/
+      a `*pool*claim*` on a pool-shaped receiver, or
+      `prefetch_pool_pages(...)` — must sit in a function that visibly
+      references the checksum-verification story (checksum/verify/
+      integrity/quarantine vocabulary) or carry
+      `# dynalint: pool-verify-ok=<reason>`. Pool pages cross worker
+      boundaries content-addressed; a call site that moves them without
+      stating where the capture checksum is verified is exactly where a
+      refactor can silently drop verify-on-fetch and launder rotten
+      bytes into a device cache (engine/kv_pool.py owns the contract)
 """
 from __future__ import annotations
 
@@ -1376,6 +1387,94 @@ def r17_actuation_pacing_contract(tree: ast.AST, lines: List[str],
             "seeded jitter, or annotate with "
             "`# dynalint: actuation-ok=<why unpaced actuation is safe "
             "here>`"))
+    return out
+
+
+# -- R18: shared-pool data paths must reference checksum verification ---------
+
+# Scope: the dynamo_tpu package and tools/ (the serving path and the
+# diagnosis tooling both touch pool pages). The shared pool
+# (engine/kv_pool.py SharedKvPool) moves KV pages ACROSS worker
+# boundaries keyed only by content hash — there is no allocator epoch or
+# scheduler.remote guard between a pool entry and a device cache, the
+# traveling capture checksum is the whole integrity story. The rule is
+# lexical like R16: the enclosing function must write down where that
+# verification happens (checksum/verify/integrity/quarantine vocabulary
+# — a docstring pointing at the claim-time verify counts, and should) or
+# the call carries `# dynalint: pool-verify-ok=<reason>` within three
+# lines above. Matched calls: `publish` / `fetch` / `note_source` on a
+# receiver whose dotted name mentions "pool" (SharedKvPool handles;
+# HostKvPool exposes none of these, so the private tiers stay quiet),
+# any `*pool*claim*` terminal, and `prefetch_pool_pages` anywhere.
+_R18_SCOPE = ("dynamo_tpu/", "tools/")
+_R18_POOL_TERMINALS = {"publish", "fetch", "note_source"}
+_R18_ANNOT_RE = re.compile(r"#\s*dynalint:\s*pool-verify-ok=\S+")
+_R18_HANDLED_RE = re.compile(r"checksum|verif|integrity|quarantin", re.I)
+
+
+def _r18_is_pool_call(node: ast.Call) -> bool:
+    name = _call_name(node)
+    terminal = name.rsplit(".", 1)[-1]
+    if terminal == "prefetch_pool_pages":
+        return True
+    low = terminal.lower()
+    if "pool" in low and "claim" in low:
+        return True
+    if terminal not in _R18_POOL_TERMINALS:
+        return False
+    recv = name.rsplit(".", 1)[0] if "." in name else ""
+    return "pool" in recv.lower()
+
+
+@rule("R18")
+def r18_pool_verification_contract(tree: ast.AST, lines: List[str],
+                                   path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R18_SCOPE) \
+            or "tests/" in norm:
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R18_ANNOT_RE.search(_line(lines, x))
+                   for x in range(ln - 3, ln + 1))
+
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def enclosing_handles(ln: int) -> bool:
+        inner = None
+        for fn in funcs:
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= ln <= end and (
+                    inner is None or fn.lineno >= inner.lineno):
+                inner = fn
+        if inner is None:
+            lo, hi = max(1, ln - 10), min(len(lines), ln + 10)
+        else:
+            lo, hi = inner.lineno, getattr(inner, "end_lineno",
+                                           inner.lineno)
+        return any(_R18_HANDLED_RE.search(_line(lines, x))
+                   for x in range(lo, hi + 1))
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _r18_is_pool_call(node):
+            continue
+        if annotated(node.lineno) or enclosing_handles(node.lineno):
+            continue
+        out.append(_finding(
+            "R18", path, lines, node,
+            f"`{_call_name(node)}(...)` moves shared-pool KV pages "
+            "without referencing checksum verification — pool pages "
+            "cross worker boundaries with the traveling capture checksum "
+            "as their ONLY integrity guard, and a data path that doesn't "
+            "state where verify-on-fetch happens is where a refactor "
+            "silently drops it",
+            "state (docstring/comment) where the capture checksum is "
+            "verified for this path — e.g. 'checksum-verified at claim "
+            "(SharedKvPool.fetch), quarantine on mismatch' — or "
+            "annotate with `# dynalint: pool-verify-ok=<why no "
+            "verification is needed here>`"))
     return out
 
 

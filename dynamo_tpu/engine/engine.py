@@ -164,6 +164,11 @@ class NativeEngine:
         self.scheduler = Scheduler(engine_cfg, host_pool=self.host_pool)
         self._pending_offloads: list = []
         self._copy_stream = None
+        # cluster-wide shared KV pool (engine/kv_pool.py): attach_kv_pool
+        # wires the content-addressed tier + the publish stream
+        self.kv_pool = None
+        self.kv_pool_source = ""
+        self._pool_stream = None
         if self.host_pool is not None:
             self.scheduler.allocator.on_evict = self._offload_page
             self._copy_stream = CopyStream(self.host_pool)
@@ -545,10 +550,14 @@ class NativeEngine:
         return self.scheduler.abort(request_id)
 
     def close(self) -> None:
-        """Release background resources (the host-tier copy thread)."""
+        """Release background resources (host-tier copy + pool publish
+        threads)."""
         if self._copy_stream is not None:
             self._copy_stream.close()
             self._copy_stream = None
+        if self._pool_stream is not None:
+            self._pool_stream.close()
+            self._pool_stream = None
 
     def has_work(self) -> bool:
         s = self.scheduler
@@ -577,6 +586,7 @@ class NativeEngine:
             plan = self.scheduler.schedule()
         self._process_offloads()  # save evicted pages before any overwrite
         self._process_onboards()  # host-tier pages the plan may read
+        self._process_pool_injects()  # cluster-tier pages the plan may read
         if plan is None:
             return []
         self.step_count += 1
@@ -1032,6 +1042,7 @@ class NativeEngine:
         if self.pp > 1 and plan.n_window <= 1:
             return False   # pp per-token fallback path
         if self.scheduler.waiting or self.scheduler.pending_onboards \
+                or self.scheduler.pending_pool_injects \
                 or self._pending_offloads:
             return False
         if self._wants_logprobs(plan.seqs) \
@@ -1156,11 +1167,13 @@ class NativeEngine:
         self.step_count += 1
         self._process_offloads()
         self._process_onboards()
+        self._process_pool_injects()
         plan, staged = pend["plan"], pend["staged"]
         follow = None
         if pend.get("drain"):
             pass        # flagged reconcile: commit, then force a re-plan
-        elif self.scheduler.waiting or self.scheduler.pending_onboards:
+        elif self.scheduler.waiting or self.scheduler.pending_onboards \
+                or self.scheduler.pending_pool_injects:
             pass        # admission pending: drain the pipeline — the
             #             in-flight window is COMMITTED below (reconciled,
             #             never discarded) and the next step() plans a
@@ -1718,7 +1731,148 @@ class NativeEngine:
         return self.moe_dropped_tokens / self.moe_routed_tokens
 
     def drain_kv_events(self):
-        return self.scheduler.allocator.drain_events()
+        events = self.scheduler.allocator.drain_events()
+        if self._pool_stream is not None and events:
+            self._publish_pool_pages(events)
+        return events
+
+    # -- cluster-wide shared KV pool (engine/kv_pool.py) ---------------------
+
+    def attach_kv_pool(self, pool, source_id: str,
+                       publish: bool = True) -> None:
+        """Join the cluster KV namespace: the prefix walk gains the
+        content-addressed pool tier below host/disk, and (publish=True)
+        every sealed full page this engine commits is published into the
+        pool off the step loop. `source_id` is this worker's id — pool
+        events ride the KV-event plane under `pool:{source_id}` so the
+        router learns pool-resident prefixes (kv_router/protocols.py)."""
+        from dynamo_tpu.engine.kv_pool import PoolPublishStream
+        self.kv_pool = pool
+        self.kv_pool_source = source_id
+        self.scheduler.kv_pool = pool
+        self.scheduler.kv_pool_mode = self.kv_quant
+        if publish:
+            self._pool_stream = PoolPublishStream(pool, source_id,
+                                                  mode=self.kv_quant)
+
+    def _publish_pool_pages(self, events) -> None:
+        """Tee newly-sealed full pages into the shared pool.
+
+        Runs at event-drain time, right after the step that sealed them —
+        the pages' contents are still intact (nothing writes the cache
+        between a step and the next), so the extraction dispatched here
+        captures the authoritative bytes; the PoolPublishStream thread
+        does the blocking D2H, computes the capture checksum the pool
+        verifies on every later fetch, and publishes. Hashes already
+        pool-resident skip the D2H (`note_source` — their one stored
+        copy was checksum-verified at its own publish)."""
+        ship_ids, ship_metas = [], []
+        for kind, pid, sh, parent, th in events:
+            if kind != "stored":
+                continue
+            if sh in self.kv_pool:
+                self.kv_pool.note_source(self.kv_pool_source, sh,
+                                         parent, th)
+            else:
+                ship_ids.append(pid)
+                ship_metas.append((sh, parent, th))
+        max_b = self.scheduler.page_buckets[-1]
+        for start in range(0, len(ship_ids), max_b):
+            pages = self.extract_pages(ship_ids[start:start + max_b])
+            self._pool_stream.submit(pages,
+                                     ship_metas[start:start + max_b])
+
+    def _process_pool_injects(self) -> None:
+        """Inject shared-pool pages claimed by _match_prefix into HBM
+        before the device step that reads them. The bytes arrived
+        checksum-verified from the claim (scheduler._pool_claim ->
+        SharedKvPool.fetch: verify against the traveling capture
+        checksum, quarantine on mismatch), so this is pure transport —
+        the tier twin of _process_onboards."""
+        pending = self.scheduler.drain_pool_injects()
+        # recycling fence: a claim whose sequence was released before
+        # this drain may have had its page freed and REALLOCATED — only
+        # inject into pages still carrying the claimed seal (a freed-
+        # but-unrecycled reusable page keeps its hash and the inject is
+        # still the content that hash names)
+        alloc = self.scheduler.allocator
+        pending = [(pid, arrays) for pid, h, arrays in pending
+                   if alloc.pages[pid].seq_hash == h]
+        if not pending:
+            return
+        max_b = self.scheduler.page_buckets[-1]
+        for start in range(0, len(pending), max_b):
+            chunk = pending[start:start + max_b]
+            ids = [pid for pid, _ in chunk]
+            got = [arrays for _, arrays in chunk]
+            nb = next_bucket(len(ids), self.scheduler.page_buckets)
+            n_leaves = len(got[0])
+            stacks = []
+            for leaf in range(n_leaves):
+                first = got[0][leaf]
+                arr = np.zeros(first.shape[:2] + (nb,) + first.shape[2:],
+                               first.dtype)
+                for i, page in enumerate(got):
+                    arr[:, :, i] = page[leaf]
+                stacks.append(arr)
+            shd = self.cache_sharding
+            k_dev = jax.device_put(jnp.asarray(stacks[0]), shd)
+            v_dev = jax.device_put(jnp.asarray(stacks[1]), shd)
+            if n_leaves == 4:
+                sshd = self.cache_scale_sharding
+                self.inject_pages(
+                    ids, k_dev, v_dev,
+                    jax.device_put(jnp.asarray(stacks[2]), sshd),
+                    jax.device_put(jnp.asarray(stacks[3]), sshd))
+            else:
+                self.inject_pages(ids, k_dev, v_dev)
+
+    def prefetch_pool_pages(self, tokens) -> int:
+        """PRESERVE-style admission-window warm-up: fetch this prompt's
+        leading pool-resident pages into HBM NOW, sealed into the
+        allocator's REUSABLE pool (ref_count 0, keyed by chained hash),
+        so a later admission's prefix walk hits device memory.
+
+        Every fetch is checksum-verified at claim (_pool_claim); a
+        failure mid-chain keeps the pages already warmed and stops.
+        Warmed pages are ordinary evictable prefix-cache entries tied to
+        no request — a prefetch racing an admission cancel or deadline
+        leaves no leaked HBM pages, and double-prefetching is a no-op
+        (the allocator lookup short-circuits). Runs between device steps
+        (worker.submit); returns pages warmed."""
+        sch = self.scheduler
+        if sch.kv_pool is None or self.cfg.sp > 1:
+            return 0
+        from dynamo_tpu.engine.kv_cache import page_hash
+        from dynamo_tpu.engine.kv_pool import POOL_STATS
+        ps = self.cfg.page_size
+        parent, warmed, pids = 0, 0, []
+        for i in range(len(tokens) // ps):
+            toks = list(tokens[i * ps:(i + 1) * ps])
+            h = page_hash(parent, toks)
+            if sch.allocator.lookup(h) is not None \
+                    or (sch.host_pool is not None and h in sch.host_pool):
+                parent = h
+                continue   # already warm in a local tier
+            if h not in sch.kv_pool or not sch.allocator.can_allocate(1):
+                break
+            got = sch._pool_claim(h)
+            if got is None:
+                break
+            pid = sch.allocator.allocate()
+            sch.allocator.seal(pid, parent, toks)
+            sch.pending_pool_injects.append((pid, h, got))
+            pids.append(pid)
+            warmed += 1
+            parent = h
+        if warmed:
+            self._process_pool_injects()
+            for pid in pids:
+                # release into the reuse pool: content + hash stay until
+                # LRU eviction, exactly like a finished request's pages
+                sch.allocator.free(pid)
+            POOL_STATS.prefetch_pages += warmed
+        return warmed
 
 
 def _extract_pages(cache, ids):

@@ -1,0 +1,461 @@
+"""Cluster-wide shared KV pool: content-addressed cross-worker page reuse.
+
+The offload tiers (engine/offload.py, HBM -> host DRAM -> disk) are
+per-worker, so a prefix prefilled on worker A is recomputed from scratch
+on worker B — the dominant TTFT waste of the millions-of-users
+shared-system-prompt workload (LMCache, PAPERS.md). This module adds the
+cluster namespace above those tiers:
+
+- **`SharedKvPool`** — a content-addressed store of sealed full KV pages
+  keyed by the chained page hash (`engine/kv_cache.page_hash`, the same
+  key the per-worker reuse maps and the router radix tree already speak).
+  Entries are dedup'd by that hash: two workers publishing the identical
+  page (same token chain, same kv_quant mode) keep ONE byte copy, with
+  both recorded as sources. The capture-time checksum travels with the
+  entry (runtime/integrity.py) and is re-verified at every fetch — a
+  rotten entry is quarantined (removed, never served) and the page is
+  recomputed, exactly the offload-tier contract. Entries carry their
+  kv_quant mode; a fetch from an engine running a different mode is
+  rejected BY NAME (PoolQuantMismatch), never silently cast.
+
+- **`PoolPublishStream`** — the worker-side publish path: a background
+  drain thread (the CopyStream shape, engine/offload.py) that receives
+  freshly-sealed device pages from the engine's event drain, performs
+  the blocking device->host copy off the step loop, computes the
+  capture checksum, and publishes into the pool. Pages whose hash is
+  already pool-resident skip the D2H entirely (`note_source` — the
+  dedup fast path that makes a 1000-worker shared system prompt cost
+  one byte copy, not one per worker).
+
+- **`AdmissionPrefetcher`** — PRESERVE-style (PAPERS.md) prefetch into
+  the admission window: while a request waits in the frontend's
+  admission queue (the `admission.wait` span, frontend/service.py), its
+  matched pool pages are warmed into the target worker's HBM
+  (`NativeEngine.prefetch_pool_pages`), so the later prefix walk hits
+  HBM and warm-prefix TTFT approaches pure transfer cost. Prefetched
+  pages land in the allocator's REUSABLE pool (ref_count 0, keyed by
+  hash) — they are ordinary evictable prefix-cache entries, so a
+  prefetch racing an admission cancel or deadline expiry leaks nothing.
+
+Publish/evict events ride the existing KV-event plane under the
+`pool:{worker_id}` source ids (kv_router/protocols.py), so the router's
+radix index learns pool-resident prefixes next to worker-resident ones
+and `TransferAwareSelector` can score cross-worker *fetchable* prefixes
+(docs/PERF.md §3e). Fetch-on-schedule degrades like the chunk-committed
+transfer protocol (docs/RESILIENCE.md): pages commit one verified unit
+at a time during the prefix walk, so a fetch that dies mid-stream (rot,
+source eviction, pool churn) keeps the committed prefix and recomputes
+only the tail — exactly today's behavior, latency not tokens.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.integrity import STATS as INTEGRITY, page_checksum
+
+log = logging.getLogger("dynamo_tpu.kv_pool")
+
+
+class PoolQuantMismatch(RuntimeError):
+    """A fetch asked for a page under a different kv_quant mode than the
+    one it was published with. Final, and named: pages travel in their
+    stored representation end-to-end (int8 values + f32 scales under
+    kv_quant), and serving a bf16 engine an int8 page (or vice versa)
+    would require a silent cast the data plane forbids everywhere else
+    (engine.inject_pages names the same error)."""
+
+    def __init__(self, seq_hash: int, stored_mode: str, asked_mode: str):
+        super().__init__(
+            f"shared-pool page {seq_hash:x} was published under kv_quant="
+            f"{stored_mode or 'off'!r} but the fetching engine runs "
+            f"kv_quant={asked_mode or 'off'!r}; cross-mode fetches are "
+            "rejected, never cast")
+        self.stored_mode = stored_mode
+        self.asked_mode = asked_mode
+
+
+class KvPoolStats:
+    """Process-local shared-pool counters (/metrics: llm_kv_pool_*).
+
+    Same pattern as kv_router/stats.py ROUTER_STATS: plain numbers bumped
+    on the pool paths, folded into Prometheus gauges at render time by
+    frontend/service.py and observability/exporter.py
+    (docs/OBSERVABILITY.md §9)."""
+
+    FIELDS = (
+        "entries",          # pages currently resident in the pool
+        "bytes",            # bytes those entries occupy (values + scales)
+        "publishes",        # new entries published (first copy of a hash)
+        "dedup_hits",       # publishes dedup'd against an existing entry
+        "dedup_ratio",      # dedup_hits / (publishes + dedup_hits)
+        "fetch_hits",       # verified pages served to a prefix walk
+        "fetch_misses",     # walk-time fetches that found no entry
+        "prefetch_pages",   # pages warmed into HBM by admission prefetch
+        "prefetch_hits",    # prefetch ops that warmed pages inside the window
+        "prefetch_late",    # prefetch ops that finished after admission
+        "quarantined",      # entries dropped on checksum mismatch (rot)
+        "quant_rejected",   # cross-kv_quant-mode publishes/fetches refused
+        "evicted",          # entries dropped by capacity LRU
+        "source_evictions", # dead-source purges (single-source entries dropped)
+    )
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        for name in self.FIELDS:
+            setattr(self, name, 0)
+
+    def snapshot(self) -> dict:
+        out = {name: getattr(self, name) for name in self.FIELDS}
+        attempts = self.publishes + self.dedup_hits
+        out["dedup_ratio"] = round(self.dedup_hits / attempts, 4) \
+            if attempts else 0.0
+        return out
+
+
+POOL_STATS = KvPoolStats()
+
+
+@dataclasses.dataclass
+class PoolEntry:
+    seq_hash: int
+    parent: int          # chained hash of the preceding page (0 = root)
+    tokens_hash: int     # content-only hash (router radix-tree edge key)
+    mode: str            # kv_quant mode the bytes are stored in ("" = off)
+    arrays: Tuple[np.ndarray, ...]   # (k, v) or (k, v, k_scale, v_scale)
+    sum_: int            # capture-time checksum (travels with the entry)
+    nbytes: int
+    sources: Set[str] = dataclasses.field(default_factory=set)
+
+
+class SharedKvPool:
+    """Content-addressed cluster KV page store (the LMCache tier role).
+
+    Thread-safe: publishes arrive from every worker's PoolPublishStream
+    drain thread while engine threads fetch during prefix walks. Capacity
+    is bounded in pages with LRU eviction; eviction and source purges emit
+    per-source Removed events (`drain_events`) so the router index stays
+    in sync through the ordinary KV-event plane.
+
+    This in-process object IS the deployment unit for a single-host
+    multi-worker cluster (the LocalTransferBackend shape); a TCP-served
+    pool front-end for cross-host fleets reuses the chunk-committed
+    transfer plane and is future work (docs/PERF.md §3e).
+    """
+
+    def __init__(self, capacity_pages: int = 4096, name: str = "kv-pool"):
+        self.capacity_pages = max(1, capacity_pages)
+        self.name = name
+        self._entries: "OrderedDict[int, PoolEntry]" = OrderedDict()
+        # per-source pending router events, allocator-event tuple shape:
+        # (kind, page_id(=0), seq_hash, parent_hash, tokens_hash)
+        self._events: Dict[str, List[Tuple[str, int, int, int, int]]] = {}
+        self._mu = threading.RLock()
+
+    def __contains__(self, seq_hash: int) -> bool:
+        with self._mu:
+            return seq_hash in self._entries
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    # -- events ---------------------------------------------------------------
+
+    def _emit(self, source: str, kind: str, e: PoolEntry) -> None:
+        """Lock held: queue one router event for `source`'s pool id."""
+        self._events.setdefault(source, []).append(
+            (kind, 0, e.seq_hash, e.parent, e.tokens_hash))
+
+    def drain_events(self, source: str) -> List[Tuple[str, int, int, int, int]]:
+        """Pending Stored/Removed events for one source worker's
+        `pool:{worker_id}` publisher (same tuple shape as
+        PageAllocator.drain_events, so KvEventPublisher batches them)."""
+        with self._mu:
+            ev = self._events.pop(source, [])
+        return ev
+
+    # -- publish --------------------------------------------------------------
+
+    def note_source(self, source: str, seq_hash: int, parent: int,
+                    tokens_hash: int) -> bool:
+        """Record `source` as a holder of an already-pool-resident page —
+        the dedup fast path (no bytes shipped; the one stored copy was
+        checksum-verified when it was published). Returns False on a
+        miss (the entry was evicted since the caller's containment
+        check — publish the bytes instead)."""
+        with self._mu:
+            e = self._entries.get(seq_hash)
+            if e is None:
+                return False
+            self._entries.move_to_end(seq_hash)
+            if source not in e.sources:
+                e.sources.add(source)
+                self._emit(source, "stored", e)
+            POOL_STATS.dedup_hits += 1
+            return True
+
+    def publish(self, source: str, seq_hash: int, parent: int,
+                tokens_hash: int, arrays, mode: str = "",
+                sum_: Optional[int] = None) -> str:
+        """Publish one sealed full page. `arrays` is (k, v) or
+        (k, v, k_scale, v_scale) host ndarrays in the engine's stored
+        representation; `sum_` is the capture-time checksum (computed
+        here when the caller staged the bytes itself). Returns "new",
+        "dup" (content-hash dedup kept the existing copy), or
+        "quant-mismatch" (an entry for this hash exists under a
+        different kv_quant mode; first representation wins)."""
+        arrays = tuple(np.asarray(a) for a in arrays)
+        if sum_ is None:
+            sum_ = page_checksum(*arrays)
+            INTEGRITY.pages_hashed += 1
+        with self._mu:
+            e = self._entries.get(seq_hash)
+            if e is not None:
+                if e.mode != mode:
+                    POOL_STATS.quant_rejected += 1
+                    return "quant-mismatch"
+                self._entries.move_to_end(seq_hash)
+                if source not in e.sources:
+                    e.sources.add(source)
+                    self._emit(source, "stored", e)
+                POOL_STATS.dedup_hits += 1
+                return "dup"
+            e = PoolEntry(seq_hash=seq_hash, parent=parent,
+                          tokens_hash=tokens_hash, mode=mode,
+                          arrays=arrays, sum_=sum_,
+                          nbytes=sum(a.nbytes for a in arrays),
+                          sources={source})
+            self._entries[seq_hash] = e
+            POOL_STATS.publishes += 1
+            POOL_STATS.entries = len(self._entries)
+            POOL_STATS.bytes += e.nbytes
+            self._emit(source, "stored", e)
+            while len(self._entries) > self.capacity_pages:
+                _, old = self._entries.popitem(last=False)
+                POOL_STATS.evicted += 1
+                POOL_STATS.bytes -= old.nbytes
+                for src in old.sources:
+                    self._emit(src, "removed", old)
+            POOL_STATS.entries = len(self._entries)
+            return "new"
+
+    # -- fetch ----------------------------------------------------------------
+
+    def fetch(self, seq_hash: int, mode: str = "") -> Optional[Tuple]:
+        """Verified host copies of one page — (k, v) or (k, v, ks, vs) —
+        or None on a miss OR an integrity mismatch (the rotten entry is
+        quarantined and the page will be recomputed; corrupted bytes can
+        never reach a device cache). Raises PoolQuantMismatch when the
+        entry exists under a different kv_quant mode — rejected by name,
+        never cast."""
+        with self._mu:
+            e = self._entries.get(seq_hash)
+            if e is None:
+                POOL_STATS.fetch_misses += 1
+                return None
+            if e.mode != mode:
+                POOL_STATS.quant_rejected += 1
+                raise PoolQuantMismatch(seq_hash, e.mode, mode)
+            self._entries.move_to_end(seq_hash)
+            # deep copies: the caller's verify + inject must not race a
+            # concurrent LRU eviction of the slab entry
+            arrays = tuple(np.array(a) for a in e.arrays)
+            sum_ = e.sum_
+        if faults.REGISTRY.enabled:   # rot surfacing on the fetch path
+            faults.REGISTRY.corrupt_array("pool.fetch", arrays[0])
+        if page_checksum(*arrays) != sum_:
+            INTEGRITY.mismatches += 1
+            INTEGRITY.quarantined += 1
+            POOL_STATS.quarantined += 1
+            with self._mu:
+                old = self._entries.pop(seq_hash, None)
+                if old is not None:
+                    POOL_STATS.entries = len(self._entries)
+                    POOL_STATS.bytes -= old.nbytes
+                    for src in old.sources:
+                        self._emit(src, "removed", old)
+            log.warning("shared-pool kv page %x failed integrity check; "
+                        "quarantined (will recompute)", seq_hash)
+            return None
+        INTEGRITY.pages_verified += 1
+        POOL_STATS.fetch_hits += 1
+        return arrays
+
+    # -- source lifecycle -----------------------------------------------------
+
+    def evict_source(self, source: str) -> int:
+        """A source worker died (watch delete): forget it everywhere.
+        Entries it alone published are dropped — in the distributed
+        deployment the bytes live with the source, and a corpse cannot
+        refresh or re-verify them; multi-source entries survive on their
+        remaining holders. Returns the number of entries dropped. The
+        router-side twin is `KvRouter`'s watch-event eviction of the
+        `pool:{worker_id}` index entries."""
+        dropped = 0
+        with self._mu:
+            self._events.pop(source, None)
+            for h in [h for h, e in self._entries.items()
+                      if source in e.sources]:
+                e = self._entries[h]
+                e.sources.discard(source)
+                if not e.sources:
+                    del self._entries[h]
+                    POOL_STATS.bytes -= e.nbytes
+                    dropped += 1
+            POOL_STATS.entries = len(self._entries)
+        if dropped:
+            POOL_STATS.source_evictions += 1
+            log.info("shared pool evicted %d page(s) solely sourced from "
+                     "dead worker %s", dropped, source)
+        return dropped
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {"entries": len(self._entries),
+                    "bytes": sum(e.nbytes for e in self._entries.values()),
+                    "sources": sorted({s for e in self._entries.values()
+                                       for s in e.sources})}
+
+
+class PoolPublishStream:
+    """Background publisher: overlaps pool-publish D2H copies with decode.
+
+    The engine *dispatches* the page extraction on-device in step order
+    (values captured before any overwrite — the CopyStream discipline,
+    engine/offload.py) and hands the device arrays here; this thread
+    performs the blocking device->host transfer, computes the capture
+    checksum, and publishes into the shared pool off the step loop —
+    decode never waits on a publish, and a failed publish only costs a
+    future recompute on some other worker."""
+
+    def __init__(self, pool: SharedKvPool, source: str, mode: str = ""):
+        self._pool = pool
+        self._source = source
+        self._mode = mode
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name="kv-pool-publish", daemon=True)
+        self._thread.start()
+
+    def submit(self, device_pages, metas) -> None:
+        """device_pages: {"k","v"[,"k_scale","v_scale"]} device arrays
+        ([L, Hkv, N, ps, hd] values; [L, Hkv, N, ps] scales) already
+        dispatched; metas: [(seq_hash, parent_hash, tokens_hash)] per
+        page along dim 2."""
+        self._q.put((device_pages, list(metas)))
+
+    def drain(self) -> None:
+        """Block until every submitted publish landed (test barrier)."""
+        self._q.join()
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=30)
+
+    def _run(self) -> None:
+        import jax  # deferred: keep module importable without a backend
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            pages, metas = item
+            try:
+                k = np.asarray(jax.device_get(pages["k"]))
+                v = np.asarray(jax.device_get(pages["v"]))
+                ks = vs = None
+                if "k_scale" in pages:   # kv_quant: scales ride along
+                    ks = np.asarray(jax.device_get(pages["k_scale"]))
+                    vs = np.asarray(jax.device_get(pages["v_scale"]))
+                for i, (sh, parent, th) in enumerate(metas):
+                    arrays = (k[:, :, i], v[:, :, i])
+                    if ks is not None:
+                        arrays += (ks[:, :, i], vs[:, :, i])
+                    # publish() computes the capture checksum over the
+                    # bytes just pulled off the authoritative device
+                    # copy; every later fetch verifies against it
+                    self._pool.publish(self._source, sh, parent, th,
+                                       arrays, mode=self._mode)
+            except Exception:  # noqa: BLE001 — a failed publish only costs
+                pass           # a future recompute; never kill the drain
+            finally:
+                self._q.task_done()
+
+
+class AdmissionPrefetcher:
+    """PRESERVE-style prefetch into the admission window.
+
+    While a request waits for admission (`admission.wait` span,
+    frontend/service.py), warm its matched shared-pool pages into the
+    target worker's HBM so the later prefix walk hits device memory.
+    Deliberately best-effort and side-effect-safe: fetches are
+    checksum-verified at claim (scheduler._pool_claim), warmed pages
+    land in the allocator's reusable pool (evictable, request-agnostic),
+    and a cancel/deadline racing the prefetch leaves nothing leaked —
+    the worst outcome of any failure is today's cold TTFT.
+
+    `tokens_fn(request)` maps the frontend request to prompt token ids
+    (None = not prefetchable); `target_fn(tokens)` picks the worker the
+    router is expected to choose and returns a handle with
+    `submit(fn)` (NativeEngineWorker) — the serve assembly wires both.
+    """
+
+    def __init__(self, pool: SharedKvPool, tokens_fn, target_fn,
+                 page_size: int):
+        self.pool = pool
+        self.tokens_fn = tokens_fn
+        self.target_fn = target_fn
+        self.page_size = page_size
+
+    def matched_pages(self, tokens) -> int:
+        """Leading full pages of `tokens` resident in the pool (the
+        cheap containment walk — no bytes move)."""
+        from dynamo_tpu.engine.kv_cache import page_hash
+        ps = self.page_size
+        parent, n = 0, 0
+        for i in range(len(tokens) // ps):
+            parent = page_hash(parent, tokens[i * ps:(i + 1) * ps])
+            if parent not in self.pool:
+                break
+            n += 1
+        return n
+
+    async def prefetch(self, request, admitted=None) -> int:
+        """Warm the request's matched pool pages into the target
+        worker's HBM; returns pages warmed (0 on any failure). Every
+        page is checksum-verified at claim inside the engine op
+        (scheduler._pool_claim -> SharedKvPool.fetch; quarantine on
+        mismatch), so nothing unverified can land. When
+        `admitted` (an asyncio.Event set once admission completes) is
+        already set by the time the warm finishes, the window was too
+        short — counted as `prefetch_late` (the pages still help the
+        next arrival)."""
+        try:
+            tokens = self.tokens_fn(request)
+            if not tokens or self.matched_pages(tokens) == 0:
+                return 0
+            worker = self.target_fn(tokens)
+            if worker is None:
+                return 0
+            warmed = await worker.submit(
+                lambda eng: eng.prefetch_pool_pages(tokens))
+        except Exception:  # noqa: BLE001 — prefetch must never fail a request
+            log.debug("admission prefetch failed", exc_info=True)
+            return 0
+        if warmed:
+            if admitted is not None and admitted.is_set():
+                POOL_STATS.prefetch_late += 1
+            else:
+                POOL_STATS.prefetch_hits += 1
+        return warmed

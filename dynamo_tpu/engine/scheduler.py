@@ -309,6 +309,20 @@ class Scheduler:
         # (pid, seq_hash) pairs whose HBM page must be filled from the host
         # pool before the next device step (engine drains + injects)
         self.pending_onboards: list = []
+        # cluster-wide shared KV pool (engine/kv_pool.py SharedKvPool;
+        # engine.attach_kv_pool wires these): the content-addressed tier
+        # BELOW the private host/disk ladder in the prefix walk
+        self.kv_pool = None
+        self.kv_pool_mode = ""   # this engine's kv_quant mode for fetches
+        # (pid, seq_hash, verified host arrays) claimed from the shared
+        # pool by _match_prefix; the engine injects them before the next
+        # step. The hash rides along as a recycling fence: a claim whose
+        # sequence is released before the inject drains could see its
+        # page freed AND reallocated — the engine skips entries whose
+        # page no longer carries the claimed seal.
+        self.pending_pool_injects: list = []
+        self.pool_fetched_pages = 0
+        self._pool_quant_logged = False
         self.waiting: deque[SequenceState] = deque()
         self.running: List[Optional[SequenceState]] = [None] * cfg.max_slots
         self.params: Dict[str, SamplingParams] = {}
@@ -590,9 +604,33 @@ class Scheduler:
                 out.append(("hbm", pid, h, toks))
             elif self.host_pool is not None and h in self.host_pool:
                 out.append(("host", None, h, toks))
+            elif self.kv_pool is not None and h in self.kv_pool:
+                # cluster tier: a page some OTHER worker prefilled and
+                # published (engine/kv_pool.py) — fetch-on-schedule
+                out.append(("pool", None, h, toks))
             else:
                 break
         return out, n_full
+
+    def _pool_claim(self, seq_hash: int):
+        """Verified host copies of one shared-pool page, or None.
+
+        The fetch re-verifies the entry's bytes against the capture-time
+        checksum traveling with it — a mismatch quarantines the entry
+        pool-side and the walk treats it as a miss (recompute, never
+        serve). A cross-kv_quant-mode entry is rejected BY NAME and also
+        walks as a miss: latency, never a silent cast."""
+        from dynamo_tpu.engine.kv_pool import PoolQuantMismatch
+        try:
+            return self.kv_pool.fetch(seq_hash, self.kv_pool_mode)
+        except PoolQuantMismatch as e:
+            if not self._pool_quant_logged:
+                self._pool_quant_logged = True
+                import logging
+                logging.getLogger("dynamo_tpu.kv_pool").warning(
+                    "shared-pool fetch rejected: %s (further mismatches "
+                    "on this engine logged at debug)", e)
+            return None
 
     def _match_prefix(self, seq: SequenceState) -> None:
         """Share resident full pages; onboard host-tier pages (prefix hit).
@@ -626,6 +664,22 @@ class Scheduler:
                 self.allocator.seal(pid, parent, toks)
                 self.pending_onboards.append((pid, h))
                 self.host_pool.stats.host_hits += 1
+            elif self.kv_pool is not None and self.allocator.can_allocate(1):
+                # cluster-tier hit: claim the page NOW (checksum-verified
+                # copies come back with the claim) and queue the inject.
+                # Each page is one committed unit — a fetch chain that
+                # dies here (rot quarantine, source eviction, quant
+                # mismatch) keeps the pages already claimed and breaks
+                # the walk, so the tail is recomputed: the salvage-to-
+                # recompute degradation of the chunk-committed protocol,
+                # at page granularity (docs/RESILIENCE.md).
+                got = self._pool_claim(h)
+                if got is None:
+                    break
+                pid = self.allocator.allocate()
+                self.allocator.seal(pid, parent, toks)
+                self.pending_pool_injects.append((pid, h, got))
+                self.pool_fetched_pages += 1
             else:
                 break
             seq.pages.append(pid)
@@ -636,6 +690,10 @@ class Scheduler:
 
     def drain_onboards(self) -> list:
         out, self.pending_onboards = self.pending_onboards, []
+        return out
+
+    def drain_pool_injects(self) -> list:
+        out, self.pending_pool_injects = self.pending_pool_injects, []
         return out
 
     def finish(self, seq: SequenceState) -> None:

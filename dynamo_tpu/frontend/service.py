@@ -70,11 +70,16 @@ class ModelManager:
 class HttpService:
     def __init__(self, host: str = "0.0.0.0", port: int = 8080,
                  registry: Optional[MetricsRegistry] = None,
-                 admission=None, default_deadline_s: Optional[float] = None):
+                 admission=None, default_deadline_s: Optional[float] = None,
+                 prefetcher=None):
         """admission: an AdmissionControl (frontend/reliability.py) for
         load shedding — past its caps, requests get 429 + Retry-After.
         default_deadline_s: end-to-end deadline armed on every request's
-        Context (propagated to workers over the wire)."""
+        Context (propagated to workers over the wire).
+        prefetcher: an AdmissionPrefetcher (engine/kv_pool.py) — while a
+        request sits in the admission queue (the `admission.wait` span),
+        its matched shared-pool pages are warmed into the target
+        worker's HBM (PRESERVE-style); strictly best-effort."""
         from dynamo_tpu.frontend.reliability import ReliabilityMetrics
         self.server = HttpServer(host, port)
         self.models = ModelManager()
@@ -87,6 +92,7 @@ class HttpService:
         if self.admission is not None and self.admission.metrics is None:
             self.admission.metrics = self.reliability
         self.default_deadline_s = default_deadline_s
+        self.prefetcher = prefetcher
         m = self.registry
         self._requests = m.counter(
             "llm_http_service_requests_total",
@@ -148,6 +154,14 @@ class HttpService:
             name: m.gauge(f"llm_router_{name}",
                           f"router scoring: {name.replace('_', ' ')}")
             for name in RouterScoringStats.FIELDS}
+        # cluster-wide shared KV pool (engine/kv_pool.py POOL_STATS):
+        # residency, dedup, fetch and admission-prefetch outcomes —
+        # same render-time fold (docs/OBSERVABILITY.md §9)
+        from dynamo_tpu.engine.kv_pool import KvPoolStats
+        self._kv_pool = {
+            name: m.gauge(f"llm_kv_pool_{name}",
+                          f"shared kv pool: {name.replace('_', ' ')}")
+            for name in KvPoolStats.FIELDS}
         # per-step engine ledger (observability/ledger.py LEDGER_STATS):
         # step counts per kind, recompiles, bucket-ladder padding waste,
         # KV tier occupancy, batch occupancy, queue depth, EWMA tok/s
@@ -232,6 +246,9 @@ class HttpService:
         from dynamo_tpu.kv_router.stats import ROUTER_STATS
         for name, value in ROUTER_STATS.snapshot().items():
             self._router[name].set(value=float(value))
+        from dynamo_tpu.engine.kv_pool import POOL_STATS
+        for name, value in POOL_STATS.snapshot().items():
+            self._kv_pool[name].set(value=float(value))
         from dynamo_tpu.observability.ledger import LEDGER_STATS
         for name, value in LEDGER_STATS.snapshot().items():
             self._engine[name].set(value=float(value))
@@ -278,6 +295,19 @@ class HttpService:
                                  endpoint=endpoint,
                                  request_type=request_type)
         admitted = False
+        prefetch_done: Optional[asyncio.Event] = None
+        if self.prefetcher is not None:
+            # PRESERVE-style warm-up riding the admission window
+            # (engine/kv_pool.py AdmissionPrefetcher): the queue wait is
+            # free time to move matched pool pages into the target
+            # worker's HBM. Fire-and-forget — the prefetcher swallows
+            # its own failures, warmed pages are request-agnostic
+            # reusable entries, and a shed below cancels the task (an
+            # engine op already submitted completes harmlessly: no
+            # leaked pages either way).
+            prefetch_done = asyncio.Event()
+            prefetch_task = asyncio.create_task(
+                self.prefetcher.prefetch(oai_req, prefetch_done))
         if self.admission is not None:
             from dynamo_tpu.frontend.reliability import AdmissionShed
             try:
@@ -289,11 +319,16 @@ class HttpService:
                 TRACER.record_span("admission.wait",
                                    root.context() if root else None, wait)
             except AdmissionShed as e:
+                if prefetch_done is not None:
+                    prefetch_done.set()
+                    prefetch_task.cancel()
                 self._requests.inc(model, endpoint, request_type, "shed")
                 TRACER.end_span(root, status="shed", error=True)
                 raise HttpError(
                     429, "server overloaded, retry later",
                     headers={"retry-after": str(e.retry_after_s)})
+        if prefetch_done is not None:
+            prefetch_done.set()   # window over: later completion = late
         ctx = Context()
         if root is not None:
             ctx.trace = root.context()

@@ -25,7 +25,33 @@ from dynamo_tpu.engine.kv_cache import tokens_hash
 __all__ = [
     "tokens_hash", "compute_page_hashes", "KvCacheStoredBlockData",
     "KvCacheStoreData", "KvCacheRemoveData", "KvCacheEvent", "RouterEvent",
+    "POOL_SOURCE_PREFIX", "pool_source_id", "is_pool_source",
+    "pool_source_worker",
 ]
+
+# Cluster-wide shared KV pool (engine/kv_pool.py): pool Stored/Removed
+# events ride this same plane under a `pool:{worker_id}` source id — the
+# radix tree then indexes pool-resident prefixes NEXT TO worker-resident
+# ones, and the router splits the two at schedule time (a pool: score is
+# a *fetchable* prefix, not a resident one). The id embeds the SOURCE
+# worker so the watch-driven eviction that purges a dead worker also
+# purges its pool-source entries — the selector must never price a
+# fetch from a corpse (docs/PERF.md §3e).
+POOL_SOURCE_PREFIX = "pool:"
+
+
+def pool_source_id(worker_id: str) -> str:
+    return f"{POOL_SOURCE_PREFIX}{worker_id}"
+
+
+def is_pool_source(worker_id: str) -> bool:
+    return worker_id.startswith(POOL_SOURCE_PREFIX)
+
+
+def pool_source_worker(worker_id: str) -> str:
+    """The source worker behind a pool: id (identity for plain ids)."""
+    return worker_id[len(POOL_SOURCE_PREFIX):] \
+        if is_pool_source(worker_id) else worker_id
 
 
 def compute_page_hashes(tokens: Sequence[int], page_size: int) -> List[int]:

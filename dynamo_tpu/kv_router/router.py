@@ -14,7 +14,10 @@ import time
 from typing import Optional, Sequence
 
 from dynamo_tpu.kv_router.indexer import KvIndexer, MatchResult
-from dynamo_tpu.kv_router.protocols import RouterEvent, compute_page_hashes
+from dynamo_tpu.kv_router.protocols import (
+    RouterEvent, compute_page_hashes, is_pool_source, pool_source_id,
+    pool_source_worker,
+)
 from dynamo_tpu.kv_router.publisher import (
     KV_EVENTS_SUBJECT, KV_HIT_RATE_SUBJECT, KvMetricsAggregator,
 )
@@ -91,6 +94,9 @@ class KvRouter:
                 self.indexer.remove_worker(worker_id)
             for worker_id in endpoints.workers:
                 self.indexer.revive_worker(worker_id)
+                # a restarted worker's POOL publishes must not stay
+                # tombstoned behind its old generation's eviction
+                self.indexer.revive_worker(pool_source_id(worker_id))
 
         self.aggregator.on_update(on_metrics)
 
@@ -107,6 +113,13 @@ class KvRouter:
             )
             if kind == "delete":
                 self.indexer.remove_worker(worker_id)
+                # pool-source twin (mirror of the PR 4 eviction above):
+                # the dead worker's SHARED-POOL publishes go with it at
+                # watch-event time, so the transfer-aware selector never
+                # prices a pool fetch sourced from a corpse — without
+                # this, a warm shared prefix kept scoring as fetchable
+                # until the next full resync
+                self.indexer.remove_worker(pool_source_id(worker_id))
                 self.scheduler.remove_worker(worker_id)
             elif kind == "put" \
                     and instance_status(info) == STATUS_DRAINING:
@@ -220,6 +233,26 @@ class KvRouter:
         return self.indexer.find_matches(
             compute_page_hashes(tokens, self.block_size))
 
+    def _split_pool_scores(self, overlap: MatchResult) -> int:
+        """Strip `pool:{worker}` entries out of the match scores and fold
+        them into ONE fetchable-prefix depth (the deepest live-sourced
+        pool match). Pool scores are not resident overlap — a candidate
+        must FETCH those pages — so they must never rank a worker as if
+        it held them; the selector prices the fetch instead. The watch
+        eviction purges dead pool sources at event time; the instance
+        re-check here is the same authoritative-watch fence the metrics
+        path uses (a racing Stored event could re-add a corpse's edge
+        between eviction and this schedule)."""
+        pool_matched = 0
+        instances = getattr(self.client, "instances", None)
+        for wid in [w for w in overlap.scores if is_pool_source(w)]:
+            score = overlap.scores.pop(wid)
+            src = pool_source_worker(wid)
+            if instances is not None and src not in instances:
+                continue   # corpse-sourced: never price a fetch from it
+            pool_matched = max(pool_matched, score)
+        return pool_matched
+
     async def schedule(self, tokens: Sequence[int],
                        exclude=()) -> str:
         """Pick the best worker for this token sequence; returns worker_id.
@@ -234,8 +267,10 @@ class KvRouter:
             if drains:
                 exclude = set(exclude) | set(drains)
         overlap = self.find_matches_for_tokens(tokens)
+        pool_matched = self._split_pool_scores(overlap)
         worker_id = self.scheduler.schedule(len(tokens), overlap,
-                                            exclude=exclude)
+                                            exclude=exclude,
+                                            pool_matched=pool_matched)
         # serving-path histogram (llm_schedule_seconds): observed HERE,
         # at the real scheduling decision, so the frontend's kv-routed
         # path and a bare router (cluster_sim) account identically; the

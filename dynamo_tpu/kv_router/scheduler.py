@@ -58,6 +58,13 @@ class AllWorkersBusy(Exception):
 class SchedulingRequest:
     isl_tokens: int                # input sequence length in tokens
     overlap: MatchResult           # per-worker matched block counts
+    # leading query blocks fetchable from the cluster-wide shared KV
+    # pool (engine/kv_pool.py; the router derives this from `pool:{w}`
+    # index scores, live sources only). Fetchable blocks a candidate
+    # does not hold locally reduce its bytes_to_move instead of counting
+    # as misses — the fetch itself is priced with the same
+    # TransferCostModel.estimate as a disagg transfer (docs/PERF.md §3e)
+    pool_matched: int = 0
 
 
 @dataclasses.dataclass
@@ -181,6 +188,7 @@ class TransferAwareSelector(DefaultWorkerSelector):
             raise AllWorkersBusy("no live workers")
         isl = max(request.isl_tokens, 1)
         required = -(-isl // block_size)
+        pool_m = max(0, min(request.pool_matched, required))
         best_logit = float("-inf")
         best: List[str] = []
         components: Dict[str, dict] = {}
@@ -192,13 +200,24 @@ class TransferAwareSelector(DefaultWorkerSelector):
             self._frozen_cost.clear()
         for worker_id, m in endpoints.workers.items():
             matched = request.overlap.scores.get(worker_id, 0)
-            overlap_score = matched * block_size / isl
+            # cluster-pool reuse (docs/PERF.md §3e): leading blocks the
+            # pool holds BEYOND this worker's resident prefix are
+            # fetchable, not misses — they join the overlap term and
+            # shrink bytes_to_move, while the fetch bytes themselves are
+            # priced below through the same cost model (cold estimates
+            # answer from the fleet-median prior, never free)
+            fetchable = max(0, pool_m - matched)
+            eff_matched = matched + fetchable
+            overlap_score = eff_matched * block_size / isl
             kv_usage = (m.kv_active_blocks / m.kv_total_blocks
                         if m.kv_total_blocks else 0.0)
             norm_active = (m.request_active_slots / m.request_total_slots
                            if m.request_total_slots else 0.0)
-            nbytes = self._bytes_to_move(m, required, matched)
-            cost_s, cold = self._cost_s(worker_id, nbytes)
+            nbytes_move = self._bytes_to_move(m, required, eff_matched)
+            nbytes_fetch = fetchable * (m.kv_page_bytes
+                                        or self.default_block_bytes)
+            cost_s, cold = self._cost_s(worker_id,
+                                        nbytes_move + nbytes_fetch)
             any_cold |= cold
             norm_cost = min(self.max_penalty, cost_s / self.horizon_s)
             logit = (self.overlap_weight * overlap_score
@@ -208,7 +227,9 @@ class TransferAwareSelector(DefaultWorkerSelector):
                 "overlap": round(overlap_score, 4),
                 "kv_usage": round(kv_usage, 4),
                 "active": round(norm_active, 4),
-                "transfer_bytes": nbytes,
+                "transfer_bytes": nbytes_move,
+                "pool_blocks": fetchable,
+                "pool_fetch_bytes": nbytes_fetch,
                 "transfer_s": round(cost_s, 6),
                 "transfer_norm": round(norm_cost, 4),
                 "cold": cold,
@@ -228,6 +249,9 @@ class TransferAwareSelector(DefaultWorkerSelector):
             ROUTER_STATS.cold_scored += 1
         if self.frozen:
             ROUTER_STATS.frozen_scored += 1
+        if pool_m > 0:
+            ROUTER_STATS.pool_scored += 1
+        ROUTER_STATS.last_pool_fetch_blocks = pick["pool_blocks"]
         ROUTER_STATS.last_transfer_est_s = pick["transfer_s"]
         ROUTER_STATS.last_transfer_bytes = pick["transfer_bytes"]
         ROUTER_STATS.est_err_abs_frac = round(
@@ -269,11 +293,14 @@ class KvScheduler:
         self.endpoints.workers.pop(worker_id, None)
 
     def schedule(self, isl_tokens: int, overlap: MatchResult,
-                 exclude=()) -> str:
+                 exclude=(), pool_matched: int = 0) -> str:
         """Pick a worker; `exclude` drops workers from consideration (the
         reliability layer's circuit breaker ejects flapping instances this
         way). If exclusion would empty the candidate set, the full set is
-        used — a probe somewhere beats failing the request outright."""
+        used — a probe somewhere beats failing the request outright.
+        `pool_matched`: leading query blocks fetchable from the shared KV
+        pool (live sources only — KvRouter derives it from the pool:
+        index scores); pool-aware selectors fold it into scoring."""
         endpoints = self.endpoints
         if exclude:
             kept = {w: m for w, m in endpoints.workers.items()
@@ -283,7 +310,8 @@ class KvScheduler:
                 # land on the live snapshot
                 endpoints = ProcessedEndpoints(workers=kept)
         sel = self.selector.select_worker(
-            endpoints, SchedulingRequest(isl_tokens, overlap),
+            endpoints, SchedulingRequest(isl_tokens, overlap,
+                                         pool_matched=pool_matched),
             self.block_size)
         m = self.endpoints.workers.get(sel.worker_id)
         if m is not None:

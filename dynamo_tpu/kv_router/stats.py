@@ -23,6 +23,9 @@ class RouterScoringStats:
         "last_transfer_est_s",   # winner's estimated transfer seconds
         "last_transfer_bytes",   # winner's bytes-to-move estimate
         "est_err_abs_frac",      # fleet mean |estimator error| (EWMA-fed)
+        # cluster-pool scoring (engine/kv_pool.py, docs/PERF.md §3e)
+        "pool_scored",           # decisions with a fetchable pool prefix
+        "last_pool_fetch_blocks",  # winner's pool-fetchable block count
     )
 
     def __init__(self):

@@ -117,10 +117,17 @@ class NativeEngineWorker(AsyncEngine):
     def __init__(self, engine, component=None, worker_id: str = "",
                  step_idle_sleep_s: float = 0.002):
         self.engine = engine
+        self.worker_id = worker_id
+        self._component = component
         self.metrics_publisher = KvMetricsPublisher()
         self.event_publisher = (
             KvEventPublisher(component, worker_id) if component is not None
             else None)
+        # shared-pool event publisher (engine/kv_pool.py): created lazily
+        # once the engine has a pool attached — pool Stored/Removed events
+        # ride the same plane under the `pool:{worker_id}` source id so
+        # the router indexer learns pool-resident prefixes
+        self._pool_publisher = None
         self._queues: Dict[str, asyncio.Queue] = {}
         self._wake = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
@@ -252,10 +259,22 @@ class NativeEngineWorker(AsyncEngine):
                     finish_reason=(FinishReason(ev.finish_reason)
                                    if ev.finish_reason else None)))
             self.metrics_publisher.update(self.engine.metrics())
-            if self.event_publisher is not None:
+            pool = getattr(self.engine, "kv_pool", None)
+            if self.event_publisher is not None or pool is not None:
+                # the drain also tees sealed pages into the shared pool
+                # (engine._publish_pool_pages), so it runs whenever a
+                # pool is attached even without a router event plane
                 events = self.engine.drain_kv_events()
-                if events:
+                if self.event_publisher is not None and events:
                     await self.event_publisher.publish_allocator_events(events)
+            if pool is not None and self._component is not None:
+                if self._pool_publisher is None:
+                    from dynamo_tpu.kv_router.protocols import pool_source_id
+                    self._pool_publisher = KvEventPublisher(
+                        self._component, pool_source_id(self.worker_id))
+                pev = pool.drain_events(self.engine.kv_pool_source)
+                if pev:
+                    await self._pool_publisher.publish_allocator_events(pev)
 
     # -- AsyncEngine ----------------------------------------------------------
 

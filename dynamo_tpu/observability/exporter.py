@@ -187,6 +187,14 @@ class MetricsExporter:
             name: r.gauge(f"{PREFIX}_autoscaler_{name}",
                           f"fleet autoscaler: {name.replace('_', ' ')}")
             for name in AutoscalerStats.FIELDS}
+        # cluster-wide shared KV pool counters (engine/kv_pool.py), same
+        # render-time refresh — when this process hosts the pool (or a
+        # publishing/fetching engine) these are its reuse health
+        from dynamo_tpu.engine.kv_pool import KvPoolStats
+        self.g_kv_pool = {
+            name: r.gauge(f"{PREFIX}_kv_pool_{name}",
+                          f"shared kv pool: {name.replace('_', ' ')}")
+            for name in KvPoolStats.FIELDS}
         self._client = None
         self._aggregator: Optional[KvMetricsAggregator] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -374,6 +382,9 @@ class MetricsExporter:
         from dynamo_tpu.runtime.autoscaler import AUTOSCALER_STATS
         for name, value in AUTOSCALER_STATS.snapshot().items():
             self.g_autoscaler[name].set(value=float(value))
+        from dynamo_tpu.engine.kv_pool import POOL_STATS
+        for name, value in POOL_STATS.snapshot().items():
+            self.g_kv_pool[name].set(value=float(value))
 
     # -- http -----------------------------------------------------------------
 

@@ -75,6 +75,7 @@ SITES = (
     "transfer.link",
     "offload.write_tier",
     "offload.read_tier",
+    "pool.fetch",
     "queue.dequeue",
     "discovery.heartbeat",
     # control-plane sites (this PR's scale harness)

@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R17), the
+- one positive AND one negative fixture per AST rule (R1-R18), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -1160,6 +1160,85 @@ def test_r17_live_on_actuation_call_sites():
             found = lint_source(f.read(), rel)
         assert not [x for x in found if x.rule == "R17"], \
             (rel, [x.message for x in found if x.rule == "R17"])
+
+
+# -- R18: shared-pool verification contract ------------------------------------
+
+R18_BAD = """
+    def warm(pool, seq_hash, mode):
+        # moves pool bytes with no word on where the capture sum is
+        # checked — the shape R18 exists to catch
+        return pool.fetch(seq_hash, mode)
+"""
+
+
+def test_r18_flags_unreferenced_pool_fetch():
+    found = lint_source(textwrap.dedent(R18_BAD),
+                        "dynamo_tpu/engine/fixture.py")
+    assert "R18" in rules(found)
+    found = lint_source(textwrap.dedent(R18_BAD), "tools/fixture.py")
+    assert "R18" in rules(found)
+    publish = """
+        def tee(kv_pool, sh, parent, th, arrays):
+            kv_pool.publish("w0", sh, parent, th, arrays)
+    """
+    found = lint_source(textwrap.dedent(publish),
+                        "dynamo_tpu/engine/fixture.py")
+    assert "R18" in rules(found)
+
+
+def test_r18_quiet_outside_scope_and_on_non_pool_receivers():
+    found = lint_source(textwrap.dedent(R18_BAD), "examples/fixture.py")
+    assert "R18" not in rules(found)
+    # generic fetch/publish on non-pool receivers is not a target
+    other = """
+        async def push(component, subject, payload):
+            await component.publish(subject, payload)
+
+        def load(store, key):
+            return store.fetch(key)
+    """
+    found = lint_source(textwrap.dedent(other),
+                        "dynamo_tpu/runtime/fixture.py")
+    assert "R18" not in rules(found)
+
+
+def test_r18_quiet_on_referenced_and_annotated_pool_paths():
+    handled = """
+        def warm(pool, seq_hash, mode):
+            # bytes are verified against the traveling capture checksum
+            # inside fetch(); a mismatch quarantines and returns None
+            return pool.fetch(seq_hash, mode)
+    """
+    found = lint_source(textwrap.dedent(handled),
+                        "dynamo_tpu/engine/fixture.py")
+    assert "R18" not in rules(found)
+    annotated = """
+        def poke(pool, seq_hash):
+            # dynalint: pool-verify-ok=containment probe, no bytes move
+            return pool.fetch(seq_hash, "")
+    """
+    found = lint_source(textwrap.dedent(annotated),
+                        "dynamo_tpu/engine/fixture.py")
+    assert "R18" not in rules(found)
+
+
+def test_r18_live_on_pool_call_sites():
+    """Every live pool publish/fetch/claim/prefetch call site states
+    where its checksum verification happens or carries a justified
+    annotation (engine/kv_pool.py, scheduler._pool_claim, the engine
+    publish tee, AdmissionPrefetcher)."""
+    import glob
+    scoped = glob.glob(os.path.join(REPO, "dynamo_tpu", "**", "*.py"),
+                       recursive=True)
+    scoped += glob.glob(os.path.join(REPO, "tools", "*.py"))
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R18"], \
+            (rel, [x.message for x in found if x.rule == "R18"])
 
 
 # -- jaxpr invariants ----------------------------------------------------------

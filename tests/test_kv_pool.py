@@ -1,0 +1,480 @@
+"""Cluster-wide shared KV pool tests (ISSUE 13, docs/PERF.md §3e).
+
+The warm-prefix e2e contract: a prefix prefilled on worker A serves on
+worker B WITHOUT re-prefilling the matched pages, token-identical to
+cold recompute (greedy AND seeded-sampled), and every failure on the
+fetch path — entry rot, seeded mid-fetch death, cross-kv_quant-mode
+entries, source death — degrades to exactly today's recompute behavior
+with zero dropped streams. Plus the routing half: pool-resident
+prefixes score as FETCHABLE (priced, never counted as resident), and a
+dead worker's pool-source index entries are evicted at watch-event
+time so the selector never prices a fetch from a corpse.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.kv_cache import page_hash
+from dynamo_tpu.engine.kv_pool import (
+    POOL_STATS, AdmissionPrefetcher, PoolQuantMismatch, SharedKvPool,
+)
+from dynamo_tpu.engine.scheduler import SamplingParams
+from dynamo_tpu.runtime.faults import REGISTRY, FaultSchedule, FaultSpec
+from dynamo_tpu.runtime.integrity import STATS as INTEGRITY
+
+# same tiny geometry as tests/test_offload.py (jax-cache hits across files)
+CFG = ModelConfig(dtype="float32", max_model_len=256)
+PAGE = 8
+PROMPT = list(range(10, 42))   # 4 pages; the walk matches the 3 full ones
+GREEDY = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+SAMPLED = SamplingParams(max_tokens=4, temperature=0.9, top_k=8,
+                         seed=1234, ignore_eos=True)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    REGISTRY.disarm()
+    REGISTRY.reset_counters()
+    INTEGRITY.reset()
+    POOL_STATS.reset()
+    yield
+    REGISTRY.disarm()
+    REGISTRY.reset_counters()
+    INTEGRITY.reset()
+    POOL_STATS.reset()
+
+
+def arm(site, *specs, seed=0):
+    REGISTRY.arm(site, FaultSchedule(seed, list(specs)))
+
+
+def make_engine(pool=None, wid="", num_pages=32, kv_quant=""):
+    eng = NativeEngine(CFG, EngineConfig(
+        page_size=PAGE, num_pages=num_pages, max_slots=2,
+        max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+        max_model_len=256, kv_quant=kv_quant), seed=0)
+    if pool is not None:
+        eng.attach_kv_pool(pool, wid or "w")
+    return eng
+
+
+def publish_all(eng):
+    """Drain sealed pages into the pool and wait for the publish thread
+    (the worker step loop does the drain in production)."""
+    eng.drain_kv_events()
+    eng._pool_stream.drain()
+
+
+def seeded_pool(prompt=PROMPT, kv_quant=""):
+    """A pool holding `prompt`'s pages, published by a throwaway worker A."""
+    pool = SharedKvPool(capacity_pages=64)
+    a = make_engine(pool, "A", kv_quant=kv_quant)
+    a.generate(prompt, GREEDY, "seed-a")
+    publish_all(a)
+    a.close()
+    return pool
+
+
+# -- warm-prefix e2e ----------------------------------------------------------
+
+def test_cross_worker_reuse_token_identity_greedy_and_sampled():
+    """Prefix prefilled on A serves on B through the pool: no
+    re-prefill of the matched pages, tokens identical to cold
+    recompute under greedy AND seeded sampling."""
+    oracle = make_engine()
+    expect_g = oracle.generate(PROMPT, GREEDY, "og")
+    expect_s = oracle.generate(PROMPT, SAMPLED, "os")
+
+    pool = seeded_pool()
+    b = make_engine(pool, "B")
+    assert b.generate(PROMPT, GREEDY, "bg") == expect_g
+    # the 3 full prefix pages were FETCHED, not recomputed: the walk
+    # claimed them from the pool and charged them as cached
+    assert b.scheduler.pool_fetched_pages == 3
+    assert POOL_STATS.fetch_hits == 3
+    assert b.scheduler._prefix_hits >= 3
+
+    b2 = make_engine(pool, "B2")
+    assert b2.generate(PROMPT, SAMPLED, "bs") == expect_s
+    assert b2.scheduler.pool_fetched_pages == 3
+    b.close(); b2.close(); oracle.close()
+
+
+def test_pool_entry_rot_quarantined_and_recomputed_not_served():
+    """At-rest rot in a pool entry: the fetch-time checksum verify
+    quarantines it (entry removed, never served) and the page is
+    recomputed — tokens stay identical to cold."""
+    expect = make_engine().generate(PROMPT, GREEDY, "o")
+    pool = seeded_pool()
+    h0 = page_hash(0, PROMPT[:PAGE])
+    with pool._mu:   # rot the first page's stored bytes
+        e = pool._entries[h0]
+        rotten = np.array(e.arrays[0])
+        rotten[0, 0, 0, 0] += 1.0
+        e.arrays = (rotten,) + e.arrays[1:]
+    b = make_engine(pool, "B")
+    assert b.generate(PROMPT, GREEDY, "b") == expect
+    assert POOL_STATS.quarantined == 1
+    assert INTEGRITY.quarantined >= 1
+    assert h0 not in pool          # quarantine removed the rotten entry
+    # the walk broke at page 0: nothing fetched, everything recomputed
+    assert b.scheduler.pool_fetched_pages == 0
+    b.close()
+
+
+def test_seeded_mid_fetch_death_salvages_to_recompute():
+    """The seeded mid-fetch-death case (acceptance): page 2 of the
+    fetch chain dies (corruption at the pool.fetch failpoint), the
+    walk keeps the committed page and recomputes the tail — zero
+    dropped streams, greedy AND seeded-sampled identity."""
+    oracle = make_engine()
+    expect_g = oracle.generate(PROMPT, GREEDY, "og")
+    expect_s = oracle.generate(PROMPT, SAMPLED, "os")
+
+    pool = seeded_pool()
+    arm("pool.fetch", FaultSpec("corrupt", p=1.0, n=1, skip=1))
+    b = make_engine(pool, "B")
+    assert b.generate(PROMPT, GREEDY, "bg") == expect_g
+    assert b.scheduler.pool_fetched_pages == 1   # committed prefix kept
+    assert POOL_STATS.quarantined == 1           # page 2 died mid-fetch
+    REGISTRY.disarm()
+
+    # same seeded death under sampling, fresh engine + fresh pool
+    POOL_STATS.reset()
+    pool2 = seeded_pool()
+    arm("pool.fetch", FaultSpec("corrupt", p=1.0, n=1, skip=1))
+    b2 = make_engine(pool2, "B2")
+    assert b2.generate(PROMPT, SAMPLED, "bs") == expect_s
+    assert POOL_STATS.quarantined == 1
+    b.close(); b2.close(); oracle.close()
+
+
+def test_cross_kv_quant_mode_fetch_rejected_by_name():
+    """An int8-published page fetched by an unquantized engine is
+    rejected BY NAME (PoolQuantMismatch naming both modes), walks as a
+    miss, and the request recomputes correctly — never a silent cast."""
+    pool = seeded_pool(kv_quant="int8")
+    h0 = page_hash(0, PROMPT[:PAGE])
+    with pytest.raises(PoolQuantMismatch) as ei:
+        pool.fetch(h0, "")
+    assert "int8" in str(ei.value) and "off" in str(ei.value)
+    assert POOL_STATS.quant_rejected == 1
+
+    expect = make_engine().generate(PROMPT, GREEDY, "o")
+    b = make_engine(pool, "B")   # unquantized engine, int8 pool entries
+    assert b.generate(PROMPT, GREEDY, "b") == expect
+    assert b.scheduler.pool_fetched_pages == 0
+    assert POOL_STATS.quant_rejected >= 2
+    b.close()
+
+
+def test_dedup_identical_int8_pages_from_two_workers_keeps_one_entry():
+    """Two int8 workers prefill the identical prompt: the pool keeps
+    ONE byte copy per page, records both sources, and counts the
+    second publish as dedup."""
+    pool = SharedKvPool(capacity_pages=64)
+    for wid in ("A1", "A2"):
+        eng = make_engine(pool, wid, kv_quant="int8")
+        eng.generate(PROMPT, GREEDY, f"seed-{wid}")
+        publish_all(eng)
+        eng.close()
+    h0 = page_hash(0, PROMPT[:PAGE])
+    with pool._mu:
+        entry = pool._entries[h0]
+        assert entry.sources == {"A1", "A2"}
+        assert entry.mode == "int8"
+        assert len(entry.arrays) == 4    # int8 values + f32 scale rows
+        n_entries = len(pool._entries)
+    assert POOL_STATS.publishes == n_entries      # one per unique hash
+    assert POOL_STATS.dedup_hits >= 3             # A2's prefix pages dedup'd
+    # bytes counted once per kept copy
+    assert POOL_STATS.bytes == sum(
+        e.nbytes for e in pool._entries.values())
+    # an int8 consumer serves from the dedup'd entries
+    expect = make_engine(kv_quant="int8").generate(PROMPT, GREEDY, "o")
+    b = make_engine(pool, "B", kv_quant="int8")
+    assert b.generate(PROMPT, GREEDY, "b") == expect
+    assert b.scheduler.pool_fetched_pages == 3
+    b.close()
+
+
+# -- prefetch (PRESERVE window) ----------------------------------------------
+
+def test_prefetch_racing_cancel_leaves_no_leaked_hbm_pages():
+    """Prefetched pages are sealed into the REUSABLE pool: a request
+    that never arrives (admission cancel / deadline expiry) leaks
+    nothing — every page stays evictable and num_free is unchanged."""
+    pool = seeded_pool()
+    b = make_engine(pool, "B", num_pages=16)
+    # the prefetch walk covers all 4 full pages (the admission walk
+    # leaves >=1 token to recompute, so it will use the leading 3)
+    warmed = b.prefetch_pool_pages(PROMPT)
+    assert warmed == 4
+    # reusable pages count as free: nothing is held for the request
+    assert b.scheduler.allocator.num_free == 16
+    # double prefetch is a no-op (HBM lookup short-circuits)
+    assert b.prefetch_pool_pages(PROMPT) == 0
+    # the "cancelled" request never arrives; a DIFFERENT workload can
+    # take every page (prefetched ones evict like any reusable entry)
+    other = [(500 + i) % 250 + 1 for i in range(80)]   # 10 pages
+    expect = make_engine().generate(other, GREEDY, "o")
+    assert b.generate(other, GREEDY, "b-other") == expect
+    assert b.scheduler.allocator.num_free == 16   # all freed after finish
+    b.close()
+
+
+def test_prefetch_serves_from_hbm_and_counts_window_outcome():
+    pool = seeded_pool()
+    expect = make_engine().generate(PROMPT, GREEDY, "o")
+    b = make_engine(pool, "B")
+    assert b.prefetch_pool_pages(PROMPT) == 4
+    assert POOL_STATS.prefetch_pages == 4
+    assert b.generate(PROMPT, GREEDY, "b") == expect
+    # served from HBM: the admission walk fetched nothing from the pool
+    assert b.scheduler.pool_fetched_pages == 0
+    b.close()
+
+
+def test_admission_prefetcher_warms_target_worker():
+    """The frontend-facing wrapper: tokens -> target worker ->
+    engine.prefetch_pool_pages between device steps, with the
+    hit-vs-late window accounting."""
+    from dynamo_tpu.llm.worker import NativeEngineWorker
+
+    async def main():
+        pool = seeded_pool()
+        worker = NativeEngineWorker(make_engine(pool, "B"))
+        await worker.start()
+        try:
+            pref = AdmissionPrefetcher(
+                pool, tokens_fn=lambda req: req,
+                target_fn=lambda toks: worker, page_size=PAGE)
+            assert pref.matched_pages(PROMPT) == 4
+            admitted = asyncio.Event()
+            assert await pref.prefetch(PROMPT, admitted) == 4
+            assert POOL_STATS.prefetch_hits == 1
+            assert POOL_STATS.prefetch_late == 0
+            # window already over -> a fresh warm counts late; an
+            # already-warm prompt (0 pages) counts neither
+            admitted.set()
+            assert await pref.prefetch(PROMPT, admitted) == 0
+            assert POOL_STATS.prefetch_late == 0
+            # unknown prompt: no pool match, no engine round trip
+            assert await pref.prefetch([9] * 32, admitted) == 0
+        finally:
+            await worker.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+# -- pool store semantics -----------------------------------------------------
+
+def test_source_eviction_drops_only_single_source_entries():
+    pool = SharedKvPool(capacity_pages=8)
+    page = (np.ones((1, 1, 2, 2), np.float32),
+            np.ones((1, 1, 2, 2), np.float32))
+    assert pool.publish("A", 1, 0, 11, page) == "new"
+    assert pool.publish("B", 1, 0, 11, page) == "dup"
+    assert pool.publish("A", 2, 1, 22, page) == "new"
+    assert pool.evict_source("A") == 1      # entry 2 was A-only
+    assert 1 in pool and 2 not in pool
+    with pool._mu:
+        assert pool._entries[1].sources == {"B"}
+    assert POOL_STATS.source_evictions == 1
+
+
+def test_capacity_eviction_emits_removed_events_per_source():
+    pool = SharedKvPool(capacity_pages=2)
+    page = (np.zeros((1, 1, 2, 2), np.float32),) * 2
+    pool.publish("A", 1, 0, 11, page)
+    pool.publish("A", 2, 1, 22, page)
+    pool.drain_events("A")
+    pool.publish("A", 3, 2, 33, page)     # LRU-evicts hash 1
+    assert 1 not in pool and POOL_STATS.evicted == 1
+    events = pool.drain_events("A")
+    assert ("removed", 0, 1, 0, 11) in events
+    assert ("stored", 0, 3, 2, 33) in events
+
+
+# -- routing: fetchable prefixes ---------------------------------------------
+
+class FakeClient:
+    def __init__(self, instances):
+        self.instances = instances
+
+
+def _endpoints(**workers):
+    from dynamo_tpu.kv_router.scoring import (
+        ProcessedEndpoints, WorkerMetrics,
+    )
+    return ProcessedEndpoints({
+        wid: WorkerMetrics(**kw) for wid, kw in workers.items()})
+
+
+def _sched(model, block_size=16, **kw):
+    import random
+
+    from dynamo_tpu.kv_router.scheduler import (
+        KvScheduler, TransferAwareSelector,
+    )
+    kw.setdefault("rng", random.Random(0))
+    kw.setdefault("default_block_bytes", 1 << 20)
+    return KvScheduler(block_size=block_size,
+                       selector=TransferAwareSelector(cost_model=model,
+                                                      **kw))
+
+
+def _model(**bw):
+    from dynamo_tpu.observability.fleet import TransferCostModel
+    m = TransferCostModel()
+    for link, bytes_per_s in bw.items():
+        m.observe(link, int(bytes_per_s), 1.0)
+    return m
+
+
+def test_selector_pool_blocks_reduce_bytes_to_move_and_join_overlap():
+    from dynamo_tpu.kv_router.indexer import MatchResult
+    model = _model(w1=1 << 28, w2=1 << 28)
+    sched = _sched(model)
+    sched.update_endpoints(_endpoints(
+        w1=dict(request_total_slots=8, kv_total_blocks=100),
+        w2=dict(request_total_slots=8, kv_total_blocks=100)))
+    # 10 required blocks, nothing resident, 6 fetchable from the pool
+    sched.schedule(160, MatchResult(), pool_matched=6)
+    comps = sched.selector.last_components
+    for w in ("w1", "w2"):
+        assert comps[w]["pool_blocks"] == 6
+        assert comps[w]["transfer_bytes"] == 4 * (1 << 20)   # misses only
+        assert comps[w]["pool_fetch_bytes"] == 6 * (1 << 20)
+        assert comps[w]["overlap"] == pytest.approx(6 * 16 / 160)
+    from dynamo_tpu.kv_router.stats import ROUTER_STATS
+    assert ROUTER_STATS.pool_scored >= 1
+    assert ROUTER_STATS.last_pool_fetch_blocks == 6
+
+
+def test_selector_resident_beats_fetchable_at_equal_depth():
+    """Equal reuse depth, but the fetch costs wire time: the worker
+    that already HOLDS the prefix must win."""
+    from dynamo_tpu.kv_router.indexer import MatchResult
+    model = _model(holder=1 << 26, fetcher=1 << 26)   # equal 64 MiB/s links
+    sched = _sched(model)
+    sched.update_endpoints(_endpoints(
+        holder=dict(request_total_slots=8, kv_total_blocks=100),
+        fetcher=dict(request_total_slots=8, kv_total_blocks=100)))
+    picked = sched.schedule(160, MatchResult(scores={"holder": 6}),
+                            pool_matched=6)
+    assert picked == "holder"
+    comps = sched.selector.last_components
+    assert comps["holder"]["pool_blocks"] == 0
+    assert comps["fetcher"]["pool_blocks"] == 6
+    assert comps["fetcher"]["transfer_s"] > comps["holder"]["transfer_s"]
+
+
+def test_selector_pool_match_beats_no_reuse_on_fast_links():
+    """A fetchable prefix on a fast link beats recomputing from
+    scratch — the LMCache shape of the decision."""
+    from dynamo_tpu.kv_router.indexer import MatchResult
+    model = _model(w1=1 << 30, w2=1 << 30)
+    sched = _sched(model)
+    sched.update_endpoints(_endpoints(
+        w1=dict(request_total_slots=8, kv_total_blocks=100),
+        w2=dict(request_total_slots=8, kv_total_blocks=100,
+                request_active_slots=1)))
+    # without the pool, w2's load loses; the fetchable prefix is shared
+    # so ranking is unchanged — pool depth is worker-independent
+    assert sched.schedule(160, MatchResult(), pool_matched=8) == "w1"
+    comps = sched.selector.last_components
+    assert comps["w1"]["overlap"] > 0
+
+
+def test_router_split_pool_scores_fences_corpse_sources():
+    """pool:{w} scores leave the resident score map, fold into ONE
+    fetchable depth, and a source absent from the live instance set is
+    never priced (the watch fence)."""
+    from dynamo_tpu.kv_router.indexer import MatchResult
+    from dynamo_tpu.kv_router.router import KvRouter
+    router = KvRouter(object(), FakeClient({"w1": {}, "w2": {}}),
+                      block_size=4)
+    overlap = MatchResult(scores={"w1": 1, "pool:w1": 3, "pool:dead": 5})
+    assert router._split_pool_scores(overlap) == 3   # corpse depth ignored
+    assert overlap.scores == {"w1": 1}
+
+
+def test_watch_delete_evicts_pool_source_entries_at_event_time():
+    """Satellite fix: a dead worker's POOL-source index entries go at
+    watch-delete time, mirroring the PR 4 worker-entry eviction — the
+    selector must never price a fetch from a corpse."""
+    from dynamo_tpu.kv_router.publisher import (
+        KvEventPublisher, KvMetricsPublisher,
+    )
+    from dynamo_tpu.kv_router.router import KvRouter
+    from dynamo_tpu.kv_router.scoring import WorkerMetrics
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    async def main():
+        plane = MemoryPlane()
+        worker_rts, pubs = [], {}
+        for wid in ("w1", "w2"):
+            rt = await DistributedRuntime.create_local(plane, wid)
+            comp = rt.namespace("ns").component("worker")
+            mpub = KvMetricsPublisher()
+            mpub.update(WorkerMetrics(
+                request_active_slots=0, request_total_slots=8,
+                kv_active_blocks=0, kv_total_blocks=100))
+
+            async def engine(request, context, wid=wid):
+                yield {"worker": wid}
+
+            await comp.endpoint("generate").serve(
+                engine, stats_handler=mpub.stats_handler)
+            pubs[wid] = comp
+            worker_rts.append(rt)
+
+        rrt = await DistributedRuntime.create_local(plane, "router")
+        comp = rrt.namespace("ns").component("worker")
+        client = comp.endpoint("generate").client()
+        await client.start()
+        await client.wait_for_instances()
+        router = await KvRouter(comp, client, block_size=4,
+                                scrape_interval_s=60.0).start()
+        await router.aggregator.scrape_once()
+
+        # w2 publishes two prefix pages into the POOL namespace
+        toks = list(range(100, 116))
+        pool = SharedKvPool(capacity_pages=8)
+        page = (np.zeros((1, 1, 2, 2), np.float32),) * 2
+        from dynamo_tpu.engine.kv_cache import tokens_hash
+        parent = 0
+        for i in range(2):
+            ptoks = toks[i * 4:(i + 1) * 4]
+            h = page_hash(parent, ptoks)
+            pool.publish("w2", h, parent, tokens_hash(ptoks), page)
+            parent = h
+        await KvEventPublisher(pubs["w2"], "pool:w2") \
+            .publish_allocator_events(pool.drain_events("w2"))
+        await asyncio.sleep(0.1)   # event pump
+
+        scores = router.find_matches_for_tokens(toks).scores
+        assert scores == {"pool:w2": 2}
+        # schedule() prices the fetchable depth (live source) without
+        # ranking anyone as resident
+        await router.schedule(toks)
+        assert router.scheduler.selector.last_pick["pool_blocks"] == 2
+
+        # w2 dies: the watch delete purges pool:w2 at EVENT time — no
+        # scrape happens (interval 60s) before the assertion
+        await worker_rts[1].shutdown()
+        await asyncio.sleep(0.2)
+        assert router.find_matches_for_tokens(toks).scores == {}
+        await router.schedule(toks)
+        assert router.scheduler.selector.last_pick["pool_blocks"] == 0
+
+        await router.stop()
+        await rrt.shutdown()
+        await worker_rts[0].shutdown()
+
+    asyncio.run(asyncio.wait_for(main(), 60))

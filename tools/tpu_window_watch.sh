@@ -28,7 +28,8 @@ while true; do
       && [ -e BENCH_SELF_r06_int8_churn.json ] \
       && [ -e PARITY_TPU_r06_kvq.json ] \
       && [ -e BENCH_SELF_r06_kvq.json ] \
-      && [ -e BENCH_SELF_r11_overlap_tpu.json ]; then
+      && [ -e BENCH_SELF_r11_overlap_tpu.json ] \
+      && [ -e BENCH_SELF_r13_warm_prefix_tpu.json ]; then
     echo "[watch] all TPU evidence captured; exiting" >&2
     exit 0
   fi
@@ -218,6 +219,37 @@ json.dump(r, open("BENCH_SELF_r11_overlap_tpu.json", "w"), indent=1)
 EOF
             cp "$ol" BENCH_SELF_r11_overlap_tpu.log 2>/dev/null
             echo "[watch] transfer-overlap captured: ratio $ovalue" >&2 ;;
+        esac
+      fi
+      if [ ! -e BENCH_SELF_r13_warm_prefix_tpu.json ]; then
+        # warm-prefix shared-pool ladder on hardware (ISSUE 13): cold vs
+        # local-hit vs pool-fetch vs pool-prefetch TTFT on the flagship
+        # — via the supervisor's ratio trajectory rows this is also the
+        # measured row for the pre-registered
+        # warm_prefix_pool_fetch_ttft_ratio_llama3_1b_tpu gate in
+        # BASELINE.json (tools/bench_compare.py scores it), AND the
+        # overdue real-TPU headline row the ROADMAP re-anchor asks every
+        # TPU window to recapture through the bench_compare gate
+        echo "[watch] -> warm-prefix pool bench" >&2
+        rm -f .bench_state.json
+        wj=/tmp/bench_w_$$.json wl=/tmp/bench_w_$$.log
+        BENCH_RUN_ID=BENCH_SELF_r13_warm_prefix_tpu BENCH_KVQ=0 \
+          BENCH_OVERLAP=0 BENCH_BUDGET_S=1200 timeout 1500 python bench.py \
+            >"$wj" 2>"$wl"
+        wvalue=$(python -c "import json,sys;print(json.load(open(sys.argv[1]))['extras'].get('warm_prefix',{}).get('pool_fetch_cold_ttft_ratio',0))" \
+            "$wj" 2>/dev/null || echo 0)
+        case "$wvalue" in
+          0|0.0|"") echo "[watch] warm-prefix bench got no ratio" >&2 ;;
+          *)
+            python - "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$wj" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[2]))
+r["timestamp"] = sys.argv[1]
+r["self_measured"] = True
+json.dump(r, open("BENCH_SELF_r13_warm_prefix_tpu.json", "w"), indent=1)
+EOF
+            cp "$wl" BENCH_SELF_r13_warm_prefix_tpu.log 2>/dev/null
+            echo "[watch] warm-prefix captured: fetch/cold $wvalue" >&2 ;;
         esac
       fi
       if [ ! -e BENCH_SELF_r05_spec.json ] \
