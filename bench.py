@@ -990,7 +990,8 @@ def run_warm_prefix(model_cfg, base_kwargs=None, *, requests=4,
                         dt = _time.perf_counter() - t0
                     toks.append(ev.token)
                 if ev.request_id == rid and ev.finished:
-                    SERVING.ttft.observe("bench-warm-prefix", value=dt)
+                    SERVING.ttft.observe("bench-warm-prefix", "standard",
+                                         value=dt)
                     return dt, toks
         # unreachable: max_tokens bounds the loop
 

@@ -104,6 +104,18 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       stating where the capture checksum is verified is exactly where a
       refactor can silently drop verify-on-fetch and launder rotten
       bytes into a device cache (engine/kv_pool.py owns the contract)
+- R19 starvation-bound contract (dynamo_tpu/ + tools/): any
+      preemption / victim-selection / class-ordered-dequeue call —
+      `_preempt_one(...)`, `_preempt_for(...)` / `preempt_for(...)`,
+      `select_victim(...)`, or `dequeue_leased(...)` — must sit in a
+      function that visibly references the aging / no-starvation bound
+      (aging|starv vocabulary — the QosPolicy.aging_limit guarantee
+      every class-conscious consumer shares, runtime/qos.py) or carry
+      `# dynalint: starvation-ok=<reason>`. A preemption or
+      priority-ordered dequeue whose author can't point at the bound
+      is exactly where a refactor silently turns weighted fairness
+      into a starvation engine: the high class wins every contest and
+      the batch tenant never completes
 """
 from __future__ import annotations
 
@@ -1475,6 +1487,83 @@ def r18_pool_verification_contract(tree: ast.AST, lines: List[str],
             "(SharedKvPool.fetch), quarantine on mismatch' — or "
             "annotate with `# dynalint: pool-verify-ok=<why no "
             "verification is needed here>`"))
+    return out
+
+
+# -- R19: preemption/victim-selection must reference the starvation bound -----
+
+# Scope: the dynamo_tpu package and tools/ (the engine scheduler, the
+# disagg queue consumers, and the QoS storm driver all preempt or
+# class-order work). Multi-tenant QoS (runtime/qos.py) made preemption
+# and class-ordered dequeue POLICY — and every such decision point is
+# one refactor away from unbounded starvation (the high class wins
+# every contest, the batch tenant never completes). The mitigation is
+# one shared bound: `QosPolicy.aging_limit` (queue bypass pinning,
+# StridePicker aging promotion, class-band victim requeue), plus the
+# per-class preemption budget. The rule is lexical like R16/R18: the
+# enclosing function must write the bound down (aging|starv
+# vocabulary) or the call carries `# dynalint: starvation-ok=<reason>`
+# within three lines above.
+_R19_SCOPE = ("dynamo_tpu/", "tools/")
+_R19_TERMINALS = {"_preempt_one", "_preempt_for", "preempt_for",
+                  "select_victim", "dequeue_leased"}
+_R19_ANNOT_RE = re.compile(r"#\s*dynalint:\s*starvation-ok=\S+")
+_R19_HANDLED_RE = re.compile(r"aging|starv", re.I)
+
+
+@rule("R19")
+def r19_starvation_bound_contract(tree: ast.AST, lines: List[str],
+                                  path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R19_SCOPE) \
+            or "tests/" in norm:
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R19_ANNOT_RE.search(_line(lines, x))
+                   for x in range(ln - 3, ln + 1))
+
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def enclosing_handles(ln: int) -> bool:
+        inner = None
+        for fn in funcs:
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= ln <= end and (
+                    inner is None or fn.lineno >= inner.lineno):
+                inner = fn
+        if inner is None:
+            lo, hi = max(1, ln - 10), min(len(lines), ln + 10)
+        else:
+            lo, hi = inner.lineno, getattr(inner, "end_lineno",
+                                           inner.lineno)
+        return any(_R19_HANDLED_RE.search(_line(lines, x))
+                   for x in range(lo, hi + 1))
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        terminal = _call_name(node).rsplit(".", 1)[-1]
+        if terminal not in _R19_TERMINALS:
+            continue
+        if annotated(node.lineno) or enclosing_handles(node.lineno):
+            continue
+        out.append(_finding(
+            "R19", path, lines, node,
+            f"`{_call_name(node)}(...)` preempts or class-orders work "
+            "without referencing the aging/no-starvation bound — a "
+            "priority decision point that can't point at its bound "
+            "(QosPolicy.aging_limit, the class-band requeue, the "
+            "preemption budget) is where a refactor silently lets the "
+            "high class win every contest and the batch tenant never "
+            "complete",
+            "state (docstring/comment) where the starvation bound is "
+            "enforced for this path — e.g. 'victim starvation bounded "
+            "by the class-band requeue + queue aging limit' — or "
+            "annotate with `# dynalint: starvation-ok=<why unbounded "
+            "priority is safe here>`"))
     return out
 
 

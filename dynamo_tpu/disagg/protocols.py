@@ -58,6 +58,11 @@ class RemotePrefillRequest(pydantic.BaseModel):
     # deadline_unix): the dequeuing worker derives the leased-queue wait
     # span from it without the processes sharing a monotonic clock
     enqueued_unix: Optional[float] = None
+    # multi-tenant QoS class (runtime/qos.py), carried from the decode
+    # worker's Context.baggage: routes the item into its class
+    # sub-queue (PrefillQueue weighted-deficit dequeue) and rides into
+    # the prefill engine's class-ordered admission. "" = default class.
+    qos: str = ""
 
 
 class PrefillCompletion(pydantic.BaseModel):

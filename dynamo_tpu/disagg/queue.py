@@ -13,31 +13,71 @@ worker that dies between dequeue and ack no longer loses the item — the
 lease expires and the item becomes visible to surviving consumers
 (runtime/transports Messaging.queue_pop_leased). Plain `dequeue` remains
 for callers that accept at-most-once.
+
+**Multi-tenant QoS** (runtime/qos.py, ROADMAP item 5): constructed with a
+`QosPolicy`, the queue becomes CLASS-AWARE — `enqueue` routes each item
+into a per-class sub-queue (`{name}.q.{class}`) by its
+`RemotePrefillRequest.qos`, and `dequeue_leased` serves the backlogged
+classes by weighted deficit (StridePicker: stride scheduling, service
+ratios converge to class weights) with the policy's BOUNDED-AGING
+no-starvation guarantee — a backlogged batch class skipped `aging_limit`
+consecutive dequeues is served next regardless (promotions counted on
+QOS_STATS.queue_aging_promotions, the storm's starvation evidence).
+Lease / ack / touch / poison semantics are UNCHANGED: each sub-queue is
+an ordinary leased messaging queue, acks resolve to the sub-queue the
+token was leased from, and the legacy base queue keeps working as the
+default class (mixed fleets where some enqueuers predate the policy).
+Without a policy the queue is byte-for-byte the old FIFO.
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import asyncio
+import time
+from typing import Dict, Optional, Tuple
 
 import msgpack
 
 from dynamo_tpu.disagg.protocols import RemotePrefillRequest
 from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.qos import QOS_STATS, QosPolicy, StridePicker
 
 
 def queue_name(namespace: str, model: str) -> str:
     return f"{namespace}.prefill_queue.{model or 'default'}"
 
 
+# bounded poll slice while every class sub-queue is empty (class-aware
+# mode only; the legacy path blocks on the single queue as before)
+_POLL_SLICE_S = 0.05
+# per-sub-queue pop grab: long enough to win the race with a concurrent
+# push the depth probe just saw, short enough not to stall the scan
+_GRAB_S = 0.02
+
+
 class PrefillQueue:
-    def __init__(self, messaging, namespace: str, model: str = ""):
+    def __init__(self, messaging, namespace: str, model: str = "",
+                 qos_policy: Optional[QosPolicy] = None):
         self.messaging = messaging
         self.name = queue_name(namespace, model)
+        self.qos_policy = qos_policy
+        self._picker = StridePicker(qos_policy) if qos_policy else None
+        # lease token -> sub-queue it was popped from (class-aware acks;
+        # tokens from other processes fall back to the base name, which
+        # every transport resolves by token anyway)
+        self._lease_queues: Dict[str, str] = {}
+
+    def _class_queue(self, cls: str) -> str:
+        return f"{self.name}.q.{cls}"
 
     async def enqueue(self, req: RemotePrefillRequest) -> None:
         # msgpack, not JSON: multimodal requests carry raw pixel bytes
         # (ImagePart.data), which msgpack frames natively
-        await self.messaging.queue_push(
-            self.name, msgpack.packb(req.model_dump(), use_bin_type=True))
+        payload = msgpack.packb(req.model_dump(), use_bin_type=True)
+        name = self.name
+        if self.qos_policy is not None:
+            name = self._class_queue(
+                self.qos_policy.resolve(req.qos or None).name)
+        await self.messaging.queue_push(name, payload)
 
     async def dequeue(self, timeout: Optional[float] = None
                       ) -> Optional[RemotePrefillRequest]:
@@ -57,21 +97,76 @@ class PrefillQueue:
     ) -> Optional[Tuple[RemotePrefillRequest, str]]:
         """Dequeue under a redelivery lease; returns (request, lease_token).
         The item is re-enqueued if `ack(token)` doesn't arrive within
-        lease_s — size the lease above the worst-case prefill+transfer."""
+        lease_s — size the lease above the worst-case prefill+transfer.
+
+        Class-aware mode serves backlogged classes by weighted deficit
+        with the policy's bounded-aging no-starvation guarantee (a class
+        skipped `aging_limit` consecutive dequeues is served next — see
+        StridePicker; dynalint R19)."""
         if faults.REGISTRY.enabled:  # pre-pop: injected faults lose nothing
             await faults.REGISTRY.fire("queue.dequeue")
-        got = await self.messaging.queue_pop_leased(
-            self.name, timeout=timeout, lease_s=lease_s)
-        if got is None:
-            return None
-        payload, token = got
-        return RemotePrefillRequest.model_validate(
-            msgpack.unpackb(payload, raw=False)), token
+        if self.qos_policy is None:
+            got = await self.messaging.queue_pop_leased(
+                self.name, timeout=timeout, lease_s=lease_s)
+            if got is None:
+                return None
+            payload, token = got
+            return RemotePrefillRequest.model_validate(
+                msgpack.unpackb(payload, raw=False)), token
+        return await self._dequeue_leased_classed(timeout, lease_s)
+
+    async def _dequeue_leased_classed(
+            self, timeout: Optional[float], lease_s: float
+    ) -> Optional[Tuple[RemotePrefillRequest, str]]:
+        policy = self.qos_policy
+        default = policy.resolve(None).name
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            # depth probe per class; the legacy base queue counts as
+            # default-class backlog (mixed fleets)
+            depths: Dict[str, int] = {}
+            for cls in policy.names():
+                d = await self.messaging.queue_depth(
+                    self._class_queue(cls))
+                if d:
+                    depths[cls] = d
+            base_depth = await self.messaging.queue_depth(self.name)
+            if base_depth:
+                depths[default] = depths.get(default, 0) + base_depth
+            order = self._picker.order(list(depths))
+            for cls in order:
+                names = [self._class_queue(cls)]
+                if cls == default and base_depth:
+                    names.append(self.name)
+                for name in names:
+                    got = await self.messaging.queue_pop_leased(
+                        name, timeout=_GRAB_S, lease_s=lease_s)
+                    if got is None:
+                        continue
+                    before = self._picker.aging_promotions
+                    self._picker.charge(cls, list(depths))
+                    QOS_STATS.queue_aging_promotions += \
+                        self._picker.aging_promotions - before
+                    payload, token = got
+                    self._lease_queues[token] = name
+                    return RemotePrefillRequest.model_validate(
+                        msgpack.unpackb(payload, raw=False)), token
+            # every sub-queue empty (or raced away): bounded poll slice
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                await asyncio.sleep(min(_POLL_SLICE_S, left))
+            else:
+                await asyncio.sleep(_POLL_SLICE_S)
 
     async def ack(self, token: str) -> None:
         """Settle a leased item (done or terminally failed — either way it
-        must not be redelivered)."""
-        await self.messaging.queue_ack(self.name, token)
+        must not be redelivered). Resolves to the sub-queue the token
+        was leased from (class-aware mode)."""
+        await self.messaging.queue_ack(
+            self._lease_queues.pop(token, self.name), token)
 
     async def touch(self, token: str, lease_s: float = 30.0) -> bool:
         """Re-arm a leased item's redelivery deadline (JetStream
@@ -85,7 +180,13 @@ class PrefillQueue:
         touch = getattr(self.messaging, "queue_touch", None)
         if touch is None:
             return True
-        return await touch(self.name, token, lease_s=lease_s)
+        return await touch(self._lease_queues.get(token, self.name),
+                           token, lease_s=lease_s)
 
     async def depth(self) -> int:
-        return await self.messaging.queue_depth(self.name)
+        total = await self.messaging.queue_depth(self.name)
+        if self.qos_policy is not None:
+            for cls in self.qos_policy.classes:
+                total += await self.messaging.queue_depth(
+                    self._class_queue(cls))
+        return total

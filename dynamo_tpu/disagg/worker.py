@@ -32,6 +32,7 @@ from dynamo_tpu.protocols.common import (
 )
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.integrity import XFER_STATS
+from dynamo_tpu.runtime.qos import qos_of
 from dynamo_tpu.runtime.tracing import TRACER, TraceContext
 
 log = logging.getLogger("dynamo_tpu.disagg")
@@ -266,6 +267,10 @@ class DisaggDecodeWorker(NativeEngineWorker):
                                    if remaining is not None else None),
                     trace=rtc.to_wire() if rtc is not None else None,
                     enqueued_unix=time.time(),
+                    # QoS class rides the baggage (runtime/qos.py):
+                    # routes the item into its class sub-queue for the
+                    # weighted-deficit dequeue
+                    qos=qos_of(context.baggage),
                 ))
                 stop_task = asyncio.create_task(context.wait_stopped())
                 try:
@@ -762,6 +767,9 @@ class PrefillWorker:
         while True:
             await self._slots.acquire()  # before dequeue: backpressure stays
             try:                         # visible in the queue depth
+                # class-aware queues serve by weighted deficit with the
+                # bounded-aging no-starvation guarantee (PrefillQueue /
+                # runtime/qos.py StridePicker; dynalint R19)
                 got = await self.queue.dequeue_leased(
                     timeout=self.dequeue_timeout_s, lease_s=self.lease_s)
             except asyncio.CancelledError:
@@ -840,7 +848,9 @@ class PrefillWorker:
                         request_id=rid, token_ids=req.token_ids,
                         sampling=req.sampling, stop=req.stop,
                         mm_parts=req.mm_parts)
-                    er = _to_engine_request(pre)
+                    # class rides into the prefill engine's own
+                    # class-ordered admission (scheduler._queue_insert)
+                    er = _to_engine_request(pre, qos=req.qos)
                     er.prefill_only = True
                     self.worker._pending_adds.append(er)
                     self.worker._wake.set()

@@ -195,6 +195,16 @@ class SequenceState:
     # multimodal: [(prompt_offset, embeds [n, D])] — kept on the sequence so
     # chunked prefill and preempt-and-re-prefill can rebuild embed rows
     mm_spans: list = dataclasses.field(default_factory=list)
+    # multi-tenant QoS (runtime/qos.py): class name + resolved priority,
+    # set at admission from EngineRequest.qos. qos_bypassed counts how
+    # many times a higher class jumped this sequence in the waiting
+    # queue — bounded by QosPolicy.aging_limit (the no-starvation
+    # guarantee); preempted_by records the preemptor's class so the
+    # debt is repaid when this victim resumes decoding.
+    qos: str = ""
+    qos_prio: int = 0
+    qos_bypassed: int = 0
+    preempted_by: Optional[str] = None
 
     @property
     def total_len(self) -> int:

@@ -31,6 +31,9 @@ import numpy as np
 
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.kv_cache import PageAllocator, SequenceState
+from dynamo_tpu.runtime.qos import (
+    DEFAULT_POLICY, QOS_STATS, QosPolicy, select_victim,
+)
 
 
 @dataclasses.dataclass
@@ -80,6 +83,11 @@ class EngineRequest:
     # mm_spans by the engine's vision tower at admission (NativeEngine.
     # _resolve_mm); requests built above the engine use this form
     mm_pixels: Optional[list] = None
+    # multi-tenant QoS class name (runtime/qos.py), carried from
+    # Context.baggage by the worker: orders the waiting queue, selects
+    # preemption victims, and charges cross-class preemptions against
+    # the class budget. "" = the policy default class.
+    qos: str = ""
 
 
 @dataclasses.dataclass
@@ -355,6 +363,12 @@ class Scheduler:
         # the smallest prefill bucket must still fit one chunk row next
         # to a decode row, or the budget silently starves prefill
         self._mixed_budget_floor = 2 * min(cfg.prefill_buckets)
+        # multi-tenant QoS (runtime/qos.py): the class table + the
+        # aging bound every class-ordered decision respects, plus the
+        # per-class outstanding cross-class-preemption debt (charged in
+        # _preempt_for, repaid when the victim re-enters a decode slot)
+        self.qos_policy: QosPolicy = DEFAULT_POLICY
+        self._qos_preempt_debt: Dict[str, int] = {}
         # monotonic epoch source shared by admission AND preemption: the
         # engine's device-resident decode carry and the sampler's host
         # array caches key slots by (request_id, epoch), so every
@@ -409,17 +423,46 @@ class Scheduler:
                     emb.tobytes())
                 for j in range(emb.shape[0]):
                     prompt[off + j] = int((base + j) % 0x7FFFFFF0) + 1
+        qos_cls = self.qos_policy.resolve(req.qos or None)
         seq = SequenceState(request_id=req.request_id, prompt=prompt,
                             prefill_only=req.prefill_only, mm_spans=spans,
-                            epoch=next(self._epoch_seq))
+                            epoch=next(self._epoch_seq),
+                            qos=req.qos or "", qos_prio=qos_cls.priority)
         self.params[req.request_id] = req.params
         self._match_prefix(seq)
         return seq
 
     def add_request(self, req: EngineRequest) -> SequenceState:
         seq = self._admit(req)
-        self.waiting.append(seq)
+        self._queue_insert(seq)
         return seq
+
+    def _queue_insert(self, seq: SequenceState) -> None:
+        """Class-aware waiting-queue insertion with bounded aging
+        (runtime/qos.py): a higher-priority arrival bypasses
+        lower-priority waiting sequences (FIFO within a class), but
+        never one already bypassed `aging_limit` times — that sequence
+        is PINNED and everything behind it stays behind it, so a batch
+        request under sustained interactive pressure waits a bounded
+        number of bypasses, never forever (the no-starvation guarantee
+        dynalint R19 holds consumers to). With a single class (or the
+        class-free default) every prio ties and this is append()."""
+        limit = self.qos_policy.aging_limit
+        idx = len(self.waiting)
+        while idx > 0:
+            prev = self.waiting[idx - 1]
+            if prev.qos_prio >= seq.qos_prio \
+                    or prev.qos_bypassed >= limit:
+                if prev.qos_bypassed >= limit \
+                        and prev.qos_prio < seq.qos_prio:
+                    QOS_STATS.sched_aging_pins += 1
+                break
+            idx -= 1
+        for j in range(idx, len(self.waiting)):
+            self.waiting[j].qos_bypassed += 1
+        if idx < len(self.waiting):
+            QOS_STATS.sched_bypasses += 1
+        self.waiting.insert(idx, seq)
 
     # -- disaggregation: decode side -----------------------------------------
 
@@ -697,6 +740,10 @@ class Scheduler:
         return out
 
     def finish(self, seq: SequenceState) -> None:
+        if seq.preempted_by:
+            # a victim that terminates without resuming (abort, client
+            # gone) still settles the preemptor class's qos debt
+            self._repay_preempt_debt(seq)
         if seq.slot >= 0:
             self.running[seq.slot] = None
             seq.slot = -1
@@ -795,7 +842,15 @@ class Scheduler:
                 plan = self._schedule_mixed()
                 if plan is not None:
                     return plan
-                # no admissible prefill row right now (slots/memory):
+                # no admissible prefill row right now (slots/memory): a
+                # high-priority head may preempt the lowest-class decode
+                # (budget-charged, aging-bounded — _preempt_for, R19)
+                # and re-plan against the freed capacity
+                if self._preempt_for(self.waiting[0]):
+                    plan = (self._schedule_mixed()
+                            or self._schedule_prefill())
+                    if plan is not None:
+                        return plan
                 # decode alone — never a decode-stalling pure prefill
                 return self._schedule_decode()
             if self.waiting:
@@ -898,6 +953,15 @@ class Scheduler:
             return None
         slots_left = sum(1 for s in self.running if s is None)
         batch, tb, head_block = self._collect_prefill_batch(slots_left)
+        if not batch and head_block in ("slot", "memory"):
+            # cross-class preemption: a blocked HIGH-priority head may
+            # evict the lowest-priority running decode (budget-charged,
+            # aging-bounded — see _preempt_for / dynalint R19) and
+            # retry admission against the freed slot/pages this pass
+            if self._preempt_for(self.waiting[0]):
+                slots_left = sum(1 for s in self.running if s is None)
+                batch, tb, head_block = \
+                    self._collect_prefill_batch(slots_left)
         if not batch:
             if head_block == "memory":
                 # only a true dead end raises: no running decode, no
@@ -934,6 +998,9 @@ class Scheduler:
             # fed-token slot
             while seq.slot >= 0 \
                     and not self._ensure_pages(seq, seq.total_len + 1):
+                # memory-pressure preemption: lowest class first,
+                # youngest within a class; victim starvation bounded by
+                # the class-band requeue + queue aging limit (R19)
                 self._preempt_one()
         active = [s for s in self.running if s is not None]
         if not active:
@@ -1061,6 +1128,10 @@ class Scheduler:
             assert slot >= 0, "final prefill chunk scheduled without a free slot"
             seq.slot = slot
             self.running[slot] = seq
+            if seq.preempted_by:
+                # the victim is decoding again: the preemptor class's
+                # outstanding cross-class debt is repaid (qos budget)
+                self._repay_preempt_debt(seq)
             seq.output.append(int(sampled_token))
             return int(sampled_token)
         self.waiting.appendleft(seq)  # continue chunking next step
@@ -1093,8 +1164,9 @@ class Scheduler:
                         ladder[0])
         # make room for every token the decode window may write (bounded by
         # the request's own prompt+max_tokens limit, which _admit kept within
-        # max_model_len), preempting (youngest-first) until the allocation
-        # succeeds or the sequence itself got preempted
+        # max_model_len), preempting (lowest QoS class first, youngest
+        # within a class) until the allocation succeeds or the sequence
+        # itself got preempted
         for seq in active:
             limit = len(seq.prompt) + self.params[seq.request_id].max_tokens
             # never below total_len+1 (the old single-step invariant): a
@@ -1102,6 +1174,9 @@ class Scheduler:
             upto = max(seq.total_len + 1, min(seq.total_len + n_window,
                                               limit))
             while seq.slot >= 0 and not self._ensure_pages(seq, upto):
+                # memory-pressure preemption: lowest class first,
+                # youngest within a class; victim starvation bounded by
+                # the class-band requeue + queue aging limit (R19)
                 self._preempt_one()
         active = [s for s in self.running if s is not None]
         if not active:
@@ -1168,17 +1243,80 @@ class Scheduler:
             n_window=n_window, stop_ids=stop_ids)
 
     def _preempt_one(self) -> None:
-        """Evict the youngest running seq back to waiting (recompute later)."""
-        victim = None
-        for seq in self.running:
-            if seq is not None and (victim is None or seq.num_computed < victim.num_computed):
-                victim = seq
+        """Evict one running seq back to waiting under MEMORY pressure.
+
+        Victim selection is policy-driven (runtime/qos.py
+        select_victim): lowest QoS class first, youngest (fewest
+        computed tokens) within a class — same-class pressure keeps
+        the historical youngest-first pick bit-for-bit, and the
+        victim's starvation is bounded by the class-band requeue plus
+        the waiting queue's aging limit (no-starvation, dynalint
+        R19)."""
+        victim = select_victim(self.running, self.qos_policy)
         if victim is None:
             raise MemoryError("KV cache exhausted with nothing to preempt")
+        self._evict_to_waiting(victim)
+
+    def _preempt_for(self, seq: SequenceState) -> bool:
+        """Cross-class preemption: a high-priority arrival that cannot
+        be admitted (blocked on slots or pages) evicts the LOWEST-
+        priority running decode strictly below its class — the
+        eviction-beats-recompute tradeoff of the KV-cache survey
+        applied as scheduler policy. The victim's committed KV pages
+        stay content-addressed in the allocator reuse pool (and spill
+        through the offload tiers under pressure), so its resume
+        re-claims them via the prefix walk and continues
+        token-identically.
+
+        Charged against the preemptor's class budget: each preemption
+        adds one outstanding debt to `seq`'s class, repaid when a
+        victim it displaced resumes decoding; at `preempt_budget` the
+        class may not preempt further (bounded harm). Victim
+        starvation is bounded by the aging limit (select_victim's
+        no-starvation note; dynalint R19). Returns True when a victim
+        was evicted."""
+        cls = self.qos_policy.resolve(seq.qos or None)
+        if cls.preempt_budget <= 0 or \
+                self._qos_preempt_debt.get(cls.name, 0) \
+                >= cls.preempt_budget:
+            if cls.preempt_budget > 0:
+                QOS_STATS.preempt_denied_budget += 1
+            return False
+        victim = select_victim(self.running, self.qos_policy,
+                               below_prio=seq.qos_prio)
+        if victim is None:
+            return False
+        victim.preempted_by = cls.name
+        self._qos_preempt_debt[cls.name] = \
+            self._qos_preempt_debt.get(cls.name, 0) + 1
+        QOS_STATS.note_preempt(
+            cls.name, self.qos_policy.resolve(victim.qos or None).name)
+        self._evict_to_waiting(victim)
+        return True
+
+    def _repay_preempt_debt(self, seq: SequenceState) -> None:
+        """A preemption victim resumed decoding: repay the preemptor
+        class's outstanding debt (the budget bounds OUTSTANDING
+        displacements, not lifetime count)."""
+        cls = seq.preempted_by
+        seq.preempted_by = None
+        if not cls:
+            return
+        n = self._qos_preempt_debt.get(cls, 0)
+        if n > 1:
+            self._qos_preempt_debt[cls] = n - 1
+        else:
+            self._qos_preempt_debt.pop(cls, None)
+
+    def _evict_to_waiting(self, victim: SequenceState) -> None:
+        """Shared eviction mechanics for both preemption paths."""
         self.running[victim.slot] = None
         victim.slot = -1
         # fresh GLOBAL epoch (not +=1): a bumped epoch must never equal
-        # one a later same-id admission draws from the shared source
+        # one a later same-id admission draws from the shared source —
+        # and the engine's device-resident decode-carry signature keys
+        # on (request_id, epoch), so the stale carry can never be
+        # decoded from after the victim resumes
         victim.epoch = next(self._epoch_seq)
         for pid in victim.pages:
             self.allocator.free(pid)
@@ -1188,9 +1326,22 @@ class Scheduler:
         victim.num_computed = 0
         # restart from scratch; prefill iterates all_tokens (prompt + output)
         # so generated tokens are recomputed without touching max_tokens
-        # accounting
+        # accounting. Committed full pages were sealed (content-hashed)
+        # before eviction: free() keeps them claimable by hash in the
+        # reuse pool, eviction under pressure offloads them through the
+        # host/disk tiers, so this _match_prefix — or the one at resume —
+        # reclaims the committed prefix instead of recomputing it.
         self._match_prefix(victim)
-        self.waiting.appendleft(victim)
+        # requeue at the head of the victim's CLASS BAND: ahead of
+        # equal/lower classes (the historical appendleft when classes
+        # tie) but behind any higher-priority arrivals — the preemptor
+        # must be able to take the freed capacity, while the victim's
+        # wait stays bounded by the queue's aging limit (R19)
+        idx = 0
+        while idx < len(self.waiting) \
+                and self.waiting[idx].qos_prio > victim.qos_prio:
+            idx += 1
+        self.waiting.insert(idx, victim)
 
     def commit_decode_token(self, seq: SequenceState, tok: int) -> None:
         """Account one decoded token for one sequence (fed-token KV resident,

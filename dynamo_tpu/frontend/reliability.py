@@ -114,6 +114,12 @@ class ReliabilityMetrics:
         self.deadline_exceeded = r.counter(
             "llm_reliability_deadline_exceeded_total",
             "requests failed by their end-to-end deadline")
+        # class-aware admission (runtime/qos.py): sheds split per QoS
+        # class — llm_reliability_shed_requests_total stays the fleet
+        # total, this partitions it by tenant class
+        self.shed_by_class = r.counter(
+            "llm_qos_shed_total",
+            "requests shed at admission, by QoS class", ("qos",))
 
     FIELDS = ("migrations", "retries", "breaker_opens", "breaker_closes",
               "shed_requests", "stall_fires", "deadline_exceeded")
@@ -254,41 +260,103 @@ class CircuitBreaker:
 
 class AdmissionShed(Exception):
     """Raised by AdmissionControl.acquire when the request must be shed;
-    carries the Retry-After hint."""
+    carries the Retry-After hint (and the shed request's QoS class in
+    class-aware mode)."""
 
-    def __init__(self, retry_after_s: int):
+    def __init__(self, retry_after_s: int, qos: str = ""):
         super().__init__("admission queue full")
         self.retry_after_s = retry_after_s
+        self.qos = qos
 
 
 class AdmissionControl:
-    """Bounded concurrent admissions + bounded FIFO wait queue.
+    """Bounded concurrent admissions + bounded wait queue, optionally
+    WEIGHTED-FAIR across QoS classes (runtime/qos.py, ROADMAP item 5).
 
-    Up to `max_inflight` requests run; up to `max_queued` more wait (at
-    most `queue_timeout_s`). Anything past that is shed immediately —
-    the caller maps AdmissionShed to HTTP 429 with Retry-After. Shedding
-    at the door keeps accepted requests' latency bounded instead of
-    letting an unbounded backlog time everyone out (ROADMAP: heavy
-    traffic from millions of users).
-    """
+    Without a policy (the legacy shape): up to `max_inflight` requests
+    run, up to `max_queued` more wait FIFO (at most `queue_timeout_s`),
+    anything past that is shed immediately — the caller maps
+    AdmissionShed to HTTP 429 with Retry-After.
+
+    With a `QosPolicy`, admission becomes class-aware end to end
+    (AdmissionState owns the synchronous logic; this wrapper owns the
+    futures): per-class token-bucket rate budgets and concurrency caps,
+    freed slots granted to queued classes in weighted-fair order with
+    the bounded-aging no-starvation guarantee, over-cap arrivals shed
+    the LOWEST-priority queued work first (batch sheds before
+    interactive ever does), and the Retry-After hint scales with the
+    shedder's own class queue depth instead of a constant. Shed
+    counters split per class (`llm_qos_shed_total{qos}`)."""
 
     def __init__(self, max_inflight: int, max_queued: int = 0,
                  queue_timeout_s: float = 5.0, retry_after_s: int = 1,
-                 metrics: Optional[ReliabilityMetrics] = None):
+                 metrics: Optional[ReliabilityMetrics] = None,
+                 policy=None):
         self.max_inflight = max_inflight
         self.max_queued = max_queued
         self.queue_timeout_s = queue_timeout_s
         self.retry_after_s = retry_after_s
         self.metrics = metrics
+        self.policy = policy
         self.active = 0
         self._waiters: "list[asyncio.Future]" = []
+        self._state = None
+        self._class_waiters: Dict[str, "list[asyncio.Future]"] = {}
+        if policy is not None:
+            from dynamo_tpu.runtime.qos import AdmissionState
+            self._state = AdmissionState(policy, max_inflight,
+                                         max_queued, retry_after_s)
 
-    def _shed(self) -> AdmissionShed:
+    def _shed(self, qos: str = "",
+              retry_after_s: Optional[int] = None) -> AdmissionShed:
         if self.metrics:
             self.metrics.shed_requests.inc()
-        return AdmissionShed(self.retry_after_s)
+            if qos:
+                self.metrics.shed_by_class.inc(qos)
+        if qos:
+            from dynamo_tpu.runtime.qos import QOS_STATS
+            QOS_STATS.note_shed(qos)
+        return AdmissionShed(retry_after_s if retry_after_s is not None
+                             else self.retry_after_s, qos)
 
-    async def acquire(self) -> None:
+    async def acquire(self, qos: Optional[str] = None) -> None:
+        if self._state is None:
+            await self._acquire_legacy()
+            return
+        from dynamo_tpu.runtime.qos import QOS_STATS
+        cls = self.policy.resolve(qos).name
+        d = self._state.try_admit(cls, time.monotonic())
+        if d.kind == "admit":
+            self.active += 1
+            return
+        if d.kind == "shed":
+            raise self._shed(cls, d.retry_after_s)
+        if d.kind == "displace":
+            # batch-first displacement: the newest waiter of the
+            # lowest-priority backlogged class is shed to make room
+            QOS_STATS.admission_displaced += 1
+            victims = self._class_waiters.get(d.victim_class, [])
+            while victims:
+                vic = victims.pop()
+                if not vic.done():
+                    vic.set_exception(self._shed(d.victim_class,
+                                                 d.retry_after_s))
+                    break
+        fut = asyncio.get_running_loop().create_future()
+        self._class_waiters.setdefault(cls, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, self.queue_timeout_s)
+        except asyncio.TimeoutError:
+            waiters = self._class_waiters.get(cls, [])
+            if fut in waiters:
+                waiters.remove(fut)
+                self._state.note_abandoned(cls)
+                raise self._shed(cls,
+                                 self._state.retry_after(cls)) from None
+            # lost the race: release() granted the slot as we timed out
+            return
+
+    async def _acquire_legacy(self) -> None:
         if self.active < self.max_inflight:
             self.active += 1
             return
@@ -305,13 +373,43 @@ class AdmissionControl:
             # lost the race: release() granted the slot as we timed out
             return
 
-    def release(self) -> None:
-        while self._waiters:
-            fut = self._waiters.pop(0)
-            if not fut.done():
-                fut.set_result(None)   # slot transfers; active unchanged
-                return
+    def release(self, qos: Optional[str] = None) -> None:
+        if self._state is None:
+            while self._waiters:
+                fut = self._waiters.pop(0)
+                if not fut.done():
+                    fut.set_result(None)   # slot transfers; active same
+                    return
+            self.active = max(0, self.active - 1)
+            return
+        from dynamo_tpu.runtime.qos import QOS_STATS
+        cls = self.policy.resolve(qos).name
+        self._state.note_released(cls)
         self.active = max(0, self.active - 1)
+        # grant the freed slot weighted-fair across queued classes
+        # (StridePicker order, bounded aging — runtime/qos.py)
+        while True:
+            before = self._state.picker.aging_promotions
+            grant = self._state.grant()
+            if grant is None:
+                return
+            QOS_STATS.admission_aging_promotions += \
+                self._state.picker.aging_promotions - before
+            waiters = self._class_waiters.get(grant, [])
+            fut = None
+            while waiters:
+                cand = waiters.pop(0)
+                if not cand.done():
+                    fut = cand
+                    break
+            if fut is not None:
+                self._state.note_granted(grant)
+                self.active += 1
+                fut.set_result(None)
+                return
+            # the picked class had no live waiter (raced a timeout that
+            # hasn't noted itself yet): reconcile and try the next class
+            self._state.note_abandoned(grant)
 
 
 # -- the migrating client ------------------------------------------------------
@@ -369,8 +467,14 @@ class ReliableClient:
         blocked = self.breaker.blocked()
         if self.router is not None:
             try:
+                # QoS class rides the baggage (runtime/qos.py): the
+                # transfer-aware selector scales its cost term by the
+                # class latency weight, so interactive requests avoid
+                # backlogged links first
+                from dynamo_tpu.runtime.qos import qos_of
                 wid = await self.router.schedule(pre.token_ids,
-                                                 exclude=blocked)
+                                                 exclude=blocked,
+                                                 qos=qos_of(ctx.baggage))
                 self.breaker.on_dispatch(wid)
                 return wid
             except Exception:  # dynalint: swallow-ok=falls-back-to-load-balancing

@@ -29,16 +29,25 @@ async def run_frontend(runtime, host: str = "0.0.0.0", port: int = 8080,
     import os
     admission = None
     max_inflight = int(os.environ.get("DYN_MAX_INFLIGHT", "0"))
+    # DYN_QOS=1: class-aware weighted-fair admission over the default
+    # QoS class table (runtime/qos.py; x-qos-class header selects the
+    # tenant class — docs/RESILIENCE.md "Multi-tenant QoS")
+    qos_policy = None
+    if os.environ.get("DYN_QOS", "") not in ("", "0"):
+        from dynamo_tpu.runtime.qos import DEFAULT_POLICY
+        qos_policy = DEFAULT_POLICY
     if max_inflight > 0:
         from dynamo_tpu.frontend.reliability import AdmissionControl
         admission = AdmissionControl(
             max_inflight,
             max_queued=int(os.environ.get("DYN_ADMISSION_QUEUE", "64")),
-            retry_after_s=int(os.environ.get("DYN_RETRY_AFTER_S", "1")))
+            retry_after_s=int(os.environ.get("DYN_RETRY_AFTER_S", "1")),
+            policy=qos_policy)
     deadline = os.environ.get("DYN_REQUEST_DEADLINE_S")
     service = await HttpService(
         host, port, admission=admission,
-        default_deadline_s=float(deadline) if deadline else None).start()
+        default_deadline_s=float(deadline) if deadline else None,
+        qos_policy=qos_policy).start()
 
     async def make_router(component, client, card):
         return await KvRouter(component, client,

@@ -71,7 +71,7 @@ class HttpService:
     def __init__(self, host: str = "0.0.0.0", port: int = 8080,
                  registry: Optional[MetricsRegistry] = None,
                  admission=None, default_deadline_s: Optional[float] = None,
-                 prefetcher=None):
+                 prefetcher=None, qos_policy=None):
         """admission: an AdmissionControl (frontend/reliability.py) for
         load shedding — past its caps, requests get 429 + Retry-After.
         default_deadline_s: end-to-end deadline armed on every request's
@@ -79,8 +79,16 @@ class HttpService:
         prefetcher: an AdmissionPrefetcher (engine/kv_pool.py) — while a
         request sits in the admission queue (the `admission.wait` span),
         its matched shared-pool pages are warmed into the target
-        worker's HBM (PRESERVE-style); strictly best-effort."""
+        worker's HBM (PRESERVE-style); strictly best-effort.
+        qos_policy: a QosPolicy (runtime/qos.py) — requests carry a
+        class (x-qos-class header, unknown names resolve to the policy
+        default) on Context.baggage across every wire hop; admission,
+        the prefill queue, the engine scheduler, and the router all
+        act on it. None = the shared DEFAULT_POLICY for labeling, no
+        behavior change without a class-aware AdmissionControl."""
         from dynamo_tpu.frontend.reliability import ReliabilityMetrics
+        from dynamo_tpu.runtime.qos import DEFAULT_POLICY
+        self.qos_policy = qos_policy or DEFAULT_POLICY
         self.server = HttpServer(host, port)
         self.models = ModelManager()
         self.registry = registry or MetricsRegistry()
@@ -181,6 +189,22 @@ class HttpService:
             name: m.gauge(f"llm_autoscaler_{name}",
                           f"fleet autoscaler: {name.replace('_', ' ')}")
             for name in AutoscalerStats.FIELDS}
+        # multi-tenant QoS (runtime/qos.py QOS_STATS): scheduler
+        # preemptions + budget refusals, queue/admission aging
+        # promotions, class bypasses, displacement sheds — same
+        # render-time fold; per-class splits as labeled gauges
+        from dynamo_tpu.runtime.qos import QosStats
+        self._qos = {
+            name: m.gauge(f"llm_qos_{name}",
+                          f"multi-tenant qos: {name.replace('_', ' ')}")
+            for name in QosStats.FIELDS}
+        self._qos_preempt = m.gauge(
+            "llm_qos_preemptions_by_class",
+            "cross-class preemptions caused, by preemptor class",
+            ("qos",))
+        self._qos_preempted = m.gauge(
+            "llm_qos_preempted_by_class",
+            "decodes preempted, by victim class", ("qos",))
         s = self.server
         s.route("POST", "/v1/chat/completions", self._chat)
         s.route("POST", "/v1/completions", self._completions)
@@ -255,6 +279,13 @@ class HttpService:
         from dynamo_tpu.runtime.autoscaler import AUTOSCALER_STATS
         for name, value in AUTOSCALER_STATS.snapshot().items():
             self._autoscaler[name].set(value=float(value))
+        from dynamo_tpu.runtime.qos import QOS_STATS
+        for name, value in QOS_STATS.snapshot().items():
+            self._qos[name].set(value=float(value))
+        for cls, n in QOS_STATS.preempt_by_class.items():
+            self._qos_preempt.set(cls, value=float(n))
+        for cls, n in QOS_STATS.preempted_by_class.items():
+            self._qos_preempted.set(cls, value=float(n))
 
     async def _chat(self, req: Request):
         try:
@@ -285,6 +316,14 @@ class HttpService:
                    model: str, start_stream):
         request_type = "stream" if oai_req.stream else "unary"
         t0 = time.perf_counter()
+        # QoS class (runtime/qos.py): clients declare a tenant class via
+        # the x-qos-class header; unknown/absent names resolve to the
+        # policy default (standard service, never accidental priority).
+        # The resolved name rides Context.baggage[QOS_KEY] across every
+        # wire hop — the same carriage as the trace context below.
+        from dynamo_tpu.runtime.qos import QOS_KEY
+        qos_cls = self.qos_policy.resolve(
+            http_req.headers.get("x-qos-class", "")).name
         # trace root: one trace per HTTP request, created at ingest so
         # the admission wait is already inside it. The context rides
         # ctx.baggage and crosses every wire hop from here on. The root
@@ -312,10 +351,10 @@ class HttpService:
             from dynamo_tpu.frontend.reliability import AdmissionShed
             try:
                 t_adm = time.monotonic()
-                await self.admission.acquire()
+                await self.admission.acquire(qos=qos_cls)
                 admitted = True
                 wait = time.monotonic() - t_adm
-                SERVING.queue_wait.observe(value=wait)
+                SERVING.queue_wait.observe(qos_cls, value=wait)
                 TRACER.record_span("admission.wait",
                                    root.context() if root else None, wait)
             except AdmissionShed as e:
@@ -324,12 +363,15 @@ class HttpService:
                     prefetch_task.cancel()
                 self._requests.inc(model, endpoint, request_type, "shed")
                 TRACER.end_span(root, status="shed", error=True)
+                # class-aware Retry-After: scaled by the shedder's own
+                # class queue depth (AdmissionState.retry_after), a
+                # constant in legacy mode
                 raise HttpError(
                     429, "server overloaded, retry later",
                     headers={"retry-after": str(e.retry_after_s)})
         if prefetch_done is not None:
             prefetch_done.set()   # window over: later completion = late
-        ctx = Context()
+        ctx = Context(baggage={QOS_KEY: qos_cls})
         if root is not None:
             ctx.trace = root.context()
             ctx.baggage[TRACE_KEY] = ctx.trace.to_wire()
@@ -347,7 +389,7 @@ class HttpService:
                 return
             finished = True
             if admitted:
-                self.admission.release()
+                self.admission.release(qos=qos_cls)
             self._inflight.dec(model)
             self._requests.inc(model, endpoint, request_type, status)
             self._duration.observe(model, value=time.perf_counter() - t0)

@@ -254,12 +254,15 @@ class KvRouter:
         return pool_matched
 
     async def schedule(self, tokens: Sequence[int],
-                       exclude=()) -> str:
+                       exclude=(), qos: str = "") -> str:
         """Pick the best worker for this token sequence; returns worker_id.
         `exclude`: instances currently ejected (circuit breaker open) —
         dropped from scoring unless that would leave no candidates.
         DRAINING instances join the exclusion the same way (planned
-        maintenance takes no new assignments)."""
+        maintenance takes no new assignments). `qos`: the request's
+        QoS class (runtime/qos.py) — its latency weight scales the
+        transfer-aware selector's cost term, steering interactive
+        requests around backlogged links first."""
         t0 = time.monotonic()
         draining = getattr(self.client, "draining_ids", None)
         if draining is not None:
@@ -268,9 +271,14 @@ class KvRouter:
                 exclude = set(exclude) | set(drains)
         overlap = self.find_matches_for_tokens(tokens)
         pool_matched = self._split_pool_scores(overlap)
+        from dynamo_tpu.runtime.qos import DEFAULT_POLICY
+        qos_cls = DEFAULT_POLICY.resolve(qos or None)
         worker_id = self.scheduler.schedule(len(tokens), overlap,
                                             exclude=exclude,
-                                            pool_matched=pool_matched)
+                                            pool_matched=pool_matched,
+                                            qos=qos_cls.name,
+                                            qos_weight=qos_cls
+                                            .latency_weight)
         # serving-path histogram (llm_schedule_seconds): observed HERE,
         # at the real scheduling decision, so the frontend's kv-routed
         # path and a bare router (cluster_sim) account identically; the

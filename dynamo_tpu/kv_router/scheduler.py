@@ -65,6 +65,13 @@ class SchedulingRequest:
     # as misses — the fetch itself is priced with the same
     # TransferCostModel.estimate as a disagg transfer (docs/PERF.md §3e)
     pool_matched: int = 0
+    # multi-tenant QoS (runtime/qos.py): the request's class name and
+    # its latency weight — transfer-aware selectors SCALE the
+    # transfer/backlog cost term by it, so latency-sensitive classes
+    # avoid backlogged links first while batch tolerates them (1.0 =
+    # class-neutral, the pre-QoS behavior)
+    qos: str = ""
+    qos_weight: float = 1.0
 
 
 @dataclasses.dataclass
@@ -220,10 +227,19 @@ class TransferAwareSelector(DefaultWorkerSelector):
                                         nbytes_move + nbytes_fetch)
             any_cold |= cold
             norm_cost = min(self.max_penalty, cost_s / self.horizon_s)
+            # class-weighted cost (runtime/qos.py): an interactive
+            # request (latency_weight > 1) pays the transfer/backlog
+            # penalty harder and routes AROUND congested links first;
+            # batch (< 1) tolerates them and soaks up the cheap slots.
+            # qos_weight defaults to 1.0 — unclassed traffic scores
+            # exactly as before.
             logit = (self.overlap_weight * overlap_score
                      - kv_usage - norm_active
-                     - self.transfer_weight * norm_cost)
+                     - self.transfer_weight * request.qos_weight
+                     * norm_cost)
             components[worker_id] = {
+                "qos": request.qos,
+                "qos_weight": request.qos_weight,
                 "overlap": round(overlap_score, 4),
                 "kv_usage": round(kv_usage, 4),
                 "active": round(norm_active, 4),
@@ -293,14 +309,18 @@ class KvScheduler:
         self.endpoints.workers.pop(worker_id, None)
 
     def schedule(self, isl_tokens: int, overlap: MatchResult,
-                 exclude=(), pool_matched: int = 0) -> str:
+                 exclude=(), pool_matched: int = 0,
+                 qos: str = "", qos_weight: float = 1.0) -> str:
         """Pick a worker; `exclude` drops workers from consideration (the
         reliability layer's circuit breaker ejects flapping instances this
         way). If exclusion would empty the candidate set, the full set is
         used — a probe somewhere beats failing the request outright.
         `pool_matched`: leading query blocks fetchable from the shared KV
         pool (live sources only — KvRouter derives it from the pool:
-        index scores); pool-aware selectors fold it into scoring."""
+        index scores); pool-aware selectors fold it into scoring.
+        `qos`/`qos_weight`: the request's QoS class + latency weight
+        (runtime/qos.py) — class-aware selectors scale the transfer
+        cost term by it."""
         endpoints = self.endpoints
         if exclude:
             kept = {w: m for w, m in endpoints.workers.items()
@@ -311,7 +331,8 @@ class KvScheduler:
                 endpoints = ProcessedEndpoints(workers=kept)
         sel = self.selector.select_worker(
             endpoints, SchedulingRequest(isl_tokens, overlap,
-                                         pool_matched=pool_matched),
+                                         pool_matched=pool_matched,
+                                         qos=qos, qos_weight=qos_weight),
             self.block_size)
         m = self.endpoints.workers.get(sel.worker_id)
         if m is not None:

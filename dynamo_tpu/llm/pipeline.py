@@ -191,6 +191,11 @@ class Pipeline:
         # between successive token frames, both per choice stream at the
         # frame (commit) boundary — the same boundary bench.py measures
         model_label = pre.model or self.card.name
+        # per-class partition (runtime/qos.py): the class rides the
+        # request baggage; unclassed requests label as the policy
+        # default so the per-class histograms cover every request
+        from dynamo_tpu.runtime.qos import qos_label
+        qos = qos_label(context.baggage)
         t_start = time.monotonic()
         last_emit: dict = {}
         posts = [BackendPostprocessor(tokenizer, pre.stop.stop or ())
@@ -227,10 +232,11 @@ class Pipeline:
                     now = time.monotonic()
                     prev = last_emit.get(i)
                     if prev is None:
-                        SERVING.ttft.observe(model_label,
+                        SERVING.ttft.observe(model_label, qos,
                                              value=now - t_start)
                     else:
-                        SERVING.itl.observe(model_label, value=now - prev)
+                        SERVING.itl.observe(model_label, qos,
+                                            value=now - prev)
                     last_emit[i] = now
                 res = posts[i].process(frame)
                 lp_obj = shapers[i].push(frame, posts[i].last_pieces,

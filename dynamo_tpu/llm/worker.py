@@ -32,7 +32,8 @@ log = logging.getLogger("dynamo_tpu.worker")
 _PROFILE_OWNER = None
 
 
-def _to_engine_request(pre: PreprocessedRequest) -> EngineRequest:
+def _to_engine_request(pre: PreprocessedRequest,
+                       qos: str = "") -> EngineRequest:
     s, st, out = pre.sampling, pre.stop, pre.output
     # resume-from-prefix (mid-stream migration): token_ids already carries
     # prompt + committed tokens; the whole sequence re-prefills and decode
@@ -61,6 +62,7 @@ def _to_engine_request(pre: PreprocessedRequest) -> EngineRequest:
         prompt=list(pre.token_ids),
         mm_pixels=mm_pixels,
         mm_spans=mm_spans,
+        qos=qos,
         params=SamplingParams(
             max_tokens=max(1, (st.max_tokens or 16) - resume),
             temperature=s.temperature if s.temperature is not None else 0.0,
@@ -338,7 +340,13 @@ class NativeEngineWorker(AsyncEngine):
             return
         q = self._register(pre.request_id)
         try:
-            self._pending_adds.append(_to_engine_request(pre))
+            # QoS class rides Context.baggage across the wire (the
+            # trace-context pattern, runtime/qos.py): the engine
+            # scheduler orders its waiting queue and selects preemption
+            # victims by it
+            from dynamo_tpu.runtime.qos import qos_of
+            self._pending_adds.append(
+                _to_engine_request(pre, qos=qos_of(context.baggage)))
             self._wake.set()
             async for frame in self._stream(pre.request_id, context, q):
                 yield frame

@@ -357,6 +357,21 @@ class FleetRollup:
             qv = hist.quantile_all(q)
             if qv == qv:  # not NaN: at least one observation exists
                 rec(name, qv, ts)
+        # per-QoS-class serving series (ISSUE 14): the same quantiles
+        # partitioned by the histograms' qos label — the series the
+        # per-class SloSpecs (observability/slo.qos_slo_specs) evaluate,
+        # so the watchdog pages per tenant class, and fleet_top's
+        # per-class columns render
+        for name, hist, q in (("ttft_p95", SERVING.ttft, 0.95),
+                              ("itl_p99", SERVING.itl, 0.99)):
+            for cls in hist.label_values("qos"):
+                qv = hist.quantile_label(q, "qos", cls)
+                if qv == qv:
+                    rec(f"qos/{cls}/{name}", qv, ts)
+        for cls in SERVING.queue_wait.label_values("qos"):
+            qv = SERVING.queue_wait.quantile_label(0.95, "qos", cls)
+            if qv == qv:
+                rec(f"qos/{cls}/queue_wait_p95", qv, ts)
         # control-plane health + event-plane lag (degraded-mode context
         # the SLO watchdog reads)
         rec("cp/event_lag_seconds", float(CP_STATS.event_lag_seconds), ts)
@@ -418,6 +433,10 @@ class FleetRollup:
         for name in st.names("role/"):
             _, role, field = name.split("/", 2)
             roles.setdefault(role, {})[field] = agg(name)
+        qos: Dict[str, dict] = {}
+        for name in st.names("qos/"):
+            _, cls, field = name.split("/", 2)
+            qos.setdefault(cls, {})[field] = agg(name)
         return {
             "ts": round(ts, 3),
             "scrapes": self.scrapes,
@@ -429,6 +448,7 @@ class FleetRollup:
             "cp": {name.split("/", 1)[1]: agg(name)
                    for name in st.names("cp/")},
             "roles": roles,
+            "qos": qos,
             "links": self.model.snapshot(),
         }
 

@@ -238,6 +238,30 @@ class Histogram(_Metric):
             total = sum(self._totals.values())
         return _bucket_quantile(self.buckets, agg, total, q)
 
+    def label_values(self, label_name: str) -> List[str]:
+        """Distinct observed values of one label dimension (e.g. the
+        QoS classes llm_ttft_seconds has series for)."""
+        i = self.label_names.index(label_name)
+        with self._lock:
+            return sorted({key[i] for key in self._counts})
+
+    def quantile_label(self, q: float, label_name: str,
+                       label_value: str) -> float:
+        """quantile() over the sum of every series matching ONE label
+        value (the per-QoS-class view of a {model, qos} histogram —
+        what the fleet rollup's qos/{class}/... series record)."""
+        i = self.label_names.index(label_name)
+        with self._lock:
+            agg = [0] * len(self.buckets)
+            total = 0
+            for key, counts in self._counts.items():
+                if key[i] != label_value:
+                    continue
+                for j, c in enumerate(counts):
+                    agg[j] += c
+                total += self._totals.get(key, 0)
+        return _bucket_quantile(self.buckets, agg, total, q)
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} {self.kind}"]

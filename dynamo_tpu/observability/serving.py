@@ -42,12 +42,16 @@ TRANSFER_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
 class ServingMetrics:
     """The five serving-path histograms on one registry.
 
-    - llm_ttft_seconds{model}: request start -> first token frame
-      (llm/pipeline._drive_n, per choice stream).
-    - llm_itl_seconds{model}: gap between successive token-carrying
+    - llm_ttft_seconds{model, qos}: request start -> first token frame
+      (llm/pipeline._drive_n, per choice stream), partitioned by the
+      request's QoS class (runtime/qos.py; unclassed requests label as
+      the policy default) — the per-tenant-class series the fleet
+      rollup's `qos/{class}/...` series and the per-class SloSpecs
+      evaluate, so the watchdog pages per tenant class.
+    - llm_itl_seconds{model, qos}: gap between successive token-carrying
       frames of one choice stream (commit-boundary ITL, the same
       boundary bench.py's churn phase measures).
-    - llm_queue_wait_seconds: admission-gate wait at the frontend
+    - llm_queue_wait_seconds{qos}: admission-gate wait at the frontend
       (AdmissionControl.acquire) — shed requests never observe.
     - llm_schedule_seconds: one KvRouter.schedule decision (or the
       reliability layer's fallback pick when no router is wired).
@@ -59,15 +63,15 @@ class ServingMetrics:
         self.registry = registry or MetricsRegistry()
         r = self.registry
         self.ttft = r.histogram(
-            "llm_ttft_seconds", "time to first token frame", ("model",),
-            buckets=TTFT_BUCKETS)
+            "llm_ttft_seconds", "time to first token frame",
+            ("model", "qos"), buckets=TTFT_BUCKETS)
         self.itl = r.histogram(
             "llm_itl_seconds",
-            "inter-token latency at the frame boundary", ("model",),
-            buckets=ITL_BUCKETS)
+            "inter-token latency at the frame boundary",
+            ("model", "qos"), buckets=ITL_BUCKETS)
         self.queue_wait = r.histogram(
             "llm_queue_wait_seconds",
-            "admission-gate wait before the request runs",
+            "admission-gate wait before the request runs", ("qos",),
             buckets=QUEUE_BUCKETS)
         self.schedule = r.histogram(
             "llm_schedule_seconds", "worker-selection decision time",

@@ -244,6 +244,39 @@ def wire_event_plane(watchdog: SloWatchdog, messaging, subject: str):
     return prev
 
 
+def qos_slo_specs(policy=None, short_window_s: float = 30.0,
+                  long_window_s: float = 300.0,
+                  burn_threshold: float = 2.0,
+                  min_samples: int = 3) -> List[SloSpec]:
+    """Per-tenant-class SloSpecs from a QosPolicy (runtime/qos.py):
+    one TTFT-p95 and one ITL-p99 spec per class, objectives taken from
+    the class targets, evaluating the rollup's `qos/{class}/...`
+    series (FleetRollup.scrape_once records them from the per-class
+    serving histograms). All specs are degraded-exempt — the router's
+    sanctioned stale-snapshot mode wobbles serving quality by design
+    and must not page a tenant class (the PR-10 watchdog contract).
+    This closes the PR-12 follow-on: the watchdog and the autoscaler's
+    burn signals can now page and act PER CLASS."""
+    from dynamo_tpu.runtime.qos import DEFAULT_POLICY
+    policy = policy or DEFAULT_POLICY
+    specs: List[SloSpec] = []
+    for name in policy.names():
+        c = policy.classes[name]
+        specs.append(SloSpec(
+            name=f"ttft_p95/{name}", series=f"qos/{name}/ttft_p95",
+            objective=c.ttft_target_s, mode="above", target=0.9,
+            short_window_s=short_window_s, long_window_s=long_window_s,
+            burn_threshold=burn_threshold, min_samples=min_samples,
+            degraded_exempt=True))
+        specs.append(SloSpec(
+            name=f"itl_p99/{name}", series=f"qos/{name}/itl_p99",
+            objective=c.itl_target_s, mode="above", target=0.9,
+            short_window_s=short_window_s, long_window_s=long_window_s,
+            burn_threshold=burn_threshold, min_samples=min_samples,
+            degraded_exempt=True))
+    return specs
+
+
 def seeded_storm_plan(seed: int, n_intervals: int = 120,
                       interval_s: float = 1.0,
                       storm_start: int = 40, storm_len: int = 40,

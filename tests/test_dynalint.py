@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R18), the
+- one positive AND one negative fixture per AST rule (R1-R19), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -1239,6 +1239,85 @@ def test_r18_live_on_pool_call_sites():
             found = lint_source(f.read(), rel)
         assert not [x for x in found if x.rule == "R18"], \
             (rel, [x.message for x in found if x.rule == "R18"])
+
+
+# -- R19: starvation-bound contract --------------------------------------------
+
+R19_BAD = """
+    def make_room(scheduler, arrival):
+        # preempts and class-orders with no visible bound: the high
+        # class wins every contest here
+        victim = select_victim(scheduler.running, below_prio=9)
+        scheduler._preempt_one()
+        return victim
+
+
+    async def pump(queue):
+        while True:
+            item = await queue.dequeue_leased(timeout=1.0)
+            if item:
+                return item
+"""
+
+
+def test_r19_flags_unreferenced_preempt_and_dequeue():
+    found = lint_source(textwrap.dedent(R19_BAD),
+                        "dynamo_tpu/engine/fixture.py")
+    r19 = [x for x in found if x.rule == "R19"]
+    assert len(r19) == 3            # select_victim + _preempt_one + dequeue
+    found = lint_source(textwrap.dedent(R19_BAD), "tools/fixture.py")
+    assert "R19" in rules(found)
+
+
+def test_r19_quiet_outside_scope_and_in_tests():
+    found = lint_source(textwrap.dedent(R19_BAD), "examples/fixture.py")
+    assert "R19" not in rules(found)
+    found = lint_source(textwrap.dedent(R19_BAD),
+                        "tests/fixture.py")
+    assert "R19" not in rules(found)
+
+
+def test_r19_quiet_on_referenced_and_annotated_sites():
+    handled = """
+        def make_room(scheduler, arrival):
+            # victim starvation bounded by the class-band requeue +
+            # queue aging limit (QosPolicy.aging_limit)
+            victim = select_victim(scheduler.running, below_prio=9)
+            scheduler._preempt_one()
+            return victim
+    """
+    found = lint_source(textwrap.dedent(handled),
+                        "dynamo_tpu/engine/fixture.py")
+    assert "R19" not in rules(found)
+    annotated = """
+        async def pump(queue):
+            while True:
+                # dynalint: starvation-ok=single-class FIFO deployment
+                item = await queue.dequeue_leased(timeout=1.0)
+                if item:
+                    return item
+    """
+    found = lint_source(textwrap.dedent(annotated),
+                        "dynamo_tpu/disagg/fixture.py")
+    assert "R19" not in rules(found)
+
+
+def test_r19_live_on_preemption_call_sites():
+    """Every live preemption / victim-selection / class-ordered-dequeue
+    call site references the aging/no-starvation bound or carries a
+    justified annotation (engine/scheduler.py preempt paths, the
+    disagg PrefillWorker consume loop, the QoS storm driver)."""
+    import glob
+    scoped = glob.glob(os.path.join(REPO, "dynamo_tpu", "**", "*.py"),
+                       recursive=True)
+    scoped += glob.glob(os.path.join(REPO, "tools", "*.py"))
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R19"], \
+            (rel, [x.message for x in found if x.rule == "R19"])
 
 
 # -- jaxpr invariants ----------------------------------------------------------

@@ -1,0 +1,602 @@
+"""Multi-tenant QoS (ISSUE 14 / ROADMAP item 5): priority classes,
+weighted-fair scheduling, in-flight preemption.
+
+Layers under test:
+- runtime/qos.py units: StridePicker weighted ratios + bounded-aging
+  no-starvation, TokenBucket, AdmissionState (weighted-fair admission,
+  batch-first displacement, class-scaled Retry-After), select_victim.
+- frontend/reliability.AdmissionControl: class-aware async wrapper
+  (weighted-fair grants, displacement sheds, legacy path unchanged).
+- engine/scheduler.py: class-ordered waiting queue with the aging
+  bound, policy-driven victim selection, cross-class preemption
+  charged against (and bounded by) the preemptor's class budget.
+- engine preempt-resume EXACTNESS: a decode preempted at an arbitrary
+  step and resumed is token-identical (greedy + seeded-sampled) on the
+  aggregated AND the disagg (remote-prefilled) paths, with the epoch
+  bump pinning that the stale device carry can never be decoded from.
+- disagg/queue.PrefillQueue: class sub-queues, weighted-deficit
+  dequeue, lease/ack routing, depth.
+- per-class serving histograms -> rollup qos/* series -> qos_slo_specs.
+- the committed QOS_r14.json storm replays bit-identically.
+"""
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import (
+    EngineRequest, SamplingParams, Scheduler,
+)
+from dynamo_tpu.runtime.qos import (
+    QOS_STATS, AdmissionState, QosClass, QosPolicy, StridePicker,
+    TokenBucket, qos_label, qos_of, select_victim,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+PAGE = 8
+
+
+def make_engine(num_pages=64, **kw):
+    # the test_disagg geometry (same compiled program shapes; num_pages
+    # only sizes the allocator) so the jit cache carries across files
+    return NativeEngine(CFG, EngineConfig(
+        page_size=PAGE, num_pages=num_pages, max_slots=4,
+        max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+        max_model_len=512, **kw), seed=0)
+
+
+@pytest.fixture(autouse=True)
+def clean_qos_stats():
+    QOS_STATS.reset()
+    yield
+    QOS_STATS.reset()
+
+
+# -- weighted-fair picker ------------------------------------------------------
+
+def test_stride_picker_service_ratios_match_weights():
+    pk = StridePicker(QosPolicy())
+    classes = ["interactive", "standard", "batch"]
+    for _ in range(120):
+        pk.charge(pk.order(classes)[0], classes)
+    # 8 : 3 : 1 exactly at 120 rounds
+    assert pk.served == {"interactive": 80, "standard": 30, "batch": 10}
+    assert pk.aging_promotions == 0   # stride alone bounds the skew here
+
+
+def test_stride_picker_bounded_aging_promotes_starved_class():
+    policy = QosPolicy((
+        QosClass("hi", priority=1, weight=1000.0),
+        QosClass("lo", priority=0, weight=1.0),
+    ), default="hi", aging_limit=5)
+    pk = StridePicker(policy)
+    served_lo_at = []
+    for i in range(40):
+        cls = pk.order(["hi", "lo"])[0]
+        pk.charge(cls, ["hi", "lo"])
+        if cls == "lo":
+            served_lo_at.append(i)
+    # without aging, weight 1000:1 would starve `lo` for ~1000 rounds;
+    # the bound forces service within aging_limit+1 rounds of backlog
+    assert served_lo_at and served_lo_at[0] <= 5
+    assert pk.aging_promotions >= 1
+    # and consecutive lo services stay <= aging_limit+1 apart
+    gaps = [b - a for a, b in zip(served_lo_at, served_lo_at[1:])]
+    assert all(g <= 6 for g in gaps)
+
+
+def test_token_bucket_rate_and_burst():
+    tb = TokenBucket(rate_per_s=2.0, burst=4.0)
+    assert all(tb.take(0.0) for _ in range(4))   # burst
+    assert not tb.take(0.0)                      # empty
+    assert tb.take(1.0)                          # 2 tokens refilled
+    assert tb.take(1.0)
+    assert not tb.take(1.0)
+    assert TokenBucket(0.0, 0.0).take(123.0)     # 0 = unlimited
+
+
+# -- admission state -----------------------------------------------------------
+
+def _policy(aging=16):
+    return QosPolicy(aging_limit=aging)
+
+
+def test_admission_weighted_fair_and_batch_first_displacement():
+    st = AdmissionState(_policy(), max_inflight=2, max_queued=2)
+    assert st.try_admit("interactive", 0.0).kind == "admit"
+    assert st.try_admit("batch", 0.0).kind == "admit"
+    assert st.try_admit("batch", 0.0).kind == "queue"
+    assert st.try_admit("batch", 0.0).kind == "queue"
+    # queue full + higher-priority arrival: the BATCH waiter sheds
+    d = st.try_admit("interactive", 0.0)
+    assert d.kind == "displace" and d.victim_class == "batch"
+    # queue now holds 1 batch + 1 interactive; a batch arrival cannot
+    # displace anything (nothing below it) -> sheds itself
+    assert st.try_admit("batch", 0.0).kind == "shed"
+    # freed slot grants weighted-fair: interactive (weight 8) first
+    st.note_released("interactive")
+    g = st.grant()
+    assert g == "interactive"
+    st.note_granted(g)
+
+
+def test_admission_retry_after_scales_with_class_queue_depth():
+    st = AdmissionState(_policy(), max_inflight=1, max_queued=8,
+                        retry_after_s=2)
+    assert st.try_admit("batch", 0.0).kind == "admit"
+    for _ in range(3):
+        assert st.try_admit("batch", 0.0).kind == "queue"
+    # batch hint scales with BATCH depth; interactive's does not
+    assert st.retry_after("batch") == 2 * (1 + 3)
+    assert st.retry_after("interactive") == 2
+
+
+def test_admission_rate_budget_sheds_over_bucket():
+    policy = QosPolicy((
+        QosClass("interactive", priority=2, weight=8.0),
+        QosClass("standard", priority=1, weight=3.0),
+        QosClass("batch", priority=0, weight=1.0,
+                 rate_per_s=1.0, burst=2.0),
+    ))
+    st = AdmissionState(policy, max_inflight=100, max_queued=10)
+    kinds = [st.try_admit("batch", 0.0).kind for _ in range(4)]
+    assert kinds == ["admit", "admit", "shed", "shed"]   # burst of 2
+    assert st.try_admit("batch", 1.0).kind == "admit"    # refilled
+    assert st.try_admit("interactive", 0.0).kind == "admit"  # unlimited
+
+
+def test_admission_control_async_weighted_fair_and_displacement():
+    from dynamo_tpu.frontend.reliability import (
+        AdmissionControl, AdmissionShed,
+    )
+
+    async def main():
+        adm = AdmissionControl(max_inflight=1, max_queued=2,
+                               queue_timeout_s=5.0, policy=_policy())
+        await adm.acquire(qos="standard")          # holds the slot
+        b = asyncio.create_task(adm.acquire(qos="batch"))      # queued
+        i = asyncio.create_task(adm.acquire(qos="interactive"))
+        await asyncio.sleep(0.01)
+        # queue full; a second interactive displaces the batch waiter
+        i2 = asyncio.create_task(adm.acquire(qos="interactive"))
+        with pytest.raises(AdmissionShed) as exc:
+            await b
+        assert exc.value.qos == "batch"
+        # freed slot grants interactive (weighted-fair)
+        adm.release(qos="standard")
+        await asyncio.wait_for(i, 1.0)
+        adm.release(qos="interactive")
+        await asyncio.wait_for(i2, 1.0)
+        adm.release(qos="interactive")
+
+    asyncio.run(main())
+
+
+def test_admission_control_legacy_path_unchanged():
+    from dynamo_tpu.frontend.reliability import (
+        AdmissionControl, AdmissionShed,
+    )
+
+    async def main():
+        adm = AdmissionControl(max_inflight=1, max_queued=0,
+                               retry_after_s=3)
+        await adm.acquire()
+        with pytest.raises(AdmissionShed) as exc:
+            await adm.acquire()
+        assert exc.value.retry_after_s == 3 and exc.value.qos == ""
+        adm.release()
+        await adm.acquire()     # slot free again
+
+    asyncio.run(main())
+
+
+# -- victim selection + scheduler policy ---------------------------------------
+
+class _Seq:
+    def __init__(self, qos, computed):
+        self.qos = qos
+        self.num_computed = computed
+
+
+def test_select_victim_lowest_class_then_youngest():
+    running = [_Seq("interactive", 2), _Seq("batch", 50),
+               _Seq("batch", 10), None, _Seq("standard", 1)]
+    v = select_victim(running)
+    assert v.qos == "batch" and v.num_computed == 10   # youngest batch
+    # same-class pressure: all one class keeps youngest-first
+    same = [_Seq("standard", 9), _Seq("standard", 3), _Seq("standard", 7)]
+    assert select_victim(same).num_computed == 3
+    # below_prio restricts to strictly lower classes
+    assert select_victim([_Seq("interactive", 1)],
+                         below_prio=2) is None
+
+
+def _sched(num_pages=64):
+    return Scheduler(EngineConfig(
+        page_size=PAGE, num_pages=num_pages, max_slots=2,
+        max_prefill_chunk=16, prefill_buckets=(8, 16),
+        max_model_len=128, decode_steps=4))
+
+
+def test_waiting_queue_class_bypass_with_aging_pin():
+    s = _sched()
+    s.qos_policy = QosPolicy(aging_limit=2)
+    for i in range(3):
+        s.add_request(EngineRequest(
+            f"b{i}", list(range(3, 12)), SamplingParams(max_tokens=2),
+            qos="batch"))
+    # interactive arrivals bypass the batch band (FIFO within class)...
+    s.add_request(EngineRequest("i0", list(range(3, 12)),
+                                SamplingParams(max_tokens=2),
+                                qos="interactive"))
+    s.add_request(EngineRequest("i1", list(range(3, 12)),
+                                SamplingParams(max_tokens=2),
+                                qos="interactive"))
+    assert [x.request_id for x in s.waiting] == \
+        ["i0", "i1", "b0", "b1", "b2"]
+    # ...but every batch seq has now been bypassed aging_limit times:
+    # they PIN, and further interactive arrivals queue BEHIND them —
+    # each batch request is jumped at most aging_limit times, bounded
+    s.add_request(EngineRequest("i2", list(range(3, 12)),
+                                SamplingParams(max_tokens=2),
+                                qos="interactive"))
+    assert [x.request_id for x in s.waiting] == \
+        ["i0", "i1", "b0", "b1", "b2", "i2"]
+    assert all(x.qos_bypassed <= 2 for x in s.waiting)
+    assert QOS_STATS.sched_aging_pins >= 1
+
+
+def test_cross_class_preempt_charged_and_budget_bounded():
+    s = _sched(num_pages=4)   # 32 token slots: genuine page pressure
+    policy = QosPolicy((
+        QosClass("interactive", priority=2, weight=8.0, preempt_budget=1),
+        QosClass("standard", priority=1, weight=3.0),
+        QosClass("batch", priority=0, weight=1.0),
+    ), default="standard")
+    s.qos_policy = policy
+    # two batch requests take both slots and all pages
+    for i in range(2):
+        s.add_request(EngineRequest(
+            f"b{i}", list(range(3, 12)),   # 9 tokens + 5 = 2 pages each
+            SamplingParams(max_tokens=5, ignore_eos=True), qos="batch"))
+    while s.waiting:
+        plan = s.schedule()
+        for r in range(len(plan.seqs)):
+            if plan.seqs[r] is not None:
+                s.commit_prefill_row(plan, r, 7)
+    assert sum(1 for x in s.running if x is not None) == 2
+    # interactive arrival: no free page -> cross-class preemption,
+    # charged against interactive's budget
+    s.add_request(EngineRequest("hi", list(range(3, 12)),
+                                SamplingParams(max_tokens=5,
+                                               ignore_eos=True),
+                                qos="interactive"))
+    plan = s.schedule()
+    assert plan is not None
+    assert s._qos_preempt_debt == {"interactive": 1}
+    assert QOS_STATS.preemptions_total == 1
+    assert QOS_STATS.preempt_by_class == {"interactive": 1}
+    assert QOS_STATS.preempted_by_class == {"batch": 1}
+    # budget (1) exhausted: a second interactive cannot preempt the
+    # remaining batch decode
+    s.add_request(EngineRequest("hi2", list(range(20, 29)),
+                                SamplingParams(max_tokens=5,
+                                               ignore_eos=True),
+                                qos="interactive"))
+    before = QOS_STATS.preemptions_total
+    s._preempt_for(next(x for x in s.waiting
+                        if x.request_id == "hi2"))
+    assert QOS_STATS.preemptions_total == before
+    assert QOS_STATS.preempt_denied_budget >= 1
+    # the victim re-queued at the head of its class band
+    victims = [x.request_id for x in s.waiting if x.qos == "batch"]
+    assert victims and victims[0].startswith("b")
+
+
+# -- preempt-resume exactness (aggregated) -------------------------------------
+
+def _run_to_completion(eng, want):
+    toks = {rid: [] for rid in want}
+    while eng.has_work():
+        for ev in eng.step():
+            if ev.request_id in toks and ev.token is not None:
+                toks[ev.request_id].append(ev.token)
+    return toks
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.9])
+def test_preempt_resume_token_identical_aggregated(temperature):
+    """A batch decode preempted at an arbitrary step by an interactive
+    arrival resumes TOKEN-IDENTICALLY (greedy + seeded-sampled), the
+    epoch bump guaranteeing the stale device carry is never decoded
+    from; the preemption is charged to the interactive class budget."""
+    prompt_b = list(range(3, 33))            # 30 tokens
+    prompt_i = list(range(40, 60))           # 20 tokens
+    params_b = SamplingParams(max_tokens=10, temperature=temperature,
+                              seed=7, ignore_eos=True)
+    params_i = SamplingParams(max_tokens=6, temperature=temperature,
+                              seed=11, ignore_eos=True)
+    # oracles: each request alone on an identical engine
+    expect_b = make_engine().generate(prompt_b, params_b, "b")
+    expect_i = make_engine().generate(prompt_i, params_i, "i")
+
+    # 5 pages of 8: the batch request's decode-window reservation
+    # (prompt 30 + max 10 -> 5 pages) takes the whole allocator
+    eng = make_engine(num_pages=5)
+    eng.add_request(EngineRequest("b", prompt_b, params_b, qos="batch"))
+    emitted = []
+    while len(emitted) < 3:                  # arbitrary mid-decode step
+        for ev in eng.step():
+            if ev.token is not None:
+                emitted.append(ev.token)
+    seq_b = next(x for x in eng.scheduler.running if x is not None)
+    epoch_before = seq_b.epoch
+    eng.add_request(EngineRequest("i", prompt_i, params_i,
+                                  qos="interactive"))
+    toks = _run_to_completion(eng, ("b", "i"))
+    # the interactive arrival actually preempted the batch decode...
+    assert QOS_STATS.preemptions_total >= 1
+    assert QOS_STATS.preempt_by_class.get("interactive", 0) >= 1
+    # ...bumping the victim's epoch so the engine's device-resident
+    # decode-carry signature (request_id, epoch) can never match the
+    # stale pre-preemption carry
+    assert seq_b.epoch > epoch_before
+    # both streams token-identical to their uninterrupted oracles
+    assert emitted + toks["b"] == expect_b
+    assert toks["i"] == expect_i
+    eng.close()
+
+
+def make_engine1(**kw):
+    """One-slot variant: an interactive arrival can only run by
+    preempting the single running decode (slot pressure, not pages)."""
+    return NativeEngine(CFG, EngineConfig(
+        page_size=PAGE, num_pages=64, max_slots=1,
+        max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+        max_model_len=512, **kw), seed=0)
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.9])
+def test_preempt_resume_token_identical_disagg(temperature):
+    """Same exactness on the DISAGG path: a remotely-prefilled decode
+    (up-front allocation + KV inject + activate) preempted mid-decode
+    resumes token-identically — the committed-prefix recompute path of
+    the decode engine is the resume mechanism."""
+    import jax
+    prompt = list(range(40, 60))
+    params = SamplingParams(max_tokens=24, temperature=temperature,
+                            seed=5, ignore_eos=True)
+    params_i = SamplingParams(max_tokens=4, temperature=temperature,
+                              seed=9, ignore_eos=True)
+    expect = make_engine1().generate(prompt, params, "direct")
+    expect_i = make_engine1().generate(list(range(10, 30)), params_i,
+                                       "i")
+
+    prefill_eng = make_engine()
+    decode_eng = make_engine1()   # single decode slot
+    alloc = decode_eng.allocate_remote(EngineRequest("r", prompt, params,
+                                                     qos="batch"))
+    assert alloc is not None
+    prefill_eng.add_request(EngineRequest("r", prompt, params,
+                                          prefill_only=True))
+    outs = []
+    while prefill_eng.has_work():
+        outs.extend(prefill_eng.step())
+    first = outs[0].token
+    seq = prefill_eng.scheduler.parked["r"]
+    pages = prefill_eng.extract_pages(seq.pages)
+    k = jax.device_put(pages["k"], decode_eng.cache_sharding)
+    v = jax.device_put(pages["v"], decode_eng.cache_sharding)
+    decode_eng.inject_pages(alloc.page_ids, k, v)
+    prefill_eng.release_parked("r")
+    decode_eng.activate_remote("r", first)
+    toks = [first]
+    while len(toks) < 3:                  # mid-decode on the disagg seq
+        for ev in decode_eng.step():
+            if ev.token is not None:
+                toks.append(ev.token)
+    # interactive arrival on the decode engine: pages exhausted by the
+    # remote seq's reservation -> policy preemption -> resume
+    decode_eng.add_request(EngineRequest("i", list(range(10, 30)),
+                                         params_i, qos="interactive"))
+    done = _run_to_completion(decode_eng, ("r", "i"))
+    assert QOS_STATS.preemptions_total >= 1
+    assert toks + done["r"] == expect
+    assert done["i"] == expect_i
+    prefill_eng.close()
+    decode_eng.close()
+
+
+# -- class-aware prefill queue -------------------------------------------------
+
+def test_prefill_queue_class_subqueues_weighted_dequeue_and_ack():
+    from dynamo_tpu.disagg import PrefillQueue, RemotePrefillRequest
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    async def main():
+        plane = MemoryPlane()
+        policy = QosPolicy(aging_limit=4)
+        q = PrefillQueue(plane.messaging, "ns", "m", qos_policy=policy)
+
+        def item(rid, qos):
+            return RemotePrefillRequest(
+                engine_id="e", request_id=rid, token_ids=[1, 2, 3],
+                page_ids=[0], page_size=8, qos=qos)
+
+        # enqueue a batch burst ahead of one interactive
+        for i in range(4):
+            await q.enqueue(item(f"b{i}", "batch"))
+        await q.enqueue(item("i0", "interactive"))
+        assert await q.depth() == 5
+        # weighted-deficit dequeue serves the interactive item FIRST
+        # despite 4 batch items enqueued earlier
+        got, tok = await q.dequeue_leased(timeout=1.0, lease_s=5.0)
+        assert got.request_id == "i0" and got.qos == "interactive"
+        await q.ack(tok)
+        # the batch backlog still drains completely (no starvation)
+        seen = []
+        for _ in range(4):
+            got, tok = await q.dequeue_leased(timeout=1.0, lease_s=5.0)
+            seen.append(got.request_id)
+            await q.ack(tok)
+        assert sorted(seen) == ["b0", "b1", "b2", "b3"]
+        assert await q.depth() == 0
+        # empty queue + timeout -> None (bounded poll)
+        assert await q.dequeue_leased(timeout=0.12) is None
+
+    asyncio.run(main())
+
+
+def test_prefill_queue_without_policy_is_fifo():
+    from dynamo_tpu.disagg import PrefillQueue, RemotePrefillRequest
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    async def main():
+        plane = MemoryPlane()
+        q = PrefillQueue(plane.messaging, "ns", "m")
+        for i in range(3):
+            await q.enqueue(RemotePrefillRequest(
+                engine_id="e", request_id=f"r{i}", token_ids=[1],
+                page_ids=[0], page_size=8,
+                qos="interactive" if i == 2 else "batch"))
+        order = []
+        for _ in range(3):
+            got, tok = await q.dequeue_leased(timeout=1.0)
+            order.append(got.request_id)
+            await q.ack(tok)
+        assert order == ["r0", "r1", "r2"]   # strict FIFO, class ignored
+
+    asyncio.run(main())
+
+
+# -- baggage + labels ----------------------------------------------------------
+
+def test_qos_baggage_helpers_and_router_weighting():
+    from dynamo_tpu.kv_router.indexer import MatchResult
+    from dynamo_tpu.kv_router.scheduler import (
+        SchedulingRequest, TransferAwareSelector,
+    )
+    from dynamo_tpu.kv_router.scoring import (
+        ProcessedEndpoints, WorkerMetrics,
+    )
+    from dynamo_tpu.observability.fleet import TransferCostModel
+
+    assert qos_of({"qos": "batch"}) == "batch"
+    assert qos_of(None) == "" and qos_of({}) == ""
+    assert qos_label({"qos": "interactive"}) == "interactive"
+    assert qos_label({}) == "standard"       # default partition
+    assert qos_label({"qos": "bogus"}) == "standard"
+
+    # class latency weight scales the transfer cost term: the slow
+    # link holds a big resident prefix (overlap win 1.6) but costs
+    # ~2 cost-horizons of transfer — decisive only through the class
+    # weight: batch (x0.5 -> penalty 1.0) keeps the prefix win,
+    # interactive (x2.0 -> penalty 4.0) routes to the fast link
+    model = TransferCostModel()
+    model.observe("slow", 2_000_000, 1.0)    # 2 MB/s
+    model.observe("fast", 100_000_000, 0.1)  # 1 GB/s
+    eps = ProcessedEndpoints(workers={
+        "slow": WorkerMetrics(kv_active_blocks=0, kv_total_blocks=100,
+                              request_active_slots=0,
+                              request_total_slots=8),
+        "fast": WorkerMetrics(kv_active_blocks=0, kv_total_blocks=100,
+                              request_active_slots=0,
+                              request_total_slots=8),
+    })
+    sel = TransferAwareSelector(rng=__import__("random").Random(0),
+                                cost_model=model)
+    overlap = MatchResult(scores={"slow": 64})
+    # batch (latency_weight 0.5) tolerates the slow link's transfer
+    # cost for the prefix win; interactive (2.0) pays it double and
+    # routes to the fast link
+    batch = sel.select_worker(
+        eps, SchedulingRequest(640, overlap, qos="batch",
+                               qos_weight=0.5), 8)
+    inter = sel.select_worker(
+        eps, SchedulingRequest(640, overlap, qos="interactive",
+                               qos_weight=2.0), 8)
+    assert batch.worker_id == "slow"
+    assert inter.worker_id == "fast"
+    assert sel.last_pick["qos"] == "interactive"
+
+
+# -- per-class series + SLO specs ---------------------------------------------
+
+def test_per_class_histograms_feed_rollup_series_and_slo_specs():
+    from dynamo_tpu.observability.fleet import FleetRollup
+    from dynamo_tpu.observability.serving import SERVING
+    from dynamo_tpu.observability.slo import SloWatchdog, qos_slo_specs
+    from dynamo_tpu.observability.timeseries import SeriesStore
+
+    SERVING.reset()
+    try:
+        for _ in range(6):
+            SERVING.ttft.observe("m", "interactive", value=0.02)
+            # past the batch class's 20s TTFT target (and inside the
+            # bucket ladder, so the quantile can express it)
+            SERVING.ttft.observe("m", "batch", value=28.0)
+            SERVING.itl.observe("m", "batch", value=0.01)
+        SERVING.queue_wait.observe("batch", value=0.5)
+
+        class _Client:
+            async def scrape_stats(self):
+                return {}
+
+        store = SeriesStore(interval_s=1.0, capacity=64)
+        rollup = FleetRollup(_Client(), store=store, interval_s=1.0)
+        for t in (100.0, 101.0, 102.0):
+            asyncio.run(rollup.scrape_once(ts=t))
+        assert store.get("qos/interactive/ttft_p95").latest() < 0.1
+        assert store.get("qos/batch/ttft_p95").latest() > 1.0
+        assert store.get("qos/batch/itl_p99") is not None
+        assert store.get("qos/batch/queue_wait_p95") is not None
+        assert "batch" in rollup.summary(ts=102.0)["qos"]
+
+        # per-class specs evaluate those series; batch (4s TTFT vs a
+        # 0.5s-target interactive spec untouched) fires its own alert
+        specs = qos_slo_specs(short_window_s=2.0, long_window_s=3.0,
+                              min_samples=2)
+        names = {s.name for s in specs}
+        assert {"ttft_p95/interactive", "ttft_p95/batch",
+                "itl_p99/batch"} <= names
+        assert all(s.degraded_exempt for s in specs)
+        wd = SloWatchdog(store, specs, degraded_fn=lambda: False)
+        events = wd.evaluate(102.0)
+        fired = {e["slo"] for e in events if e["event"] == "fire"}
+        assert "ttft_p95/batch" in fired
+        assert "ttft_p95/interactive" not in fired
+    finally:
+        SERVING.reset()
+
+
+# -- storm replay --------------------------------------------------------------
+
+def test_qos_storm_replay_matches_committed_artifact():
+    """The committed QOS_r14.json evidence replays bit-identically:
+    the same TenantShape through the real QoS machinery yields the
+    exact decision/victim timeline and per-class outcomes."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from fleet_storm import TenantShape, qos_storm_once
+    path = os.path.join(REPO, "QOS_r14.json")
+    if not os.path.exists(path):
+        pytest.skip("QOS_r14.json not committed")
+    with open(path) as f:
+        plan = json.load(f)
+    assert plan["ok"] is True
+    shape = TenantShape.from_dict(plan["shape"])
+    replay = qos_storm_once(shape, True, ticks=plan["ticks"])
+    committed = plan["qos"]
+    assert replay["timeline"] == committed["timeline"]
+    assert replay["per_class"] == committed["per_class"]
+    assert replay["aging_promotions"] == committed["aging_promotions"]
+    # the committed contracts hold as stated
+    assert plan["contracts"]["interactive_p99_held"]
+    assert plan["contracts"]["batch_not_starved"]
+    assert plan["contracts"]["zero_dropped_streams"]
+    assert plan["contracts"]["per_class_slo_fired_and_cleared"]

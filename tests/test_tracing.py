@@ -426,12 +426,16 @@ def test_frontend_metrics_serve_ttft_and_itl_histograms():
     assert mstatus == 200
     n_tokens = usage["completion_tokens"]
     assert n_tokens > 1
-    # exactly one first-token observation, one ITL per later frame
-    assert 'llm_ttft_seconds_count{model="echo-model"} 1' in text
-    assert ('llm_itl_seconds_count{model="echo-model"} '
+    # exactly one first-token observation, one ITL per later frame —
+    # series carry the request's QoS class label (runtime/qos.py;
+    # unclassed requests label as the policy default "standard")
+    assert ('llm_ttft_seconds_count{model="echo-model",qos="standard"} 1'
+            in text)
+    assert ('llm_itl_seconds_count{model="echo-model",qos="standard"} '
             f"{n_tokens - 1}") in text
-    assert 'llm_ttft_seconds_bucket{model="echo-model",le="+Inf"} 1' in text
-    assert "llm_queue_wait_seconds_count 1" in text
+    assert ('llm_ttft_seconds_bucket{model="echo-model",qos="standard",'
+            'le="+Inf"} 1') in text
+    assert 'llm_queue_wait_seconds_count{qos="standard"} 1' in text
     assert "# TYPE llm_ttft_seconds histogram" in text
     assert "# TYPE llm_schedule_seconds histogram" in text
 
@@ -440,7 +444,7 @@ def test_exporter_folds_serving_histograms():
     """The standalone exporter's /metrics appends the same serving
     histograms (render-time fold)."""
     SERVING.reset()
-    SERVING.ttft.observe("m", value=0.02)
+    SERVING.ttft.observe("m", "standard", value=0.02)
     SERVING.kv_transfer.observe(value=0.003)
     from dynamo_tpu.observability.exporter import MetricsExporter
     from dynamo_tpu.runtime.distributed import DistributedRuntime
@@ -460,7 +464,7 @@ def test_exporter_folds_serving_histograms():
 
     status, text = run(main())
     assert status == 200
-    assert 'llm_ttft_seconds_count{model="m"} 1' in text
+    assert 'llm_ttft_seconds_count{model="m",qos="standard"} 1' in text
     assert "llm_kv_transfer_seconds_count 1" in text
 
 

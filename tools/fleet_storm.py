@@ -1,10 +1,26 @@
 #!/usr/bin/env python
-"""fleet_storm: telemetry + autoscaler evidence runs.
+"""fleet_storm: telemetry + autoscaler + multi-tenant QoS evidence runs.
 
-Two evidence modes:
+Three evidence modes:
 
 `--mode slo` — the resource-telemetry chain (FLEET_r10.json, ISSUE 10):
 engine ledger + 64-worker rollup + SLO fire->clear storm.
+
+`--mode qos` — the multi-tenant QoS chain (QOS_r14.json, ISSUE 14 /
+ROADMAP item 5): a seeded BATCH flash crowd arrives under steady
+interactive load, driven through the REAL QoS machinery on a virtual
+clock — `AdmissionState` (weighted-fair admission, token buckets,
+batch-first displacement, class-scaled Retry-After), `StridePicker`
+(weighted-deficit queue service with bounded aging), and
+`select_victim` (cross-class decode preemption charged against the
+preemptor's class budget) — twice (replay) plus a FIFO baseline over
+the identical arrival stream. Per-class TTFT series feed the real
+`SloWatchdog` with `qos_slo_specs`. Contracts (exit 1 on violation):
+interactive p99 TTFT within bound while FIFO's blows through it
+(class isolation), batch not starved (aging promotions > 0, every
+admitted batch request completes), zero dropped streams across every
+preemption, at least one per-class SLO fires AND clears, and the
+decision/victim timeline replays bit-identically.
 
 `--mode autoscale` (default) — the closed-loop autoscaler chain
 (AUTOSCALE_r12.json, ISSUE 12 / ROADMAP item 4): a seeded diurnal +
@@ -372,12 +388,379 @@ async def run_autoscale_storm(args) -> dict:
     }
 
 
+@dataclasses.dataclass(frozen=True)
+class TenantShape:
+    """Seeded multi-tenant arrival driver: steady interactive +
+    standard load, a BATCH flash crowd in [crowd_start, crowd_start +
+    crowd_len). `arrivals(cls, tick)` is a pure function of (shape,
+    class, tick) — per-tick seeded draws, no stateful rng — so the
+    QOS_r14 bit-identical-replay contract holds regardless of what
+    else consumes randomness."""
+
+    seed: int = 14
+    interactive_rate: float = 3.0
+    standard_rate: float = 1.2
+    batch_rate: float = 0.6
+    crowd_start: int = 30
+    crowd_len: int = 40
+    crowd_mult: float = 14.0
+
+    def rate(self, cls: str, tick: int) -> float:
+        r = {"interactive": self.interactive_rate,
+             "standard": self.standard_rate,
+             "batch": self.batch_rate}[cls]
+        if cls == "batch" and \
+                self.crowd_start <= tick < self.crowd_start + self.crowd_len:
+            r *= self.crowd_mult
+        return r
+
+    def arrivals(self, cls: str, tick: int) -> int:
+        r = self.rate(cls, tick)
+        n = int(r)
+        # zlib.crc32, not hash(): str hashing is per-process randomized
+        # and would break the cross-process bit-identical replay
+        import zlib
+        rng = random.Random((self.seed * 1000003 + tick) * 131
+                            + zlib.crc32(cls.encode()) % 9973)
+        return n + (1 if rng.random() < r - n else 0)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantShape":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+def qos_storm_once(shape: TenantShape, qos_on: bool, ticks: int = 220,
+                   max_inflight: int = 60, max_queued: int = 20,
+                   prefill_tok_s: float = 2600.0, decode_slots: int = 18,
+                   decode_tok_s: float = 30.0,
+                   aging_limit: int = 8, drain_ticks: int = 30) -> dict:
+    """One virtual-clock multi-tenant storm through the REAL QoS
+    machinery (runtime/qos.py): AdmissionState at the door, a
+    StridePicker ordering prefill service AND decode-slot grants
+    (weighted deficit, bounded aging — the no-starvation guarantee the
+    aging_promotions counters evidence), select_victim for cross-class
+    decode preemption charged against the preemptor's class budget.
+    qos_on=False collapses the policy to one class — the FIFO baseline
+    over the IDENTICAL seeded arrival stream.
+
+    Virtual service model (pure): prefill drains admitted requests at
+    `prefill_tok_s` in picker order; a completed prefill wants a
+    decode slot — TTFT = slot-acquisition tick + 1 - arrival — and a
+    blocked high-class request preempts the lowest-class decode
+    (progress retained: the victim resumes from its committed tokens,
+    never restarts, never drops). Per-class TTFT p95 series feed the
+    real SloWatchdog via qos_slo_specs.
+    """
+    from dynamo_tpu.observability.slo import SloWatchdog, qos_slo_specs
+    from dynamo_tpu.observability.timeseries import SeriesStore
+    from dynamo_tpu.runtime.qos import (
+        AdmissionState, QosClass, QosPolicy, StridePicker, select_victim,
+    )
+    if qos_on:
+        policy = QosPolicy((
+            QosClass("interactive", priority=2, weight=8.0,
+                     ttft_target_s=3.0, itl_target_s=1.0,
+                     preempt_budget=4, latency_weight=2.0),
+            QosClass("standard", priority=1, weight=3.0,
+                     ttft_target_s=8.0, itl_target_s=1.0,
+                     preempt_budget=1),
+            QosClass("batch", priority=0, weight=1.0,
+                     ttft_target_s=12.0, itl_target_s=2.0,
+                     # rate budget: the flash crowd overruns the batch
+                     # token bucket and sheds batch-first at the door;
+                     # sized so the admitted batch backlog drains (and
+                     # its TTFT SLO clears) well inside the run
+                     rate_per_s=2.5, burst=6.0),
+        ), default="standard", aging_limit=aging_limit)
+    else:
+        policy = QosPolicy((QosClass("standard", priority=1,
+                                     weight=1.0, ttft_target_s=8.0),),
+                           default="standard", aging_limit=aging_limit)
+    classes = ("interactive", "standard", "batch")
+
+    def label(cls: str) -> str:
+        return policy.resolve(cls).name   # FIFO folds all -> standard
+
+    adm = AdmissionState(policy, max_inflight, max_queued)
+    prefill_pick = StridePicker(policy)
+    decode_pick = StridePicker(policy)
+
+    class VStream:
+        __slots__ = ("rid", "cls", "qos", "t_arr", "prefill_left",
+                     "decode_left", "num_computed", "preempted",
+                     "ttft", "done_at")
+
+        def __init__(self, rid, cls, t_arr, prefill, decode):
+            self.rid, self.cls, self.t_arr = rid, cls, t_arr
+            self.qos = label(cls)       # select_victim reads .qos
+            self.prefill_left = prefill
+            self.decode_left = decode
+            self.num_computed = 0
+            self.preempted = 0
+            self.ttft = None
+            self.done_at = None
+
+    store = SeriesStore(interval_s=1.0, capacity=max(600, ticks + 8))
+    wd = SloWatchdog(store, qos_slo_specs(
+        policy, short_window_s=8.0, long_window_s=24.0, min_samples=3),
+        degraded_fn=lambda: False)
+    timeline = []               # the bit-identical-replay contract
+    adm_waiting = {}            # cls -> [VStream] (admission queue)
+    prefill_q = {}              # cls -> [VStream] (admitted, prefilling)
+    decode_wait = {}            # cls -> [VStream] (prefilled, want slot)
+    running = [None] * decode_slots
+    preempt_debt = {}
+    stats = {c: {"arrived": 0, "admitted": 0, "shed": 0, "done": 0,
+                 "preempted": 0, "ttfts": []} for c in classes}
+    dropped = 0
+    rid_seq = 0
+    ttft_window = {c: [] for c in classes}
+
+    def shed(s, cls_name):
+        stats[s.cls]["shed"] += 1
+        timeline.append([tick, "shed", s.rid, label(s.cls)])
+
+    def enter_prefill(s):
+        prefill_q.setdefault(label(s.cls), []).append(s)
+        stats[s.cls]["admitted"] += 1
+
+    def take_slot(s, slot):
+        running[slot] = s
+        if s.ttft is None:
+            s.ttft = tick + 1.0 - s.t_arr
+            stats[s.cls]["ttfts"].append(s.ttft)
+            w = ttft_window[s.cls]
+            w.append(s.ttft)
+            del w[:-10]    # sliding p95 window: short enough that
+            #                post-crowd recovery shows within the run
+        if s.preempted and preempt_debt.get(s.preempted, 0):
+            # victim resumed: repay the preemptor class's debt (the
+            # budget bounds OUTSTANDING displacements)
+            n = preempt_debt[s.preempted]
+            if n > 1:
+                preempt_debt[s.preempted] = n - 1
+            else:
+                preempt_debt.pop(s.preempted, None)
+            s.preempted = 0
+
+    for tick in range(ticks):
+        ts = float(tick)
+        # 1. arrivals -> admission (real AdmissionState); the last
+        # drain_ticks take no arrivals so the completion contracts
+        # (batch done == admitted, zero drops) evaluate a drained system
+        for cls in classes if tick < ticks - drain_ticks else ():
+            for _ in range(shape.arrivals(cls, tick)):
+                rid_seq += 1
+                rng = random.Random(shape.seed * 7919 + rid_seq)
+                s = VStream(rid_seq, cls, ts,
+                            rng.randint(150, 500), rng.randint(40, 140))
+                stats[cls]["arrived"] += 1
+                d = adm.try_admit(label(cls), now=ts)
+                if d.kind == "admit":
+                    enter_prefill(s)
+                elif d.kind == "shed":
+                    shed(s, label(cls))
+                else:
+                    if d.kind == "displace":
+                        vic_q = adm_waiting.get(d.victim_class, [])
+                        if vic_q:
+                            vic = vic_q.pop()       # newest sheds first
+                            shed(vic, d.victim_class)
+                            timeline.append([tick, "displace",
+                                             d.victim_class])
+                    adm_waiting.setdefault(label(s.cls), []).append(s)
+        # 2. prefill service: weighted-deficit class order (bounded
+        # aging: a backlogged batch class skipped aging_limit rounds is
+        # served next — no starvation, the R19 bound)
+        capacity = prefill_tok_s
+        while capacity > 0:
+            backlog = [c for c, q in prefill_q.items() if q]
+            order = prefill_pick.order(backlog)
+            if not order:
+                break
+            cls = order[0]
+            before = prefill_pick.aging_promotions
+            prefill_pick.charge(cls, backlog)
+            if prefill_pick.aging_promotions > before:
+                timeline.append([tick, "aging", cls])
+            s = prefill_q[cls][0]
+            take = min(s.prefill_left, capacity)
+            s.prefill_left -= take
+            capacity -= take
+            if s.prefill_left <= 0:
+                prefill_q[cls].pop(0)
+                decode_wait.setdefault(cls, []).append(s)
+        # 3. decode-slot assignment: free slots first (weighted-fair
+        # with aging), then cross-class preemption for still-blocked
+        # high classes (select_victim: lowest class, youngest within;
+        # victim starvation bounded by class-band requeue + aging)
+        while any(x is None for x in running):
+            backlog = [c for c, q in decode_wait.items() if q]
+            order = decode_pick.order(backlog)
+            if not order:
+                break
+            cls = order[0]
+            before = decode_pick.aging_promotions
+            decode_pick.charge(cls, backlog)
+            if decode_pick.aging_promotions > before:
+                timeline.append([tick, "aging", cls])
+            take_slot(decode_wait[cls].pop(0), running.index(None))
+        if qos_on:
+            for cls in sorted((c for c, q in decode_wait.items() if q),
+                              key=lambda c: -policy.priority_of(c)):
+                c_obj = policy.resolve(cls)
+                while decode_wait[cls]:
+                    if c_obj.preempt_budget <= 0 or \
+                            preempt_debt.get(cls, 0) \
+                            >= c_obj.preempt_budget:
+                        break
+                    victim = select_victim(
+                        running, policy,
+                        below_prio=c_obj.priority)
+                    if victim is None:
+                        break
+                    slot = running.index(victim)
+                    running[slot] = None
+                    victim.preempted = cls       # debt owner
+                    preempt_debt[cls] = preempt_debt.get(cls, 0) + 1
+                    stats[victim.cls]["preempted"] += 1
+                    # committed-prefix semantics: progress retained,
+                    # victim rejoins the head of its class band
+                    decode_wait.setdefault(label(victim.cls),
+                                           []).insert(0, victim)
+                    s = decode_wait[cls].pop(0)
+                    take_slot(s, slot)
+                    timeline.append([tick, "preempt", s.rid, victim.rid,
+                                     cls, label(victim.cls)])
+        # 4. decode progress + completion -> admission release/grant
+        for i, s in enumerate(running):
+            if s is None:
+                continue
+            s.decode_left -= decode_tok_s
+            s.num_computed += decode_tok_s
+            if s.decode_left <= 0:
+                running[i] = None
+                s.done_at = ts
+                stats[s.cls]["done"] += 1
+                adm.note_released(label(s.cls))
+                g = adm.grant()
+                if g is not None:
+                    q = adm_waiting.get(g, [])
+                    if q:
+                        adm.note_granted(g)
+                        enter_prefill(q.pop(0))
+                    else:
+                        adm.note_abandoned(g)
+        # 5. per-class series + watchdog
+        for cls in classes:
+            w = ttft_window[cls]
+            if w:
+                store.record(f"qos/{label(cls)}/ttft_p95",
+                             percentile(sorted(w), 0.95), ts)
+        wd.evaluate(ts)
+
+    lat = {c: sorted(stats[c]["ttfts"]) for c in classes}
+    return {
+        "mode": "qos" if qos_on else "fifo",
+        "ticks": ticks,
+        "requests": rid_seq,
+        "per_class": {
+            c: {
+                "arrived": stats[c]["arrived"],
+                "admitted": stats[c]["admitted"],
+                "done": stats[c]["done"],
+                "shed": stats[c]["shed"],
+                "preempted": stats[c]["preempted"],
+                "ttft_p50_s": round(percentile(lat[c], 0.5), 3),
+                "ttft_p99_s": round(percentile(lat[c], 0.99), 3),
+            } for c in classes},
+        "aging_promotions": (prefill_pick.aging_promotions
+                             + decode_pick.aging_promotions),
+        "admission_displaced": adm.displaced,
+        "dropped_streams": dropped,
+        "slo_alerts": list(wd.alerts),
+        "slo_firing_at_end": wd.firing(),
+        "timeline": timeline,
+    }
+
+
+def percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return float(sorted_vals[i])
+
+
+def run_qos_storm(args) -> dict:
+    """The QOS_r14 evidence chain: QoS vs FIFO over the identical
+    seeded multi-tenant burst, plus a bit-identical replay."""
+    shape = TenantShape(seed=args.seed + 4)
+    kw = dict(ticks=args.ticks)
+    qos = qos_storm_once(shape, True, **kw)
+    fifo = qos_storm_once(shape, False, **kw)
+    replay = qos_storm_once(shape, True, **kw)
+
+    pc = qos["per_class"]
+    fired = [ev for ev in qos["slo_alerts"] if ev["event"] == "fire"
+             and ev["slo"].startswith(("ttft_p95/", "itl_p99/"))]
+    cleared = [ev for ev in qos["slo_alerts"] if ev["event"] == "clear"]
+    contracts = {
+        # class isolation: interactive p99 TTFT bound held under the
+        # batch flash crowd, while FIFO over the SAME arrivals burns it
+        "interactive_p99_held":
+            pc["interactive"]["ttft_p99_s"] <= args.interactive_bound_s,
+        "fifo_burns_interactive":
+            fifo["per_class"]["interactive"]["ttft_p99_s"]
+            > 2 * pc["interactive"]["ttft_p99_s"],
+        # no starvation: the bounded-aging guarantee actually engaged,
+        # and every admitted batch request completed
+        "batch_not_starved":
+            qos["aging_promotions"] > 0
+            and pc["batch"]["done"] == pc["batch"]["admitted"],
+        # preemption never drops: victims resume from committed
+        # progress and finish
+        "zero_dropped_streams":
+            qos["dropped_streams"] == 0
+            and sum(c["done"] for c in pc.values())
+            == sum(c["admitted"] for c in pc.values()),
+        "preemptions_happened":
+            sum(c["preempted"] for c in pc.values()) >= 1,
+        # batch sheds first at the door (rate budget + displacement)
+        "batch_sheds_first":
+            pc["batch"]["shed"] > 0
+            and pc["interactive"]["shed"] == 0,
+        # at least one per-class SloSpec fired AND cleared in-storm
+        "per_class_slo_fired_and_cleared":
+            bool(fired) and len(cleared) >= len(fired)
+            and not qos["slo_firing_at_end"],
+        # the whole decision/victim timeline, not a hash
+        "replay_bit_identical": replay["timeline"] == qos["timeline"],
+    }
+    return {
+        "shape": shape.to_dict(),
+        "ticks": args.ticks,
+        "seed": args.seed,
+        "interactive_bound_s": args.interactive_bound_s,
+        "qos": qos,
+        "fifo": fifo,
+        "replay_timeline_len": len(replay["timeline"]),
+        "contracts": contracts,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="fleet_storm", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--mode", choices=("autoscale", "slo"),
+    ap.add_argument("--mode", choices=("autoscale", "slo", "qos"),
                     default="autoscale")
+    ap.add_argument("--interactive-bound-s", type=float, default=3.0,
+                    help="qos mode: interactive p99 TTFT contract bound "
+                         "(virtual seconds)")
     ap.add_argument("--workers", type=int, default=64,
                     help="fleet size for the slo-mode storm")
     ap.add_argument("--seed", type=int, default=10)
@@ -403,6 +786,27 @@ def main(argv=None) -> int:
         args.ticks = min(args.ticks, 240)
 
     t0 = time.time()
+    if args.mode == "qos":
+        if args.ticks > 240:
+            args.ticks = 220        # qos storm is sized for ~220 ticks
+        out = args.out or os.path.join(REPO_ROOT, "QOS_r14.json")
+        report = run_qos_storm(args)
+        report["elapsed_s"] = round(time.time() - t0, 1)
+        report["ok"] = all(report["contracts"].values())
+        print(json.dumps({
+            "contracts": report["contracts"],
+            "qos_per_class": report["qos"]["per_class"],
+            "fifo_interactive_p99":
+                report["fifo"]["per_class"]["interactive"]["ttft_p99_s"],
+            "aging_promotions": report["qos"]["aging_promotions"],
+            "timeline_len": len(report["qos"]["timeline"]),
+            "slo_alerts": report["qos"]["slo_alerts"],
+            "elapsed_s": report["elapsed_s"]}, indent=1))
+        if not args.no_artifact:
+            from tools.artifacts import write_json
+            write_json(out, report)
+            print(f"committed {out}", file=sys.stderr)
+        return 0 if report["ok"] else 1
     if args.mode == "autoscale":
         out = args.out or os.path.join(REPO_ROOT, "AUTOSCALE_r12.json")
         report = asyncio.run(run_autoscale_storm(args))

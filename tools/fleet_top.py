@@ -72,6 +72,17 @@ def render_summary(summary: dict, slo: Optional[dict] = None,
         if agg:
             out.append(f"  serving/{name}: last={_fmt(agg.get('last'), 4)} "
                        f"avg={_fmt(agg.get('avg'), 4)}")
+    qos = summary.get("qos") or {}
+    if qos:
+        # per-tenant-class serving split (rollup `qos/*` series from
+        # the class-labeled histograms; older artifacts omit it)
+        out.append("  qos classes (last):")
+        for cls, fields in sorted(qos.items()):
+            vals = {k: (a or {}).get("last") for k, a in fields.items()}
+            out.append(
+                f"    {cls:<12} ttft_p95={_fmt(vals.get('ttft_p95'), 4)} "
+                f"itl_p99={_fmt(vals.get('itl_p99'), 4)} "
+                f"queue_p95={_fmt(vals.get('queue_wait_p95'), 4)}")
     cp = summary.get("cp") or {}
     if cp:
         vals = {k: (a or {}).get("last") for k, a in cp.items()}
