@@ -82,6 +82,7 @@ PHASE_STALL_S = {
     "ttft": 150.0,
     "churn": 150.0,
     "transfer_overlap": 300.0,   # two extra engine builds (disagg pair)
+    "sharded_transfer": 300.0,   # disagg pair reused, paced transfer legs
     "warm_prefix": 300.0,        # four engine builds sharing one program set
     "parity": 300.0,         # second engine build + single-step compiles
     "spec_ceiling": 600.0,   # spec-twin engine build + verify compile
@@ -402,6 +403,9 @@ def supervise() -> int:
                 wp = best["extras"].get("warm_prefix") or {}
                 if "failure" in wp:
                     wp = {}
+                sh = best["extras"].get("sharded_transfer") or {}
+                if "failure" in sh:
+                    sh = {}
                 ratios = {
                     f"disagg_agg_ttft_ratio_early_{suffix}":
                         to.get("disagg_agg_ttft_ratio_early")
@@ -415,6 +419,13 @@ def supervise() -> int:
                         wp.get("pool_fetch_cold_ttft_ratio"),
                     f"warm_prefix_prefetch_fetch_ttft_ratio_{suffix}":
                         wp.get("prefetch_fetch_ttft_ratio"),
+                    # sharded parallel transfer (ISSUE 15): N-stream /
+                    # 1-stream wall time under per-host-NIC pacing, and
+                    # the disagg TTFT ratio — both gated "lower"
+                    f"sharded_transfer_wall_ratio_{suffix}":
+                        sh.get("paced_wall_ratio"),
+                    f"sharded_disagg_ttft_ratio_{suffix}":
+                        sh.get("disagg_ttft_ratio"),
                 }
                 for metric, value in ratios.items():
                     if value and value > 0:
@@ -923,6 +934,283 @@ def run_transfer_overlap_ab(model_cfg, base_kwargs=None, *, requests=6,
              f"({rab['p99_improvement'] * 100:.1f}% better)")
     except Exception as e:   # the TTFT A/B evidence stands on its own
         result["routing_ab"] = {"failure": f"{type(e).__name__}: {e}"}
+    touch()
+    return result
+
+
+def run_sharded_transfer_ab(model_cfg, base_kwargs=None, *, transfers=5,
+                            requests=4, n_streams=2, wire_s=0.2,
+                            n_chips=1, touch=lambda: None, logf=None):
+    """1-stream vs N-stream KV transfer A/B for
+    extras["sharded_transfer"] (ISSUE 15): the decode side swaps its
+    single KvTransferServer for a ShardedKvTransferGroup (`n_streams`
+    per-host endpoints, one chunk-committed stream per (shard, host))
+    and the same transfers re-run.
+
+    Two legs, one in-process stack (MemoryPlane + real TCP loopback):
+
+    1. transfer WALL time — the same extracted page stack shipped
+       `transfers` times per mode, with each destination-host link
+       paced at a fixed per-NIC bandwidth (sized so one stream's wire
+       time is `wire_s`); N parallel streams ride N host NICs, so the
+       paced ratio measures whether the data plane actually runs the
+       streams CONCURRENTLY end-to-end (a protocol that serialized
+       them anywhere — a shared lock, a shared frontier, ack coupling
+       — would show ~1.0). The RAW loopback ratio is also recorded but
+       NOT gated: one host's event loop and memory bus are shared by
+       every stream, so single-host loopback has no parallel NIC to
+       win on (same CPU-scale caveat as the churn phase, PERF.md §3b);
+       the hardware verdict is the TPU ladder item.
+    2. disagg TTFT — full worker stack (wait-for-completion mode, so
+       TTFT pays the whole transfer), same per-NIC pacing, p50 over
+       `requests` distinct-prompt requests per mode; greedy AND
+       seeded-sampled outputs must be token-identical across modes and
+       to the local-prefill oracle."""
+    import asyncio
+
+    from dynamo_tpu.disagg import (
+        DisaggDecodeWorker, DisaggregatedRouter, KvTransferServer,
+        PrefillQueue, PrefillWorker, RemoteTransferBackend,
+        ShardedKvTransferGroup,
+    )
+    from dynamo_tpu.disagg.remote_transfer import transfer_key
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+    from dynamo_tpu.llm.worker import NativeEngineWorker
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    logf = logf or log
+    kw = dict(base_kwargs or PAGE_KWARGS)
+    pmod = min(1000, model_cfg.vocab_size - 2)
+    ps = kw["page_size"]
+    prompt_len = max(2 * ps, min(4 * 128, (kw["num_pages"] // 4) * ps - ps))
+    max_tokens = 4
+
+    class NicPaced(RemoteTransferBackend):
+        """Each destination host's NIC serializes its payload at a
+        fixed bandwidth: the write path sleeps frame_bytes/bw per
+        chunk, per connection — concurrent streams to different hosts
+        pace concurrently, exactly the multi-NIC premise."""
+
+        nic_bytes_per_s = 1e9   # set once the payload size is known
+
+        async def _write(self, writer, frame, deadline):
+            await super()._write(writer, frame, deadline)
+            nb = sum(len(v) for v in frame.values()
+                     if isinstance(v, (bytes, bytearray)))
+            if nb:
+                await asyncio.sleep(nb / self.nic_bytes_per_s)
+
+    async def main():
+        plane = MemoryPlane()
+        queue = PrefillQueue(plane.messaging, "bench", "sharded")
+        drouter = DisaggregatedRouter(max_local_prefill_length=ps,
+                                      max_prefill_queue_size=64,
+                                      model="bench")
+        decode = DisaggDecodeWorker(
+            NativeEngine(model_cfg, EngineConfig(**kw), seed=0),
+            plane.messaging, drouter, queue, worker_id="bench-sh",
+            prefill_timeout_s=300.0, early_decode=False)
+        touch()
+        prefill_worker = NativeEngineWorker(
+            NativeEngine(model_cfg, EngineConfig(**kw), seed=0))
+        txA = NicPaced(plane.kv, chunk_pages=2, window_chunks=2)
+        prefill = PrefillWorker(prefill_worker, queue, txA,
+                                plane.messaging)
+        touch()
+        await decode.start()
+        await prefill.start()
+        rid_n = [0]
+
+        def make_pre(tag, sampled):
+            rid_n[0] += 1
+            rid = f"sh-{tag}-{rid_n[0]}"
+            salt = 137 * rid_n[0] + sum(tag.encode())
+            skw = {}
+            if sampled:
+                skw = dict(sampling={"temperature": 0.8, "top_k": 40,
+                                     "top_p": 0.95, "seed": 1234})
+            return PreprocessedRequest(
+                request_id=rid,
+                token_ids=[(salt + 3 * j) % pmod + 1
+                           for j in range(prompt_len)],
+                stop=StopConditions(max_tokens=max_tokens,
+                                    ignore_eos=True), **skw), rid
+
+        async def one(tag, sampled=False, pre=None):
+            if pre is None:
+                pre, rid = make_pre(tag, sampled)
+            else:
+                rid = pre.request_id
+            t0 = time.perf_counter()
+            ttft = None
+            toks = []
+            async for frame in decode.generate(
+                    pre.model_dump(exclude_none=True), Context(rid)):
+                if ttft is None and frame.get("token_ids"):
+                    ttft = time.perf_counter() - t0
+                toks.extend(frame.get("token_ids", ()))
+            touch()
+            return ttft, toks
+
+        # size the per-NIC pacing off the real page payload: one
+        # stream's wire time ~= wire_s regardless of tiny-vs-real model
+        params = SamplingParams(max_tokens=1, temperature=0.0,
+                                ignore_eos=True)
+        prompt = [(11 * j) % pmod + 1 for j in range(prompt_len)]
+        peng = prefill_worker.engine
+        peng.add_request(EngineRequest("sz", prompt, params,
+                                       prefill_only=True))
+        while peng.has_work():
+            peng.step()
+        pages = peng.extract_pages(peng.scheduler.parked["sz"].pages)
+        payload = pages["k"].nbytes + pages["v"].nbytes
+        for leaf in ("k_scale", "v_scale"):
+            if leaf in pages:
+                payload += pages[leaf].nbytes
+        NicPaced.nic_bytes_per_s = payload / wire_s
+        await prefill_worker.submit(lambda eng: eng.release_parked("sz"))
+        touch()
+
+        async def wall_leg(tag, tx, paced):
+            """`transfers` sends of the extracted stack, p50 wall."""
+            saved = NicPaced.nic_bytes_per_s
+            if not paced:
+                NicPaced.nic_bytes_per_s = float("inf")
+            walls = []
+            try:
+                for r in range(transfers + 1):
+                    rid = f"wall-{tag}-{paced}-{r}"
+                    alloc = await decode.submit(
+                        lambda eng, rid=rid: eng.allocate_remote(
+                            EngineRequest(rid, prompt, params)))
+                    t0 = time.perf_counter()
+                    await tx.send_pages(
+                        "bench-sh", rid, alloc.page_ids,
+                        pages["k"], pages["v"],
+                        k_scale=pages.get("k_scale"),
+                        v_scale=pages.get("v_scale"),
+                        alloc_epoch=alloc.alloc_epoch)
+                    walls.append(time.perf_counter() - t0)
+                    await decode.submit(
+                        lambda eng, rid=rid: eng.release_remote(rid))
+                    touch()
+            finally:
+                NicPaced.nic_bytes_per_s = saved
+            walls = sorted(walls[1:])     # first send pays compiles
+            return round(walls[len(walls) // 2] * 1e3, 2)
+
+        async def ttft_leg(tag):
+            await one(tag + "w")          # compile out of the timing
+            vals = []
+            for _ in range(requests):
+                ttft, _ = await one(tag)
+                vals.append(ttft)
+            vals.sort()
+            return round(vals[len(vals) // 2] * 1e3, 2)
+
+        async def identity_probe(tag):
+            """Token identity through the REMOTE path of this mode:
+            fresh per-mode prompts run remote FIRST (no prefix to hit),
+            then the same prompts re-run locally (router threshold
+            lifted; the now-cached prefix is exact reuse) as the
+            oracle. Greedy AND seeded-sampled must match."""
+            ok = True
+            for kind, sampled in (("g", False), ("s", True)):
+                pre, _ = make_pre(f"id{kind}-{tag}", sampled)
+                before = decode.remote_prefills
+                _, remote_toks = await one(tag, pre=pre)
+                if decode.remote_prefills == before:
+                    raise RuntimeError(
+                        f"identity probe id{kind}-{tag} never went "
+                        "remote")
+                saved = drouter.max_local_prefill_length
+                drouter.max_local_prefill_length = 1 << 30
+                oracle_pre = pre.model_copy(
+                    update={"request_id": pre.request_id + "-o"})
+                _, local_toks = await one(tag, pre=oracle_pre)
+                drouter.max_local_prefill_length = saved
+                ok = ok and (remote_toks == local_toks)
+            return ok
+
+        try:
+            # aggregated TTFT reference (local prefill, threshold lifted)
+            saved_thr = drouter.max_local_prefill_length
+            drouter.max_local_prefill_length = 1 << 30
+            ttft_agg = await ttft_leg("agg")
+            drouter.max_local_prefill_length = saved_thr
+
+            # mode A: single stream (legacy endpoint)
+            server = await KvTransferServer(decode, "bench-sh").start()
+            await server.register(plane.kv)
+            ident_1 = await identity_probe("one")
+            ttft_1 = await ttft_leg("one")
+            wall_1 = await wall_leg("one", txA, paced=True)
+            wall_1_raw = await wall_leg("one", txA, paced=False)
+            await server.stop()
+            await txA.close()
+            await plane.kv.delete(transfer_key("bench-sh"))
+
+            # mode B: N parallel (shard, host) streams
+            group = await ShardedKvTransferGroup(
+                decode, "bench-sh", hosts=n_streams,
+                n_streams=n_streams).start()
+            await group.register(plane.kv)
+            txB = NicPaced(plane.kv, chunk_pages=2 * n_streams,
+                           window_chunks=2)
+            prefill.transfer = txB
+            ident_n = await identity_probe("par")
+            ttft_n = await ttft_leg("par")
+            wall_n = await wall_leg("par", txB, paced=True)
+            wall_n_raw = await wall_leg("par", txB, paced=False)
+            identical = ident_1 and ident_n
+            counters = {
+                "remote_prefills": decode.remote_prefills,
+                "parallel_streams": group.n_streams,
+                "agg_ttft_ms": ttft_agg,
+            }
+            await txB.close()
+            await group.stop()
+        finally:
+            await prefill.stop()
+            await decode.stop()
+        return (payload, wall_1, wall_n, wall_1_raw, wall_n_raw,
+                ttft_1, ttft_n, identical, counters)
+
+    (payload, wall_1, wall_n, wall_1_raw, wall_n_raw, ttft_1, ttft_n,
+     identical, counters) = asyncio.run(main())
+    if not identical:
+        raise RuntimeError(
+            "sharded transfer A/B output mismatch: greedy/seeded streams "
+            "must be token-identical across 1-stream, N-stream, and the "
+            "local oracle")
+    result = {
+        "prompt_len": prompt_len, "payload_bytes": payload,
+        "n_streams": n_streams, "transfers": transfers,
+        "wire_s_per_stream": wire_s,
+        "wall_1_stream_ms": wall_1, "wall_n_stream_ms": wall_n,
+        "paced_wall_ratio": round(wall_n / max(wall_1, 1e-9), 3),
+        "wall_1_stream_raw_ms": wall_1_raw,
+        "wall_n_stream_raw_ms": wall_n_raw,
+        "raw_wall_ratio": round(wall_n_raw / max(wall_1_raw, 1e-9), 3),
+        "disagg_ttft_1_stream_ms": ttft_1,
+        "disagg_ttft_n_stream_ms": ttft_n,
+        "disagg_ttft_ratio": round(ttft_n / max(ttft_1, 1e-9), 3),
+        "token_identical": identical,
+        **counters,
+    }
+    logf(f"sharded transfer A/B ({n_streams} streams, "
+         f"{payload >> 20}MiB payload): paced wall {wall_1}ms -> "
+         f"{wall_n}ms ({result['paced_wall_ratio']}x), raw "
+         f"{wall_1_raw}ms -> {wall_n_raw}ms "
+         f"({result['raw_wall_ratio']}x), disagg TTFT {ttft_1}ms -> "
+         f"{ttft_n}ms ({result['disagg_ttft_ratio']}x), "
+         f"token-identical {identical}")
     touch()
     return result
 
@@ -1529,6 +1817,21 @@ def worker():
         except Exception as e:  # evidence phase must not kill the capture
             log(f"transfer overlap A/B failed ({type(e).__name__}: {e})")
             st.result["extras"]["transfer_overlap"] = {"failure": str(e)}
+        st.touch()
+
+    if os.environ.get("BENCH_SHARDED", "1") != "0" \
+            and time.time() - T0 < BUDGET_S - 180:
+        st.set_phase("sharded_transfer")
+        log("phase: sharded transfer A/B — 1-stream vs N-(shard, host)-"
+            "stream KV transfer wall time + disagg TTFT (ISSUE 15)")
+        try:
+            st.result["extras"]["sharded_transfer"] = \
+                run_sharded_transfer_ab(model_cfg, PAGE_KWARGS,
+                                        n_chips=n_chips, touch=st.touch,
+                                        logf=log)
+        except Exception as e:  # evidence phase must not kill the capture
+            log(f"sharded transfer A/B failed ({type(e).__name__}: {e})")
+            st.result["extras"]["sharded_transfer"] = {"failure": str(e)}
         st.touch()
 
     if os.environ.get("BENCH_WARM_PREFIX", "1") != "0" \

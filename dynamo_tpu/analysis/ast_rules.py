@@ -116,6 +116,20 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       is exactly where a refactor silently turns weighted fairness
       into a starvation engine: the high class wins every contest and
       the batch tenant never completes
+- R20 min-frontier aggregation contract (dynamo_tpu/ + tools/): any
+      consumer of a committed transfer frontier — `stream_frontier(...)`
+      / `committed_frontier(...)`, or the fate-deciding call sites that
+      consume it (`salvage_remote(...)`, `preactivate_remote(...)`,
+      `poll_overlap_gates(...)`) — must sit in a function that visibly
+      references the min-over-streams aggregation (min/aggregat/
+      straggler vocabulary — sharded parallel transfer commits each
+      (shard, host) stream independently, and a page is only usable
+      once EVERY stream committed it) or carry
+      `# dynalint: frontier-ok=<reason>`. A frontier consumer that
+      can't point at the min is exactly where a refactor silently
+      trusts ONE stream's frontier — and salvage then charges pages
+      whose sibling slices never landed, decoding garbage
+      (disagg/remote_transfer.py owns the aggregation)
 """
 from __future__ import annotations
 
@@ -1564,6 +1578,86 @@ def r19_starvation_bound_contract(tree: ast.AST, lines: List[str],
             "by the class-band requeue + queue aging limit' — or "
             "annotate with `# dynalint: starvation-ok=<why unbounded "
             "priority is safe here>`"))
+    return out
+
+
+# -- R20: committed-frontier consumers must reference the min aggregation -----
+
+# Scope: the dynamo_tpu package and tools/ (the transfer servers, the
+# disagg workers, the scheduler's overlap gates, and the bench/chaos
+# drivers all consume committed frontiers). Sharded parallel transfer
+# (disagg/remote_transfer.py) made the committed frontier PER-STREAM:
+# each (shard, host) stream commits independently, and the request-wide
+# frontier — the number salvage charges, the early-decode gate opens
+# on, and resume reasons about — is the MIN over streams. Every
+# consumer is one refactor away from trusting a single stream's
+# frontier (salvaging pages whose sibling slices never landed = decoded
+# garbage). The rule is lexical like R16/R18/R19: the enclosing
+# function must write the aggregation down (min/aggregat/straggler
+# vocabulary) or the call carries `# dynalint: frontier-ok=<reason>`
+# within three lines above.
+_R20_SCOPE = ("dynamo_tpu/", "tools/")
+_R20_TERMINALS = {"stream_frontier", "committed_frontier",
+                  "salvage_remote", "preactivate_remote",
+                  "poll_overlap_gates"}
+_R20_ANNOT_RE = re.compile(r"#\s*dynalint:\s*frontier-ok=\S+")
+_R20_HANDLED_RE = re.compile(r"\bmin\b|min-frontier|min over|aggregat|"
+                             r"straggler", re.I)
+
+
+@rule("R20")
+def r20_min_frontier_contract(tree: ast.AST, lines: List[str],
+                              path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R20_SCOPE) \
+            or "tests/" in norm:
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R20_ANNOT_RE.search(_line(lines, x))
+                   for x in range(ln - 3, ln + 1))
+
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def enclosing_handles(ln: int) -> bool:
+        inner = None
+        for fn in funcs:
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= ln <= end and (
+                    inner is None or fn.lineno >= inner.lineno):
+                inner = fn
+        if inner is None:
+            lo, hi = max(1, ln - 10), min(len(lines), ln + 10)
+        else:
+            lo, hi = inner.lineno, getattr(inner, "end_lineno",
+                                           inner.lineno)
+        return any(_R20_HANDLED_RE.search(_line(lines, x))
+                   for x in range(lo, hi + 1))
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        terminal = _call_name(node).rsplit(".", 1)[-1]
+        if terminal not in _R20_TERMINALS:
+            continue
+        if annotated(node.lineno) or enclosing_handles(node.lineno):
+            continue
+        out.append(_finding(
+            "R20", path, lines, node,
+            f"`{_call_name(node)}(...)` consumes a committed transfer "
+            "frontier without referencing the min-over-streams "
+            "aggregation — sharded parallel transfer commits each "
+            "(shard, host) stream independently, and a consumer that "
+            "can't point at the min is where a refactor silently "
+            "trusts one stream's frontier and salvages pages whose "
+            "sibling slices never landed",
+            "state (docstring/comment) where the min-frontier "
+            "aggregation happens for this path — e.g. 'frontier = min "
+            "over per-stream frontiers (ShardedKvTransferGroup)' — or "
+            "annotate with `# dynalint: frontier-ok=<why a single "
+            "stream's frontier is safe here>`"))
     return out
 
 

@@ -47,8 +47,23 @@ def _disabled_rules(lines: List[str], lineno: int) -> set:
     return out
 
 
+# Memoized full-tree passes: the per-rule live gates and the repo-wide
+# baseline gate each lint the same ~170 unchanged files in one process
+# (tier-1 runs them back to back), and every rule re-walks the AST —
+# a content-keyed cache makes every pass after the first free. Keyed by
+# (path, source hash) so fixtures sharing a path never alias; bounded.
+_LINT_CACHE: dict = {}
+_LINT_CACHE_MAX = 2048
+
+
 def lint_source(source: str, path: str) -> List[Finding]:
-    """Run every AST rule over one file's source text."""
+    """Run every AST rule over one file's source text (memoized by
+    (path, content) — repeated tree-wide passes in one process reuse
+    the first pass's findings)."""
+    key = (path, hash(source))
+    cached = _LINT_CACHE.get(key)
+    if cached is not None:
+        return list(cached)
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -57,8 +72,12 @@ def lint_source(source: str, path: str) -> List[Finding]:
                         line_text="")]
     lines = source.splitlines()
     findings = run_rules(tree, lines, path)
-    return [f for f in findings
-            if f.rule not in _disabled_rules(lines, f.line)]
+    out = [f for f in findings
+           if f.rule not in _disabled_rules(lines, f.line)]
+    if len(_LINT_CACHE) >= _LINT_CACHE_MAX:
+        _LINT_CACHE.clear()
+    _LINT_CACHE[key] = out
+    return list(out)
 
 
 def iter_py_files(paths: Iterable[str]) -> Iterable[str]:

@@ -11,7 +11,7 @@ relayout), with the same queue/notify control flow.
 from dynamo_tpu.disagg.protocols import PrefillCompletion, RemotePrefillRequest
 from dynamo_tpu.disagg.queue import PrefillQueue
 from dynamo_tpu.disagg.remote_transfer import (
-    KvTransferServer, RemoteTransferBackend,
+    KvTransferServer, RemoteTransferBackend, ShardedKvTransferGroup,
 )
 from dynamo_tpu.disagg.router import DisaggregatedRouter
 from dynamo_tpu.disagg.transfer import LocalTransferBackend, TransferBackend
@@ -20,6 +20,6 @@ from dynamo_tpu.disagg.worker import DisaggDecodeWorker, PrefillWorker
 __all__ = [
     "RemotePrefillRequest", "PrefillCompletion", "PrefillQueue",
     "DisaggregatedRouter", "TransferBackend", "LocalTransferBackend",
-    "KvTransferServer", "RemoteTransferBackend",
+    "KvTransferServer", "RemoteTransferBackend", "ShardedKvTransferGroup",
     "DisaggDecodeWorker", "PrefillWorker",
 ]

@@ -185,9 +185,13 @@ class DisaggDecodeWorker(NativeEngineWorker):
             log.exception("prefill cancel publish failed for %s", rid)
 
     def _committed_frontier(self, rid: str, alloc_epoch: int) -> int:
-        """Transfer-list pages the attached KvTransferServer has durably
-        committed for this exact allocation (0 without a server — the
-        local backend's one-shot device_put is all-or-nothing)."""
+        """Transfer-list pages the attached transfer server (a single
+        KvTransferServer or a sharded ShardedKvTransferGroup) has
+        durably committed for this exact allocation — the MIN over
+        per-stream frontiers on sharded parallel transfers, so a page
+        only counts once every shard slice of it landed (0 without a
+        server — the local backend's one-shot device_put is
+        all-or-nothing)."""
         srv = self.kv_transfer_server
         if srv is None:
             return 0
@@ -353,6 +357,9 @@ class DisaggDecodeWorker(NativeEngineWorker):
                     XFER_STATS.salvaged_pages += frontier
                     q = self._register(rid)
                     try:
+                        # salvage charges the MIN-over-streams frontier
+                        # (_committed_frontier): only pages EVERY shard
+                        # stream committed are kept
                         salvaged = await self.submit(
                             lambda eng: eng.salvage_remote(rid,
                                                            valid_pages))
@@ -513,6 +520,10 @@ class DisaggDecodeWorker(NativeEngineWorker):
         needed = len(alloc.page_ids) - start_page
         q = self._register(rid)
         try:
+            # the gate's frontier_fn is the MIN over per-stream
+            # frontiers (KvTransferServer/ShardedKvTransferGroup
+            # .committed_frontier aggregation): decode never activates
+            # while any shard stream still owes a slice
             await self.submit(lambda eng: eng.preactivate_remote(
                 rid, first, needed,
                 lambda: srv.committed_frontier(rid, epoch)))
@@ -574,6 +585,8 @@ class DisaggDecodeWorker(NativeEngineWorker):
                     failure.error if failure else "timeout", frontier)
                 self.salvaged_prefills += 1
                 XFER_STATS.salvaged_pages += frontier
+                # salvage charges the MIN-over-streams frontier: only
+                # pages every shard stream committed are kept
                 salvaged = await self.submit(
                     lambda eng: eng.salvage_remote(
                         rid, start_page + frontier, first_token=first))

@@ -223,8 +223,8 @@ class EngineConfig:
     # offload tiers -> disagg transfer; ops/kv_quant.py). Set here (the
     # deployment surface) it overrides ModelConfig.kv_quant at engine
     # construction. Composes with pipeline_depth=2, mixed steps, tp/dp
-    # meshes, and fault injection; pp meshes reject it (the GPipe stage
-    # scan does not thread scale shards yet).
+    # AND pp meshes (the GPipe stage scan threads the scale-stack shards
+    # — models/pp.pp_cache_scale_sharding), and fault injection.
     kv_quant: str = ""
     # COMPAT ALIAS (legacy alternating scheduler only, i.e.
     # mixed_token_budget=0): longest run of consecutive prefill steps

@@ -86,11 +86,6 @@ class NativeEngine:
         # per-token dispatch.
         self.pp = self.mesh.shape.get("pp", 1)
         if self.pp > 1:
-            if self.kv_quant:
-                raise ValueError(
-                    "kv_quant does not compose with pp meshes yet (the "
-                    "GPipe stage scan does not thread scale shards); use "
-                    "tp/dp meshes or disable kv_quant")
             if model_cfg.is_moe:
                 raise ValueError("pp requires a dense model; shard MoE "
                                  "configs over the ep axis instead")
@@ -448,6 +443,10 @@ class NativeEngine:
         # out-of-range ids are dropped
         self._extract_fn = jax.jit(_extract_pages)
         self._inject_fn = jax.jit(_inject_pages, donate_argnums=(0,))
+        # sharded parallel transfer (disagg/remote_transfer.py): one
+        # jitted slice-scatter per shard-slice plan entry — the set is
+        # bounded by the transfer layout (parallel/mesh.kv_shard_layout)
+        self._inject_shard_fns = {}
         # multimodal: jitted vision tower (models/vision.py); the encoder
         # runs at admission time (the "vision prefill"), its projected
         # patch embeds feed the text prefill via PrefillPlan.mm_embeds
@@ -480,6 +479,9 @@ class NativeEngine:
     @property
     def cache_scale_sharding(self) -> NamedSharding:
         """Sharding for KV scale page stacks (kv_quant engines only)."""
+        if self.pp > 1:
+            from dynamo_tpu.models.pp import pp_cache_scale_sharding
+            return NamedSharding(self.mesh, pp_cache_scale_sharding())
         return NamedSharding(self.mesh,
                              llama.cache_scale_sharding(self.model_cfg))
 
@@ -487,9 +489,16 @@ class NativeEngine:
     def cache_shardings(self):
         """Per-leaf NamedShardings matching the cache dict layout."""
         if self.pp > 1:
-            from dynamo_tpu.models.pp import pp_cache_sharding
+            from dynamo_tpu.models.pp import (
+                pp_cache_scale_sharding, pp_cache_sharding,
+            )
             shd = NamedSharding(self.mesh, pp_cache_sharding())
-            return {"k": shd, "v": shd}
+            out = {"k": shd, "v": shd}
+            if self.kv_quant:
+                sshd = NamedSharding(self.mesh, pp_cache_scale_sharding())
+                out["k_scale"] = sshd
+                out["v_scale"] = sshd
+            return out
         return {key: NamedSharding(self.mesh, spec) for key, spec in
                 llama.cache_shardings(self.model_cfg).items()}
 
@@ -563,9 +572,10 @@ class NativeEngine:
         s = self.scheduler
         if s.overlap_gates:
             # early-decode overlap (docs/PERF.md): promote any gated
-            # remote sequence whose committed frontier now covers its
-            # transfer list — the watermark check runs HERE, before
-            # planning, on the same thread that applies injects
+            # remote sequence whose committed frontier — the MIN over
+            # per-stream frontiers on sharded parallel transfers — now
+            # covers its transfer list; the watermark check runs HERE,
+            # before planning, on the same thread that applies injects
             s.poll_overlap_gates()
         return (self._pipeline is not None or bool(s.waiting)
                 or any(x is not None for x in s.running))
@@ -1587,7 +1597,10 @@ class NativeEngine:
         """Decode side, early-decode overlap: arm a committed-frontier
         gate so the sequence activates the moment every transferred
         page is verified + injected, instead of waiting for stream
-        completion + the notify round trip (docs/PERF.md)."""
+        completion + the notify round trip (docs/PERF.md).
+        `frontier_fn` must answer the MIN over per-stream frontiers on
+        sharded parallel transfers (the transfer server's aggregation)
+        — the gate may only open once every shard slice landed."""
         self.scheduler.preactivate_remote(request_id, first_token,
                                           needed_pages, frontier_fn)
 
@@ -1603,9 +1616,12 @@ class NativeEngine:
         streamed transfer COMMITTED a prefix (verified + injected +
         acked chunks). Keep those pages and re-prefill locally only
         from the committed page boundary — the disagg twin of the
-        migration path's committed-prefix re-dispatch. `first_token`
-        seeds the already-emitted first output token on the early-
-        decode overlap path. Returns the salvaged token count."""
+        migration path's committed-prefix re-dispatch. `valid_pages`
+        must come from the MIN-over-streams frontier aggregation on
+        sharded parallel transfers: a page is only salvageable when
+        EVERY shard stream committed its slice. `first_token` seeds
+        the already-emitted first output token on the early-decode
+        overlap path. Returns the salvaged token count."""
         return self.scheduler.salvage_remote(request_id, valid_pages,
                                              first_token=first_token)
 
@@ -1670,6 +1686,66 @@ class NativeEngine:
             pages["k_scale"] = k_scale
             pages["v_scale"] = v_scale
         self.cache = self._inject_fn(self.cache, jnp.asarray(ids), pages)
+
+    def shard_slices(self, n_streams: int = 0) -> list:
+        """This engine's KV transfer shard plan: one slice tuple per
+        parallel transfer stream (parallel/mesh.kv_shard_layout over the
+        mesh's tp/pp extents — the cache sharding spec's shard blocks).
+        The disagg data plane opens one chunk-committed stream per
+        (slice, destination host) and the receiver injects each slice
+        independently; `n_streams` overrides the natural shard count on
+        non-pp meshes (must divide num_kv_heads)."""
+        from dynamo_tpu.parallel.mesh import kv_shard_layout
+        return kv_shard_layout(self.model_cfg.num_layers,
+                               self.model_cfg.num_kv_heads,
+                               tp=self.mesh.shape.get("tp", 1),
+                               pp=self.pp, n_streams=n_streams)
+
+    def inject_pages_shard(self, page_ids, k_pages, v_pages, slices,
+                           k_scale=None, v_scale=None) -> None:
+        """Scatter a SHARD SLICE of whole KV pages into this engine's
+        cache: the sharded-parallel-transfer twin of inject_pages.
+
+        `slices` is one entry of shard_slices() — ((axis, start, count),
+        ...) over the leading (layer, kv-head) axes, shared by the value
+        leaves ([Ls, Hs, Nb, ps, hd]) and the kv_quant scale leaves
+        ([Ls, Hs, Nb, ps]). Each stream's chunks land here independently
+        of its sibling streams; a page is only USABLE once every stream
+        covering it has committed — the min-over-streams frontier the
+        transfer server aggregates (KvTransferServer.committed_frontier)
+        gates decode, so a partially-assembled page is never read.
+
+        The update compiles once per (plan entry, id bucket): the slice
+        bounds are static, only page ids are data."""
+        if self.kv_quant and k_scale is None:
+            raise ValueError(
+                "this engine stores int8 KV pages (kv_quant="
+                f"{self.kv_quant!r}) but the sender shipped no scales; "
+                "both sides of a transfer must run the same kv_quant mode")
+        if not self.kv_quant and k_scale is not None:
+            raise ValueError(
+                "sender shipped quantized KV pages but this engine's "
+                "cache is unquantized; both sides of a transfer must run "
+                "the same kv_quant mode")
+        if self._pending_offloads:
+            self._process_offloads()
+        nb = k_pages.shape[2]
+        if len(page_ids) > nb:
+            raise ValueError(
+                f"{len(page_ids)} dst pages but only {nb} pages sent")
+        ids = np.full((nb,), self.cfg.num_pages, np.int32)
+        ids[:len(page_ids)] = page_ids
+        pages = {"k": k_pages, "v": v_pages}
+        if k_scale is not None:
+            pages["k_scale"] = k_scale
+            pages["v_scale"] = v_scale
+        key = tuple(tuple(s) for s in slices)
+        fn = self._inject_shard_fns.get(key)
+        if fn is None:
+            fn = self._inject_shard_fns[key] = jax.jit(
+                functools.partial(_inject_pages_slice, slices=key),
+                donate_argnums=(0,))
+        self.cache = fn(self.cache, jnp.asarray(ids), pages)
 
     # -- introspection -------------------------------------------------------
 
@@ -1891,6 +1967,29 @@ def _inject_pages(cache, ids, pages):
     # dynalint: kv-codec — whole-page moves of the stored representation
     return {key: cache[key].at[:, :, ids].set(pages[key], mode="drop")
             for key in cache}
+
+
+def _inject_pages_slice(cache, ids, pages, slices=()):
+    """Scatter a shard slice of pages into the cache at ids: `slices`
+    ((axis, start, count), ...) are STATIC bounds over the leading
+    (layer, kv-head) axes — one compiled program per shard-plan entry.
+    Out-of-range ids drop, exactly like _inject_pages. ONE mixed
+    basic+advanced `.at[]` per leaf (static slices + the page-id array,
+    which numpy semantics keep in place as the single advanced index):
+    a direct strided scatter on the donated buffer, never a
+    materialized sub-cache copy — the per-chunk inject cost is O(chunk
+    slice), not O(cache)."""
+    out = {}
+    # dynalint: kv-codec — whole-page slice moves keep the stored
+    # (possibly quantized) representation; scale leaves share axes 0/1
+    for key in cache:
+        arr = cache[key]
+        idx = [slice(None)] * arr.ndim
+        for axis, start, count in slices:
+            idx[axis] = slice(start, start + count)
+        idx[2] = ids
+        out[key] = arr.at[tuple(idx)].set(pages[key], mode="drop")
+    return out
 
 
 def _scatter_new_kv(cache, k_news, v_news, write_idx):

@@ -82,6 +82,10 @@ class ReliabilityPolicy:
     # default end-to-end deadline armed when the caller didn't set one
     # (None = unbounded requests stay unbounded)
     request_deadline_s: Optional[float] = None
+    # how long one dispatch attempt waits for ANY serving instance to
+    # appear before the attempt fails and the retry/backoff ladder
+    # takes over (was a hardcoded 5.0 inside the scheduler pick)
+    instance_wait_s: float = 5.0
 
 
 class ReliabilityMetrics:
@@ -485,9 +489,10 @@ class ReliableClient:
             ids = self.client.instance_ids()   # all ejected: probe anyway
         if not ids:
             rem = ctx.time_remaining()
+            wait = self.policy.instance_wait_s
             await with_deadline(
                 self.client.wait_for_instances(
-                    timeout=min(5.0, rem) if rem is not None else 5.0),
+                    timeout=min(wait, rem) if rem is not None else wait),
                 None, ctx)
             ids = self.client.instance_ids()
         if self.route_policy == "round_robin":

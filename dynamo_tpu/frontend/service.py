@@ -144,7 +144,20 @@ class HttpService:
                          # salvaged committed-prefix pages, epoch-fenced
                          # stale chunks, per-IO link timeouts
                          "resumes", "salvaged_pages", "stale_chunks",
-                         "link_timeouts")}
+                         "link_timeouts",
+                         # sharded parallel transfer: sends fanned out
+                         # over N (shard, host) streams
+                         "parallel_transfers")}
+        # per-(shard, host) stream dimension of the sharded parallel
+        # transfer plane: unique bytes/pages per stream, chunk-level
+        # resumes, and the last committed frontier — the straggler-
+        # diagnosis surface (min over `frontier` series per request =
+        # what gates salvage/overlap; tools/fleet_top.py renders it)
+        self._kv_xfer_stream = {
+            name: m.gauge(f"llm_kv_transfer_stream_{name}",
+                          f"kv transfer per (shard, host) stream: {name}",
+                          ("stream",))
+            for name in ("bytes", "pages", "resumes", "frontier")}
         # control-plane health (runtime/cpstats.py CP_STATS): watch
         # queue depth + coalescing, indexer size + eviction backlog,
         # event-plane lag, and the router's stale-snapshot degraded flag
@@ -264,6 +277,9 @@ class HttpService:
         for name, value in XFER_STATS.snapshot().items():
             if name in self._kv_xfer:
                 self._kv_xfer[name].set(value=value)
+        for skey, row in XFER_STATS.stream_snapshot().items():
+            for name, value in row.items():
+                self._kv_xfer_stream[name].set(skey, value=value)
         from dynamo_tpu.runtime.cpstats import CP_STATS
         for name, value in CP_STATS.snapshot().items():
             self._cp[name].set(value=float(value))

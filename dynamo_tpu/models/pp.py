@@ -41,7 +41,7 @@ from dynamo_tpu.models.llama import (
     rms_norm, scale_embeds,
 )
 from dynamo_tpu.ops.attention import (
-    _softcap, paged_attention, write_kv_pages,
+    _softcap, paged_attention, write_kv_pages, write_kv_pages_quant,
 )
 from dynamo_tpu.parallel.mesh import shard_map_compat
 
@@ -100,6 +100,13 @@ def pp_cache_sharding() -> P:
     return P("pp", "tp", None, None, None)
 
 
+def pp_cache_scale_sharding() -> P:
+    """kv_quant scale stacks [L, Hkv, P, ps]: the value sharding minus
+    head_dim — each stage owns its own layers' scale rows, each tp shard
+    its own heads', so the int8 codec stays stage/shard-local."""
+    return P("pp", "tp", None, None)
+
+
 def _head_and_specs(cfg: ModelConfig, params: Params):
     """Shared spec selection for both pp entry points: returns
     (layer+head shardings [quantized if the params are], head operand,
@@ -119,7 +126,7 @@ def _head_and_specs(cfg: ModelConfig, params: Params):
 
 
 def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
-           meta: AttnMetadata, wnds=None):
+           meta: AttnMetadata, wnds=None, ksc=None, vsc=None):
     """Run this stage's local layers (scan) on one microbatch.
 
     Mirrors models/llama.forward's layer_step (gather attention path) with
@@ -127,18 +134,28 @@ def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
     [L/pp, Hkv/tp, ...] cache shards. `wnds` is the stage-local slice of
     the per-layer sliding-window array (None = all layers full attention);
     post-norms / soft-caps / query scaling follow models/llama.forward.
+    `ksc`/`vsc` (kv_quant engines) are the stage-local scale-stack shards
+    ([L/pp, Hkv/tp, P, ps]): new rows quantize at capture inside the
+    scan (write_kv_pages_quant) and attention dequantizes at the gather,
+    exactly like the single-mesh forward — the int8 codec never crosses
+    a stage or tp boundary because values and scales shard together.
     """
     b, tq, _ = x.shape
     h = cfg.num_heads // tp
     hkv = cfg.num_kv_heads // tp
     hd = cfg.head_dim
+    kvq = ksc is not None
 
     def layer_step(x, layer):
         if wnds is not None:
-            lp, kc, vc, wnd = layer
+            layer, wnd = layer[:-1], layer[-1]
+        else:
+            wnd = None
+        if kvq:
+            lp, kc, vc, ksc_l, vsc_l = layer
         else:
             lp, kc, vc = layer
-            wnd = None
+            ksc_l = vsc_l = None
         xn = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_plus_one)
         q = jnp.einsum("btd,de->bte", xn, wmat(lp["wq"], xn.dtype))
         k = jnp.einsum("btd,de->bte", xn, wmat(lp["wk"], xn.dtype))
@@ -149,10 +166,17 @@ def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
         k = apply_rope(k.reshape(b, tq, hkv, hd), meta.positions,
                        cfg.rope_theta)
         v = v.reshape(b, tq, hkv, hd)
-        kc, vc = write_kv_pages(kc, vc, k, v, meta.write_idx)
+        if kvq:
+            # capture-time quantization inside the stage scan: int8
+            # values + f32 scale rows scatter together (ops/kv_quant.py)
+            kc, vc, ksc_l, vsc_l = write_kv_pages_quant(
+                kc, vc, ksc_l, vsc_l, k, v, meta.write_idx)
+        else:
+            kc, vc = write_kv_pages(kc, vc, k, v, meta.write_idx)
         attn = paged_attention(q, kc, vc, meta.page_table, meta.kv_lens,
                                meta.positions, softcap=cfg.attn_softcap,
-                               window=wnd, q_scale=cfg.query_scale)
+                               window=wnd, q_scale=cfg.query_scale,
+                               k_scale=ksc_l, v_scale=vsc_l)
         o = jnp.einsum("bte,ed->btd", attn.reshape(b, tq, h * hd),
                        wmat(lp["wo"], x.dtype))
         # psum BEFORE the post-norm: rms_norm is nonlinear, so it must see
@@ -172,11 +196,18 @@ def _stage(cfg: ModelConfig, tp: int, x, layers, kc, vc,
             mlp = rms_norm(mlp, lp["post_mlp_norm"], cfg.rms_norm_eps,
                            cfg.norm_plus_one)
         x = x + mlp
-        return x, (kc, vc)
+        ys = (kc, vc, ksc_l, vsc_l) if kvq else (kc, vc)
+        return x, ys
 
-    xs = (layers, kc, vc) if wnds is None else (layers, kc, vc, wnds)
+    xs = (layers, kc, vc)
+    if kvq:
+        xs = xs + (ksc, vsc)
+    if wnds is not None:
+        xs = xs + (wnds,)
     x, ys = jax.lax.scan(layer_step, x, xs)
-    return x, ys[0], ys[1]
+    if kvq:
+        return x, ys[0], ys[1], ys[2], ys[3]
+    return x, ys[0], ys[1], None, None
 
 
 def pp_forward(
@@ -206,35 +237,53 @@ def pp_forward(
     shardings, head, head_spec, base_hs = _head_and_specs(cfg, params)
     lw = cfg.layer_windows()
     wnds = None if lw is None else jnp.asarray(lw, jnp.int32)
-    fwd = functools.partial(_pp_body, cfg, pp, tp, m)
+    kvq = "k_scale" in cache
+    fwd = functools.partial(_pp_body, cfg, pp, tp, m, kvq,
+                            wnds is not None)
     in_specs = (P("tp", None), shardings["layers"], P(None), head_spec,
                 pp_cache_sharding(), pp_cache_sharding(),
                 P(), P(), P(), P(), P())
     args = (params["embed"], params["layers"], params["final_norm"], head,
-            # dynalint: kv-codec — pp caches are always unquantized
-            # (NativeEngine rejects kv_quant on pp meshes)
+            # int8 caches thread their scale-stack shards through the
+            # stage scan (write_kv_pages_quant in _stage); unquantized
+            # caches pass values only  # dynalint: kv-codec
             cache["k"], cache["v"], tokens, meta.positions, meta.page_table,
             meta.kv_lens, meta.write_idx)
+    # logits vocab-sharded over tp when the head is; cache back in place
+    out_specs = (P(None, None, "tp") if base_hs[1] == "tp" else P(),
+                 pp_cache_sharding(), pp_cache_sharding())
+    if kvq:
+        in_specs = in_specs + (pp_cache_scale_sharding(),
+                               pp_cache_scale_sharding())
+        # dynalint: kv-codec — scale shards ride next to the values
+        args = args + (cache["k_scale"], cache["v_scale"])
+        out_specs = out_specs + (pp_cache_scale_sharding(),
+                                 pp_cache_scale_sharding())
     if wnds is not None:
         in_specs = in_specs + (P("pp"),)
         args = args + (wnds,)
-    specs = dict(
-        mesh=mesh,
-        in_specs=in_specs,
-        # logits vocab-sharded over tp when the head is; cache back in place
-        out_specs=(P(None, None, "tp") if base_hs[1] == "tp" else P(),
-                   pp_cache_sharding(), pp_cache_sharding()),
-    )
-    logits, kc, vc = shard_map_compat(fwd, **specs)(*args)
+    specs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    out = shard_map_compat(fwd, **specs)(*args)
+    if kvq:
+        logits, kc, vc, ksc, vsc = out
+        return logits, {"k": kc, "v": vc, "k_scale": ksc, "v_scale": vsc}
+    logits, kc, vc = out
     return logits, {"k": kc, "v": vc}
 
 
-def _pp_body(cfg, pp, tp, m,
+def _pp_body(cfg, pp, tp, m, kvq, has_wnds,
              embed, layers, final_norm, head,
              kc, vc, tokens, positions, page_table, kv_lens, write_idx,
-             wnds=None):
+             *extra):
     """shard_map body: runs once per (pp, tp) shard with stage-local
-    layers/cache. One GPipe schedule of m microbatches over pp stages."""
+    layers/cache. One GPipe schedule of m microbatches over pp stages.
+    `extra` carries (ksc, vsc) when kvq and the per-layer window array
+    when has_wnds, in that order."""
+    ksc = vsc = wnds = None
+    if kvq:
+        ksc, vsc = extra[0], extra[1]
+    if has_wnds:
+        wnds = extra[-1]
     r = jax.lax.axis_index("pp")
     last = pp - 1
     b, tq = tokens.shape
@@ -257,7 +306,7 @@ def _pp_body(cfg, pp, tp, m,
     x0_all = scale_embeds(_embed_lookup(embed, toks_mb).astype(dt), cfg)
 
     def tick(carry, t):
-        x_prev, kc, vc = carry
+        x_prev, kc, vc, ksc_c, vsc_c = carry
         i = t - r                      # microbatch this stage works on
         valid = (i >= 0) & (i < m)
         ic = jnp.clip(i, 0, m - 1)
@@ -269,7 +318,8 @@ def _pp_body(cfg, pp, tp, m,
             positions=pos_mb[ic], page_table=pt_mb[ic], kv_lens=kl_mb[ic],
             # fill/drain ticks must not write KV: scatter drops idx < 0
             write_idx=jnp.where(valid, wi_mb[ic], -1))
-        y, kc, vc = _stage(cfg, tp, x_in, layers, kc, vc, meta_t, wnds)
+        y, kc, vc, ksc_c, vsc_c = _stage(cfg, tp, x_in, layers, kc, vc,
+                                         meta_t, wnds, ksc_c, vsc_c)
         # the LAST stage finishes microbatch i at this tick
         xf = rms_norm(y, final_norm, cfg.rms_norm_eps, cfg.norm_plus_one)
         lg = _softcap(jnp.einsum("btd,dv->btv", xf,
@@ -278,11 +328,11 @@ def _pp_body(cfg, pp, tp, m,
         # hop activations to the next stage (ring; stage 0's recv is unused)
         y_next = jax.lax.ppermute(
             y, "pp", [(s, (s + 1) % pp) for s in range(pp)])
-        return (y_next, kc, vc), (lg, ic)
+        return (y_next, kc, vc, ksc_c, vsc_c), (lg, ic)
 
     x0 = jnp.zeros((b // m, tq, cfg.hidden_size), dt)
-    (_, kc, vc), (lgs, idxs) = jax.lax.scan(
-        tick, (x0, kc, vc), jnp.arange(ticks))
+    (_, kc, vc, ksc, vsc), (lgs, idxs) = jax.lax.scan(
+        tick, (x0, kc, vc, ksc, vsc), jnp.arange(ticks))
     # scatter each tick's logits into its microbatch slot: non-last stages
     # and fill/drain ticks contributed zeros, and each microbatch's logits
     # were produced exactly once (on the last stage, at tick i + pp - 1)
@@ -291,6 +341,8 @@ def _pp_body(cfg, pp, tp, m,
     out = out.reshape(b, tq, v_loc)
     # masked broadcast: only the last stage holds real logits
     out = jax.lax.psum(out, "pp")
+    if kvq:
+        return out, kc, vc, ksc, vsc
     return out, kc, vc
 
 
@@ -357,40 +409,60 @@ def pp_decode_window(
     shardings, head, head_spec, _ = _head_and_specs(cfg, params)
     lw = cfg.layer_windows()
     wnds = None if lw is None else jnp.asarray(lw, jnp.int32)
+    kvq = "k_scale" in cache
     fwd = functools.partial(_pp_decode_body, cfg, pp, tp, n_steps,
-                            page_size, eos_ids, greedy)
+                            page_size, eos_ids, greedy, kvq,
+                            wnds is not None)
     in_specs = (P("tp", None), shardings["layers"], P(None), head_spec,
                 pp_cache_sharding(), pp_cache_sharding(),
                 P(), P(), P(), P(), P(), P(), P(), P(),
                 P(), P(), P(), P())
     args = (params["embed"], params["layers"], params["final_norm"], head,
-            # dynalint: kv-codec — pp caches are always unquantized
-            # (NativeEngine rejects kv_quant on pp meshes)
+            # int8 caches thread their scale-stack shards through the
+            # stage scan (write_kv_pages_quant in _stage); unquantized
+            # caches pass values only  # dynalint: kv-codec
             cache["k"], cache["v"], tokens, positions, page_table, max_pos,
             min_tokens, counters, ignore_eos, stop_ids,
             temperature, top_k, top_p, seeds)
+    out_specs = (P(), pp_cache_sharding(), pp_cache_sharding())
+    if kvq:
+        in_specs = in_specs + (pp_cache_scale_sharding(),
+                               pp_cache_scale_sharding())
+        # dynalint: kv-codec — scale shards ride next to the values
+        args = args + (cache["k_scale"], cache["v_scale"])
+        out_specs = out_specs + (pp_cache_scale_sharding(),
+                                 pp_cache_scale_sharding())
     if wnds is not None:
         in_specs = in_specs + (P("pp"),)
         args = args + (wnds,)
-    out_toks, kc, vc = shard_map_compat(
-        fwd, mesh=mesh,
-        in_specs=in_specs,
-        out_specs=(P(), pp_cache_sharding(), pp_cache_sharding()),
-    )(*args)
+    out = shard_map_compat(
+        fwd, mesh=mesh, in_specs=in_specs, out_specs=out_specs)(*args)
+    if kvq:
+        out_toks, kc, vc, ksc, vsc = out
+        new_cache = {"k": kc, "v": vc, "k_scale": ksc, "v_scale": vsc}
+    else:
+        out_toks, kc, vc = out
+        new_cache = {"k": kc, "v": vc}
     # next-window carry (engine overlapped decode pipeline, docs/PERF.md):
     # the final sampled token per slot plus advanced position/counter
     # columns stay ON DEVICE, so an unchanged slot set dispatches the next
     # window with zero host array uploads — same contract as the
     # single-mesh window's (tok_f, pos_f, ctr_f) carry
     nxt = (out_toks[n_steps - 1], positions + n_steps, counters + n_steps)
-    return out_toks, {"k": kc, "v": vc}, nxt
+    return out_toks, new_cache, nxt
 
 
 def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
+                    kvq, has_wnds,
                     embed, layers, final_norm, head,
                     kc, vc, tokens, pos0, page_table, max_pos,
                     min_tokens, counters, ignore_eos, stop_ids,
-                    temperature, top_k, top_p, seeds, wnds=None):
+                    temperature, top_k, top_p, seeds, *extra):
+    ksc = vsc = wnds = None
+    if kvq:
+        ksc, vsc = extra[0], extra[1]
+    if has_wnds:
+        wnds = extra[-1]
     r = jax.lax.axis_index("pp")
     last = pp - 1
     m = pp                      # microbatches == stages (see docstring)
@@ -418,7 +490,7 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
 
     def tick(carry, t):
         (y_prev, w_prev, feed_tok, feed_alive,
-         d_tok, d_alive, d_idx, kc, vc) = carry
+         d_tok, d_alive, d_idx, kc, vc, ksc_c, vsc_c) = carry
         # deliver last tick's sampled tokens into the feed (sentinel M
         # drops; negative would wrap)
         feed_tok = feed_tok.at[d_idx].set(d_tok, mode="drop")
@@ -439,7 +511,8 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
         kv_lens = jnp.clip(pos + 1, 0, mp_mb[i] + 1)
         meta_t = AttnMetadata(positions=pos[:, None], page_table=pt_mb[i],
                               kv_lens=kv_lens, write_idx=write_idx)
-        y, kc, vc = _stage(cfg, tp, x_in, layers, kc, vc, meta_t, wnds)
+        y, kc, vc, ksc_c, vsc_c = _stage(cfg, tp, x_in, layers, kc, vc,
+                                         meta_t, wnds, ksc_c, vsc_c)
         # last stage: greedy-sample this microbatch's token
         xf = rms_norm(y, final_norm, cfg.rms_norm_eps, cfg.norm_plus_one)
         lg = _softcap(jnp.einsum("btd,dv->btv", xf,
@@ -475,16 +548,16 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
         out_tok = jnp.where(emit, sampled, 0)
         out_k = jnp.where(emit, k, n_steps)    # sentinel row drops
         return ((y_next, w_next, feed_tok, feed_alive,
-                 d_tok2, d_alive2, d_idx2, kc, vc),
+                 d_tok2, d_alive2, d_idx2, kc, vc, ksc_c, vsc_c),
                 (out_tok, out_k, jnp.where(emit, i, 0)))
 
     y0 = jnp.zeros((bm, 1, cfg.hidden_size), dt)
     carry0 = (y0, jnp.zeros((bm,), bool), mb(tokens), mb(max_pos >= 0),
               jnp.zeros((bm,), jnp.int32), jnp.zeros((bm,), bool),
-              jnp.asarray(m, jnp.int32), kc, vc)
+              jnp.asarray(m, jnp.int32), kc, vc, ksc, vsc)
     (c_final), (toks_t, k_t, i_t) = jax.lax.scan(
         tick, carry0, jnp.arange(ticks))
-    kc, vc = c_final[-2], c_final[-1]
+    kc, vc, ksc, vsc = c_final[-4], c_final[-3], c_final[-2], c_final[-1]
     # scatter tick outputs into [n_steps, M, bm]; non-emitting ticks carry
     # the k = n_steps sentinel and drop
     out = jnp.zeros((n_steps, m, bm), jnp.int32)
@@ -492,4 +565,6 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
     out = out.reshape(n_steps, s)
     # each (k, slot) was produced once, on the last stage: psum broadcasts
     out = jax.lax.psum(out, "pp")
+    if kvq:
+        return out, kc, vc, ksc, vsc
     return out, kc, vc

@@ -85,6 +85,13 @@ class TransferCostModel:
         self._links: Dict[str, Ewma] = {}
         self._err: Dict[str, Ewma] = {}
         self._inflight: Dict[str, int] = {}
+        # sharded parallel transfer (disagg/remote_transfer.py): a
+        # destination engine whose decode mesh spans multiple hosts is
+        # a GROUP of per-host links ("{engine}/{host}"); estimate()
+        # prices the parallel streams (bytes split per member, wall =
+        # the slowest member) so the router sees multi-host decode
+        # workers as genuinely faster targets
+        self._groups: Dict[str, List[str]] = {}
 
     def observe(self, link: str, nbytes: int, seconds: float) -> None:
         if nbytes <= 0 or seconds < self.min_sample_s:
@@ -145,11 +152,47 @@ class TransferCostModel:
         ew = self._links.get(link)
         return ew is not None and ew.samples > 0
 
+    # -- sharded parallel streams (per-host link groups) ----------------------
+
+    def set_group(self, link: str, members: List[str]) -> None:
+        """Register `link` (a destination engine id) as a group of
+        per-host member links: transfers to it ride N parallel streams,
+        one per (shard, host), so its cost is the parallel composition
+        of the members' — registered by the sender when discovery shows
+        per-host `kv_transfer/{engine}/{host}` endpoints."""
+        if len(members) >= 2:
+            self._groups[link] = list(members)
+        else:
+            self._groups.pop(link, None)
+
+    def group_members(self, link: str) -> Optional[List[str]]:
+        return self._groups.get(link)
+
     def estimate(self, link: str, nbytes: int) -> TransferEstimate:
         """Cost of shipping `nbytes` to `link` now, cold-aware: a
         never-measured link answers at the fleet-median bandwidth with
         cold=True — it can never score as free (bytes always cost
-        time) nor as infinitely penalized (the prior is finite)."""
+        time) nor as infinitely penalized (the prior is finite).
+
+        A GROUP link (multi-host sharded target, set_group) prices the
+        parallel streams: bytes split evenly per member, wall-clock =
+        the SLOWEST member's share time (the min-frontier straggler
+        bound), aggregate bandwidth reported as the sum of member
+        EWMAs; cold only when every member is cold (the measured/
+        cold/median vocabulary of dynalint R16 applies member-wise)."""
+        members = self._groups.get(link)
+        if members:
+            share = max(0, nbytes) / len(members)
+            worst = 0.0
+            agg_bw = 0.0
+            cold = True
+            for m in members:
+                e = self.estimate(m, int(share))
+                worst = max(worst, e.seconds)
+                agg_bw += e.bytes_per_s
+                cold = cold and e.cold
+            return TransferEstimate(link=link, seconds=worst,
+                                    bytes_per_s=agg_bw, cold=cold)
         cold = not self.measured(link)
         bw = max(1.0, self.bandwidth_bytes_per_s(link))
         return TransferEstimate(link=link, seconds=max(0, nbytes) / bw,
@@ -162,7 +205,14 @@ class TransferCostModel:
     def queue_s(self, link: str) -> float:
         """Drain time of the bytes already in flight toward `link` —
         the per-destination transfer-backlog term of the router score.
-        Cold-safe: rides the same fleet-median prior as estimate()."""
+        Cold-safe: rides the same fleet-median prior as estimate().
+        Group links (sharded multi-host targets) answer with the WORST
+        member host's drain time: backlog is tracked per destination
+        host, and the slowest host's queue is what gates a parallel
+        transfer's min frontier."""
+        members = self._groups.get(link)
+        if members:
+            return max((self.queue_s(m) for m in members), default=0.0)
         backlog = self.backlog_bytes(link)
         if backlog <= 0:
             return 0.0
@@ -201,9 +251,17 @@ class TransferCostModel:
         self._links.clear()
         self._err.clear()
         self._inflight.clear()
+        self._groups.clear()
 
 
 TRANSFER_MODEL = TransferCostModel()
+
+
+def _xfer_stream_snapshot() -> Dict[str, Dict[str, int]]:
+    """Per-(shard, host) transfer-stream rows for the rollup summary
+    (runtime/integrity.py XFER_STATS.per_stream)."""
+    from dynamo_tpu.runtime.integrity import XFER_STATS
+    return XFER_STATS.stream_snapshot()
 
 
 def parse_prometheus_text(text: str) -> Dict[str, Dict[str, float]]:
@@ -450,6 +508,11 @@ class FleetRollup:
             "roles": roles,
             "qos": qos,
             "links": self.model.snapshot(),
+            # sharded parallel transfer: per-(shard, host) stream rows
+            # (process-local XFER_STATS dimension — populated on the
+            # in-process bench/test stacks and on any worker co-hosting
+            # the rollup; fleet_top renders frontiers + the straggler)
+            "xfer_streams": _xfer_stream_snapshot(),
         }
 
     def per_role(self) -> Dict[str, dict]:

@@ -30,6 +30,7 @@ Counters live on the process-global ``STATS`` and render on /metrics
 from __future__ import annotations
 
 import dataclasses
+from collections import OrderedDict
 from typing import Dict
 
 import numpy as np
@@ -102,13 +103,48 @@ class KvTransferStats:
     salvaged_pages: int = 0
     stale_chunks: int = 0
     link_timeouts: int = 0
+    # sharded parallel transfer (disagg/remote_transfer.py): sends that
+    # fanned out over N (shard, host) chunk-committed streams
+    parallel_transfers: int = 0
+
+    # per-(shard, host) stream dimension, keyed by the canonical
+    # "{engine}/{host}#{stream}" key (remote_transfer.stream_key):
+    # sender-side unique bytes/pages + chunk-level resumes, receiver-
+    # side last committed frontier. Rendered as labeled gauges
+    # (llm_kv_transfer_stream_*) next to the scalar family; bounded —
+    # a fleet's stream-key population is (engines x hosts x shards).
+    MAX_STREAM_KEYS = 256
+
+    def __post_init__(self):
+        self.per_stream: "OrderedDict[str, Dict[str, int]]" = OrderedDict()
+
+    def note_stream(self, key: str, *, bytes: int = 0, pages: int = 0,
+                    resumes: int = 0, frontier: int = -1) -> None:
+        row = self.per_stream.get(key)
+        if row is None:
+            row = self.per_stream[key] = {
+                "bytes": 0, "pages": 0, "resumes": 0, "frontier": 0}
+            while len(self.per_stream) > self.MAX_STREAM_KEYS:
+                self.per_stream.popitem(last=False)
+        else:
+            self.per_stream.move_to_end(key)
+        row["bytes"] += bytes
+        row["pages"] += pages
+        row["resumes"] += resumes
+        if frontier >= 0:
+            row["frontier"] = frontier
+
+    def stream_snapshot(self) -> Dict[str, Dict[str, int]]:
+        return {k: dict(v) for k, v in self.per_stream.items()}
 
     def snapshot(self) -> Dict[str, int]:
-        return dataclasses.asdict(self)
+        return {f.name: getattr(self, f.name)
+                for f in dataclasses.fields(self)}
 
     def reset(self) -> None:
         for f in dataclasses.fields(self):
             setattr(self, f.name, 0)
+        self.per_stream.clear()
 
 
 XFER_STATS = KvTransferStats()

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 from dynamo_tpu.disagg import (
     DisaggDecodeWorker, DisaggregatedRouter, KvTransferServer, PrefillQueue,
-    RemoteTransferBackend,
+    RemoteTransferBackend, ShardedKvTransferGroup,
 )
 from dynamo_tpu.disagg import PrefillWorker as QueuePrefillWorker
 from dynamo_tpu.engine.config import EngineConfig
@@ -108,8 +108,20 @@ class DecodeWorker:
             worker_id=f"decode-{self.runtime.worker_id}",
             prefill_timeout_s=float(cfg.get("prefill_timeout_s", 120.0)))
         await worker.start()
-        self.kv_server = await KvTransferServer(
-            worker, worker.engine_id).start()
+        # sharded parallel transfer (PERF.md §3f): transfer_hosts > 1
+        # runs per-host endpoints with one chunk-committed stream per
+        # (cache shard, host) — on a real multi-host decode mesh each
+        # host runs its own endpoint so aggregate transfer bandwidth
+        # scales with host count; transfer_streams optionally overrides
+        # the natural shard count (must divide num_kv_heads)
+        hosts = int(cfg.get("transfer_hosts", 1))
+        if hosts > 1:
+            self.kv_server = await ShardedKvTransferGroup(
+                worker, worker.engine_id, hosts=hosts,
+                n_streams=int(cfg.get("transfer_streams", 0))).start()
+        else:
+            self.kv_server = await KvTransferServer(
+                worker, worker.engine_id).start()
         await self.kv_server.register(self.runtime.kv, self.runtime.lease.id)
         await serve_llm_worker(self.runtime, NS, "backend", worker,
                                card=card)

@@ -99,6 +99,11 @@ TRANSFER_STORM_PLAN = {
         {"kind": "drop", "p": 0.12}]},
     "queue.dequeue": {"seed": 353, "specs": [
         {"kind": "delay", "p": 0.5, "delay_s": 0.01}]},
+    # phase E (sharded parallel streams; popped before arm_from_dict —
+    # not a fault site): deterministic per-(shard, host)-stream failures
+    # driven by chunk index, a pure function of these parameters
+    "sharded": {"cut_stream": 1, "cut_chunk": 1,
+                "dead_stream": 1, "dead_from": 2},
 }
 
 # control-plane storm (the scale-harness scenario): watch-stream
@@ -519,28 +524,44 @@ def run_disagg_transfer_storm(plan):
         discovery;
       phase D — the link dies for good after 3 of 4 chunks committed;
         the decode worker must SALVAGE the committed prefix (local
-        re-prefill only past the committed page boundary).
+        re-prefill only past the committed page boundary);
+      phase E — SHARDED PARALLEL STREAMS (ISSUE 15): a second decode
+        worker runs a ShardedKvTransferGroup (2 hosts x 2 shard
+        streams); E1 cuts ONE stream once at the plan's chunk index —
+        only that stream's unacked tail is re-shipped (the sibling
+        stream records zero resumes); E2 kills one stream's link for
+        good while the sibling completes — salvage must charge exactly
+        the MIN-frontier pages (the pages EVERY stream committed).
+        The per-stream failures are a pure function of the plan's
+        "sharded" parameters (chunk-indexed, no randomness), so the
+        committed plan replays bit-identically.
 
     Contract: ZERO dropped streams — every request completes
     token-identical to the aggregated oracle; >= 1 chunk-level resume is
-    recorded; and no request whose transfer was majority-committed is
+    recorded; no request whose transfer was majority-committed is
     ever re-prefilled from token zero (salvage counters prove the
-    committed prefix was reused)."""
+    committed prefix was reused); and the sharded phase's salvage
+    charge equals the min over per-stream frontiers."""
     from dynamo_tpu.disagg import (
         DisaggDecodeWorker, DisaggregatedRouter, KvTransferServer,
         PrefillQueue, PrefillWorker, RemoteTransferBackend,
+        ShardedKvTransferGroup,
     )
     from dynamo_tpu.llm.worker import NativeEngineWorker
     from dynamo_tpu.runtime.integrity import XFER_STATS
 
     # 30-token prompts -> 4 pages -> 4 one-page chunks per transfer
+    # (8-9 feed phase E's sharded-stream legs)
     prompts = {i: [(11 * i + j) % 200 + 3 for j in range(30)]
-               for i in range(8)}
+               for i in range(10)}
     params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
     oracle_engine = make_engine()
     oracle = {i: oracle_engine.generate(p, params, f"o{i}")
               for i, p in prompts.items()}
     r0, s0 = XFER_STATS.resumes, XFER_STATS.salvaged_pages
+    plan = dict(plan)
+    shp = plan.pop("sharded", {"cut_stream": 1, "cut_chunk": 1,
+                               "dead_stream": 1, "dead_from": 2})
 
     async def main():
         faults.REGISTRY.arm_from_dict(plan)
@@ -627,6 +648,87 @@ def run_disagg_transfer_storm(plan):
         faults.REGISTRY.disarm("transfer.link")
         assert decode.salvaged_prefills >= 1, "phase D never salvaged"
 
+        # phase E: sharded parallel streams — straggler/dead SINGLE
+        # stream while its sibling stays healthy. Failures are chunk-
+        # indexed per stream (plan["sharded"]), so the phase is a pure
+        # function of the committed plan.
+        class StreamFault(RemoteTransferBackend):
+            cut_done = 0
+            mode = "cut"    # "cut" = once; "dead" = permanent
+
+            async def _chunk_gate(self, chunk_idx, stream=0):
+                if self.mode == "cut" \
+                        and stream == shp["cut_stream"] \
+                        and chunk_idx == shp["cut_chunk"] \
+                        and not StreamFault.cut_done:
+                    StreamFault.cut_done = 1
+                    raise ConnectionResetError("seeded stream cut")
+                if self.mode == "dead" \
+                        and stream == shp["dead_stream"] \
+                        and chunk_idx >= shp["dead_from"]:
+                    raise ConnectionResetError("stream link dead")
+                await super()._chunk_gate(chunk_idx, stream)
+
+        queue_e = PrefillQueue(plane.messaging, "ns", "tiny-sharded")
+        decode2 = DisaggDecodeWorker(
+            make_engine(), plane.messaging, DisaggregatedRouter(
+                max_local_prefill_length=4, max_prefill_queue_size=32),
+            queue_e, worker_id="dec-1", prefill_timeout_s=90.0)
+        group = await ShardedKvTransferGroup(
+            decode2, "dec-1", hosts=2, n_streams=2).start()
+        await group.register(plane.kv)
+        sh_tx = StreamFault(plane.kv, chunk_pages=1, window_chunks=1,
+                            link_retries=1)
+        prefill_e = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue_e, sh_tx,
+            plane.messaging, dequeue_timeout_s=0.1)
+        await decode2.start()
+        await prefill_e.start()
+        XFER_STATS.per_stream.clear()
+
+        async def run_request_e(i):
+            ctx = Context(f"r{i}")
+            toks = []
+            async for frame in decode2.generate(
+                    pre_request(f"r{i}", prompts[i], 4), ctx):
+                assert frame.get("finish_reason") not in ("error",), frame
+                toks.extend(frame.get("token_ids", ()))
+            return i, toks
+
+        # E1: one cut on one stream — resume ONLY that stream's tail
+        i, toks = await asyncio.wait_for(run_request_e(8), 180)
+        assert toks == oracle[i], (i, toks, oracle[i])
+        snap = XFER_STATS.stream_snapshot()
+        cut_key = f"dec-1/h{shp['cut_stream'] % 2}#{shp['cut_stream']}"
+        sib_key = f"dec-1/h{(1 - shp['cut_stream']) % 2}" \
+                  f"#{1 - shp['cut_stream']}"
+        assert snap[cut_key]["resumes"] == 1, snap
+        assert snap[sib_key]["resumes"] == 0, \
+            "a healthy sibling stream re-shipped chunks"
+        # unique per-stream accounting: 4 pages crossed each stream once
+        assert snap[cut_key]["pages"] == 4 and snap[sib_key]["pages"] == 4
+
+        # E2: one stream's link dies for good (sibling completes) —
+        # salvage charges exactly the MIN over per-stream frontiers
+        StreamFault.mode = "dead"
+        sp0 = XFER_STATS.salvaged_pages
+        i, toks = await asyncio.wait_for(run_request_e(9), 180)
+        assert toks == oracle[i], (i, toks, oracle[i])
+        assert decode2.salvaged_prefills == 1, "phase E2 never salvaged"
+        assert XFER_STATS.salvaged_pages - sp0 == shp["dead_from"], \
+            "salvage charge != min-frontier pages"
+        assert decode2.majority_committed_full_reprefills == 0
+        sharded_summary = {
+            "stream_cut_resumes": snap[cut_key]["resumes"],
+            "sibling_resumes": snap[sib_key]["resumes"],
+            "salvaged_pages_e2": XFER_STATS.salvaged_pages - sp0,
+            "parallel_transfers": XFER_STATS.parallel_transfers,
+        }
+        await prefill_e.stop()
+        await decode2.stop()
+        await group.stop()
+        await sh_tx.close()
+
         # the storm-wide contracts
         assert decode.majority_committed_full_reprefills == 0, \
             "a majority-committed transfer was re-prefilled from zero"
@@ -637,6 +739,7 @@ def run_disagg_transfer_storm(plan):
             "redeliveries": plane.messaging.redeliveries,
             "resumes": XFER_STATS.resumes - r0,
             "salvaged_pages": XFER_STATS.salvaged_pages - s0,
+            "sharded": sharded_summary,
         }
         await survivor.stop()
         await decode.stop()

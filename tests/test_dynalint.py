@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R19), the
+- one positive AND one negative fixture per AST rule (R1-R20), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -1300,6 +1300,67 @@ def test_r19_quiet_on_referenced_and_annotated_sites():
     found = lint_source(textwrap.dedent(annotated),
                         "dynamo_tpu/disagg/fixture.py")
     assert "R19" not in rules(found)
+
+
+# -- R20: min-frontier aggregation contract ------------------------------------
+
+R20_BAD = """
+    def decide_fate(worker, rid, epoch):
+        # trusts whatever one endpoint answers, silently
+        pages = worker.server.committed_frontier(rid, epoch)
+        if pages:
+            worker.engine.salvage_remote(rid, pages)
+        return pages
+
+
+    def arm(engine, rid, first, needed, srv, epoch):
+        engine.preactivate_remote(
+            rid, first, needed,
+            lambda: srv.stream_frontier(rid, epoch, 0))
+"""
+
+
+def test_r20_flags_unreferenced_frontier_consumers():
+    found = lint_source(textwrap.dedent(R20_BAD),
+                        "dynamo_tpu/disagg/fixture.py")
+    r20 = [x for x in found if x.rule == "R20"]
+    # committed_frontier + salvage_remote + preactivate_remote +
+    # stream_frontier
+    assert len(r20) == 4
+    found = lint_source(textwrap.dedent(R20_BAD), "tools/fixture.py")
+    assert "R20" in rules(found)
+
+
+def test_r20_quiet_outside_scope_and_in_tests():
+    found = lint_source(textwrap.dedent(R20_BAD), "examples/fixture.py")
+    assert "R20" not in rules(found)
+    found = lint_source(textwrap.dedent(R20_BAD), "tests/fixture.py")
+    assert "R20" not in rules(found)
+
+
+def test_r20_quiet_on_referenced_and_annotated_sites():
+    handled = """
+        def decide_fate(worker, rid, epoch):
+            # frontier = MIN over per-stream frontiers (the
+            # ShardedKvTransferGroup aggregation): salvage only keeps
+            # pages every shard stream committed
+            pages = worker.server.committed_frontier(rid, epoch)
+            if pages:
+                worker.engine.salvage_remote(rid, pages)
+            return pages
+    """
+    found = lint_source(textwrap.dedent(handled),
+                        "dynamo_tpu/disagg/fixture.py")
+    assert "R20" not in rules(found)
+    annotated = """
+        def resume_point(srv, rid, epoch, sid):
+            # dynalint: frontier-ok=per-stream resume handshake; fate
+            # decisions still go through the min aggregation
+            return srv.stream_frontier(rid, epoch, sid)
+    """
+    found = lint_source(textwrap.dedent(annotated),
+                        "dynamo_tpu/disagg/fixture.py")
+    assert "R20" not in rules(found)
 
 
 def test_r19_live_on_preemption_call_sites():

@@ -178,20 +178,60 @@ def test_metrics_carry_kv_repr_gauges(eng_q):
     assert w.kv_page_bytes == m.kv_page_bytes
 
 
-def test_kv_quant_rejected_on_pp_mesh():
+def test_int8_on_pp_mesh_identity_and_parity():
+    """ISSUE 15 satellite (ROADMAP item 1b slice): kv_quant composes
+    with pp — the GPipe stage scan threads the int8 scale-stack shards
+    (models/pp._stage: write_kv_pages_quant at capture, dequant at the
+    paged gather). Two bars in one engine set (tier-1 budget):
+
+    - IDENTITY: the pp=2 int8 engine is token-identical to the
+      single-device int8 engine, greedy AND seeded-sampled (same
+      codec, different mesh — quantization changes values, never
+      mesh-dependent behavior; the pp=2 x tp=2 interplay of
+      vocab-sharded sampling with sharded caches is already pinned by
+      test_pp's bf16 suite, and the tp scale-shard split by
+      test_int8_on_tp_mesh_matches_single_device);
+    - PARITY vs bf16-pp on the SAME mesh through the committed parity
+      bar (bench.KVQ_MATCH_MIN greedy-match floor): quantization drift
+      on a pp mesh is no worse than the single-mesh gate bounds."""
     import jax
     if len(jax.devices()) < 2:
         pytest.skip("needs 2 virtual devices")
+    from bench import KVQ_MATCH_MIN
     from dynamo_tpu.parallel.mesh import make_mesh
+    kw = dict(page_size=8, num_pages=64, max_slots=2, max_prefill_chunk=16,
+              prefill_buckets=(8, 16), max_model_len=128, decode_steps=4)
+    cfg = ModelConfig(dtype="float32", num_layers=4, max_model_len=128)
+    greedy = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    sampled = SamplingParams(max_tokens=6, temperature=0.8, top_k=40,
+                             top_p=0.95, seed=1234, ignore_eos=True)
+    prompt = list(range(3, 15))
+    prompt2 = list(range(40, 52))
+    one = NativeEngine(cfg, EngineConfig(kv_quant="int8", **kw), seed=0)
+    expect_g = one.generate(prompt, greedy, "og")
+    expect_s = one.generate(prompt2, sampled, "os")
     mesh = make_mesh(pp=2, devices=jax.devices()[:2])
-    with pytest.raises(ValueError, match="pp"):
-        NativeEngine(ModelConfig(dtype="float32", num_layers=4,
-                                 max_model_len=128, kv_quant="int8"),
-                     EngineConfig(page_size=8, num_pages=64, max_slots=2,
-                                  max_prefill_chunk=16,
-                                  prefill_buckets=(8, 16),
-                                  max_model_len=128),
-                     mesh=mesh, seed=0)
+    q = NativeEngine(cfg, EngineConfig(kv_quant="int8", **kw), mesh=mesh,
+                     seed=0)
+    assert q.generate(prompt, greedy, "pg") == expect_g, \
+        "greedy int8 pp=2 diverged from int8 single-device"
+    assert q.generate(prompt2, sampled, "ps") == expect_s, \
+        "sampled int8 pp=2 diverged from int8 single-device"
+    # parity vs the unquantized pp twin (same mesh, same prompts)
+    bf = NativeEngine(cfg, EngineConfig(**kw),
+                      mesh=make_mesh(pp=2, devices=jax.devices()[:2]),
+                      seed=0)
+    p8 = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    prompts = [[(7 * i + j) % 200 + 3 for j in range(12)]
+               for i in range(3)]
+    match = total = 0
+    for i, pr in enumerate(prompts):
+        a = bf.generate(pr, p8, f"b{i}")
+        b = q.generate(pr, p8, f"q{i}")
+        match += sum(1 for x, y in zip(a, b) if x == y)
+        total += len(a)
+    assert total > 0 and match / total >= KVQ_MATCH_MIN, \
+        f"pp int8 greedy match {match}/{total} below {KVQ_MATCH_MIN}"
 
 
 def test_int8_on_tp_mesh_matches_single_device():

@@ -226,7 +226,8 @@ def test_dispatch_retry_exhaustion_yields_error_frame():
         metrics = ReliabilityMetrics()
         rel = ReliableClient(
             client, ReliabilityPolicy(max_attempts=3, backoff_base_s=0.01,
-                                      dispatch_timeout_s=0.5),
+                                      dispatch_timeout_s=0.5,
+                                      instance_wait_s=0.2),
             metrics=metrics)
         frames = []
         async for frame in rel.generate(

@@ -1,0 +1,701 @@
+"""Sharded parallel KV transfer (ISSUE 15): per-(shard, host)
+chunk-committed streams for cross-mesh disagg.
+
+The matrix the acceptance criteria name, per stream:
+
+- e2e token identity (greedy + seeded-sampled) through N parallel
+  streams, on single-device (head-split layout) AND tp=2 decode meshes;
+- seeded cut of ONE stream at the first/middle/last chunk: only that
+  stream's unacked tail is re-shipped, siblings never resend;
+- sender death mid-transfer: the replacement sender's handshakes skip
+  each stream's OWN committed frontier;
+- a permanently dead single stream (others healthy): salvage charges
+  exactly the MIN-frontier pages;
+- stale-epoch fencing per stream after release+realloc;
+- early decode gates on the min over per-stream frontiers (a straggler
+  stream holds the gate);
+- int8 kv_quant slices (values + scale rows sharded by the same plan);
+- TransferCostModel group pricing (bytes split per shard, aggregate
+  goodput = sum of per-link EWMAs, backlog per destination host).
+
+Engines reuse the test_remote_transfer geometry for jax-cache hits.
+"""
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg import (
+    DisaggDecodeWorker, DisaggregatedRouter, PrefillQueue, PrefillWorker,
+    RemoteTransferBackend, ShardedKvTransferGroup,
+)
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+from dynamo_tpu.llm.worker import NativeEngineWorker
+from dynamo_tpu.parallel.mesh import kv_shard_layout, make_mesh
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.integrity import XFER_STATS
+from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+CFG = ModelConfig(dtype="float32", max_model_len=512)
+PAGE = 8
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.REGISTRY.disarm()
+    faults.REGISTRY.reset_counters()
+
+
+def make_engine(mesh=None, kv_quant=""):
+    return NativeEngine(CFG, EngineConfig(
+        page_size=PAGE, num_pages=64, max_slots=4, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=512,
+        kv_quant=kv_quant), mesh=mesh, seed=0)
+
+
+# ONE oracle engine per module (tier-1 budget): oracle generation is
+# deterministic and prefix reuse is exact, so sharing it across tests
+# only warms its cache; expected outputs memoized per (prompt, params).
+_ORACLE = {}
+_EXPECT = {}
+
+
+def expected(prompt, params, kv_quant=""):
+    key = (tuple(prompt), params.max_tokens, params.temperature,
+           params.top_k, params.top_p, params.seed, kv_quant)
+    if key not in _EXPECT:
+        eng = _ORACLE.get(kv_quant)
+        if eng is None:
+            eng = _ORACLE[kv_quant] = make_engine(kv_quant=kv_quant)
+        _EXPECT[key] = eng.generate(prompt, params,
+                                    f"o{len(_EXPECT)}")
+    return _EXPECT[key]
+
+
+def pre_request(rid, prompt, max_tokens=6, sampled=False):
+    kw = {}
+    if sampled:
+        kw = dict(sampling={"temperature": 0.8, "top_k": 40,
+                            "top_p": 0.95, "seed": 1234})
+    return PreprocessedRequest(
+        request_id=rid, token_ids=prompt,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True), **kw)
+
+
+async def _drive(worker_gen):
+    toks, reason = [], None
+    async for frame in worker_gen:
+        toks.extend(frame.get("token_ids", ()))
+        if frame.get("finish_reason") not in (None, "prefill_done"):
+            reason = frame["finish_reason"]
+    return toks, reason
+
+
+async def _build_sharded_stack(plane, hosts=2, n_streams=2,
+                               decode_mesh=None, prefill_mesh=None,
+                               chunk_pages=1, kv_quant="",
+                               transfer_cls=RemoteTransferBackend,
+                               transfer_kw=None, early_decode=True):
+    """Disagg stack over the sharded parallel transfer plane: a per-host
+    endpoint group on the decode side, one stream per (shard, host)."""
+    queue = PrefillQueue(plane.messaging, "ns", "tiny")
+    router = DisaggregatedRouter(max_local_prefill_length=4,
+                                 max_prefill_queue_size=8, model="tiny")
+    decode = DisaggDecodeWorker(
+        make_engine(decode_mesh, kv_quant), plane.messaging, router, queue,
+        worker_id="dec-0", prefill_timeout_s=60.0,
+        early_decode=early_decode)
+    group = await ShardedKvTransferGroup(
+        decode, "dec-0", hosts=hosts, n_streams=n_streams).start()
+    await group.register(plane.kv)
+    transfer = transfer_cls(plane.kv, chunk_pages=chunk_pages,
+                            window_chunks=1, **(transfer_kw or {}))
+    prefill = PrefillWorker(
+        NativeEngineWorker(make_engine(prefill_mesh, kv_quant)), queue,
+        transfer, plane.messaging, dequeue_timeout_s=0.1)
+    return decode, prefill, group, transfer
+
+
+async def _teardown(decode, prefill, group, transfer):
+    await prefill.stop()
+    await decode.stop()
+    await transfer.close()
+    await group.stop()
+
+
+def test_sharded_e2e_token_identical_greedy_and_sampled():
+    """2 hosts x 2 shard streams: greedy AND seeded-sampled outputs are
+    token-identical to the aggregated oracle; both per-host endpoints
+    inject their slices; the transfer is counted as parallel."""
+    prompt = list(range(100, 120))          # 3 pages -> 3 chunks/stream
+    prompt2 = list(range(130, 150))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = expected(prompt, params)
+    sp = SamplingParams(max_tokens=6, temperature=0.8, top_k=40,
+                        top_p=0.95, seed=1234, ignore_eos=True)
+    expect2 = expected(prompt2, sp)
+    p0 = XFER_STATS.parallel_transfers
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, group, transfer = await _build_sharded_stack(plane)
+        assert decode.kv_transfer_server is group
+        assert group.n_streams == 2 and len(group.servers) == 2
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, reason = await asyncio.wait_for(_drive(
+                decode.generate(pre_request("r1", prompt).model_dump(
+                    exclude_none=True), Context("r1"))), 60)
+            toks2, reason2 = await asyncio.wait_for(_drive(
+                decode.generate(
+                    pre_request("r2", prompt2, sampled=True).model_dump(
+                        exclude_none=True), Context("r2"))), 60)
+        finally:
+            await _teardown(decode, prefill, group, transfer)
+        per_server_rx = [srv.received_pages for srv in group.servers]
+        return toks, reason, toks2, reason2, per_server_rx
+
+    toks, reason, toks2, reason2, per_server_rx = asyncio.run(main())
+    assert reason == "length" and toks == expect
+    assert reason2 == "length" and toks2 == expect2
+    # each endpoint injected its own stream's slice of every page
+    assert all(rx >= 3 for rx in per_server_rx), per_server_rx
+    assert XFER_STATS.parallel_transfers - p0 == 2
+
+
+def test_sharded_e2e_on_tp2_decode_mesh():
+    """The shard plan aligned with a REAL tp=2 decode mesh: slices land
+    via the per-shard scatter, tokens match the single-device oracle
+    (the mesh identity the pp/tp suites already pin, now through the
+    sharded transfer plane)."""
+    devs = jax.devices()
+    assert len(devs) >= 2
+    prompt = list(range(60, 80))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = expected(prompt, params)
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, group, transfer = await _build_sharded_stack(
+            plane, hosts=2, n_streams=0,   # natural layout: tp shards
+            decode_mesh=make_mesh(tp=2, devices=devs[:2]))
+        assert group.n_streams == 2
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, reason = await asyncio.wait_for(_drive(
+                decode.generate(pre_request("t1", prompt).model_dump(
+                    exclude_none=True), Context("t1"))), 60)
+        finally:
+            await _teardown(decode, prefill, group, transfer)
+        return toks, reason
+
+    toks, reason = asyncio.run(main())
+    assert reason == "length" and toks == expect
+
+
+def test_sharded_kv_quant_int8_e2e():
+    """int8 engines both sides: the shard plan slices the scale rows
+    with the values (shared leading axes), verify-on-fetch covers the
+    quantized slice bytes, tokens match the int8 oracle."""
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = expected(prompt, params, kv_quant="int8")
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, group, transfer = await _build_sharded_stack(
+            plane, kv_quant="int8")
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, reason = await asyncio.wait_for(_drive(
+                decode.generate(pre_request("rq", prompt).model_dump(
+                    exclude_none=True), Context("rq"))), 60)
+        finally:
+            await _teardown(decode, prefill, group, transfer)
+        return toks, reason
+
+    toks, reason = asyncio.run(main())
+    assert reason == "length" and toks == expect
+
+
+class CutOneStream(RemoteTransferBackend):
+    """Deterministically cut ONE stream at one chunk index, once."""
+
+    cut_stream = 1
+    cut_chunk = 0
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.cuts = 0
+
+    async def _chunk_gate(self, chunk_idx, stream=0):
+        if (stream == self.cut_stream and chunk_idx == self.cut_chunk
+                and self.cuts == 0):
+            self.cuts += 1
+            raise ConnectionResetError("seeded single-stream cut")
+        await super()._chunk_gate(chunk_idx, stream)
+
+
+@pytest.mark.parametrize("cut_chunk", [0, 1, 2])
+def test_single_stream_cut_resumes_only_that_stream(cut_chunk):
+    """A cut on stream 1 at the first/middle/last chunk: the stream
+    reconnects, learns ITS OWN frontier, and re-ships only its unacked
+    tail — stream 0 never re-sends a chunk, and the output is
+    token-identical."""
+    prompt = list(range(100, 120))          # 3 pages
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = expected(prompt, params)
+    XFER_STATS.per_stream.clear()
+    r0 = XFER_STATS.resumes
+
+    async def main():
+        plane = MemoryPlane()
+        CutOneStream.cut_chunk = cut_chunk
+        decode, prefill, group, transfer = await _build_sharded_stack(
+            plane, transfer_cls=CutOneStream)
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, reason = await asyncio.wait_for(_drive(
+                decode.generate(pre_request("rc", prompt).model_dump(
+                    exclude_none=True), Context("rc"))), 60)
+        finally:
+            await _teardown(decode, prefill, group, transfer)
+        return toks, reason, transfer.cuts
+
+    toks, reason, cuts = asyncio.run(main())
+    assert reason == "length" and toks == expect and cuts == 1
+    snap = XFER_STATS.stream_snapshot()
+    s0 = snap["dec-0/h0#0"]
+    s1 = snap["dec-0/h1#1"]
+    # unique accounting: every page-slice crossed each stream exactly once
+    assert s0["pages"] == 3 and s1["pages"] == 3
+    assert s0["resumes"] == 0
+    if cut_chunk > 0:
+        # the cut stream resumed from its OWN nonzero frontier
+        assert s1["resumes"] == 1
+        assert XFER_STATS.resumes - r0 == 1
+    assert s0["frontier"] == 3 and s1["frontier"] == 3
+
+
+class StallStream(RemoteTransferBackend):
+    """Stream `stall_stream` wedges forever at chunk >= `stall_chunk`:
+    the worker driving it dies holding a part-committed transfer while
+    its sibling stream completes."""
+
+    stall_stream = 1
+    stall_chunk = 2
+
+    async def _chunk_gate(self, chunk_idx, stream=0):
+        if stream == self.stall_stream and chunk_idx >= self.stall_chunk:
+            await asyncio.Event().wait()
+        await super()._chunk_gate(chunk_idx, stream)
+
+
+def test_sender_death_replacement_resumes_each_stream_frontier():
+    """Sender dies with stream 0 complete and stream 1 stalled at chunk
+    2 of 5: the re-leased replacement opens BOTH streams, stream 0's
+    handshake skips everything, stream 1 ships only its tail."""
+    prompt = list(range(50, 90))            # 5 pages
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = expected(prompt, params)
+    XFER_STATS.per_stream.clear()
+    r0 = XFER_STATS.resumes
+
+    async def main():
+        plane = MemoryPlane()
+        decode, doomed_pf, group, doomed_tx = await _build_sharded_stack(
+            plane, transfer_cls=StallStream)
+        doomed_pf.lease_s = 0.5
+        surv_tx = RemoteTransferBackend(plane.kv, chunk_pages=1,
+                                        window_chunks=1)
+        survivor = PrefillWorker(
+            NativeEngineWorker(make_engine()), doomed_pf.queue,
+            surv_tx, plane.messaging, dequeue_timeout_s=0.1, lease_s=10.0)
+        await decode.start()
+        await doomed_pf.start()
+        task = asyncio.create_task(_drive(
+            decode.generate(pre_request("rd", prompt).model_dump(
+                exclude_none=True), Context("rd"))))
+        # wait until stream 0 commits everything and stream 1 stalls
+        deadline = asyncio.get_event_loop().time() + 30
+
+        def _epoch(dec):
+            seq = dec.engine.scheduler.remote.get("rd")
+            return seq.epoch if seq is not None else 0
+
+        def stalled():
+            f = group.stream_frontiers("rd", _epoch(decode))
+            return f.get("dec-0/h0#0", 0) >= 5 \
+                and f.get("dec-0/h1#1", 0) >= 2
+
+        while not stalled():
+            assert asyncio.get_event_loop().time() < deadline, \
+                group.stream_frontiers("rd", _epoch(decode))
+            await asyncio.sleep(0.02)
+        await doomed_pf.stop()
+        await survivor.start()
+        toks, reason = await asyncio.wait_for(task, 120)
+        redelivered = plane.messaging.redeliveries
+        survivor_sent = surv_tx.sent_pages
+        await survivor.stop()
+        await decode.stop()
+        await group.stop()
+        await surv_tx.close()
+        return toks, reason, redelivered, survivor_sent
+
+    toks, reason, redelivered, survivor_sent = asyncio.run(main())
+    assert reason == "length" and toks == expect
+    assert redelivered >= 1
+    # the replacement shipped ONLY stream 1's tail (3 page-slices of 5;
+    # stream 0's handshake skipped all 5) — per-stream frontiers, not
+    # one shared frontier
+    assert survivor_sent == 3, survivor_sent
+    assert XFER_STATS.resumes - r0 >= 1
+
+
+class DeadStream(RemoteTransferBackend):
+    """Stream `dead_stream` fails permanently from chunk `dead_from`."""
+
+    dead_stream = 1
+    dead_from = 2
+
+    async def _chunk_gate(self, chunk_idx, stream=0):
+        if stream == self.dead_stream and chunk_idx >= self.dead_from:
+            raise ConnectionResetError("stream link permanently dead")
+        await super()._chunk_gate(chunk_idx, stream)
+
+
+def test_dead_single_stream_salvages_min_frontier_pages():
+    """Stream 1's link dies for good after committing 2 of 5 chunks
+    while stream 0 completes: salvage must charge exactly the MIN
+    frontier (2 pages) — the pages every stream committed — and
+    re-prefill the rest; token-identical; the sibling stream is never
+    the unit that decides (dynalint R20's aggregation contract)."""
+    prompt = list(range(50, 90))            # 5 pages
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = expected(prompt, params)
+    s0 = XFER_STATS.salvaged_pages
+
+    async def main():
+        plane = MemoryPlane()
+        decode, prefill, group, transfer = await _build_sharded_stack(
+            plane, transfer_cls=DeadStream,
+            transfer_kw=dict(link_retries=1))
+        await decode.start()
+        await prefill.start()
+        try:
+            toks, reason = await asyncio.wait_for(_drive(
+                decode.generate(pre_request("rs", prompt).model_dump(
+                    exclude_none=True), Context("rs"))), 120)
+        finally:
+            await _teardown(decode, prefill, group, transfer)
+        return (toks, reason, decode.salvaged_prefills,
+                decode.full_reprefills,
+                decode.majority_committed_full_reprefills)
+
+    toks, reason, salvaged, full, majority_full = asyncio.run(main())
+    assert reason == "length" and toks == expect
+    assert salvaged == 1 and full == 0 and majority_full == 0
+    # min over per-stream frontiers: stream 0 committed 5, stream 1
+    # committed 2 -> salvage keeps exactly 2 pages
+    assert XFER_STATS.salvaged_pages - s0 == 2
+
+
+def test_stale_epoch_fenced_per_stream_after_realloc():
+    """Release + re-allocate the same request id: a sender holding the
+    OLD epoch is fenced on EVERY stream — no slice lands — while the
+    new-epoch sender streams normally."""
+    prompt = list(range(100, 120))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+
+    async def main():
+        plane = MemoryPlane()
+        decode = NativeEngineWorker(make_engine())
+        await decode.start()
+        group = await ShardedKvTransferGroup(
+            decode, "dec-0", hosts=2, n_streams=2).start()
+        await group.register(plane.kv)
+        transfer = RemoteTransferBackend(plane.kv, chunk_pages=1)
+        prefill_eng = make_engine()
+        st0 = XFER_STATS.stale_chunks
+        try:
+            alloc1 = await decode.submit(
+                lambda eng: eng.allocate_remote(
+                    EngineRequest("race", prompt, params)))
+            prefill_eng.add_request(
+                EngineRequest("race", prompt, params, prefill_only=True))
+            while prefill_eng.has_work():
+                prefill_eng.step()
+            pages = prefill_eng.extract_pages(
+                prefill_eng.scheduler.parked["race"].pages)
+            await decode.submit(lambda eng: eng.release_remote("race"))
+            alloc2 = await decode.submit(
+                lambda eng: eng.allocate_remote(
+                    EngineRequest("race", prompt, params)))
+            assert alloc2.alloc_epoch > alloc1.alloc_epoch > 0
+            with pytest.raises(RuntimeError, match="[Ss]tale"):
+                await transfer.send_pages(
+                    "dec-0", "race", alloc1.page_ids,
+                    pages["k"], pages["v"],
+                    alloc_epoch=alloc1.alloc_epoch)
+            assert XFER_STATS.stale_chunks - st0 >= 1
+            assert group.received_pages == 0
+            # min-frontier sees nothing committed for the live epoch
+            assert group.committed_frontier("race",
+                                            alloc2.alloc_epoch) == 0
+            await transfer.send_pages(
+                "dec-0", "race", alloc2.page_ids,
+                pages["k"], pages["v"], alloc_epoch=alloc2.alloc_epoch)
+            assert group.committed_frontier(
+                "race", alloc2.alloc_epoch) == len(alloc2.page_ids)
+        finally:
+            await transfer.close()
+            await group.stop()
+            await decode.stop()
+
+    asyncio.run(main())
+
+
+class SlowLastChunk(RemoteTransferBackend):
+    """Stream 1 delays its FINAL chunk: the early-decode gate must hold
+    on the min frontier until the straggler lands."""
+
+    hold = None     # asyncio.Event set by the test to release the chunk
+    total_chunks = 3
+
+    async def _chunk_gate(self, chunk_idx, stream=0):
+        if stream == 1 and chunk_idx == self.total_chunks - 1 \
+                and self.hold is not None:
+            await self.hold.wait()
+        await super()._chunk_gate(chunk_idx, stream)
+
+
+def test_early_decode_gate_waits_for_straggler_stream():
+    """Early-decode overlap over sharded streams: the first token is
+    emitted while BOTH streams are still in flight, but decode
+    activation waits for the min frontier — a straggler stream holding
+    one slice of the last page holds the gate; once it lands the gate
+    opens and the output is token-identical."""
+    prompt = list(range(100, 120))          # 3 pages
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    expect = expected(prompt, params)
+
+    async def main():
+        plane = MemoryPlane()
+        SlowLastChunk.hold = asyncio.Event()
+        decode, prefill, group, transfer = await _build_sharded_stack(
+            plane, transfer_cls=SlowLastChunk)
+        await decode.start()
+        await prefill.start()
+        try:
+            frames = []
+            gen = decode.generate(pre_request("ro", prompt).model_dump(
+                exclude_none=True), Context("ro"))
+            # first frame: the early-emitted first token, before the
+            # straggler chunk has landed
+            first = await asyncio.wait_for(gen.__anext__(), 60)
+            frames.append(first)
+            assert first.get("token_ids"), first
+            assert decode.early_first_emits == 1
+            # pull the next frame concurrently so the generator arms
+            # the gate, then verify the straggler holds it
+            nxt = asyncio.create_task(gen.__anext__())
+            sch = decode.engine.scheduler
+            deadline = asyncio.get_event_loop().time() + 30
+            while "ro" not in sch.overlap_gates and not nxt.done():
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            assert not nxt.done(), "decode frame arrived while the " \
+                "straggler stream still held a slice of the last page"
+            seq = sch.remote.get("ro")
+            assert seq is not None
+            # wait for the healthy stream to finish and the straggler
+            # to park one chunk short: min over per-stream frontiers ->
+            # the request-wide frontier is 2 of 3 and the gate holds
+            def stream_state():
+                return group.stream_frontiers("ro", seq.epoch)
+            while not (stream_state().get("dec-0/h0#0", 0) == 3
+                       and stream_state().get("dec-0/h1#1", 0) == 2):
+                assert asyncio.get_event_loop().time() < deadline, \
+                    stream_state()
+                assert not nxt.done()
+                await asyncio.sleep(0.01)
+            assert group.committed_frontier("ro", seq.epoch) == 2
+            assert not nxt.done(), "decode started below the min frontier"
+            gated = await decode.submit(
+                lambda eng: eng.scheduler.poll_overlap_gates())
+            assert gated == 0, \
+                "gate opened before the straggler stream committed"
+            SlowLastChunk.hold.set()
+            frames.append(await asyncio.wait_for(nxt, 60))
+            async for frame in gen:
+                frames.append(frame)
+        finally:
+            await _teardown(decode, prefill, group, transfer)
+        toks = [t for f in frames for t in f.get("token_ids", ())]
+        reasons = [f.get("finish_reason") for f in frames
+                   if f.get("finish_reason")]
+        return toks, reasons, decode.engine.scheduler.overlap_activations
+
+    toks, reasons, activations = asyncio.run(main())
+    assert toks == expect and reasons == ["length"]
+    assert activations == 1
+
+
+# -- units: layout, plan, frontier aggregation, cost model ---------------------
+
+def test_kv_shard_layout_shapes():
+    assert kv_shard_layout(4, 4, tp=2) == [((1, 0, 2),), ((1, 2, 2),)]
+    assert kv_shard_layout(4, 4, tp=1) == [((1, 0, 4),)]
+    assert kv_shard_layout(4, 4, tp=2, pp=2) == [
+        ((0, 0, 2), (1, 0, 2)), ((0, 0, 2), (1, 2, 2)),
+        ((0, 2, 2), (1, 0, 2)), ((0, 2, 2), (1, 2, 2))]
+    assert kv_shard_layout(2, 2, n_streams=2) == [((1, 0, 1),),
+                                                  ((1, 1, 1),)]
+    with pytest.raises(ValueError, match="divide"):
+        kv_shard_layout(2, 2, n_streams=3)
+    with pytest.raises(ValueError, match="pp"):
+        kv_shard_layout(4, 4, pp=2, n_streams=2)
+
+
+def test_group_frontier_is_min_over_streams():
+    """Unit: the group facade answers min(over endpoints' min(over
+    streams)) — the single number salvage/overlap/resume consume."""
+    from dynamo_tpu.disagg.remote_transfer import KvTransferServer
+
+    class W:     # bare worker stand-in
+        pass
+
+    w = W()
+    g = object.__new__(ShardedKvTransferGroup)
+    g.worker, g.engine_id, g.n_streams = w, "e", 3
+    s0 = KvTransferServer(w, "e", host_label="h0",
+                          streams={0: ((1, 0, 1),), 2: ((1, 2, 1),)},
+                          attach=False)
+    s1 = KvTransferServer(w, "e", host_label="h1",
+                          streams={1: ((1, 1, 1),)}, attach=False)
+    g.servers = [s0, s1]
+    assert g.committed_frontier("r", 7) == 0
+    s0._session("r", 7, total_pages=5, stream=0).committed_pages = 5
+    s1._session("r", 7, total_pages=5, stream=1).committed_pages = 3
+    assert g.committed_frontier("r", 7) == 0   # stream 2 never opened
+    s0._session("r", 7, total_pages=5, stream=2).committed_pages = 4
+    assert g.committed_frontier("r", 7) == 3   # min(5, 3, 4)
+    assert g.stream_frontiers("r", 7) == {
+        "e/h0#0": 5, "e/h1#1": 3, "e/h0#2": 4}
+    # a different epoch sees nothing
+    assert g.committed_frontier("r", 8) == 0
+    g.forget("r")
+    assert g.committed_frontier("r", 7) == 0
+
+
+def test_cost_model_prices_parallel_stream_groups():
+    """set_group: bytes split per member, wall = slowest member share,
+    aggregate bandwidth = sum of member EWMAs, backlog per destination
+    host, cold only when every member is cold."""
+    from dynamo_tpu.observability.fleet import TransferCostModel
+    m = TransferCostModel()
+    m.set_group("eng", ["eng/h0", "eng/h1"])
+    # both cold: median prior per member, still cold
+    est = m.estimate("eng", 1 << 20)
+    assert est.cold
+    m.observe("eng/h0", 100 * 1024 * 1024, 1.0)   # 100 MiB/s
+    m.observe("eng/h1", 50 * 1024 * 1024, 1.0)    # 50 MiB/s (straggler)
+    est = m.estimate("eng", 100 * 1024 * 1024)
+    assert not est.cold
+    # 50 MiB share over the 50 MiB/s member gates the wall clock
+    assert est.seconds == pytest.approx(1.0, rel=0.05)
+    assert est.bytes_per_s == pytest.approx(150 * 1024 * 1024, rel=0.05)
+    # single-link estimate for comparison: the group is ~2x faster
+    m2 = TransferCostModel()
+    m2.observe("solo", 50 * 1024 * 1024, 1.0)
+    assert m2.estimate("solo", 100 * 1024 * 1024).seconds \
+        == pytest.approx(2.0, rel=0.05)
+    # backlog per destination host: queue_s = worst member drain
+    m.note_inflight("eng/h1", 50 * 1024 * 1024)
+    assert m.queue_s("eng") == pytest.approx(1.0, rel=0.05)
+    m.note_done("eng/h1", 50 * 1024 * 1024)
+    assert m.queue_s("eng") == 0.0
+    # degenerate groups dissolve
+    m.set_group("eng", ["eng/h0"])
+    assert m.group_members("eng") is None
+
+
+def test_trace_explain_stream_table_and_fleet_top_straggler():
+    """Satellite surfaces: trace_explain --summary tabulates per-stream
+    totals + the min-frontier stall naming the straggler; fleet_top
+    flags the min-frontier straggler stream. Old artifacts (no stream
+    spans / no xfer_streams) render unchanged."""
+    import importlib.util as iu
+    import os
+
+    def load(mod, rel):
+        spec = iu.spec_from_file_location(
+            mod, os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), rel))
+        m = iu.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        return m
+
+    te = load("_te", "tools/trace_explain.py")
+    spans = [
+        {"trace_id": "t1", "name": "kv.transfer.stream", "ts": 0.0,
+         "dur": 0.10, "attrs": {"request_id": "r", "engine_id": "e",
+                                "host": "h0", "stream": 0,
+                                "bytes": 100, "resumes": 0}},
+        {"trace_id": "t1", "name": "kv.transfer.stream", "ts": 0.0,
+         "dur": 0.25, "attrs": {"request_id": "r", "engine_id": "e",
+                                "host": "h1", "stream": 1,
+                                "bytes": 100, "resumes": 1}},
+    ]
+    table = "\n".join(te.stream_frontier_table(spans))
+    assert "e/h0#0" in table and "e/h1#1" in table
+    assert "min-frontier stall" in table and "150.00 ms" in table
+    # the straggler column marks the slowest stream of the transfer
+    h1_row = [ln for ln in table.splitlines() if "e/h1#1" in ln][0]
+    assert h1_row.rstrip().endswith("1")
+    assert te.stream_frontier_table([]) == []
+
+    ft = load("_ft", "tools/fleet_top.py")
+    out = ft.render_summary({
+        "ts": 0, "scrapes": 1, "workers_seen": 0, "fleet": {},
+        "serving": {}, "cp": {}, "roles": {}, "qos": {}, "links": {},
+        "xfer_streams": {
+            "e/h0#0": {"bytes": 10, "pages": 4, "resumes": 0,
+                       "frontier": 4},
+            "e/h1#1": {"bytes": 10, "pages": 4, "resumes": 1,
+                       "frontier": 2},
+        }})
+    assert "kv-transfer streams" in out
+    straggler_lines = [ln for ln in out.splitlines()
+                       if "min-frontier straggler" in ln]
+    assert len(straggler_lines) == 1 and "e/h1#1" in straggler_lines[0]
+
+
+def test_stream_plan_orders_and_fractions():
+    from dynamo_tpu.disagg.remote_transfer import (
+        RemoteTransferBackend, _StreamCtx,
+    )
+    plan = RemoteTransferBackend._stream_plan(
+        RemoteTransferBackend.__new__(RemoteTransferBackend), "e", {
+            "h1": {"streams": [{"stream": 1,
+                                "slices": [[1, 1, 1]]}]},
+            "h0": {"streams": [{"stream": 0,
+                                "slices": [[1, 0, 1]]}]},
+        })
+    assert [c.stream for c in plan] == [0, 1]
+    assert plan[0].conn_key == "e/h0#0" and plan[1].link == "e/h1"
+    shape = (2, 2, 4, 8, 4)
+    assert plan[0].fraction(shape) == pytest.approx(0.5)
+    legacy = _StreamCtx("e")
+    assert legacy.conn_key == "e" and legacy.fraction(shape) == 1.0

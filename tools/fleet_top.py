@@ -104,6 +104,31 @@ def render_summary(summary: dict, slo: Optional[dict] = None,
             bl_txt = f" backlog {backlog >> 20}MiB" if backlog else ""
             out.append(f"    {link:<24} {mbs:10.1f} MB/s "
                        f"({snap['samples']} samples){err_txt}{bl_txt}")
+    streams = summary.get("xfer_streams") or {}
+    if streams:
+        # sharded parallel transfer: per-(shard, host) stream rows.
+        # The request-wide committed frontier is the MIN over a
+        # transfer's streams, so the stream pinning the min per engine
+        # prefix is flagged as the straggler — the first thing to look
+        # at when disagg TTFT regresses on a multi-host mesh.
+        mins: dict = {}
+        for skey, row in streams.items():
+            eng = skey.split("/", 1)[0]
+            cur = mins.get(eng)
+            if cur is None or row.get("frontier", 0) < cur[1]:
+                mins[eng] = (skey, row.get("frontier", 0))
+        out.append(f"  kv-transfer streams ({len(streams)}):")
+        for skey, row in sorted(streams.items()):
+            eng = skey.split("/", 1)[0]
+            straggler = " <- min-frontier straggler" \
+                if mins.get(eng, ("",))[0] == skey \
+                and len([s for s in streams if
+                         s.split('/', 1)[0] == eng]) > 1 else ""
+            out.append(
+                f"    {skey:<24} frontier={row.get('frontier', 0):<5}"
+                f" pages={row.get('pages', 0):<7}"
+                f" bytes={row.get('bytes', 0):<12}"
+                f" resumes={row.get('resumes', 0)}{straggler}")
     if slo:
         out.append("  slo burn:")
         for name, st in sorted(slo.items()):

@@ -29,7 +29,8 @@ while true; do
       && [ -e PARITY_TPU_r06_kvq.json ] \
       && [ -e BENCH_SELF_r06_kvq.json ] \
       && [ -e BENCH_SELF_r11_overlap_tpu.json ] \
-      && [ -e BENCH_SELF_r13_warm_prefix_tpu.json ]; then
+      && [ -e BENCH_SELF_r13_warm_prefix_tpu.json ] \
+      && [ -e BENCH_SELF_r15_sharded_tpu.json ]; then
     echo "[watch] all TPU evidence captured; exiting" >&2
     exit 0
   fi
@@ -250,6 +251,37 @@ json.dump(r, open("BENCH_SELF_r13_warm_prefix_tpu.json", "w"), indent=1)
 EOF
             cp "$wl" BENCH_SELF_r13_warm_prefix_tpu.log 2>/dev/null
             echo "[watch] warm-prefix captured: fetch/cold $wvalue" >&2 ;;
+        esac
+      fi
+      if [ ! -e BENCH_SELF_r15_sharded_tpu.json ]; then
+        # sharded parallel KV transfer on hardware (ISSUE 15): 1-stream
+        # vs N-(shard, host)-stream transfer wall time + disagg TTFT on
+        # the flagship — via the supervisor's ratio trajectory rows this
+        # is the measured row for the pre-registered
+        # sharded_transfer_wall_ratio_llama3_1b_tpu gate in BASELINE.json
+        # (tools/bench_compare.py scores it), AND another recapture of
+        # the overdue real-TPU headline row the ROADMAP re-anchor asks
+        # every TPU window to take through the bench_compare gate
+        echo "[watch] -> sharded-transfer bench" >&2
+        rm -f .bench_state.json
+        hj=/tmp/bench_h_$$.json hl=/tmp/bench_h_$$.log
+        BENCH_RUN_ID=BENCH_SELF_r15_sharded_tpu BENCH_KVQ=0 \
+          BENCH_OVERLAP=0 BENCH_WARM_PREFIX=0 BENCH_BUDGET_S=1200 \
+          timeout 1500 python bench.py >"$hj" 2>"$hl"
+        hvalue=$(python -c "import json,sys;print(json.load(open(sys.argv[1]))['extras'].get('sharded_transfer',{}).get('paced_wall_ratio',0))" \
+            "$hj" 2>/dev/null || echo 0)
+        case "$hvalue" in
+          0|0.0|"") echo "[watch] sharded-transfer bench got no ratio" >&2 ;;
+          *)
+            python - "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$hj" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[2]))
+r["timestamp"] = sys.argv[1]
+r["self_measured"] = True
+json.dump(r, open("BENCH_SELF_r15_sharded_tpu.json", "w"), indent=1)
+EOF
+            cp "$hl" BENCH_SELF_r15_sharded_tpu.log 2>/dev/null
+            echo "[watch] sharded transfer captured: wall ratio $hvalue" >&2 ;;
         esac
       fi
       if [ ! -e BENCH_SELF_r05_spec.json ] \

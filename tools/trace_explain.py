@@ -233,7 +233,70 @@ def summarize(spans: List[dict]) -> str:
     if table:
         out.append("")
         out.extend(table)
+    stream_table = stream_frontier_table(spans)
+    if stream_table:
+        out.append("")
+        out.extend(stream_table)
     return "\n".join(out)
+
+
+def stream_frontier_table(spans: List[dict]) -> List[str]:
+    """Per-(shard, host) stream table from kv.transfer.stream spans
+    (sharded parallel transfer, disagg/remote_transfer.py): wall time,
+    bytes, and resumes per stream, plus the MIN-FRONTIER STALL — how
+    long the slowest stream of each transfer outlived the fastest
+    (the time the request-wide min frontier, which gates early decode
+    and bounds salvage, sat waiting on the straggler). The straggler
+    column names the stream that pinned the min. Empty when no span
+    carries a stream id (pre-ISSUE-15 artifacts render unchanged)."""
+    # (trace_id, request_id) -> stream spans of that transfer
+    by_xfer: Dict[tuple, List[dict]] = {}
+    for s in spans:
+        attrs = s.get("attrs") or {}
+        if s["name"] == "kv.transfer.stream" and s.get("dur", 0.0) > 0.0:
+            key = (s["trace_id"], attrs.get("request_id", "?"))
+            by_xfer.setdefault(key, []).append(s)
+    if not by_xfer:
+        return []
+    per_stream: Dict[str, dict] = {}
+    stalls: List[float] = []
+    stragglers: Dict[str, int] = {}
+    for rows in by_xfer.values():
+        ends = [(r["ts"] + r["dur"], r) for r in rows]
+        if len(ends) >= 2:
+            last_end, last = max(ends, key=lambda x: x[0])
+            first_end = min(e for e, _ in ends)
+            stalls.append(last_end - first_end)
+            a = last.get("attrs") or {}
+            skey = f"{a.get('engine_id', '?')}/{a.get('host', '?')}" \
+                   f"#{a.get('stream', '?')}"
+            stragglers[skey] = stragglers.get(skey, 0) + 1
+        for r in rows:
+            a = r.get("attrs") or {}
+            skey = f"{a.get('engine_id', '?')}/{a.get('host', '?')}" \
+                   f"#{a.get('stream', '?')}"
+            row = per_stream.setdefault(
+                skey, {"n": 0, "bytes": 0, "dur": 0.0, "resumes": 0})
+            row["n"] += 1
+            row["bytes"] += a.get("bytes") or 0
+            row["dur"] += r["dur"]
+            row["resumes"] += a.get("resumes") or 0
+    out = ["kv transfer streams (per shard, host):",
+           f"  {'stream':<24}{'sends':>6}{'bytes':>12}{'total ms':>10}"
+           f"{'resumes':>8}{'straggler':>10}"]
+    for skey in sorted(per_stream):
+        row = per_stream[skey]
+        out.append(f"  {skey:<24}{row['n']:>6}{row['bytes']:>12}"
+                   f"{row['dur'] * 1e3:>10.2f}{row['resumes']:>8}"
+                   f"{stragglers.get(skey, 0):>10}")
+    if stalls:
+        stalls.sort()
+        out.append(
+            f"  min-frontier stall (slowest-fastest stream end): "
+            f"p50 {stalls[len(stalls) // 2] * 1e3:.2f} ms, "
+            f"max {stalls[-1] * 1e3:.2f} ms over {len(stalls)} "
+            "parallel transfer(s)")
+    return out
 
 
 def link_estimator_table(spans: List[dict]) -> List[str]:
