@@ -1,16 +1,22 @@
 """dynalint: project-specific static analysis + jaxpr invariant auditing.
 
-Two layers (see docs/ANALYSIS.md):
+Three layers (see docs/ANALYSIS.md):
 
-- AST lint (ast_rules.py, R1-R6): source-level rules distilled from this
-  repo's actual bug history — unguarded vocab gathers, Pallas kernels
-  missing stale-tail K/V zeroing, blocking calls on async paths,
+- AST lint (ast_rules.py, R1-R21): source-level rules distilled from
+  this repo's actual bug history — unguarded vocab gathers, Pallas
+  kernels missing stale-tail K/V zeroing, blocking calls on async paths,
   CancelledError-swallowing handlers, iterate-while-mutating, host syncs
-  in hot-path files.
+  in hot-path files, unbounded waits, span lifecycle, contract rules,
+  await-interleaving TOCTOU races.
 - jaxpr audit (jaxpr_audit.py, J1-J5): traces the engine's jitted entry
   points with abstract bucket-shaped inputs and asserts invariants on
   the jaxprs (no f64 leaks, donation consumable, trace-tight bucket
   ladder, no host callbacks, no convert_element_type round-trips).
+- flow analysis (flow.py, consumed by the rules + interleave.py): a
+  per-function CFG with reaching definitions, constant propagation,
+  one-level alias tracking, and a must-reach query — the engine that
+  upgraded R7/R10/R11/R13/R14 from lexical tripwires to proofs and
+  carries R21 outright.
 
 CLI: `python tools/dynalint.py dynamo_tpu`. The checked-in baseline
 (tools/dynalint_baseline.json) suppresses pre-existing findings so the

@@ -19,7 +19,9 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
 - R5  mutation of a dict/list while iterating it
 - R6  host-sync call in a file marked `# dynalint: hot-path`
 - R7  unbounded await on a control-plane/transport round trip in the
-      serving layers (transports/, frontend/, disagg/)
+      serving layers (transports/, frontend/, disagg/) — a missing
+      timeout= kwarg, a literal timeout=None, or (layer 3, flow.py) a
+      timeout variable that constant-propagates to None on every path
 - R8  blocking device sync (jax.device_get / .block_until_ready() /
       np.asarray(<device array>)) inside a `# dynalint: hot-path-begin`
       .. `hot-path-end` region without an explicit
@@ -31,14 +33,20 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       with an unbucketed (data-dependent `len(...)`) leading dim — every
       distinct shape mints a new compiled XLA program, so an admission-
       dependent dim recompiles the serving loop per arrival — without a
-      `# dynalint: bucketed` annotation
+      `# dynalint: bucketed` annotation; layer 3 (flow.py) follows
+      `n = len(batch)` bindings into the dim through reaching defs,
+      and a value routed through next_bucket()/pow2_buckets()/
+      page_bucket_ladder() is admission-stable by construction
 - R11 raw KV-cache leaf access (`cache["k"]` / `cache["v"]` / the scale
       leaves) in model/ops/engine-step code without a
       `# dynalint: kv-codec` annotation — with kv_quant the leaves hold
       int8 bytes + scales, and code that indexes them directly (or
       `.astype`s them to a float) silently treats quantized bytes as
       values; every access must go through (or knowingly feed) the
-      ops/kv_quant.py codec
+      ops/kv_quant.py codec. Layer 3 (flow.py) tracks aliases: a
+      `kv = cache` dict copy indexed later, and a `k = cache["k"]`
+      value-leaf alias feeding downstream `.astype(<float>)` or
+      arithmetic, are flagged at the consuming site
 - R12 control-plane retry loops (watch pumps, heartbeat/keepalive
       loops, lease renewal, scrape loops) that survive failures —
       a `while` loop with a non-reraising exception handler around a
@@ -48,17 +56,22 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       un-jittered retry loop re-synchronizes hundreds of workers into
       thundering-herd waves against the discovery store
 - R13 tracing span lifecycle (runtime/tracing.py): (a) a manually-begun
-      span (`begin_span`) must be ended on every path — `with` form or a
-      try/finally containing `end_span`/`.finish()` — else early exits
-      leak the span; (b) span-RECORDING calls inside
+      span (`begin_span`) must be ended on every path — `with` form, a
+      try/finally containing `end_span`/`.finish()`, or a layer-3 CFG
+      proof that every path from the binding reaches an end (flow.py
+      must-reach analysis; a begin whose result is immediately returned
+      transfers ownership to the caller) — else early exits leak the
+      span; (b) span-RECORDING calls inside
       `# dynalint: hot-path-begin/end` regions must use the deferred
       recorder (`defer_phase`, what PhaseTimer routes through) instead
       of allocating span objects between device dispatches; escape
       hatch `# dynalint: span-ok=<reason>`
 - R14 unbounded raw stream IO on the data/control wire (disagg/,
       runtime/transports/): an awaited `read_frame` / `readexactly` /
-      `readuntil` / `readline` / `drain` with no `timeout=` kwarg, no
-      enclosing `asyncio.wait_for` in the same await expression, and no
+      `readuntil` / `readline` / `drain` with no effective `timeout=`
+      kwarg (missing, literal None, or constant-propagated None —
+      layer 3), no enclosing `asyncio.wait_for` in the same await
+      expression, and no
       `# dynalint: unbounded-io-ok=<reason>` annotation within three
       lines above. R7 bounds the higher-level round trips; R14 pins the
       raw socket ops under them — a half-open peer or a receiver that
@@ -130,6 +143,16 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       trusts ONE stream's frontier — and salvage then charges pages
       whose sibling slices never landed, decoding garbage
       (disagg/remote_transfer.py owns the aggregation)
+- R21 await-interleaving TOCTOU (layer 3, interleave.py): in any
+      `async def` under runtime/, disagg/, frontend/, kv_router/, a
+      name bound to shared state (`self.X`, `self.X[...]`, a module
+      UPPERCASE registry) before an `await` and consumed after it by a
+      fate-deciding call (dispatch/generate, inject*/salvage, commit*,
+      schedule, deregister/remove_*, resolve*) without revalidation —
+      a re-read or membership guard mentioning the captured root, or
+      an epoch/frontier/fence/generation/corpse/alive/lease check —
+      is the corpse-routing race class of PRs 7-15 mechanized; escape
+      hatch `# dynalint: interleave-ok=<where revalidation lives>`
 """
 from __future__ import annotations
 
@@ -138,6 +161,7 @@ import re
 from typing import Callable, Dict, List, Optional
 
 from dynamo_tpu.analysis.findings import Finding
+from dynamo_tpu.analysis.flow import header_exprs, module_flow
 
 RULES: Dict[str, Callable] = {}
 
@@ -521,6 +545,30 @@ _R7_TARGETS = {
 _R7_WRAPPERS = {"wait_for", "with_deadline"}
 
 
+def _timeout_unbounded(call: ast.Call, tree: ast.AST) -> bool:
+    """True when the call provides no effective deadline: no `timeout=`
+    kwarg at all, a literal `timeout=None`, or (layer 3, flow.py) a
+    timeout VARIABLE whose every reaching definition is None — asyncio
+    treats timeout=None as wait-forever, so a defaulted-None local that
+    never received a budget is the missing-deadline bug with extra
+    steps. A variable that MAY hold a real budget on some path is given
+    the benefit of the doubt (incomplete constant sets make no claim)."""
+    for kw in call.keywords:
+        if kw.arg != "timeout":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant):
+            return v.value is None
+        if isinstance(v, ast.Name):
+            res = module_flow(tree).const_values(v)
+            if res is not None:
+                complete, values = res
+                if complete and values == {None}:
+                    return True
+        return False
+    return True
+
+
 @rule("R7")
 def r7_unbounded_transport_await(tree: ast.AST, lines: List[str],
                                  path: str) -> List[Finding]:
@@ -539,13 +587,14 @@ def r7_unbounded_transport_await(tree: ast.AST, lines: List[str],
             continue
         if terminal not in _R7_TARGETS:
             continue
-        if any(kw.arg == "timeout" for kw in call.keywords):
+        if not _timeout_unbounded(call, tree):
             continue
         out.append(_finding(
             "R7", path, lines, node,
             f"`await {name}(...)` is a control-plane/transport round "
-            "trip with no deadline — a dead peer wedges this coroutine "
-            "(and whatever stream it serves) forever",
+            "trip with no deadline (missing timeout=, or a timeout "
+            "that resolves to None on every path) — a dead peer wedges "
+            "this coroutine (and whatever stream it serves) forever",
             "pass timeout=..., or wrap in asyncio.wait_for / "
             "runtime.deadline.with_deadline bounded by the request "
             "Context's remaining budget"))
@@ -736,6 +785,38 @@ def _contains_len_call(node: ast.AST) -> bool:
                for n in ast.walk(node))
 
 
+# Sanctioned bucketing calls: a value routed through one is admission-
+# stable by construction and stops the layer-3 derivation walk.
+_R10_BUCKETS = {"next_bucket", "pow2_buckets", "page_bucket_ladder"}
+
+
+def _is_bucket_call(n: ast.AST) -> bool:
+    return isinstance(n, ast.Call) and \
+        _call_name(n).rsplit(".", 1)[-1] in _R10_BUCKETS
+
+
+def _is_len_call(n: ast.AST) -> bool:
+    return isinstance(n, ast.Call) and _call_name(n) == "len"
+
+
+def _lead_data_dependent(lead: ast.expr, tree: ast.AST) -> bool:
+    """Does the leading shape element track the live batch? Lexically: a
+    bare `len(...)` inside the element. Through layer 3 (flow.py): a
+    NAME whose reaching definitions derive from `len(...)` without
+    passing a sanctioned bucketing call — `n = len(batch)` one statement
+    before the allocation is the documented escape this closes, while
+    `n = next_bucket(len(batch), ladder)` stays quiet."""
+    if _contains_len_call(lead):
+        return True
+    names = [n for n in ast.walk(lead) if isinstance(n, ast.Name)
+             and isinstance(n.ctx, ast.Load)]
+    if not names:
+        return False
+    mf = module_flow(tree)
+    return any(mf.name_derives_from(nm, _is_len_call, _is_bucket_call)
+               for nm in names)
+
+
 @rule("R10")
 def r10_unbucketed_plan_dims(tree: ast.AST, lines: List[str],
                              path: str) -> List[Finding]:
@@ -760,7 +841,7 @@ def r10_unbucketed_plan_dims(tree: ast.AST, lines: List[str],
             shape = node.args[0]
             lead = shape.elts[0] if (isinstance(shape, ast.Tuple)
                                      and shape.elts) else shape
-            if not _contains_len_call(lead):
+            if not _lead_data_dependent(lead, tree):
                 continue
             if annotated(node.lineno):
                 continue
@@ -794,6 +875,13 @@ _R11_SCOPE = ("models/", "ops/", "engine/engine")
 _R11_EXEMPT = ("ops/kv_quant",)
 _R11_KEYS = {"k", "v", "k_scale", "v_scale"}
 _R11_ANNOT_RE = re.compile(r"#\s*dynalint:\s*kv-codec")
+_R11_FLOAT_RE = re.compile(r"float|bfloat|bf16|f16|f32|fp16")
+_R11_HINT = (
+    "route the read/write through ops/kv_quant.py (quantize_"
+    "rows / dequantize_rows / gather_dequant) or the codec-"
+    "aware attention/write helpers, or annotate with "
+    "`# dynalint: kv-codec` and say how the site preserves or "
+    "decodes the representation")
 
 
 @rule("R11")
@@ -808,35 +896,97 @@ def r11_raw_kv_cache_access(tree: ast.AST, lines: List[str],
         return any(_R11_ANNOT_RE.search(_line(lines, x))
                    for x in (ln, ln - 1, ln - 2))
 
+    def is_cache_base(expr: ast.AST) -> bool:
+        # a name or attribute whose last component is `cache`
+        # (cache, self.cache, eng.cache)
+        return (isinstance(expr, ast.Name) and expr.id == "cache") or \
+            (isinstance(expr, ast.Attribute) and expr.attr == "cache")
+
+    mf = None
+
+    def aliases(name_node: ast.Name) -> list:
+        nonlocal mf
+        if mf is None:
+            mf = module_flow(tree)
+        return mf.alias_exprs(name_node)
+
+    def aliases_cache(base: ast.AST) -> bool:
+        """base aliases the cache dict through layer-3 name copies
+        (`kv = cache` / `kv = self.cache`, the documented escape)."""
+        return isinstance(base, ast.Name) and \
+            any(is_cache_base(a) for a in aliases(base))
+
+    def value_leaf_alias(name_node: ast.Name) -> Optional[ast.expr]:
+        """The `<cache-ish>["k"|"v"]` expression `name_node` aliases
+        (directly or through a cache-dict alias), or None."""
+        for a in aliases(name_node):
+            if isinstance(a, ast.Subscript) and \
+                    isinstance(a.slice, ast.Constant) and \
+                    a.slice.value in ("k", "v") and \
+                    (is_cache_base(a.value) or aliases_cache(a.value)):
+                return a
+        return None
+
     out: List[Finding] = []
+    flagged: set = set()
     for node in ast.walk(tree):
         if not isinstance(node, ast.Subscript):
-            continue
-        # match <...cache>["k"] etc.: a name or attribute whose last
-        # component is `cache` (cache, self.cache, eng.cache), indexed
-        # by one of the KV leaf keys
-        base = node.value
-        base_name = (base.id if isinstance(base, ast.Name)
-                     else base.attr if isinstance(base, ast.Attribute)
-                     else None)
-        if base_name != "cache":
             continue
         sl = node.slice
         if not (isinstance(sl, ast.Constant) and sl.value in _R11_KEYS):
             continue
+        base = node.value
+        direct = is_cache_base(base)
+        if not direct and not aliases_cache(base):
+            continue
         if annotated(node.lineno):
             continue
+        via = "" if direct else (
+            f" (`{_unparse(base)}` aliases the cache dict — layer-3 "
+            "alias tracking)")
+        flagged.add(node.lineno)
         out.append(_finding(
             "R11", path, lines, node,
-            f"raw KV-cache leaf access `{_unparse(node)}` outside the "
-            "kv_quant codec helpers — with kv_quant='int8' this leaf "
-            "holds quantized bytes (+scale rows elsewhere); indexing or "
-            "casting it directly treats int8 bytes as values",
-            "route the read/write through ops/kv_quant.py (quantize_"
-            "rows / dequantize_rows / gather_dequant) or the codec-"
-            "aware attention/write helpers, or annotate with "
-            "`# dynalint: kv-codec` and say how the site preserves or "
-            "decodes the representation"))
+            f"raw KV-cache leaf access `{_unparse(node)}`{via} outside "
+            "the kv_quant codec helpers — with kv_quant='int8' this "
+            "leaf holds quantized bytes (+scale rows elsewhere); "
+            "indexing or casting it directly treats int8 bytes as "
+            "values",
+            _R11_HINT))
+
+    # layer 3: downstream arithmetic on an ALIAS of a value leaf —
+    #   k = cache["k"]            (maybe annotated as a whole-page move)
+    #   ...; x = k.astype(jnp.float32); y = k * scale
+    # the alias carries quantized bytes out of the annotated site and
+    # into float math, which is exactly the bytes-as-values bug the
+    # lexical rule could not follow.
+    for node in ast.walk(tree):
+        cands: list = []
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "astype" and \
+                isinstance(node.func.value, ast.Name) and node.args and \
+                _R11_FLOAT_RE.search(_unparse(node.args[0])):
+            cands = [(node.func.value,
+                      f".astype({_unparse(node.args[0])})")]
+        elif isinstance(node, ast.BinOp):
+            cands = [(s, "arithmetic") for s in (node.left, node.right)
+                     if isinstance(s, ast.Name)]
+        for nm, how in cands:
+            if node.lineno in flagged or annotated(node.lineno):
+                continue
+            leaf = value_leaf_alias(nm)
+            if leaf is None:
+                continue
+            flagged.add(node.lineno)
+            out.append(_finding(
+                "R11", path, lines, node,
+                f"`{nm.id}` aliases KV-cache value leaf "
+                f"`{_unparse(leaf)}` and feeds {how} (layer-3 alias "
+                "tracking) — with kv_quant='int8' the alias carries "
+                "quantized bytes, and float math on them treats bytes "
+                "as values",
+                _R11_HINT))
     return out
 
 
@@ -988,21 +1138,40 @@ def r13_span_lifecycle(tree: ast.AST, lines: List[str],
                     if isinstance(n, ast.Call) and \
                             _call_name(n).rsplit(".", 1)[-1] == _R13_BEGIN:
                         safe.add(id(n))
-    # a begin_span ASSIGNED right before a try/finally-with-end is the
-    # idiomatic pattern: treat `x = begin_span(...)` as safe when the
-    # same FUNCTION holds a try whose finally ends a span
-    for fn in [n for n in ast.walk(tree)
-               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
-        has_ending_finally = any(
-            isinstance(t, ast.Try) and t.finalbody
-            and any(_calls_named(f, _R13_END) for f in t.finalbody)
-            for t in ast.walk(fn))
-        if not has_ending_finally:
+    # a begin_span bound to a name is safe when layer 3 (flow.py)
+    # PROVES every CFG path from the binding reaches an end_span /
+    # .finish() — the assign-then-try/finally idiom, branch-complete
+    # endings — and when the call's result is immediately returned
+    # (ownership transfers to the caller). This replaces the old
+    # function-local heuristic ("some try/finally in the function ends
+    # some span"), which blessed every begin_span in a function that
+    # correctly ended ONE of them.
+    mf = None
+
+    def _ends(cfg_node: ast.AST) -> bool:
+        for root in header_exprs(cfg_node):
+            for n in ast.walk(root):
+                if isinstance(n, ast.Call) and \
+                        _call_name(n).rsplit(".", 1)[-1] in _R13_END:
+                    return True
+        return False
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) \
+                or _call_name(node).rsplit(".", 1)[-1] != _R13_BEGIN \
+                or id(node) in safe:
             continue
-        for n in ast.walk(fn):
-            if isinstance(n, ast.Call) and \
-                    _call_name(n).rsplit(".", 1)[-1] == _R13_BEGIN:
-                safe.add(id(n))
+        if mf is None:
+            mf = module_flow(tree)
+        fl = mf.flow_for(node)
+        if fl is None:
+            continue
+        stmt = fl.stmt_of(node)
+        if isinstance(stmt, ast.Return):
+            safe.add(id(node))  # span factory: the caller owns the end
+            continue
+        if fl.always_reaches_after(node, _ends):
+            safe.add(id(node))
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call) \
                 or _call_name(node).rsplit(".", 1)[-1] != _R13_BEGIN:
@@ -1090,14 +1259,16 @@ def r14_unbounded_stream_io(tree: ast.AST, lines: List[str],
             # a wait_for(...) wrapper makes the terminal "wait_for";
             # the raw op inside it is bounded by construction
             continue
-        if any(kw.arg == "timeout" for kw in call.keywords):
+        if not _timeout_unbounded(call, tree):
             continue
         if annotated(node.lineno):
             continue
         out.append(_finding(
             "R14", path, lines, node,
             f"`await {name}(...)` is a raw stream read/write with no "
-            "deadline — a half-open peer (or one that stops reading) "
+            "deadline (missing timeout=, or a timeout that resolves to "
+            "None on every path) — a half-open peer (or one that stops "
+            "reading) "
             "wedges this coroutine, and with it the transfer/queue slot "
             "it serves, until process restart",
             "bound it: pass timeout= (read_frame supports it), wrap in "
@@ -1659,6 +1830,18 @@ def r20_min_frontier_contract(tree: ast.AST, lines: List[str],
             "annotate with `# dynalint: frontier-ok=<why a single "
             "stream's frontier is safe here>`"))
     return out
+
+
+# -- R21: await-interleaving TOCTOU (layer 3) ---------------------------------
+
+# The detector lives in interleave.py (it is a dataflow analysis over
+# the flow.py CFG, not a lexical matcher); importing it here registers
+# it so run_rules / the runner see one rule table.
+from dynamo_tpu.analysis.interleave import (  # noqa: E402
+    r21_await_interleaving_toctou,
+)
+
+RULES["R21"] = r21_await_interleaving_toctou
 
 
 def run_rules(tree: ast.AST, lines: List[str], path: str) -> List[Finding]:

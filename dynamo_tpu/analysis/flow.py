@@ -1,0 +1,621 @@
+"""Layer-3 dynalint: flow-sensitive analysis over plain `ast`.
+
+The R1-R20 layer is a set of lexical tripwires; this module gives rules
+that need it an actual (small) dataflow engine — per-function CFG
+construction, reaching definitions, constant propagation, and one-level
+alias tracking — with no dependencies beyond the standard library. It
+exists to close the escapes docs/ANALYSIS.md used to record as "Static
+limitation" (a `timeout=None` variable, a `len()` bound one line before
+the allocation, a cache leaf aliased through a local) and to power the
+R21 await-interleaving race detector (interleave.py).
+
+Scope and honesty:
+
+- The CFG is STATEMENT-level and intraprocedural. Compound statements
+  contribute a header node (the `if`/`while` test, the `for` iterator,
+  the `with` items); their bodies are separate nodes. `try` is modeled
+  conservatively: every statement in the protected body gets an edge to
+  every handler and to the `finally` entry, and `return`/`raise` inside
+  a `try` with a `finally` routes through the innermost `finally` — so
+  must-reach queries (R13a) see the real exception/early-exit paths.
+- Reaching definitions are a classic forward may-analysis (union merge)
+  solved to fixpoint; parameters enter as PARAM pseudo-definitions and
+  anything unresolvable (tuple unpacking, augmented assignment, `for`
+  targets, `with ... as`, imports) defines the UNKNOWN sentinel.
+- Constant propagation and alias tracking resolve a name at a USE
+  through its reaching definitions, following plain `a = b` name copies
+  a bounded number of hops. They answer "what LITERALS can this name
+  hold here" / "what expression does this name alias here" — and answer
+  "don't know" (never a wrong literal) whenever any path escapes the
+  model. Consumers must treat None/incomplete results as "no claim".
+
+Facade: `module_flow(tree)` memoizes a ModuleFlow on the tree object
+(rules for one file share one index); `ModuleFlow` lazily builds a
+`FunctionFlow` per innermost enclosing function on first query.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, FrozenSet, List, Optional, Set, Tuple
+
+
+class _Sentinel:
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return self.name
+
+
+#: reaching-def value for a function parameter (value unknowable).
+PARAM = _Sentinel("<param>")
+#: reaching-def value for a binding the model cannot express.
+UNKNOWN = _Sentinel("<unknown>")
+#: _literal() result for an expression that is not a literal.
+NOT_CONST = _Sentinel("<not-const>")
+
+_FN_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def header_exprs(node: ast.AST) -> List[ast.expr]:
+    """The expression roots evaluated AT a CFG node — for compound
+    statements only the header (test/iter/items), never the body, so
+    per-node queries don't leak into statements that are their own CFG
+    nodes. Simple statements contribute all their child expressions."""
+    if isinstance(node, (ast.If, ast.While)):
+        return [node.test]
+    if isinstance(node, (ast.For, ast.AsyncFor)):
+        return [node.iter, node.target]
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        out: List[ast.expr] = []
+        for item in node.items:
+            out.append(item.context_expr)
+            if item.optional_vars is not None:
+                out.append(item.optional_vars)
+        return out
+    if isinstance(node, ast.Try):
+        return []
+    if isinstance(node, ast.ExceptHandler):
+        return [node.type] if node.type is not None else []
+    if isinstance(node, _FN_TYPES + (ast.ClassDef,)):
+        # a nested def is ONE node in the enclosing CFG; its body belongs
+        # to its own FunctionFlow. Decorators/defaults evaluate here.
+        out = list(node.decorator_list)
+        if isinstance(node, _FN_TYPES):
+            out += [d for d in node.args.defaults]
+            out += [d for d in node.args.kw_defaults if d is not None]
+        return out
+    return [c for c in ast.iter_child_nodes(node)
+            if isinstance(c, ast.expr)]
+
+
+def _contains_await(node: ast.AST) -> bool:
+    """True when executing this CFG node suspends the coroutine: an
+    explicit `await` in its header expressions, or the implicit awaits
+    of an `async for` / `async with` header."""
+    if isinstance(node, (ast.AsyncFor, ast.AsyncWith)):
+        return True
+    for root in header_exprs(node):
+        for n in ast.walk(root):
+            if isinstance(n, ast.Await):
+                return True
+    return False
+
+
+class _Loop:
+    __slots__ = ("header", "breaks", "fin_depth")
+
+    def __init__(self, header: ast.AST, fin_depth: int):
+        self.header = header
+        self.breaks: List[ast.AST] = []
+        self.fin_depth = fin_depth  # finally-stack depth at loop entry
+
+
+class CFG:
+    """Statement-level control-flow graph of one function body."""
+
+    def __init__(self, fn: ast.AST):
+        self.nodes: List[ast.AST] = []
+        self.succ: Dict[int, List[ast.AST]] = {}
+        self.entry: Optional[ast.AST] = None
+        self._loops: List[_Loop] = []
+        self._finallies: List[ast.AST] = []
+        body = fn.body if isinstance(fn, _FN_TYPES + (ast.Module,)) else [fn]
+        idx0 = len(self.nodes)
+        self._seq(body, [])
+        if len(self.nodes) > idx0:
+            self.entry = self.nodes[idx0]
+        self.pred: Dict[int, List[ast.AST]] = {id(n): [] for n in self.nodes}
+        for n in self.nodes:
+            for t in self.succ.get(id(n), []):
+                self.pred[id(t)].append(n)
+
+    # -- construction ---------------------------------------------------------
+
+    def _add(self, node: ast.AST, preds: List[ast.AST]) -> None:
+        self.nodes.append(node)
+        self.succ.setdefault(id(node), [])
+        self._connect(preds, node)
+
+    def _connect(self, preds: List[ast.AST], node: ast.AST) -> None:
+        for p in preds:
+            succs = self.succ.setdefault(id(p), [])
+            if node not in succs:
+                succs.append(node)
+
+    def _seq(self, stmts: List[ast.stmt],
+             preds: List[ast.AST]) -> List[ast.AST]:
+        frontier = preds
+        for st in stmts:
+            frontier = self._stmt(st, frontier)
+        return frontier
+
+    def _stmt(self, st: ast.stmt, preds: List[ast.AST]) -> List[ast.AST]:
+        if isinstance(st, ast.If):
+            self._add(st, preds)
+            body_out = self._seq(st.body, [st])
+            orelse_out = self._seq(st.orelse, [st]) if st.orelse else [st]
+            return body_out + orelse_out
+
+        if isinstance(st, (ast.While, ast.For, ast.AsyncFor)):
+            self._add(st, preds)
+            loop = _Loop(st, len(self._finallies))
+            self._loops.append(loop)
+            body_out = self._seq(st.body, [st])
+            self._connect(body_out, st)  # back edge
+            self._loops.pop()
+            infinite = (isinstance(st, ast.While)
+                        and isinstance(st.test, ast.Constant)
+                        and bool(st.test.value))
+            exit_preds = [] if infinite else [st]
+            if st.orelse:
+                exit_preds = self._seq(st.orelse, exit_preds)
+            return exit_preds + loop.breaks
+
+        if isinstance(st, ast.Try):
+            self._add(st, preds)  # header: a no-op entry node
+            fin_entry: Optional[ast.AST] = None
+            fin_out: List[ast.AST] = []
+            if st.finalbody:
+                i0 = len(self.nodes)
+                fin_out = self._seq(st.finalbody, [])
+                fin_entry = self.nodes[i0]
+                self._finallies.append(fin_entry)
+            body_i0 = len(self.nodes)
+            body_out = self._seq(st.body, [st])
+            body_nodes = self.nodes[body_i0:len(self.nodes)]
+            handler_outs: List[ast.AST] = []
+            handler_nodes: List[ast.AST] = []
+            for h in st.handlers:
+                self._add(h, [st])
+                # any protected statement may raise into the handler
+                self._connect(body_nodes, h)
+                h_i0 = len(self.nodes)
+                handler_outs += self._seq(h.body, [h])
+                handler_nodes += [h] + self.nodes[h_i0:len(self.nodes)]
+            orelse_out = (self._seq(st.orelse, body_out) if st.orelse
+                          else body_out)
+            if fin_entry is not None:
+                self._finallies.pop()
+                # normal completion, plus the conservative exception
+                # edge from every protected/handler statement
+                self._connect(orelse_out + handler_outs, fin_entry)
+                self._connect(body_nodes + handler_nodes, fin_entry)
+                return fin_out
+            return orelse_out + handler_outs
+
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            self._add(st, preds)
+            return self._seq(st.body, [st])
+
+        # simple statements (incl. nested def/class as single nodes)
+        self._add(st, preds)
+        if isinstance(st, (ast.Return, ast.Raise)):
+            if self._finallies:
+                self._connect([st], self._finallies[-1])
+            return []
+        if isinstance(st, (ast.Break, ast.Continue)):
+            loop = self._loops[-1] if self._loops else None
+            # a break/continue inside a try whose finally opened INSIDE
+            # the loop runs that finally first (Python routes early
+            # exits through finally); the finally subgraph then carries
+            # the path onward — an over-approximation of "then jump",
+            # safe for both may- and must-queries
+            if loop is not None and len(self._finallies) > loop.fin_depth:
+                self._connect([st], self._finallies[-1])
+            elif loop is not None:
+                if isinstance(st, ast.Break):
+                    loop.breaks.append(st)
+                else:
+                    self._connect([st], loop.header)
+            return []
+        return [st]
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    if not isinstance(fn, _FN_TYPES):
+        return []
+    a = fn.args
+    names = [p.arg for p in
+             list(getattr(a, "posonlyargs", [])) + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _bind_target(tgt: ast.expr, value, out: Dict[str, object]) -> None:
+    if isinstance(tgt, ast.Name):
+        out[tgt.id] = value
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for e in tgt.elts:
+            _bind_target(e, UNKNOWN, out)
+    elif isinstance(tgt, ast.Starred):
+        _bind_target(tgt.value, UNKNOWN, out)
+    # Attribute / Subscript targets bind no local name
+
+
+def _bindings(node: ast.AST) -> Dict[str, object]:
+    """Names this CFG node (re)binds -> defining value expression, or
+    PARAM/UNKNOWN when the model cannot express the value."""
+    out: Dict[str, object] = {}
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            _bind_target(t, node.value, out)
+    elif isinstance(node, ast.AnnAssign):
+        if node.value is not None:
+            _bind_target(node.target, node.value, out)
+    elif isinstance(node, ast.AugAssign):
+        if isinstance(node.target, ast.Name):
+            out[node.target.id] = UNKNOWN
+    elif isinstance(node, (ast.For, ast.AsyncFor)):
+        _bind_target(node.target, UNKNOWN, out)
+    elif isinstance(node, (ast.With, ast.AsyncWith)):
+        for item in node.items:
+            if item.optional_vars is not None:
+                _bind_target(item.optional_vars, UNKNOWN, out)
+    elif isinstance(node, _FN_TYPES + (ast.ClassDef,)):
+        out[node.name] = UNKNOWN
+    elif isinstance(node, (ast.Import, ast.ImportFrom)):
+        for alias in node.names:
+            out[(alias.asname or alias.name).split(".")[0]] = UNKNOWN
+    elif isinstance(node, ast.ExceptHandler):
+        if node.name:
+            out[node.name] = UNKNOWN
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = UNKNOWN
+    for root in header_exprs(node):
+        for n in ast.walk(root):
+            if isinstance(n, ast.NamedExpr) and \
+                    isinstance(n.target, ast.Name):
+                out[n.target.id] = n.value
+    return out
+
+
+def _literal(expr) -> object:
+    """The literal value of an expression, or NOT_CONST."""
+    if isinstance(expr, ast.Constant):
+        return expr.value
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub) \
+            and isinstance(expr.operand, ast.Constant) \
+            and isinstance(expr.operand.value, (int, float)):
+        return -expr.operand.value
+    return NOT_CONST
+
+
+_PARAM_DEF = ("param",)
+
+
+class FunctionFlow:
+    """Reaching definitions + derived queries for one function."""
+
+    MAX_HOPS = 6  # name-copy chain bound for const/alias resolution
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.cfg = CFG(fn)
+        self._node_of: Dict[int, ast.AST] = {
+            id(n): n for n in self.cfg.nodes}
+        self._stmt_of: Dict[int, ast.AST] = {}
+        for node in self.cfg.nodes:
+            self._stmt_of[id(node)] = node
+            for root in header_exprs(node):
+                for sub in ast.walk(root):
+                    self._stmt_of[id(sub)] = node
+        self._gen: Dict[int, Dict[str, object]] = {
+            id(n): _bindings(n) for n in self.cfg.nodes}
+        self._in = self._solve()
+
+    # -- reaching definitions -------------------------------------------------
+
+    def _solve(self) -> Dict[int, Dict[str, FrozenSet[tuple]]]:
+        params = _param_names(self.fn)
+        entry_env = {name: frozenset({(_PARAM_DEF, name)})
+                     for name in params}
+        env_in: Dict[int, Dict[str, FrozenSet[tuple]]] = {}
+        env_out: Dict[int, Dict[str, FrozenSet[tuple]]] = {}
+        nodes = self.cfg.nodes
+        for _ in range(len(nodes) + 8):  # fixpoint bound: acyclic depth
+            changed = False
+            for n in nodes:
+                merged: Dict[str, FrozenSet[tuple]] = {}
+                if n is self.cfg.entry:
+                    merged.update(entry_env)
+                for p in self.cfg.pred.get(id(n), []):
+                    for name, defs in env_out.get(id(p), {}).items():
+                        prev = merged.get(name)
+                        merged[name] = defs if prev is None else prev | defs
+                out = dict(merged)
+                for name in self._gen[id(n)]:
+                    out[name] = frozenset({(id(n), name)})
+                if out != env_out.get(id(n)):
+                    env_out[id(n)] = out
+                    changed = True
+                env_in[id(n)] = merged
+            if not changed:
+                break
+        return env_in
+
+    # -- queries --------------------------------------------------------------
+
+    def stmt_of(self, node: ast.AST) -> Optional[ast.AST]:
+        """The CFG node whose header evaluates `node` (None when `node`
+        lives in a nested function or outside this one)."""
+        return self._stmt_of.get(id(node))
+
+    def _def_value(self, d: tuple) -> object:
+        if d[0] is _PARAM_DEF:
+            return PARAM
+        stmt = self._node_of.get(d[0])
+        if stmt is None:
+            return UNKNOWN
+        return self._gen[id(stmt)].get(d[1], UNKNOWN)
+
+    def def_exprs_at(self, node: ast.AST, name: str) -> Optional[list]:
+        """Reaching-definition values of `name` at `node`: a list over
+        {expr, PARAM, UNKNOWN}, or None when `node` is unmapped or no
+        definition reaches (global / builtin / undefined)."""
+        stmt = self.stmt_of(node)
+        if stmt is None:
+            return None
+        defs = self._in.get(id(stmt), {}).get(name)
+        if not defs:
+            return None
+        return [self._def_value(d) for d in defs]
+
+    def const_values_at(self, node: ast.AST,
+                        name: str) -> Tuple[bool, Set[object]]:
+        """(complete, values): literal values `name` may hold at `node`,
+        resolved through reaching defs and bounded name-copy chains.
+        complete=False whenever any reaching def escapes the model —
+        consumers must make no claim from an incomplete set."""
+        seen: Set[tuple] = set()
+
+        def resolve(stmt: ast.AST, nm: str,
+                    depth: int) -> Tuple[bool, Set[object]]:
+            if depth > self.MAX_HOPS:
+                return (False, set())
+            key = (id(stmt), nm)
+            if key in seen:
+                return (True, set())  # cycle contributes nothing new
+            seen.add(key)
+            defs = self._in.get(id(stmt), {}).get(nm)
+            if not defs:
+                return (False, set())
+            complete, values = True, set()
+            for d in defs:
+                val = self._def_value(d)
+                if val is PARAM or val is UNKNOWN:
+                    complete = False
+                    continue
+                lit = _literal(val)
+                if lit is not NOT_CONST:
+                    values.add(lit)
+                    continue
+                if isinstance(val, ast.Name):
+                    dstmt = self._node_of.get(d[0])
+                    if dstmt is None:
+                        complete = False
+                        continue
+                    c2, v2 = resolve(dstmt, val.id, depth + 1)
+                    complete = complete and c2
+                    values |= v2
+                    continue
+                complete = False
+            return (complete, values)
+
+        stmt = self.stmt_of(node)
+        if stmt is None:
+            return (False, set())
+        return resolve(stmt, name, 0)
+
+    def alias_exprs_at(self, node: ast.AST, name: str) -> List[ast.expr]:
+        """Source expressions `name` may alias at `node`: the reaching
+        def values, following plain `a = b` name copies up to MAX_HOPS.
+        A copy chain that bottoms out at a parameter or global yields
+        that terminal Name (the source IS the name); PARAM/UNKNOWN defs
+        themselves are dropped (no claim about those paths)."""
+        seen: Set[tuple] = set()
+        out: List[ast.expr] = []
+
+        def resolve(stmt: ast.AST, nm: str, depth: int) -> None:
+            if depth > self.MAX_HOPS:
+                return
+            key = (id(stmt), nm)
+            if key in seen:
+                return
+            seen.add(key)
+            defs = self._in.get(id(stmt), {}).get(nm) or ()
+            for d in defs:
+                val = self._def_value(d)
+                if val is PARAM or val is UNKNOWN:
+                    continue
+                if isinstance(val, ast.Name):
+                    dstmt = self._node_of.get(d[0])
+                    if dstmt is None:
+                        out.append(val)
+                        continue
+                    inner = self._in.get(id(dstmt), {}).get(val.id)
+                    ivals = ([self._def_value(x) for x in inner]
+                             if inner else [])
+                    if not inner or any(v is PARAM for v in ivals):
+                        out.append(val)
+                    if any(v is not PARAM for v in ivals):
+                        resolve(dstmt, val.id, depth + 1)
+                    continue
+                out.append(val)
+
+        stmt = self.stmt_of(node)
+        if stmt is not None:
+            resolve(stmt, name, 0)
+        return out
+
+    def name_derives_from(self, node: ast.AST, name: str,
+                          match: Callable[[ast.expr], bool],
+                          stop: Callable[[ast.expr], bool] = None,
+                          ) -> bool:
+        """May-analysis: does ANY reaching definition of `name` at
+        `node` derive from an expression satisfying `match`? Follows
+        names through defining expressions (including arithmetic on
+        them) up to MAX_HOPS; an expression satisfying `stop` ends that
+        branch (e.g. a sanctioned bucketing call laundered the value)."""
+        seen: Set[tuple] = set()
+
+        def expr_derives(stmt: ast.AST, expr: ast.expr,
+                         depth: int) -> bool:
+            if depth > self.MAX_HOPS:
+                return False
+            if stop is not None and any(stop(n) for n in ast.walk(expr)):
+                return False  # laundered through a sanctioned call
+            if any(match(n) for n in ast.walk(expr)):
+                return True
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Name) and \
+                        isinstance(n.ctx, ast.Load):
+                    if name_derives(stmt, n.id, depth + 1):
+                        return True
+            return False
+
+        def name_derives(stmt: ast.AST, nm: str, depth: int) -> bool:
+            key = (id(stmt), nm)
+            if key in seen or depth > self.MAX_HOPS:
+                return False
+            seen.add(key)
+            defs = self._in.get(id(stmt), {}).get(nm) or ()
+            for d in defs:
+                val = self._def_value(d)
+                if val is PARAM or val is UNKNOWN:
+                    continue
+                dstmt = self._node_of.get(d[0])
+                if dstmt is None:
+                    continue
+                if expr_derives(dstmt, val, depth):
+                    return True
+            return False
+
+        stmt = self.stmt_of(node)
+        if stmt is None:
+            return False
+        return name_derives(stmt, name, 0)
+
+    def always_reaches_after(self, node: ast.AST,
+                             pred: Callable[[ast.AST], bool]) -> bool:
+        """Must-analysis: from the CFG node evaluating `node`, does
+        EVERY path that EXITS the function pass a statement satisfying
+        `pred` first? `pred` sees each CFG node's own header (use
+        header_exprs). Solved as a greatest fixpoint, so a cycle that
+        never exits (a `while True:` serve loop) is vacuously safe —
+        the leak only exists on paths that actually leave the function
+        — while any path falling off the end unsatisfied fails."""
+        start = self.stmt_of(node)
+        if start is None:
+            return False
+        must: Dict[int, bool] = {id(n): True for n in self.cfg.nodes}
+        for _ in range(len(self.cfg.nodes) + 8):
+            changed = False
+            for n in self.cfg.nodes:
+                if not must[id(n)] or pred(n):
+                    continue
+                succs = self.cfg.succ.get(id(n), [])
+                if not succs or not all(must[id(t)] for t in succs):
+                    must[id(n)] = False
+                    changed = True
+            if not changed:
+                break
+        succs = self.cfg.succ.get(id(start), [])
+        return bool(succs) and all(must[id(t)] for t in succs)
+
+
+class ModuleFlow:
+    """Maps any AST node to its innermost enclosing function's
+    FunctionFlow, built lazily on first query."""
+
+    def __init__(self, tree: ast.AST):
+        self._fn_of: Dict[int, ast.AST] = {}
+        self._fns: Dict[int, ast.AST] = {}
+        self._flows: Dict[int, FunctionFlow] = {}
+        self._index(tree, None)
+
+    def _index(self, node: ast.AST, fn: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FN_TYPES):
+                self._fns[id(child)] = child
+                if fn is not None:
+                    self._fn_of[id(child)] = fn
+                self._index(child, child)
+            else:
+                if fn is not None:
+                    self._fn_of[id(child)] = fn
+                self._index(child, fn)
+
+    def function_flow(self, fn: ast.AST) -> FunctionFlow:
+        flow = self._flows.get(id(fn))
+        if flow is None:
+            flow = FunctionFlow(fn)
+            self._flows[id(fn)] = flow
+        return flow
+
+    def flow_for(self, node: ast.AST) -> Optional[FunctionFlow]:
+        fn = self._fn_of.get(id(node))
+        if fn is None:
+            return None
+        return self.function_flow(fn)
+
+    # convenience wrappers over the common "query a Name at its use" shape
+
+    def const_values(self, name_node: ast.Name
+                     ) -> Optional[Tuple[bool, Set[object]]]:
+        flow = self.flow_for(name_node)
+        if flow is None or flow.stmt_of(name_node) is None:
+            return None
+        return flow.const_values_at(name_node, name_node.id)
+
+    def alias_exprs(self, name_node: ast.Name) -> List[ast.expr]:
+        flow = self.flow_for(name_node)
+        if flow is None:
+            return []
+        return flow.alias_exprs_at(name_node, name_node.id)
+
+    def name_derives_from(self, name_node: ast.Name,
+                          match: Callable[[ast.expr], bool],
+                          stop: Callable[[ast.expr], bool] = None) -> bool:
+        flow = self.flow_for(name_node)
+        if flow is None:
+            return False
+        return flow.name_derives_from(name_node, name_node.id, match, stop)
+
+
+def module_flow(tree: ast.AST) -> ModuleFlow:
+    """Memoized ModuleFlow for one parsed file — every rule running over
+    the same tree (one lint_source call) shares one index, and the index
+    is garbage-collected with the tree."""
+    mf = getattr(tree, "_dynalint_flow", None)
+    if mf is None:
+        mf = ModuleFlow(tree)
+        tree._dynalint_flow = mf
+    return mf

@@ -1,0 +1,280 @@
+"""R21: await-interleaving TOCTOU detection for the async control plane.
+
+Every expensive bug class of PRs 7-15 was the same race: an `async def`
+reads shared state (a worker table, an instance set, an epoch, a queue
+registry), then *awaits* — yielding the event loop to whatever mutates
+that state (a watch pump deregistering a corpse, a drain controller, a
+pool re-price) — and then commits a fate decision with the pre-await
+snapshot: dispatches to the dead worker, injects pages into a replaced
+cache epoch, returns a schedule against an instance that left. Rust's
+borrow checker makes many of these unrepresentable; in Python the only
+structural defense is to revalidate after the await.
+
+R21 mechanizes that contract with a forward may-stale dataflow over the
+layer-3 CFG (flow.py), per `async def` under runtime/, disagg/,
+frontend/, kv_router/:
+
+- CAPTURE: binding a name to a read of shared mutable state — a
+  `self.X` attribute, an element/`.get` of one (`self.X[...]`), or a
+  module-level UPPERCASE registry. Plain `self.X` handle attributes
+  that are service objects rather than racy data (self.client,
+  self.messaging, config, locks, ...) are excluded; element reads are
+  always captures (pulling an entry out of a shared collection is the
+  snapshot this rule exists for).
+- STALE: any statement that suspends (an `await` in its own header, an
+  `async for`/`async with`) marks every live capture stale — the loop
+  interleaved, the snapshot may describe a world that no longer exists.
+- REVALIDATION clears staleness, deliberately generously (the rule
+  must be cheap to satisfy *by writing the check*): after the await,
+  any statement whose own source re-mentions the captured root
+  (`self.workers` appears again — a re-read or membership guard), or
+  whose text speaks the fence vocabulary (epoch / frontier / fence /
+  generation / corpse / alive / lease / revalidate / watch), clears
+  the matching (root) or all (fence) captures. Rebinding a name kills
+  its capture outright.
+- FATE: a stale name consumed by a fate-deciding call — dispatch /
+  generate / direct, inject*/salvage/preactivate, commit*, schedule,
+  deregister/unregister/remove_*, resolve* — as an argument or as the
+  call's receiver is the finding: the decision commits a snapshot that
+  an interleaved writer may have invalidated.
+
+Escape hatch: `# dynalint: interleave-ok=<reason>` on the flagged
+line, within three lines above it, on the capture line, or on the
+`async def` line (blessing the whole function). The reason must say
+where the revalidation actually lives (an owning-actor argument, a
+fence the callee checks, idempotence of the fate call).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis.findings import Finding
+from dynamo_tpu.analysis.flow import (
+    CFG, _bindings, _contains_await, header_exprs,
+)
+
+_R21_SCOPE = ("runtime/", "disagg/", "frontend/", "kv_router/")
+
+# Fate-deciding terminals: calls that commit a routing/injection/
+# registration decision. Calibrated against the live tree — extend when
+# a new fate surface appears (docs/ANALYSIS.md, "R21").
+_R21_FATE = {
+    # dispatch / generation against a chosen worker or endpoint
+    "generate", "dispatch", "direct", "submit",
+    # KV-page injection / salvage into a live cache
+    "inject", "inject_pages", "inject_pages_shard",
+    "salvage_remote", "preactivate_remote",
+    # commit / (de)registration fate
+    "commit", "commit_chunk", "deregister", "unregister",
+    "remove_instance", "remove_worker",
+    # schedule-return / endpoint resolution
+    "schedule", "resolve", "resolve_endpoint", "best_instance",
+}
+
+# `self.X` handle attributes that are service objects, not racy data.
+_R21_HANDLE_RE = re.compile(
+    r"^_?(client|messaging|transport|store|component|engine|router|"
+    r"scheduler|queue|pool|indexer|publisher|backend|server|runtime|"
+    r"loop|lock|cond|sem|logger|log|cfg|config|settings|policy|opts|"
+    r"tracer|metrics|registry_client|clock|rng)$")
+
+# Statement text that counts as a revalidation fence on its own.
+_R21_FENCE_RE = re.compile(
+    r"epoch|frontier|fence|generation|corpse|alive|lease|revalidat|"
+    r"watch", re.I)
+
+_R21_ANNOT_RE = re.compile(r"#\s*dynalint:\s*interleave-ok=\S+")
+
+# capture state: name -> (root text, capture lineno, stale-await lineno
+# or None while still clean)
+_State = Dict[str, Tuple[str, int, Optional[int]]]
+
+
+def _capture_root(expr: ast.expr) -> Optional[str]:
+    """Root text of a shared-state read expression, or None.
+
+    `self.workers`            -> "self.workers"   (non-handle attrs)
+    `self.workers[wid]`       -> "self.workers"
+    `self.workers.get(wid)`   -> "self.workers"
+    `REGISTRY[name]` / .get   -> "REGISTRY"       (module-level registry)
+    """
+    e = expr
+    if isinstance(e, ast.Await):
+        return None  # a call result, not a snapshot read
+    if isinstance(e, ast.Call):
+        f = e.func
+        if isinstance(f, ast.Attribute) and f.attr in ("get", "copy"):
+            e = f.value
+        else:
+            return None
+    if isinstance(e, ast.Subscript):
+        e = e.value
+    if isinstance(e, ast.Attribute) and \
+            isinstance(e.value, ast.Name) and e.value.id == "self":
+        if expr is e and _R21_HANDLE_RE.match(e.attr):
+            return None  # bare handle attribute
+        return f"self.{e.attr}"
+    if isinstance(e, ast.Name) and expr is not e and \
+            re.fullmatch(r"_?[A-Z][A-Z0-9_]*", e.id):
+        return e.id
+    return None
+
+
+def _stmt_text(node: ast.AST) -> str:
+    parts = []
+    for root in header_exprs(node):
+        try:
+            parts.append(ast.unparse(root))
+        except Exception:  # pragma: no cover
+            pass
+    return " ".join(parts)
+
+
+def _fate_uses(node: ast.AST) -> List[Tuple[str, str, int]]:
+    """(name, fate-call text, lineno) for every Name consumed by a
+    fate-deciding call in this CFG node's own expressions — as an
+    argument, a keyword, or the receiver chain."""
+    out: List[Tuple[str, str, int]] = []
+    for root in header_exprs(node):
+        for call in ast.walk(root):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            terminal = None
+            recv: Optional[ast.expr] = None
+            if isinstance(f, ast.Attribute):
+                terminal, recv = f.attr, f.value
+            elif isinstance(f, ast.Name):
+                terminal = f.id
+            if terminal not in _R21_FATE:
+                continue
+            try:
+                text = ast.unparse(call)
+            except Exception:  # pragma: no cover
+                text = terminal
+            names: List[str] = []
+            for a in list(call.args) + [kw.value for kw in call.keywords]:
+                names += [n.id for n in ast.walk(a)
+                          if isinstance(n, ast.Name)
+                          and isinstance(n.ctx, ast.Load)]
+            if recv is not None:
+                names += [n.id for n in ast.walk(recv)
+                          if isinstance(n, ast.Name)
+                          and isinstance(n.ctx, ast.Load)]
+            ln = getattr(call, "lineno", getattr(node, "lineno", 0))
+            for nm in names:
+                out.append((nm, text, ln))
+    return out
+
+
+def _merge(states: List[_State]) -> _State:
+    out: _State = {}
+    for st in states:
+        for name, rec in st.items():
+            prev = out.get(name)
+            if prev is None:
+                out[name] = rec
+            elif prev[2] is None and rec[2] is not None:
+                out[name] = rec  # stale wins over clean
+    return out
+
+
+def _analyze_fn(fn: ast.AsyncFunctionDef, lines: List[str],
+                path: str) -> List[Finding]:
+    cfg = CFG(fn)
+    if not cfg.nodes:
+        return []
+
+    def annotated(ln: int) -> bool:
+        cand = list(range(ln - 3, ln + 1)) + [fn.lineno]
+        return any(_R21_ANNOT_RE.search(lines[x - 1])
+                   for x in cand if 0 < x <= len(lines))
+
+    env_in: Dict[int, _State] = {}
+    env_out: Dict[int, _State] = {}
+    hits: Dict[Tuple[int, str], Finding] = {}
+
+    for _ in range(len(cfg.nodes) + 8):
+        changed = False
+        for node in cfg.nodes:
+            preds = cfg.pred.get(id(node), [])
+            state = _merge([env_out.get(id(p), {}) for p in preds]) \
+                if preds else {}
+            env_in[id(node)] = state
+            state = dict(state)
+
+            # 1. USES: a stale capture feeding a fate call is the bug
+            for name, call_text, ln in _fate_uses(node):
+                rec = state.get(name)
+                if rec is None or rec[2] is None:
+                    continue
+                root, cap_ln, await_ln = rec
+                if annotated(ln) or annotated(cap_ln):
+                    continue
+                key = (ln, name)
+                if key not in hits:
+                    hits[key] = Finding(
+                        rule="R21", path=path, line=ln,
+                        message=(
+                            f"`{name}` snapshots shared state "
+                            f"`{root}` (line {cap_ln}) but the event "
+                            f"loop interleaved at the await on line "
+                            f"{await_ln} before `{call_text}` commits "
+                            "it — a concurrent writer (watch pump, "
+                            "drain, re-registration) can invalidate "
+                            "the snapshot between read and use"),
+                        hint=(
+                            "revalidate after the await: re-read "
+                            f"`{root}`, guard on the epoch/fence the "
+                            "writer bumps, or annotate with "
+                            "`# dynalint: interleave-ok=<where the "
+                            "revalidation actually lives>`"),
+                        line_text=(lines[ln - 1].strip()
+                                   if 0 < ln <= len(lines) else ""))
+
+            # 2. REVALIDATION: re-mentioning the root or speaking the
+            # fence vocabulary clears staleness (generous by design)
+            text = _stmt_text(node)
+            if text:
+                fence = bool(_R21_FENCE_RE.search(text))
+                for name, (root, cap_ln, await_ln) in list(state.items()):
+                    if await_ln is not None and (fence or root in text):
+                        state[name] = (root, cap_ln, None)
+
+            # 3. AWAIT: suspension makes every live capture stale
+            if _contains_await(node):
+                ln = getattr(node, "lineno", 0)
+                for name, (root, cap_ln, await_ln) in list(state.items()):
+                    if await_ln is None:
+                        state[name] = (root, cap_ln, ln)
+
+            # 4. DEFS: new captures enter clean; other bindings kill
+            for name, val in _bindings(node).items():
+                root = _capture_root(val) if isinstance(val, ast.AST) \
+                    else None
+                if root is not None:
+                    state[name] = (root, getattr(node, "lineno", 0), None)
+                else:
+                    state.pop(name, None)
+
+            if state != env_out.get(id(node)):
+                env_out[id(node)] = state
+                changed = True
+        if not changed:
+            break
+
+    return [hits[k] for k in sorted(hits)]
+
+
+def r21_await_interleaving_toctou(tree: ast.AST, lines: List[str],
+                                  path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R21_SCOPE):
+        return []
+    out: List[Finding] = []
+    for fn in ast.walk(tree):
+        if isinstance(fn, ast.AsyncFunctionDef):
+            out.extend(_analyze_fn(fn, lines, path))
+    return out

@@ -2,7 +2,8 @@
 
 The AST layer catches source-level bug classes; this layer traces the
 engine's actual jitted entry points (decode window, verify step,
-prefill step, paged-attention kernels, sampler) with abstract
+prefill step, paged-attention kernels, sampler, the sharded page-slice
+injection, and the pp x kv_quant pipeline forward) with abstract
 bucket-shaped inputs and asserts invariants on the resulting jaxprs —
 the closest a Python/JAX rebuild gets to the compile-time guarantees
 NVIDIA Dynamo buys from rustc (PAPER.md §1). Tracing is cheap (no
@@ -318,6 +319,58 @@ def audit_engine_entry_points() -> List[Finding]:
         "sampler", sampler_entry,
         f32((s, cfg.vocab_size)), f32((s,)), i32((s,)),
         jnp.ones((s,), jnp.float32), i32((s,)), i32((s,)), i32((s,)))
+
+    # sharded parallel KV injection (disagg data plane): one compiled
+    # program per shard-plan entry — static slice bounds, donated cache,
+    # page ids as the only data. Audited on a real kv_shard_layout entry
+    # so the slice/donation contract can't drift from the planner.
+    from dynamo_tpu.engine.engine import _inject_pages_slice
+    from dynamo_tpu.parallel.mesh import kv_shard_layout, make_mesh
+
+    nb = 3
+    plan = kv_shard_layout(cfg.num_layers, cfg.num_kv_heads,
+                           n_streams=cfg.num_kv_heads)
+    sl = plan[0]
+    count = sl[0][2]
+    slice_pages = {
+        "k": f32((cfg.num_layers, count, nb, ps, cfg.head_dim)),
+        "v": f32((cfg.num_layers, count, nb, ps, cfg.head_dim)),
+    }
+    inject_fn = functools.partial(_inject_pages_slice,
+                                  slices=tuple(tuple(x) for x in sl))
+    inject_args = (cache, i32((nb,)), slice_pages)
+    findings += trace_and_audit("inject_pages_shard", inject_fn,
+                                *inject_args)
+    findings += audit_donation("inject_pages_shard", inject_fn, (0,),
+                               *inject_args)
+
+    # pp x kv_quant stage scan: the pipeline forward threads int8 value
+    # shards AND their paired f32 scale stacks through the stage scan
+    # (models/pp.py _stage -> write_kv_pages_quant). pp adapts to the
+    # device count so the audit also runs on a single-device CLI
+    # invocation (tier-1 runs with 8 virtual CPU devices).
+    from dynamo_tpu.models.llama import AttnMetadata
+    from dynamo_tpu.models.pp import pp_forward
+
+    cfg_q = ModelConfig(name="dynalint-audit-ppq", dtype="float32",
+                        vocab_size=128, hidden_size=64,
+                        intermediate_size=128, num_layers=2, num_heads=4,
+                        num_kv_heads=2, head_dim=16, max_model_len=64,
+                        decode_kernel="off", kv_quant="int8")
+    pp = 2 if len(jax.devices()) >= 2 else 1
+    mesh = make_mesh(pp=pp, devices=jax.devices()[:pp])
+    params_q = _zeros_like_shape(jax.eval_shape(
+        functools.partial(llama.init_params, cfg=cfg_q),
+        jax.random.PRNGKey(0)))
+    cache_q = _zeros_like_shape(jax.eval_shape(functools.partial(
+        llama.init_cache, cfg_q, num_pages=pages, page_size=ps)))
+    meta = AttnMetadata(positions=i32((s, tq)), page_table=i32((s, pb)),
+                        kv_lens=i32((s,)), write_idx=i32((s, tq)))
+    tokens = i32((s, tq))
+    findings += trace_and_audit(
+        "pp_forward_kv_quant",
+        lambda p, c: pp_forward(p, cfg_q, tokens, c, meta, mesh),
+        params_q, cache_q)
 
     findings += audit_bucket_ladder(
         "prefill_bucket_ladder", (8, 16, 32), next_bucket)

@@ -133,7 +133,6 @@ class LocalTransferBackend(TransferBackend):
     async def _send_pages_inner(self, engine_id: str, request_id: str, ids,
                                 k_pages, v_pages, k_scale, v_scale,
                                 span, alloc_epoch: int = 0) -> None:
-        worker = self._receivers[engine_id]
         if faults.REGISTRY.enabled \
                 and faults.REGISTRY.armed("remote_transfer.fetch_page"):
             # chaos mode: route through a host staging hop so the
@@ -143,6 +142,18 @@ class LocalTransferBackend(TransferBackend):
             # the fast path below never leaves the device)
             k_pages, v_pages, k_scale, v_scale = await self._verified_stage(
                 request_id, ids, k_pages, v_pages, k_scale, v_scale)
+        # Read the receiver AFTER the (possible) staging await: the hop
+        # yields the event loop, and a worker snapshot taken before it
+        # would submit the injection to an engine that deregistered in
+        # the meantime (R21) — the inject-side epoch fence guards page
+        # reallocation within a live engine, not a corpse handle. From
+        # here to worker.submit() nothing suspends, so the read is
+        # use-time fresh.
+        worker = self._receivers.get(engine_id)
+        if worker is None:
+            raise KeyError(
+                f"decode engine {engine_id!r} deregistered during "
+                "transfer staging")
         # The cross-mesh move + relayout: place the pages with the decode
         # engine's cache sharding (ICI/DCN transfer; resharding handles
         # prefill-TP != decode-TP, the kv_rearrange equivalent).

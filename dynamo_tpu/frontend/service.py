@@ -344,8 +344,8 @@ class HttpService:
         # the admission wait is already inside it. The context rides
         # ctx.baggage and crosses every wire hop from here on. The root
         # span ends in finish() below (every exit funnels there) —
-        # dynalint: span-ok=root-span-ends-in-the-idempotent-finish-callback
         trace = TRACER.start_trace()
+        # dynalint: span-ok=root-span-ends-in-the-idempotent-finish-callback
         root = TRACER.begin_span("http.request", trace, model=model,
                                  endpoint=endpoint,
                                  request_type=request_type)

@@ -153,6 +153,57 @@ def test_engine_remote_alloc_inject_activate_matches_local():
     assert toks == expect
 
 
+def test_transfer_receiver_deregisters_during_staging():
+    """Regression for the await-interleaving race in LocalTransferBackend:
+    the chaos-mode staging hop suspends, and the receiver registry can
+    lose the decode engine while the event loop is yielded. The backend
+    must re-read the registry after the hop and fail loudly instead of
+    submitting the injection through the pre-await corpse handle."""
+    from dynamo_tpu.runtime import faults
+    from dynamo_tpu.runtime.faults import FaultSchedule, FaultSpec
+
+    prompt = list(range(40, 60))
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+
+    class ChurnTransfer(LocalTransferBackend):
+        async def _verified_stage(self, request_id, ids, k_pages, v_pages,
+                                  k_scale=None, v_scale=None):
+            staged = await LocalTransferBackend._verified_stage(
+                request_id, ids, k_pages, v_pages, k_scale, v_scale)
+            # the watch pump culls the decode worker while the staging
+            # hop held the loop — exactly the interleaving under test
+            self.unregister("dec-0")
+            return staged
+
+    async def main():
+        prefill_eng = make_engine()
+        decode_eng = make_engine()
+        alloc = decode_eng.allocate_remote(EngineRequest("r", prompt, params))
+        assert alloc is not None
+        prefill_eng.add_request(
+            EngineRequest("r", prompt, params, prefill_only=True))
+        while prefill_eng.has_work():
+            prefill_eng.step()
+        pages = prefill_eng.extract_pages(
+            prefill_eng.scheduler.parked["r"].pages)
+        transfer = ChurnTransfer()
+        transfer.register("dec-0", NativeEngineWorker(decode_eng))
+        # arm the staging site with a never-firing spec (p=0): the pages
+        # route device -> host -> device, which is where the await lives,
+        # but no corruption is ever injected
+        faults.REGISTRY.arm("remote_transfer.fetch_page",
+                            FaultSchedule(0, [FaultSpec("corrupt", p=0.0)]))
+        try:
+            with pytest.raises(KeyError, match="deregistered during"):
+                await transfer.send_pages(
+                    "dec-0", "r", alloc.page_ids, pages["k"], pages["v"],
+                    alloc_epoch=alloc.alloc_epoch)
+        finally:
+            faults.REGISTRY.disarm()
+
+    asyncio.run(main())
+
+
 # -- full worker-level disagg flow --------------------------------------------
 
 async def _drive(worker_gen):

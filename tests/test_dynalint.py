@@ -1381,6 +1381,327 @@ def test_r19_live_on_preemption_call_sites():
             (rel, [x.message for x in found if x.rule == "R19"])
 
 
+# -- layer 3: flow-sensitive escapes closed (flow.py) --------------------------
+#
+# One paired fixture per escape that docs/ANALYSIS.md used to list as a
+# "Static limitation": the positive is a shape the PRE-flow lexical rule
+# provably missed (the bug hides behind a name binding), the negative is
+# the legitimate idiom the new recognition must keep quiet on.
+
+def test_r7_flow_flags_timeout_variable_that_is_always_none():
+    # pre-flow escape: `timeout=deadline` satisfied the lexical
+    # "has a timeout kwarg" check even when the variable is None on
+    # every reaching path — asyncio's wait-forever with extra steps
+    leaky = """
+        async def dispatch(messaging, subject, payload):
+            deadline = None
+            return await messaging.request(subject, payload,
+                                           timeout=deadline)
+    """
+    found = lint_source(textwrap.dedent(leaky),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert "R7" in rules(found)
+
+
+def test_r7_flow_quiet_when_variable_may_hold_a_budget():
+    # a real constant budget through a binding: quiet
+    bounded = """
+        async def dispatch(messaging, subject, payload):
+            t = 30.0
+            return await messaging.request(subject, payload, timeout=t)
+    """
+    found = lint_source(textwrap.dedent(bounded),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert "R7" not in rules(found)
+    # one path None, one path bounded: MAY hold a budget — benefit of
+    # the doubt (the rule only fires on an all-paths-None proof)
+    maybe = """
+        async def dispatch(messaging, subject, payload, fast):
+            t = None
+            if fast:
+                t = 5.0
+            return await messaging.request(subject, payload, timeout=t)
+    """
+    found = lint_source(textwrap.dedent(maybe),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert "R7" not in rules(found)
+    # parameter-fed timeout: incomplete constant set, no claim
+    param = """
+        async def dispatch(messaging, subject, payload, t=None):
+            return await messaging.request(subject, payload, timeout=t)
+    """
+    found = lint_source(textwrap.dedent(param),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert "R7" not in rules(found)
+
+
+def test_r14_flow_flags_timeout_variable_that_is_always_none():
+    leaky = """
+        from dynamo_tpu.runtime.transports.wire import read_frame
+
+        async def pump(reader):
+            t = None
+            return await read_frame(reader, timeout=t)
+    """
+    found = lint_source(textwrap.dedent(leaky),
+                        "dynamo_tpu/runtime/transports/fixture.py")
+    assert "R14" in rules(found)
+
+
+def test_r14_flow_quiet_on_bound_timeout_variable():
+    bounded = """
+        from dynamo_tpu.runtime.transports.wire import read_frame
+
+        async def pump(reader):
+            t = 5.0
+            return await read_frame(reader, timeout=t)
+    """
+    found = lint_source(textwrap.dedent(bounded),
+                        "dynamo_tpu/runtime/transports/fixture.py")
+    assert "R14" not in rules(found)
+
+
+def test_r10_flow_follows_len_through_a_binding():
+    # pre-flow escape: `n = len(batch)` one statement before the
+    # allocation hid the data-dependent dim from the lexical
+    # "len() inside the shape element" check
+    leaky = """
+        import numpy as np
+
+        def _build_mixed(batch, tb):
+            n = len(batch)
+            tokens = np.zeros((n, tb), np.int32)
+            return tokens
+    """
+    found = lint_source(textwrap.dedent(leaky),
+                        "dynamo_tpu/engine/scheduler_fixture.py")
+    assert "R10" in rules(found)
+
+
+def test_r10_flow_quiet_when_len_is_laundered_through_a_bucket():
+    # the binding derives from len() but passes through next_bucket():
+    # admission-stable, exactly the idiom the planners use
+    bucketed = """
+        import numpy as np
+
+        def _build_mixed(batch, tb, buckets):
+            n = next_bucket(len(batch), buckets)
+            tokens = np.zeros((n, tb), np.int32)
+            return tokens
+    """
+    found = lint_source(textwrap.dedent(bucketed),
+                        "dynamo_tpu/engine/scheduler_fixture.py")
+    assert "R10" not in rules(found)
+
+
+def test_r11_flow_tracks_cache_leaf_alias_into_float_math():
+    # pre-flow escape: the annotated whole-page read was sanctioned,
+    # but the ALIAS carried the quantized bytes into .astype(float)
+    # three lines later where the lexical rule could not see them
+    leaky = """
+        import jax.numpy as jnp
+
+        def leaky_alias(cache, page_table):
+            # dynalint: kv-codec — whole-page move keeps representation
+            k = cache["k"]
+            moved = jnp.take(k, page_table, axis=2)
+            cast = k.astype(jnp.float32)
+            return moved, cast
+    """
+    found = lint_source(textwrap.dedent(leaky),
+                        "dynamo_tpu/models/fixture.py")
+    assert len([f for f in found if f.rule == "R11"]) == 1  # the astype
+    # and through a cache-dict alias + arithmetic, same escape
+    arith = """
+        def mix(cache, scale):
+            kv = cache
+            k = kv["k"]
+            return k * scale
+    """
+    found = lint_source(textwrap.dedent(arith),
+                        "dynamo_tpu/models/fixture.py")
+    assert "R11" in rules(found)
+
+
+def test_r11_flow_quiet_on_representation_preserving_alias_use():
+    # the alias only feeds whole-page moves / a dequantizing consumer:
+    # no astype-to-float, no raw arithmetic — quiet
+    neg = """
+        import jax.numpy as jnp
+        from dynamo_tpu.ops.kv_quant import dequantize_rows
+
+        def codec_path(cache, page_table):
+            # dynalint: kv-codec — whole-page move keeps representation
+            k = cache["k"]
+            g = jnp.take(k, page_table, axis=1)
+            return dequantize_rows(g, None, jnp.bfloat16)
+    """
+    found = lint_source(textwrap.dedent(neg),
+                        "dynamo_tpu/models/fixture.py")
+    assert "R11" not in rules(found)
+    # annotated downstream cast: the codec site moved, the annotation
+    # moved with it
+    annotated = """
+        import jax.numpy as jnp
+
+        def codec_cast(cache):
+            # dynalint: kv-codec — capture for the dequant below
+            k = cache["k"]
+            # dynalint: kv-codec — dequant entry, scales applied inside
+            return k.astype(jnp.float32)
+    """
+    found = lint_source(textwrap.dedent(annotated),
+                        "dynamo_tpu/models/fixture.py")
+    assert "R11" not in rules(found)
+
+
+def test_r13_flow_flags_leak_despite_unrelated_try_finally():
+    # pre-flow escape: the old heuristic blessed EVERY begin_span in a
+    # function where SOME try/finally ended a span — this early return
+    # leaks before the try is ever entered, and only the CFG sees it
+    leaky = """
+        from dynamo_tpu.runtime.tracing import TRACER
+
+        async def serve_one(trace, req):
+            span = TRACER.begin_span("serve", trace)
+            if req.bad:
+                return None          # leaks: the finally is never reached
+            try:
+                return await req.run()
+            finally:
+                TRACER.end_span(span)
+    """
+    assert "R13" in rules(lint(leaky))
+
+
+def test_r13_flow_proves_branch_complete_and_loop_exit_endings():
+    # branch-complete ending, no try/finally anywhere: the must-reach
+    # proof is the only thing keeping this quiet
+    branchy = """
+        from dynamo_tpu.runtime.tracing import TRACER
+
+        def run_one(trace, req):
+            span = TRACER.begin_span("serve", trace)
+            if req.fast:
+                out = req.fast_path()
+            else:
+                out = req.slow_path()
+            TRACER.end_span(span)
+            return out
+    """
+    assert "R13" not in rules(lint(branchy))
+    # continue inside try/finally: the back edge routes THROUGH the
+    # finally, so every attempt's span still ends (the reliability
+    # retry-machine shape)
+    retry = """
+        from dynamo_tpu.runtime.tracing import TRACER
+
+        async def retry_loop(trace, req):
+            while True:
+                span = TRACER.begin_span("attempt", trace)
+                try:
+                    r = await req.run()
+                    if r is None:
+                        continue
+                    return r
+                finally:
+                    TRACER.end_span(span)
+    """
+    assert "R13" not in rules(lint(retry))
+    # span factory: the begin's result is returned — ownership (and the
+    # end obligation) transfers to the caller
+    factory = """
+        from dynamo_tpu.runtime.tracing import TRACER
+
+        def open_span(trace):
+            return TRACER.begin_span("serve", trace)
+    """
+    assert "R13" not in rules(lint(factory))
+
+
+# -- R21: await-interleaving TOCTOU (interleave.py) ----------------------------
+
+R21_SRC = """
+    async def route(self, rid, payload):
+        worker = self.workers[rid]
+        await self.queue.put(rid)
+        return await worker.dispatch(payload)
+"""
+
+
+def test_r21_flags_stale_snapshot_committed_after_await():
+    found = lint_source(textwrap.dedent(R21_SRC),
+                        "dynamo_tpu/runtime/fixture.py")
+    r21 = [f for f in found if f.rule == "R21"]
+    assert len(r21) == 1
+    assert "worker" in r21[0].message and "self.workers" in r21[0].message
+
+
+def test_r21_quiet_outside_async_control_plane_scope():
+    found = lint_source(textwrap.dedent(R21_SRC),
+                        "dynamo_tpu/models/fixture.py")
+    assert "R21" not in rules(found)
+
+
+def test_r21_quiet_on_post_await_reread_and_fence():
+    reread = """
+        async def route(self, rid, payload):
+            worker = self.workers[rid]
+            await self.queue.put(rid)
+            worker = self.workers.get(rid)   # use-time re-read
+            if worker is None:
+                raise KeyError(rid)
+            return await worker.dispatch(payload)
+    """
+    found = lint_source(textwrap.dedent(reread),
+                        "dynamo_tpu/runtime/fixture.py")
+    assert "R21" not in rules(found)
+    fenced = """
+        async def commit_pages(self, rid, pages):
+            seq = self.pending[rid]
+            await self._stage(pages)
+            if seq.epoch != self.lease_epoch(rid):   # fence check
+                raise KeyError(rid)
+            return seq.commit(pages)
+    """
+    found = lint_source(textwrap.dedent(fenced),
+                        "dynamo_tpu/disagg/fixture.py")
+    assert "R21" not in rules(found)
+
+
+def test_r21_quiet_on_interleave_ok_annotation():
+    annotated = """
+        async def route(self, rid, payload):
+            worker = self.workers[rid]
+            await self.queue.put(rid)
+            # dynalint: interleave-ok=dispatch-revalidates-liveness-and-
+            # raises-on-a-deregistered-worker
+            return await worker.dispatch(payload)
+    """
+    found = lint_source(textwrap.dedent(annotated),
+                        "dynamo_tpu/runtime/fixture.py")
+    assert "R21" not in rules(found)
+
+
+def test_r21_live_on_async_control_plane():
+    """The R21 sweep stays fully triaged: zero unannotated stale-snapshot
+    commits across runtime/, disagg/, frontend/, kv_router/ (the one
+    real race it found — LocalTransferBackend's pre-staging receiver
+    snapshot — is FIXED, with a regression test in test_disagg.py)."""
+    import glob
+    scoped = []
+    for pat in ("dynamo_tpu/runtime/**/*.py", "dynamo_tpu/disagg/*.py",
+                "dynamo_tpu/frontend/*.py", "dynamo_tpu/kv_router/*.py"):
+        scoped.extend(glob.glob(os.path.join(REPO, pat), recursive=True))
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R21"], \
+            (rel, [x.message for x in found if x.rule == "R21"])
+
+
 # -- jaxpr invariants ----------------------------------------------------------
 
 def test_j1_flags_float64_leak():
@@ -1514,3 +1835,87 @@ def test_cli_exits_zero_on_clean_tree():
          "--no-jaxpr"],
         capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_json_round_trips_findings(tmp_path):
+    """--json emits findings that reconstruct into Finding objects, and
+    exit-code semantics are unchanged by the output format."""
+    import subprocess
+    import sys
+    bad = tmp_path / "frontend"
+    bad.mkdir()
+    src = textwrap.dedent("""
+        async def dispatch(messaging, subject, payload):
+            deadline = None
+            return await messaging.request(subject, payload,
+                                           timeout=deadline)
+    """)
+    (bad / "leaky.py").write_text(src)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "dynalint.py"),
+         "--no-jaxpr", "--no-baseline", "--json", str(bad / "leaky.py")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["fresh"] == len(payload["findings"]) >= 1
+    revived = [Finding(**d) for d in payload["findings"]]
+    assert any(f.rule == "R7" for f in revived)
+    assert all(f.line_text for f in revived)
+
+
+def test_cli_changed_lints_only_the_merge_base_diff():
+    """--changed scopes the lint to .py files changed vs the merge-base
+    (plus untracked) and stays machine-readable with --json; on the
+    current working tree it must agree with the full-tree gate (clean)."""
+    import subprocess
+    import sys
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "dynalint.py"),
+         "--changed", "--json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["fresh"] == 0
+    for name in payload.get("files", []):
+        assert name.endswith(".py") and ".." not in name
+        assert os.path.exists(os.path.join(REPO, name))
+
+
+# -- layer-3 cost: the memo and the wall-clock bound ---------------------------
+
+def test_flow_layer_rides_the_lint_source_memo(monkeypatch):
+    """Repeated passes over an unchanged file are served from the
+    content-keyed memo: the flow/CFG solve happens once per (path,
+    content), not once per live gate. Proven by making re-parse
+    impossible and linting again."""
+    from dynamo_tpu.analysis import runner
+    src = textwrap.dedent(R21_SRC)
+    path = "dynamo_tpu/runtime/memo_fixture.py"
+    first = lint_source(src, path)
+    assert (path, hash(src)) in runner._LINT_CACHE
+
+    def boom(*a, **k):  # pragma: no cover
+        raise AssertionError("memo miss: re-analyzed an unchanged file")
+
+    monkeypatch.setattr(runner.ast, "parse", boom)
+    second = lint_source(src, path)
+    assert second == first
+    assert second is not first  # defensive copy, not the cached list
+
+
+def test_flow_layer_wall_time_is_bounded():
+    """One COLD full-tree pass (memo defeated by a content salt, so
+    every file re-runs all rules including the layer-3 CFG/dataflow
+    solves) stays a small fraction of the 870s tier-1 budget."""
+    import glob
+    import time
+    files = sorted(glob.glob(os.path.join(REPO, "dynamo_tpu/**/*.py"),
+                             recursive=True))
+    assert len(files) > 50
+    t0 = time.monotonic()
+    for path in files:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            lint_source(f.read() + "\n# cold-pass salt\n", rel)
+    dt = time.monotonic() - t0
+    assert dt < 120.0, f"cold full-tree lint took {dt:.1f}s"

@@ -6,6 +6,10 @@ Usage:
     python tools/dynalint.py --no-jaxpr          # AST layer only
     python tools/dynalint.py --write-baseline    # regenerate the baseline
     python tools/dynalint.py --no-baseline       # show ALL findings
+    python tools/dynalint.py --changed           # only files changed vs
+                                                 # the merge-base (implies
+                                                 # --no-jaxpr)
+    python tools/dynalint.py --json              # machine-readable output
 
 Exit code 0 when every finding is covered by tools/dynalint_baseline.json
 (or inline `# dynalint: disable=Rn` annotations), 1 otherwise — so the
@@ -15,6 +19,8 @@ entry points under the tier-1 pytest gate. See docs/ANALYSIS.md.
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import json
 import os
 import sys
 
@@ -23,6 +29,38 @@ sys.path.insert(0, REPO_ROOT)
 
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools",
                                 "dynalint_baseline.json")
+
+
+def changed_py_files(root: str = REPO_ROOT):
+    """Python files changed vs the merge-base with the main branch, plus
+    untracked ones — the pre-push fast path. Returns repo-relative
+    forward-slash paths; raises RuntimeError when git is unusable."""
+    import subprocess
+
+    def git(*cmd):
+        return subprocess.run(
+            ("git",) + cmd, cwd=root, capture_output=True, text=True,
+            timeout=30)
+
+    base = None
+    for ref in ("origin/main", "origin/master", "main", "master"):
+        r = git("merge-base", "HEAD", ref)
+        if r.returncode == 0:
+            base = r.stdout.strip()
+            break
+    if base is None:
+        # detached/shallow fallback: everything in the working tree vs HEAD
+        base = "HEAD"
+    diff = git("diff", "--name-only", base, "--")
+    if diff.returncode != 0:
+        raise RuntimeError(f"git diff failed: {diff.stderr.strip()}")
+    untracked = git("ls-files", "--others", "--exclude-standard")
+    names = set(diff.stdout.splitlines())
+    if untracked.returncode == 0:
+        names |= set(untracked.stdout.splitlines())
+    return sorted(
+        n.replace("\\", "/") for n in names
+        if n.endswith(".py") and os.path.exists(os.path.join(root, n)))
 
 
 def main(argv=None) -> int:
@@ -43,13 +81,34 @@ def main(argv=None) -> int:
     ap.add_argument("--no-jaxpr", action="store_true",
                     help="skip the layer-2 jaxpr audit (pure AST lint; "
                          "no jax import)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only .py files changed vs the merge-base "
+                         "with main (plus untracked); implies --no-jaxpr")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON on stdout (exit code "
+                         "semantics unchanged)")
     args = ap.parse_args(argv)
 
     from dynamo_tpu.analysis import (
         filter_baseline, load_baseline, run_lint, save_baseline,
     )
 
-    findings = run_lint(args.paths, root=REPO_ROOT)
+    paths = args.paths
+    if args.changed:
+        # diff-scoped fast path: whole-program jaxpr audit makes no sense
+        # against a file subset, so the layer-2 pass is skipped
+        args.no_jaxpr = True
+        names = changed_py_files()
+        paths = [os.path.join(REPO_ROOT, n) for n in names]
+        if not paths:
+            if args.as_json:
+                print(json.dumps({"findings": [], "fresh": 0,
+                                  "baselined": 0, "files": []}))
+            else:
+                print("dynalint: no changed python files")
+            return 0
+
+    findings = run_lint(paths, root=REPO_ROOT)
     if not args.no_jaxpr:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         from dynamo_tpu.analysis import audit_engine_entry_points
@@ -62,6 +121,17 @@ def main(argv=None) -> int:
 
     baseline = None if args.no_baseline else load_baseline(args.baseline)
     fresh = filter_baseline(findings, baseline)
+    if args.as_json:
+        payload = {
+            "findings": [dataclasses.asdict(f) for f in fresh],
+            "fresh": len(fresh),
+            "baselined": len(findings) - len(fresh),
+        }
+        if args.changed:
+            payload["files"] = [os.path.relpath(p, REPO_ROOT)
+                                .replace("\\", "/") for p in paths]
+        print(json.dumps(payload, indent=2, sort_keys=True))
+        return 1 if fresh else 0
     for f in fresh:
         print(f.render())
     suppressed = len(findings) - len(fresh)
