@@ -83,7 +83,8 @@ PHASE_STALL_S = {
     "churn": 150.0,
     "transfer_overlap": 300.0,   # two extra engine builds (disagg pair)
     "sharded_transfer": 300.0,   # disagg pair reused, paced transfer legs
-    "warm_prefix": 300.0,        # four engine builds sharing one program set
+    "warm_prefix": 420.0,        # seven engine builds sharing one program set
+                                 # (4 local-pool rungs + 3 remote-pool rungs)
     "parity": 300.0,         # second engine build + single-step compiles
     "spec_ceiling": 600.0,   # spec-twin engine build + verify compile
 }
@@ -419,6 +420,13 @@ def supervise() -> int:
                         wp.get("pool_fetch_cold_ttft_ratio"),
                     f"warm_prefix_prefetch_fetch_ttft_ratio_{suffix}":
                         wp.get("prefetch_fetch_ttft_ratio"),
+                    # remote-pool rungs (ISSUE 17): cross-HOST replica-
+                    # walk fetch TTFT over cold must stay under the cold
+                    # ceiling — both gated "lower" in BASELINE.json
+                    f"warm_prefix_remote_fetch_ttft_ratio_{suffix}":
+                        wp.get("remote_fetch_cold_ttft_ratio"),
+                    f"warm_prefix_remote_prefetch_ttft_ratio_{suffix}":
+                        wp.get("remote_prefetch_fetch_ttft_ratio"),
                     # sharded parallel transfer (ISSUE 15): N-stream /
                     # 1-stream wall time under per-host-NIC pacing, and
                     # the disagg TTFT ratio — both gated "lower"
@@ -1233,20 +1241,31 @@ def run_warm_prefix(model_cfg, base_kwargs=None, *, requests=4,
     4. pool_prefetch — engine B additionally warmed the pages into HBM
                      during a simulated admission wait
                      (engine.prefetch_pool_pages, the PRESERVE window),
-                     so the walk hits device memory.
+                     so the walk hits device memory;
+    5. remote_fetch — the prefixes live in the served, replicated
+                     ClusterKvPool (engine/pool_service.py: hash-ring
+                     placement over 2 KvPoolHosts, R=2, checksum
+                     re-verify on the serving host), and a fresh engine
+                     serves by fetching through the replica walk — the
+                     cross-HOST rung ISSUE 17 adds;
+    6. remote_prefetch — same cluster pool, pages warmed through the
+                     PRESERVE window before admission.
 
     Distinct shared prefixes per measured request keep each fetch
     genuinely cold on the serving engine; every TTFT sample is also
     observed into the llm_ttft_seconds histogram (SERVING.ttft).
     Greedy token identity pool-vs-cold is asserted inline — a pool
     serve that changed tokens would poison the measurement. CPU
-    validation proves plumbing + ratio direction; the TPU ladder item
-    (BENCH_SELF_r13_warm_prefix_tpu) gives the hardware verdict."""
+    validation proves plumbing + ratio direction; the TPU ladder items
+    (BENCH_SELF_r13_warm_prefix_tpu, BENCH_SELF_r17_pool_remote_tpu)
+    give the hardware verdict."""
     import time as _time
 
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.engine import NativeEngine
     from dynamo_tpu.engine.kv_pool import POOL_STATS, SharedKvPool
+    from dynamo_tpu.engine.pool_service import (REMOTE_STATS, ClusterKvPool,
+                                                KvPoolHost)
     from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
     from dynamo_tpu.observability.serving import SERVING
 
@@ -1315,10 +1334,12 @@ def run_warm_prefix(model_cfg, base_kwargs=None, *, requests=4,
         touch()
 
     cold_v, local_v, fetch_v, pre_v = [], [], [], []
+    cold_toks_by_i = {}
     identical = True
     for i in range(1, requests + 1):
         prompt = prefix(i) + tail(i)
         dt, cold_toks = ttft(cold, f"cold-{i}", prompt)
+        cold_toks_by_i[i] = cold_toks
         cold_v.append(dt)
         dt, _ = ttft(cold, f"local-{i}", prompt)   # same engine: HBM hit
         local_v.append(dt)
@@ -1339,6 +1360,47 @@ def run_warm_prefix(model_cfg, base_kwargs=None, *, requests=4,
         eng.close()
     del a, cold, b, c
 
+    # 5./6. REMOTE rungs: the pool as a served cluster component —
+    # 2 KvPoolHosts behind a consistent-hash ring, R=2, every fetch
+    # checksum-verified on the serving host before it crosses back
+    # (ISSUE 17; failure model in docs/RESILIENCE.md). The facade is
+    # interface-identical to SharedKvPool, so attach/publish/claim and
+    # the PRESERVE prefetch path are the production code paths.
+    cluster = ClusterKvPool(replicas=2)
+    for hid in ("bench-ph0", "bench-ph1"):
+        cluster.add_host(KvPoolHost(hid, capacity_pages=kw["num_pages"] * 2))
+    cluster.run_rebalance()      # drain the (empty) join handoffs
+    a2 = build(cluster, "warm-ra")
+    for i in range(requests + 1):
+        a2.generate(prefix(i), params, f"rseed-{i}")
+        a2.drain_kv_events()
+        touch()
+    a2._pool_stream.drain()
+    d = build(cluster, "warm-rd")
+    e = build(cluster, "warm-re")
+    for eng, tag in ((d, "w3"), (e, "w4")):
+        ttft(eng, f"warm-{tag}", prefix(0) + tail(0))
+        touch()
+    remote_v, rpre_v = [], []
+    for i in range(1, requests + 1):
+        prompt = prefix(i) + tail(i)
+        fetched_before = REMOTE_STATS.snapshot()["fetch_pages"]
+        dt, rtoks = ttft(d, f"rfetch-{i}", prompt)
+        remote_v.append(dt)
+        identical &= rtoks == cold_toks_by_i[i]
+        assert REMOTE_STATS.snapshot()["fetch_pages"] > fetched_before, \
+            "remote-fetch mode served without a cluster fetch " \
+            "(measurement void)"
+        warmed = e.prefetch_pool_pages(prompt)
+        assert warmed >= shared_pages - 1, \
+            f"remote prefetch warmed {warmed} < {shared_pages - 1} pages"
+        dt, _ = ttft(e, f"rpre-{i}", prompt)
+        rpre_v.append(dt)
+        touch()
+    for eng in (a2, d, e):
+        eng.close()
+    del a2, d, e
+
     result = {
         "shared_len": shared_len, "requests": requests,
         "pool_entries_seeded": seeded_entries,
@@ -1346,22 +1408,37 @@ def run_warm_prefix(model_cfg, base_kwargs=None, *, requests=4,
         "local_hit_ttft_p50_ms": p50(local_v),
         "pool_fetch_ttft_p50_ms": p50(fetch_v),
         "pool_prefetch_ttft_p50_ms": p50(pre_v),
+        "remote_fetch_ttft_p50_ms": p50(remote_v),
+        "remote_prefetch_ttft_p50_ms": p50(rpre_v),
         "pool_fetch_cold_ttft_ratio":
             round(p50(fetch_v) / max(p50(cold_v), 1e-9), 3),
         "prefetch_fetch_ttft_ratio":
             round(p50(pre_v) / max(p50(fetch_v), 1e-9), 3),
+        "remote_fetch_cold_ttft_ratio":
+            round(p50(remote_v) / max(p50(cold_v), 1e-9), 3),
+        "remote_prefetch_fetch_ttft_ratio":
+            round(p50(rpre_v) / max(p50(remote_v), 1e-9), 3),
         "token_identity_greedy": identical,
         "pool_counters": {k: POOL_STATS.snapshot()[k] for k in (
             "publishes", "dedup_hits", "fetch_hits", "fetch_misses",
             "prefetch_pages", "quarantined")},
+        "remote_counters": {k: REMOTE_STATS.snapshot()[k] for k in (
+            "fetch_pages", "fetch_failovers", "fetch_exhausted",
+            "publishes", "stale_epoch_rejected", "stale_epoch_landed")},
     }
+    assert result["remote_counters"]["stale_epoch_landed"] == 0, \
+        "stale-epoch write LANDED during bench (fence violated)"
     logf(f"warm-prefix TTFT p50: cold {result['cold_ttft_p50_ms']}ms, "
          f"local-hit {result['local_hit_ttft_p50_ms']}ms, pool-fetch "
          f"{result['pool_fetch_ttft_p50_ms']}ms "
          f"({result['pool_fetch_cold_ttft_ratio']}x cold), pool-prefetch "
          f"{result['pool_prefetch_ttft_p50_ms']}ms "
-         f"({result['prefetch_fetch_ttft_ratio']}x fetch); greedy "
-         f"identity {'OK' if identical else 'BROKEN'}")
+         f"({result['prefetch_fetch_ttft_ratio']}x fetch), remote-fetch "
+         f"{result['remote_fetch_ttft_p50_ms']}ms "
+         f"({result['remote_fetch_cold_ttft_ratio']}x cold), "
+         f"remote-prefetch {result['remote_prefetch_ttft_p50_ms']}ms "
+         f"({result['remote_prefetch_fetch_ttft_ratio']}x remote-fetch); "
+         f"greedy identity {'OK' if identical else 'BROKEN'}")
     touch()
     return result
 
@@ -1915,10 +1992,18 @@ def worker():
 
         def oracle_propose(tokens, k, min_ngram=2, max_ngram=4,
                            max_scan=4096):
+            vocab = model_cfg.vocab_size
             for p, full in oracle.items():
                 lp = len(p)
                 if len(tokens) >= lp and tuple(tokens[:lp]) == p:
-                    return full[len(tokens):len(tokens) + k]
+                    out = full[len(tokens):len(tokens) + k]
+                    # truncate at the first id outside the vocab: the
+                    # recorded history feeds the verify forward's
+                    # embedding take verbatim (dynalint R1)
+                    for j, t in enumerate(out):
+                        if not 0 <= t < vocab:
+                            return out[:j]
+                    return out
             return []
 
         del engine  # free HBM before the spec twin (same seed => params)

@@ -153,6 +153,21 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       an epoch/frontier/fence/generation/corpse/alive/lease check —
       is the corpse-routing race class of PRs 7-15 mechanized; escape
       hatch `# dynalint: interleave-ok=<where revalidation lives>`
+- R22 placement-epoch contract (dynamo_tpu/ + tools/): any consumer of
+      a placement result — `owners_for(...)`, `ring.lookup(...)`, or
+      the pool-host resolution calls (`live_hosts(...)`,
+      `owner_hosts(...)`) — must sit in a function that visibly
+      references the ownership-epoch discipline (epoch|stale|fence|
+      re-resolve|watch|replica|rebalance vocabulary — receiver names
+      like `ring.`/`membership.` alone do NOT count; the HashRing
+      bumps its epoch on every join/leave, and a placement answer is
+      only valid under the epoch it was computed at) or carry
+      `# dynalint: ring-ok=<reason>`. A placement consumer that can't
+      point at the epoch is exactly where a refactor caches an owner
+      list across a membership change and writes to (or fetches from)
+      hosts that no longer own the key — the zombie-sender class of
+      bug, one layer down (runtime/placement.py is the placement layer
+      itself and is exempt, like ops/kv_quant.py for R11)
 """
 from __future__ import annotations
 
@@ -1829,6 +1844,95 @@ def r20_min_frontier_contract(tree: ast.AST, lines: List[str],
             "over per-stream frontiers (ShardedKvTransferGroup)' — or "
             "annotate with `# dynalint: frontier-ok=<why a single "
             "stream's frontier is safe here>`"))
+    return out
+
+
+# -- R22: placement results are only valid under their ownership epoch --------
+
+# Scope: the dynamo_tpu package and tools/ (the pool service, the
+# router's pool scoring, the schedulers, and any future bench/ops
+# driver all resolve consistent-hash placement). The cross-host pool
+# (engine/pool_service.py) made ownership DYNAMIC: the HashRing bumps
+# its epoch on every membership change, publishes carry that epoch and
+# serving hosts fence mismatches, and fetch walks re-resolve owners
+# per page. Every consumer of `owners_for(...)` / `ring.lookup(...)` /
+# the pool-host resolution calls is one refactor away from caching an
+# owner list across a join/leave and writing to hosts that no longer
+# own the key — the zombie-sender bug class, one layer down. Lexical
+# like R16/R18-R20: the enclosing function must write the
+# epoch/membership discipline down, or the call carries
+# `# dynalint: ring-ok=<reason>` within three lines above.
+# runtime/placement.py is the placement layer itself — exempt (the
+# R11 ops/kv_quant.py precedent).
+_R22_SCOPE = ("dynamo_tpu/", "tools/")
+_R22_EXEMPT = ("runtime/placement.py",)
+_R22_TERMINALS = {"owners_for", "live_hosts", "owner_hosts"}
+_R22_ANNOT_RE = re.compile(r"#\s*dynalint:\s*ring-ok=\S+")
+# receiver names alone (`ring.`, `membership.`) must NOT satisfy the
+# rule — every consumer spells those — so the vocabulary is the epoch
+# DISCIPLINE itself: when the answer goes stale and who fences it
+_R22_HANDLED_RE = re.compile(r"epoch|\bstale\b|fenc|re-?resolv|"
+                             r"\bwatch\b|replica|rebalanc|"
+                             r"membership +chang|join/leave", re.I)
+
+
+@rule("R22")
+def r22_placement_epoch_contract(tree: ast.AST, lines: List[str],
+                                 path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R22_SCOPE) \
+            or "tests/" in norm \
+            or any(part in norm for part in _R22_EXEMPT):
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R22_ANNOT_RE.search(_line(lines, x))
+                   for x in range(ln - 3, ln + 1))
+
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def enclosing_handles(ln: int) -> bool:
+        inner = None
+        for fn in funcs:
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= ln <= end and (
+                    inner is None or fn.lineno >= inner.lineno):
+                inner = fn
+        if inner is None:
+            lo, hi = max(1, ln - 10), min(len(lines), ln + 10)
+        else:
+            lo, hi = inner.lineno, getattr(inner, "end_lineno",
+                                           inner.lineno)
+        return any(_R22_HANDLED_RE.search(_line(lines, x))
+                   for x in range(lo, hi + 1))
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        terminal = name.rsplit(".", 1)[-1]
+        # bare `lookup` is too generic; only the ring's lookup counts
+        if terminal not in _R22_TERMINALS \
+                and not name.endswith("ring.lookup"):
+            continue
+        if annotated(node.lineno) or enclosing_handles(node.lineno):
+            continue
+        out.append(_finding(
+            "R22", path, lines, node,
+            f"`{name}(...)` consumes a consistent-hash placement "
+            "result without referencing the ownership-epoch / "
+            "membership discipline — the ring bumps its epoch on "
+            "every join/leave and a cached owner list is stale the "
+            "moment membership changes; a consumer that can't point "
+            "at the epoch is where a refactor writes to (or fetches "
+            "from) hosts that no longer own the key",
+            "state (docstring/comment) how this path tracks membership "
+            "— e.g. 'owners re-resolved per page; writes carry "
+            "ring_epoch and hosts fence mismatches' — or annotate "
+            "with `# dynalint: ring-ok=<why a stale owner list is "
+            "safe here>`"))
     return out
 
 

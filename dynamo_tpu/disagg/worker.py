@@ -853,6 +853,7 @@ class PrefillWorker:
                 raise ValueError(
                     f"page size mismatch: decode {req.page_size} != "
                     f"prefill {eng_ps}")
+            await self._touch_for_pool_claim(req, token)
             with TRACER.span("prefill.run", trace, request_id=rid,
                              tokens=len(req.token_ids)):
                 q = self.worker._register(rid)
@@ -940,6 +941,35 @@ class PrefillWorker:
             # clean failure: the decode side was told and falls back to a
             # local prefill — redelivering would double-run the request
             await self.queue.ack(token)
+
+    async def _touch_for_pool_claim(self, req: RemotePrefillRequest,
+                                    token: str) -> bool:
+        """Lease re-arm for long REMOTE pool fetches: when the attached
+        cluster pool holds a multi-page prefix of this prompt, the
+        engine-side claim ladder (page-by-page verified remote fetches,
+        each possibly failing over across replicas) can legitimately
+        outlast `lease_s` — exactly like the transfer leg's resume
+        ladder, which re-arms before `send_pages` above. Touch the lease
+        BEFORE entering the engine so the queue cannot redeliver the
+        item mid-fetch and spawn a duplicate sender; a single-page (or
+        no) match keeps the normal lease discipline — an in-process
+        claim can't stretch past it. Returns True when the lease was
+        re-armed (False: no pool / short match / lease already expired,
+        in which case the item was redelivered and whichever sender
+        finishes first wins — chunk commits are idempotent)."""
+        try:
+            eng = self.worker.engine
+            pool = getattr(eng, "kv_pool", None)
+            if pool is None:
+                return False
+            from dynamo_tpu.engine.kv_pool import matched_pool_pages
+            matched = matched_pool_pages(pool, req.token_ids,
+                                         eng.cfg.page_size)
+        except Exception:  # dynalint: swallow-ok=re-arm-is-best-effort-lease-covers-default
+            return False
+        if matched < 2:
+            return False
+        return await self.queue.touch(token, self.lease_s)
 
     async def _notify(self, req: RemotePrefillRequest,
                       done: PrefillCompletion) -> None:

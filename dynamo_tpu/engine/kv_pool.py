@@ -125,6 +125,23 @@ class KvPoolStats:
 POOL_STATS = KvPoolStats()
 
 
+def matched_pool_pages(pool, tokens, page_size: int) -> int:
+    """Leading full pages of `tokens` resident in `pool` — the cheap
+    containment walk (hash chaining only; no bytes move, no verify —
+    the checksum verification happens at claim time in
+    `SharedKvPool.fetch`/`ClusterKvPool.fetch`). Shared by the
+    admission prefetcher and the disagg lease re-arm (a multi-page
+    remote claim ladder can outlast the queue lease)."""
+    from dynamo_tpu.engine.kv_cache import page_hash
+    parent, n = 0, 0
+    for i in range(len(tokens) // page_size):
+        parent = page_hash(parent, tokens[i * page_size:(i + 1) * page_size])
+        if parent not in pool:
+            break
+        n += 1
+    return n
+
+
 @dataclasses.dataclass
 class PoolEntry:
     seq_hash: int
@@ -421,15 +438,7 @@ class AdmissionPrefetcher:
     def matched_pages(self, tokens) -> int:
         """Leading full pages of `tokens` resident in the pool (the
         cheap containment walk — no bytes move)."""
-        from dynamo_tpu.engine.kv_cache import page_hash
-        ps = self.page_size
-        parent, n = 0, 0
-        for i in range(len(tokens) // ps):
-            parent = page_hash(parent, tokens[i * ps:(i + 1) * ps])
-            if parent not in self.pool:
-                break
-            n += 1
-        return n
+        return matched_pool_pages(self.pool, tokens, self.page_size)
 
     async def prefetch(self, request, admitted=None) -> int:
         """Warm the request's matched pool pages into the target

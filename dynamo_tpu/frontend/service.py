@@ -183,6 +183,20 @@ class HttpService:
             name: m.gauge(f"llm_kv_pool_{name}",
                           f"shared kv pool: {name.replace('_', ' ')}")
             for name in KvPoolStats.FIELDS}
+        # cross-host pool service (engine/pool_service.py): remote
+        # fetch/failover/quorum outcomes + placement-ring membership,
+        # epoch and rebalance progress — same render-time fold
+        from dynamo_tpu.engine.pool_service import (
+            PoolRingStats, RemotePoolStats,
+        )
+        self._kv_pool_remote = {
+            name: m.gauge(f"llm_kv_pool_remote_{name}",
+                          f"cross-host kv pool: {name.replace('_', ' ')}")
+            for name in RemotePoolStats.FIELDS}
+        self._pool_ring = {
+            name: m.gauge(f"llm_pool_ring_{name}",
+                          f"pool placement ring: {name.replace('_', ' ')}")
+            for name in PoolRingStats.FIELDS}
         # per-step engine ledger (observability/ledger.py LEDGER_STATS):
         # step counts per kind, recompiles, bucket-ladder padding waste,
         # KV tier occupancy, batch occupancy, queue depth, EWMA tok/s
@@ -289,6 +303,13 @@ class HttpService:
         from dynamo_tpu.engine.kv_pool import POOL_STATS
         for name, value in POOL_STATS.snapshot().items():
             self._kv_pool[name].set(value=float(value))
+        from dynamo_tpu.engine.pool_service import (
+            REMOTE_STATS as POOL_REMOTE, RING_STATS as POOL_RING,
+        )
+        for name, value in POOL_REMOTE.snapshot().items():
+            self._kv_pool_remote[name].set(value=float(value))
+        for name, value in POOL_RING.snapshot().items():
+            self._pool_ring[name].set(value=float(value))
         from dynamo_tpu.observability.ledger import LEDGER_STATS
         for name, value in LEDGER_STATS.snapshot().items():
             self._engine[name].set(value=float(value))

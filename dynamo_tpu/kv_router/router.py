@@ -37,7 +37,8 @@ class KvRouter:
                  degraded_lag_s: float = 2.0,
                  degraded_backlog: int = 10_000,
                  degraded_min_s: float = 1.0,
-                 event_batch: int = 2048):
+                 event_batch: int = 2048,
+                 pool_membership=None):
         """degraded_lag_s / degraded_backlog: thresholds for the
         STALE-SNAPSHOT DEGRADED MODE. Prefix scores are advisory — when
         the event plane lags (publish ts → apply time) past
@@ -47,7 +48,13 @@ class KvRouter:
         llm_cp_router_degraded) instead of blocking requests behind
         event application. Exit uses half-threshold hysteresis plus a
         degraded_min_s dwell so the gaps BETWEEN a lag storm's delayed
-        bursts can't flap the flag."""
+        bursts can't flap the flag.
+
+        pool_membership: the cross-host pool's ring membership view
+        (runtime/placement.py PoolMembership) — when wired, pool-host
+        instance events (pool-host:{host} ids) feed it at watch-event
+        time and `_split_pool_scores` stops pricing pool fetches the
+        moment no live member can serve them."""
         self.component = component
         self.client = worker_client
         self.block_size = block_size
@@ -67,6 +74,7 @@ class KvRouter:
         self.degraded_backlog = degraded_backlog
         self.degraded_min_s = degraded_min_s
         self.event_batch = event_batch
+        self.pool_membership = pool_membership
         self.degraded = False
         self.degraded_entries = 0
         self._degraded_since = 0.0
@@ -111,6 +119,17 @@ class KvRouter:
             from dynamo_tpu.runtime.component import (
                 STATUS_DRAINING, instance_status,
             )
+            from dynamo_tpu.runtime.placement import is_pool_host_instance
+            if is_pool_host_instance(worker_id):
+                # pool-HOST liveness (ring membership): a pool host's
+                # instance delete leaves the ring AT EVENT TIME — the
+                # ownership epoch bumps and _split_pool_scores stops
+                # pricing fetches no live member can serve, the same
+                # corpse-routing fence the worker delete below applies
+                # to pool SOURCES
+                if self.pool_membership is not None:
+                    self.pool_membership.on_instance(kind, worker_id, info)
+                return
             if kind == "delete":
                 self.indexer.remove_worker(worker_id)
                 # pool-source twin (mirror of the PR 4 eviction above):
@@ -242,11 +261,25 @@ class KvRouter:
         eviction purges dead pool sources at event time; the instance
         re-check here is the same authoritative-watch fence the metrics
         path uses (a racing Stored event could re-add a corpse's edge
-        between eviction and this schedule)."""
+        between eviction and this schedule).
+
+        Pool-HOST liveness rides the same fence one layer down: with a
+        cross-host pool, the bytes live on ring-member pool hosts, not
+        with the publishing workers — when membership is wired and NO
+        live host remains, every pool score is unfetchable regardless
+        of source liveness, so pricing zeroes at watch-event time
+        instead of burning a doomed fetch ladder per schedule. (With
+        any member left, replication R keeps entries fetchable, so a
+        single host death changes nothing here — the fetch-side replica
+        walk fails over.)"""
         pool_matched = 0
+        dead_pool = (self.pool_membership is not None
+                     and not self.pool_membership.live_hosts())
         instances = getattr(self.client, "instances", None)
         for wid in [w for w in overlap.scores if is_pool_source(w)]:
             score = overlap.scores.pop(wid)
+            if dead_pool:
+                continue   # no live pool host can serve ANY fetch
             src = pool_source_worker(wid)
             if instances is not None and src not in instances:
                 continue   # corpse-sourced: never price a fetch from it

@@ -195,6 +195,20 @@ class MetricsExporter:
             name: r.gauge(f"{PREFIX}_kv_pool_{name}",
                           f"shared kv pool: {name.replace('_', ' ')}")
             for name in KvPoolStats.FIELDS}
+        # cross-host pool service (engine/pool_service.py): remote
+        # fetch/failover/quorum health + placement-ring membership and
+        # rebalance progress, same render-time refresh
+        from dynamo_tpu.engine.pool_service import (
+            PoolRingStats, RemotePoolStats,
+        )
+        self.g_kv_pool_remote = {
+            name: r.gauge(f"{PREFIX}_kv_pool_remote_{name}",
+                          f"cross-host kv pool: {name.replace('_', ' ')}")
+            for name in RemotePoolStats.FIELDS}
+        self.g_pool_ring = {
+            name: r.gauge(f"{PREFIX}_pool_ring_{name}",
+                          f"pool placement ring: {name.replace('_', ' ')}")
+            for name in PoolRingStats.FIELDS}
         self._client = None
         self._aggregator: Optional[KvMetricsAggregator] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -385,6 +399,13 @@ class MetricsExporter:
         from dynamo_tpu.engine.kv_pool import POOL_STATS
         for name, value in POOL_STATS.snapshot().items():
             self.g_kv_pool[name].set(value=float(value))
+        from dynamo_tpu.engine.pool_service import (
+            REMOTE_STATS as POOL_REMOTE, RING_STATS as POOL_RING,
+        )
+        for name, value in POOL_REMOTE.snapshot().items():
+            self.g_kv_pool_remote[name].set(value=float(value))
+        for name, value in POOL_RING.snapshot().items():
+            self.g_pool_ring[name].set(value=float(value))
 
     # -- http -----------------------------------------------------------------
 

@@ -76,6 +76,8 @@ SITES = (
     "offload.write_tier",
     "offload.read_tier",
     "pool.fetch",
+    "pool.remote_fetch",
+    "pool.rebalance",
     "queue.dequeue",
     "discovery.heartbeat",
     # control-plane sites (this PR's scale harness)

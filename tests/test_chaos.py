@@ -106,6 +106,32 @@ TRANSFER_STORM_PLAN = {
                 "dead_stream": 1, "dead_from": 2},
 }
 
+# cross-host pool service storm (ISSUE 17): host death mid-fetch,
+# partition with a quorum-degraded publish, replica-local rot, and a
+# host killed DURING a watch-driven rebalance — all over the replicated
+# consistent-hash pool. The `pool.remote_fetch` hit index k is exactly
+# the k-th LIVE replica fetch attempt of the storm (dead/partitioned
+# hosts raise before the failpoint, consuming no decision), so the two
+# deterministic specs pin to known attempts:
+#   hits 1..4  phase A greedy walk (hit 2 = the mid-fetch host death;
+#              page 1 fails over, hits 3..4 finish the walk),
+#   hits 5..7  phase B sampled walk past a PARTITIONED first owner,
+#   hit 8      phase C rot (corrupt -> replica-local quarantine),
+#   hit 9      phase C's sibling replica serving the same page,
+#   hits 10..  phase D rebalance read-side copies + the final oracle
+#              re-fetch (all clean: both bounded specs are exhausted).
+POOL_STORM_PLAN = {
+    "pool.remote_fetch": {"seed": 61, "specs": [
+        {"kind": "fail_n", "n": 1, "skip": 1},
+        {"kind": "corrupt", "p": 1.0, "n": 1, "skip": 7}]},
+    # kill-during-rebalance leg: seeded copy drops; repair must converge
+    # anyway (idempotent passes) and no stale-epoch write may land
+    "pool.rebalance": {"seed": 161, "specs": [
+        {"kind": "drop", "p": 0.4}]},
+    # not a fault site (popped before arm_from_dict): cluster geometry
+    "pool": {"hosts": 4, "replicas": 2, "extra_entries": 12},
+}
+
 # control-plane storm (the scale-harness scenario): watch-stream
 # disconnects, a discovery-store brown-out, event-plane lag/reorder, and
 # seeded heartbeat loss — all at once, over a simulated fleet
@@ -762,6 +788,169 @@ def test_chaos_disagg_transfer_storm():
 
 # -- scenario: control-plane storm over the simulated fleet --------------------
 
+def run_pool_host_storm(plan):
+    """Failure storm over the CROSS-HOST replicated KV pool
+    (engine/pool_service.py + runtime/placement.py, ISSUE 17):
+
+      phase A — a pool host "dies" serving a page mid-walk (the plan's
+        deterministic drop on fetch attempt 2): the walk fails over to
+        the sibling replica frontier-exact — the already-claimed page 0
+        stays committed, pages 1-2 still arrive, tokens are greedy
+        oracle-identical, ZERO dropped streams;
+      phase B — the first ring owner of the warm prefix is PARTITIONED
+        (member, unreachable — no membership change, so no rebalance):
+        a seeded-SAMPLED stream fails over past it token-identically,
+        and a publish whose owner set includes the partitioned host
+        still lands quorum-1 on the reachable owner (counted degraded);
+      phase C — bytes rot on ONE replica (the plan's corrupt on fetch
+        attempt 8): that replica's verify quarantines the page LOCALLY
+        and the sibling serves it — exactly one owner loses its copy;
+      phase D — a new host JOINS (watch-driven handoff starts), and an
+        original host is KILLED while that rebalance is mid-flight,
+        under seeded rebalance-copy drops: repair passes converge
+        anyway, every entry ends >= min(R, live hosts)-sourced and
+        fetchable, and the stale-epoch-write counter reads ZERO (every
+        copy that raced the membership change was fenced by ring epoch,
+        the alloc_epoch discipline applied to placement).
+
+    Contract: every stream token-identical to the single-engine oracle
+    (greedy AND seeded-sampled), no entry lost with <= R-1 dead owners,
+    `stale_epoch_landed == 0`, rot quarantined replica-locally. The
+    fault plan is two bounded specs + one seeded drop rate — the run
+    replays bit-identically from the committed plan."""
+    import numpy as np
+
+    from dynamo_tpu.engine.kv_cache import page_hash
+    from dynamo_tpu.engine.pool_service import (
+        REMOTE_STATS, RING_STATS, ClusterKvPool, KvPoolHost,
+    )
+    from dynamo_tpu.runtime.integrity import STATS as INTEGRITY
+
+    plan = dict(plan)
+    geo = plan.pop("pool", {"hosts": 4, "replicas": 2,
+                            "extra_entries": 12})
+    REMOTE_STATS.reset()
+    RING_STATS.reset()
+    prompt = [(13 * j) % 200 + 3 for j in range(32)]   # exactly 4 pages
+    gp = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    sp = SamplingParams(max_tokens=4, temperature=0.9, top_k=8,
+                        seed=1234, ignore_eos=True)
+    oracle_eng = make_engine()
+    want_g = oracle_eng.generate(prompt, gp, "og")
+    want_s = oracle_eng.generate(prompt, sp, "os")
+
+    def arrs(i):
+        r = np.random.default_rng(i)
+        shape = (2, 2, 2, 4)
+        return (r.standard_normal(shape).astype(np.float32),
+                r.standard_normal(shape).astype(np.float32))
+
+    faults.REGISTRY.arm_from_dict(plan)
+    try:
+        cluster = ClusterKvPool(replicas=geo["replicas"])
+        for i in range(geo["hosts"]):
+            cluster.add_host(KvPoolHost(f"ph{i}", capacity_pages=256))
+        cluster.run_rebalance()          # drain join enqueues (empty pool)
+        seeder = make_engine()
+        seeder.attach_kv_pool(cluster, "seed")
+        seeder.generate(prompt, gp, "seed-r")
+        seeder.drain_kv_events()
+        seeder._pool_stream.drain()
+        # the 3 matched prefix page hashes (chained content hashes)
+        phashes, parent = [], 0
+        for p in range(3):
+            parent = page_hash(parent, prompt[p * PAGE:(p + 1) * PAGE])
+            phashes.append(parent)
+
+        # phase A: host death mid-fetch -> frontier-exact failover
+        a = make_engine()
+        a.attach_kv_pool(cluster, "A")
+        assert a.generate(prompt, gp, "a") == want_g
+        assert a.scheduler.pool_fetched_pages == 3   # no page fell back
+        assert REMOTE_STATS.fetch_failovers == 1
+        assert REMOTE_STATS.fetch_exhausted == 0
+
+        # phase B: partition the warm prefix's first owner
+        h0 = phashes[0]
+        part = cluster.membership.owners_for(h0)[0]
+        cluster.partition_host(part)
+        f0 = REMOTE_STATS.fetch_failovers
+        b = make_engine()
+        b.attach_kv_pool(cluster, "B")
+        assert b.generate(prompt, sp, "b") == want_s
+        assert b.scheduler.pool_fetched_pages == 3
+        assert REMOTE_STATS.fetch_failovers > f0     # walked past it
+        # publisher quorum holds through the partition
+        pub_sh = 0x9000
+        while part not in cluster.membership.owners_for(pub_sh):
+            pub_sh += 1
+        assert cluster.publish("w-pub", pub_sh, 0, pub_sh,
+                               arrs(pub_sh)) == "new"
+        assert REMOTE_STATS.publish_quorum_degraded >= 1
+        cluster.partition_host(part, False)          # heal
+
+        # phase C: rot on one replica -> replica-local quarantine
+        q0 = INTEGRITY.quarantined
+        owners_before = set(cluster.owner_hosts(h0))
+        assert len(owners_before) == 2
+        assert cluster.fetch(h0) is not None         # sibling serves
+        assert INTEGRITY.quarantined == q0 + 1
+        assert len(owners_before - set(cluster.owner_hosts(h0))) == 1
+
+        # repair the degraded publish + the rot-dropped copy before the
+        # membership storm (so <= R-1 owners ever die under-repaired)
+        for _ in range(40):
+            if cluster.run_rebalance()["under_replicated"] == 0:
+                break
+        assert not cluster.under_replicated()
+
+        # phase D: join, then kill an original host MID-rebalance
+        extra = []
+        for i in range(geo["extra_entries"]):
+            sh = 0x5000 + i
+            assert cluster.publish("w-pub", sh, 0, i, arrs(i)) == "new"
+            extra.append(sh)
+        cluster.add_host(KvPoolHost("ph-new", capacity_pages=256))
+        cluster.run_rebalance(budget=6)              # handoff mid-flight
+        victim = [h for h in cluster.membership.live_hosts()
+                  if h != "ph-new"][0]
+        cluster.kill_host(victim)                    # leave DURING it
+        for _ in range(60):
+            if cluster.run_rebalance(budget=8)["under_replicated"] == 0:
+                break
+        assert not cluster.under_replicated()
+        target = min(geo["replicas"], len(cluster.membership.live_hosts()))
+        for sh in extra + phashes + [pub_sh]:
+            assert len(cluster.owner_hosts(sh)) >= target, hex(sh)
+            assert cluster.fetch(sh) is not None, hex(sh)
+
+        # the acceptance counter: NO stale-epoch write ever landed
+        assert REMOTE_STATS.stale_epoch_landed == 0
+        # the storm actually exercised the repair plane
+        assert RING_STATS.rebalanced_pages > 0
+
+        # epilogue: a fresh consumer over the converged cluster is
+        # still greedy oracle-identical, fully pool-served
+        e = make_engine()
+        e.attach_kv_pool(cluster, "E")
+        assert e.generate(prompt, gp, "e") == want_g
+        assert e.scheduler.pool_fetched_pages == 3
+        return {"remote": REMOTE_STATS.snapshot(),
+                "ring": RING_STATS.snapshot(),
+                "hosts": {hid: len(h)
+                          for hid, h in cluster._hosts.items()},
+                "faults": faults.REGISTRY.snapshot()}
+    finally:
+        faults.REGISTRY.disarm()
+        REMOTE_STATS.reset()
+        RING_STATS.reset()
+        INTEGRITY.reset()
+
+
+def test_chaos_pool_host_storm():
+    run_scenario("pool_host_storm")
+
+
 def run_control_plane_storm(plan):
     """The scale-harness scenario (runtime/simcluster.py) as a chaos
     run: a simulated fleet under watch disconnects, a discovery-store
@@ -823,4 +1012,5 @@ SCENARIOS = {
                               TRANSFER_STORM_PLAN),
     "rolling_restart": (run_rolling_restart, ROLLING_PLAN),
     "control_plane_storm": (run_control_plane_storm, CONTROL_PLANE_PLAN),
+    "pool_host_storm": (run_pool_host_storm, POOL_STORM_PLAN),
 }

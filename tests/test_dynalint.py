@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R20), the
+- one positive AND one negative fixture per AST rule (R1-R22), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -1361,6 +1361,96 @@ def test_r20_quiet_on_referenced_and_annotated_sites():
     found = lint_source(textwrap.dedent(annotated),
                         "dynamo_tpu/disagg/fixture.py")
     assert "R20" not in rules(found)
+
+
+# -- R22: placement-epoch contract ---------------------------------------------
+
+R22_BAD = """
+    def route_publish(ring, membership, key, payload):
+        # caches placement with no word about when it expires
+        targets = ring.owners_for(key)
+        primary = ring.lookup(key)
+        for hid in targets:
+            payload.send(hid)
+        return primary
+
+
+    def price_pool(membership, score):
+        if not membership.live_hosts():
+            return 0
+        return score
+"""
+
+
+def test_r22_flags_unreferenced_placement_consumers():
+    found = lint_source(textwrap.dedent(R22_BAD),
+                        "dynamo_tpu/engine/fixture.py")
+    r22 = [x for x in found if x.rule == "R22"]
+    # owners_for + ring.lookup + live_hosts
+    assert len(r22) == 3
+    found = lint_source(textwrap.dedent(R22_BAD), "tools/fixture.py")
+    assert "R22" in rules(found)
+
+
+def test_r22_quiet_outside_scope_tests_and_placement_layer():
+    found = lint_source(textwrap.dedent(R22_BAD), "examples/fixture.py")
+    assert "R22" not in rules(found)
+    found = lint_source(textwrap.dedent(R22_BAD), "tests/fixture.py")
+    assert "R22" not in rules(found)
+    # the placement layer itself is exempt (it IS the epoch machinery,
+    # the ops/kv_quant.py precedent from R11)
+    found = lint_source(textwrap.dedent(R22_BAD),
+                        "dynamo_tpu/runtime/placement.py")
+    assert "R22" not in rules(found)
+
+
+def test_r22_quiet_on_referenced_and_annotated_sites():
+    handled = """
+        def route_publish(ring, membership, key, payload):
+            # owners re-resolved per call; every write carries the
+            # membership epoch and serving hosts fence stale ones
+            targets = ring.owners_for(key)
+            for hid in targets:
+                payload.send(hid)
+    """
+    found = lint_source(textwrap.dedent(handled),
+                        "dynamo_tpu/engine/fixture.py")
+    assert "R22" not in rules(found)
+    annotated = """
+        def snapshot_hosts(membership):
+            # dynalint: ring-ok=read-only diagnosis snapshot, no
+            # write or fetch is routed from this list
+            return list(membership.live_hosts())
+    """
+    found = lint_source(textwrap.dedent(annotated),
+                        "dynamo_tpu/engine/fixture.py")
+    assert "R22" not in rules(found)
+    # bare `.lookup` on a non-ring receiver is not placement
+    other = """
+        def find(catalog, key):
+            return catalog.lookup(key)
+    """
+    found = lint_source(textwrap.dedent(other),
+                        "dynamo_tpu/engine/fixture.py")
+    assert "R22" not in rules(found)
+
+
+def test_r22_live_on_placement_call_sites():
+    """Every live consumer of owners_for / ring.lookup / pool-host
+    resolution speaks the ownership-epoch vocabulary or carries a
+    justified annotation (pool_service fetch/publish/rebalance, the
+    router's pool-host liveness fence)."""
+    import glob
+    scoped = glob.glob(os.path.join(REPO, "dynamo_tpu", "**", "*.py"),
+                       recursive=True)
+    scoped += glob.glob(os.path.join(REPO, "tools", "*.py"))
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R22"], \
+            (rel, [x.message for x in found if x.rule == "R22"])
 
 
 def test_r19_live_on_preemption_call_sites():

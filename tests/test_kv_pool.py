@@ -403,6 +403,39 @@ def test_router_split_pool_scores_fences_corpse_sources():
     assert overlap.scores == {"w1": 1}
 
 
+def test_router_split_pool_scores_fences_dead_pool_hosts():
+    """PR 17 satellite: liveness one layer DOWN from the source worker —
+    the pool HOSTS (ring membership). While ≥1 member is live a replica
+    walk can still serve every entry, so pool depth keeps pricing; the
+    moment the watch deletes the last `pool-host:` instance the
+    fetchable prefix is worth zero, at event time, before any fetch
+    would hang on a corpse host."""
+    from dynamo_tpu.kv_router.indexer import MatchResult
+    from dynamo_tpu.kv_router.router import KvRouter
+    from dynamo_tpu.runtime.placement import (
+        PoolMembership, pool_host_instance_id,
+    )
+    m = PoolMembership()
+    router = KvRouter(object(), FakeClient({"w1": {}}), block_size=4,
+                      pool_membership=m)
+    # the router's watch listener forwards `pool-host:` instance events
+    # here (see KvRouter on_instance); drive the same membership shape
+    m.on_instance("put", pool_host_instance_id("ph0"), {})
+    m.on_instance("put", pool_host_instance_id("ph1"), {})
+    assert set(m.live_hosts()) == {"ph0", "ph1"}   # watch feeds the ring
+    overlap = MatchResult(scores={"w1": 1, "pool:w1": 3})
+    assert router._split_pool_scores(overlap) == 3
+    # one host down: replication still serves — still priced
+    m.on_instance("delete", pool_host_instance_id("ph0"), {})
+    overlap = MatchResult(scores={"w1": 1, "pool:w1": 3})
+    assert router._split_pool_scores(overlap) == 3
+    # LAST host down: zero at event time
+    m.on_instance("delete", pool_host_instance_id("ph1"), {})
+    overlap = MatchResult(scores={"w1": 1, "pool:w1": 3})
+    assert router._split_pool_scores(overlap) == 0
+    assert overlap.scores == {"w1": 1}
+
+
 def test_watch_delete_evicts_pool_source_entries_at_event_time():
     """Satellite fix: a dead worker's POOL-source index entries go at
     watch-delete time, mirroring the PR 4 worker-entry eviction — the

@@ -1,0 +1,636 @@
+"""Cross-host KV pool service failure surface (ISSUE 17).
+
+The cluster contract: pool pages replicate across R ring owners, and
+every failure on the remote path — a host death mid-fetch, a membership
+change racing a rebalance, rot on one replica, a dead owner at publish
+time — degrades to failover or recompute, never to wrong tokens, a
+dropped stream, or a stale-epoch write landing. Placement itself is
+pinned too: the ring is deterministic, balanced within the vnode bound,
+and moves a minimal key fraction on join.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.engine import NativeEngine
+from dynamo_tpu.engine.kv_cache import page_hash, tokens_hash
+from dynamo_tpu.engine.kv_pool import POOL_STATS, PoolQuantMismatch
+from dynamo_tpu.engine.pool_service import (
+    REMOTE_STATS, RING_STATS, ClusterKvPool, KvPoolHost,
+    PoolHostUnavailable,
+)
+from dynamo_tpu.engine.scheduler import SamplingParams
+from dynamo_tpu.runtime.faults import REGISTRY, FaultSchedule, FaultSpec
+from dynamo_tpu.runtime.integrity import STATS as INTEGRITY
+from dynamo_tpu.runtime.placement import (
+    HashRing, PoolMembership, pool_host_instance_id,
+)
+
+# same tiny geometry as tests/test_kv_pool.py (jax-cache hits across files)
+CFG = ModelConfig(dtype="float32", max_model_len=256)
+PAGE = 8
+PROMPT = list(range(10, 42))   # 4 pages; the walk matches the 3 full ones
+GREEDY = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+SAMPLED = SamplingParams(max_tokens=4, temperature=0.9, top_k=8,
+                         seed=1234, ignore_eos=True)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    REGISTRY.disarm()
+    REGISTRY.reset_counters()
+    INTEGRITY.reset()
+    POOL_STATS.reset()
+    REMOTE_STATS.reset()
+    RING_STATS.reset()
+    yield
+    REGISTRY.disarm()
+    REGISTRY.reset_counters()
+    INTEGRITY.reset()
+    POOL_STATS.reset()
+    REMOTE_STATS.reset()
+    RING_STATS.reset()
+
+
+def arm(site, *specs, seed=0):
+    REGISTRY.arm(site, FaultSchedule(seed, list(specs)))
+
+
+def make_engine(pool=None, wid="", num_pages=32, kv_quant=""):
+    eng = NativeEngine(CFG, EngineConfig(
+        page_size=PAGE, num_pages=num_pages, max_slots=2,
+        max_prefill_chunk=32, prefill_buckets=(8, 16, 32),
+        max_model_len=256, kv_quant=kv_quant), seed=0)
+    if pool is not None:
+        eng.attach_kv_pool(pool, wid or "w")
+    return eng
+
+
+def publish_all(eng):
+    eng.drain_kv_events()
+    eng._pool_stream.drain()
+
+
+def make_cluster(n_hosts=3, replicas=2, capacity_pages=64,
+                 disk_capacity_pages=0, tmpdir=None):
+    cl = ClusterKvPool(replicas=replicas)
+    for i in range(n_hosts):
+        hid = f"ph{i}"
+        cl.add_host(KvPoolHost(
+            hid, capacity_pages=capacity_pages,
+            disk_capacity_pages=disk_capacity_pages,
+            disk_dir=f"{tmpdir}/{hid}" if tmpdir else None))
+    cl.run_rebalance()   # drain the join enqueues (nothing resident yet)
+    return cl
+
+
+def seeded_cluster(prompt=PROMPT, kv_quant="", **kw):
+    """A cluster holding `prompt`'s pages, published by worker A."""
+    cl = make_cluster(**kw)
+    a = make_engine(cl, "A", kv_quant=kv_quant)
+    a.generate(prompt, GREEDY, "seed-a")
+    publish_all(a)
+    a.close()
+    return cl
+
+
+def page_arrays(seed=0, shape=(2, 2, 2, 4)):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape).astype(np.float32),
+            rng.standard_normal(shape).astype(np.float32))
+
+
+# -- placement ring unit tests ------------------------------------------------
+
+def test_ring_determinism_across_instances():
+    """Same membership (any insertion order) -> same owners; placement
+    must agree across processes without coordination."""
+    r1, r2 = HashRing(vnodes=32), HashRing(vnodes=32)
+    for h in ("a", "b", "c"):
+        r1.add(h)
+    for h in ("c", "a", "b"):
+        r2.add(h)
+    for k in range(500):
+        assert r1.owners_for(k) == r2.owners_for(k)
+    assert r1.owners_for(123) == r1.owners_for(123)   # stable re-ask
+
+
+def test_ring_replicas_distinct_and_bounded_by_membership():
+    r = HashRing(vnodes=16, replicas=3)
+    r.add("a")
+    assert r.owners_for(7) == ["a"]          # R degrades to hosts
+    r.add("b"); r.add("c"); r.add("d")
+    for k in range(200):
+        owners = r.owners_for(k)
+        assert len(owners) == 3
+        assert len(set(owners)) == 3         # distinct hosts
+    assert r.owners_for(5, r=1)[0] == r.owners_for(5)[0]   # primary stable
+
+
+def test_ring_balance_bound():
+    """Virtual nodes bound skew: with 64 vnodes/host no host owns more
+    than ~2x its fair share of primary assignments."""
+    r = HashRing(vnodes=64)
+    for h in ("a", "b", "c", "d"):
+        r.add(h)
+    counts = {h: 0 for h in ("a", "b", "c", "d")}
+    n = 4000
+    for k in range(n):
+        counts[r.lookup(k)] += 1
+    fair = n / 4
+    for h, c in counts.items():
+        assert 0.5 * fair < c < 2.0 * fair, (h, counts)
+
+
+def test_ring_minimal_movement_on_join():
+    """Consistent hashing's point: a join steals only the arcs it lands
+    on — at most ~the joiner's fair share of keys moves primary."""
+    r = HashRing(vnodes=64)
+    for h in ("a", "b", "c"):
+        r.add(h)
+    before = {k: r.lookup(k) for k in range(3000)}
+    epoch_before = r.epoch
+    r.add("d")
+    assert r.epoch == epoch_before + 1       # membership bumps the epoch
+    moved = sum(1 for k, h in before.items() if r.lookup(k) != h)
+    # fair share is 1/4; allow slack for vnode granularity
+    assert moved / 3000 < 0.40, moved
+    # every moved key moved TO the joiner (nothing shuffled between
+    # incumbents — the minimal-movement property)
+    for k, h in before.items():
+        now = r.lookup(k)
+        if now != h:
+            assert now == "d"
+
+
+def test_ring_epoch_bumps_on_every_membership_change():
+    r = HashRing()
+    assert r.epoch == 0
+    assert r.add("a") and r.epoch == 1
+    assert not r.add("a") and r.epoch == 1   # no-op: no bump
+    assert r.add("b") and r.epoch == 2
+    assert r.remove("a") and r.epoch == 3
+    assert not r.remove("a") and r.epoch == 3
+
+
+def test_membership_watch_feed_joins_and_leaves_at_event_time():
+    m = PoolMembership()
+    events = []
+    m.on_change(lambda kind, host, epoch: events.append((kind, host, epoch)))
+    m.on_instance("put", pool_host_instance_id("h1"), {})
+    m.on_instance("put", "worker-7", {})      # non-pool instance: ignored
+    m.on_instance("put", pool_host_instance_id("h2"), {})
+    assert set(m.live_hosts()) == {"h1", "h2"}
+    m.on_instance("delete", pool_host_instance_id("h1"), {})
+    assert set(m.live_hosts()) == {"h2"}
+    assert events == [("join", "h1", 1), ("join", "h2", 2),
+                      ("leave", "h1", 3)]
+
+
+# -- replica failover ---------------------------------------------------------
+
+def test_replica_failover_mid_fetch_token_identity():
+    """THE failover contract (acceptance): a pool host dies mid-fetch
+    (after page 1 committed, before page 2's fetch — the watch delete
+    has NOT landed, so the dead host is still a ring member), the walk
+    fails over to the surviving replica at page granularity, and tokens
+    are identical to an all-local oracle under greedy AND seeded
+    sampling. Zero dropped streams: every page still fetches."""
+    oracle = make_engine()
+    expect_g = oracle.generate(PROMPT, GREEDY, "og")
+    expect_s = oracle.generate(PROMPT, SAMPLED, "os")
+
+    for params, expect, tag in ((GREEDY, expect_g, "g"),
+                                (SAMPLED, expect_s, "s")):
+        REMOTE_STATS.reset()
+        cl = seeded_cluster()
+        # drop exactly the 3rd fetch ATTEMPT (= page 2's first-replica
+        # try: one attempt per page while everyone is healthy)
+        arm("pool.remote_fetch", FaultSpec("fail_n", n=1, skip=2))
+        b = make_engine(cl, "B" + tag)
+        assert b.generate(PROMPT, params, "b" + tag) == expect
+        # all 3 matched pages fetched — the killed attempt failed OVER,
+        # it did not fall back to recompute
+        assert b.scheduler.pool_fetched_pages == 3
+        assert REMOTE_STATS.fetch_pages == 3
+        assert REMOTE_STATS.fetch_failovers == 1
+        assert REMOTE_STATS.fetch_exhausted == 0
+        REGISTRY.disarm()
+        b.close()
+    oracle.close()
+
+
+def test_dead_host_failover_whole_walk():
+    """A host killed BEFORE the fetch walk (no watch delete yet: still
+    a ring member) makes every page it primaries fail over — the walk
+    completes from the replicas, token-identical."""
+    expect = make_engine().generate(PROMPT, GREEDY, "o")
+    cl = seeded_cluster()
+    # kill the primary owner of the FIRST page without membership change
+    h0 = page_hash(0, PROMPT[:PAGE])
+    primary = cl.membership.owners_for(h0)[0]
+    cl._hosts[primary].kill()
+    b = make_engine(cl, "B")
+    assert b.generate(PROMPT, GREEDY, "b") == expect
+    assert b.scheduler.pool_fetched_pages == 3
+    assert REMOTE_STATS.fetch_failovers >= 1    # h0 (at least) hopped
+    assert REMOTE_STATS.fetch_exhausted == 0
+    b.close()
+
+
+def test_all_replicas_exhausted_salvages_to_recompute():
+    """Every owner dead: the fetch returns None, the walk breaks, the
+    tail recomputes — exactly the in-process salvage contract (latency,
+    never tokens)."""
+    expect = make_engine().generate(PROMPT, GREEDY, "o")
+    cl = seeded_cluster()
+    h0 = page_hash(0, PROMPT[:PAGE])
+    for h in list(cl._hosts.values()):
+        h.kill()
+    # a direct fetch walks every (dead) replica and gives up cleanly
+    assert cl.fetch(h0) is None
+    assert REMOTE_STATS.fetch_exhausted == 1
+    # e2e: the containment facade already reports the pages gone (no
+    # alive holder), so the engine recomputes without even fetching
+    b = make_engine(cl, "B")
+    assert b.generate(PROMPT, GREEDY, "b") == expect
+    assert b.scheduler.pool_fetched_pages == 0
+    b.close()
+
+
+def test_rot_on_one_replica_quarantines_that_replica_only():
+    """Corrupt the first replica attempt: THAT replica quarantines the
+    page (removed there, never served), the fetch succeeds from the
+    next replica, and the sibling copy survives."""
+    expect = make_engine().generate(PROMPT, GREEDY, "o")
+    cl = seeded_cluster()
+    h0 = page_hash(0, PROMPT[:PAGE])
+    owners_before = cl.owner_hosts(h0)
+    assert len(owners_before) == 2
+    # corrupt exactly the first fetch attempt (= page 0, replica 0)
+    arm("pool.remote_fetch", FaultSpec("corrupt", p=1.0, n=1))
+    b = make_engine(cl, "B")
+    assert b.generate(PROMPT, GREEDY, "b") == expect
+    assert b.scheduler.pool_fetched_pages == 3     # failover, not recompute
+    assert REMOTE_STATS.fetch_failovers == 1
+    assert INTEGRITY.quarantined == 1
+    REGISTRY.disarm()
+    # the rotten replica dropped its copy; the sibling still holds it
+    assert len(cl.owner_hosts(h0)) == 1
+    assert h0 in cl
+    b.close()
+
+
+# -- epoch fencing ------------------------------------------------------------
+
+def test_ring_epoch_stale_write_fence():
+    """A write computed under an old membership epoch is rejected BY
+    NAME on the serving host and counted — it can never land (the
+    alloc_epoch zombie-sender discipline, applied to placement)."""
+    cl = make_cluster(n_hosts=2)
+    arr = page_arrays()
+    stale_epoch = cl.membership.epoch
+    target = cl.membership.owners_for(0x42)[0]
+    host = cl._hosts[target]
+    # membership changes: the captured epoch is now stale
+    cl.membership.join("late-joiner")
+    r = host.publish_page("w1", 0x42, 0, 0x1, arr,
+                          ring_epoch=stale_epoch)
+    assert r == "stale-epoch"
+    assert REMOTE_STATS.stale_epoch_rejected == 1
+    assert REMOTE_STATS.stale_epoch_landed == 0
+    assert not host.contains(0x42)               # nothing landed
+    # the same write under the CURRENT epoch lands
+    assert host.publish_page("w1", 0x42, 0, 0x1, arr,
+                             ring_epoch=cl.membership.epoch) == "new"
+
+
+def test_cluster_publish_rechecks_epoch_per_publish():
+    """ClusterKvPool.publish captures the epoch at call time, so an
+    ordinary publish after a membership change lands (fresh epoch) —
+    the fence only stops writers that DON'T recheck."""
+    cl = make_cluster(n_hosts=3)
+    cl.membership.leave("ph2")
+    assert cl.publish("w1", 0x7, 0, 0x1, page_arrays()) == "new"
+    assert REMOTE_STATS.stale_epoch_rejected == 0
+    assert 0x7 in cl
+
+
+# -- quorum publish -----------------------------------------------------------
+
+def test_quorum_1_publish_under_one_dead_owner():
+    """R=2 with one owner dead: the publish lands on the survivor
+    (quorum 1 — availability), is counted quorum-degraded, fetches
+    fine, and the repair pass restores R once membership recovers."""
+    cl = make_cluster(n_hosts=2)
+    sh = 0x1234
+    dead = cl.membership.owners_for(sh)[0]
+    cl._hosts[dead].kill()          # dead but still a member (no watch yet)
+    assert cl.publish("w1", sh, 0, 0x9, page_arrays()) == "new"
+    assert REMOTE_STATS.publish_quorum_degraded == 1
+    assert cl.fetch(sh) is not None               # served by the survivor
+    # watch delete lands -> re-replication target is min(R, hosts)=1
+    cl.kill_host(dead)
+    assert cl.run_rebalance()["under_replicated"] == 0
+
+
+def test_publish_all_owners_unreachable_returns_unavailable():
+    cl = make_cluster(n_hosts=2)
+    for h in cl._hosts.values():
+        h.partition(True)
+    assert cl.publish("w1", 0x5, 0, 0x1, page_arrays()) == "unavailable"
+    assert 0x5 not in cl
+
+
+def test_partitioned_host_fetch_fails_over_and_quorum_holds():
+    """Partition (unreachable, still a member): fetchers fail over past
+    it, publishes land on the reachable owner — and NO rebalance runs,
+    because membership never changed."""
+    cl = make_cluster(n_hosts=2)
+    sh = 0x777
+    assert cl.publish("w1", sh, 0, 0x1, page_arrays()) == "new"
+    part = cl.membership.owners_for(sh)[0]
+    cl.partition_host(part)
+    assert cl.fetch(sh) is not None
+    assert REMOTE_STATS.fetch_failovers == 1
+    # a NEW publish still lands (quorum 1) and counts degraded
+    assert cl.publish("w1", 0x778, 0, 0x1, page_arrays(1)) == "new"
+    assert REMOTE_STATS.publish_quorum_degraded >= 1
+    assert cl.run_rebalance()["copied"] == 0      # membership unchanged
+    cl.partition_host(part, False)                # heal
+    assert cl.fetch(sh) is not None
+
+
+# -- rebalance conservation ---------------------------------------------------
+
+def _publish_n(cl, n, source="w1"):
+    hashes = []
+    for i in range(n):
+        sh = 0x1000 + i
+        assert cl.publish(source, sh, 0, i, page_arrays(i)) == "new"
+        hashes.append(sh)
+    return hashes
+
+
+def test_leave_rebalance_restores_replication():
+    """Host leave: survivors re-replicate from their own copies until
+    every entry is ≥ min(R, hosts)-sourced — conservation under churn."""
+    cl = make_cluster(n_hosts=3)
+    hashes = _publish_n(cl, 24)
+    victim = cl.membership.live_hosts()[0]
+    cl.kill_host(victim)
+    # bounded convergence: small budget forces multiple paced passes
+    for _ in range(20):
+        if cl.run_rebalance(budget=4)["under_replicated"] == 0:
+            break
+    for sh in hashes:
+        assert len(cl.owner_hosts(sh)) >= 2, hex(sh)
+        assert cl.fetch(sh) is not None
+    assert RING_STATS.under_replicated == 0
+    assert RING_STATS.rebalanced_pages > 0
+
+
+def test_join_rebalance_amortized_handoff():
+    """Host join: the new owner receives its owed entries under the
+    bounded budget; after convergence every entry is held by its CURRENT
+    ring owners."""
+    cl = make_cluster(n_hosts=2)
+    hashes = _publish_n(cl, 24)
+    newcomer = KvPoolHost("ph-new", capacity_pages=64)
+    cl.add_host(newcomer)
+    for _ in range(20):
+        if cl.run_rebalance(budget=6)["under_replicated"] == 0:
+            break
+    for sh in hashes:
+        owners = cl.membership.owners_for(sh)
+        for hid in owners:
+            assert cl._hosts[hid].contains(sh), (hex(sh), hid)
+    assert len(newcomer) > 0                     # it actually took work
+
+
+def test_rebalance_copy_faults_are_repaired_next_pass():
+    """pool.rebalance drops skip copies without losing them: the next
+    pass re-finds the gap (repair is idempotent)."""
+    cl = make_cluster(n_hosts=3)
+    hashes = _publish_n(cl, 12)
+    cl.kill_host(cl.membership.live_hosts()[-1])
+    arm("pool.rebalance", FaultSpec("drop", p=0.5))
+    for _ in range(30):
+        if cl.run_rebalance(budget=8)["under_replicated"] == 0:
+            break
+    REGISTRY.disarm()
+    for sh in hashes:
+        assert len(cl.owner_hosts(sh)) >= 2
+    assert REMOTE_STATS.stale_epoch_landed == 0
+
+
+def test_membership_change_mid_rebalance_fences_inflight_copies():
+    """A leave landing between a rebalance's scan and its copies: the
+    copies carry the scan-time epoch, the hosts fence them, and the
+    next pass converges under the new membership — no entry lost, no
+    stale write landed."""
+    cl = make_cluster(n_hosts=3)
+    hashes = _publish_n(cl, 10)
+    cl.kill_host(cl.membership.live_hosts()[0])
+    # sabotage: bump membership as a side effect of the first copy, by
+    # hooking the first target host's publish
+    fired = {"done": False}
+    for h in cl._hosts.values():
+        orig = h.publish_page
+
+        def hooked(*a, _orig=orig, **kw):
+            if not fired["done"]:
+                fired["done"] = True
+                cl.membership.join("ghost")      # epoch bump mid-pass
+                cl.membership.leave("ghost")     # (and a second one)
+            return _orig(*a, **kw)
+
+        h.publish_page = hooked
+    first = cl.run_rebalance(budget=100)
+    assert fired["done"]
+    # every copy after the sabotage was fenced, none landed stale
+    assert REMOTE_STATS.stale_epoch_rejected >= 1
+    assert REMOTE_STATS.stale_epoch_landed == 0
+    for h in cl._hosts.values():                 # drop the hooks
+        if "hooked" in repr(h.publish_page):
+            h.publish_page = h.publish_page.__defaults__[0] \
+                if False else type(h).publish_page.__get__(h)
+    for _ in range(20):
+        if cl.run_rebalance(budget=100)["under_replicated"] == 0:
+            break
+    for sh in hashes:
+        assert len(cl.owner_hosts(sh)) >= 2
+        assert cl.fetch(sh) is not None
+
+
+# -- NVMe tier ----------------------------------------------------------------
+
+def test_disk_spill_and_promote_with_traveling_checksum(tmp_path):
+    """RAM-capacity evictions spill to the NVMe tier with the traveling
+    checksum; a later fetch promotes back, verified."""
+    cl = make_cluster(n_hosts=1, replicas=1, capacity_pages=2,
+                      disk_capacity_pages=8, tmpdir=str(tmp_path))
+    hashes = _publish_n(cl, 6)
+    assert REMOTE_STATS.disk_spills >= 4
+    for sh in hashes:                            # all still fetchable
+        assert cl.fetch(sh) is not None
+    assert REMOTE_STATS.disk_hits >= 4
+
+
+def test_nvme_tier_rot_quarantine(tmp_path):
+    """At-rest rot in the pool-side NVMe tier: DiskKvPool.take's verify
+    (offload.read_tier failpoint) quarantines the entry — never served,
+    counted, and the fetch degrades to a miss (recompute), exactly the
+    offload-tier contract promoted pool-side."""
+    cl = make_cluster(n_hosts=1, replicas=1, capacity_pages=2,
+                      disk_capacity_pages=8, tmpdir=str(tmp_path))
+    hashes = _publish_n(cl, 5)
+    spilled = [sh for sh in hashes
+               if sh in cl._hosts["ph0"]._disk_meta]
+    assert spilled
+    arm("offload.read_tier", FaultSpec("corrupt", p=1.0, n=1))
+    assert cl.fetch(spilled[0]) is None          # quarantined, not served
+    REGISTRY.disarm()
+    assert REMOTE_STATS.disk_quarantined == 1
+    assert INTEGRITY.quarantined >= 1
+    assert spilled[0] not in cl
+
+
+def test_disk_tier_preserves_kv_quant_mode(tmp_path):
+    """Quantized pages spill and promote in their stored representation;
+    a cross-mode fetch from the disk tier is rejected by name."""
+    cl = make_cluster(n_hosts=1, replicas=1, capacity_pages=1,
+                      disk_capacity_pages=8, tmpdir=str(tmp_path))
+    k = np.ones((2, 2, 2, 4), np.int8)
+    v = np.ones((2, 2, 2, 4), np.int8)
+    ks = np.ones((2, 2, 2), np.float32)
+    vs = np.ones((2, 2, 2), np.float32)
+    assert cl.publish("w1", 0xA, 0, 1, (k, v, ks, vs),
+                      mode="int8") == "new"
+    assert cl.publish("w1", 0xB, 0, 2, (k, v, ks, vs),
+                      mode="int8") == "new"      # spills 0xA to disk
+    assert 0xA in cl._hosts["ph0"]._disk_meta
+    with pytest.raises(PoolQuantMismatch):
+        cl._hosts["ph0"].fetch_page(0xA, mode="")
+    got = cl.fetch(0xA, mode="int8")
+    assert got is not None and len(got) == 4     # scales rode along
+
+
+# -- facade / events ----------------------------------------------------------
+
+def test_cluster_pool_is_sharedkvpool_compatible_for_the_engine():
+    """attach_kv_pool/_pool_claim/prefetch/publish-stream all run
+    against the cluster facade unchanged (checksum-verified at claim
+    like the in-process pool)."""
+    cl = seeded_cluster()
+    assert len(cl) >= 3          # the 3 matched pages (+ any tail page)
+    b = make_engine(cl, "B")
+    warmed = b.prefetch_pool_pages(PROMPT)
+    assert warmed == 4           # all 4 full pages of PROMPT warm locally
+    b.close()
+
+
+def test_evict_source_drops_single_source_entries_cluster_wide():
+    cl = make_cluster(n_hosts=2)
+    _publish_n(cl, 4, source="w1")
+    cl.drain_events("w1")
+    assert cl.evict_source("w1") == 4
+    assert len(cl) == 0
+    # no removed events to the dead source itself
+    assert cl.drain_events("w1") == []
+
+
+def test_stored_events_ride_once_per_source():
+    cl = make_cluster(n_hosts=3)
+    cl.publish("w1", 0x1, 0, 0x10, page_arrays())
+    cl.publish("w1", 0x1, 0, 0x10, page_arrays())     # dup: no new event
+    cl.note_source("w2", 0x1, 0, 0x10)
+    ev1 = cl.drain_events("w1")
+    ev2 = cl.drain_events("w2")
+    assert ev1 == [("stored", 0, 0x1, 0, 0x10)]
+    assert ev2 == [("stored", 0, 0x1, 0, 0x10)]
+
+
+# -- disagg admission: lease re-arm (satellite) -------------------------------
+
+def test_lease_rearm_before_multi_page_pool_claim_pins_one_fetcher():
+    """A remote pool claim ladder longer than lease_s must not spawn a
+    duplicate sender: the admission path touches the lease BEFORE the
+    engine claim when the pool holds a multi-page prefix, so the item
+    is never redelivered mid-fetch — exactly one fetcher."""
+    from dynamo_tpu.disagg.protocols import RemotePrefillRequest
+    from dynamo_tpu.disagg.queue import PrefillQueue
+    from dynamo_tpu.disagg.worker import PrefillWorker
+    from dynamo_tpu.runtime.transports.memory import MemoryPlane
+
+    cl = seeded_cluster()
+
+    class Eng:
+        class cfg:
+            page_size = PAGE
+        kv_pool = cl
+
+    class W:
+        engine = Eng()
+
+    def req(rid, tokens):
+        return RemotePrefillRequest(
+            engine_id="dec-0", request_id=rid, token_ids=tokens,
+            page_ids=list(range(len(tokens) // PAGE + 1)), page_size=PAGE)
+
+    async def main():
+        plane = MemoryPlane()
+        q = PrefillQueue(plane.messaging, "ns", "tiny")
+        await q.enqueue(req("r1", PROMPT))
+        got, token = await q.dequeue_leased(lease_s=0.2)
+        w = PrefillWorker.__new__(PrefillWorker)
+        w.worker = W()
+        w.queue = q
+        w.lease_s = 5.0
+        # the re-arm fires (multi-page pool match) and extends the lease
+        assert await w._touch_for_pool_claim(got, token) is True
+        await asyncio.sleep(0.3)   # original 0.2s lease would have expired
+        # NOT redelivered: the re-armed lease still covers the fetcher —
+        # exactly one sender for this item
+        assert await q.dequeue_leased(lease_s=1.0, timeout=0.05) is None
+        await q.ack(token)
+
+        # control: a single-page match is covered by the normal lease,
+        # so no re-arm fires
+        await q.enqueue(req("r2", PROMPT[:PAGE]))
+        got2, tok2 = await q.dequeue_leased(lease_s=0.2)
+        assert await w._touch_for_pool_claim(got2, tok2) is False
+        await q.ack(tok2)
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
+# -- router: pool-host liveness (satellite regression) ------------------------
+
+def test_split_pool_scores_zeroes_when_no_live_pool_host():
+    """Dead pool HOSTS (ring membership empty) stop pool pricing at
+    watch-event time even though the publishing workers are alive —
+    the PR-4 corpse fence extended one layer down."""
+    from dynamo_tpu.kv_router.indexer import MatchResult
+    from dynamo_tpu.kv_router.router import KvRouter
+
+    class FakeClient:
+        def __init__(self, instances):
+            self.instances = instances
+
+    m = PoolMembership()
+    router = KvRouter(object(), FakeClient({"w1": {}}), block_size=4,
+                      pool_membership=m)
+    # both pool hosts live: the (live-sourced) pool depth prices
+    m.join("h1"); m.join("h2")
+    overlap = MatchResult(scores={"w1": 1, "pool:w1": 3})
+    assert router._split_pool_scores(overlap) == 3
+    # the last pool host dies at watch-event time: pricing zeroes
+    # immediately — no live member can serve any fetch
+    m.on_instance("delete", pool_host_instance_id("h1"), {})
+    m.on_instance("delete", pool_host_instance_id("h2"), {})
+    overlap = MatchResult(scores={"w1": 1, "pool:w1": 3})
+    assert router._split_pool_scores(overlap) == 0
+    assert overlap.scores == {"w1": 1}   # pool scores still split out

@@ -41,6 +41,7 @@ SCENARIO_NAMES = (
     "disagg_transfer_storm",
     "rolling_restart",
     "control_plane_storm",
+    "pool_host_storm",
 )
 
 DEFAULT_LOG = os.path.join(REPO_ROOT, "CHAOS_REPLAY.jsonl")

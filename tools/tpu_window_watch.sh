@@ -30,7 +30,8 @@ while true; do
       && [ -e BENCH_SELF_r06_kvq.json ] \
       && [ -e BENCH_SELF_r11_overlap_tpu.json ] \
       && [ -e BENCH_SELF_r13_warm_prefix_tpu.json ] \
-      && [ -e BENCH_SELF_r15_sharded_tpu.json ]; then
+      && [ -e BENCH_SELF_r15_sharded_tpu.json ] \
+      && [ -e BENCH_SELF_r17_pool_remote_tpu.json ]; then
     echo "[watch] all TPU evidence captured; exiting" >&2
     exit 0
   fi
@@ -282,6 +283,37 @@ json.dump(r, open("BENCH_SELF_r15_sharded_tpu.json", "w"), indent=1)
 EOF
             cp "$hl" BENCH_SELF_r15_sharded_tpu.log 2>/dev/null
             echo "[watch] sharded transfer captured: wall ratio $hvalue" >&2 ;;
+        esac
+      fi
+      if [ ! -e BENCH_SELF_r17_pool_remote_tpu.json ]; then
+        # remote-pool rungs on hardware (ISSUE 17): the warm-prefix
+        # ladder's remote_fetch / remote_prefetch TTFT through the
+        # served ClusterKvPool (hash-ring placement, R=2, per-page
+        # checksum verify on the serving host) on the flagship — via
+        # the supervisor's ratio trajectory rows this is the measured
+        # row for the pre-registered
+        # warm_prefix_remote_fetch_ttft_ratio_llama3_1b_tpu gate in
+        # BASELINE.json (tools/bench_compare.py scores it)
+        echo "[watch] -> remote-pool bench" >&2
+        rm -f .bench_state.json
+        rj=/tmp/bench_r_$$.json rl=/tmp/bench_r_$$.log
+        BENCH_RUN_ID=BENCH_SELF_r17_pool_remote_tpu BENCH_KVQ=0 \
+          BENCH_OVERLAP=0 BENCH_BUDGET_S=1200 timeout 1500 python bench.py \
+            >"$rj" 2>"$rl"
+        rvalue=$(python -c "import json,sys;print(json.load(open(sys.argv[1]))['extras'].get('warm_prefix',{}).get('remote_fetch_cold_ttft_ratio',0))" \
+            "$rj" 2>/dev/null || echo 0)
+        case "$rvalue" in
+          0|0.0|"") echo "[watch] remote-pool bench got no ratio" >&2 ;;
+          *)
+            python - "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$rj" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[2]))
+r["timestamp"] = sys.argv[1]
+r["self_measured"] = True
+json.dump(r, open("BENCH_SELF_r17_pool_remote_tpu.json", "w"), indent=1)
+EOF
+            cp "$rl" BENCH_SELF_r17_pool_remote_tpu.log 2>/dev/null
+            echo "[watch] remote-pool captured: remote-fetch/cold $rvalue" >&2 ;;
         esac
       fi
       if [ ! -e BENCH_SELF_r05_spec.json ] \
