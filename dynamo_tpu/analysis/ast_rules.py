@@ -1866,7 +1866,8 @@ def r20_min_frontier_contract(tree: ast.AST, lines: List[str],
 # R11 ops/kv_quant.py precedent).
 _R22_SCOPE = ("dynamo_tpu/", "tools/")
 _R22_EXEMPT = ("runtime/placement.py",)
-_R22_TERMINALS = {"owners_for", "live_hosts", "owner_hosts"}
+_R22_TERMINALS = {"owners_for", "owners_with_epoch", "live_hosts",
+                  "owner_hosts"}
 _R22_ANNOT_RE = re.compile(r"#\s*dynalint:\s*ring-ok=\S+")
 # receiver names alone (`ring.`, `membership.`) must NOT satisfy the
 # rule — every consumer spells those — so the vocabulary is the epoch

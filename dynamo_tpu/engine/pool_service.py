@@ -184,6 +184,12 @@ class KvPoolHost:
         self._disk_meta: Dict[int, Tuple[int, int, str]] = {}
         self._mu = threading.RLock()
         self.on_removed = None        # cb(entry) — cluster event plumbing
+        # entries dropped while _mu was held; drained by _flush_dropped
+        # AFTER the lock is released — the on_removed callback scans
+        # OTHER hosts (ClusterKvPool._host_dropped_entry -> contains),
+        # so invoking it under our lock is an ABBA deadlock with a
+        # concurrent eviction on a sibling host
+        self._dropped_pending: List[PoolEntry] = []
 
     # -- chaos controls -------------------------------------------------------
 
@@ -241,27 +247,37 @@ class KvPoolHost:
             sum_ = page_checksum(*arrays)
             INTEGRITY.pages_hashed += 1
         with self._mu:
-            e = self._entries.get(seq_hash)
-            if e is not None:
-                if e.mode != mode:
-                    return "quant-mismatch"
-                self._entries.move_to_end(seq_hash)
-                e.sources.add(source)
-                return "dup"
-            if seq_hash in self._disk_meta:
-                if self._disk_meta[seq_hash][2] != mode:
-                    return "quant-mismatch"
-                return "dup"
-            e = PoolEntry(seq_hash=seq_hash, parent=parent,
-                          tokens_hash=tokens_hash, mode=mode,
-                          arrays=arrays, sum_=sum_,
-                          nbytes=sum(a.nbytes for a in arrays),
-                          sources={source})
-            self._entries[seq_hash] = e
-            while len(self._entries) > self.capacity_pages:
-                _, old = self._entries.popitem(last=False)
-                self._spill(old)
-            return "new"
+            result = self._publish_locked(source, seq_hash, parent,
+                                          tokens_hash, arrays, mode, sum_)
+        self._flush_dropped()
+        return result
+
+    def _publish_locked(self, source: str, seq_hash: int, parent: int,
+                        tokens_hash: int, arrays, mode: str,
+                        sum_: int) -> str:
+        """Lock held. Capacity evictions only QUEUE their on_removed
+        report (_dropped); the caller flushes after releasing _mu."""
+        e = self._entries.get(seq_hash)
+        if e is not None:
+            if e.mode != mode:
+                return "quant-mismatch"
+            self._entries.move_to_end(seq_hash)
+            e.sources.add(source)
+            return "dup"
+        if seq_hash in self._disk_meta:
+            if self._disk_meta[seq_hash][2] != mode:
+                return "quant-mismatch"
+            return "dup"
+        e = PoolEntry(seq_hash=seq_hash, parent=parent,
+                      tokens_hash=tokens_hash, mode=mode,
+                      arrays=arrays, sum_=sum_,
+                      nbytes=sum(a.nbytes for a in arrays),
+                      sources={source})
+        self._entries[seq_hash] = e
+        while len(self._entries) > self.capacity_pages:
+            _, old = self._entries.popitem(last=False)
+            self._spill(old)
+        return "new"
 
     def _spill(self, e: PoolEntry) -> None:
         """Lock held. RAM-capacity eviction: spill down to the NVMe tier
@@ -296,10 +312,26 @@ class KvPoolHost:
 
     def _dropped(self, e: PoolEntry) -> None:
         """An entry permanently left this host (disk eviction, drop, or
-        quarantine) — report up so the cluster can emit Removed events
-        once NO owner holds it."""
-        if self.on_removed is not None:
-            self.on_removed(self.host_id, e)
+        quarantine). Only QUEUES the report — the on_removed callback
+        takes cluster and sibling-host locks (it scans every host to
+        decide whether the entry is globally gone), so it must never
+        run while this host's _mu is held. Every public path that can
+        drop calls _flush_dropped after releasing the lock."""
+        with self._mu:
+            self._dropped_pending.append(e)
+
+    def _flush_dropped(self) -> None:
+        """Deliver queued on_removed reports. Call with _mu RELEASED —
+        this is the lock-order boundary that prevents the ABBA deadlock
+        between two hosts evicting concurrently."""
+        while True:
+            with self._mu:
+                if not self._dropped_pending:
+                    return
+                pending, self._dropped_pending = self._dropped_pending, []
+            if self.on_removed is not None:
+                for e in pending:
+                    self.on_removed(self.host_id, e)
 
     # -- read path ------------------------------------------------------------
 
@@ -325,6 +357,7 @@ class KvPoolHost:
             if out.drop:
                 raise PoolHostUnavailable(
                     f"pool host {self.host_id}: injected fetch fault")
+        from_disk = False
         with self._mu:
             e = self._entries.get(seq_hash)
             if e is not None:
@@ -334,7 +367,13 @@ class KvPoolHost:
                 arrays = tuple(np.array(a) for a in e.arrays)
                 sum_ = e.sum_
             else:
-                return self._fetch_from_disk(seq_hash, mode)
+                arrays = self._fetch_from_disk(seq_hash, mode)
+                from_disk = True
+        if from_disk:
+            # the disk promote may have queued drops (tier quarantine,
+            # promote-triggered RAM spill) — deliver outside the lock
+            self._flush_dropped()
+            return arrays
         if out is not None and out.corrupt:
             # deterministic single-byte rot standing in for this
             # replica's tier rotting: the verify below catches it and
@@ -348,6 +387,7 @@ class KvPoolHost:
                 old = self._entries.pop(seq_hash, None)
             if old is not None:
                 self._dropped(old)
+                self._flush_dropped()
             log.warning("pool host %s: page %x failed integrity check; "
                         "quarantined on this replica", self.host_id,
                         seq_hash)
@@ -403,12 +443,31 @@ class KvPoolHost:
         if arrays is None:
             return None
         with self._mu:
-            e = self._entries[seq_hash]
+            e = self._entries.get(seq_hash)
+            if e is None:
+                # a concurrent publish evicted/spilled the entry between
+                # the fetch and here — treat as a miss; the next
+                # rebalance pass re-finds the gap
+                return None
             return (e.parent, e.tokens_hash, e.mode,
                     tuple(np.array(a) for a in arrays), e.sum_,
                     set(e.sources))
 
     # -- source lifecycle -----------------------------------------------------
+
+    def note_holder(self, source: str, seq_hash: int) -> bool:
+        """Dedup fast path: record `source` as a holder when this host
+        already stores the hash (RAM or NVMe tier). Reachability-checked
+        like every served call — a killed or partitioned owner must not
+        count as holding bytes it cannot serve (raises
+        PoolHostUnavailable; the cluster skips it)."""
+        self._check_reachable()
+        with self._mu:
+            e = self._entries.get(seq_hash)
+            if e is not None:
+                e.sources.add(source)
+                return True
+            return seq_hash in self._disk_meta
 
     def evict_source(self, source: str) -> List[int]:
         """Forget a dead source worker; single-source entries drop (the
@@ -559,6 +618,7 @@ class ClusterKvPool:
         with self._mu:
             sources = self._hash_sources.pop(e.seq_hash, set())
             self._hash_meta.pop(e.seq_hash, None)
+            POOL_STATS.entries = len(self._hash_meta)
         for src in sources:
             self._emit(src, "removed", e.seq_hash, e.parent, e.tokens_hash)
 
@@ -583,17 +643,15 @@ class ClusterKvPool:
         """Dedup fast path: record `source` as a holder on the live
         owners already storing this hash (their one stored copy was
         checksum-verified at publish — no bytes move). False when no
-        reachable owner holds it (publish the bytes instead)."""
+        REACHABLE owner holds it (publish the bytes instead): a killed
+        or partitioned host is skipped by note_holder's reachability
+        check, so the fast path never reports "stored" for bytes no
+        live owner can actually serve."""
         found = False
         for host in self._live_owner_objs(seq_hash):
             try:
-                with host._mu:
-                    e = host._entries.get(seq_hash)
-                    if e is not None:
-                        e.sources.add(source)
-                        found = True
-                    elif seq_hash in host._disk_meta:
-                        found = True
+                if host.note_holder(source, seq_hash):
+                    found = True
             except PoolHostUnavailable:
                 continue
         if not found:
@@ -613,8 +671,9 @@ class ClusterKvPool:
                 sum_: Optional[int] = None) -> str:
         """Quorum-1 replicated publish: write to every live ring owner
         under the CURRENT ownership epoch (stale-epoch writes are fenced
-        host-side; a membership change mid-publish costs a repair, never
-        a misplaced copy). One landed checksum-carrying copy is a
+        host-side; a membership change mid-publish is retried once under
+        the new epoch, then costs at worst a repair — never a misplaced
+        copy). One landed checksum-carrying copy is a
         success — availability over replication, with the gap counted
         (publish_quorum_degraded) and closed by the async repair pass.
         Returns the SharedKvPool result vocabulary: "new" / "dup" /
@@ -623,22 +682,36 @@ class ClusterKvPool:
         if sum_ is None:
             sum_ = page_checksum(*arrays)
             INTEGRITY.pages_hashed += 1
-        epoch = self.membership.epoch
-        owners = self.membership.owners_for(seq_hash)
         REMOTE_STATS.publishes += 1
+        owners: List[str] = []
         results: List[str] = []
-        for host_id in owners:
-            with self._mu:
-                host = self._hosts.get(host_id)
-            if host is None:
-                continue
-            try:
-                results.append(host.publish_page(
-                    source, seq_hash, parent, tokens_hash, arrays,
-                    mode=mode, sum_=sum_, ring_epoch=epoch))
-            except PoolHostUnavailable:
-                continue
-        landed = [r for r in results if r in ("new", "dup")]
+        landed: List[str] = []
+        for _attempt in range(2):
+            # atomic (epoch, owners) snapshot under ONE ring lock hold:
+            # reading epoch and owners_for separately lets a membership
+            # change slip between them — new-ring owners tagged with
+            # the old epoch, every owner fencing a healthy publish
+            epoch, owners = self.membership.owners_with_epoch(seq_hash)
+            results = []
+            for host_id in owners:
+                with self._mu:
+                    host = self._hosts.get(host_id)
+                if host is None:
+                    continue
+                try:
+                    results.append(host.publish_page(
+                        source, seq_hash, parent, tokens_hash, arrays,
+                        mode=mode, sum_=sum_, ring_epoch=epoch))
+                except PoolHostUnavailable:
+                    continue
+            landed = [r for r in results if r in ("new", "dup")]
+            if landed or not results \
+                    or any(r != "stale-epoch" for r in results):
+                break
+            # membership changed between the snapshot and the writes:
+            # every owner fenced the now-stale epoch. Re-resolve under
+            # the new membership and retry ONCE — further churn falls
+            # to the repair pass instead of looping here.
         if not landed:
             if "quant-mismatch" in results:
                 POOL_STATS.quant_rejected += 1
@@ -650,12 +723,16 @@ class ClusterKvPool:
             POOL_STATS.publishes += 1
         else:
             POOL_STATS.dedup_hits += 1
-        POOL_STATS.entries = len(self)
         with self._mu:
             srcs = self._hash_sources.setdefault(seq_hash, set())
             newly = source not in srcs
             srcs.add(source)
             self._hash_meta[seq_hash] = (parent, tokens_hash)
+            # O(1) distinct-hash gauge: len(self) unions every host's
+            # hashes (O(total entries)) — too slow for the hot publish
+            # path; _hash_meta tracks distinct published hashes and is
+            # pruned when the last owner drops one
+            POOL_STATS.entries = len(self._hash_meta)
         if newly:
             self._emit(source, "stored", seq_hash, parent, tokens_hash)
         return "new" if "new" in landed else "dup"
@@ -732,7 +809,7 @@ class ClusterKvPool:
         with self._mu:
             for sh, srcs in list(self._hash_sources.items()):
                 srcs.discard(source)
-        POOL_STATS.entries = len(self)
+            POOL_STATS.entries = len(self._hash_meta)
         if dropped:
             POOL_STATS.source_evictions += 1
         return dropped

@@ -169,6 +169,18 @@ class HashRing:
                         break
             return owners
 
+    def owners_with_epoch(self, key: int,
+                          r: Optional[int] = None) -> Tuple[int, List[str]]:
+        """Atomic (epoch, owners) snapshot under ONE lock hold — the
+        pair a fenced write needs: owners computed under a ring tagged
+        with THAT ring's epoch. Reading `epoch` and `owners_for` as two
+        separate calls lets a membership change slip between them,
+        yielding new-ring owners tagged with the old epoch — every
+        serving host then fences the write and a healthy publish
+        reports unavailable."""
+        with self._mu:
+            return self.epoch, self.owners_for(key, r)
+
     def lookup(self, key: int) -> Optional[str]:
         """Primary owner only (epoch-fenced like owners_for: valid for
         the current membership epoch, rechecked by the serving host)."""
@@ -245,3 +257,9 @@ class PoolMembership:
         # placement answers are epoch-scoped: pair with `epoch` and let
         # the serving host's stale-epoch fence reject a racing change
         return self.ring.owners_for(key, r)
+
+    def owners_with_epoch(self, key: int,
+                          r: Optional[int] = None) -> Tuple[int, List[str]]:
+        # the atomic pairing of the two reads above — what an
+        # epoch-fenced write path must use (HashRing.owners_with_epoch)
+        return self.ring.owners_with_epoch(key, r)

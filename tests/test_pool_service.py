@@ -553,6 +553,97 @@ def test_stored_events_ride_once_per_source():
     assert ev2 == [("stored", 0, 0x1, 0, 0x10)]
 
 
+def test_note_source_skips_unreachable_owner():
+    """The dedup fast path counts only REACHABLE owners: a killed or
+    partitioned host must not vouch for bytes it cannot serve — a
+    'stored' answer with no live holder would price routes on a prefix
+    whose every fetch burns a doomed replica walk into recompute."""
+    cl = make_cluster(n_hosts=2)
+    cl.publish("w1", 0x1, 0, 0x10, page_arrays())
+    for h in cl._hosts.values():
+        h.partition(True)
+    assert cl.note_source("w2", 0x1, 0, 0x10) is False
+    assert cl.drain_events("w2") == []     # no stored event emitted
+    for h in cl._hosts.values():           # heal: owners vouch again
+        h.partition(False)
+    assert cl.note_source("w2", 0x1, 0, 0x10) is True
+    assert cl.drain_events("w2") == [("stored", 0, 0x1, 0, 0x10)]
+
+
+# -- concurrency regressions --------------------------------------------------
+
+def test_concurrent_capacity_evictions_no_cross_host_deadlock():
+    """ABBA regression: a capacity eviction reports the removed entry
+    up to the cluster, whose globally-gone check scans the OTHER hosts.
+    Two at-capacity hosts evicting concurrently used to each hold their
+    own lock while waiting on the other's. The report is now delivered
+    only after the evicting host's lock is released, so a publish storm
+    across tiny no-disk hosts must always terminate."""
+    import threading
+    cl = make_cluster(n_hosts=2, replicas=1, capacity_pages=1)
+    errs = []
+
+    def storm(wid, base):
+        try:
+            for i in range(60):
+                cl.publish(wid, base + i, 0, 0x1, page_arrays(i % 4))
+        except Exception as exc:   # pragma: no cover — diagnostics only
+            errs.append(exc)
+
+    ts = [threading.Thread(target=storm, args=(f"w{k}", 0x1000 * (k + 1)),
+                           daemon=True) for k in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errs
+    assert not any(t.is_alive() for t in ts)   # a hung thread == deadlock
+
+
+def test_read_page_miss_after_concurrent_eviction_returns_none():
+    """read_page re-locks after the verifying fetch; a concurrent
+    publish can evict the just-read entry in that window — the
+    rebalance-side read must answer None (the next pass re-finds the
+    gap), never crash run_rebalance with a KeyError."""
+    h = KvPoolHost("ph0", capacity_pages=4)
+    assert h.publish_page("w1", 0x1, 0, 0x10, page_arrays()) == "new"
+    orig = h.fetch_page
+
+    def racing_fetch(seq_hash, mode=""):
+        arrays = orig(seq_hash, mode)
+        with h._mu:                    # concurrent publish evicts it
+            h._entries.pop(seq_hash, None)
+        return arrays
+
+    h.fetch_page = racing_fetch
+    assert h.read_page(0x1) is None
+
+
+def test_publish_retries_once_when_membership_races_mid_publish():
+    """The (epoch, owners) snapshot is atomic, but membership can still
+    change between the snapshot and the writes — every owner then
+    fences the stale epoch. publish re-resolves under the new
+    membership and retries ONCE instead of reporting a healthy pool
+    'unavailable' (and silently not caching the page)."""
+    cl = make_cluster(n_hosts=2)
+    real = cl.membership.owners_with_epoch
+    calls = {"n": 0}
+
+    def racing(key, r=None):
+        calls["n"] += 1
+        epoch, owners = real(key, r)
+        if calls["n"] == 1:            # join/leave landed mid-publish
+            return epoch - 1, owners
+        return epoch, owners
+
+    cl.membership.owners_with_epoch = racing
+    assert cl.publish("w1", 0x9, 0, 0x1, page_arrays()) == "new"
+    assert calls["n"] == 2
+    assert REMOTE_STATS.stale_epoch_rejected >= 1
+    assert REMOTE_STATS.stale_epoch_landed == 0
+    assert 0x9 in cl
+
+
 # -- disagg admission: lease re-arm (satellite) -------------------------------
 
 def test_lease_rearm_before_multi_page_pool_claim_pins_one_fetcher():
