@@ -407,6 +407,9 @@ def supervise() -> int:
                 sh = best["extras"].get("sharded_transfer") or {}
                 if "failure" in sh:
                     sh = {}
+                dk = best["extras"].get("decode_kernel") or {}
+                if "failure" in dk:
+                    dk = {}
                 ratios = {
                     f"disagg_agg_ttft_ratio_early_{suffix}":
                         to.get("disagg_agg_ttft_ratio_early")
@@ -434,6 +437,13 @@ def supervise() -> int:
                         sh.get("paced_wall_ratio"),
                     f"sharded_disagg_ttft_ratio_{suffix}":
                         sh.get("disagg_ttft_ratio"),
+                    # ragged kernel (ISSUE 18): unified/legacy step time
+                    # must stay at or under parity, and the fused tail
+                    # under the unfused — both gated "lower"
+                    f"decode_kernel_unified_legacy_step_ratio_{suffix}":
+                        dk.get("unified_legacy_step_ratio"),
+                    f"decode_kernel_fused_tail_step_ratio_{suffix}":
+                        dk.get("fused_unfused_step_ratio"),
                 }
                 for metric, value in ratios.items():
                     if value and value > 0:
@@ -776,6 +786,113 @@ def run_kv_quant_ab(model_cfg, base_kwargs=None, *, seconds=10.0,
     del eng
     return {"capacity": capacity,
             "churn_int8_tok_s": round(tok_s, 1)}
+
+
+def run_decode_kernel_ab(model_cfg, base_kwargs=None, *, rows=8,
+                         n_chips=1, touch=lambda: None, logf=None):
+    """Ragged-kernel + fused-tail A/B for extras["decode_kernel"]
+    (ISSUE 18): step time of the frozen pre-PR-18 kernel vs the unified
+    ragged kernel vs unified + fused sampling tail, token-identity
+    enforced in-phase.
+
+    Each arm is ONE jitted "decode step" at the model's geometry:
+    paged attention over ragged lengths -> a head projection -> the
+    sampling tail. Arms: (a) legacy (s, hkv)-grid kernel + unfused tail,
+    (b) unified ragged kernel + unfused tail, (c) unified + fused tail
+    (the production common path — what a decode window runs per step).
+    All three must sample IDENTICAL tokens (top_p = 1 workload); the
+    unified/legacy step-time ratio is the tentpole's no-regression gate
+    (<= 1.0, BASELINE.json `decode_kernel_unified_legacy_step_ratio_*`)
+    and the fused/unfused ratio prices the tail fusion. CPU runs both
+    kernels in interpret mode (program-count overhead dominates: the
+    ragged kernel launches s programs vs the legacy s*hkv); the TPU
+    ladder item (BENCH_SELF_r18_ragged_tpu) gives the hardware verdict.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine import sampler
+    from dynamo_tpu.ops.paged_attention import decode_paged_attention
+    from dynamo_tpu.ops.paged_attention_oracle import (
+        decode_paged_attention_legacy,
+    )
+
+    logf = logf or log
+    kw = dict(base_kwargs or PAGE_KWARGS)
+    interpret = jax.devices()[0].platform != "tpu"
+    s = rows
+    h, hkv, hd = (model_cfg.num_heads, model_cfg.num_kv_heads,
+                  model_cfg.head_dim)
+    ps, pb = kw["page_size"], 4
+    p = s * pb
+    vocab = model_cfg.vocab_size
+    rng = np.random.default_rng(18)
+    q = jnp.asarray(rng.standard_normal((s, h, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((hkv, p, ps, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((hkv, p, ps, hd)), jnp.float32)
+    pt = jnp.asarray(np.arange(s * pb).reshape(s, pb), jnp.int32)
+    lens = jnp.asarray(rng.integers(1, pb * ps, s), jnp.int32)
+    w_head = jnp.asarray(
+        rng.standard_normal((h * hd, vocab)) * 0.05, jnp.float32)
+    temp = jnp.full((s,), 0.8, jnp.float32)
+    top_k = jnp.full((s,), 40, jnp.int32)
+    top_p = jnp.ones((s,), jnp.float32)
+    keys = sampler.make_keys(jnp.arange(s, dtype=jnp.int32),
+                             jnp.zeros((s,), jnp.int32))
+
+    def make_step(kernel, fused):
+        def f(q, k, v, pt, lens, w_head, temp, top_k, top_p, keys):
+            attn = kernel(q, k, v, pt, lens, interpret=interpret)
+            logits = attn.reshape(s, h * hd) @ w_head
+            if fused:
+                return sampler.sample_fused(logits, temp, top_k, keys)
+            return sampler.sample(logits, temp, top_k, top_p, keys)
+        return jax.jit(f)
+
+    arms = {
+        "legacy": make_step(decode_paged_attention_legacy, False),
+        "unified": make_step(decode_paged_attention, False),
+        "unified_fused": make_step(decode_paged_attention, True),
+    }
+    args = (q, k, v, pt, lens, w_head, temp, top_k, top_p, keys)
+    toks, ms = {}, {}
+    reps = 30 if not interpret else 4
+    for name, fn in arms.items():
+        toks[name] = np.asarray(fn(*args))     # compile + identity probe
+        touch()
+        t0 = _time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        out.block_until_ready()
+        ms[name] = (_time.perf_counter() - t0) / reps * 1e3
+        touch()
+    identical = bool(np.array_equal(toks["legacy"], toks["unified"])
+                     and np.array_equal(toks["unified"],
+                                        toks["unified_fused"]))
+    # token identity is the phase's correctness gate, not a soft metric
+    assert identical, {k2: v2.tolist() for k2, v2 in toks.items()}
+    res = {
+        "rows": s, "heads": h, "kv_heads": hkv, "head_dim": hd,
+        "page_size": ps, "interpret": interpret,
+        "legacy_step_ms": round(ms["legacy"], 3),
+        "unified_step_ms": round(ms["unified"], 3),
+        "unified_fused_step_ms": round(ms["unified_fused"], 3),
+        "unified_legacy_step_ratio": round(
+            ms["unified"] / ms["legacy"], 4) if ms["legacy"] else None,
+        "fused_unfused_step_ratio": round(
+            ms["unified_fused"] / ms["unified"], 4)
+        if ms["unified"] else None,
+        "tokens_identical": identical,
+    }
+    logf(f"decode kernel A/B ({'interpret' if interpret else 'tpu'}): "
+         f"legacy {ms['legacy']:.2f} ms -> unified {ms['unified']:.2f} ms "
+         f"(ratio {res['unified_legacy_step_ratio']}), fused tail "
+         f"{ms['unified_fused']:.2f} ms "
+         f"(ratio {res['fused_unfused_step_ratio']}); tokens identical")
+    return res
 
 
 def run_transfer_overlap_ab(model_cfg, base_kwargs=None, *, requests=6,
@@ -1938,6 +2055,21 @@ def worker():
         except Exception as e:  # evidence phase must not kill the capture
             log(f"kv_quant A/B failed ({type(e).__name__}: {e})")
             st.result["extras"]["kv_quant"] = {"failure": str(e)}
+        st.touch()
+
+    if os.environ.get("BENCH_DECODE_KERNEL", "1") != "0" \
+            and time.time() - T0 < BUDGET_S - 120:
+        st.set_phase("decode_kernel_ab")
+        log("phase: decode kernel A/B — frozen legacy vs unified ragged "
+            "kernel vs unified + fused sampling tail, token-identity "
+            "enforced (ISSUE 18)")
+        try:
+            st.result["extras"]["decode_kernel"] = run_decode_kernel_ab(
+                model_cfg, PAGE_KWARGS, n_chips=n_chips, touch=st.touch,
+                logf=log)
+        except Exception as e:  # evidence phase must not kill the capture
+            log(f"decode kernel A/B failed ({type(e).__name__}: {e})")
+            st.result["extras"]["decode_kernel"] = {"failure": str(e)}
         st.touch()
 
     if os.environ.get("BENCH_SPEC") == "oracle":

@@ -2,12 +2,13 @@
 
 Three layers (see docs/ANALYSIS.md):
 
-- AST lint (ast_rules.py, R1-R21): source-level rules distilled from
+- AST lint (ast_rules.py, R1-R23): source-level rules distilled from
   this repo's actual bug history — unguarded vocab gathers, Pallas
   kernels missing stale-tail K/V zeroing, blocking calls on async paths,
   CancelledError-swallowing handlers, iterate-while-mutating, host syncs
   in hot-path files, unbounded waits, span lifecycle, contract rules,
-  await-interleaving TOCTOU races.
+  await-interleaving TOCTOU races, decode-kernel forks outside the
+  unified dispatcher.
 - jaxpr audit (jaxpr_audit.py, J1-J5): traces the engine's jitted entry
   points with abstract bucket-shaped inputs and asserts invariants on
   the jaxprs (no f64 leaks, donation consumable, trace-tight bucket

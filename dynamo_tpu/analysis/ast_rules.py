@@ -168,6 +168,17 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       hosts that no longer own the key — the zombie-sender class of
       bug, one layer down (runtime/placement.py is the placement layer
       itself and is exempt, like ops/kv_quant.py for R11)
+- R23 one decode kernel (dynamo_tpu/ + tools/): constructing a decode
+      attention `pl.pallas_call(...)` anywhere outside the unified
+      dispatcher (ops/paged_attention.py owns THE ragged kernel; the
+      frozen legacy copies live in ops/paged_attention_oracle.py as
+      test oracles) must carry `# dynalint: kernel-ok=<reason>` within
+      three lines above. PR 18 collapsed three decode kernels into one
+      ragged kernel precisely because per-call-site kernel forks drift
+      — a fork skips the stale-tail zeroing (R2) or the int8
+      scale-folding and decodes garbage only on the geometry the fork
+      serves. Any new direct construction is either a test oracle
+      (annotate it) or a regression
 """
 from __future__ import annotations
 
@@ -302,7 +313,7 @@ def r1_unguarded_vocab_gather(tree: ast.AST, lines: List[str],
 
 # -- R2: Pallas decode kernels missing stale-tail K/V zeroing -----------------
 
-_KERNEL_RE = re.compile(r"^_decode_kernel")
+_KERNEL_RE = re.compile(r"^_(ragged_)?decode_kernel")
 _BUF_RE = re.compile(r"\b[kv]_buf\b")
 
 
@@ -1934,6 +1945,84 @@ def r22_placement_epoch_contract(tree: ast.AST, lines: List[str],
             "ring_epoch and hosts fence mismatches' — or annotate "
             "with `# dynalint: ring-ok=<why a stale owner list is "
             "safe here>`"))
+    return out
+
+
+# -- R23: one decode kernel — direct pallas_call forks must be declared -------
+
+# Scope: the dynamo_tpu package and tools/ (bench/profile drivers are
+# exactly where a "quick local kernel" fork gets pasted). PR 18
+# collapsed _decode_kernel / _decode_kernel_packed /
+# _decode_kernel_prefix into ONE ragged kernel dispatched from
+# ops/paged_attention.py; the frozen pre-PR-18 copies survive only in
+# ops/paged_attention_oracle.py as parity oracles. A decode-attention
+# `pl.pallas_call` constructed anywhere else is a kernel fork: it
+# starts life without the stale-tail zeroing (R2) and int8
+# scale-folding defenses and drifts from the dispatcher on the next
+# geometry change. Lexical like R22: the call must carry
+# `# dynalint: kernel-ok=<reason>` within three lines above.
+# ops/paged_attention.py is the dispatcher itself — exempt (the R11
+# ops/kv_quant.py precedent). The oracle module is in scope on
+# purpose: its two frozen call sites carry the annotation, so a THIRD
+# copy pasted there still flags.
+_R23_SCOPE = ("dynamo_tpu/", "tools/")
+_R23_EXEMPT = ("ops/paged_attention.py",)
+_R23_ANNOT_RE = re.compile(r"#\s*dynalint:\s*kernel-ok=\S+")
+
+
+def _r23_mentions_decode(node: ast.AST) -> bool:
+    """True when any identifier under `node` names a decode kernel.
+
+    Catches the kernel passed bare (`_decode_kernel_packed`), through
+    `functools.partial(_ragged_decode_kernel, ...)`, or as an
+    attribute (`mod._decode_kernel`).
+    """
+    for sub in ast.walk(node):
+        ident = sub.id if isinstance(sub, ast.Name) else (
+            sub.attr if isinstance(sub, ast.Attribute) else "")
+        if "decode" in ident.lower() and "kernel" in ident.lower():
+            return True
+    return False
+
+
+@rule("R23")
+def r23_one_decode_kernel(tree: ast.AST, lines: List[str],
+                          path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R23_SCOPE) \
+            or any(part in norm for part in _R23_EXEMPT):
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R23_ANNOT_RE.search(_line(lines, x))
+                   for x in range(ln - 3, ln + 1))
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node).rsplit(".", 1)[-1] != "pallas_call":
+            continue
+        kernel = node.args[0] if node.args else None
+        if kernel is None:
+            for kw in node.keywords:
+                if kw.arg in ("kernel", "f"):
+                    kernel = kw.value
+        if kernel is None or not _r23_mentions_decode(kernel):
+            continue
+        if annotated(node.lineno):
+            continue
+        out.append(_finding(
+            "R23", path, lines, node,
+            "decode-attention `pallas_call` constructed outside the "
+            "unified dispatcher (ops/paged_attention.py) — PR 18 "
+            "collapsed the decode kernels into one ragged kernel "
+            "because per-site forks skip the stale-tail zeroing and "
+            "int8 scale-folding defenses and drift on the next "
+            "geometry change",
+            "dispatch through ops/paged_attention.py, or annotate "
+            "with `# dynalint: kernel-ok=<why this copy must exist — "
+            "e.g. frozen parity oracle>` within three lines above"))
     return out
 
 

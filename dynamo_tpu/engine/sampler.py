@@ -47,6 +47,7 @@ class SamplingArrayCache:
         self._key = None
         self._static = None
         self._greedy = True
+        self._fusable = True
 
     def invalidate(self) -> None:
         self._key = None
@@ -74,6 +75,7 @@ class SamplingArrayCache:
                 min_toks[i] = p.min_tokens
             self._static = (temp, top_k, top_p, seeds, min_toks)
             self._greedy = bool(np.all(temp <= 0.0))
+            self._fusable = bool(np.all(top_p >= 1.0))
             self._key = key
         temp, top_k, top_p, seeds, min_toks = self._static
         counters = np.fromiter(
@@ -85,6 +87,14 @@ class SamplingArrayCache:
     def all_greedy(self) -> bool:
         """Every slot in the last-built set samples greedily."""
         return self._greedy
+
+    @property
+    def fused_eligible(self) -> bool:
+        """Every slot in the last-built set has top_p disabled (== 1.0), so
+        the fused top_p-free sampler (`sample_fused`) draws token-identical
+        samples — the decode window's common-path tail. Rows requesting a
+        real top_p force the window onto the unfused `sample` tail."""
+        return self._fusable
 
 
 class RepPenaltyCache:
@@ -226,11 +236,65 @@ def sample(
     return jnp.where(temperature <= 0.0, greedy_tok, sampled)
 
 
+def sample_fused(
+    logits: jax.Array,        # [B, V] f32
+    temperature: jax.Array,   # [B] f32; 0 => greedy
+    top_k: jax.Array,         # [B] int32; 0 => disabled
+    keys: jax.Array,          # [B] PRNG keys (make_keys)
+) -> jax.Array:               # [B] int32
+    """The fused decode-window sampling tail: temperature + top-k only.
+
+    Valid ONLY when every row's top_p is 1.0 (disabled) — the common
+    serving shape (SamplingArrayCache.fused_eligible gates it). Token-
+    identical to `sample` there, by construction:
+
+    - ranks: `sample` computes argsort(argsort(scaled)[:, ::-1]) — the
+      inverse permutation of the descending order. Scattering iota through
+      the SAME descending permutation (`ranks[order[j]] = j`) IS that
+      inverse, element-for-element, so tie-breaking is bit-identical while
+      dropping one full-vocab argsort and the jnp.sort.
+    - masked set: with top_p == 1.0, `sample`'s keep_p mask is all-True
+      (the strict `cumprobs - sorted_probs < 1.0` can only exclude a tail
+      element when the f32 cumsum rounds to exactly 1.0 while that
+      element's softmax underflows to 0 — a probability-0 candidate; the
+      PERF.md §3g exactness note), so keep_k alone decides — identical.
+    - draw: same make_keys stream, same categorical over the same masked
+      row => the same token.
+
+    What this buys inside the jitted window: the full tail keeps FOUR
+    [B, V] intermediates alive (sorted logits, two argsorts, softmax+
+    cumsum) between ops; this one keeps one argsort and one scatter — the
+    zero-intermediate-HBM-round-trip sampling leg of the one-dispatch
+    decode step."""
+    b, v = logits.shape
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    order = jnp.argsort(scaled, axis=-1)[:, ::-1]          # [B, V] desc perm
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    iota = jnp.broadcast_to(jnp.arange(v, dtype=jnp.int32), (b, v))
+    ranks = jnp.zeros((b, v), jnp.int32).at[rows, order].set(iota)
+
+    k = jnp.where(top_k > 0, top_k, v)[:, None]
+    masked = jnp.where(ranks < k, scaled, NEG_INF)
+    sampled = jax.vmap(
+        lambda kk, row: jax.random.categorical(kk, row)
+    )(keys, masked).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled)
+
+
 def sample_logits(logits, eos_ids, temperature, top_k, top_p, seeds,
                   counters, min_tokens, seen=None, rep_penalty=None,
-                  with_lp=False, greedy=False):
+                  with_lp=False, greedy=False, fused=False):
     """Shared tail of every engine step: repetition penalty (optional) +
     eos ban below min_tokens + sample (+ logprobs when with_lp).
+
+    `fused` selects the top_p-free `sample_fused` tail; callers must only
+    set it when every row's top_p is 1.0 (SamplingArrayCache.fused_eligible)
+    — the engine stages it as a static window-key bit, so a plan mixing in
+    a real top_p row recompiles onto the unfused tail, token-identically.
 
     Returns (tokens [B], sampled_lp [B], top_ids [B, K], top_lps [B, K]);
     the lp outputs are None unless with_lp — the full-vocab log_softmax +
@@ -252,6 +316,9 @@ def sample_logits(logits, eos_ids, temperature, top_k, top_p, seeds,
         # all-greedy plan: argmax only — the full sampler's vocab sort
         # costs ~1.5 ms/step on a 128k vocab (measured, v5e)
         toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    elif fused:
+        keys = make_keys(seeds, counters)
+        toks = sample_fused(logits, temperature, top_k, keys)
     else:
         keys = make_keys(seeds, counters)
         toks = sample(logits, temperature, top_k, top_p, keys)

@@ -212,6 +212,10 @@ class EngineMetrics:
     # slot membership) / blocking output fetches / windows that staged
     # fresh host plan arrays (0-upload steady state when this stays flat)
     decode_windows: int = 0
+    # device program launches in decode — the one-dispatch-per-window
+    # invariant (PR 18): dispatches / windows holds at exactly 1.0 on the
+    # common path (attention kernel + sampling tail fused in one program)
+    decode_dispatches: int = 0
     pipeline_windows: int = 0
     pipeline_overlapped: int = 0
     pipeline_fallbacks: int = 0

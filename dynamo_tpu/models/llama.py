@@ -45,18 +45,25 @@ Params = Dict[str, Any]
 def _decode_kernel_mode(cfg: ModelConfig) -> Optional[str]:
     """Resolve the decode-attention implementation at trace time.
 
-    Returns "tpu" / "interpret" to use the Pallas kernel, None for the XLA
-    gather path. On multi-device meshes the kernel runs under shard_map
-    over "tp" (auto-sharded jit cannot partition a pallas_call).
+    Returns "tpu" / "interpret" to use the ragged Pallas kernel (the ONE
+    decode-attention kernel, ops/paged_attention.py — per-row page-walk
+    lengths cover plain, packed, and prefix-window rows in a single
+    program), None for the XLA gather path. On multi-device meshes the
+    kernel runs under shard_map over "tp" (auto-sharded jit cannot
+    partition a pallas_call).
 
     "auto" now resolves to the GATHER path everywhere: measured on v5e
-    (llama3-1b, batch 8, kv~300-600), the deferred-write gather decode runs
-    7.5 ms/step vs 34 ms for the Pallas kernel — the kernel's per-(seq,
-    head, page) small dots ([G<=8, 128] x [rows, 128]) are fixed-overhead
-    bound on the MXU, while the gather path's single big einsum amortizes.
-    The kernel stays available ("on") for geometries where gathered-KV HBM
-    traffic dominates (very long contexts with large page buckets), and
-    "interpret" remains the CPU test path exercising the kernel code."""
+    (llama3-1b, batch 8, kv~300-600, the pre-unification kernel trio), the
+    deferred-write gather decode runs 7.5 ms/step vs 34 ms for the Pallas
+    kernel — per-(seq, head, page) small dots ([G<=8, 128] x [rows, 128])
+    are fixed-overhead bound on the MXU, while the gather path's single
+    big einsum amortizes. The ragged kernel walks the same pages with the
+    same dot shapes (grid (s,) instead of (s, hkv)), so the verdict is
+    expected to hold until the BENCH_SELF_r18_ragged_tpu ladder item
+    re-measures it; the kernel stays available ("on") for geometries where
+    gathered-KV HBM traffic dominates (very long contexts with large page
+    buckets), and "interpret" remains the CPU test path exercising the
+    kernel code."""
     mode = cfg.decode_kernel
     if mode in ("off", "auto"):
         return None

@@ -353,6 +353,7 @@ def pp_decode_window(
     n_steps: int,
     page_size: int,
     greedy: bool,
+    fused: bool,
     params: Params,
     cache: Dict[str, jax.Array],
     tokens: jax.Array,       # [S] int32 — fed token per slot
@@ -388,8 +389,11 @@ def pp_decode_window(
     previously greedy-only, with sampled plans paying full host-dispatch
     latency x pipeline bubble per token). `greedy` picks the
     argmax-only compiled variant so all-greedy plans skip the sampler's
-    vocab sort. Logprob/penalty plans stay per-token (the engine routes
-    them to the fused single-step path).
+    vocab sort; `fused` picks the top_p-free sample_fused tail for
+    sampled plans whose every row has top_p disabled — the same static
+    window-key bit as the single-mesh engine, so pp plans fuse the
+    common sampling tail identically. Logprob/penalty plans stay
+    per-token (the engine routes them to the fused single-step path).
 
     Device-side finish tracking mirrors the single-mesh decode window:
     eos (unless ignore_eos), hidden stop ids, and the max_pos budget all
@@ -411,7 +415,7 @@ def pp_decode_window(
     wnds = None if lw is None else jnp.asarray(lw, jnp.int32)
     kvq = "k_scale" in cache
     fwd = functools.partial(_pp_decode_body, cfg, pp, tp, n_steps,
-                            page_size, eos_ids, greedy, kvq,
+                            page_size, eos_ids, greedy, fused, kvq,
                             wnds is not None)
     in_specs = (P("tp", None), shardings["layers"], P(None), head_spec,
                 pp_cache_sharding(), pp_cache_sharding(),
@@ -453,7 +457,7 @@ def pp_decode_window(
 
 
 def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
-                    kvq, has_wnds,
+                    fused, kvq, has_wnds,
                     embed, layers, final_norm, head,
                     kc, vc, tokens, pos0, page_table, max_pos,
                     min_tokens, counters, ignore_eos, stop_ids,
@@ -526,7 +530,7 @@ def _pp_decode_body(cfg, pp, tp, n_steps, page_size, eos_ids, greedy,
         # real (others see garbage logits); emit gates what rides out.
         sampled, _, _, _ = sample_logits(
             lg, eos_ids, temp_mb[i], tk_mb[i], tp_mb[i], seed_mb[i],
-            ctr_mb[i] + k, mt_mb[i], greedy=greedy)
+            ctr_mb[i] + k, mt_mb[i], greedy=greedy, fused=fused)
         new_alive = alive_in
         if eos_vec is not None:
             new_alive = new_alive & (ign_mb[i] | ~eos_vec[sampled])

@@ -119,6 +119,17 @@ def model_flops_per_token(cfg) -> float:
     return 2.0 * (cfg.num_layers * (attn + mlp) + head)
 
 
+def sampler_flops_per_token(cfg) -> float:
+    """FLOPs the fused sampling tail spends per decoded token (PR 18):
+    with the tail fused into the decode window program, its vocab-sized
+    work (temperature scale, rank mask, gumbel draw — ~5 elementwise
+    passes over [V], sort excluded as comparison-not-FLOP) executes on
+    the device inside the step the ledger meters, so the MFU denominator
+    counts it. Kept separate from `model_flops_per_token` (whose formula
+    is load-bearing for existing consumers); the engine passes the sum."""
+    return 5.0 * cfg.vocab_size
+
+
 _KINDS = ("prefill", "decode", "mixed", "spec")
 
 

@@ -26,11 +26,11 @@ written once, by the same write_idx scatter as the values.
 Dequantization sites (the only places quantized bytes become values):
 - the XLA gather fallback (ops/attention.py): dequantize right after
   the page gather, before any score math;
-- the Pallas decode kernels (ops/paged_attention.py): int8 pages DMA
-  HBM->VMEM and the scales fold into the score/probability rows —
+- the ragged Pallas decode kernel (ops/paged_attention.py): int8 pages
+  DMA HBM->VMEM and the scales fold into the score/probability rows —
   ``(q . k_int8) * s_k`` equals ``q . (k_int8 * s_k)`` because a row's
-  scale is constant over the contraction, so the kernels never
-  materialize a dequantized page;
+  scale is constant over the contraction, so the kernel never
+  materializes a dequantized page;
 - the decode window's base gather (engine/engine.py): the per-window
   read-only base buffer is dequantized once per window.
 

@@ -1,4 +1,4 @@
-"""Pallas TPU decode kernel: paged attention reading HBM pages directly.
+"""Pallas TPU decode attention: ONE ragged paged-attention kernel.
 
 The XLA fallback (ops/attention.py) materializes the gathered KV prefix
 ([B, Pb*ps, Hkv, hd]) in HBM every step — a 2x-3x traffic amplification on
@@ -9,24 +9,44 @@ GPU engines' paged-attention kernels behind the reference, e.g. vLLM's; the
 reference's own native kernel is the block-copy CUDA kernel,
 lib/llm/src/kernels/block_copy.cu:40-200).
 
-Layout contract: per-layer caches are [Hkv, P, ps, hd] so one (head, page)
+There is exactly ONE production kernel (`_ragged_decode_kernel`, built by
+the one `pl.pallas_call` in `ragged_decode_attention` — dynalint R23 keeps
+it that way). It is ragged over the batch: grid (s,), one program per
+sequence row, each row's page walk driven by its own per-row length from
+`AttnMetadata` (the `MixedPlan` row vocabulary: plain single-token rows,
+packed multi-query rows, prefix-window rows all reduce to "attend `lens[s]`
+tokens of row s's pages"). The kernel always returns the UNNORMALIZED flash
+state (acc, m, l); consumers pick the mode:
+
+- prefix rows (`decode_paged_attention_prefix`): `lens` counts valid kv
+  BEFORE the current token; fold the token itself with
+  `combine_self_attention` (the deferred-write decode hot path);
+- plain/packed rows (`decode_paged_attention`): `lens` is INCLUSIVE of the
+  current token (already scattered into the pages); normalize by l outside.
+
+The historical three-kernel split (`_decode_kernel` direct hd>=128,
+`_decode_kernel_packed` hd<128, `_decode_kernel_prefix`) survives only as
+test oracles in ops/paged_attention_oracle.py.
+
+Layout contract: caches are [L, Hkv, P, ps, hd] so one (layer, head, page)
 slice is a contiguous [ps, hd] block — the DMA-friendly layout (same reason
 the reference keeps per-layer block tensors, lib/llm/src/kv/layer.rs:100-616).
-
-Grid: (batch, kv_head). Each program owns one (sequence, kv head) pair and
-loops over that sequence's pages (dynamic trip count = ceil(kv_len/ps)),
-prefetching page i+1 while computing page i. Grouped-query heads ride along:
-the q block is [G, hd] with G = H // Hkv.
+The layer index is a scalar-prefetch arg so callers never materialize a
+per-layer slice copy; per-layer [Hkv, P, ps, hd] callers pass a free
+`cache[None]` view with layer 0.
 
 head_dim < 128 (llama3-1b has hd=64): an HBM slice whose minor dim is hd
 would violate Mosaic's 128-lane tiling ("Slice shape along dimension 3 must
-be aligned to tiling (128)"). The packed variant instead views each [ps, hd]
-page as [ps/pack, 128] rows (pack = 128//hd; a free row-major reshape done
+be aligned to tiling (128)"). The kernel therefore views each [ps, hd] page
+as [ps/pack, pack*hd] rows (pack = 128//hd; a free row-major reshape done
 outside the kernel), so every DMA is lane-aligned. Row r of a packed block
 holds tokens r*pack .. r*pack+pack-1; scores come from `pack` lane-shifted
 copies of q dotted against the packed block, and the flash accumulator is
-kept packed [G, 128] (each hd-lane segment accumulates its residue class),
-folded to [G, hd] by a reshape+sum outside the kernel.
+kept packed [G, pack*hd] (each hd-lane segment accumulates its residue
+class), folded to [G, hd] by a reshape+sum outside the kernel. hd >= 128 is
+the same code at pack = 1: one q copy, a full-lane mask, rows = ps — the
+packed machinery degenerates to the direct layout, which is what lets one
+kernel cover every geometry `kernel_supported` admits.
 """
 # dynalint: hot-path — every op here runs inside jitted decode/prefill programs;
 # host syncs (.item(), device_get, float()) are dynalint R6 findings
@@ -48,222 +68,50 @@ NEG_INF = -1e30
 
 def kernel_supported(head_dim: int, page_size: int) -> bool:
     """Whether the compiled (non-interpret) kernel has a lane-aligned path
-    for this geometry: hd a multiple of 128 (direct DMA) or hd < 128 with
-    128 % hd == 0 and ps % (128//hd) == 0 (packed DMA). Callers gate to the
-    XLA fallback otherwise instead of dying at Mosaic compile."""
+    for this geometry: hd a multiple of 128 (pack=1 direct DMA) or hd < 128
+    with 128 % hd == 0 and ps % (128//hd) == 0 (packed DMA). Callers gate to
+    the XLA fallback otherwise instead of dying at Mosaic compile."""
     if head_dim >= 128:
         return head_dim % 128 == 0
     return 128 % head_dim == 0 and page_size % (128 // head_dim) == 0
 
 
-def _decode_kernel(ps: int, g: int, quant: bool, pt_ref, lens_ref, q_ref,
-                   k_hbm, v_hbm, *rest):
-    if quant:
-        # int8 pages: per-(page, token-row) scale blocks ride as regular
-        # VMEM inputs (gathered by page table outside the kernel); the
-        # dequant folds into the score/probability rows — a row's scale
-        # is constant over the hd contraction, so (q . k_int8) * s_k ==
-        # q . (k_int8 * s_k), and p * s_v moves V's scale into the
-        # probability operand of the accumulator dot
-        sk_ref, sv_ref, o_ref, k_buf, v_buf, sems = rest
-    else:
-        o_ref, k_buf, v_buf, sems = rest
-        sk_ref = sv_ref = None
-    s = pl.program_id(0)
-    j = pl.program_id(1)
-    kv_len = lens_ref[s]
-    n_pages = pl.cdiv(kv_len, ps)
-
-    hd = q_ref.shape[3]
-    # q is pre-grouped [S, Hkv, G, hd] and the BlockSpec blocks over the
-    # kv-head dim, so the block's minor dims (G, hd) equal the full array
-    # extent — the layout Mosaic accepts even when G < 8 (a G-row slice of
-    # an [H, hd] block is an unsupported vector.load for G=4, hd=64)
-    q = q_ref[0, 0].astype(jnp.float32) * (hd ** -0.5)
-
-    def dma(i, slot, hbm, buf, kv):
-        return pltpu.make_async_copy(
-            hbm.at[j, pt_ref[s, i]], buf.at[slot], sems.at[slot, kv])
-
-    # warm-up: decode always has kv_len >= 1, so page 0 exists
-    dma(0, 0, k_hbm, k_buf, 0).start()
-    dma(0, 0, v_hbm, v_buf, 1).start()
-
-    def body(i, carry):
-        m, l, acc = carry
-        slot = jax.lax.rem(i, 2)
-        nxt = jax.lax.rem(i + 1, 2)
-
-        @pl.when(i + 1 < n_pages)
-        def _():
-            dma(i + 1, nxt, k_hbm, k_buf, 0).start()
-            dma(i + 1, nxt, v_hbm, v_buf, 1).start()
-
-        dma(i, slot, k_hbm, k_buf, 0).wait()
-        dma(i, slot, v_hbm, v_buf, 1).wait()
-        k = k_buf[slot].astype(jnp.float32)            # [ps, hd]
-        v = v_buf[slot].astype(jnp.float32)
-        # zero V rows past kv_len: the boundary page's tail holds whatever
-        # a recycled page last held, and p == 0 there does not survive a
-        # non-finite V (0 * NaN = NaN poisons the accumulator; same
-        # defense as the reference ops in ops/attention.py)
-        vrow = i * ps + jax.lax.broadcasted_iota(jnp.int32, (ps, 1), 0)
-        v = jnp.where(vrow < kv_len, v, 0.0)
-
-        scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [G, ps]
-        if quant:
-            scores = scores * sk_ref[0, 0, pl.ds(i, 1)]  # [1, ps] K dequant
-        pos = i * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps), 1)
-        scores = jnp.where(pos < kv_len, scores, NEG_INF)
-
-        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)                     # [G, 1]
-        p = jnp.exp(scores - m_new)                    # [G, ps]
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        pv = p * sv_ref[0, 0, pl.ds(i, 1)] if quant else p  # V dequant
-        acc_new = acc * alpha + jax.lax.dot_general(
-            pv, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [G, hd]
-        return m_new, l_new, acc_new
-
-    m0 = jnp.full((g, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((g, 1), jnp.float32)
-    acc0 = jnp.zeros((g, hd), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
-    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+def _kernel_pack(head_dim: int, page_size: int) -> int:
+    """Lane-packing factor for a geometry: 128//hd when the packed layout is
+    lane-exact, else 1 (direct [ps, hd] rows — the interpret-mode fallback
+    for unsupported geometries, and the hd >= 128 production layout)."""
+    if head_dim < 128 and kernel_supported(head_dim, page_size):
+        return 128 // head_dim
+    return 1
 
 
-def _decode_kernel_packed(ps: int, g: int, hd: int, pack: int, quant: bool,
-                          pt_ref, lens_ref, q_ref, k_hbm, v_hbm, *rest):
-    """hd < 128 variant: pages are packed [rows, 128] blocks (rows = ps/pack).
-
-    Token (r*pack + pk) of a page lives in row r, lanes [pk*hd, (pk+1)*hd).
-    The output o_ref is the PACKED accumulator [G, 128] (f32): lane segment
-    pk holds the attention contribution of tokens == pk (mod pack); the
-    caller folds segments with a reshape+sum.
-
-    quant (int8 pages): scale blocks arrive [1, 1, Pb*pack, rows] (page-
-    table-gathered outside, token (r*pack+pk) of page i at [i*pack+pk, r])
-    and fold into the per-segment score/probability rows — segment pk's
-    [G, rows] score covers exactly the tokens whose scale row is
-    [i*pack+pk], so the fold is a [1, rows] broadcast multiply.
-    """
-    if quant:
-        sk_ref, sv_ref, o_ref, k_buf, v_buf, sems = rest
-    else:
-        o_ref, k_buf, v_buf, sems = rest
-        sk_ref = sv_ref = None
-    s = pl.program_id(0)
-    j = pl.program_id(1)
-    kv_len = lens_ref[s]
-    n_pages = pl.cdiv(kv_len, ps)
-    rows = ps // pack
-
-    # q pre-grouped [S, Hkv, G, hd]; this block is kv-head j's G query rows
-    q = q_ref[0, 0].astype(jnp.float32) * (hd ** -0.5)
-    zeros = jnp.zeros((g, hd), jnp.float32)
-    # pack lane-shifted copies: q_shifts[pk] has q in lanes [pk*hd,(pk+1)*hd)
-    q_shifts = [
-        jnp.concatenate([zeros] * pk + [q] + [zeros] * (pack - 1 - pk),
-                        axis=-1)
-        for pk in range(pack)
-    ]
-    lane = jax.lax.broadcasted_iota(jnp.int32, (g, pack * hd), 1)
-    lane_masks = [(lane // hd) == pk for pk in range(pack)]
-
-    def dma(i, slot, hbm, buf, kv):
-        return pltpu.make_async_copy(
-            hbm.at[j, pt_ref[s, i]], buf.at[slot], sems.at[slot, kv])
-
-    dma(0, 0, k_hbm, k_buf, 0).start()
-    dma(0, 0, v_hbm, v_buf, 1).start()
-
-    def body(i, carry):
-        m, l, acc = carry            # m, l: [G, 1]; acc: [G, 128] packed
-        slot = jax.lax.rem(i, 2)
-        nxt = jax.lax.rem(i + 1, 2)
-
-        @pl.when(i + 1 < n_pages)
-        def _():
-            dma(i + 1, nxt, k_hbm, k_buf, 0).start()
-            dma(i + 1, nxt, v_hbm, v_buf, 1).start()
-
-        dma(i, slot, k_hbm, k_buf, 0).wait()
-        dma(i, slot, v_hbm, v_buf, 1).wait()
-        k = k_buf[slot].astype(jnp.float32)            # [rows, 128]
-        v = v_buf[slot].astype(jnp.float32)
-        # zero K AND V lanes of tokens past kv_len (recycled-page tail):
-        # p == 0 does not survive a non-finite V (0 * NaN = NaN), and the
-        # packed score dot contracts over ALL 128 lanes, so a non-finite
-        # K lane in a NEIGHBORING segment NaNs a VALID token's score
-        # through the zero-padded q_shifts (0 * NaN again) — lane segment
-        # pk of row r holds token i*ps + r*pack + pk
-        vrow = jax.lax.broadcasted_iota(jnp.int32, (rows, pack * hd), 0)
-        vlane = jax.lax.broadcasted_iota(jnp.int32, (rows, pack * hd), 1)
-        vpos = i * ps + vrow * pack + vlane // hd
-        k = jnp.where(vpos < kv_len, k, 0.0)
-        v = jnp.where(vpos < kv_len, v, 0.0)
-
-        row = jax.lax.broadcasted_iota(jnp.int32, (1, rows), 1)
-        scores = []
-        for pk in range(pack):
-            sc = jax.lax.dot_general(
-                q_shifts[pk], k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)    # [G, rows]
-            if quant:
-                sc = sc * sk_ref[0, 0, pl.ds(i * pack + pk, 1)]  # [1, rows]
-            pos = i * ps + row * pack + pk
-            scores.append(jnp.where(pos < kv_len, sc, NEG_INF))
-
-        m_new = m
-        for sc in scores:
-            m_new = jnp.maximum(m_new, jnp.max(sc, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l
-        acc_new = acc * alpha
-        for pk in range(pack):
-            p = jnp.exp(scores[pk] - m_new)            # [G, rows]
-            l_new = l_new + jnp.sum(p, axis=-1, keepdims=True)
-            pv = (p * sv_ref[0, 0, pl.ds(i * pack + pk, 1)] if quant
-                  else p)                              # V dequant fold
-            contrib = jax.lax.dot_general(
-                pv, v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)    # [G, 128]
-            # lanes outside segment pk are cross-residue junk — mask them
-            acc_new = acc_new + jnp.where(lane_masks[pk], contrib, 0.0)
-        return m_new, l_new, acc_new
-
-    m0 = jnp.full((g, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((g, 1), jnp.float32)
-    acc0 = jnp.zeros((g, pack * hd), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
-    o_ref[0, 0] = acc / l
-
-
-def _decode_kernel_prefix(ps: int, hkv: int, g: int, hd: int, pack: int,
+def _ragged_decode_kernel(ps: int, hkv: int, g: int, hd: int, pack: int,
                           quant: bool, pt_ref, lens_ref, layer_ref,
                           q_ref, k_hbm, v_hbm, *rest):
-    """Prefix-only decode attention, one program per SEQUENCE (grid (s,)).
+    """THE decode attention kernel: one program per SEQUENCE (grid (s,)).
 
-    Three design deltas vs _decode_kernel_packed, all for the serving hot
-    loop (round-2 verdict: decode was host- and overhead-bound):
-    - grid (s,) with all kv heads batched per program: 8x fewer program
-      launches and one [Hkv, rows, W] DMA per page instead of Hkv small
-      ones (the (s, hkv) grid's per-program overhead exceeded the XLA
-      gather path's whole cost on a 1B model);
-    - the cache stays WHOLE ([L, Hkv, P, rows, W]) with the layer index a
-      scalar-prefetch arg, so the caller never materializes a per-layer
-      slice copy;
-    - attends the PREFIX only and returns the unnormalized flash state
-      (acc, m, l): the current token's kv is combined outside
-      (combine_self_attention), which lets the engine defer all cache
-      writes to one in-place scatter per step.
+    Ragged: each program walks its own row's pages (dynamic trip count
+    ceil(lens[s]/ps)), prefetching page i+1 while computing page i, with
+    all kv heads batched per program — one [Hkv, rows, W] DMA per page
+    instead of Hkv small ones, and 8x fewer program launches than the
+    historical (s, hkv) grid (whose per-program overhead exceeded the XLA
+    gather path's whole cost on a 1B model; round-2 verdict: decode was
+    host- and overhead-bound).
+
+    The cache stays WHOLE ([L, Hkv, P, rows, W]) with the layer index a
+    scalar-prefetch arg, so the caller never materializes a per-layer
+    slice copy. The kernel attends the first lens[s] tokens of the row's
+    pages and returns the UNNORMALIZED flash state (acc, m, l); whether
+    that span is a prefix (combine the current token outside) or the full
+    inclusive window (normalize by l outside) is the caller's contract —
+    the kernel itself is mode-free.
 
     quant (int8 pages): per-head scale blocks [1, Hkv, Pb*pack, rows]
     (this layer's scales, page-table-gathered outside) fold into the
-    score/probability rows exactly as in _decode_kernel_packed.
+    score/probability rows — a row's scale is constant over the hd
+    contraction, so (q . k_int8) * s_k == q . (k_int8 * s_k), and p * s_v
+    moves V's scale into the probability operand of the accumulator dot.
+    The page DMA itself stays int8: half the HBM traffic of a bf16 read.
     """
     if quant:
         sk_ref, sv_ref, o_ref, m_ref, l_ref, k_buf, v_buf, sems = rest
@@ -273,22 +121,25 @@ def _decode_kernel_prefix(ps: int, hkv: int, g: int, hd: int, pack: int,
     s = pl.program_id(0)
     w = pack * hd
     rows = ps // pack
-    prefix = lens_ref[s]
+    length = lens_ref[s]
     lyr = layer_ref[0]
-    # clamped page count: padding slots (prefix 0) still DMA page 0 safely.
+    # clamped page count: padding slots (length 0) still DMA page 0 safely.
     # NOTE their outputs are NOT zeros: fully-masked scores are a finite
     # NEG_INF, so m stays NEG_INF but p = exp(sc - m) = 1 — l/acc pick up
-    # page-0 garbage. Correctness relies on combine_self_attention scaling
-    # by exp(m - m') which underflows to exactly 0; do NOT normalize by l
+    # page-0 garbage. Correctness relies on the consumer scaling by
+    # exp(m - m') (combine_self_attention) which underflows to exactly 0,
+    # or on the plain wrapper clamping lens >= 1; do NOT normalize by l
     # here or skip the combine for empty prefixes.
-    n_pages = jnp.maximum(pl.cdiv(prefix, ps), 1)
+    n_pages = jnp.maximum(pl.cdiv(length, ps), 1)
 
     # per-head unrolled compute (a batched dot_general over the head dim
     # lowered to something ~4x slower in Mosaic; plain 2-D dots per head
-    # match the proven _decode_kernel_packed codegen)
+    # are the proven codegen)
     qs = [q_ref[0, j].astype(jnp.float32) * (hd ** -0.5)
           for j in range(hkv)]                           # each [G, hd]
     zeros = jnp.zeros((g, hd), jnp.float32)
+    # pack lane-shifted q copies: segment pk holds q in lanes
+    # [pk*hd, (pk+1)*hd); at pack=1 this is just [[q]] — the direct layout
     q_shifts = [
         [jnp.concatenate([zeros] * pk + [qs[j]] + [zeros] * (pack - 1 - pk),
                          axis=-1) for pk in range(pack)]
@@ -317,17 +168,17 @@ def _decode_kernel_prefix(ps: int, hkv: int, g: int, hd: int, pack: int,
         dma(i, slot, k_hbm, k_buf, 0).wait()
         dma(i, slot, v_hbm, v_buf, 1).wait()
 
-        # zero K AND V lanes of tokens past the prefix (recycled-page
+        # zero K AND V lanes of tokens past the valid span (recycled-page
         # tails hold arbitrary, possibly non-finite values): the packed
         # score dot contracts over ALL 128 lanes, so a non-finite K lane
         # in a NEIGHBOURING token's segment NaNs a VALID token's score
         # through the zero-padded q_shifts (0 * NaN), and p == 0 on
         # masked rows does not survive a non-finite V in the accumulator
-        # dot — same defense as _decode_kernel_packed (ADVICE r5 medium)
+        # dot (ADVICE r5 medium; the round-5 page-poisoning class)
         vrow = jax.lax.broadcasted_iota(jnp.int32, (rows, w), 0)
         vlane = jax.lax.broadcasted_iota(jnp.int32, (rows, w), 1)
         vpos = i * ps + vrow * pack + vlane // hd
-        tail_ok = vpos < prefix
+        tail_ok = vpos < length
 
         row = jax.lax.broadcasted_iota(jnp.int32, (1, rows), 1)
         ms_n, ls_n, accs_n = [], [], []
@@ -344,7 +195,7 @@ def _decode_kernel_prefix(ps: int, hkv: int, g: int, hd: int, pack: int,
                 if quant:
                     sc = sc * sk_ref[0, j, pl.ds(i * pack + pk, 1)]
                 pos = i * ps + row * pack + pk
-                scores.append(jnp.where(pos < prefix, sc, NEG_INF))
+                scores.append(jnp.where(pos < length, sc, NEG_INF))
             m_new = ms[j]
             for sc in scores:
                 m_new = jnp.maximum(m_new,
@@ -376,20 +227,25 @@ def _decode_kernel_prefix(ps: int, hkv: int, g: int, hd: int, pack: int,
         l_ref[0, j] = jnp.broadcast_to(ls[j], (g, w))
 
 
-def decode_paged_attention_prefix(
+def ragged_decode_attention(
     q: jax.Array,            # [S, H, hd] — one query token per sequence
     k_cache: jax.Array,      # [L, Hkv, P, ps, hd] (whole stack, all layers)
     v_cache: jax.Array,
     layer: jax.Array,        # [1] int32 — which layer's pages to read
     page_table: jax.Array,   # [S, Pb] int32
-    prefix_lens: jax.Array,  # [S] int32 — valid kv BEFORE this token
+    lens: jax.Array,         # [S] int32 — valid tokens in row s's pages
     *,
     interpret: bool = False,
     k_scale: Optional[jax.Array] = None,  # [L, Hkv, P, ps] f32 (int8 cache)
     v_scale: Optional[jax.Array] = None,
 ):
-    """Unnormalized prefix attention state: (acc [S,H,hd] f32, m [S,H,1],
-    l [S,H,1]). Fold with the current token via combine_self_attention.
+    """THE unified dispatcher: builds the one production `pl.pallas_call`
+    (dynalint R23 fences any other decode-attention pallas_call site).
+
+    Returns the unnormalized flash state (acc [S,H,hd] f32, m [S,H,1],
+    l [S,H,1]) of each row over the first lens[s] tokens of its pages.
+    Prefix consumers fold the current token via combine_self_attention;
+    inclusive consumers (decode_paged_attention) normalize by l.
 
     With k_scale/v_scale (int8 cache), this layer's scales are gathered by
     the page table OUTSIDE the kernel (an [S, Hkv, Pb, ps] f32 gather —
@@ -399,7 +255,7 @@ def decode_paged_attention_prefix(
     s, h, hd = q.shape
     nl, hkv, p, ps, _ = k_cache.shape
     g = h // hkv
-    pack = max(1, 128 // hd)
+    pack = _kernel_pack(hd, ps)
     w = pack * hd
     rows = ps // pack
     quant = k_scale is not None
@@ -413,7 +269,7 @@ def decode_paged_attention_prefix(
         pl.BlockSpec(memory_space=pl.ANY),
         pl.BlockSpec(memory_space=pl.ANY),
     ]
-    args = (page_table, prefix_lens, layer, qg, k_pk, v_pk)
+    args = (page_table, lens, layer, qg, k_pk, v_pk)
     if quant:
         def scale_blocks(scale):
             # this layer's scales, gathered to [S, Hkv, Pb*pack, rows]:
@@ -451,7 +307,7 @@ def decode_paged_attention_prefix(
     )
     shape = jax.ShapeDtypeStruct((s, hkv, g, w), jnp.float32)
     acc, m, l = pl.pallas_call(
-        functools.partial(_decode_kernel_prefix, ps, hkv, g, hd, pack,
+        functools.partial(_ragged_decode_kernel, ps, hkv, g, hd, pack,
                           quant),
         out_shape=[shape, shape, shape],
         grid_spec=grid_spec,
@@ -459,6 +315,27 @@ def decode_paged_attention_prefix(
     )(*args)
     acc = acc.reshape(s, hkv, g, pack, hd).sum(axis=3).reshape(s, h, hd)
     return acc, m[..., :1].reshape(s, h, 1), l[..., :1].reshape(s, h, 1)
+
+
+def decode_paged_attention_prefix(
+    q: jax.Array,            # [S, H, hd] — one query token per sequence
+    k_cache: jax.Array,      # [L, Hkv, P, ps, hd] (whole stack, all layers)
+    v_cache: jax.Array,
+    layer: jax.Array,        # [1] int32 — which layer's pages to read
+    page_table: jax.Array,   # [S, Pb] int32
+    prefix_lens: jax.Array,  # [S] int32 — valid kv BEFORE this token
+    *,
+    interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [L, Hkv, P, ps] f32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
+):
+    """Prefix-mode view of the ragged kernel: lens counts valid kv BEFORE
+    the current token, so the engine can defer all cache writes to one
+    in-place scatter per step. Returns the unnormalized state (acc, m, l);
+    fold the current token via combine_self_attention."""
+    return ragged_decode_attention(
+        q, k_cache, v_cache, layer, page_table, prefix_lens,
+        interpret=interpret, k_scale=k_scale, v_scale=v_scale)
 
 
 def combine_self_attention(q, k_new, v_new, acc, m, l):
@@ -489,8 +366,9 @@ def decode_paged_attention_prefix_sharded(
     q, k_cache, v_cache, layer, page_table, prefix_lens, mesh,
     *, interpret: bool = False, k_scale=None, v_scale=None,
 ):
-    """shard_map the prefix kernel over the "tp" axis (heads sharded);
-    int8 caches shard the scale stacks' kv-head axis the same way."""
+    """shard_map the ragged kernel (prefix mode) over the "tp" axis (heads
+    sharded); int8 caches shard the scale stacks' kv-head axis the same
+    way."""
     in_specs = (P(None, "tp", None), P(None, "tp", None, None, None),
                 P(None, "tp", None, None, None), P(None),
                 P(None, None), P(None))
@@ -529,108 +407,26 @@ def decode_paged_attention(
     k_scale: Optional[jax.Array] = None,  # [Hkv, P, ps] f32 (int8 cache)
     v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Returns [S, H, hd] attention of each decode token over its pages.
+    """Inclusive-mode view of the ragged kernel: returns [S, H, hd]
+    attention of each decode token over its pages, kv_lens INCLUSIVE of
+    the current token (already scattered into the pages).
+
+    The per-layer [Hkv, P, ps, hd] cache rides as a free `cache[None]`
+    single-layer view with layer index 0; the kernel's unnormalized
+    (acc, m, l) is normalized here (the historical in-kernel `acc / l`).
 
     With k_scale/v_scale (int8 cache) the scales are gathered by the page
     table outside the kernel and folded into the in-kernel score/prob
     rows; the page DMA stays int8."""
-    s, h, hd = q.shape
-    hkv, p, ps, _ = k_cache.shape
-    g = h // hkv
-    pb = page_table.shape[1]
-    quant = k_scale is not None
     # padded decode slots carry kv_len 0; clamp so the page-0 warm-up DMA
     # and the 1/l normalization stay well-defined (their output is ignored)
     kv_lens = jnp.maximum(kv_lens, 1)
-
-    # group queries by kv head: [S, Hkv, G, hd]. The BlockSpec blocks over
-    # the kv-head dim so each program's q block minor dims (G, hd) are the
-    # full array extent — valid Mosaic layout for any G (see kernel docs).
-    qg = q.reshape(s, hkv, g, hd)
-
-    def gather_scale(scale):                     # -> [S, Hkv, Pb, ps]
-        sg = jnp.take(scale, page_table.reshape(-1),
-                      axis=1).reshape(hkv, s, pb, ps)
-        return sg.transpose(1, 0, 2, 3)
-
-    if hd < 128 and kernel_supported(hd, ps):
-        # lane-aligned packed path (see module docstring): view pages as
-        # [rows, 128] and fold the packed accumulator outside the kernel
-        pack = 128 // hd
-        rows = ps // pack
-        k_pk = k_cache.reshape(hkv, p, rows, 128)   # free row-major bitcast
-        v_pk = v_cache.reshape(hkv, p, rows, 128)
-        in_specs = [
-            pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ]
-        args = (page_table, kv_lens, qg, k_pk, v_pk)
-        if quant:
-            def packed_scale(scale):             # -> [S, Hkv, Pb*pack, rows]
-                sg = gather_scale(scale)
-                return (sg.reshape(s, hkv, pb, rows, pack)
-                        .transpose(0, 1, 2, 4, 3)
-                        .reshape(s, hkv, pb * pack, rows))
-            in_specs += [
-                pl.BlockSpec((1, 1, pb * pack, rows),
-                             lambda i, j, *_: (i, j, 0, 0)),
-                pl.BlockSpec((1, 1, pb * pack, rows),
-                             lambda i, j, *_: (i, j, 0, 0)),
-            ]
-            args = args + (packed_scale(k_scale), packed_scale(v_scale))
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(s, hkv),
-            in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, 1, g, 128),
-                                   lambda i, j, *_: (i, j, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((2, rows, 128), k_cache.dtype),
-                pltpu.VMEM((2, rows, 128), v_cache.dtype),
-                pltpu.SemaphoreType.DMA((2, 2)),
-            ],
-        )
-        packed = pl.pallas_call(
-            functools.partial(_decode_kernel_packed, ps, g, hd, pack,
-                              quant),
-            out_shape=jax.ShapeDtypeStruct((s, hkv, g, 128), jnp.float32),
-            grid_spec=grid_spec,
-            interpret=interpret,
-        )(*args)
-        return (packed.reshape(s, h, pack, hd).sum(axis=2).astype(q.dtype))
-
-    in_specs = [
-        pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
-        pl.BlockSpec(memory_space=pl.ANY),
-        pl.BlockSpec(memory_space=pl.ANY),
-    ]
-    args = (page_table, kv_lens, qg, k_cache, v_cache)
-    if quant:
-        in_specs += [
-            pl.BlockSpec((1, 1, pb, ps), lambda i, j, *_: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, pb, ps), lambda i, j, *_: (i, j, 0, 0)),
-        ]
-        args = args + (gather_scale(k_scale), gather_scale(v_scale))
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(s, hkv),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, ps, hd), k_cache.dtype),
-            pltpu.VMEM((2, ps, hd), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
-    )
-    out = pl.pallas_call(
-        functools.partial(_decode_kernel, ps, g, quant),
-        out_shape=jax.ShapeDtypeStruct((s, hkv, g, hd),
-                                       jnp.float32 if quant else q.dtype),
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(*args)
-    return out.reshape(s, h, hd).astype(q.dtype)
+    acc, _, l = ragged_decode_attention(
+        q, k_cache[None], v_cache[None], jnp.zeros((1,), jnp.int32),
+        page_table, kv_lens, interpret=interpret,
+        k_scale=None if k_scale is None else k_scale[None],
+        v_scale=None if v_scale is None else v_scale[None])
+    return (acc / l).astype(q.dtype)
 
 
 def decode_paged_attention_sharded(
@@ -645,7 +441,7 @@ def decode_paged_attention_sharded(
     k_scale: Optional[jax.Array] = None,  # [Hkv, P, ps] f32 (int8 cache)
     v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Multi-chip decode kernel: shard_map over the "tp" mesh axis.
+    """Multi-chip inclusive-mode kernel: shard_map over the "tp" mesh axis.
 
     pallas_call cannot be auto-partitioned by jit, so each tp shard runs the
     kernel on its own H/tp query heads against its Hkv/tp kv heads (the GQA

@@ -1,0 +1,328 @@
+"""LEGACY decode kernels, demoted to test oracles (PR 18).
+
+These are the historical three-way kernel split that `ragged_decode_attention`
+(ops/paged_attention.py) collapsed into one ragged kernel:
+
+- `_decode_kernel`        — direct hd >= 128 path, grid (s, hkv)
+- `_decode_kernel_packed` — lane-packed hd < 128 path, grid (s, hkv)
+
+(the third, `_decode_kernel_prefix`, WAS the ragged kernel's ancestor and
+lives on as the production kernel itself — its oracle is the XLA gather
+path plus the numpy references in tests/.)
+
+They exist ONLY as independent numerical oracles for the parity matrix
+(tests/test_ragged_kernel.py) and the bench `decode_kernel_ab` phase: a
+same-math-different-schedule cross-check that the unified kernel preserved
+the per-page flash accumulation, int8 scale folds, and stale-tail-zeroing
+of the kernels it replaced. Nothing under engine/ or models/ may import
+this module — dynalint R23 fences any decode-attention `pl.pallas_call`
+outside the unified dispatcher, and the two sites here carry the
+`kernel-ok` annotation that marks them sanctioned oracles.
+
+Do not optimize this file: its value is that it does NOT change.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dynamo_tpu.ops.paged_attention import NEG_INF, kernel_supported
+
+
+def _decode_kernel(ps: int, g: int, quant: bool, pt_ref, lens_ref, q_ref,
+                   k_hbm, v_hbm, *rest):
+    if quant:
+        # int8 pages: per-(page, token-row) scale blocks ride as regular
+        # VMEM inputs (gathered by page table outside the kernel); the
+        # dequant folds into the score/probability rows — a row's scale
+        # is constant over the hd contraction, so (q . k_int8) * s_k ==
+        # q . (k_int8 * s_k), and p * s_v moves V's scale into the
+        # probability operand of the accumulator dot
+        sk_ref, sv_ref, o_ref, k_buf, v_buf, sems = rest
+    else:
+        o_ref, k_buf, v_buf, sems = rest
+        sk_ref = sv_ref = None
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    kv_len = lens_ref[s]
+    n_pages = pl.cdiv(kv_len, ps)
+
+    hd = q_ref.shape[3]
+    # q is pre-grouped [S, Hkv, G, hd] and the BlockSpec blocks over the
+    # kv-head dim, so the block's minor dims (G, hd) equal the full array
+    # extent — the layout Mosaic accepts even when G < 8 (a G-row slice of
+    # an [H, hd] block is an unsupported vector.load for G=4, hd=64)
+    q = q_ref[0, 0].astype(jnp.float32) * (hd ** -0.5)
+
+    def dma(i, slot, hbm, buf, kv):
+        return pltpu.make_async_copy(
+            hbm.at[j, pt_ref[s, i]], buf.at[slot], sems.at[slot, kv])
+
+    # warm-up: decode always has kv_len >= 1, so page 0 exists
+    dma(0, 0, k_hbm, k_buf, 0).start()
+    dma(0, 0, v_hbm, v_buf, 1).start()
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, 2)
+        nxt = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            dma(i + 1, nxt, k_hbm, k_buf, 0).start()
+            dma(i + 1, nxt, v_hbm, v_buf, 1).start()
+
+        dma(i, slot, k_hbm, k_buf, 0).wait()
+        dma(i, slot, v_hbm, v_buf, 1).wait()
+        k = k_buf[slot].astype(jnp.float32)            # [ps, hd]
+        v = v_buf[slot].astype(jnp.float32)
+        # zero V rows past kv_len: the boundary page's tail holds whatever
+        # a recycled page last held, and p == 0 there does not survive a
+        # non-finite V (0 * NaN = NaN poisons the accumulator; same
+        # defense as the reference ops in ops/attention.py)
+        vrow = i * ps + jax.lax.broadcasted_iota(jnp.int32, (ps, 1), 0)
+        v = jnp.where(vrow < kv_len, v, 0.0)
+
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [G, ps]
+        if quant:
+            scores = scores * sk_ref[0, 0, pl.ds(i, 1)]  # [1, ps] K dequant
+        pos = i * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps), 1)
+        scores = jnp.where(pos < kv_len, scores, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)                     # [G, 1]
+        p = jnp.exp(scores - m_new)                    # [G, ps]
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = p * sv_ref[0, 0, pl.ds(i, 1)] if quant else p  # V dequant
+        acc_new = acc * alpha + jax.lax.dot_general(
+            pv, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [G, hd]
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((g, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    acc0 = jnp.zeros((g, hd), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def _decode_kernel_packed(ps: int, g: int, hd: int, pack: int, quant: bool,
+                          pt_ref, lens_ref, q_ref, k_hbm, v_hbm, *rest):
+    """hd < 128 variant: pages are packed [rows, 128] blocks (rows = ps/pack).
+
+    Token (r*pack + pk) of a page lives in row r, lanes [pk*hd, (pk+1)*hd).
+    The output o_ref is the PACKED accumulator [G, 128] (f32): lane segment
+    pk holds the attention contribution of tokens == pk (mod pack); the
+    caller folds segments with a reshape+sum.
+
+    quant (int8 pages): scale blocks arrive [1, 1, Pb*pack, rows] (page-
+    table-gathered outside, token (r*pack+pk) of page i at [i*pack+pk, r])
+    and fold into the per-segment score/probability rows — segment pk's
+    [G, rows] score covers exactly the tokens whose scale row is
+    [i*pack+pk], so the fold is a [1, rows] broadcast multiply.
+    """
+    if quant:
+        sk_ref, sv_ref, o_ref, k_buf, v_buf, sems = rest
+    else:
+        o_ref, k_buf, v_buf, sems = rest
+        sk_ref = sv_ref = None
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    kv_len = lens_ref[s]
+    n_pages = pl.cdiv(kv_len, ps)
+    rows = ps // pack
+
+    # q pre-grouped [S, Hkv, G, hd]; this block is kv-head j's G query rows
+    q = q_ref[0, 0].astype(jnp.float32) * (hd ** -0.5)
+    zeros = jnp.zeros((g, hd), jnp.float32)
+    # pack lane-shifted copies: q_shifts[pk] has q in lanes [pk*hd,(pk+1)*hd)
+    q_shifts = [
+        jnp.concatenate([zeros] * pk + [q] + [zeros] * (pack - 1 - pk),
+                        axis=-1)
+        for pk in range(pack)
+    ]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (g, pack * hd), 1)
+    lane_masks = [(lane // hd) == pk for pk in range(pack)]
+
+    def dma(i, slot, hbm, buf, kv):
+        return pltpu.make_async_copy(
+            hbm.at[j, pt_ref[s, i]], buf.at[slot], sems.at[slot, kv])
+
+    dma(0, 0, k_hbm, k_buf, 0).start()
+    dma(0, 0, v_hbm, v_buf, 1).start()
+
+    def body(i, carry):
+        m, l, acc = carry            # m, l: [G, 1]; acc: [G, 128] packed
+        slot = jax.lax.rem(i, 2)
+        nxt = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            dma(i + 1, nxt, k_hbm, k_buf, 0).start()
+            dma(i + 1, nxt, v_hbm, v_buf, 1).start()
+
+        dma(i, slot, k_hbm, k_buf, 0).wait()
+        dma(i, slot, v_hbm, v_buf, 1).wait()
+        k = k_buf[slot].astype(jnp.float32)            # [rows, 128]
+        v = v_buf[slot].astype(jnp.float32)
+        # zero K AND V lanes of tokens past kv_len (recycled-page tail):
+        # p == 0 does not survive a non-finite V (0 * NaN = NaN), and the
+        # packed score dot contracts over ALL 128 lanes, so a non-finite
+        # K lane in a NEIGHBORING segment NaNs a VALID token's score
+        # through the zero-padded q_shifts (0 * NaN again) — lane segment
+        # pk of row r holds token i*ps + r*pack + pk
+        vrow = jax.lax.broadcasted_iota(jnp.int32, (rows, pack * hd), 0)
+        vlane = jax.lax.broadcasted_iota(jnp.int32, (rows, pack * hd), 1)
+        vpos = i * ps + vrow * pack + vlane // hd
+        k = jnp.where(vpos < kv_len, k, 0.0)
+        v = jnp.where(vpos < kv_len, v, 0.0)
+
+        row = jax.lax.broadcasted_iota(jnp.int32, (1, rows), 1)
+        scores = []
+        for pk in range(pack):
+            sc = jax.lax.dot_general(
+                q_shifts[pk], k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)    # [G, rows]
+            if quant:
+                sc = sc * sk_ref[0, 0, pl.ds(i * pack + pk, 1)]  # [1, rows]
+            pos = i * ps + row * pack + pk
+            scores.append(jnp.where(pos < kv_len, sc, NEG_INF))
+
+        m_new = m
+        for sc in scores:
+            m_new = jnp.maximum(m_new, jnp.max(sc, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l
+        acc_new = acc * alpha
+        for pk in range(pack):
+            p = jnp.exp(scores[pk] - m_new)            # [G, rows]
+            l_new = l_new + jnp.sum(p, axis=-1, keepdims=True)
+            pv = (p * sv_ref[0, 0, pl.ds(i * pack + pk, 1)] if quant
+                  else p)                              # V dequant fold
+            contrib = jax.lax.dot_general(
+                pv, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)    # [G, 128]
+            # lanes outside segment pk are cross-residue junk — mask them
+            acc_new = acc_new + jnp.where(lane_masks[pk], contrib, 0.0)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((g, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    acc0 = jnp.zeros((g, pack * hd), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    o_ref[0, 0] = acc / l
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decode_paged_attention_legacy(
+    q: jax.Array,            # [S, H, hd] — one query token per sequence
+    k_cache: jax.Array,      # [Hkv, P, ps, hd]
+    v_cache: jax.Array,      # [Hkv, P, ps, hd]
+    page_table: jax.Array,   # [S, Pb] int32
+    kv_lens: jax.Array,      # [S] int32 (>= 1 per active slot)
+    *,
+    interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [Hkv, P, ps] f32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
+) -> jax.Array:
+    """The pre-PR-18 `decode_paged_attention`: grid (s, hkv), one program
+    per (sequence, kv head), packed or direct per geometry. Test oracle
+    only — production routes through `ragged_decode_attention`."""
+    s, h, hd = q.shape
+    hkv, p, ps, _ = k_cache.shape
+    g = h // hkv
+    pb = page_table.shape[1]
+    quant = k_scale is not None
+    kv_lens = jnp.maximum(kv_lens, 1)
+    qg = q.reshape(s, hkv, g, hd)
+
+    def gather_scale(scale):                     # -> [S, Hkv, Pb, ps]
+        sg = jnp.take(scale, page_table.reshape(-1),
+                      axis=1).reshape(hkv, s, pb, ps)
+        return sg.transpose(1, 0, 2, 3)
+
+    if hd < 128 and kernel_supported(hd, ps):
+        pack = 128 // hd
+        rows = ps // pack
+        k_pk = k_cache.reshape(hkv, p, rows, 128)   # free row-major bitcast
+        v_pk = v_cache.reshape(hkv, p, rows, 128)
+        in_specs = [
+            pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        args = (page_table, kv_lens, qg, k_pk, v_pk)
+        if quant:
+            def packed_scale(scale):             # -> [S, Hkv, Pb*pack, rows]
+                sg = gather_scale(scale)
+                return (sg.reshape(s, hkv, pb, rows, pack)
+                        .transpose(0, 1, 2, 4, 3)
+                        .reshape(s, hkv, pb * pack, rows))
+            in_specs += [
+                pl.BlockSpec((1, 1, pb * pack, rows),
+                             lambda i, j, *_: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, pb * pack, rows),
+                             lambda i, j, *_: (i, j, 0, 0)),
+            ]
+            args = args + (packed_scale(k_scale), packed_scale(v_scale))
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(s, hkv),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, g, 128),
+                                   lambda i, j, *_: (i, j, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, rows, 128), k_cache.dtype),
+                pltpu.VMEM((2, rows, 128), v_cache.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        )
+        # dynalint: kernel-ok=frozen pre-PR-18 packed oracle for the parity matrix
+        packed = pl.pallas_call(
+            functools.partial(_decode_kernel_packed, ps, g, hd, pack,
+                              quant),
+            out_shape=jax.ShapeDtypeStruct((s, hkv, g, 128), jnp.float32),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(*args)
+        return (packed.reshape(s, h, pack, hd).sum(axis=2).astype(q.dtype))
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    args = (page_table, kv_lens, qg, k_cache, v_cache)
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, pb, ps), lambda i, j, *_: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, pb, ps), lambda i, j, *_: (i, j, 0, 0)),
+        ]
+        args = args + (gather_scale(k_scale), gather_scale(v_scale))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, hkv),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, g, hd), lambda i, j, *_: (i, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, hd), k_cache.dtype),
+            pltpu.VMEM((2, ps, hd), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    # dynalint: kernel-ok=frozen pre-PR-18 direct oracle for the parity matrix
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, ps, g, quant),
+        out_shape=jax.ShapeDtypeStruct((s, hkv, g, hd),
+                                       jnp.float32 if quant else q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(*args)
+    return out.reshape(s, h, hd).astype(q.dtype)

@@ -80,7 +80,7 @@ def main():
     sds = jax.ShapeDtypeStruct
     dec = jax.jit(
         functools.partial(pp_decode_window, cfg, (128001,), mesh, n_steps,
-                          page_size, True),
+                          page_size, True, False),
         donate_argnums=(1,)).lower(
         params, cache,
         sds((slots,), jnp.int32), sds((slots,), jnp.int32),

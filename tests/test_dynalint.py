@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R22), the
+- one positive AND one negative fixture per AST rule (R1-R23), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -1451,6 +1451,106 @@ def test_r22_live_on_placement_call_sites():
             found = lint_source(f.read(), rel)
         assert not [x for x in found if x.rule == "R22"], \
             (rel, [x.message for x in found if x.rule == "R22"])
+
+
+# -- R23: one decode kernel ----------------------------------------------------
+
+R23_BAD = """
+    import functools
+    import jax.experimental.pallas as pl
+
+
+    def my_local_decode(q, k, v, ps, hkv):
+        # a "quick local kernel" fork of the decode attention path
+        return pl.pallas_call(
+            functools.partial(_decode_kernel_fork, ps, hkv),
+            grid=(4,),
+        )(q, k, v)
+"""
+
+
+def test_r23_flags_decode_pallas_call_outside_dispatcher():
+    found = lint_source(textwrap.dedent(R23_BAD),
+                        "dynamo_tpu/engine/fixture.py")
+    r23 = [x for x in found if x.rule == "R23"]
+    assert len(r23) == 1
+    found = lint_source(textwrap.dedent(R23_BAD), "tools/fixture.py")
+    assert "R23" in rules(found)
+    # a THIRD frozen copy pasted into the oracle module still flags
+    found = lint_source(textwrap.dedent(R23_BAD),
+                        "dynamo_tpu/ops/paged_attention_oracle.py")
+    assert "R23" in rules(found)
+
+
+def test_r23_quiet_outside_scope_and_in_dispatcher():
+    found = lint_source(textwrap.dedent(R23_BAD), "examples/fixture.py")
+    assert "R23" not in rules(found)
+    # the unified dispatcher owns THE kernel — exempt (the
+    # ops/kv_quant.py precedent from R11)
+    found = lint_source(textwrap.dedent(R23_BAD),
+                        "dynamo_tpu/ops/paged_attention.py")
+    assert "R23" not in rules(found)
+    # a pallas_call whose kernel is not decode attention stays quiet
+    other = """
+        import jax.experimental.pallas as pl
+
+
+        def quantize(x):
+            return pl.pallas_call(_quant_kernel, grid=(4,))(x)
+    """
+    found = lint_source(textwrap.dedent(other),
+                        "dynamo_tpu/ops/fixture.py")
+    assert "R23" not in rules(found)
+
+
+def test_r23_quiet_on_annotated_sites():
+    annotated = """
+        import functools
+        import jax.experimental.pallas as pl
+
+
+        def frozen_oracle(q, ps, hkv):
+            # dynalint: kernel-ok=frozen pre-PR-18 oracle fixture
+            return pl.pallas_call(
+                functools.partial(_decode_kernel_fork, ps, hkv),
+                grid=(4,),
+            )(q)
+    """
+    found = lint_source(textwrap.dedent(annotated),
+                        "dynamo_tpu/engine/fixture.py")
+    assert "R23" not in rules(found)
+
+
+def test_r23_live_tree_has_one_decode_dispatcher():
+    """The live tree dispatches decode attention through exactly one
+    module: ops/paged_attention.py (exempt). The two frozen oracle
+    call sites in ops/paged_attention_oracle.py carry
+    `# dynalint: kernel-ok=` annotations; nothing else constructs a
+    decode pallas_call."""
+    import glob
+    scoped = glob.glob(os.path.join(REPO, "dynamo_tpu", "**", "*.py"),
+                       recursive=True)
+    scoped += glob.glob(os.path.join(REPO, "tools", "*.py"))
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R23"], \
+            (rel, [x.message for x in found if x.rule == "R23"])
+
+
+def test_r23_oracle_unreachable_from_engine():
+    """Acceptance: the legacy kernels are demoted to test oracles —
+    nothing under engine/ or models/ imports paged_attention_oracle."""
+    import glob
+    prod = glob.glob(os.path.join(REPO, "dynamo_tpu", "engine", "*.py"))
+    prod += glob.glob(os.path.join(REPO, "dynamo_tpu", "models", "*.py"))
+    assert prod
+    for path in prod:
+        with open(path) as f:
+            src = f.read()
+        assert "paged_attention_oracle" not in src, path
 
 
 def test_r19_live_on_preemption_call_sites():

@@ -17,6 +17,15 @@ breakdown:
    counters (windows / overlapped / fallbacks / host syncs / plan
    uploads), and the host seconds that executed concurrently with device
    compute.
+3. **Kernel + sampler attribution** (PR 18): each pass records which
+   decode kernel served the device leg (`decode_kernel_tag`: ragged /
+   gather / pp, "+fused" when the sampling tail ran in-program) and the
+   one-dispatch-per-window invariant (`decode_dispatches`,
+   `dispatches_per_window` — the unified ragged kernel keeps the common
+   decode window at EXACTLY one device dispatch). The fused sampling
+   tail never shows up in fetch/commit (it runs inside the window
+   program), so its cost is split out standalone: `sampler_tail` times
+   the fused vs unfused tail at the same [slots, vocab] geometry.
 
 The record is appended (append-only, final name — tools/artifacts.py
 policy, VERDICT r5 weak #7) to DECODE_PROFILE.jsonl at the repo root.
@@ -78,8 +87,14 @@ def run_pass(args, depth: int, profile_sync: bool, trace_dir=None) -> dict:
 
     eng = build_engine(args, depth)
     max_tokens = args.windows * args.decode_steps
-    params = SamplingParams(max_tokens=max_tokens, temperature=0.0,
-                            ignore_eos=True)
+    # --sampled drives the fused-tail path (seeded, top_p = 1) so the
+    # device leg carries the "+fused" kernel tag; default stays greedy
+    # for comparability with pre-PR-18 records
+    params = SamplingParams(
+        max_tokens=max_tokens, ignore_eos=True,
+        temperature=0.8 if args.sampled else 0.0,
+        top_k=40 if args.sampled else 0,
+        seed=1234 if args.sampled else 0)
     for i in range(args.slots):
         prompt = [(131 * i + j) % (eng.model_cfg.vocab_size - 1) + 1
                   for j in range(args.prompt_len)]
@@ -118,14 +133,68 @@ def run_pass(args, depth: int, profile_sync: bool, trace_dir=None) -> dict:
         "tokens": tokens,
         "tok_s": round(tokens / wall, 1) if wall else 0.0,
         "phases": eng.phases.split(),
+        # which kernel served the device leg ("ragged"/"gather"/"pp",
+        # "+fused" when the sampling tail ran inside the window program)
+        "decode_kernel_tag": eng.decode_kernel_tag,
         "counters": {
             "decode_windows": eng.decode_windows,
+            "decode_dispatches": eng.decode_dispatches,
             "pipeline_windows": eng.pipeline_windows,
             "pipeline_overlapped": eng.pipeline_overlapped,
             "pipeline_fallbacks": eng.pipeline_fallbacks,
             "decode_host_syncs": eng.decode_host_syncs,
             "decode_plan_uploads": eng.decode_plan_uploads,
         },
+        # the PR-18 invariant: the common decode window is ONE dispatch
+        "dispatches_per_window": round(
+            eng.decode_dispatches / eng.decode_windows, 4)
+        if eng.decode_windows else 0.0,
+    }
+
+
+def sampler_tail_split(args, vocab_size: int) -> dict:
+    """Standalone fused-vs-unfused sampling-tail timing at the decode
+    geometry [slots, vocab]. Inside a fused window the tail's cost rides
+    the device leg (fetch/commit never see it), so attribution needs the
+    tail measured on its own: `unfused_ms` is the full sort + double
+    argsort + softmax-cumsum tail, `fused_ms` the single-argsort rank
+    tail the common path dispatches (docs/PERF.md §3g)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine import sampler
+
+    rng = np.random.default_rng(0)
+    b = args.slots
+    logits = jnp.asarray(rng.standard_normal((b, vocab_size)), jnp.float32)
+    temp = jnp.full((b,), 0.8, jnp.float32)
+    top_k = jnp.full((b,), 40, jnp.int32)
+    top_p = jnp.ones((b,), jnp.float32)
+    keys = sampler.make_keys(jnp.arange(b, dtype=jnp.int32),
+                             jnp.zeros((b,), jnp.int32))
+
+    fused_fn = jax.jit(sampler.sample_fused)
+    unfused_fn = jax.jit(sampler.sample)
+
+    def timed(fn, *a):
+        fn(*a).block_until_ready()          # compile outside the clock
+        reps = 20
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*a)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    fused_ms = timed(fused_fn, logits, temp, top_k, keys)
+    unfused_ms = timed(unfused_fn, logits, temp, top_k, top_p, keys)
+    return {
+        "batch": b,
+        "vocab": vocab_size,
+        "fused_ms": round(fused_ms, 4),
+        "unfused_ms": round(unfused_ms, 4),
+        "fused_over_unfused": round(fused_ms / unfused_ms, 4)
+        if unfused_ms else 0.0,
     }
 
 
@@ -145,6 +214,8 @@ def main(argv=None) -> int:
                     help="append-only JSONL artifact (final name)")
     ap.add_argument("--trace-dir", default=None,
                     help="also capture a jax.profiler trace here")
+    ap.add_argument("--sampled", action="store_true",
+                    help="seeded sampling (top_p=1): the fused-tail path")
     args = ap.parse_args(argv)
 
     import jax
@@ -154,6 +225,11 @@ def main(argv=None) -> int:
                            trace_dir=args.trace_dir)
     # 2. overlap: the pipelined loop on the same workload
     pipelined = run_pass(args, depth=2, profile_sync=False)
+    # 3. the sampling tail, split out of the window program (PR 18)
+    from dynamo_tpu.engine.config import ModelConfig, get_model_config
+    vocab = (ModelConfig().vocab_size if args.model == "tiny-f32"
+             else get_model_config(args.model).vocab_size)
+    sampler_tail = sampler_tail_split(args, vocab)
 
     host_phases = ("plan", "upload", "commit", "detok")
     hidden_s = sum(pipelined["phases"].get(p, {}).get("seconds", 0.0)
@@ -167,6 +243,7 @@ def main(argv=None) -> int:
         "device_count": jax.device_count(),
         "attribution": attribution,
         "pipelined": pipelined,
+        "sampler_tail": sampler_tail,
         "overlap": {
             # host seconds that executed while the device ran a window
             "host_s_overlapped_with_device": round(hidden_s, 4),

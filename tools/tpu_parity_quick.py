@@ -74,6 +74,18 @@ def main() -> int:
             return 3  # config error: permanent, never retried
         import dataclasses
         model_cfg = dataclasses.replace(model_cfg, quant=quant)
+    # PARITY_DECODE_KERNEL=on: run the window-vs-single-step check with the
+    # ragged Pallas decode kernel instead of the serving-default XLA gather
+    # (models/llama._decode_kernel_mode), so the kernel path gets its own
+    # token-for-token hardware evidence (PARITY_TPU_r18_ragged ladder item).
+    dk = os.environ.get("PARITY_DECODE_KERNEL", "")
+    if dk:
+        if dk not in ("on", "interpret"):
+            log(f"PARITY_DECODE_KERNEL={dk!r} unsupported "
+                "(supported: on, interpret)")
+            return 3
+        import dataclasses
+        model_cfg = dataclasses.replace(model_cfg, decode_kernel=dk)
     # PARITY_KV_QUANT=int8: run the kv-cache quantization gate instead of
     # the window-vs-single-step check — greedy-match rate + bounded logit
     # drift between the int8-KV engine and its unquantized twin, the SAME
@@ -96,6 +108,8 @@ def main() -> int:
     }
     if quant:
         record["quant"] = quant
+    if dk:
+        record["decode_kernel"] = dk
     if kvq:
         record["kv_quant"] = kvq
     # evidence-artifact policy (tools/artifacts.py, VERDICT r5 weak #7):

@@ -31,7 +31,9 @@ while true; do
       && [ -e BENCH_SELF_r11_overlap_tpu.json ] \
       && [ -e BENCH_SELF_r13_warm_prefix_tpu.json ] \
       && [ -e BENCH_SELF_r15_sharded_tpu.json ] \
-      && [ -e BENCH_SELF_r17_pool_remote_tpu.json ]; then
+      && [ -e BENCH_SELF_r17_pool_remote_tpu.json ] \
+      && [ -e PARITY_TPU_r18_ragged.json ] \
+      && [ -e BENCH_SELF_r18_ragged_tpu.json ]; then
     echo "[watch] all TPU evidence captured; exiting" >&2
     exit 0
   fi
@@ -314,6 +316,54 @@ json.dump(r, open("BENCH_SELF_r17_pool_remote_tpu.json", "w"), indent=1)
 EOF
             cp "$rl" BENCH_SELF_r17_pool_remote_tpu.log 2>/dev/null
             echo "[watch] remote-pool captured: remote-fetch/cold $rvalue" >&2 ;;
+        esac
+      fi
+      if [ ! -e PARITY_TPU_r18_ragged.json ]; then
+        # ragged-kernel parity on hardware (ISSUE 18): window-vs-single-
+        # step greedy token check with decode_kernel=on, so the unified
+        # Pallas kernel (not the serving-default gather) carries the
+        # forward pass — Mosaic numerics are the one thing the CPU
+        # interpret-mode parity matrix (tests/test_ragged_kernel.py)
+        # cannot exercise
+        echo "[watch] -> ragged-kernel parity" >&2
+        PARITY_DECODE_KERNEL=on PARITY_OUT=PARITY_TPU_r18_ragged.json \
+          timeout 900 python tools/tpu_parity_quick.py \
+          >> tpu_parity_r18_ragged.log 2>&1 \
+          && echo "[watch] ragged-kernel parity captured" >&2
+      fi
+      if [ ! -e BENCH_SELF_r18_ragged_tpu.json ]; then
+        # ragged-kernel + fused-tail A/B on hardware (ISSUE 18): the
+        # bench's decode_kernel_ab phase (frozen legacy trio vs unified
+        # ragged kernel vs unified+fused sampling tail, token-identity
+        # asserted in-phase) on the flagship's geometry — via the
+        # supervisor's ratio trajectory rows this is the measured row for
+        # the pre-registered
+        # decode_kernel_unified_legacy_step_ratio_llama3_1b_tpu gate in
+        # BASELINE.json (tools/bench_compare.py scores it), AND another
+        # recapture of the overdue real-TPU headline row (last measured:
+        # BENCH_r02's 81.33 tok/s/chip) the ROADMAP re-anchor asks every
+        # TPU window to take through the bench_compare gate
+        echo "[watch] -> ragged-kernel bench" >&2
+        rm -f .bench_state.json
+        gj=/tmp/bench_g_$$.json gl=/tmp/bench_g_$$.log
+        BENCH_RUN_ID=BENCH_SELF_r18_ragged_tpu BENCH_KVQ=0 \
+          BENCH_OVERLAP=0 BENCH_WARM_PREFIX=0 BENCH_SHARDED=0 \
+          BENCH_BUDGET_S=1200 timeout 1500 python bench.py \
+            >"$gj" 2>"$gl"
+        gvalue=$(python -c "import json,sys;print(json.load(open(sys.argv[1]))['extras'].get('decode_kernel',{}).get('unified_legacy_step_ratio',0))" \
+            "$gj" 2>/dev/null || echo 0)
+        case "$gvalue" in
+          0|0.0|"") echo "[watch] ragged-kernel bench got no ratio" >&2 ;;
+          *)
+            python - "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$gj" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[2]))
+r["timestamp"] = sys.argv[1]
+r["self_measured"] = True
+json.dump(r, open("BENCH_SELF_r18_ragged_tpu.json", "w"), indent=1)
+EOF
+            cp "$gl" BENCH_SELF_r18_ragged_tpu.log 2>/dev/null
+            echo "[watch] ragged kernel captured: unified/legacy $gvalue" >&2 ;;
         esac
       fi
       if [ ! -e BENCH_SELF_r05_spec.json ] \
