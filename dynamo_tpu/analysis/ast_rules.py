@@ -179,6 +179,19 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       scale-folding and decodes garbage only on the geometry the fork
       serves. Any new direct construction is either a test oracle
       (annotate it) or a regression
+- R24 hedged-dispatch exactness (dynamo_tpu/ + tools/): any call that
+      dispatches a hedge attempt (`_start_hedge(...)`,
+      `start_hedge(...)`, `dispatch_hedge(...)`, `hedge_dispatch(...)`)
+      must sit in a function that visibly references the
+      first-wins / loser-cancellation / pre-commit discipline
+      (first-wins|cancel|abandon|loser|pre-commit vocabulary) or carry
+      `# dynalint: hedge-ok=<reason>`. A hedge is only exact BEFORE
+      the first token commits: a call site that can't point at the
+      race discipline is exactly where a refactor fires a hedge after
+      commit — duplicating tokens the client already consumed — or
+      leaks the losing stream (frontend/reliability.py owns the
+      reference race; its call site speaks the vocabulary and stays
+      in scope, so a second undisciplined site still flags)
 """
 from __future__ import annotations
 
@@ -2023,6 +2036,92 @@ def r23_one_decode_kernel(tree: ast.AST, lines: List[str],
             "dispatch through ops/paged_attention.py, or annotate "
             "with `# dynalint: kernel-ok=<why this copy must exist — "
             "e.g. frozen parity oracle>` within three lines above"))
+    return out
+
+
+# -- R24: hedged dispatch is only exact pre-commit ----------------------------
+
+# Scope: the dynamo_tpu package and tools/ (a load-shedding driver or
+# a future router layer is exactly where a "just hedge it" call gets
+# added). The fail-slow PR (ISSUE 19) made hedged dispatch exact by
+# CONSTRUCTION: a hedge may only fire while zero tokens are committed
+# (identical request + deterministic engines => identical tokens, so
+# whichever stream wins, the client sees one token sequence), the
+# first frame wins the race, and the loser is cancelled through the
+# abort path. Every one of those three legs is load-bearing — hedge
+# after commit duplicates tokens the client already consumed; no
+# cancellation leaks a stream and double-charges the fleet. Lexical
+# like R22: the enclosing function must write the race discipline
+# down, or the call carries `# dynalint: hedge-ok=<reason>` within
+# three lines above. frontend/reliability.py owns the reference race
+# and stays in scope on purpose (the R23 oracle-module precedent): its
+# call site speaks the vocabulary, so a second undisciplined site
+# still flags.
+_R24_SCOPE = ("dynamo_tpu/", "tools/")
+_R24_TERMINALS = {"start_hedge", "_start_hedge", "dispatch_hedge",
+                  "_dispatch_hedge", "hedge_dispatch"}
+_R24_ANNOT_RE = re.compile(r"#\s*dynalint:\s*hedge-ok=\S+")
+# the vocabulary is the exactness discipline itself: who wins, who is
+# cancelled, and why committed tokens fence the hedge out. Bare
+# "hedge" must NOT satisfy the rule — every call site spells that.
+_R24_HANDLED_RE = re.compile(
+    r"first[-_ ]?(?:frame|token)?[-_ ]?win|pre[-_ ]?commit|"
+    r"\bcancel|abandon|loser|uncommitted|zero +tokens +committed",
+    re.I)
+
+
+@rule("R24")
+def r24_hedged_dispatch_exactness(tree: ast.AST, lines: List[str],
+                                  path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R24_SCOPE) \
+            or "tests/" in norm:
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R24_ANNOT_RE.search(_line(lines, x))
+                   for x in range(ln - 3, ln + 1))
+
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def enclosing_handles(ln: int) -> bool:
+        inner = None
+        for fn in funcs:
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= ln <= end and (
+                    inner is None or fn.lineno >= inner.lineno):
+                inner = fn
+        if inner is None:
+            lo, hi = max(1, ln - 10), min(len(lines), ln + 10)
+        else:
+            lo, hi = inner.lineno, getattr(inner, "end_lineno",
+                                           inner.lineno)
+        return any(_R24_HANDLED_RE.search(_line(lines, x))
+                   for x in range(lo, hi + 1))
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name.rsplit(".", 1)[-1] not in _R24_TERMINALS:
+            continue
+        if annotated(node.lineno) or enclosing_handles(node.lineno):
+            continue
+        out.append(_finding(
+            "R24", path, lines, node,
+            f"`{name}(...)` dispatches a hedge attempt without "
+            "referencing the first-wins / loser-cancellation / "
+            "pre-commit discipline — a hedge is only exact while ZERO "
+            "tokens are committed; a call site that can't point at "
+            "the race rules is where a refactor fires a hedge after "
+            "commit (duplicating tokens the client already consumed) "
+            "or leaks the losing stream",
+            "state (docstring/comment) the race discipline — e.g. "
+            "'first frame wins; loser cancelled via abort; suppressed "
+            "once any token is committed' — or annotate with "
+            "`# dynalint: hedge-ok=<why exactness holds here>`"))
     return out
 
 

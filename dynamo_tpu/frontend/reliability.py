@@ -32,6 +32,20 @@ is that layer for our stack. It wraps a runtime `Client` with:
 - **Load shedding** (AdmissionControl, used by frontend/service.py):
   bounded concurrent admissions + a bounded wait queue; past the cap,
   requests are shed immediately with 429 + Retry-After.
+- **Fail-slow tolerance** (docs/RESILIENCE.md "Fail-slow failure model"):
+  everything above is crash-stop; a gray-failed worker (throttled chip,
+  flaky NIC) stays alive and drags p99 without tripping anything. Per-
+  attempt wall times feed runtime/health.py's fleet-relative scorer;
+  its SLOW decisions drive a latency-tripped breaker state (reduced
+  dispatch share, never full eviction — the residual traffic IS the
+  probe stream that lets a recovered worker re-earn share gradually)
+  and pre-commit-only hedged dispatch: when the primary exceeds an
+  adaptive per-class TTFT percentile with NOTHING committed yet, one
+  budgeted hedge races it, first frame wins, the loser is cancelled
+  through the abort path. Because a hedge can only fire while the
+  committed prefix is empty, exactly one attempt ever commits tokens —
+  token identity is preserved by construction (dynalint R24 statically
+  rejects the hedge-after-commit class).
 
 The reference framework stops at failure *detection* (SURVEY §5); this is
 the recovery story layered on top.
@@ -52,6 +66,9 @@ from dynamo_tpu.protocols.common import (
 )
 from dynamo_tpu.runtime.deadline import DeadlineExceeded, with_deadline
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.health import (
+    HEALTH, HEDGE_STATS, HealthScorer, HedgeBudget,
+)
 from dynamo_tpu.runtime.tracing import TRACE_KEY, TRACER
 
 log = logging.getLogger("dynamo_tpu.reliability")
@@ -86,6 +103,23 @@ class ReliabilityPolicy:
     # appear before the attempt fails and the retry/backoff ladder
     # takes over (was a hardcoded 5.0 inside the scheduler pick)
     instance_wait_s: float = 5.0
+    # -- fail-slow plane (docs/RESILIENCE.md "Fail-slow failure model") --
+    # hedged dispatch: when the primary attempt has produced NO frame
+    # after the adaptive per-class delay (hedge_quantile of the live
+    # TTFT histogram, floored at hedge_min_delay_s, capped at
+    # hedge_max_delay_s), dispatch ONE hedge to the next-best healthy
+    # instance; first frame wins, the loser is cancelled through the
+    # abort path. Hedges only ever fire while the committed prefix is
+    # empty, so token identity is preserved by construction.
+    hedge_enabled: bool = False
+    hedge_quantile: float = 0.95
+    hedge_min_delay_s: float = 0.05
+    hedge_max_delay_s: float = 5.0
+    # per-class hedge budget: fired <= frac * class request count + burst
+    hedge_budget_frac: float = 0.1
+    hedge_burst: int = 2
+    # cadence of fleet-relative health evaluations (runtime/health.py)
+    health_eval_interval_s: float = 1.0
 
 
 class ReliabilityMetrics:
@@ -160,6 +194,10 @@ class _BreakerState:
     probe_successes: int = 0
     open_until: float = 0.0
     probe_inflight: bool = False
+    # latency-tripped SLOW plane (orthogonal to the error states above:
+    # a SLOW instance still answers, so it is never fully ejected)
+    slow: bool = False
+    reearn_until: float = 0.0        # post-SLOW traffic re-earn ramp
 
 
 class CircuitBreaker:
@@ -171,17 +209,30 @@ class CircuitBreaker:
     breaker goes half-open and admits ONE probe dispatch at a time;
     `probe_successes` successful probes close it, any probe failure
     re-opens it for another cooldown.
+
+    Distinct from error-tripped OPEN is the latency-tripped **SLOW**
+    state (`trip_slow`/`clear_slow`, driven by runtime/health.py's
+    fleet-relative scorer): a SLOW instance is *never* ejected — it
+    keeps `slow_share` of the dispatch it would otherwise win
+    (`dispatch_weight`), and that residual traffic is the probe stream
+    that produces the fresh latency evidence recovery needs. After
+    `clear_slow` the weight ramps linearly back to 1.0 over `reearn_s`,
+    so a recovered worker re-earns traffic gradually instead of being
+    slammed with a full share while still warming back up.
     """
 
     def __init__(self, failure_threshold: int = 3, cooldown_s: float = 5.0,
                  probe_successes: int = 1,
                  metrics: Optional[ReliabilityMetrics] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 slow_share: float = 0.25, reearn_s: float = 30.0):
         self.failure_threshold = failure_threshold
         self.cooldown_s = cooldown_s
         self.probe_successes = probe_successes
         self.metrics = metrics
         self._clock = clock
+        self.slow_share = slow_share
+        self.reearn_s = reearn_s
         self._states: Dict[str, _BreakerState] = {}
 
     def _state(self, instance: str) -> _BreakerState:
@@ -253,6 +304,61 @@ class CircuitBreaker:
             log.warning("breaker %s for %s after %d consecutive failures",
                         "re-opened" if reopening else "opened", instance,
                         st.consecutive_failures)
+
+    # -- latency-tripped SLOW plane (fail-slow, runtime/health.py) -----------
+
+    def trip_slow(self, instance: str) -> None:
+        """Latency trip: reduce the instance's dispatch share to
+        `slow_share` without ejecting it (the residual traffic is the
+        recovery probe stream)."""
+        st = self._state(instance)
+        if not st.slow:
+            st.slow = True
+            st.reearn_until = 0.0
+            log.warning("breaker SLOW for %s (latency-tripped; dispatch "
+                        "share reduced to %.0f%%)", instance,
+                        100 * self.slow_share)
+
+    def clear_slow(self, instance: str) -> None:
+        """Recovery: start the linear re-earn ramp back to full share."""
+        st = self._states.get(instance)
+        if st is not None and st.slow:
+            st.slow = False
+            st.reearn_until = self._clock() + self.reearn_s
+            log.info("breaker SLOW cleared for %s (re-earning traffic "
+                     "over %.0fs)", instance, self.reearn_s)
+
+    def is_slow(self, instance: str) -> bool:
+        st = self._states.get(instance)
+        return st is not None and st.slow
+
+    def dispatch_weight(self, instance: str) -> float:
+        """Fraction of would-be dispatch this instance should receive:
+        1.0 healthy, `slow_share` while SLOW, ramping slow_share -> 1.0
+        over `reearn_s` after recovery."""
+        st = self._states.get(instance)
+        if st is None:
+            return 1.0
+        if st.slow:
+            return self.slow_share
+        if st.reearn_until:
+            rem = st.reearn_until - self._clock()
+            if rem > 0:
+                return self.slow_share + (1.0 - self.slow_share) * (
+                    1.0 - rem / self.reearn_s)
+            st.reearn_until = 0.0
+        return 1.0
+
+    def state_of(self, instance: str) -> str:
+        """closed | open | half_open | slow (error states trump SLOW —
+        an instance can be both, and OPEN is the stronger claim)."""
+        st = self._states.get(instance)
+        if st is None:
+            return "closed"
+        self._tick(st)
+        if st.state == "closed" and st.slow:
+            return "slow"
+        return st.state
 
     def forget(self, instance: str) -> None:
         """Drop state for a departed instance (lease pruned for good)."""
@@ -434,7 +540,8 @@ class ReliableClient:
                  router=None, breaker: Optional[CircuitBreaker] = None,
                  metrics: Optional[ReliabilityMetrics] = None,
                  route_policy: str = "round_robin",
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 health: Optional[HealthScorer] = None):
         self.client = client
         self.policy = policy or ReliabilityPolicy()
         self.router = router
@@ -444,6 +551,37 @@ class ReliableClient:
         self.route_policy = route_policy
         self._rng = rng or random.Random()
         self._rr = 0
+        self.health = health if health is not None else HEALTH
+        self._hedge_budget = HedgeBudget(self.policy.hedge_budget_frac,
+                                         self.policy.hedge_burst)
+        self._last_health_eval = float("-inf")
+        # watch-delete eviction: a departed instance's breaker/health
+        # state must not leak onto a later same-named registration (the
+        # kv_router and exporter evictions' sibling hook) — without this
+        # a reused worker name inherits a corpse's failure streak, SLOW
+        # flag, or latency EWMA
+        if hasattr(self.client, "add_listener"):
+            self.client.add_listener(self._on_instance_event)
+
+    def _on_instance_event(self, kind: str, worker_id: str,
+                           info: Optional[dict]) -> None:
+        # called synchronously from the watch pump — keep it cheap
+        if kind == "delete":
+            self.breaker.forget(worker_id)
+            self.health.forget(worker_id)
+
+    def _health_tick(self) -> None:
+        """Periodic fleet-relative health evaluation; SLOW transitions
+        drive the breaker's latency-tripped state."""
+        now = time.monotonic()
+        if now - self._last_health_eval < self.policy.health_eval_interval_s:
+            return
+        self._last_health_eval = now
+        for ev in self.health.evaluate(now):
+            if ev["event"] == "slow_enter":
+                self.breaker.trip_slow(ev["worker"])
+            elif ev["event"] == "slow_exit":
+                self.breaker.clear_slow(ev["worker"])
 
     # -- instance selection ---------------------------------------------------
 
@@ -469,6 +607,24 @@ class ReliableClient:
     async def _pick_instance_inner(self, pre: PreprocessedRequest,
                                    ctx: Context) -> str:
         blocked = self.breaker.blocked()
+        wid = await self._choose(pre, ctx, blocked)
+        # latency-tripped SLOW plane: a SLOW (or still re-earning)
+        # instance keeps only dispatch_weight of the picks it would
+        # otherwise win — a seeded coin diverts the rest to the next
+        # choice, so degraded-but-alive workers shed load without ever
+        # being fully ejected
+        weight = self.breaker.dispatch_weight(wid)
+        if weight < 1.0 and self._rng.random() >= weight:
+            alt = await self._choose(pre, ctx, blocked | {wid},
+                                     required=False)
+            if alt is not None:
+                wid = alt
+        self.breaker.on_dispatch(wid)
+        return wid
+
+    async def _choose(self, pre: PreprocessedRequest, ctx: Context,
+                      exclude: Set[str],
+                      required: bool = True) -> Optional[str]:
         if self.router is not None:
             try:
                 # QoS class rides the baggage (runtime/qos.py): the
@@ -477,15 +633,20 @@ class ReliableClient:
                 # backlogged links first
                 from dynamo_tpu.runtime.qos import qos_of
                 wid = await self.router.schedule(pre.token_ids,
-                                                 exclude=blocked,
+                                                 exclude=exclude,
                                                  qos=qos_of(ctx.baggage))
-                self.breaker.on_dispatch(wid)
-                return wid
+                if wid not in exclude or required:
+                    return wid
+                # the router's all-excluded fallback handed back an
+                # excluded instance; an optional pick declines it
+                return None
             except Exception:  # dynalint: swallow-ok=falls-back-to-load-balancing
                 log.exception("kv routing failed; falling back to %s",
                               self.route_policy)
-        ids = [i for i in self.client.instance_ids() if i not in blocked]
+        ids = [i for i in self.client.instance_ids() if i not in exclude]
         if not ids:
+            if not required:
+                return None
             ids = self.client.instance_ids()   # all ejected: probe anyway
         if not ids:
             rem = ctx.time_remaining()
@@ -500,7 +661,6 @@ class ReliableClient:
             wid = sorted(ids)[self._rr]
         else:
             wid = self._rng.choice(ids)
-        self.breaker.on_dispatch(wid)
         return wid
 
     # -- migration bookkeeping ------------------------------------------------
@@ -531,6 +691,284 @@ class ReliableClient:
         if delay > 0:
             await asyncio.sleep(delay)
 
+    # -- hedged dispatch (fail-slow plane) ------------------------------------
+
+    def _hedge_delay(self, qos: str) -> float:
+        """Adaptive hedge trigger: the hedge_quantile of the LIVE TTFT
+        histogram (per-class view when available), floored/capped by
+        policy — cold histograms fall back to the floor."""
+        from dynamo_tpu.observability.serving import ttft_quantile
+        v = ttft_quantile(self.policy.hedge_quantile, qos)
+        if not (v == v):                       # NaN: no observations yet
+            return self.policy.hedge_min_delay_s
+        return min(max(v, self.policy.hedge_min_delay_s),
+                   self.policy.hedge_max_delay_s)
+
+    async def _pick_hedge_instance(self, pre: PreprocessedRequest,
+                                   ctx: Context,
+                                   exclude: Set[str]) -> Optional[str]:
+        """Next-best HEALTHY instance for a hedge (never the primary,
+        never a blocked one); None when the fleet has no second choice."""
+        if self.router is not None:
+            wid = await self._choose(pre, ctx, exclude, required=False)
+            if wid is not None and wid not in exclude:
+                return wid
+        ids = [i for i in self.client.instance_ids() if i not in exclude]
+        if not ids:
+            return None
+        # healthiest-first: the hedge exists to dodge a slow primary,
+        # so it goes to the best-scored candidate, not the next
+        # round-robin slot
+        return max(ids, key=lambda w: (self.health.score(w),
+                                       self.breaker.dispatch_weight(w), w))
+
+    async def _start_hedge(self, req: PreprocessedRequest, ctx: Context,
+                           instance: str):
+        """Dispatch the duplicate (hedge) attempt of ``req`` to
+        ``instance`` under a fresh engine-level request id. Pre-commit
+        only: the caller (_hedge_race) races first frames, first one
+        WINS, and the loser is cancelled through the abort path before
+        anything is committed."""
+        hreq = req.model_copy(deep=True)
+        # a distinct engine-level id: the primary is still live on its
+        # worker, and engine admission rejects duplicate in-flight ids
+        hreq.request_id = f"{req.request_id}~h"
+        h_ctx = ctx.child()
+        # dynalint: span-ok=ends-here-on-dispatch-failure-else-in-the-race-settlement
+        hspan = TRACER.begin_span("hedge", ctx.trace, instance=instance,
+                                  engine_request_id=hreq.request_id)
+        if hspan is not None:
+            h_ctx.trace = hspan.context()
+            h_ctx.baggage[TRACE_KEY] = h_ctx.trace.to_wire()
+        try:
+            stream = await with_deadline(
+                self.client.generate(hreq.model_dump(exclude_none=True),
+                                     h_ctx, instance=instance),
+                self.policy.dispatch_timeout_s, ctx)
+        except BaseException:
+            TRACER.end_span(hspan, outcome="hedge_dispatch_failed",
+                            error=True)
+            raise
+        return stream, stream.__aiter__(), h_ctx, hspan
+
+    async def _abandon(self, slot: dict, record_failure: bool) -> None:
+        """Close out one raced attempt: cancel its pending first-frame
+        task, stop the responder, release the data-plane stream, and
+        settle its breaker slot (record_failure for a genuine error,
+        release_probe for a first-wins-race loser — losing a race is
+        not the instance's fault)."""
+        task = slot.get("task")
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            # dynalint: swallow-ok=we-cancelled-it-a-real-error-settled-the-race-already
+            except (asyncio.CancelledError, Exception):
+                pass
+        slot["ctx"].stop_generating()
+        aclose = getattr(slot["stream"], "aclose", None)
+        if aclose is not None:
+            try:
+                await aclose()
+            except Exception:  # dynalint: swallow-ok=best-effort-stream-close
+                pass
+        if slot.get("span") is not None:
+            TRACER.end_span(slot["span"],
+                            outcome="hedge_error" if record_failure
+                            else "hedge_lost")
+        if record_failure:
+            self.breaker.record_failure(slot["inst"])
+        else:
+            self.breaker.release_probe(slot["inst"])
+
+    def _race_bound(self, ctx: Context, stall_deadline: float) -> float:
+        bound = stall_deadline - time.monotonic()
+        rem = ctx.time_remaining()
+        if rem is not None:
+            bound = min(bound, rem)
+        return bound
+
+    async def _hedge_race(self, req: PreprocessedRequest, ctx: Context,
+                          instance: str, stream, it, sub_ctx,
+                          t0: float, qos: str):
+        """Pre-commit hedge window: wait for the primary's first frame
+        up to the adaptive per-class percentile delay; if the delay
+        fires first (and the per-class budget allows), _start_hedge
+        dispatches ONE duplicate attempt to the next-best healthy
+        instance and the two first frames race. The first frame WINS;
+        the loser is cancelled through the abort path (stop_generating
+        + stream close + breaker probe release) BEFORE the winning
+        frame is returned, so nothing is ever committed by two
+        attempts — the committed prefix is empty for the whole race by
+        precondition, which is what makes hedging token-exact by
+        construction.
+
+        Returns (first_frame, inst, stream, it, sub_ctx, t0, error,
+        deadline_hit): the surviving attempt's plumbing with its first
+        frame already pulled, or first_frame None with `error` set
+        (every attempt died / stall fired) or deadline_hit True. The
+        returned attempt's breaker outcome is NOT yet settled — the
+        caller's normal per-attempt bookkeeping owns it.
+        """
+        p = {"inst": instance, "stream": stream, "it": it, "ctx": sub_ctx,
+             "t0": t0, "span": None,
+             "task": asyncio.ensure_future(it.__anext__())}
+        try:
+            return await self._hedge_race_inner(p, req, ctx, qos)
+        except asyncio.CancelledError:
+            # caller abort mid-race: settle both attempts' tasks, fully
+            # close the hedge (the outer finally only knows the
+            # primary), then stay cancelled
+            hedge = p.get("_hedge")
+            await self._cancel_task(p)
+            if hedge is not None:
+                await self._abandon(hedge, record_failure=False)
+            raise
+
+    async def _hedge_race_inner(self, p: dict, req: PreprocessedRequest,
+                                ctx: Context, qos: str):
+        p0 = p                      # for the unreachable-guard return
+        h: Optional[dict] = None
+        stall_deadline = p["t0"] + self.policy.stall_timeout_s
+
+        def _ret(slot, frame=None, error=None, deadline=False):
+            return (frame, slot["inst"], slot["stream"], slot["it"],
+                    slot["ctx"], slot["t0"], error, deadline)
+
+        # phase 1: primary alone, up to the hedge delay
+        delay = self._hedge_delay(qos)
+        bound = min(delay, max(0.0, self._race_bound(ctx, stall_deadline)))
+        done, _ = await asyncio.wait({p["task"]}, timeout=bound)
+        if p["task"] in done:
+            try:
+                return _ret(p, frame=p["task"].result())
+            except StopAsyncIteration:
+                return _ret(p, error="stream ended without finish frame")
+            except Exception as e:
+                return _ret(p, error=f"{type(e).__name__}: {e}")
+        if ctx.is_stopped or ctx.deadline_expired:
+            await self._cancel_task(p)
+            return _ret(p, error="abandoned before first frame",
+                        deadline=ctx.deadline_expired)
+
+        # phase 2: fire the hedge (budgeted; next-best healthy instance)
+        if not self._hedge_budget.try_fire(qos):
+            HEDGE_STATS.budget_denied += 1
+        else:
+            h_inst = await self._pick_hedge_instance(
+                req, ctx, self.breaker.blocked() | {p["inst"]})
+            if h_inst is None:
+                HEDGE_STATS.no_candidate += 1
+            else:
+                self.breaker.on_dispatch(h_inst)
+                try:
+                    h_stream, h_it, h_ctx, hspan = await self._start_hedge(
+                        req, ctx, h_inst)
+                    h = {"inst": h_inst, "stream": h_stream, "it": h_it,
+                         "ctx": h_ctx, "t0": time.monotonic(),
+                         "span": hspan,
+                         "task": asyncio.ensure_future(h_it.__anext__())}
+                    p["_hedge"] = h      # visible to the cancel handler
+                    HEDGE_STATS.fired += 1
+                    HEDGE_STATS.fired_by_class[qos] = \
+                        HEDGE_STATS.fired_by_class.get(qos, 0) + 1
+                except DeadlineExceeded:
+                    self.breaker.release_probe(h_inst)
+                except asyncio.CancelledError:
+                    self.breaker.release_probe(h_inst)
+                    raise
+                except Exception as e:
+                    self.breaker.record_failure(h_inst)
+                    log.warning("hedge dispatch to %s failed: %s",
+                                h_inst, e)
+
+        # phase 3: first frame wins
+        while True:
+            live = [s for s in (p, h) if s is not None]
+            if not live:
+                # unreachable by construction (the last failing slot
+                # returns instead of being closed out), kept as a guard
+                return _ret(p0, error="all hedge attempts died")
+            bound = self._race_bound(ctx, stall_deadline)
+            if bound <= 0 or ctx.is_stopped or ctx.deadline_expired:
+                deadline = ctx.deadline_expired
+                stalled = not deadline and not ctx.is_stopped
+                if stalled:
+                    self.metrics.stall_fires.inc()
+                # settle every slot but the one we hand back
+                for s in live[1:]:
+                    await self._abandon(s, record_failure=stalled)
+                await self._cancel_task(live[0])
+                return _ret(
+                    live[0],
+                    error=(f"stream stalled "
+                           f">{self.policy.stall_timeout_s:.1f}s"
+                           if stalled else "abandoned before first frame"),
+                    deadline=deadline)
+            done, _ = await asyncio.wait(
+                {s["task"] for s in live}, timeout=bound,
+                return_when=asyncio.FIRST_COMPLETED)
+            if not done:
+                continue
+            # deterministic tie-break: the primary wins a photo finish
+            # (keeps cache affinity; the hedge is cancelled pre-commit)
+            winner = None
+            for s in (p, h):
+                if s is None or s["task"] not in done:
+                    continue
+                try:
+                    s["frame"] = s["task"].result()
+                    if winner is None:
+                        winner = s
+                except StopAsyncIteration:
+                    s["error"] = "stream ended without finish frame"
+                except Exception as e:
+                    s["error"] = f"{type(e).__name__}: {e}"
+            if winner is not None:
+                loser = h if winner is p else p
+                if loser is not None:
+                    if winner is h:
+                        # censored evidence for the abandoned primary:
+                        # it was at least this slow before losing
+                        self.health.observe(
+                            loser["inst"],
+                            time.monotonic() - loser["t0"])
+                        HEDGE_STATS.wins += 1
+                    elif h is not None:
+                        HEDGE_STATS.losses += 1
+                    await self._abandon(
+                        loser, record_failure="error" in loser)
+                    if loser is h:
+                        p["_hedge"] = None
+                if winner is h and winner.get("span") is not None:
+                    TRACER.end_span(winner["span"], outcome="hedge_won")
+                    winner["span"] = None
+                return _ret(winner, frame=winner["frame"])
+            # no winner: every completed slot errored; drop the dead,
+            # keep racing any survivor
+            for name, s in (("p", p), ("h", h)):
+                if s is not None and "error" in s:
+                    survivors = [o for o in (p, h)
+                                 if o is not None and o is not s]
+                    if not survivors:
+                        return _ret(s, error=s["error"])
+                    await self._abandon(s, record_failure=True)
+                    if name == "p":
+                        p = None
+                    else:
+                        h = None
+
+    @staticmethod
+    async def _cancel_task(slot: dict) -> None:
+        task = slot.get("task")
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            # dynalint: swallow-ok=we-cancelled-it-a-real-error-settled-the-race-already
+            except (asyncio.CancelledError, Exception):
+                pass
+
     # -- the state machine ----------------------------------------------------
 
     async def generate(self, request, context: Optional[Context] = None):
@@ -547,6 +985,14 @@ class ReliableClient:
         if ctx.time_remaining() is None \
                 and self.policy.request_deadline_s is not None:
             ctx.set_deadline(self.policy.request_deadline_s)
+
+        # fail-slow plane: periodic fleet-relative scoring (SLOW trips)
+        # and the per-class hedge budget's request accounting
+        self._health_tick()
+        from dynamo_tpu.runtime.qos import qos_of
+        qos_cls = qos_of(ctx.baggage)
+        if self.policy.hedge_enabled:
+            self._hedge_budget.on_request(qos_cls)
 
         committed: list = []
         max_toks = pre.stop.max_tokens
@@ -596,6 +1042,7 @@ class ReliableClient:
             # leak the half-open probe slot
             outcome_recorded = False
             try:
+                t0 = time.monotonic()
                 try:
                     instance = await self._pick_instance(req, ctx)
                     stream = await with_deadline(
@@ -628,24 +1075,51 @@ class ReliableClient:
 
                 error: Optional[str] = None
                 deadline_hit = False
+                first_frame: Optional[dict] = None
+                ttfb_seen = False
                 try:
                     it = stream.__aiter__()
-                    while True:
-                        try:
-                            frame = await with_deadline(
-                                it.__anext__(),
-                                self.policy.stall_timeout_s, ctx)
-                        except StopAsyncIteration:
-                            error = "stream ended without finish frame"
-                            break
-                        except DeadlineExceeded:
-                            deadline_hit = True
-                            break
-                        except asyncio.TimeoutError:
-                            self.metrics.stall_fires.inc()
-                            error = (f"stream stalled "
-                                     f">{self.policy.stall_timeout_s:.1f}s")
-                            break
+                    if self.policy.hedge_enabled and committed:
+                        # pre-commit exactness guard: a resumed stream
+                        # already holds committed tokens, so the hedge
+                        # window never opens for it (R24's invariant,
+                        # made visible as a counter)
+                        HEDGE_STATS.suppressed_commit += 1
+                    if self.policy.hedge_enabled and not committed \
+                            and not ctx.is_stopped:
+                        # pre-commit hedge window: _hedge_race returns
+                        # exactly one surviving attempt (first frame
+                        # wins, loser cancelled through the abort path
+                        # with nothing committed yet)
+                        (first_frame, instance, stream, it, sub_ctx, t0,
+                         error, deadline_hit) = await self._hedge_race(
+                            req, ctx, instance, stream, it, sub_ctx,
+                            t0, qos_cls)
+                    while error is None and not deadline_hit:
+                        if first_frame is not None:
+                            frame, first_frame = first_frame, None
+                        else:
+                            try:
+                                frame = await with_deadline(
+                                    it.__anext__(),
+                                    self.policy.stall_timeout_s, ctx)
+                            except StopAsyncIteration:
+                                error = "stream ended without finish frame"
+                                break
+                            except DeadlineExceeded:
+                                deadline_hit = True
+                                break
+                            except asyncio.TimeoutError:
+                                self.metrics.stall_fires.inc()
+                                error = (f"stream stalled >"
+                                         f"{self.policy.stall_timeout_s:.1f}s")
+                                break
+                        if not ttfb_seen:
+                            ttfb_seen = True
+                            # per-attempt first-frame latency is the
+                            # gray-failure evidence stream
+                            self.health.observe(instance,
+                                                time.monotonic() - t0)
                         fr = frame.get("finish_reason")
                         if fr == FinishReason.ERROR.value:
                             if frame.get("retryable") is False:

@@ -232,6 +232,23 @@ class HttpService:
         self._qos_preempted = m.gauge(
             "llm_qos_preempted_by_class",
             "decodes preempted, by victim class", ("qos",))
+        # fail-slow plane (runtime/health.py): gray-failure detection
+        # counters (HEALTH_STATS) + hedged-dispatch outcomes
+        # (HEDGE_STATS) — same render-time fold; per-class hedge
+        # volume as a labeled gauge (docs/RESILIENCE.md "Fail-slow
+        # failure model")
+        from dynamo_tpu.runtime.health import HealthStats, HedgeStats
+        self._health = {
+            name: m.gauge(f"llm_health_{name}",
+                          f"fail-slow detection: {name.replace('_', ' ')}")
+            for name in HealthStats.FIELDS}
+        self._hedge = {
+            name: m.gauge(f"llm_hedge_{name}",
+                          f"hedged dispatch: {name.replace('_', ' ')}")
+            for name in HedgeStats.FIELDS}
+        self._hedge_by_class = m.gauge(
+            "llm_hedge_fired_by_class",
+            "hedged dispatch: hedges fired per QoS class", ("qos",))
         s = self.server
         s.route("POST", "/v1/chat/completions", self._chat)
         s.route("POST", "/v1/completions", self._completions)
@@ -323,6 +340,15 @@ class HttpService:
             self._qos_preempt.set(cls, value=float(n))
         for cls, n in QOS_STATS.preempted_by_class.items():
             self._qos_preempted.set(cls, value=float(n))
+        from dynamo_tpu.runtime.health import (
+            HEALTH_STATS, HEDGE_STATS, HealthStats, HedgeStats,
+        )
+        for name in HealthStats.FIELDS:
+            self._health[name].set(value=float(getattr(HEALTH_STATS, name)))
+        for name in HedgeStats.FIELDS:
+            self._hedge[name].set(value=float(getattr(HEDGE_STATS, name)))
+        for cls, n in HEDGE_STATS.fired_by_class.items():
+            self._hedge_by_class.set(cls, value=float(n))
 
     async def _chat(self, req: Request):
         try:

@@ -140,6 +140,12 @@ class KvRouter:
                 # until the next full resync
                 self.indexer.remove_worker(pool_source_id(worker_id))
                 self.scheduler.remove_worker(worker_id)
+                # fail-slow twin of the same eviction: a dead worker's
+                # latency evidence and SLOW flag must not bias a reused
+                # instance name (frontend/reliability.py evicts its
+                # breaker state through its own listener)
+                from dynamo_tpu.runtime.health import HEALTH
+                HEALTH.forget(worker_id)
             elif kind == "put" \
                     and instance_status(info) == STATUS_DRAINING:
                 # drain fence: keep the worker out of prefix scoring so

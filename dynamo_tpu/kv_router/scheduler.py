@@ -136,7 +136,9 @@ class TransferAwareSelector(DefaultWorkerSelector):
                  max_penalty: float = 4.0,
                  default_block_bytes: int = 64 * 1024,
                  cost_model=None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 health_weight: float = 1.0,
+                 health_of=None):
         super().__init__(overlap_weight, rng)
         self.transfer_weight = transfer_weight
         self.horizon_s = horizon_s
@@ -146,6 +148,16 @@ class TransferAwareSelector(DefaultWorkerSelector):
             from dynamo_tpu.observability.fleet import TRANSFER_MODEL
             cost_model = TRANSFER_MODEL
         self.cost_model = cost_model
+        # fail-slow fold (runtime/health.py): health_of(worker) -> [0,1]
+        # health score; the logit pays health_weight * (1 - health), so
+        # a gray-failed worker sheds load BEFORE any breaker trips and a
+        # fully healthy fleet (all scores 1.0) ranks exactly as before.
+        # Defaults to the process-global HealthScorer.
+        if health_of is None:
+            from dynamo_tpu.runtime.health import HEALTH
+            health_of = HEALTH.score
+        self.health_of = health_of
+        self.health_weight = health_weight
         # degraded-mode interaction: while frozen, per-worker cost
         # terms pin to their last live values (KvRouter flips this with
         # its stale-snapshot degraded flag)
@@ -200,6 +212,7 @@ class TransferAwareSelector(DefaultWorkerSelector):
         best: List[str] = []
         components: Dict[str, dict] = {}
         any_cold = False
+        any_degraded = False
         if not self.frozen:
             # the pinned-cost table is "the last live decision's view":
             # rebuilt per decision (bounded by the candidate set) so a
@@ -233,10 +246,17 @@ class TransferAwareSelector(DefaultWorkerSelector):
             # batch (< 1) tolerates them and soaks up the cheap slots.
             # qos_weight defaults to 1.0 — unclassed traffic scores
             # exactly as before.
+            # fail-slow health fold: a degraded candidate pays
+            # health_weight * (1 - score) — gray-failed workers shed
+            # load before the latency breaker ever trips, and a score
+            # of 1.0 (healthy or insufficient evidence) costs nothing
+            health = self.health_of(worker_id)
+            any_degraded |= health < 1.0
             logit = (self.overlap_weight * overlap_score
                      - kv_usage - norm_active
                      - self.transfer_weight * request.qos_weight
-                     * norm_cost)
+                     * norm_cost
+                     - self.health_weight * (1.0 - health))
             components[worker_id] = {
                 "qos": request.qos,
                 "qos_weight": request.qos_weight,
@@ -250,6 +270,7 @@ class TransferAwareSelector(DefaultWorkerSelector):
                 "transfer_norm": round(norm_cost, 4),
                 "cold": cold,
                 "frozen": self.frozen,
+                "health": round(health, 4),
                 "logit": round(logit, 4),
             }
             if logit > best_logit:
@@ -267,6 +288,9 @@ class TransferAwareSelector(DefaultWorkerSelector):
             ROUTER_STATS.frozen_scored += 1
         if pool_m > 0:
             ROUTER_STATS.pool_scored += 1
+        if any_degraded:
+            ROUTER_STATS.health_scored += 1
+        ROUTER_STATS.last_pick_health = pick["health"]
         ROUTER_STATS.last_pool_fetch_blocks = pick["pool_blocks"]
         ROUTER_STATS.last_transfer_est_s = pick["transfer_s"]
         ROUTER_STATS.last_transfer_bytes = pick["transfer_bytes"]

@@ -26,6 +26,13 @@ class RouterScoringStats:
         # cluster-pool scoring (engine/kv_pool.py, docs/PERF.md §3e)
         "pool_scored",           # decisions with a fetchable pool prefix
         "last_pool_fetch_blocks",  # winner's pool-fetchable block count
+        # fail-slow health fold (runtime/health.py, docs/RESILIENCE.md
+        # "Fail-slow failure model"): decisions where a candidate's
+        # health score was below 1.0, and the winner's own score —
+        # a degraded-but-alive worker shedding load is visible here
+        # before any breaker trips
+        "health_scored",         # decisions with >=1 degraded candidate
+        "last_pick_health",      # winner's health score (1.0 = healthy)
     )
 
     def __init__(self):

@@ -209,6 +209,22 @@ class MetricsExporter:
             name: r.gauge(f"{PREFIX}_pool_ring_{name}",
                           f"pool placement ring: {name.replace('_', ' ')}")
             for name in PoolRingStats.FIELDS}
+        # fail-slow plane (runtime/health.py): gray-failure detection
+        # counters (HEALTH_STATS) + hedged-dispatch outcomes
+        # (HEDGE_STATS), same render-time refresh — live when this
+        # process hosts a reliability layer or scorer, 0 otherwise
+        from dynamo_tpu.runtime.health import HealthStats, HedgeStats
+        self.g_health = {
+            name: r.gauge(f"{PREFIX}_health_{name}",
+                          f"fail-slow detection: {name.replace('_', ' ')}")
+            for name in HealthStats.FIELDS}
+        self.g_hedge = {
+            name: r.gauge(f"{PREFIX}_hedge_{name}",
+                          f"hedged dispatch: {name.replace('_', ' ')}")
+            for name in HedgeStats.FIELDS}
+        self.g_hedge_by_class = r.gauge(
+            f"{PREFIX}_hedge_fired_by_class",
+            "hedged dispatch: hedges fired per QoS class", ("qos",))
         self._client = None
         self._aggregator: Optional[KvMetricsAggregator] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -406,6 +422,15 @@ class MetricsExporter:
             self.g_kv_pool_remote[name].set(value=float(value))
         for name, value in POOL_RING.snapshot().items():
             self.g_pool_ring[name].set(value=float(value))
+        from dynamo_tpu.runtime.health import (
+            HEALTH_STATS, HEDGE_STATS, HealthStats, HedgeStats,
+        )
+        for name in HealthStats.FIELDS:
+            self.g_health[name].set(value=float(getattr(HEALTH_STATS, name)))
+        for name in HedgeStats.FIELDS:
+            self.g_hedge[name].set(value=float(getattr(HEDGE_STATS, name)))
+        for cls, n in HEDGE_STATS.fired_by_class.items():
+            self.g_hedge_by_class.set(cls, value=float(n))
 
     # -- http -----------------------------------------------------------------
 

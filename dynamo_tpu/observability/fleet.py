@@ -264,6 +264,16 @@ def _xfer_stream_snapshot() -> Dict[str, Dict[str, int]]:
     return XFER_STATS.stream_snapshot()
 
 
+def _health_snapshot() -> dict:
+    """Fail-slow table for the rollup summary: the HealthScorer's
+    per-worker score/z/evidence/SLOW rows plus the process hedge
+    counters (runtime/health.py)."""
+    from dynamo_tpu.runtime.health import HEALTH, HEDGE_STATS
+    snap = HEALTH.snapshot()
+    snap["hedges"] = HEDGE_STATS.snapshot()
+    return snap
+
+
 def parse_prometheus_text(text: str) -> Dict[str, Dict[str, float]]:
     """Minimal Prometheus text-exposition parser: family name ->
     {label-string -> value}. HELP/TYPE lines are recorded as presence
@@ -437,6 +447,15 @@ class FleetRollup:
         # per-link measured transfer bandwidth (the router-scoring feed)
         for link, snap in self.model.snapshot().items():
             rec(f"link/{link}/bytes_per_s", snap["bytes_per_s"], ts)
+        # fail-slow health plane (runtime/health.py): per-worker score
+        # series + the fleet SLOW count, so a gray failure shows up as
+        # history (when did this worker start sinking?) and not just as
+        # the breaker's current flag
+        from dynamo_tpu.runtime.health import HEALTH
+        hsnap = HEALTH.snapshot()
+        for wid, row in hsnap["workers"].items():
+            rec(f"health/{wid}/score", row["score"], ts)
+        rec("fleet/workers_slow", float(len(hsnap["slow"])), ts)
         self.scrapes += 1
         return {"ts": ts, "workers": live,
                 "links": len(self.model.links())}
@@ -508,6 +527,11 @@ class FleetRollup:
             "roles": roles,
             "qos": qos,
             "links": self.model.snapshot(),
+            # fail-slow health table (runtime/health.py HEALTH): score/
+            # z/evidence/SLOW per worker plus hedge counters — what
+            # fleet_top's health column renders (absent key = artifact
+            # from an older build; renderers must tolerate that)
+            "health": _health_snapshot(),
             # sharded parallel transfer: per-(shard, host) stream rows
             # (process-local XFER_STATS dimension — populated on the
             # in-process bench/test stacks and on any worker co-hosting

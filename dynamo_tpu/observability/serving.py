@@ -92,3 +92,19 @@ class ServingMetrics:
 
 
 SERVING = ServingMetrics()
+
+
+def ttft_quantile(q: float, qos: str = "") -> float:
+    """Live TTFT quantile with per-class refinement: the per-QoS-class
+    view when that class has observations, the fleet-wide view
+    otherwise; NaN only when the histogram is completely empty. This is
+    the hedging trigger's adaptive delay source
+    (frontend/reliability.py): a hedge fires when the primary exceeds
+    the q-th percentile of what the fleet is ACTUALLY serving, not a
+    hand-tuned constant that rots as traffic shifts."""
+    v = float("nan")
+    if qos:
+        v = SERVING.ttft.quantile_label(q, "qos", qos)
+    if not (v == v):
+        v = SERVING.ttft.quantile_all(q)
+    return v

@@ -51,9 +51,14 @@ above):
 Fault kinds: ``drop`` (the op raises FaultInjected, a ConnectionError —
 the recovery layers treat it as any transport death), ``delay`` (seeded
 jitter up to delay_s), ``corrupt`` (flip nbytes seeded byte positions in
-the payload), ``duplicate`` (the site delivers twice), and ``fail_n``
+the payload), ``duplicate`` (the site delivers twice), ``fail_n``
 (deterministically fail the first n hits, then pass — the shape that
-proves bounded retries actually bound).
+proves bounded retries actually bound), and ``slow`` (a PERSISTENT
+degradation: every hit the rule fires on reports a multiplicative
+``slow_factor`` the site applies to its own base duration — the
+gray-failure / fail-slow model, distinct from one-shot ``delay`` jitter;
+a worker armed with factor=10 is 10x slow for the whole run, which is
+what the fail-slow detection plane has to catch).
 
 Zero-cost when disarmed: call sites guard with ``if REGISTRY.enabled:``
 — one attribute read on the hot path, no coroutine, no rng draw.
@@ -87,7 +92,7 @@ SITES = (
     "event.plane",
 )
 
-KINDS = ("drop", "delay", "corrupt", "duplicate", "fail_n")
+KINDS = ("drop", "delay", "corrupt", "duplicate", "fail_n", "slow")
 
 
 class FaultInjected(ConnectionError):
@@ -113,7 +118,8 @@ class FaultSpec:
     chunk/op — the transfer.link resume matrix rides this).
     ``delay_min_s`` floors the seeded delay draw (delay in
     [delay_min_s, delay_s]); delay_min_s == delay_s is a deterministic
-    stall of exactly that length."""
+    stall of exactly that length. ``factor`` is the `slow` kind's
+    persistent multiplicative degradation (1.0 = healthy)."""
 
     kind: str
     p: float = 1.0
@@ -122,6 +128,7 @@ class FaultSpec:
     nbytes: int = 1
     skip: int = 0
     delay_min_s: float = 0.0
+    factor: float = 1.0
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -138,11 +145,12 @@ class Outcome:
     corrupt: bool = False
     duplicate: bool = False
     nbytes: int = 0
+    slow_factor: float = 1.0
 
     @property
     def fired(self) -> bool:
         return self.drop or self.corrupt or self.duplicate \
-            or self.delay_s > 0
+            or self.delay_s > 0 or self.slow_factor != 1.0
 
 
 class FaultSchedule:
@@ -195,6 +203,12 @@ class FaultSchedule:
                 out.nbytes = max(out.nbytes, spec.nbytes)
             elif spec.kind == "duplicate":
                 out.duplicate = True
+            elif spec.kind == "slow":
+                # persistent degradation: every firing hit reports the
+                # same multiplicative factor — the call site applies it
+                # to its own base duration, so a factor=10 worker is
+                # 10x slow for as long as the rule stays armed
+                out.slow_factor = max(out.slow_factor, spec.factor)
         return out
 
     def corrupt_positions(self, length: int, nbytes: int) -> List[int]:
@@ -285,6 +299,14 @@ class FaultRegistry:
         path uses this to schedule DELAYED puts instead of blocking the
         publisher, which is what makes injected lag also reorder)."""
         return self._decide(site)
+
+    def slow_factor(self, site: str) -> float:
+        """Persistent-degradation multiplier for sites that scale their
+        own base duration by the `slow` kind (1.0 when disarmed). Counts
+        as a hit: the decision stream stays a pure function of hit
+        index, same as every other site hook."""
+        out = self._decide(site)
+        return 1.0 if out is None else out.slow_factor
 
     async def fire(self, site: str) -> Outcome:
         """Async sites: apply delay, raise on drop, return the outcome

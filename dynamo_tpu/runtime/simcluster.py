@@ -838,6 +838,197 @@ class SimCluster:
             "mean_abs_est_err": round(model.mean_abs_est_err(), 4),
         }
 
+    # -- fail-slow detection A/B (ISSUE 19) -----------------------------------
+
+    async def fail_slow_ab(self, requests: int = 2000,
+                           service_s: float = 0.05,
+                           arrival_spacing_s: Optional[float] = None,
+                           degraded_fraction: float = 0.08,
+                           slow_factors: tuple = (4.0, 8.0, 16.0),
+                           noise_frac: float = 0.05,
+                           eval_interval_s: float = 0.25,
+                           min_evidence: int = 6,
+                           slow_share: float = 0.25,
+                           hedge_quantile: float = 0.95,
+                           hedge_min_delay_s: float = 0.02,
+                           hedge_budget_frac: float = 0.1,
+                           hedge_burst: int = 2,
+                           replay_check: bool = True) -> dict:
+        """Detection-OFF vs detection-ON (scoring + SLOW share + hedged
+        dispatch) over a fleet with seeded GRAY-FAILED workers, measured
+        on simulated TTFT — the fail-slow twin of `routing_ab`.
+
+        A seeded fraction of workers is degraded through the persistent
+        ``slow`` fault kind (runtime/faults.py): each owns a
+        FaultSchedule with one ``FaultSpec("slow", p=1.0, factor=f)``,
+        so its service time is multiplied by a seeded factor on every
+        request it serves — alive, answering, dragging p99, exactly the
+        failure the crash-stop planes cannot see. Both modes run the
+        identical seeded arrival stream with per-request seeded service
+        noise (noise draws key on the request index, not on mode
+        decisions, so mode divergence cannot skew the comparison).
+
+        OFF: least-backlog dispatch, blind to latency. ON: the same
+        dispatch feeding a `HealthScorer` (virtual clock, evaluated at
+        ``eval_interval_s``); a SLOW-marked worker keeps only
+        ``slow_share`` of its dispatch (the residual traffic is the
+        probe stream — never full eviction), and a request whose primary
+        exceeds the adaptive TTFT quantile hedges once to the
+        least-backlog healthy alternative under a per-class
+        `HedgeBudget`, first token wins.
+
+        Contracts checked here and gated by the chaos scenario:
+        p99(ON) beats p99(OFF); ``dropped`` == 0 (every request produced
+        a first token); ``false_ejections`` == 0 (no healthy worker ever
+        marked SLOW — the min-evidence floor + MAD robustness at work);
+        and with ``replay_check`` the ON mode runs twice and the SLOW
+        decision timelines must be bit-identical (`timeline_replay_ok`).
+        """
+        import zlib
+
+        from dynamo_tpu.runtime.health import HealthScorer, HedgeBudget
+
+        seed = self.cfg.seed
+        ids = sorted(self.workers)
+        if arrival_spacing_s is None:
+            # ~0.6 of fleet service capacity: loaded but not saturated,
+            # so queue wait reflects dispatch quality, not overload
+            arrival_spacing_s = service_s / (0.6 * max(1, len(ids)))
+
+        def wseed(wid: str, salt: int) -> int:
+            return (seed * 1000003 + salt) ^ zlib.crc32(wid.encode())
+
+        # seeded gray-failure membership: persistent slow factor per
+        # degraded worker via the "slow" fault kind
+        degraded: Dict[str, faults.FaultSchedule] = {}
+        factors: Dict[str, float] = {}
+        for wid in ids:
+            r = random.Random(wseed(wid, 11))
+            if r.random() < degraded_fraction:
+                f = slow_factors[r.randrange(len(slow_factors))]
+                factors[wid] = f
+                degraded[wid] = faults.FaultSchedule(
+                    wseed(wid, 12),
+                    [faults.FaultSpec("slow", p=1.0, factor=f)])
+        if degraded_fraction > 0 and not degraded and ids:
+            # tiny fleets must still contain one gray failure
+            wid = ids[random.Random(seed + 13).randrange(len(ids))]
+            factors[wid] = slow_factors[0]
+            degraded[wid] = faults.FaultSchedule(
+                wseed(wid, 12),
+                [faults.FaultSpec("slow", p=1.0,
+                                  factor=slow_factors[0])])
+
+        def svc_time(wid: str, req_rng: random.Random) -> float:
+            sf = (degraded[wid].decide().slow_factor
+                  if wid in degraded else 1.0)
+            return service_s * sf * (
+                1.0 + noise_frac * (req_rng.random() * 2.0 - 1.0))
+
+        def run_mode(detect: bool) -> dict:
+            for sched in degraded.values():
+                sched.reset()       # same seeded factor stream per mode
+            scorer = HealthScorer(min_evidence=min_evidence,
+                                  clock=lambda: 0.0)
+            budget = HedgeBudget(hedge_budget_frac, hedge_burst)
+            gate_rng = random.Random(seed + 29)   # SLOW-share dispatch
+            busy = {w: 0.0 for w in ids}
+            obs: List[float] = []                 # hedge-delay window
+            ttfts: List[float] = []
+            next_eval = eval_interval_s
+            fired = wins = denied = dropped = 0
+            for i in range(requests):
+                now = i * arrival_spacing_s
+                if detect:
+                    while now >= next_eval:
+                        scorer.evaluate(now=next_eval)
+                        next_eval += eval_interval_s
+                req_rng = random.Random(seed * 7919 + i)
+                pick = min(ids, key=lambda w: (busy[w], w))
+                if detect and scorer.is_slow(pick) \
+                        and gate_rng.random() >= slow_share:
+                    healthy = [w for w in ids if not scorer.is_slow(w)]
+                    if healthy:
+                        pick = min(healthy, key=lambda w: (busy[w], w))
+                svc = svc_time(pick, req_rng)
+                start = max(now, busy[pick])
+                finish = start + svc
+                busy[pick] = finish
+                scorer.observe(pick, svc)
+                ttft = finish - now
+                if ttft != ttft or ttft < 0:       # pragma: no cover
+                    dropped += 1                   # no first token
+                budget.on_request("")
+                if detect:
+                    delay = (max(percentile(sorted(obs), hedge_quantile),
+                                 hedge_min_delay_s)
+                             if len(obs) >= 20 else float("inf"))
+                    if ttft > delay:
+                        if not budget.try_fire(""):
+                            denied += 1
+                        else:
+                            alts = [w for w in ids if w != pick
+                                    and not scorer.is_slow(w)]
+                            if alts:
+                                h = min(alts,
+                                        key=lambda w: (busy[w], w))
+                                hsvc = svc_time(h, req_rng)
+                                hstart = max(now + delay, busy[h])
+                                hfinish = hstart + hsvc
+                                busy[h] = hfinish
+                                scorer.observe(h, hsvc)
+                                fired += 1
+                                if hfinish < finish:
+                                    # first token wins; the primary is
+                                    # abandoned pre-commit
+                                    wins += 1
+                                    ttft = hfinish - now
+                obs.append(ttft)
+                del obs[:-200]
+                ttfts.append(ttft)
+            false_ej = sorted(w for w in scorer.slow_workers()
+                              if w not in degraded)
+            detected = sorted(w for w in scorer.slow_workers()
+                              if w in degraded)
+            lat = sorted(ttfts)
+            return {
+                "requests": requests,
+                "ttft_p50_ms": round(percentile(lat, 0.50) * 1e3, 2),
+                "ttft_p95_ms": round(percentile(lat, 0.95) * 1e3, 2),
+                "ttft_p99_ms": round(percentile(lat, 0.99) * 1e3, 2),
+                "ttft_mean_ms": round(sum(lat) / len(lat) * 1e3, 2),
+                "dropped": dropped,
+                "hedges_fired": fired,
+                "hedge_wins": wins,
+                "hedge_budget_denied": denied,
+                "false_ejections": false_ej,
+                "detected_slow": detected,
+                "timeline": list(scorer.timeline),
+            }
+
+        off = run_mode(False)
+        on = run_mode(True)
+        replay_ok = True
+        if replay_check:
+            on2 = run_mode(True)
+            replay_ok = (json.dumps(on["timeline"], sort_keys=True)
+                         == json.dumps(on2["timeline"], sort_keys=True))
+        return {
+            "seed": seed,
+            "workers": len(ids),
+            "degraded_workers": len(degraded),
+            "slow_factors": {w: factors[w] for w in sorted(factors)},
+            "detection_off": off,
+            "detection_on": on,
+            "p99_improvement": round(
+                1.0 - on["ttft_p99_ms"]
+                / max(off["ttft_p99_ms"], 1e-9), 4),
+            "p95_improvement": round(
+                1.0 - on["ttft_p95_ms"]
+                / max(off["ttft_p95_ms"], 1e-9), 4),
+            "timeline_replay_ok": replay_ok,
+        }
+
     # -- closed-loop autoscale storm (ISSUE 12 / ROADMAP item 4) --------------
 
     async def _await_fence(self, wid: str, timeout_s: float = 2.0) -> bool:

@@ -1004,6 +1004,270 @@ def test_chaos_control_plane_storm():
     run_scenario("control_plane_storm")
 
 
+# -- scenario: fail-slow (gray failure) storm ----------------------------------
+
+# not a fault site (popped before arm_from_dict): the A/B geometry.
+# The gray failures themselves are per-worker seeded FaultSchedules
+# with the persistent "slow" kind, built inside
+# SimCluster.fail_slow_ab from this geometry — one schedule per
+# degraded worker, so the same plan replays the same sick fleet.
+FAILSLOW_PLAN = {
+    "failslow": {"workers": 32, "requests": 1500, "seed": 7,
+                 "min_p99_margin": 0.25},
+}
+
+
+def run_fail_slow_storm(plan):
+    """Gray-failure storm (docs/RESILIENCE.md "Fail-slow failure
+    model"): a seeded fraction of a simulated fleet degrades through
+    the persistent ``slow`` fault kind — alive, answering, dragging
+    p99 — and the detection plane (HealthScorer + SLOW dispatch share
+    + hedged dispatch) runs A/B against a detection-blind baseline
+    over the identical seeded request stream.
+
+    Four contracts, all hard-asserted:
+      1. p99 TTFT with detection ON beats OFF by the plan's margin;
+      2. zero dropped streams in BOTH modes (hedging never loses a
+         first token; pre-commit-only hedges cannot double-commit);
+      3. zero false ejections — no healthy worker is ever marked SLOW
+         (the min-evidence floor + MAD robustness);
+      4. the SLOW decision timeline replays bit-identically (two
+         same-seed ON runs produce byte-equal timelines)."""
+    from dynamo_tpu.runtime.simcluster import SimCluster, SimConfig
+    plan = dict(plan)
+    geo = dict(plan.pop("failslow", {}))
+    workers = int(geo.get("workers", 32))
+    requests = int(geo.get("requests", 1500))
+    seed = int(geo.get("seed", 7))
+    min_margin = float(geo.get("min_p99_margin", 0.25))
+
+    async def main():
+        faults.REGISTRY.arm_from_dict(plan)
+        # mock-only fleet: fail_slow_ab is a pure virtual-time model
+        # over the worker id set, so the control plane never starts
+        sim = SimCluster(SimConfig(workers=workers, seed=seed))
+        sim.workers = {f"w{i:04d}": None for i in range(workers)}
+        try:
+            return await sim.fail_slow_ab(requests=requests)
+        finally:
+            faults.REGISTRY.disarm()
+
+    rep = asyncio.run(asyncio.wait_for(main(), 300))
+    on, off = rep["detection_on"], rep["detection_off"]
+    # contract 1: the detection plane earns its keep at the tail
+    assert rep["p99_improvement"] >= min_margin, (
+        rep["p99_improvement"], min_margin)
+    # contract 2: no stream ever lost its first token, either mode
+    assert on["dropped"] == 0 and off["dropped"] == 0, (on, off)
+    # contract 3: zero false ejections of healthy workers
+    assert on["false_ejections"] == [], on["false_ejections"]
+    # contract 4: bit-identical decision-timeline replay
+    assert rep["timeline_replay_ok"], "SLOW timeline diverged on replay"
+    # the machinery demonstrably fired: gray workers were detected and
+    # hedges dispatched (a storm where nothing happens proves nothing)
+    assert rep["degraded_workers"] >= 1, rep
+    assert on["detected_slow"], rep
+    assert on["hedges_fired"] >= 1, on
+    # keep the committed artifact light: the timelines are replay-
+    # verified above, only the ON timeline (the decision record) ships
+    trimmed = dict(rep)
+    trimmed["detection_on"] = dict(on)
+    trimmed["detection_off"] = {k: v for k, v in off.items()
+                                if k != "timeline"}
+    return trimmed
+
+
+def test_chaos_fail_slow_storm():
+    run_scenario("fail_slow_storm")
+
+
+@pytest.mark.slow
+def test_chaos_fail_slow_storm_1000_workers():
+    rep = run_scenario("fail_slow_storm", {
+        "failslow": {"workers": 1000, "requests": 40000, "seed": 7,
+                     "min_p99_margin": 0.30}})
+    # at scale the detector must catch a substantial share of the sick
+    on = rep["detection_on"]
+    assert len(on["detected_slow"]) >= rep["degraded_workers"] // 2, rep
+
+
+# -- hedged dispatch: token identity on real engines ---------------------------
+#
+# ISSUE 19 acceptance: a hedged request is TOKEN-IDENTICAL to an
+# unhedged single-engine run — greedy AND seeded-sampled — on both the
+# aggregated and the disaggregated serving path. The mechanism is
+# pre-commit-only first-frame-wins racing (frontend/reliability.py):
+# the losing attempt is cancelled with zero tokens committed, so the
+# winner's stream is indistinguishable from a lone dispatch. These
+# tests force a hedge on EVERY request (zero hedge delay, generous
+# budget) and compare against direct single-engine oracles.
+
+def _hedge_policy():
+    return ReliabilityPolicy(
+        hedge_enabled=True, hedge_min_delay_s=0.0, hedge_max_delay_s=0.01,
+        hedge_budget_frac=1.0, hedge_burst=64,
+        stall_timeout_s=5.0, dispatch_timeout_s=10.0, max_attempts=6,
+        backoff_base_s=0.05)
+
+
+def sampled_request(rid, prompt, max_tokens, seed):
+    from dynamo_tpu.protocols.common import SamplingOptions
+    return PreprocessedRequest(
+        request_id=rid, token_ids=prompt,
+        sampling=SamplingOptions(temperature=0.8, top_k=40, seed=seed),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    ).model_dump(exclude_none=True)
+
+
+def _sampled_params(seed, max_tokens=6):
+    return SamplingParams(max_tokens=max_tokens, temperature=0.8,
+                          top_k=40, seed=seed, ignore_eos=True)
+
+
+async def _collect(rel, request, rid):
+    toks = []
+    async for frame in rel.generate(request, Context(rid)):
+        assert frame.get("finish_reason") != "error", (rid, frame)
+        toks.extend(frame.get("token_ids", ()))
+    return toks
+
+
+def test_hedged_streams_token_identical_aggregated():
+    """Every request hedges across two same-seed workers; greedy and
+    seeded-sampled streams both match the unhedged single-engine
+    oracle token for token, whichever attempt won its race."""
+    from dynamo_tpu.runtime.health import HEDGE_STATS, HealthScorer
+
+    oracle = greedy_oracle(4)
+    eng = make_engine()
+    sampled_oracle = {i: eng.generate(prompt_for(i), _sampled_params(500 + i),
+                                      f"so{i}")
+                      for i in range(4)}
+
+    async def main():
+        plane = MemoryPlane()
+        wrt1 = await DistributedRuntime.create_local(plane, "w1")
+        worker1 = await NativeEngineWorker(make_engine()).start()
+        await serve_llm_worker(wrt1, "ns", "backend", worker1)
+        wrt2 = await DistributedRuntime.create_local(plane, "w2")
+        worker2 = await NativeEngineWorker(make_engine()).start()
+        await serve_llm_worker(wrt2, "ns", "backend", worker2)
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("backend").endpoint(
+            "generate").client()
+        await client.start()
+        await client.wait_for_instances()
+        for _ in range(200):
+            if len(client.instances) == 2:
+                break
+            await asyncio.sleep(0.02)
+        assert len(client.instances) == 2, client.instances
+
+        HEDGE_STATS.reset()
+        rel = ReliableClient(client, _hedge_policy(),
+                             health=HealthScorer())
+        try:
+            for i in range(4):
+                toks = await _collect(
+                    rel, pre_request(f"hg{i}", prompt_for(i), 6), f"hg{i}")
+                assert toks == oracle[i], (i, toks, oracle[i])
+            for i in range(4):
+                toks = await _collect(
+                    rel, sampled_request(f"hs{i}", prompt_for(i), 6,
+                                         500 + i), f"hs{i}")
+                assert toks == sampled_oracle[i], (
+                    i, toks, sampled_oracle[i])
+        finally:
+            await worker1.stop()
+            await worker2.stop()
+            for rt in (crt, wrt1, wrt2):
+                await rt.shutdown()
+        return HEDGE_STATS.snapshot()
+
+    snap = asyncio.run(asyncio.wait_for(main(), 300))
+    # the races actually happened, and each settled exactly once
+    assert snap["fired"] >= 4, snap
+    assert snap["wins"] + snap["losses"] == snap["fired"], snap
+
+
+def test_hedged_streams_token_identical_disagg():
+    """The same exactness contract on the disaggregated path: hedges
+    race across two decode workers, each driving its own remote
+    prefill through the shared queue — the loser's prefill is wasted
+    work, never wrong tokens."""
+    from dynamo_tpu.disagg import (
+        DisaggDecodeWorker, DisaggregatedRouter, LocalTransferBackend,
+        PrefillQueue, PrefillWorker,
+    )
+    from dynamo_tpu.runtime.health import HEDGE_STATS, HealthScorer
+
+    oracle = greedy_oracle(3)
+    eng = make_engine()
+    sampled_oracle = {i: eng.generate(prompt_for(i), _sampled_params(700 + i),
+                                      f"do{i}")
+                      for i in range(3)}
+
+    async def main():
+        plane = MemoryPlane()
+        queue = PrefillQueue(plane.messaging, "ns", "tiny")
+        router = DisaggregatedRouter(max_local_prefill_length=4,
+                                     max_prefill_queue_size=32)
+        transfer = LocalTransferBackend()
+        decodes, rts = [], []
+        for i in range(2):
+            dec = DisaggDecodeWorker(
+                make_engine(), plane.messaging, router, queue,
+                worker_id=f"dec-{i}", prefill_timeout_s=60.0)
+            transfer.register(f"dec-{i}", dec)
+            await dec.start()
+            decodes.append(dec)
+            rt = await DistributedRuntime.create_local(plane, f"d{i}")
+            await serve_llm_worker(rt, "ns", "decode", dec)
+            rts.append(rt)
+        prefill = PrefillWorker(
+            NativeEngineWorker(make_engine()), queue, transfer,
+            plane.messaging, dequeue_timeout_s=0.1, lease_s=5.0)
+        await prefill.start()
+        crt = await DistributedRuntime.create_local(plane, "cl")
+        client = crt.namespace("ns").component("decode").endpoint(
+            "generate").client()
+        await client.start()
+        await client.wait_for_instances()
+        for _ in range(200):
+            if len(client.instances) == 2:
+                break
+            await asyncio.sleep(0.02)
+        assert len(client.instances) == 2, client.instances
+
+        HEDGE_STATS.reset()
+        rel = ReliableClient(client, _hedge_policy(),
+                             health=HealthScorer())
+        try:
+            for i in range(3):
+                toks = await _collect(
+                    rel, pre_request(f"dg{i}", prompt_for(i), 6), f"dg{i}")
+                assert toks == oracle[i], (i, toks, oracle[i])
+            for i in range(3):
+                toks = await _collect(
+                    rel, sampled_request(f"ds{i}", prompt_for(i), 6,
+                                         700 + i), f"ds{i}")
+                assert toks == sampled_oracle[i], (
+                    i, toks, sampled_oracle[i])
+            remote = sum(d.remote_prefills for d in decodes)
+        finally:
+            await prefill.stop()
+            for d in decodes:
+                await d.stop()
+            for rt in rts + [crt]:
+                await rt.shutdown()
+        return HEDGE_STATS.snapshot(), remote
+
+    snap, remote = asyncio.run(asyncio.wait_for(main(), 300))
+    assert snap["fired"] >= 3, snap
+    assert snap["wins"] + snap["losses"] == snap["fired"], snap
+    assert remote >= 1, "nothing ever took the remote prefill path"
+
+
 # name -> (runner, committed default plan); tools/chaos_replay.py's menu
 SCENARIOS = {
     "aggregated_zero_drop": (run_aggregated_zero_drop, AGGREGATED_PLAN),
@@ -1013,4 +1277,5 @@ SCENARIOS = {
     "rolling_restart": (run_rolling_restart, ROLLING_PLAN),
     "control_plane_storm": (run_control_plane_storm, CONTROL_PLANE_PLAN),
     "pool_host_storm": (run_pool_host_storm, POOL_STORM_PLAN),
+    "fail_slow_storm": (run_fail_slow_storm, FAILSLOW_PLAN),
 }

@@ -1,7 +1,7 @@
 """dynalint (dynamo_tpu/analysis): rule fixtures + the repo-wide CI gate.
 
 Layout:
-- one positive AND one negative fixture per AST rule (R1-R23), the
+- one positive AND one negative fixture per AST rule (R1-R24), the
   positives for R1/R2 being faithful minimal copies of the PRE-FIX
   ADVICE r5 bugs (spec.py salt-id drafts, _decode_kernel_prefix missing
   stale-tail zeroing) — the analyzer must flag both on the pre-fix
@@ -1551,6 +1551,78 @@ def test_r23_oracle_unreachable_from_engine():
         with open(path) as f:
             src = f.read()
         assert "paged_attention_oracle" not in src, path
+
+
+# -- R24: hedged-dispatch exactness --------------------------------------------
+
+R24_BAD = """
+    async def retry_faster(client, request):
+        # "just fire a second copy if it's slow" — no race discipline,
+        # no teardown, nothing stops a post-commit duplicate
+        slot = client._start_hedge(request)
+        return await slot
+"""
+
+
+def test_r24_flags_undisciplined_hedge_dispatch():
+    found = lint_source(textwrap.dedent(R24_BAD),
+                        "dynamo_tpu/frontend/fixture.py")
+    r24 = [x for x in found if x.rule == "R24"]
+    assert len(r24) == 1
+    # a driver script forking hedges flags too — tools/ is in scope
+    found = lint_source(textwrap.dedent(R24_BAD), "tools/fixture.py")
+    assert "R24" in rules(found)
+
+
+def test_r24_quiet_outside_scope():
+    found = lint_source(textwrap.dedent(R24_BAD), "examples/fixture.py")
+    assert "R24" not in rules(found)
+    found = lint_source(textwrap.dedent(R24_BAD), "tests/fixture.py")
+    assert "R24" not in rules(found)
+
+
+def test_r24_quiet_when_function_speaks_the_discipline():
+    disciplined = """
+        async def hedge_race(client, request):
+            # first frame wins; the loser is cancelled through the
+            # abort path before any token is committed (pre-commit
+            # only — a hedge never races a stream that has emitted)
+            slot = client._start_hedge(request)
+            return await slot
+    """
+    found = lint_source(textwrap.dedent(disciplined),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert "R24" not in rules(found)
+
+
+def test_r24_quiet_on_annotated_sites():
+    annotated = """
+        async def replay_hedge(client, request):
+            # dynalint: hedge-ok=offline replay of a recorded race
+            slot = client._start_hedge(request)
+            return await slot
+    """
+    found = lint_source(textwrap.dedent(annotated),
+                        "dynamo_tpu/frontend/fixture.py")
+    assert "R24" not in rules(found)
+
+
+def test_r24_live_tree_hedge_sites_disciplined():
+    """The live tree dispatches hedges from exactly one place —
+    frontend/reliability.py's first-token-wins race — and that call
+    site speaks the first-wins / cancellation / pre-commit vocabulary,
+    so the gate holds at zero findings."""
+    import glob
+    scoped = glob.glob(os.path.join(REPO, "dynamo_tpu", "**", "*.py"),
+                       recursive=True)
+    scoped += glob.glob(os.path.join(REPO, "tools", "*.py"))
+    assert scoped
+    for path in scoped:
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            found = lint_source(f.read(), rel)
+        assert not [x for x in found if x.rule == "R24"], \
+            (rel, [x.message for x in found if x.rule == "R24"])
 
 
 def test_r19_live_on_preemption_call_sites():

@@ -134,6 +134,58 @@ def test_outcomes_do_not_shift_the_stream():
     assert [x.drop for x in a[2:]] == [x.drop for x in b[2:]]
 
 
+def test_slow_kind_persistent_factor_and_max_combining():
+    """`slow` is PERSISTENT degradation: every firing hit reports the
+    same multiplicative factor (not a one-shot delay), and two armed
+    slow specs combine by max — the worst rule wins, factors never
+    stack multiplicatively."""
+    sched = FaultSchedule(3, [FaultSpec("slow", p=1.0, factor=8.0)])
+    assert [o.slow_factor for o in drain(sched, 6)] == [8.0] * 6
+    both = FaultSchedule(3, [FaultSpec("slow", p=1.0, factor=8.0),
+                             FaultSpec("slow", p=1.0, factor=3.0)])
+    assert [o.slow_factor for o in drain(both, 6)] == [8.0] * 6
+
+
+def test_slow_kind_seeded_intermittence_replays():
+    """p < 1 models a flapping gray failure (NIC that degrades in
+    bursts): which hits degrade is a pure function of the seed, and a
+    non-firing hit reports the neutral factor 1.0."""
+    specs = [FaultSpec("slow", p=0.4, factor=5.0)]
+    a = [o.slow_factor for o in drain(FaultSchedule(11, specs), 40)]
+    b = [o.slow_factor for o in drain(FaultSchedule(11, specs), 40)]
+    assert a == b
+    assert set(a) == {1.0, 5.0}
+
+
+def test_slow_spec_consumes_one_draw_per_hit():
+    """A `slow` spec ahead of another spec consumes exactly one rng
+    draw per hit, firing or not — replacing it with an inert spec
+    leaves the later spec's decision stream untouched (the same
+    stream-stability property test_outcomes_do_not_shift_the_stream
+    pins for fail_n)."""
+    with_slow = FaultSchedule(9, [FaultSpec("slow", p=0.4, factor=4.0),
+                                  FaultSpec("drop", p=0.5)])
+    inert = FaultSchedule(9, [FaultSpec("drop", p=0.0),
+                              FaultSpec("drop", p=0.5)])
+    assert [x.drop for x in drain(with_slow, 32)] == \
+        [x.drop for x in drain(inert, 32)]
+
+
+def test_registry_slow_factor_counts_hits_and_disarmed_is_neutral():
+    """REGISTRY.slow_factor(site) is a site hook like fire/decide: it
+    advances the decision stream (counts a hit) while armed, and is the
+    neutral 1.0 with zero bookkeeping when disarmed."""
+    assert REGISTRY.slow_factor("transport.send") == 1.0
+    assert REGISTRY.snapshot()["hits"] == {}
+    REGISTRY.arm("transport.send", FaultSchedule(
+        5, [FaultSpec("slow", p=1.0, factor=10.0)]))
+    assert REGISTRY.slow_factor("transport.send") == 10.0
+    assert REGISTRY.slow_factor("transport.send") == 10.0
+    assert REGISTRY.snapshot()["hits"]["transport.send"] == 2
+    REGISTRY.disarm()
+    assert REGISTRY.slow_factor("transport.send") == 1.0
+
+
 def test_unknown_kind_and_site_rejected():
     with pytest.raises(ValueError):
         FaultSpec("explode")

@@ -1,0 +1,193 @@
+"""Gray-failure detection unit tests (runtime/health.py).
+
+The chaos A/B (tests/test_chaos.py fail_slow_storm) proves the plane
+end to end; these pin the scorer's math one property at a time: the
+robust MAD z-score, the min-evidence cold floor, enter/exit hysteresis,
+watch-delete eviction, the hedge budget, and decision-timeline
+determinism (the replay contract's unit-level twin).
+"""
+import pytest
+
+from dynamo_tpu.runtime.health import HealthScorer, HedgeBudget
+
+
+def mk(**kw):
+    kw.setdefault("clock", lambda: 0.0)
+    kw.setdefault("min_evidence", 3)
+    kw.setdefault("enter_evals", 2)
+    kw.setdefault("exit_evals", 2)
+    return HealthScorer(**kw)
+
+
+def feed(sc, latencies, n=4):
+    """n samples per worker at the given per-worker latency."""
+    for _ in range(n):
+        for w, v in latencies.items():
+            sc.observe(w, v)
+
+
+# -- robust scoring ------------------------------------------------------------
+
+
+def test_outlier_worker_scores_low_fleet_scores_high():
+    sc = mk()
+    feed(sc, {"a": 0.05, "b": 0.05, "c": 0.05, "sick": 0.50})
+    sc.evaluate(0.0)
+    assert sc.score("sick") < 0.5 < sc.score("a")
+    assert sc.zscore("sick") > sc.z_enter
+    # the healthy majority is untouched by the outlier (median/MAD,
+    # not mean/stddev: the sick worker cannot drag the baseline)
+    assert sc.score("a") == sc.score("b") == sc.score("c") == 1.0
+
+
+def test_median_baseline_resists_a_slow_clique():
+    """Two of five workers degraded: the healthy three still define the
+    baseline, so the clique stands out instead of normalizing itself."""
+    sc = mk()
+    feed(sc, {"a": 0.05, "b": 0.05, "c": 0.05, "s1": 0.4, "s2": 0.5})
+    sc.evaluate(0.0)
+    assert sc.zscore("s1") > sc.z_enter
+    assert sc.zscore("s2") > sc.z_enter
+    assert sc.score("a") == 1.0
+
+
+def test_no_quorum_no_condemnation():
+    """Fewer than 3 warm workers: no fleet baseline, everyone healthy."""
+    sc = mk()
+    feed(sc, {"a": 0.05, "sick": 5.0})
+    for t in range(5):
+        assert sc.evaluate(float(t)) == []
+    assert sc.score("sick") == 1.0
+    assert not sc.is_slow("sick")
+
+
+def test_min_evidence_floor_never_condemns_cold():
+    """A cold worker (few samples — fresh restart, still compiling) is
+    exempt no matter how slow its first observations are."""
+    sc = mk(min_evidence=8)
+    feed(sc, {"a": 0.05, "b": 0.05, "c": 0.05}, n=10)
+    sc.observe("cold", 9.0)   # 1 sample << min_evidence
+    for t in range(5):
+        sc.evaluate(float(t))
+    assert sc.score("cold") == 1.0
+    assert not sc.is_slow("cold")
+    # once warm, the same latency condemns it
+    feed(sc, {"cold": 9.0}, n=8)
+    sc.evaluate(10.0)
+    sc.evaluate(11.0)
+    assert sc.is_slow("cold")
+
+
+def test_link_err_evidence_inflates_z():
+    """A persistently underestimated link (gray NIC) adds to the
+    worker's effective z even when its service latency looks typical."""
+    sc = mk(z_enter=1.0, z_exit=0.5)
+    feed(sc, {"a": 0.05, "b": 0.05, "c": 0.05})
+    sc.observe_link_err("c", 1.0)
+    sc.evaluate(0.0)
+    assert sc.zscore("c") == pytest.approx(sc.err_weight)
+    assert sc.zscore("a") == 0.0
+
+
+# -- hysteresis ----------------------------------------------------------------
+
+
+def test_enter_needs_consecutive_evals():
+    sc = mk(enter_evals=3)
+    feed(sc, {"a": 0.05, "b": 0.05, "c": 0.05, "sick": 0.5})
+    assert sc.evaluate(0.0) == []          # streak 1
+    assert sc.evaluate(1.0) == []          # streak 2
+    events = sc.evaluate(2.0)              # streak 3: trip
+    assert [e["event"] for e in events] == ["slow_enter"]
+    assert events[0]["worker"] == "sick"
+    assert sc.is_slow("sick")
+
+
+def test_one_spike_flips_nothing():
+    """The streak resets when z dips back under z_enter mid-streak."""
+    sc = mk(enter_evals=2)
+    feed(sc, {"a": 0.05, "b": 0.05, "c": 0.05, "w": 0.5})
+    assert sc.evaluate(0.0) == []          # streak 1
+    # recovery samples pull the EWMA back toward the fleet before the
+    # second strike lands
+    feed(sc, {"w": 0.05}, n=12)
+    assert sc.evaluate(1.0) == []          # streak broken
+    feed(sc, {"w": 0.5}, n=4)
+    assert sc.evaluate(2.0) == []          # streak 1 again, not 2
+    assert not sc.is_slow("w")
+
+
+def test_exit_hysteresis_and_recovery():
+    sc = mk()
+    feed(sc, {"a": 0.05, "b": 0.05, "c": 0.05, "sick": 0.5})
+    sc.evaluate(0.0)
+    sc.evaluate(1.0)
+    assert sc.is_slow("sick")
+    feed(sc, {"sick": 0.05}, n=20)         # EWMA converges back
+    assert sc.evaluate(2.0) == []          # exit streak 1
+    events = sc.evaluate(3.0)              # exit streak 2: recover
+    assert [e["event"] for e in events] == ["slow_exit"]
+    assert not sc.is_slow("sick")
+    assert sc.slow_workers() == []
+
+
+def test_hysteresis_requires_exit_below_enter():
+    with pytest.raises(ValueError):
+        HealthScorer(z_enter=2.0, z_exit=2.0)
+
+
+# -- eviction + determinism ----------------------------------------------------
+
+
+def test_forget_evicts_all_state():
+    """Watch-delete hook: a reused worker name starts cold — it must not
+    inherit a corpse's EWMA, SLOW flag, or streaks."""
+    sc = mk()
+    feed(sc, {"a": 0.05, "b": 0.05, "c": 0.05, "sick": 0.5})
+    sc.evaluate(0.0)
+    sc.evaluate(1.0)
+    assert sc.is_slow("sick")
+    sc.forget("sick")
+    assert not sc.is_slow("sick")
+    assert sc.score("sick") == 1.0
+    assert sc.evidence("sick") == 0
+    assert "sick" not in sc.snapshot()["workers"]
+
+
+def test_same_stream_same_timeline():
+    """Scoring is a pure function of the observation stream + clock:
+    the replay contract (fail_slow_ab timeline_replay_ok), unit-sized."""
+    def run():
+        sc = mk()
+        for t in range(6):
+            feed(sc, {"a": 0.05, "b": 0.05, "c": 0.05,
+                      "sick": 0.5 if t < 4 else 0.05}, n=2)
+            sc.evaluate(float(t))
+        return sc.timeline
+    assert run() == run()
+
+
+# -- hedge budget --------------------------------------------------------------
+
+
+def test_hedge_budget_burst_then_denial():
+    b = HedgeBudget(budget_frac=0.5, burst=2)
+    # no requests seen yet: only the burst allowance
+    assert b.try_fire("std")
+    assert b.try_fire("std")
+    assert not b.try_fire("std")
+    # volume grows the budget: 4 requests * 0.5 + 2 = 4 total
+    for _ in range(4):
+        b.on_request("std")
+    assert b.try_fire("std")
+    assert b.try_fire("std")
+    assert not b.try_fire("std")
+
+
+def test_hedge_budget_is_per_class():
+    b = HedgeBudget(budget_frac=0.0, burst=1)
+    assert b.try_fire("interactive")
+    assert not b.try_fire("interactive")
+    assert b.try_fire("batch")             # separate class, own burst
+    snap = b.snapshot()
+    assert snap["fired"] == {"interactive": 1, "batch": 1}

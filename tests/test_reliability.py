@@ -104,6 +104,118 @@ def test_breaker_success_resets_failure_streak():
     assert br.allow("w")           # never opened: failures not consecutive
 
 
+# -- latency-tripped SLOW state (fail-slow plane) ------------------------------
+
+
+def test_slow_state_reduces_share_but_never_ejects():
+    """SLOW is not OPEN: a latency-tripped instance keeps dispatching at
+    slow_share — that residual traffic IS the recovery probe stream."""
+    clock = [0.0]
+    br = CircuitBreaker(slow_share=0.25, reearn_s=10.0,
+                        clock=lambda: clock[0])
+    assert br.dispatch_weight("w") == 1.0
+    br.trip_slow("w")
+    assert br.is_slow("w")
+    assert br.state_of("w") == "slow"
+    assert br.dispatch_weight("w") == 0.25
+    assert br.allow("w")               # never ejected
+    assert br.blocked() == set()
+
+
+def test_slow_clear_reearns_traffic_linearly():
+    clock = [0.0]
+    br = CircuitBreaker(slow_share=0.25, reearn_s=10.0,
+                        clock=lambda: clock[0])
+    br.trip_slow("w")
+    br.clear_slow("w")
+    assert not br.is_slow("w")
+    # ramp: slow_share at t=0 -> 1.0 at reearn_s, linear in between
+    assert br.dispatch_weight("w") == pytest.approx(0.25)
+    clock[0] = 5.0
+    assert br.dispatch_weight("w") == pytest.approx(0.625)
+    clock[0] = 10.5
+    assert br.dispatch_weight("w") == 1.0
+    # and the ramp state is cleaned up, not recomputed forever
+    assert br.dispatch_weight("w") == 1.0
+
+
+def test_slow_is_orthogonal_to_error_states():
+    """An instance can be SLOW and OPEN at once; OPEN (the stronger
+    claim) wins state_of and the dispatch gate, and clearing the error
+    state leaves the SLOW plane intact."""
+    clock = [0.0]
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                        probe_successes=1, clock=lambda: clock[0])
+    br.trip_slow("w")
+    br.record_failure("w")
+    assert br.state_of("w") == "open"
+    assert not br.allow("w")           # error ejection trumps SLOW
+    clock[0] = 5.1
+    br.on_dispatch("w")
+    br.record_success("w")             # probe closes the error state
+    assert br.state_of("w") == "slow"  # latency plane still remembers
+    assert br.dispatch_weight("w") == br.slow_share
+    br.clear_slow("w")
+    clock[0] = 100.0
+    assert br.state_of("w") == "closed"
+
+
+def test_slow_trip_is_idempotent_and_forget_clears_it():
+    br = CircuitBreaker()
+    br.trip_slow("w")
+    br.trip_slow("w")                  # no double-trip bookkeeping
+    assert br.is_slow("w")
+    br.forget("w")
+    assert not br.is_slow("w")
+    assert br.dispatch_weight("w") == 1.0
+    # clear_slow on an unknown instance is a no-op, not a KeyError
+    br.clear_slow("ghost")
+
+
+def test_watch_delete_evicts_breaker_and_health_three_generations():
+    """Regression: a worker name reused across 3 register/death cycles
+    must start each generation with a clean breaker AND clean health
+    evidence — without the watch-delete hook, generation 2 inherits
+    generation 1's open breaker or SLOW flag and is ejected at birth."""
+    from dynamo_tpu.runtime.health import HealthScorer
+
+    class StubClient:
+        def __init__(self):
+            self.listeners = []
+
+        def add_listener(self, fn):
+            self.listeners.append(fn)
+
+        def instance_ids(self):
+            return []
+
+    stub = StubClient()
+    health = HealthScorer(min_evidence=3, enter_evals=1, exit_evals=1,
+                          clock=lambda: 0.0)
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=1e9)
+    rel = ReliableClient(stub, ReliabilityPolicy(), breaker=br,
+                         health=health)
+    assert stub.listeners == [rel._on_instance_event]
+
+    for generation in range(3):
+        # the generation accumulates damning evidence on "w0"...
+        br.record_failure("w0")
+        br.record_failure("w0")
+        assert not br.allow("w0"), generation
+        for _ in range(4):
+            for w, v in (("w0", 9.0), ("a", 0.05), ("b", 0.05),
+                         ("c", 0.05)):
+                health.observe(w, v)
+        health.evaluate(float(generation))
+        assert health.is_slow("w0"), generation
+        # ...then dies; the watch pump delivers the delete
+        rel._on_instance_event("delete", "w0", None)
+        assert br.allow("w0"), generation          # clean breaker
+        assert br.state_of("w0") == "closed"
+        assert not health.is_slow("w0"), generation
+        assert health.evidence("w0") == 0, generation
+
+
 # -- admission control (load shedding) ----------------------------------------
 
 
@@ -443,3 +555,182 @@ def test_queue_poison_item_dropped_after_max_redeliveries():
         assert mq.redeliveries == 2
 
     run(main())
+
+
+# -- hedged dispatch (fail-slow plane) -----------------------------------------
+
+
+class SlowFirstFrameEngine(EchoTokenEngine):
+    """Healthy but laggy: every stream's first frame is delayed by
+    `first_frame_s` — the shape of a gray-failed worker (alive, correct,
+    slow), and exactly what the hedge window exists to dodge."""
+
+    def __init__(self, first_frame_s=0.5):
+        super().__init__()
+        self.first_frame_s = first_frame_s
+
+    async def generate(self, request, context):
+        await asyncio.sleep(self.first_frame_s)
+        async for frame in super().generate(request, context):
+            yield frame
+
+
+async def _hedge_fleet(plane, engines):
+    """Serve `engines` as named instances; return (runtimes, client)."""
+    rts = []
+    for name, engine in engines:
+        rt = await DistributedRuntime.create_local(plane, name)
+        await serve_llm_worker(rt, "ns", "backend", engine)
+        rts.append(rt)
+    crt = await DistributedRuntime.create_local(plane, "cl")
+    client = crt.namespace("ns").component("backend").endpoint(
+        "generate").client()
+    await client.start()
+    await client.wait_for_instances()
+    for _ in range(200):
+        if len(client.instances) >= len(engines):
+            break
+        await asyncio.sleep(0.02)
+    assert len(client.instances) == len(engines), client.instances
+    rts.append(crt)
+    return rts, client
+
+
+def _hedge_policy(**kw):
+    kw.setdefault("hedge_enabled", True)
+    kw.setdefault("hedge_min_delay_s", 0.0)
+    kw.setdefault("hedge_max_delay_s", 0.05)
+    kw.setdefault("hedge_budget_frac", 1.0)
+    kw.setdefault("hedge_burst", 16)
+    kw.setdefault("stall_timeout_s", 5.0)
+    kw.setdefault("backoff_base_s", 0.01)
+    return ReliabilityPolicy(**kw)
+
+
+def test_hedge_first_frame_wins_and_loser_is_cancelled():
+    """Two laggy workers, zero hedge delay: every request races a hedge.
+    First frame wins, the loser is cancelled pre-commit, and the client
+    stream is token-identical to an unhedged echo either way."""
+    from dynamo_tpu.runtime.health import HEDGE_STATS, HealthScorer
+
+    async def main():
+        rts, client = await _hedge_fleet(
+            MemoryPlane(), [("w1", SlowFirstFrameEngine(0.2)),
+                            ("w2", SlowFirstFrameEngine(0.2))])
+        HEDGE_STATS.reset()
+        rel = ReliableClient(client, _hedge_policy(),
+                             health=HealthScorer())
+        prompt = list(range(40, 50))
+        try:
+            for i in range(3):
+                toks = []
+                async for frame in rel.generate(
+                        pre_request(f"h{i}", prompt, 10), Context(f"h{i}")):
+                    assert frame.get("finish_reason") != "error", frame
+                    toks.extend(frame.get("token_ids", ()))
+                assert toks == prompt, (i, toks)
+        finally:
+            for rt in rts:
+                await rt.shutdown()
+        return HEDGE_STATS.snapshot()
+
+    snap = run(main())
+    assert snap["fired"] == 3, snap
+    # every race settled exactly once: a win or a loss, never both/neither
+    assert snap["wins"] + snap["losses"] == snap["fired"], snap
+    assert snap["fired_by_class"] == {"": 3}, snap
+
+
+def test_hedge_no_candidate_on_single_instance_fleet():
+    """One instance: the hedge window fires but there is no second
+    choice — counted, not crashed, and the stream completes."""
+    from dynamo_tpu.runtime.health import HEDGE_STATS, HealthScorer
+
+    async def main():
+        rts, client = await _hedge_fleet(
+            MemoryPlane(), [("w1", SlowFirstFrameEngine(0.2))])
+        HEDGE_STATS.reset()
+        rel = ReliableClient(client, _hedge_policy(),
+                             health=HealthScorer())
+        toks = []
+        try:
+            async for frame in rel.generate(
+                    pre_request("h", [5, 6, 7], 3), Context("h")):
+                toks.extend(frame.get("token_ids", ()))
+        finally:
+            for rt in rts:
+                await rt.shutdown()
+        return toks, HEDGE_STATS.snapshot()
+
+    toks, snap = run(main())
+    assert toks == [5, 6, 7]
+    assert snap["no_candidate"] == 1, snap
+    assert snap["fired"] == 0, snap
+
+
+def test_hedge_budget_denied_counts_and_serves():
+    """Budget exhausted: the hedge is refused (counted), the primary
+    serves alone, and nothing errors — a sick fleet can't melt itself
+    with duplicate work."""
+    from dynamo_tpu.runtime.health import HEDGE_STATS, HealthScorer
+
+    async def main():
+        rts, client = await _hedge_fleet(
+            MemoryPlane(), [("w1", SlowFirstFrameEngine(0.2)),
+                            ("w2", SlowFirstFrameEngine(0.2))])
+        HEDGE_STATS.reset()
+        rel = ReliableClient(
+            client, _hedge_policy(hedge_budget_frac=0.0, hedge_burst=0),
+            health=HealthScorer())
+        toks = []
+        try:
+            async for frame in rel.generate(
+                    pre_request("h", [5, 6, 7], 3), Context("h")):
+                toks.extend(frame.get("token_ids", ()))
+        finally:
+            for rt in rts:
+                await rt.shutdown()
+        return toks, HEDGE_STATS.snapshot()
+
+    toks, snap = run(main())
+    assert toks == [5, 6, 7]
+    assert snap["budget_denied"] == 1, snap
+    assert snap["fired"] == 0, snap
+
+
+def test_hedge_suppressed_once_tokens_commit():
+    """The pre-commit exactness guard: a migrated (resumed) attempt
+    carries committed tokens, so its hedge window never opens — counted
+    as suppressed_commit, and the resumed stream stays token-exact."""
+    from dynamo_tpu.runtime.health import HEDGE_STATS, HealthScorer
+
+    async def main():
+        rts, client = await _serving_pair(MemoryPlane())
+        HEDGE_STATS.reset()
+        metrics = ReliabilityMetrics()
+        rel = ReliableClient(
+            client,
+            # hedge windows are armed but the delay is far beyond the
+            # stall timeout: no race ever fires, isolating the guard
+            _hedge_policy(hedge_min_delay_s=30.0, hedge_max_delay_s=30.0,
+                          stall_timeout_s=0.2, max_attempts=6),
+            breaker=CircuitBreaker(failure_threshold=1, cooldown_s=30.0,
+                                   metrics=metrics),
+            metrics=metrics, health=HealthScorer())
+        prompt = list(range(10, 22))
+        try:
+            for i in range(4):   # round robin forces the flaky instance
+                toks = []
+                async for frame in rel.generate(
+                        pre_request(f"s{i}", prompt, 12), Context(f"s{i}")):
+                    toks.extend(frame.get("token_ids", ()))
+                assert toks == prompt, (i, toks)
+        finally:
+            for rt in rts:
+                await rt.shutdown()
+        return metrics.snapshot(), HEDGE_STATS.snapshot()
+
+    rsnap, hsnap = run(main())
+    assert rsnap["migrations"] >= 1, rsnap
+    assert hsnap["suppressed_commit"] >= 1, hsnap
+    assert hsnap["fired"] == 0, hsnap

@@ -42,6 +42,7 @@ SCENARIO_NAMES = (
     "rolling_restart",
     "control_plane_storm",
     "pool_host_storm",
+    "fail_slow_storm",
 )
 
 DEFAULT_LOG = os.path.join(REPO_ROOT, "CHAOS_REPLAY.jsonl")

@@ -89,6 +89,32 @@ def render_summary(summary: dict, slo: Optional[dict] = None,
         out.append(f"  control plane: degraded="
                    f"{_fmt(vals.get('router_degraded'), 0)} "
                    f"event_lag={_fmt(vals.get('event_lag_seconds'), 3)}s")
+    health = summary.get("health") or {}
+    if health.get("workers"):
+        # fail-slow plane (runtime/health.py): fleet-relative scores in
+        # [0, 1], SLOW workers marked. Older artifacts carry no health
+        # key -> section omitted (renderers must tolerate that).
+        slow = set(health.get("slow") or ())
+        rows = sorted(health["workers"].items(),
+                      key=lambda kv: (kv[1].get("score", 1.0), kv[0]))
+        out.append(f"  fail-slow health ({len(rows)} scored, "
+                   f"{len(slow)} slow):")
+        for wid, row in rows[:16]:
+            mark = " SLOW" if wid in slow else ""
+            out.append(f"    {wid:<12} score={_fmt(row.get('score'))} "
+                       f"z={_fmt(row.get('z'))} "
+                       f"n={_fmt(row.get('n'), 0)}{mark}")
+        if len(rows) > 16:
+            out.append(f"    ... {len(rows) - 16} more")
+        hed = health.get("hedges") or {}
+        if hed:
+            out.append(
+                f"    hedges: fired={_fmt(hed.get('fired'), 0)} "
+                f"won={_fmt(hed.get('wins'), 0)} "
+                f"lost={_fmt(hed.get('losses'), 0)} "
+                f"budget_denied={_fmt(hed.get('budget_denied'), 0)} "
+                f"suppressed_commit="
+                f"{_fmt(hed.get('suppressed_commit'), 0)}")
     links = summary.get("links") or {}
     if links:
         out.append(f"  kv-transfer links ({len(links)} measured):")

@@ -33,7 +33,8 @@ while true; do
       && [ -e BENCH_SELF_r15_sharded_tpu.json ] \
       && [ -e BENCH_SELF_r17_pool_remote_tpu.json ] \
       && [ -e PARITY_TPU_r18_ragged.json ] \
-      && [ -e BENCH_SELF_r18_ragged_tpu.json ]; then
+      && [ -e BENCH_SELF_r18_ragged_tpu.json ] \
+      && [ -e BENCH_SELF_r19_failslow_tpu.json ]; then
     echo "[watch] all TPU evidence captured; exiting" >&2
     exit 0
   fi
@@ -365,6 +366,35 @@ EOF
             cp "$gl" BENCH_SELF_r18_ragged_tpu.log 2>/dev/null
             echo "[watch] ragged kernel captured: unified/legacy $gvalue" >&2 ;;
         esac
+      fi
+      if [ ! -e BENCH_SELF_r19_failslow_tpu.json ]; then
+        # fail-slow plane on hardware (ISSUE 19): the hedged-dispatch
+        # token-identity contracts (greedy + seeded-sampled, aggregated
+        # + disagg) against the REAL engine — the CPU tier-1 runs prove
+        # the race discipline, but only a hardware pass proves a hedge
+        # race stays token-identical under Mosaic numerics — then the
+        # fail_slow_storm A/B replay for the recorded p99 margin and
+        # its four contracts (margin, zero drops, zero false ejections,
+        # bit-identical decision timeline)
+        echo "[watch] -> fail-slow hedging evidence" >&2
+        fl=/tmp/failslow_$$.log fj=/tmp/failslow_$$.json
+        if timeout 900 python -m pytest tests/test_chaos.py -q \
+              -k "hedge" -p no:cacheprovider >"$fl" 2>&1 \
+            && timeout 600 python tools/chaos_replay.py fail_slow_storm \
+              >"$fj" 2>>"$fl"; then
+          python - "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$fj" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[2]))
+r["timestamp"] = sys.argv[1]
+r["self_measured"] = True
+r["run_id"] = "BENCH_SELF_r19_failslow_tpu"
+json.dump(r, open("BENCH_SELF_r19_failslow_tpu.json", "w"), indent=1)
+EOF
+          cp "$fl" BENCH_SELF_r19_failslow_tpu.log 2>/dev/null
+          echo "[watch] fail-slow evidence captured" >&2
+        else
+          echo "[watch] fail-slow hedging run failed (log: $fl)" >&2
+        fi
       fi
       if [ ! -e BENCH_SELF_r05_spec.json ] \
           && [ -e BENCH_SELF_r05_int8.json ]; then
