@@ -85,6 +85,7 @@ PHASE_STALL_S = {
     "sharded_transfer": 300.0,   # disagg pair reused, paced transfer legs
     "warm_prefix": 420.0,        # seven engine builds sharing one program set
                                  # (4 local-pool rungs + 3 remote-pool rungs)
+    "long_context": 420.0,   # two extra engine builds (streamed + oracle)
     "parity": 300.0,         # second engine build + single-step compiles
     "spec_ceiling": 600.0,   # spec-twin engine build + verify compile
 }
@@ -410,6 +411,9 @@ def supervise() -> int:
                 dk = best["extras"].get("decode_kernel") or {}
                 if "failure" in dk:
                     dk = {}
+                lc = best["extras"].get("long_context") or {}
+                if "failure" in lc:
+                    lc = {}
                 ratios = {
                     f"disagg_agg_ttft_ratio_early_{suffix}":
                         to.get("disagg_agg_ttft_ratio_early")
@@ -444,6 +448,11 @@ def supervise() -> int:
                         dk.get("unified_legacy_step_ratio"),
                     f"decode_kernel_fused_tail_step_ratio_{suffix}":
                         dk.get("fused_unfused_step_ratio"),
+                    # long-context streaming (ISSUE 20): the ITL price
+                    # of attending beyond HBM at the 4x-budget rung,
+                    # token-identity-gated at capture — gated "lower"
+                    f"long_context_itl_inflation_4x_{suffix}":
+                        lc.get("itl_inflation_4x"),
                 }
                 for metric, value in ratios.items():
                     if value and value > 0:
@@ -1560,6 +1569,140 @@ def run_warm_prefix(model_cfg, base_kwargs=None, *, requests=4,
     return result
 
 
+def run_long_context(model_cfg, base_kwargs=None, *, budget_pages=6,
+                     page_size=4, decode_tokens=16, n_chips=1,
+                     touch=lambda: None, logf=None):
+    """Tiered-KV streaming decode ladder for extras["long_context"]
+    (ISSUE 20, PERF.md §3h — the million-token-context lever):
+
+    At each context rung (1x / 2x / 4x the streamed engine's HBM page
+    budget) the SAME prompt decodes on two engines:
+
+    - resident — an oversized-HBM oracle (every page stays in device
+      memory; the pre-streaming best case and the ITL denominator);
+    - streamed — an engine whose page budget is 1/4 of the top rung's
+      context, cold pages spilled to the host tier and streamed back
+      through the double-buffered window pool.
+
+    Greedy token identity streamed-vs-resident is asserted inline at
+    every rung — streaming that changed tokens would poison the
+    measurement. Reported per rung: ITL p50/p95 for both engines plus
+    the prefetch hit/late split (STREAM_STATS deltas); the headline is
+    `itl_inflation_4x` = streamed/resident ITL p50 at the 4x rung —
+    the price of attending beyond HBM, gated "lower" in BASELINE.json.
+    CPU validation proves plumbing + ratio direction; the TPU ladder
+    item (BENCH_SELF_r20_long_context_tpu) gives the hardware verdict."""
+    import time as _time
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+    from dynamo_tpu.engine.streaming import STREAM_STATS
+
+    logf = logf or log
+    ps = page_size
+    pmod = min(1000, model_cfg.vocab_size - 2)
+    top_pages = 4 * budget_pages
+    mml = min(model_cfg.max_model_len, 2 * top_pages * ps)
+    # decode_steps=1: one token per engine.step() on BOTH engines, so a
+    # perf_counter stamp per step IS the inter-token latency (a decode
+    # window would emit a burst of same-stamp tokens and fake ITL 0)
+    common = dict(page_size=ps, max_slots=2, max_prefill_chunk=8 * ps,
+                  prefill_buckets=(2 * ps, 4 * ps, 8 * ps),
+                  max_model_len=mml, decode_steps=1)
+    resident_eng = NativeEngine(
+        model_cfg, EngineConfig(num_pages=2 * top_pages + 8, **common),
+        seed=0)
+    streamed_eng = NativeEngine(
+        model_cfg, EngineConfig(num_pages=budget_pages,
+                                host_pages=2 * top_pages + 8,
+                                stream_pages=4,
+                                stream_resident_pages=budget_pages - 2,
+                                stream_hot_pages=2, **common),
+        seed=0)
+    params = SamplingParams(max_tokens=decode_tokens, temperature=0.0,
+                            ignore_eos=True)
+
+    def decode_itl(eng, rid, prompt):
+        """(tokens, itl_ms list) — inter-token gaps after the first."""
+        eng.add_request(EngineRequest(rid, prompt, params))
+        toks, stamps = [], []
+        while eng.has_work():
+            for ev in eng.step():
+                if ev.request_id == rid and ev.token is not None:
+                    toks.append(ev.token)
+                    stamps.append(_time.perf_counter())
+        itl = [(b - a) * 1e3 for a, b in zip(stamps, stamps[1:])]
+        return toks, itl
+
+    def pctl(xs, q):
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))], 3)
+
+    # warmup: absorb prefill/decode compiles on both engines (the
+    # warmup context fits residency, so the streamed engine's stream
+    # programs still compile inside the 1x rung — its p50 is robust to
+    # that one-off, and only rung p95s carry any residual compile)
+    warm = [(11 * i + 5) % pmod + 1 for i in range(2 * ps)]
+    decode_itl(resident_eng, "warm-res", warm)
+    decode_itl(streamed_eng, "warm-str", warm)
+    touch()
+
+    rungs = {}
+    identical = True
+    for m in (1, 2, 4):
+        prompt_len = m * budget_pages * ps - decode_tokens
+        prompt = [(7 * i + 3) % pmod + 1 for i in range(prompt_len)]
+        r_toks, r_itl = decode_itl(resident_eng, f"res-{m}x", prompt)
+        s0 = STREAM_STATS.snapshot()
+        s_toks, s_itl = decode_itl(streamed_eng, f"str-{m}x", prompt)
+        s1 = STREAM_STATS.snapshot()
+        identical = identical and (s_toks == r_toks)
+        hits = int(s1["prefetch_hit"] - s0["prefetch_hit"])
+        lates = int(s1["prefetch_late"] - s0["prefetch_late"])
+        rungs[f"{m}x"] = {
+            "context_tokens": prompt_len + decode_tokens,
+            "context_pages": m * budget_pages,
+            "streamed": bool(s1["stream_seqs"] - s0["stream_seqs"]),
+            "resident_itl_p50_ms": pctl(r_itl, 0.50),
+            "resident_itl_p95_ms": pctl(r_itl, 0.95),
+            "streamed_itl_p50_ms": pctl(s_itl, 0.50),
+            "streamed_itl_p95_ms": pctl(s_itl, 0.95),
+            "prefetch_hit": hits, "prefetch_late": lates,
+            "pages_spilled": int(s1["pages_spilled"]
+                                 - s0["pages_spilled"]),
+        }
+        logf(f"long-context {m}x ({prompt_len + decode_tokens} tok, "
+             f"streamed={rungs[f'{m}x']['streamed']}): resident ITL p50 "
+             f"{rungs[f'{m}x']['resident_itl_p50_ms']}ms, streamed "
+             f"{rungs[f'{m}x']['streamed_itl_p50_ms']}ms, "
+             f"hit/late {hits}/{lates}; identity "
+             f"{'OK' if s_toks == r_toks else 'BROKEN'}")
+        touch()
+    assert identical, \
+        "streamed decode diverged from the resident oracle (gate broken)"
+    assert rungs["4x"]["streamed"] and rungs["4x"]["pages_spilled"] > 0, \
+        "the 4x rung never actually streamed — the ladder measured nothing"
+    top = rungs["4x"]
+    hits, lates = top["prefetch_hit"], top["prefetch_late"]
+    result = {
+        "page_size": ps, "budget_pages": budget_pages,
+        "decode_tokens": decode_tokens, "rungs": rungs,
+        "itl_inflation_4x": round(
+            top["streamed_itl_p50_ms"]
+            / max(top["resident_itl_p50_ms"], 1e-9), 4),
+        "prefetch_hit_ratio_4x": round(hits / max(hits + lates, 1), 4),
+        "token_identity_ok": identical,
+    }
+    assert hits > lates, \
+        f"prefetch hits ({hits}) must dominate lates ({lates})"
+    logf(f"long-context headline: ITL inflation at 4x budget "
+         f"{result['itl_inflation_4x']}x, prefetch hit ratio "
+         f"{result['prefetch_hit_ratio_4x']}")
+    touch()
+    return result
+
+
 def run_parity(model_cfg, engine_box=None, touch=lambda: None, logf=None):
     """Window-vs-single-step greedy token parity on the current backend.
 
@@ -2070,6 +2213,21 @@ def worker():
         except Exception as e:  # evidence phase must not kill the capture
             log(f"decode kernel A/B failed ({type(e).__name__}: {e})")
             st.result["extras"]["decode_kernel"] = {"failure": str(e)}
+        st.touch()
+
+    if os.environ.get("BENCH_LONG_CONTEXT", "1") != "0" \
+            and time.time() - T0 < BUDGET_S - 120:
+        st.set_phase("long_context")
+        log("phase: long-context streaming ladder — resident vs streamed "
+            "ITL at 1x/2x/4x the HBM page budget, token identity + "
+            "prefetch hit/late split (ISSUE 20)")
+        try:
+            st.result["extras"]["long_context"] = run_long_context(
+                model_cfg, PAGE_KWARGS, n_chips=n_chips, touch=st.touch,
+                logf=log)
+        except Exception as e:  # evidence phase must not kill the capture
+            log(f"long-context ladder failed ({type(e).__name__}: {e})")
+            st.result["extras"]["long_context"] = {"failure": str(e)}
         st.touch()
 
     if os.environ.get("BENCH_SPEC") == "oracle":

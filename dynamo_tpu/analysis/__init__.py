@@ -2,7 +2,7 @@
 
 Three layers (see docs/ANALYSIS.md):
 
-- AST lint (ast_rules.py, R1-R24): source-level rules distilled from
+- AST lint (ast_rules.py, R1-R25): source-level rules distilled from
   this repo's actual bug history — unguarded vocab gathers, Pallas
   kernels missing stale-tail K/V zeroing, blocking calls on async paths,
   CancelledError-swallowing handlers, iterate-while-mutating, host syncs

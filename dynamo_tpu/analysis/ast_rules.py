@@ -192,6 +192,21 @@ Rule ids (docs/ANALYSIS.md has the long-form description of each):
       leaks the losing stream (frontend/reliability.py owns the
       reference race; its call site speaks the vocabulary and stays
       in scope, so a second undisciplined site still flags)
+- R25 streamed window-pool claim/fill/victim discipline (dynamo_tpu/ +
+      tools/): any call that claims, fills, or spills a streamed
+      window-pool page (`pool.take(...)`, `pool.prefetch(...)`,
+      `_assemble(...)`, `_pin_cold(...)`, `_spill_victims(...)`) must
+      sit in a function that visibly references the keyed-double-buffer
+      / verify-on-fetch / checksummed-spill discipline
+      (double-buffer|checksum|chained-hash|quarantine|verify
+      vocabulary) or carry `# dynalint: stream-ok=<reason>`. Streamed
+      decode beyond HBM is only exact while a stale prefetch can never
+      be consumed (halves keyed by chained page hashes), rot
+      quarantines + recomputes only the victim page, and spills ride
+      the checksummed offload leg — a site that can't point at those
+      rules is where a refactor consumes a stale half or spills an
+      unverifiable page (engine/streaming.py owns the reference loop;
+      its sites speak the vocabulary and stay in scope)
 """
 from __future__ import annotations
 
@@ -2122,6 +2137,96 @@ def r24_hedged_dispatch_exactness(tree: ast.AST, lines: List[str],
             "'first frame wins; loser cancelled via abort; suppressed "
             "once any token is committed' — or annotate with "
             "`# dynalint: hedge-ok=<why exactness holds here>`"))
+    return out
+
+
+# -- R25: streamed window-pool claim/fill/victim discipline -------------------
+
+# Scope: dynamo_tpu/ + tools/ (a streaming driver or a future "just
+# stage the page" helper is where an undisciplined site gets added).
+# The million-token streaming PR made decode-beyond-HBM exact by
+# CONSTRUCTION: window-pool halves are KEYED by the segment's chained
+# page hashes (a stale prefetch against a changed cold set can never
+# be consumed), every cold fetch pays the traveling-checksum verify
+# (rot quarantines the entry and recomputes ONLY the victim page), and
+# spill victims ride the checksummed offload leg — the bytes that come
+# back are the bytes that left. Lexical like R24: the enclosing
+# function must write that discipline down, or the call carries
+# `# dynalint: stream-ok=<reason>` within three lines above.
+# engine/streaming.py owns the reference loop and stays in scope (the
+# R23/R24 oracle-module precedent): its sites speak the vocabulary, so
+# a second undisciplined claim/fill/victim site still flags.
+_R25_SCOPE = ("dynamo_tpu/", "tools/")
+_R25_TERMINALS = {"_assemble", "_spill_victims", "_pin_cold"}
+_R25_QUALIFIED = {("pool", "take"), ("pool", "prefetch")}
+_R25_ANNOT_RE = re.compile(r"#\s*dynalint:\s*stream-ok=\S+")
+# the vocabulary is the exactness discipline itself: the keyed double
+# buffer, the verify/quarantine gate, and the chained-hash/checksum
+# custody of spilled bytes. Bare "stream"/"page"/"spill"/"victim" must
+# NOT satisfy the rule — `_spill_victims` spells the last two itself.
+_R25_HANDLED_RE = re.compile(
+    r"double.?buffer|prefetch\s+(?:hit|late)|stale\s+prefetch|"
+    r"checksum|chain(?:ed|ing)\s+hash|quarantin|verify",
+    re.I)
+
+
+@rule("R25")
+def r25_stream_window_pool_discipline(tree: ast.AST, lines: List[str],
+                                      path: str) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in _R25_SCOPE) \
+            or "tests/" in norm:
+        return []
+
+    def annotated(ln: int) -> bool:
+        return any(_R25_ANNOT_RE.search(_line(lines, x))
+                   for x in range(ln - 3, ln + 1))
+
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def enclosing_handles(ln: int) -> bool:
+        inner = None
+        for fn in funcs:
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= ln <= end and (
+                    inner is None or fn.lineno >= inner.lineno):
+                inner = fn
+        if inner is None:
+            lo, hi = max(1, ln - 10), min(len(lines), ln + 10)
+        else:
+            lo, hi = inner.lineno, getattr(inner, "end_lineno",
+                                           inner.lineno)
+        return any(_R25_HANDLED_RE.search(_line(lines, x))
+                   for x in range(lo, hi + 1))
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        parts = name.split(".")
+        if parts[-1] not in _R25_TERMINALS and \
+                tuple(parts[-2:]) not in _R25_QUALIFIED:
+            continue
+        if annotated(node.lineno) or enclosing_handles(node.lineno):
+            continue
+        out.append(_finding(
+            "R25", path, lines, node,
+            f"`{name}(...)` claims/fills/spills a streamed window-pool "
+            "page without referencing the keyed-double-buffer / "
+            "verify-on-fetch / checksummed-spill discipline — streamed "
+            "decode is only exact while stale prefetches can't be "
+            "consumed (hash-tuple keys), rot quarantines and recomputes "
+            "the victim page, and spilled bytes ride the checksummed "
+            "offload leg; a site that can't point at those rules is "
+            "where a refactor consumes a stale half or spills an "
+            "unverifiable page",
+            "state (docstring/comment) the discipline — e.g. 'double "
+            "buffer keyed by page hashes; rot quarantines + recomputes "
+            "the victim; spills ride the checksummed offload leg' — or "
+            "annotate with `# dynalint: stream-ok=<why exactness holds "
+            "here>`"))
     return out
 
 

@@ -284,7 +284,7 @@ def audit_engine_entry_points() -> List[Finding]:
                                *decode_args)
 
     verify_fn = functools.partial(_engine_verify_step, cfg, eos, None,
-                                  None)
+                                  None, None)
     verify_args = (params, cache, i32((s, kp1)), i32((s, kp1)),
                    i32((s, pb)), i32((s,)), i32((s, kp1)), i32((s,)),
                    i32((s,)))

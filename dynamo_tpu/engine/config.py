@@ -139,6 +139,18 @@ class EngineConfig:
     # host_pages > 0. disk_dir None = a temp directory.
     disk_pages: int = 0
     disk_dir: Optional[str] = None
+    # decode-time KV streaming beyond HBM (engine/streaming.py): a request
+    # whose admission-time page count exceeds stream_resident_pages keeps
+    # only a resident working set in HBM and attends over the rest by
+    # staging cold pages from the offload tiers (host / disk) through a
+    # double-buffered window pool, prefetched ahead of the consuming
+    # dispatch. stream_pages = window-pool slots per staging half (0 =
+    # streaming off; requires host_pages > 0). Cold-page victims are
+    # picked by a per-page attention-mass EWMA; the first
+    # stream_hot_pages logical pages are never spilled (hot prefix).
+    stream_pages: int = 0
+    stream_resident_pages: int = 8
+    stream_hot_pages: int = 2
     # mesh axes sizes: (dp, tp). dp>1 replicates the whole engine.
     tp: int = 1
     dp: int = 1

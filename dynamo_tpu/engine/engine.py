@@ -33,7 +33,7 @@ from dynamo_tpu.engine.sampler import (
 )
 from dynamo_tpu.engine.scheduler import (
     DecodePlan, EngineRequest, MixedPlan, PrefillPlan, SamplingParams,
-    Scheduler, next_bucket, pow2_buckets,
+    Scheduler, StreamPlan, next_bucket, pow2_buckets,
 )
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.llama import AttnMetadata
@@ -91,10 +91,6 @@ class NativeEngine:
                                  "configs over the ep axis instead")
             if engine_cfg.sp > 1:
                 raise ValueError("pp and sp (ring attention) do not compose")
-            if model_cfg.vision is not None:
-                raise ValueError("multimodal models are not supported on a "
-                                 "pp mesh; use tp/dp (pp_param_shardings "
-                                 "carries no vision subtree)")
             model_cfg = dataclasses.replace(model_cfg, decode_kernel="off")
             if engine_cfg.max_slots % self.pp:
                 # decode slot-groups are the pipeline microbatches, so the
@@ -395,11 +391,6 @@ class NativeEngine:
                     "(supported: 'ngram', 'draft')")
             if engine_cfg.spec_k < 1:
                 raise ValueError("spec_decode requires spec_k >= 1")
-            if self.pp > 1:
-                raise ValueError(
-                    "spec_decode does not compose with pp meshes (the "
-                    "verify block would need a pipelined multi-token "
-                    "forward); use tp/dp meshes or disable spec_decode")
             if engine_cfg.sp > 1:
                 # llama.forward routes ANY Tq>1 forward on an sp mesh to
                 # ring attention, which attends only within the chunk —
@@ -408,9 +399,12 @@ class NativeEngine:
                 raise ValueError(
                     "spec_decode does not compose with sp (ring-attention "
                     "prefill); use tp/dp meshes or disable spec_decode")
+            # on pp meshes the verify block is just a prefill-shaped
+            # pp_forward — the GPipe scan already handles Tq > 1, so the
+            # pipelined multi-token forward comes for free
             self._verify_fn = jax.jit(
                 functools.partial(_engine_verify_step, model_cfg,
-                                  eos_tuple, None, kernel_mesh),
+                                  eos_tuple, None, kernel_mesh, pp_mesh),
                 donate_argnums=(1,))
             if engine_cfg.spec_decode == "draft":
                 import os as _os
@@ -472,6 +466,39 @@ class NativeEngine:
             from dynamo_tpu.models import vision as _vision
             self._encode_fn = jax.jit(
                 lambda p, px: _vision.encode(p, model_cfg, px))
+        # tiered-KV streaming decode (engine/streaming.py): contexts
+        # beyond the resident HBM budget attend over cold pages staged
+        # from the offload tiers through a double-buffered window pool
+        self._streamer = None
+        if engine_cfg.stream_pages > 0:
+            if engine_cfg.host_pages <= 0:
+                raise ValueError(
+                    "stream_pages > 0 requires host_pages > 0: cold "
+                    "pages live in the host/disk offload tiers")
+            if self.pp > 1 or engine_cfg.sp > 1 or self.mesh.size > 1:
+                raise ValueError(
+                    "tiered-KV streaming runs single-device only for "
+                    "now (the per-layer window-pool loop does not "
+                    "compose with pp/sp/multi-chip meshes)")
+            if engine_cfg.spec_decode:
+                raise ValueError(
+                    "tiered-KV streaming does not compose with "
+                    "spec_decode (the streamed step has no verify "
+                    "block); disable one of them")
+            if model_cfg.is_moe and model_cfg.moe_impl == "dispatch":
+                raise ValueError(
+                    "tiered-KV streaming requires moe_impl='dense' on "
+                    "MoE models (the streamed per-layer loop uses the "
+                    "dense-compute MLP path)")
+            if model_cfg.attn_softcap or model_cfg.sliding_window:
+                raise ValueError(
+                    "tiered-KV streaming supports full attention only "
+                    "(no attn_softcap / sliding_window): a sliding "
+                    "window never exceeds the resident budget anyway")
+            from dynamo_tpu.engine.streaming import StreamingDecoder
+            self._streamer = StreamingDecoder(self)
+            self.scheduler.stream_enabled = True
+            self.scheduler.on_stream_finish = self._streamer.release
 
     def encode_image(self, pixels: np.ndarray) -> np.ndarray:
         """pixels [H, W, 3] or [B, H, W, 3] float in [0,1] ->
@@ -595,6 +622,7 @@ class NativeEngine:
             # before planning, on the same thread that applies injects
             s.poll_overlap_gates()
         return (self._pipeline is not None or bool(s.waiting)
+                or bool(s.stream_active)
                 or any(x is not None for x in s.running))
 
     def step(self) -> List[StepOutput]:
@@ -617,6 +645,8 @@ class NativeEngine:
         if plan is None:
             return []
         self.step_count += 1
+        if isinstance(plan, StreamPlan):
+            return self._run_stream(plan)
         if isinstance(plan, MixedPlan):
             return self._run_mixed(plan)
         if isinstance(plan, PrefillPlan):
@@ -708,11 +738,13 @@ class NativeEngine:
             self._pending_recompiles += 1
 
     def _ledger_record(self, kind: str, rows: int, rows_live: int,
-                       useful: int, padded: int) -> None:
+                       useful: int, padded: int, **stream_kw) -> None:
         """One ledger sample at a commit site. Host-state reads only
         (allocator counters, pool free lists, deque length) — the
         deferred-recorder discipline the ledger's overhead contract and
-        the decode hot-path region both require."""
+        the decode hot-path region both require. `stream_kw` carries a
+        streamed step's window-pool deltas (stream_hit/late/spilled/
+        stalls) straight through to record_step."""
         if not self.ledger.enabled:
             return
         alloc = self.scheduler.allocator
@@ -727,7 +759,31 @@ class NativeEngine:
             kind, rows, rows_live, useful, padded,
             alloc.num_pages - alloc.num_free, alloc.num_pages,
             host_used, host_total, disk_used, disk_total,
-            len(self.scheduler.waiting), rc)
+            len(self.scheduler.waiting), rc, **stream_kw)
+
+    def _run_stream(self, plan: StreamPlan) -> List[StepOutput]:
+        """One tiered-KV streamed step (engine/streaming.py): a prefill
+        chunk or one decoded token for a sequence whose context exceeds
+        the resident HBM budget. The streamer walks the per-layer
+        window-pool double buffer; this wrapper owns event emission and
+        the ledger sample (kind="stream", with the step's prefetch
+        hit/late/spill/stall deltas)."""
+        from dynamo_tpu.engine.streaming import STREAM_STATS
+        seq = plan.seq
+        st0 = (STREAM_STATS.prefetch_hit, STREAM_STATS.prefetch_late,
+               STREAM_STATS.pages_spilled, STREAM_STATS.stall_steps)
+        tok, _ = self._streamer.step(seq)
+        events: List[StepOutput] = []
+        if tok is not None:
+            seq.output.append(tok)
+            events.append(self._postprocess(seq, tok))
+        st1 = (STREAM_STATS.prefetch_hit, STREAM_STATS.prefetch_late,
+               STREAM_STATS.pages_spilled, STREAM_STATS.stall_steps)
+        self._ledger_record(
+            "stream", 1, 1, 1 if tok is not None else 0, 1,
+            stream_hit=st1[0] - st0[0], stream_late=st1[1] - st0[1],
+            stream_spilled=st1[2] - st0[2], stream_stalls=st1[3] - st0[3])
+        return events
 
     def _run_device_step(self, plan, reqs, mixed: bool = False):
         temp, top_k, top_p, seeds, counters, min_toks = \
@@ -1093,6 +1149,8 @@ class NativeEngine:
                 or self.scheduler.pending_pool_injects \
                 or self._pending_offloads:
             return False
+        if self.scheduler.stream_active:
+            return False   # streamed steps interleave; don't lock them out
         if self._wants_logprobs(plan.seqs) \
                 or self._rep_penalty_arrays(plan.seqs) is not None:
             return False
@@ -1495,10 +1553,34 @@ class NativeEngine:
         Logprob / penalty plans take one token per dispatch through the
         same fused program prefill uses."""
         samp = self._sampling_arrays(plan.seqs)
+        counters, min_toks = samp[4], samp[5]
         greedy = self._samp_cache.all_greedy
-        if plan.n_window > 1 \
-                and not self._wants_logprobs(plan.seqs) \
-                and self._rep_penalty_arrays(plan.seqs) is None:
+        with_lp = self._wants_logprobs(plan.seqs)
+        rp = self._rep_penalty_arrays(plan.seqs)
+        # speculative decoding composes with pp: the verify block is one
+        # prefill-shaped pp_forward (the GPipe stage scan already handles
+        # Tq > 1), so the same cost gate and accept loop run here as on
+        # tp/dp meshes (_run_decode)
+        if (self._verify_fn is not None and greedy and not with_lp
+                and rp is None):
+            if self._draft is not None:
+                caps = self._draft.caps(plan)
+                if sum(caps) and self._spec_worthwhile(plan, sum(caps)):
+                    drafts = self._draft.propose(plan, caps)
+                    return self._run_spec_decode(plan, drafts, counters,
+                                                 min_toks)
+            elif self._spec_bound_ok(plan):
+                drafts = self._gather_drafts(plan)
+                if any(drafts):
+                    if self._spec_worthwhile(
+                            plan, sum(len(d) for d in drafts)):
+                        return self._run_spec_decode(plan, drafts,
+                                                     counters, min_toks)
+                elif self._spec_gate_skips >= self.cfg.spec_probe_every:
+                    # see _run_decode: a granted probe that found no
+                    # drafts must still spend the probe
+                    self._spec_gate_skips = 0
+        if plan.n_window > 1 and not with_lp and rp is None:
             fused = not greedy and self._samp_cache.fused_eligible
             staged = self._stage_pp_window(plan, samp, greedy, fused)
             outs, nxt = self._dispatch_staged(staged, staged["first"])
@@ -1839,6 +1921,15 @@ class NativeEngine:
             if self.host_pool.disk is not None:
                 m.kv_disk_pages_used = self.host_pool.disk.used
                 m.kv_disk_pages_total = self.host_pool.disk.capacity
+        if self._streamer is not None:
+            from dynamo_tpu.engine.streaming import STREAM_STATS
+            m.kv_stream_steps = int(STREAM_STATS.stream_steps)
+            m.kv_stream_prefetch_hit = int(STREAM_STATS.prefetch_hit)
+            m.kv_stream_prefetch_late = int(STREAM_STATS.prefetch_late)
+            m.kv_stream_pages_spilled = int(STREAM_STATS.pages_spilled)
+            m.kv_stream_pages_quarantined = int(
+                STREAM_STATS.pages_quarantined)
+            m.kv_stream_stall_steps = int(STREAM_STATS.stall_steps)
         return m
 
     def moe_drop_rate(self) -> float:
@@ -2286,8 +2377,8 @@ def _engine_decode_window(cfg: ModelConfig, eos_ids: tuple, kernel_mesh,
 
 
 def _engine_verify_step(cfg: ModelConfig, eos_ids: tuple, sp_mesh,
-                        kernel_mesh, params, cache, tokens, positions,
-                        page_table, kv_lens, write_idx, counters,
+                        kernel_mesh, pp_mesh, params, cache, tokens,
+                        positions, page_table, kv_lens, write_idx, counters,
                         min_tokens):
     """Speculative-decoding verify: one prefill-shaped forward over each
     slot's [last_token, draft...] block, returning the greedy token at
@@ -2301,9 +2392,19 @@ def _engine_verify_step(cfg: ModelConfig, eos_ids: tuple, sp_mesh,
     """
     meta = AttnMetadata(positions=positions, page_table=page_table,
                         kv_lens=kv_lens, write_idx=write_idx)
-    logits, cache, aux = llama.forward(params, cfg, tokens, cache, meta,
-                                       sp_mesh=sp_mesh, mesh=kernel_mesh,
-                                       with_aux=True)
+    if pp_mesh is not None:
+        from dynamo_tpu.models.pp import pp_forward
+        logits, cache = pp_forward(params, cfg, tokens, cache, meta,
+                                   pp_mesh)
+        # the per-position argmax below must see full vocab rows — same
+        # replication argument as _engine_step's sampling tail
+        logits = jax.lax.with_sharding_constraint(
+            logits, NamedSharding(pp_mesh, P(None, None, None)))
+        aux = {}
+    else:
+        logits, cache, aux = llama.forward(params, cfg, tokens, cache, meta,
+                                           sp_mesh=sp_mesh, mesh=kernel_mesh,
+                                           with_aux=True)
     if eos_ids:
         # mirror sample_logits' min-tokens eos ban, per block position:
         # position j emits generated-token index counters+j
@@ -2328,14 +2429,10 @@ def _engine_step(cfg: ModelConfig, eos_ids: tuple, sp_mesh, kernel_mesh,
                         kv_lens=kv_lens, write_idx=write_idx)
     if pp_mesh is not None:
         from dynamo_tpu.models.pp import pp_forward
-        if with_mm:
-            # mm embeds mix happens before the pipeline; fold it here so
-            # pp_forward's stage-0 embed sees the final input rows
-            raise NotImplementedError(
-                "multimodal + pp is not supported yet (route vision "
-                "configs to tp/dp meshes)")
-        logits, cache = pp_forward(params, cfg, tokens, cache, meta,
-                                   pp_mesh)
+        logits, cache = pp_forward(
+            params, cfg, tokens, cache, meta, pp_mesh,
+            input_embeds=mm_embeds if with_mm else None,
+            embeds_mask=mm_mask if with_mm else None)
         # replicate before the sampling tail: pp_forward returns logits
         # vocab-sharded over "tp", and with jax_threefry_partitionable
         # =False (this build's default) a categorical draw partitioned

@@ -205,6 +205,12 @@ class SequenceState:
     qos_prio: int = 0
     qos_bypassed: int = 0
     preempted_by: Optional[str] = None
+    # tiered-KV streaming decode (engine/streaming.py): set at admission
+    # when the full page footprint exceeds stream_resident_pages. A
+    # streamed sequence never holds seq.pages — its residency plan
+    # (resident set, window-pool staging, spill victims) lives on the
+    # StreamingDecoder's StreamSeq record.
+    streamed: bool = False
 
     @property
     def total_len(self) -> int:

@@ -249,6 +249,15 @@ class HttpService:
         self._hedge_by_class = m.gauge(
             "llm_hedge_fired_by_class",
             "hedged dispatch: hedges fired per QoS class", ("qos",))
+        # tiered-KV streaming decode (engine/streaming.py STREAM_STATS):
+        # window-pool occupancy, prefetch hit/late outcomes, spill /
+        # promote / quarantine / recompute page counts, stall steps —
+        # same render-time fold (docs/OBSERVABILITY.md §9)
+        from dynamo_tpu.engine.streaming import StreamStats
+        self._kv_stream = {
+            name: m.gauge(f"llm_kv_stream_{name}",
+                          f"tiered-kv streaming: {name.replace('_', ' ')}")
+            for name in StreamStats.FIELDS}
         s = self.server
         s.route("POST", "/v1/chat/completions", self._chat)
         s.route("POST", "/v1/completions", self._completions)
@@ -330,6 +339,9 @@ class HttpService:
         from dynamo_tpu.observability.ledger import LEDGER_STATS
         for name, value in LEDGER_STATS.snapshot().items():
             self._engine[name].set(value=float(value))
+        from dynamo_tpu.engine.streaming import STREAM_STATS
+        for name, value in STREAM_STATS.snapshot().items():
+            self._kv_stream[name].set(value=float(value))
         from dynamo_tpu.runtime.autoscaler import AUTOSCALER_STATS
         for name, value in AUTOSCALER_STATS.snapshot().items():
             self._autoscaler[name].set(value=float(value))

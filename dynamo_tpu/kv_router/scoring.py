@@ -74,6 +74,15 @@ class WorkerMetrics:
     kv_host_pages_total: int = 0
     kv_disk_pages_used: int = 0
     kv_disk_pages_total: int = 0
+    # tiered-KV streaming decode (engine/streaming.py): streamed steps,
+    # double-buffer prefetch outcomes, spill / quarantine page counts
+    # and prefetch-stalled steps (0s on engines without stream_pages)
+    kv_stream_steps: int = 0
+    kv_stream_prefetch_hit: int = 0
+    kv_stream_prefetch_late: int = 0
+    kv_stream_pages_spilled: int = 0
+    kv_stream_pages_quarantined: int = 0
+    kv_stream_stall_steps: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "WorkerMetrics":

@@ -27,7 +27,7 @@ dispatch and ring-attention prefill compose with tp/ep/sp meshes, not pp.
 from __future__ import annotations
 
 import functools
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -80,6 +80,11 @@ def pp_param_shardings(cfg: ModelConfig) -> Params:
     }
     if not cfg.tie_word_embeddings:
         out["lm_head"] = P(None, "tp")
+    if cfg.vision is not None:
+        # the vision tower is layer-small: it stays stage-replicated with
+        # head/FFN dims over "tp" (the pp axis only shards text layers)
+        from dynamo_tpu.models import vision
+        out["vision"] = vision.param_shardings(cfg)
     return out
 
 
@@ -219,6 +224,8 @@ def pp_forward(
     mesh,
     n_micro: int = 0,             # 0 = min(pp, B) microbatches; snapped to
                                   # the largest divisor of B
+    input_embeds: Optional[jax.Array] = None,  # [B, Tq, D] mm patch embeds
+    embeds_mask: Optional[jax.Array] = None,   # [B, Tq] bool, True = patch
 ) -> tuple:
     """Pipeline-parallel equivalent of models/llama.forward (dense path).
 
@@ -238,8 +245,13 @@ def pp_forward(
     lw = cfg.layer_windows()
     wnds = None if lw is None else jnp.asarray(lw, jnp.int32)
     kvq = "k_scale" in cache
+    has_mm = input_embeds is not None
+    if has_mm and embeds_mask is None:
+        raise ValueError("pp_forward multimodal input needs embeds_mask "
+                         "(full-embeds input without token ids is a "
+                         "single-mesh-only path)")
     fwd = functools.partial(_pp_body, cfg, pp, tp, m, kvq,
-                            wnds is not None)
+                            wnds is not None, has_mm)
     in_specs = (P("tp", None), shardings["layers"], P(None), head_spec,
                 pp_cache_sharding(), pp_cache_sharding(),
                 P(), P(), P(), P(), P())
@@ -262,6 +274,11 @@ def pp_forward(
     if wnds is not None:
         in_specs = in_specs + (P("pp"),)
         args = args + (wnds,)
+    if has_mm:
+        # patch embeds ride replicated: only stage 0 reads them, and the
+        # mm prefill batch is small (one image-bearing request per chunk)
+        in_specs = in_specs + (P(), P())
+        args = args + (input_embeds, embeds_mask)
     specs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     out = shard_map_compat(fwd, **specs)(*args)
     if kvq:
@@ -271,19 +288,23 @@ def pp_forward(
     return logits, {"k": kc, "v": vc}
 
 
-def _pp_body(cfg, pp, tp, m, kvq, has_wnds,
+def _pp_body(cfg, pp, tp, m, kvq, has_wnds, has_mm,
              embed, layers, final_norm, head,
              kc, vc, tokens, positions, page_table, kv_lens, write_idx,
              *extra):
     """shard_map body: runs once per (pp, tp) shard with stage-local
     layers/cache. One GPipe schedule of m microbatches over pp stages.
-    `extra` carries (ksc, vsc) when kvq and the per-layer window array
-    when has_wnds, in that order."""
-    ksc = vsc = wnds = None
+    `extra` carries (ksc, vsc) when kvq, the per-layer window array when
+    has_wnds, then (input_embeds, embeds_mask) when has_mm, in that
+    order."""
+    ksc = vsc = wnds = mm_embeds = mm_mask = None
+    ex = list(extra)
     if kvq:
-        ksc, vsc = extra[0], extra[1]
+        ksc, vsc, ex = ex[0], ex[1], ex[2:]
     if has_wnds:
-        wnds = extra[-1]
+        wnds, ex = ex[0], ex[1:]
+    if has_mm:
+        mm_embeds, mm_mask = ex[0], ex[1]
     r = jax.lax.axis_index("pp")
     last = pp - 1
     b, tq = tokens.shape
@@ -303,7 +324,15 @@ def _pp_body(cfg, pp, tp, m, kvq, has_wnds,
     wi_mb = mb(write_idx)
     # prefill token ids are all known up front: one gather+psum for the
     # whole batch instead of a collective per scan tick (code-review r5)
-    x0_all = scale_embeds(_embed_lookup(embed, toks_mb).astype(dt), cfg)
+    x0_all = _embed_lookup(embed, toks_mb).astype(dt)
+    if has_mm:
+        # multimodal prefill: image-patch rows take the vision encoder's
+        # projected embeds, text rows keep the token embeds. Masked
+        # positions carry hashing salts, not vocab ids (scheduler._admit);
+        # _embed_lookup's bounds check already zeroed any out-of-range row
+        x0_all = jnp.where(mb(mm_mask)[..., None], mb(mm_embeds).astype(dt),
+                           x0_all)
+    x0_all = scale_embeds(x0_all, cfg)
 
     def tick(carry, t):
         x_prev, kc, vc, ksc_c, vsc_c = carry

@@ -144,6 +144,30 @@ class MetricsExporter:
                 ("disk_pages_used", "Disk KV tier pages in use"),
                 ("disk_pages_total", "Disk KV tier page capacity"),
             )}
+        # tiered-KV streaming decode (engine/streaming.py via
+        # EngineMetrics): contexts beyond the HBM page budget — prefetch
+        # hit/late is the double-buffer health signal (hit >> late on a
+        # well-provisioned tier), quarantines count verify-on-fetch rot
+        self.g_kv_stream = {
+            name: r.gauge(f"{PREFIX}_kv_stream_{name}", help_, labels)
+            for name, help_ in (
+                ("steps", "Streamed decode/prefill steps run"),
+                ("prefetch_hit",
+                 "Window-pool segment consumes served by a completed "
+                 "double-buffer prefetch"),
+                ("prefetch_late",
+                 "Window-pool segment consumes that staged synchronously "
+                 "(prefetch missed the compute window)"),
+                ("pages_spilled",
+                 "Resident KV pages spilled to the offload hierarchy by "
+                 "the attention-mass EWMA policy"),
+                ("pages_quarantined",
+                 "Cold pages that failed the verify-on-fetch checksum "
+                 "gate (each recomputed from its token span)"),
+                ("stall_steps",
+                 "Streamed steps that consumed at least one late "
+                 "segment"),
+            )}
         self.g_load_avg = r.gauge(
             f"{PREFIX}_load_avg", "Mean active KV blocks across workers")
         self.g_load_std = r.gauge(
@@ -276,7 +300,8 @@ class MetricsExporter:
                 self.g_usage, self.g_hit_rate, self.g_window_steps,
                 self.g_window_wasted, self.g_spec_proposed,
                 self.g_spec_accepted, *self.g_pipe.values(),
-                *self.g_kv_repr.values(), *self.g_engine.values())
+                *self.g_kv_repr.values(), *self.g_engine.values(),
+                *self.g_kv_stream.values())
 
     def _evict_worker_series(self, worker_id: str) -> None:
         for g in self._worker_gauges():
@@ -365,6 +390,18 @@ class MetricsExporter:
                 worker_id, value=m.kv_disk_pages_used)
             self.g_engine["disk_pages_total"].set(
                 worker_id, value=m.kv_disk_pages_total)
+            self.g_kv_stream["steps"].set(
+                worker_id, value=m.kv_stream_steps)
+            self.g_kv_stream["prefetch_hit"].set(
+                worker_id, value=m.kv_stream_prefetch_hit)
+            self.g_kv_stream["prefetch_late"].set(
+                worker_id, value=m.kv_stream_prefetch_late)
+            self.g_kv_stream["pages_spilled"].set(
+                worker_id, value=m.kv_stream_pages_spilled)
+            self.g_kv_stream["pages_quarantined"].set(
+                worker_id, value=m.kv_stream_pages_quarantined)
+            self.g_kv_stream["stall_steps"].set(
+                worker_id, value=m.kv_stream_stall_steps)
         self.g_load_avg.set(value=endpoints.load_avg)
         self.g_load_std.set(value=endpoints.load_std)
         self.g_workers.set(value=len(endpoints.workers))

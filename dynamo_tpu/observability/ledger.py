@@ -32,8 +32,11 @@ even that off.
 Per-step sample schema (one JSONL record per step after `drain()`):
     {"ts", "dt", "kind", "rows", "rows_live", "tokens_useful",
      "tokens_padded", "kv_used", "kv_total", "host_used", "host_total",
-     "disk_used", "disk_total", "waiting", "recompiles", "tok_s", "mfu"}
-`kind` is the step kind ("prefill" | "decode" | "mixed" | "spec");
+     "disk_used", "disk_total", "waiting", "recompiles", "stream_hit",
+     "stream_late", "stream_spilled", "stream_stalls", "tok_s", "mfu"}
+`kind` is the step kind ("prefill" | "decode" | "mixed" | "spec" |
+"stream" — the last is a tiered-KV streamed long-context step, whose
+stream_* columns carry that step's window-pool prefetch deltas);
 `tokens_padded` is the FULL bucket charge of the step ([Bb, Tb] or
 window steps x slots) so padded - useful is the bucket-ladder waste,
 attributable per step kind. `recompiles` counts NEW (program, bucket)
@@ -65,6 +68,7 @@ class LedgerStats:
         "steps_decode",           # decode windows (one per window)
         "steps_mixed",            # fused prefill+decode steps
         "steps_spec",             # speculative verify steps
+        "steps_stream",           # tiered-KV streamed long-context steps
         "recompiles",             # new (program, bucket) keys dispatched
         "tokens_useful",          # committed/consumed tokens, all kinds
         "tokens_padded",          # full bucket charge, all kinds
@@ -82,6 +86,15 @@ class LedgerStats:
         "disk_pages_total",
         "batch_rows_live",        # last step: live rows in the bucket
         "batch_rows_total",       # last step: bucket row capacity
+        # tiered-KV streaming decode (engine/streaming.py), cumulative
+        # across streamed steps: window-pool segments consumed from a
+        # prior prefetch vs staged synchronously (the double-buffer's
+        # hide-the-tier-latency verdict), pages spilled by the EWMA
+        # policy, and steps that stalled on >= 1 late segment
+        "stream_prefetch_hit",
+        "stream_prefetch_late",
+        "stream_pages_spilled",
+        "stream_stall_steps",
         "queue_depth",            # last step: requests waiting
         "tok_s",                  # EWMA instantaneous useful tok/s
         "mfu",                    # tok_s * flops/token / peak (0 = no peak)
@@ -130,7 +143,7 @@ def sampler_flops_per_token(cfg) -> float:
     return 5.0 * cfg.vocab_size
 
 
-_KINDS = ("prefill", "decode", "mixed", "spec")
+_KINDS = ("prefill", "decode", "mixed", "spec", "stream")
 
 
 class StepLedger:
@@ -189,9 +202,14 @@ class StepLedger:
                     kv_used: int, kv_total: int,
                     host_used: int, host_total: int,
                     disk_used: int, disk_total: int,
-                    waiting: int, recompiles: int) -> None:
+                    waiting: int, recompiles: int,
+                    stream_hit: int = 0, stream_late: int = 0,
+                    stream_spilled: int = 0, stream_stalls: int = 0) -> None:
         """Record one committed device step. Every argument is an
-        already-known host int — the disabled path is this one branch."""
+        already-known host int — the disabled path is this one branch.
+        The stream_* kwargs are this step's window-pool deltas (0 on
+        non-streamed kinds); they attribute the prefetch leg per step
+        in the drained JSONL (tools/decode_profile.py)."""
         if not self.enabled:
             return
         now = time.monotonic()
@@ -206,6 +224,7 @@ class StepLedger:
         rec = (now, dt, kind, rows, rows_live, useful, padded,
                kv_used, kv_total, host_used, host_total,
                disk_used, disk_total, waiting, recompiles,
+               stream_hit, stream_late, stream_spilled, stream_stalls,
                self._tok_s, mfu)
         if len(self._recs) < self.capacity:
             self._recs.append(rec)
@@ -237,6 +256,10 @@ class StepLedger:
         s.batch_rows_live = rows_live
         s.batch_rows_total = rows
         s.queue_depth = waiting
+        s.stream_prefetch_hit += stream_hit
+        s.stream_prefetch_late += stream_late
+        s.stream_pages_spilled += stream_spilled
+        s.stream_stall_steps += stream_stalls
         s.tok_s = self._tok_s
         s.mfu = mfu
         s.samples_dropped = self.dropped
@@ -273,7 +296,8 @@ class StepLedger:
         keys = ("ts", "dt", "kind", "rows", "rows_live", "tokens_useful",
                 "tokens_padded", "kv_used", "kv_total", "host_used",
                 "host_total", "disk_used", "disk_total", "waiting",
-                "recompiles", "tok_s", "mfu")
+                "recompiles", "stream_hit", "stream_late",
+                "stream_spilled", "stream_stalls", "tok_s", "mfu")
         out = []
         for rec in recs:
             d = dict(zip(keys, rec))

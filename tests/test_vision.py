@@ -293,3 +293,48 @@ def test_image_span_straddles_prefill_chunks():
     chunked = make_engine(max_prefill_chunk=16, prefill_buckets=(8, 16))
     got_chunked = run(chunked, "c")
     assert got_chunked == got_whole
+
+
+# -- pp composition ------------------------------------------------------------
+
+@pytest.mark.parametrize("pp,tp", [(2, 1), (2, 2)])
+def test_vision_pp_mesh_exact(pp, tp):
+    """Multimodal prefill composes with pp meshes: pp_param_shardings now
+    carries the vision subtree and _pp_body mixes the projected patch
+    embeds into stage 0's embedding lookup (the same embeds_mask semantics
+    as llama.forward). Tokens must match the single-mesh engine exactly.
+    Previously rejected at engine init (ROADMAP-1b)."""
+    import jax
+
+    from dynamo_tpu.parallel.mesh import make_mesh
+
+    img = image(7)
+    oracle = make_engine()
+    emb = oracle.encode_image(img)
+
+    def gen(eng, rid, e):
+        req = mm_request(rid, e)
+        eng.add_request(req)
+        out = []
+        while eng.has_work():
+            for ev in eng.step():
+                if ev.token is not None:
+                    out.append(ev.token)
+        return out
+
+    expect = gen(oracle, "o", emb)
+    # sanity: the image must actually influence the stream (otherwise a
+    # pp path that silently dropped the embeds would pass)
+    assert expect != oracle.generate(
+        [5, 6, 7, 8] + [0] * N_PATCH + [9, 10, 11, 12],
+        SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True),
+        "raw")
+
+    mesh = make_mesh(pp=pp, tp=tp, devices=jax.devices()[:pp * tp])
+    eng = NativeEngine(CFG, EngineConfig(
+        page_size=8, num_pages=64, max_slots=2, max_prefill_chunk=32,
+        prefill_buckets=(8, 16, 32), max_model_len=256), mesh=mesh, seed=0)
+    emb_pp = eng.encode_image(img)
+    np.testing.assert_allclose(np.asarray(emb_pp), np.asarray(emb),
+                               rtol=1e-5, atol=1e-5)
+    assert gen(eng, "p", emb_pp) == expect

@@ -198,6 +198,72 @@ def sampler_tail_split(args, vocab_size: int) -> dict:
     }
 
 
+def run_stream_pass(args) -> dict:
+    """Streamed long-context attribution (PERF.md §3h): one sequence
+    whose context is ~4x the HBM page budget, driven through the
+    tiered-KV streaming decode with profile_sync semantics (the stream
+    loop is host-driven, so its phases are already synchronous). The
+    PhaseTimer's `prefetch` phase isolates the double-buffer staging
+    leg; the stream counters qualify it — a hit-dominated run means
+    those seconds were ahead-of-consume copies, a late-dominated run
+    means the tier is slower than the decode cadence and the staging
+    time sat on the critical path."""
+    from dynamo_tpu.engine.config import (
+        EngineConfig, ModelConfig, get_model_config,
+    )
+    from dynamo_tpu.engine.engine import NativeEngine
+    from dynamo_tpu.engine.scheduler import EngineRequest, SamplingParams
+    from dynamo_tpu.engine.streaming import STREAM_STATS
+
+    if args.model == "tiny-f32":
+        mcfg = ModelConfig(dtype="float32", max_model_len=2048)
+    else:
+        mcfg = get_model_config(args.model)
+    page = args.stream_page_size
+    max_tokens = 8 * page
+    total_pages = -(-(args.stream_prompt_len + max_tokens) // page)
+    budget = max(total_pages // 4, 6)          # context = ~4x HBM budget
+    ecfg = EngineConfig(
+        page_size=page, num_pages=budget, max_slots=2,
+        max_prefill_chunk=8 * page,
+        prefill_buckets=(2 * page, 4 * page, 8 * page),
+        max_model_len=mcfg.max_model_len,
+        host_pages=2 * total_pages, stream_pages=4,
+        stream_resident_pages=max(budget - 2, 4), stream_hot_pages=2)
+    eng = NativeEngine(mcfg, ecfg, seed=0)
+    prompt = [(7 * i + 3) % (mcfg.vocab_size - 1) + 1
+              for i in range(args.stream_prompt_len)]
+    eng.add_request(EngineRequest("stream", prompt, SamplingParams(
+        max_tokens=max_tokens, temperature=0.0, ignore_eos=True)))
+    s0 = STREAM_STATS.snapshot()
+    eng.phases.reset()
+    tokens = 0
+    t0 = time.perf_counter()
+    while eng.has_work():
+        for ev in eng.step():
+            if ev.token is not None:
+                tokens += 1
+    wall = time.perf_counter() - t0
+    s1 = STREAM_STATS.snapshot()
+    delta = {k: s1[k] - s0[k] for k in s1}
+    hits, lates = delta["prefetch_hit"], delta["prefetch_late"]
+    phases = eng.phases.split()
+    return {
+        "context_tokens": args.stream_prompt_len + max_tokens,
+        "hbm_budget_pages": budget,
+        "context_pages": total_pages,
+        "wall_s": round(wall, 4),
+        "tokens": tokens,
+        "tok_s": round(tokens / wall, 1) if wall else 0.0,
+        "phases": phases,
+        "prefetch_s": round(
+            phases.get("prefetch", {}).get("seconds", 0.0), 4),
+        "stream_counters": delta,
+        "prefetch_hit_ratio": round(hits / (hits + lates), 4)
+        if hits + lates else 0.0,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", default="tiny-f32",
@@ -216,6 +282,14 @@ def main(argv=None) -> int:
                     help="also capture a jax.profiler trace here")
     ap.add_argument("--sampled", action="store_true",
                     help="seeded sampling (top_p=1): the fused-tail path")
+    ap.add_argument("--no-stream", action="store_true",
+                    help="skip the streamed long-context pass (PERF.md §3h)")
+    ap.add_argument("--stream-prompt-len", type=int, default=320,
+                    help="prompt length for the streamed pass (its HBM "
+                         "budget is derived as ~1/4 of the context pages)")
+    ap.add_argument("--stream-page-size", type=int, default=4,
+                    help="page size for the streamed pass (small pages "
+                         "keep the tiny-CPU stream geometry meaningful)")
     args = ap.parse_args(argv)
 
     import jax
@@ -230,6 +304,9 @@ def main(argv=None) -> int:
     vocab = (ModelConfig().vocab_size if args.model == "tiny-f32"
              else get_model_config(args.model).vocab_size)
     sampler_tail = sampler_tail_split(args, vocab)
+    # 4. the streamed long-context leg: prefetch-phase attribution for
+    # decode beyond the HBM page budget (PERF.md §3h)
+    stream = None if args.no_stream else run_stream_pass(args)
 
     host_phases = ("plan", "upload", "commit", "detok")
     hidden_s = sum(pipelined["phases"].get(p, {}).get("seconds", 0.0)
@@ -244,6 +321,7 @@ def main(argv=None) -> int:
         "attribution": attribution,
         "pipelined": pipelined,
         "sampler_tail": sampler_tail,
+        "stream": stream,
         "overlap": {
             # host seconds that executed while the device ran a window
             "host_s_overlapped_with_device": round(hidden_s, 4),

@@ -34,7 +34,8 @@ while true; do
       && [ -e BENCH_SELF_r17_pool_remote_tpu.json ] \
       && [ -e PARITY_TPU_r18_ragged.json ] \
       && [ -e BENCH_SELF_r18_ragged_tpu.json ] \
-      && [ -e BENCH_SELF_r19_failslow_tpu.json ]; then
+      && [ -e BENCH_SELF_r19_failslow_tpu.json ] \
+      && [ -e BENCH_SELF_r20_long_context_tpu.json ]; then
     echo "[watch] all TPU evidence captured; exiting" >&2
     exit 0
   fi
@@ -395,6 +396,42 @@ EOF
         else
           echo "[watch] fail-slow hedging run failed (log: $fl)" >&2
         fi
+      fi
+      if [ ! -e BENCH_SELF_r20_long_context_tpu.json ]; then
+        # tiered-KV streaming decode on hardware (ISSUE 20): the bench's
+        # long_context phase — a streamed engine whose HBM page budget is
+        # 1/4 of the context vs an oversized-HBM resident oracle, token
+        # identity asserted per rung, per-token ITL percentiles on both,
+        # prefetch hit/late/spill counters from STREAM_STATS — on the
+        # flagship's geometry — via the supervisor's ratio trajectory
+        # rows this is the measured row for the pre-registered
+        # long_context_itl_inflation_4x_llama3_1b_tpu gate in
+        # BASELINE.json (tools/bench_compare.py scores it), AND another
+        # recapture of the overdue real-TPU headline row (last measured:
+        # BENCH_r02's 81.33 tok/s/chip) the ROADMAP re-anchor asks every
+        # TPU window to take through the bench_compare gate
+        echo "[watch] -> long-context streaming bench" >&2
+        rm -f .bench_state.json
+        lj=/tmp/bench_l_$$.json ll=/tmp/bench_l_$$.log
+        BENCH_RUN_ID=BENCH_SELF_r20_long_context_tpu BENCH_KVQ=0 \
+          BENCH_OVERLAP=0 BENCH_WARM_PREFIX=0 BENCH_SHARDED=0 \
+          BENCH_DECODE_KERNEL=0 BENCH_BUDGET_S=1200 timeout 1500 \
+          python bench.py >"$lj" 2>"$ll"
+        lvalue=$(python -c "import json,sys;print(json.load(open(sys.argv[1]))['extras'].get('long_context',{}).get('itl_inflation_4x',0))" \
+            "$lj" 2>/dev/null || echo 0)
+        case "$lvalue" in
+          0|0.0|"") echo "[watch] long-context bench got no ratio" >&2 ;;
+          *)
+            python - "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$lj" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[2]))
+r["timestamp"] = sys.argv[1]
+r["self_measured"] = True
+json.dump(r, open("BENCH_SELF_r20_long_context_tpu.json", "w"), indent=1)
+EOF
+            cp "$ll" BENCH_SELF_r20_long_context_tpu.log 2>/dev/null
+            echo "[watch] long-context captured: streamed/resident ITL $lvalue" >&2 ;;
+        esac
       fi
       if [ ! -e BENCH_SELF_r05_spec.json ] \
           && [ -e BENCH_SELF_r05_int8.json ]; then
